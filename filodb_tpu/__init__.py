@@ -1,0 +1,24 @@
+"""filodb_tpu — a TPU-native, Prometheus-compatible, distributed time-series
+database framework with the capabilities of FiloDB (reference: filodb/FiloDB).
+
+Architecture (see SURVEY.md for the reference layer map this mirrors):
+
+- ``core``      — chunk encodings, schemas, ingestion records, partition keys
+                  (reference L0/L1: filodb.memory format/*, binaryrecord2/*).
+- ``memstore``  — sharded in-memory store: partitions, write buffers, sealed
+                  chunks, tag index, flush & eviction (reference L2).
+- ``store``     — persistence API + local column store, checkpoints
+                  (reference L3: store/*, cassandra/*).
+- ``query``     — PromQL front end, LogicalPlan, ExecPlan tree, range-vector
+                  model (reference L4/L6: query/*, prometheus/*).
+- ``ops``       — the TPU compute path: jit window kernels over staged
+                  ``[series, time]`` chunk blocks (replaces the reference's
+                  per-series iterator hot loops + Rust SIMD).
+- ``parallel``  — device mesh, sharded cross-series reduction (replaces
+                  Akka/Arrow-Flight scatter-gather with psum over ICI).
+- ``coordinator`` — planners, shard mapping, dispatch (reference L5).
+- ``api``       — Prometheus HTTP API (reference L6 http/).
+- ``gateway``   — line-protocol ingest parsers (reference L7 gateway/).
+"""
+
+__version__ = "0.1.0"

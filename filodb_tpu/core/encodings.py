@@ -1,0 +1,267 @@
+"""Chunk codecs: the numeric substrate (reference L0, filodb.memory.format).
+
+The reference stores each sealed chunk column as an immutable off-heap
+BinaryVector (BinaryVector.scala:19) in one of several wire formats
+(WireFormat.scala:8-38): delta-delta longs (DeltaDeltaVector.scala:28),
+NibblePack'd XOR doubles (NibblePack.scala:12, doc/compression.md:33-69),
+bit-packed ints (IntBinaryVector.scala), and 2D-delta histograms
+(HistogramVector.scala). This module re-designs those codecs for a host that
+stages *decoded fixed-shape arrays* to TPU HBM: codecs are vectorized numpy
+transforms over whole chunks (encode once at seal time, decode once at stage
+time) instead of per-element cursors. Formats are our own — byte-compatibility
+with the reference is a non-goal.
+
+Wire formats implemented here:
+
+- ``DeltaDelta``  — int64 sequences as base + slope + zigzag residuals,
+                    NibblePack'd; constant-slope shortcut (reference
+                    DeltaDeltaVector.scala:46-60 "const vector").
+- ``XorDouble``   — float64 as u64 XOR-with-previous streams, NibblePack'd
+                    (reference packDoubles, NibblePack.scala:73).
+- ``NibblePack``  — groups of 8 u64: nonzero bitmask byte + (trailing-zero
+                    nibbles, nibble count) header + packed nibbles (reference
+                    NibblePack.scala:108 pack8). Python impl here; C++
+                    acceleration in native/codecs.cpp behind the same API.
+- ``Delta2DHist`` — histogram chunks [T, B]: delta over time then over bucket
+                    axis, zigzag + NibblePack (reference HistogramVector 2DDELTA).
+
+Every codec round-trips exactly (lossless), including NaN payloads for
+doubles — NaN is Prometheus staleness and is load-bearing (SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+
+import numpy as np
+
+# Wire-format tags (our analog of WireFormat.scala vector type/subtype tags).
+FMT_CONST_DELTA = 1  # perfectly linear int64 sequence: base+slope only
+FMT_DELTA_DELTA = 2  # int64: base+slope+nibblepacked zigzag residuals
+FMT_XOR_DOUBLE = 3  # float64: xor-prev, nibblepacked
+FMT_RAW_I64 = 4  # fallback
+FMT_RAW_F64 = 5  # fallback
+FMT_DELTA2D_HIST = 6  # [T, B] int64 histogram: 2D delta, nibblepacked
+FMT_INT_PACK = 7  # small ints bit-packed to minimal nbits
+
+_HEADER = struct.Struct("<BxHI")  # fmt, pad, reserved, n_elements
+
+
+def _zigzag(v: np.ndarray) -> np.ndarray:
+    """Map signed int64 -> unsigned u64 with small magnitudes staying small."""
+    v = v.astype(np.int64)
+    return ((v << np.int64(1)) ^ (v >> np.int64(63))).astype(np.uint64)
+
+
+def _unzigzag(u: np.ndarray) -> np.ndarray:
+    u = u.astype(np.uint64)
+    return ((u >> np.uint64(1)).astype(np.int64)) ^ -(u & np.uint64(1)).astype(np.int64)
+
+
+# ---------------------------------------------------------------------------
+# NibblePack: 8-at-a-time nibble packing of u64 streams.
+# Group layout: [bitmask u8] then, if bitmask != 0:
+#   [header u8: low nibble = nnibbles-1, high nibble = trailing-zero nibbles]
+#   then nnibbles nibbles per nonzero value, low-nibble-first, byte-padded
+#   per group.
+# ---------------------------------------------------------------------------
+
+
+def nibble_pack(values: np.ndarray) -> bytes:
+    """Pack a u64 array. Pure-numpy group loop (C++ fast path in native/)."""
+    v = np.ascontiguousarray(values, dtype=np.uint64)
+    n = len(v)
+    out = bytearray()
+    for g0 in range(0, n, 8):
+        grp = v[g0 : g0 + 8]
+        nz = grp != 0
+        bitmask = 0
+        for i, x in enumerate(nz):
+            if x:
+                bitmask |= 1 << i
+        out.append(bitmask)
+        if bitmask == 0:
+            continue
+        nzvals = grp[nz]
+        # trailing / leading zero nibbles across all nonzero values
+        tz_bits = 64
+        lz_bits = 64
+        for x in nzvals:
+            xi = int(x)
+            tz_bits = min(tz_bits, (xi & -xi).bit_length() - 1)
+            lz_bits = min(lz_bits, 64 - xi.bit_length())
+        tz_nib = tz_bits // 4
+        lz_nib = lz_bits // 4
+        nnib = max(1, 16 - tz_nib - lz_nib)
+        out.append(((tz_nib & 0xF) << 4) | (nnib - 1))
+        # emit nibbles low-first
+        acc = 0
+        acc_n = 0
+        for x in nzvals:
+            xi = int(x) >> (tz_nib * 4)
+            for k in range(nnib):
+                acc |= ((xi >> (4 * k)) & 0xF) << (4 * acc_n)
+                acc_n += 1
+                if acc_n == 2:
+                    out.append(acc)
+                    acc = 0
+                    acc_n = 0
+        if acc_n:
+            out.append(acc)
+    return bytes(out)
+
+
+def nibble_unpack(data: bytes, n: int) -> np.ndarray:
+    """Inverse of :func:`nibble_pack`; returns u64 array of length n."""
+    out = np.zeros(n, dtype=np.uint64)
+    pos = 0
+    i = 0
+    mv = memoryview(data)
+    while i < n:
+        glen = min(8, n - i)
+        bitmask = mv[pos]
+        pos += 1
+        if bitmask == 0:
+            i += glen
+            continue
+        hdr = mv[pos]
+        pos += 1
+        tz_nib = hdr >> 4
+        nnib = (hdr & 0xF) + 1
+        n_nz = bin(bitmask).count("1")
+        total_nibbles = n_nz * nnib
+        nbytes = (total_nibbles + 1) // 2
+        chunk = int.from_bytes(mv[pos : pos + nbytes], "little")
+        pos += nbytes
+        vi = 0
+        mask_nib = (1 << (4 * nnib)) - 1
+        for b in range(glen):
+            if bitmask & (1 << b):
+                val = (chunk >> (4 * nnib * vi)) & mask_nib
+                out[i + b] = np.uint64((val << (4 * tz_nib)) & 0xFFFFFFFFFFFFFFFF)
+                vi += 1
+        i += glen
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Column codecs
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Encoded:
+    """An encoded chunk column: wire format tag + payload bytes + length."""
+
+    fmt: int
+    n: int
+    payload: bytes
+
+    def to_bytes(self) -> bytes:
+        return _HEADER.pack(self.fmt, 0, self.n) + self.payload
+
+    @staticmethod
+    def from_bytes(b: bytes) -> "Encoded":
+        fmt, _, n = _HEADER.unpack_from(b)
+        return Encoded(fmt, n, bytes(b[_HEADER.size :]))
+
+    @property
+    def nbytes(self) -> int:
+        return _HEADER.size + len(self.payload)
+
+
+def encode_int64(ts: np.ndarray) -> Encoded:
+    """Delta-delta encode int64 (timestamps, integral doubles, counts).
+
+    Mirrors DeltaDeltaVector.scala:28 — base + per-step slope + residuals —
+    with the const shortcut of :46-60 when the sequence is exactly linear.
+    """
+    ts = np.ascontiguousarray(ts, dtype=np.int64)
+    n = len(ts)
+    if n == 0:
+        return Encoded(FMT_CONST_DELTA, 0, struct.pack("<qq", 0, 0))
+    base = int(ts[0])
+    slope = int(round((int(ts[-1]) - base) / (n - 1))) if n > 1 else 0
+    pred = base + slope * np.arange(n, dtype=np.int64)
+    resid = ts - pred
+    if not resid.any():
+        return Encoded(FMT_CONST_DELTA, n, struct.pack("<qq", base, slope))
+    packed = nibble_pack(_zigzag(resid))
+    if len(packed) >= 8 * n:  # incompressible
+        return Encoded(FMT_RAW_I64, n, ts.tobytes())
+    return Encoded(FMT_DELTA_DELTA, n, struct.pack("<qq", base, slope) + packed)
+
+
+def encode_double(vals: np.ndarray) -> Encoded:
+    """Encode float64 values.
+
+    Integral-valued runs auto-promote to delta-delta int64 (the reference does
+    the same, DoubleVector.scala:86-99); otherwise XOR-with-previous then
+    NibblePack (NibblePack.scala:73 packDoubles). NaNs round-trip bit-exactly.
+    """
+    vals = np.ascontiguousarray(vals, dtype=np.float64)
+    n = len(vals)
+    finite = np.isfinite(vals)
+    if n and finite.all():
+        as_int = vals.astype(np.int64)
+        if (as_int == vals).all() and np.abs(vals).max() < 2**53:
+            enc = encode_int64(as_int)
+            if enc.fmt != FMT_RAW_I64:
+                return enc
+    bits = vals.view(np.uint64)
+    xored = np.empty_like(bits)
+    if n:
+        xored[0] = bits[0]
+        xored[1:] = bits[1:] ^ bits[:-1]
+    packed = nibble_pack(xored)
+    if len(packed) >= 8 * n:
+        return Encoded(FMT_RAW_F64, n, vals.tobytes())
+    return Encoded(FMT_XOR_DOUBLE, n, packed)
+
+
+def encode_hist(counts: np.ndarray) -> Encoded:
+    """Encode a histogram chunk ``[T, B]`` of cumulative bucket counts.
+
+    2D delta (reference HistogramVector.scala 2DDELTA subtype): delta along
+    time then along bucket axis leaves near-zero residuals for smooth
+    cumulative histograms; zigzag + NibblePack.
+    """
+    counts = np.ascontiguousarray(counts, dtype=np.int64)
+    t, b = counts.shape
+    d_time = np.diff(counts, axis=0, prepend=counts[:1] * 0)
+    d_time[0] = counts[0]
+    d2 = np.diff(d_time, axis=1, prepend=d_time[:, :1] * 0)
+    d2[:, 0] = d_time[:, 0]
+    packed = nibble_pack(_zigzag(d2.ravel()))
+    return Encoded(FMT_DELTA2D_HIST, t * b, struct.pack("<ii", t, b) + packed)
+
+
+def decode(enc: Encoded) -> np.ndarray:
+    """Decode any Encoded column back to its numpy array."""
+    if enc.fmt == FMT_CONST_DELTA:
+        base, slope = struct.unpack_from("<qq", enc.payload)
+        return base + slope * np.arange(enc.n, dtype=np.int64)
+    if enc.fmt == FMT_DELTA_DELTA:
+        base, slope = struct.unpack_from("<qq", enc.payload)
+        resid = _unzigzag(nibble_unpack(enc.payload[16:], enc.n))
+        return base + slope * np.arange(enc.n, dtype=np.int64) + resid
+    if enc.fmt == FMT_XOR_DOUBLE:
+        xored = nibble_unpack(enc.payload, enc.n)
+        bits = np.bitwise_xor.accumulate(xored)
+        return bits.view(np.float64).copy()
+    if enc.fmt == FMT_RAW_I64:
+        return np.frombuffer(enc.payload, dtype=np.int64, count=enc.n).copy()
+    if enc.fmt == FMT_RAW_F64:
+        return np.frombuffer(enc.payload, dtype=np.float64, count=enc.n).copy()
+    if enc.fmt == FMT_DELTA2D_HIST:
+        t, b = struct.unpack_from("<ii", enc.payload)
+        d2 = _unzigzag(nibble_unpack(enc.payload[8:], t * b)).reshape(t, b)
+        d_time = np.cumsum(d2, axis=1)
+        return np.cumsum(d_time, axis=0)
+    raise ValueError(f"unknown wire format {enc.fmt}")
+
+
+def decode_double(enc: Encoded) -> np.ndarray:
+    """Decode to float64 regardless of the on-wire integer promotion."""
+    return decode(enc).astype(np.float64, copy=False)

@@ -1,0 +1,126 @@
+"""Round-trip tests for chunk codecs (model: reference DoubleVectorTest,
+NibblePackTest, HistogramTest under core/src/test/scala/filodb.memory/format/)."""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.core import encodings as E
+
+
+def roundtrip_u64(vals):
+    v = np.asarray(vals, dtype=np.uint64)
+    packed = E.nibble_pack(v)
+    out = E.nibble_unpack(packed, len(v))
+    np.testing.assert_array_equal(out, v)
+    return packed
+
+
+class TestNibblePack:
+    def test_zeros(self):
+        packed = roundtrip_u64(np.zeros(16, dtype=np.uint64))
+        assert len(packed) == 2  # one bitmask byte per group of 8
+
+    def test_small_values(self):
+        roundtrip_u64([1, 2, 3, 4, 5, 6, 7, 8, 9, 10])
+
+    def test_mixed_zero_nonzero(self):
+        roundtrip_u64([0, 5, 0, 1 << 40, 0, 0, 7, 0, 3])
+
+    def test_large_values(self):
+        rng = np.random.default_rng(42)
+        roundtrip_u64(rng.integers(0, 2**63, 1000, dtype=np.uint64))
+
+    def test_max_u64(self):
+        roundtrip_u64([np.uint64(2**64 - 1)] * 9)
+
+    def test_trailing_zero_exploit(self):
+        # values with common trailing zeros should compress well
+        v = np.arange(8, dtype=np.uint64) << np.uint64(32)
+        packed = roundtrip_u64(v)
+        assert len(packed) < 8 * 8
+
+    def test_non_multiple_of_8(self):
+        for n in [1, 3, 7, 9, 15, 17]:
+            roundtrip_u64(np.arange(n, dtype=np.uint64) * 1000)
+
+    def test_empty(self):
+        assert E.nibble_unpack(E.nibble_pack(np.array([], dtype=np.uint64)), 0).size == 0
+
+
+class TestDeltaDelta:
+    def test_regular_timestamps_const(self):
+        ts = np.arange(0, 720 * 10_000, 10_000, dtype=np.int64) + 1_600_000_000_000
+        enc = E.encode_int64(ts)
+        assert enc.fmt == E.FMT_CONST_DELTA
+        assert enc.nbytes < 30  # base+slope only
+        np.testing.assert_array_equal(E.decode(enc), ts)
+
+    def test_jittered_timestamps(self):
+        rng = np.random.default_rng(0)
+        ts = 1_600_000_000_000 + np.arange(720, dtype=np.int64) * 10_000
+        ts += rng.integers(-50, 50, 720)
+        enc = E.encode_int64(ts)
+        assert enc.fmt == E.FMT_DELTA_DELTA
+        np.testing.assert_array_equal(E.decode(enc), ts)
+        assert enc.nbytes < 2 * 720  # ~2 bytes/sample for small jitter
+
+    def test_random_walk(self):
+        rng = np.random.default_rng(1)
+        ts = np.cumsum(rng.integers(-1000, 1000, 500)).astype(np.int64)
+        enc = E.encode_int64(ts)
+        np.testing.assert_array_equal(E.decode(enc), ts)
+
+    def test_single_and_empty(self):
+        np.testing.assert_array_equal(E.decode(E.encode_int64(np.array([42], dtype=np.int64))), [42])
+        assert E.decode(E.encode_int64(np.array([], dtype=np.int64))).size == 0
+
+    def test_negative(self):
+        ts = np.array([-(10**12), 5, -3, 10**14], dtype=np.int64)
+        np.testing.assert_array_equal(E.decode(E.encode_int64(ts)), ts)
+
+
+class TestDouble:
+    def test_integral_promotes(self):
+        v = np.arange(100, dtype=np.float64) * 5
+        enc = E.encode_double(v)
+        assert enc.fmt in (E.FMT_CONST_DELTA, E.FMT_DELTA_DELTA)
+        np.testing.assert_array_equal(E.decode_double(enc), v)
+
+    def test_gauge_values(self):
+        rng = np.random.default_rng(2)
+        v = 50 + 10 * rng.standard_normal(720)
+        enc = E.encode_double(v)
+        np.testing.assert_array_equal(E.decode_double(enc), v)
+
+    def test_nan_staleness_roundtrip(self):
+        v = np.array([1.5, np.nan, 2.5, np.nan, np.nan, 3.0])
+        out = E.decode_double(E.encode_double(v))
+        np.testing.assert_array_equal(np.isnan(out), np.isnan(v))
+        np.testing.assert_array_equal(out[~np.isnan(v)], v[~np.isnan(v)])
+
+    def test_counter_like_compresses(self):
+        # slowly increasing counter with repeated values: XOR stream is sparse
+        v = np.repeat(np.arange(90, dtype=np.float64) * 1000 + 0.5, 8)
+        enc = E.encode_double(v)
+        assert enc.nbytes < v.nbytes / 2
+        np.testing.assert_array_equal(E.decode_double(enc), v)
+
+    def test_inf_and_extremes(self):
+        v = np.array([np.inf, -np.inf, 1e308, -1e-308, 0.0, -0.0])
+        out = E.decode_double(E.encode_double(v))
+        np.testing.assert_array_equal(out.view(np.uint64), v.view(np.uint64))
+
+
+class TestHistogram:
+    def test_cumulative_hist_roundtrip(self):
+        rng = np.random.default_rng(3)
+        # cumulative counts over 64 buckets, increasing in time
+        incr = rng.poisson(3, size=(50, 64))
+        counts = np.cumsum(np.cumsum(incr, axis=1), axis=0).astype(np.int64)
+        enc = E.encode_hist(counts)
+        np.testing.assert_array_equal(E.decode(enc), counts)
+        assert enc.nbytes < counts.nbytes / 4
+
+    def test_hist_single_row(self):
+        counts = np.array([[1, 2, 3, 10]], dtype=np.int64)
+        np.testing.assert_array_equal(E.decode(E.encode_hist(counts)), counts)
