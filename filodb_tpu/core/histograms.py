@@ -1,0 +1,57 @@
+"""Histogram bucket schemes (reference L0 filodb.memory format/vectors/
+Histogram.scala:609-899 — Geometric, Custom, Base2Exponential schemes;
+quantile/fraction math at :64-130 moves to ops/kernels.py on device).
+
+A histogram sample is a vector of cumulative bucket counts aligned to a
+bucket scheme; the top bucket is +Inf. Native histograms are first-class:
+chunks store ``[T, B]`` count arrays (ideal TPU layout), and
+histogram_quantile runs as a vectorized kernel over ``[S, T, B]`` blocks.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class BucketScheme:
+    """Bucket upper bounds (``le`` values), last = +inf."""
+
+    les: tuple[float, ...]
+
+    @property
+    def num_buckets(self) -> int:
+        return len(self.les)
+
+    def bounds(self) -> np.ndarray:
+        return np.asarray(self.les, dtype=np.float64)
+
+
+def custom_buckets(les) -> BucketScheme:
+    les = tuple(float(x) for x in les)
+    if les[-1] != np.inf:
+        les = les + (np.inf,)
+    return BucketScheme(les)
+
+
+def geometric_buckets(first: float, multiplier: float, num: int) -> BucketScheme:
+    """reference GeometricBuckets (Histogram.scala:609)."""
+    les = tuple(first * multiplier**i for i in range(num)) + (np.inf,)
+    return BucketScheme(les)
+
+
+def base2_exp_buckets(scale: int, start_index: int, num: int) -> BucketScheme:
+    """OTel base-2 exponential scheme (reference Base2ExpHistogramBuckets,
+    Histogram.scala:684): bucket i upper bound = 2^((start+i+1) * 2^-scale),
+    with a zero bucket first."""
+    base = 2.0 ** (2.0**-scale)
+    les = (0.0,) + tuple(base ** (start_index + i + 1) for i in range(num)) + (np.inf,)
+    return BucketScheme(les)
+
+
+# The reference's default Prometheus-style scheme used by test fixtures
+PROM_DEFAULT = custom_buckets(
+    [0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10]
+)

@@ -1,0 +1,119 @@
+"""Ingestion records (reference L1: binaryrecord2/RecordBuilder.scala:34,
+RecordContainer.scala:28).
+
+The reference streams BinaryRecords into off-heap containers that are also
+the Kafka message format. Here the unit of ingest is a columnar
+``RecordBatch``: numpy arrays per column plus per-record series tags — the
+natural bulk form for both the host ingest loop and eventual TPU staging.
+A ``SeriesBatch`` is the grouped form (one series, many samples) that the
+memstore ingest hot path consumes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterable, Mapping, Sequence
+
+import numpy as np
+
+from .schemas import Schema, canonical_partkey, partkey_hash, shard_for
+
+
+@dataclass
+class RecordBatch:
+    """Columnar batch of ingestion records sharing one schema.
+
+    values maps column name -> array of shape [N] (DOUBLE/LONG) or [N, B]
+    (HISTOGRAM). ``tags[i]`` is record i's full series tag map.
+    """
+
+    schema: Schema
+    timestamps: np.ndarray
+    values: dict[str, np.ndarray]
+    tags: Sequence[Mapping[str, str]]
+    bucket_les: np.ndarray | None = None  # histogram schemas only
+
+    def __len__(self) -> int:
+        return len(self.timestamps)
+
+    def group_by_series(self) -> "list[SeriesBatch]":
+        """Group records by partition key, preserving time order within series."""
+        groups: dict[bytes, list[int]] = {}
+        keys: dict[bytes, Mapping[str, str]] = {}
+        for i, t in enumerate(self.tags):
+            pk = canonical_partkey(t)
+            groups.setdefault(pk, []).append(i)
+            keys.setdefault(pk, t)
+        out = []
+        for pk, idxs in groups.items():
+            ix = np.asarray(idxs)
+            out.append(
+                SeriesBatch(
+                    schema=self.schema,
+                    tags=dict(keys[pk]),
+                    timestamps=self.timestamps[ix],
+                    values={k: v[ix] for k, v in self.values.items()},
+                    bucket_les=self.bucket_les,
+                )
+            )
+        return out
+
+    def shard_split(self, spread: int, num_shards: int) -> dict[int, "RecordBatch"]:
+        """Partition a batch by destination shard (gateway shardingPipeline
+        analog, GatewayServer.scala:335)."""
+        shard_of = np.array([shard_for(t, spread, num_shards) for t in self.tags])
+        out: dict[int, RecordBatch] = {}
+        for s in np.unique(shard_of):
+            ix = np.nonzero(shard_of == s)[0]
+            out[int(s)] = RecordBatch(
+                self.schema,
+                self.timestamps[ix],
+                {k: v[ix] for k, v in self.values.items()},
+                [self.tags[i] for i in ix],
+                self.bucket_les,
+            )
+        return out
+
+
+@dataclass
+class SeriesBatch:
+    """Samples for a single series (one partition key), time-ordered."""
+
+    schema: Schema
+    tags: Mapping[str, str]
+    timestamps: np.ndarray
+    values: dict[str, np.ndarray]
+    bucket_les: np.ndarray | None = None
+
+    @property
+    def partkey(self) -> bytes:
+        return canonical_partkey(self.tags)
+
+    @property
+    def partkey_hash(self) -> int:
+        return partkey_hash(self.tags)
+
+
+def gauge_batch(
+    metric: str,
+    samples: Iterable[tuple[Mapping[str, str], int, float]],
+    schema: Schema | None = None,
+) -> RecordBatch:
+    """Convenience builder: (tags, ts_ms, value) triples -> RecordBatch."""
+    from .schemas import GAUGE, METRIC_TAG
+
+    schema = schema or GAUGE
+    tags_list, ts, vals = [], [], []
+    for tags, t, v in samples:
+        full = dict(tags)
+        full.setdefault(METRIC_TAG, metric)
+        tags_list.append(full)
+        ts.append(t)
+        vals.append(v)
+    col = schema.value_column
+    return RecordBatch(
+        schema,
+        np.asarray(ts, dtype=np.int64),
+        {col: np.asarray(vals, dtype=np.float64)},
+        tags_list,
+    )

@@ -1,0 +1,259 @@
+"""Schemas & datasets (reference L1: filodb.core metadata/Schemas.scala:171,264,
+Column.scala, Dataset.scala:38).
+
+FiloDB is multi-schema: each time series carries a schema id chosen at ingest
+by its column layout (gauge vs counter vs native histogram ...), and the query
+engine picks decode/correction behavior per schema (filodb-defaults.conf:220-400
+defines the standard set). We keep that model: a ``Schema`` is a named tuple of
+typed data columns plus semantic flags (counter drop-detection, downsample
+links); the registry below mirrors the reference's standard schemas.
+
+Partition keys: a series identity is its tag map (including ``__name__``/
+``_metric_``) under shard-key columns ``_ws_``/``_ns_``/``_metric_``
+(Dataset.scala:73). Hashing for shard routing reproduces the reference's
+spread model (ShardMapper.scala): the top bits of the shard come from the
+shard-key hash (so one metric lands on 2^spread shards) and the low ``spread``
+bits from the full partition-key hash.
+"""
+
+from __future__ import annotations
+
+import enum
+import hashlib
+from dataclasses import dataclass, field
+from typing import Mapping, Sequence
+
+SHARD_KEY_TAGS = ("_ws_", "_ns_", "_metric_")
+METRIC_TAG = "_metric_"
+PROM_METRIC_TAG = "__name__"
+
+
+class ColumnType(enum.Enum):
+    TIMESTAMP = "ts"
+    DOUBLE = "double"
+    LONG = "long"
+    HISTOGRAM = "hist"
+    STRING = "string"
+
+
+@dataclass(frozen=True)
+class Column:
+    name: str
+    ctype: ColumnType
+    # counter semantics: monotonically-increasing, detect resets at ingest
+    # (reference Column params detectDrops, Schemas prom-counter)
+    is_counter: bool = False
+    # delta temporality (OTel delta counters/histograms): values are already
+    # per-interval increases, no correction needed
+    is_delta: bool = False
+
+
+@dataclass(frozen=True)
+class DownsampleSpec:
+    """Ingest-time downsample functions per column (reference
+    downsample/ChunkDownsampler.scala:38 dMin/dMax/dSum/dCount/dAvg/tTime)."""
+
+    funcs: Sequence[str] = ()
+    target_schema: str = ""
+
+
+@dataclass(frozen=True)
+class Schema:
+    name: str
+    columns: Sequence[Column]
+    value_column: str  # the default column queries read
+    downsample: DownsampleSpec | None = None
+
+    @property
+    def schema_id(self) -> int:
+        # stable 16-bit id from name+layout hash (reference Schemas.scala hashes
+        # column definitions into a schemaID embedded in part keys)
+        h = hashlib.blake2b(
+            (self.name + "|" + ",".join(f"{c.name}:{c.ctype.value}" for c in self.columns)).encode(),
+            digest_size=2,
+        ).digest()
+        return int.from_bytes(h, "little")
+
+    def column(self, name: str) -> Column:
+        for c in self.columns:
+            if c.name == name:
+                return c
+        raise KeyError(f"schema {self.name} has no column {name}")
+
+    @property
+    def has_histogram(self) -> bool:
+        return any(c.ctype == ColumnType.HISTOGRAM for c in self.columns)
+
+
+def _ts() -> Column:
+    return Column("timestamp", ColumnType.TIMESTAMP)
+
+
+# The standard schema registry (reference filodb-defaults.conf:220-400).
+SCHEMAS: dict[str, Schema] = {}
+
+
+def _register(s: Schema) -> Schema:
+    SCHEMAS[s.name] = s
+    return s
+
+
+GAUGE = _register(
+    Schema(
+        "gauge",
+        [_ts(), Column("value", ColumnType.DOUBLE)],
+        "value",
+        DownsampleSpec(("dMin", "dMax", "dSum", "dCount", "dAvg"), "ds-gauge"),
+    )
+)
+UNTYPED = _register(Schema("untyped", [_ts(), Column("value", ColumnType.DOUBLE)], "value"))
+PROM_COUNTER = _register(
+    Schema(
+        "prom-counter",
+        [_ts(), Column("count", ColumnType.DOUBLE, is_counter=True)],
+        "count",
+        DownsampleSpec(("tTime", "dLast"), "prom-counter"),
+    )
+)
+DELTA_COUNTER = _register(
+    Schema(
+        "delta-counter",
+        [_ts(), Column("count", ColumnType.DOUBLE, is_delta=True)],
+        "count",
+        DownsampleSpec(("tTime", "dSum"), "delta-counter"),
+    )
+)
+PROM_HISTOGRAM = _register(
+    Schema(
+        "prom-histogram",
+        [
+            _ts(),
+            Column("sum", ColumnType.DOUBLE, is_counter=True),
+            Column("count", ColumnType.DOUBLE, is_counter=True),
+            Column("h", ColumnType.HISTOGRAM, is_counter=True),
+        ],
+        "h",
+        DownsampleSpec(("tTime", "dLast", "dLast", "hLast"), "prom-histogram"),
+    )
+)
+DELTA_HISTOGRAM = _register(
+    Schema(
+        "delta-histogram",
+        [
+            _ts(),
+            Column("sum", ColumnType.DOUBLE, is_delta=True),
+            Column("count", ColumnType.DOUBLE, is_delta=True),
+            Column("h", ColumnType.HISTOGRAM, is_delta=True),
+        ],
+        "h",
+    )
+)
+OTEL_CUMULATIVE_HISTOGRAM = _register(
+    Schema(
+        "otel-cumulative-histogram",
+        [
+            _ts(),
+            Column("sum", ColumnType.DOUBLE, is_counter=True),
+            Column("count", ColumnType.DOUBLE, is_counter=True),
+            Column("h", ColumnType.HISTOGRAM, is_counter=True),
+            Column("min", ColumnType.DOUBLE),
+            Column("max", ColumnType.DOUBLE),
+        ],
+        "h",
+    )
+)
+OTEL_DELTA_HISTOGRAM = _register(
+    Schema(
+        "otel-delta-histogram",
+        [
+            _ts(),
+            Column("sum", ColumnType.DOUBLE, is_delta=True),
+            Column("count", ColumnType.DOUBLE, is_delta=True),
+            Column("h", ColumnType.HISTOGRAM, is_delta=True),
+            Column("min", ColumnType.DOUBLE),
+            Column("max", ColumnType.DOUBLE),
+        ],
+        "h",
+    )
+)
+OTEL_EXP_DELTA_HISTOGRAM = _register(
+    Schema(
+        "otel-exp-delta-histogram",
+        [
+            _ts(),
+            Column("sum", ColumnType.DOUBLE, is_delta=True),
+            Column("count", ColumnType.DOUBLE, is_delta=True),
+            Column("h", ColumnType.HISTOGRAM, is_delta=True),
+        ],
+        "h",
+    )
+)
+
+
+def schema_by_id(sid: int) -> Schema:
+    for s in SCHEMAS.values():
+        if s.schema_id == sid:
+            return s
+    raise KeyError(f"unknown schema id {sid}")
+
+
+@dataclass(frozen=True)
+class DatasetOptions:
+    shard_key_columns: Sequence[str] = SHARD_KEY_TAGS
+    metric_column: str = METRIC_TAG
+
+
+@dataclass
+class Dataset:
+    """dataset = name + allowed schemas + options (reference Dataset.scala:38)."""
+
+    name: str
+    schemas: Sequence[Schema] = field(default_factory=lambda: list(SCHEMAS.values()))
+    options: DatasetOptions = field(default_factory=DatasetOptions)
+
+
+# ---------------------------------------------------------------------------
+# Partition / shard key hashing
+# ---------------------------------------------------------------------------
+
+
+def canonical_partkey(tags: Mapping[str, str]) -> bytes:
+    """Canonical byte form of a series identity: sorted tag pairs.
+
+    Prometheus ``__name__`` is normalized to ``_metric_`` (reference
+    PrometheusInputRecord conversion, gateway/.../InputRecord.scala:15).
+    """
+    items = []
+    for k, v in tags.items():
+        if k == PROM_METRIC_TAG:
+            k = METRIC_TAG
+        items.append((k, v))
+    items.sort()
+    return "\x00".join(f"{k}\x01{v}" for k, v in items).encode()
+
+
+def hash64(data: bytes) -> int:
+    return int.from_bytes(hashlib.blake2b(data, digest_size=8).digest(), "little")
+
+
+def partkey_hash(tags: Mapping[str, str]) -> int:
+    return hash64(canonical_partkey(tags))
+
+
+def shardkey_hash(tags: Mapping[str, str], options: DatasetOptions = DatasetOptions()) -> int:
+    """Hash of only the shard-key columns (RecordBuilder.shardKeyHash analog)."""
+    norm = {(METRIC_TAG if k == PROM_METRIC_TAG else k): v for k, v in tags.items()}
+    parts = "\x00".join(f"{c}\x01{norm.get(c, '')}" for c in options.shard_key_columns)
+    return hash64(parts.encode())
+
+
+def ingestion_shard(shard_key_hash: int, part_key_hash: int, spread: int, num_shards: int) -> int:
+    """Shard routing with spread (reference ShardMapper.ingestionShard):
+    high bits select the 2^spread shard group for the shard key, low ``spread``
+    bits distribute the group's series by full partition hash."""
+    mask = (1 << spread) - 1
+    return (((shard_key_hash & ~mask) | (part_key_hash & mask)) & 0x7FFFFFFF) % num_shards
+
+
+def shard_for(tags: Mapping[str, str], spread: int, num_shards: int) -> int:
+    return ingestion_shard(shardkey_hash(tags), partkey_hash(tags), spread, num_shards)
