@@ -1,0 +1,159 @@
+"""Part-key tag index (reference L2: memstore/PartKeyIndex.scala traits,
+PartKeyLuceneIndex.scala:70 / PartKeyTantivyIndex.scala:38 + 6.3k Rust).
+
+The reference indexes each series' tag map in Lucene or Tantivy and answers
+``partIdsFromFilters`` (:655), label-names/values, and start/end-time queries.
+This is a host-side inverted index re-designed for the query shapes PromQL
+actually issues: per (tag key -> value -> posting set) with anchored-regex and
+time-overlap filtering. Pure-Python posting sets here; the C++ fast path
+(native/index.cpp) plugs in behind the same class when built.
+
+Regex fast path: patterns that are pure alternations of literals
+(``a|b|c``) expand to set unions without scanning values (the reference's
+tantivy_utils has the same "range-aware regex" optimization).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Iterable, Mapping, Sequence
+
+import numpy as np
+
+from ..core.filters import ColumnFilter
+
+_LITERAL_ALT = re.compile(r"^[\w.+-]+(\|[\w.+-]*)*$")
+
+
+class PartKeyIndex:
+    """Inverted index over one shard's partition keys."""
+
+    def __init__(self):
+        self._postings: dict[str, dict[str, set[int]]] = {}
+        self._tags: dict[int, Mapping[str, str]] = {}
+        self._start: dict[int, int] = {}
+        self._end: dict[int, int] = {}
+        self._all: set[int] = set()
+
+    # -- write -------------------------------------------------------------
+
+    def add_partkey(self, part_id: int, tags: Mapping[str, str], start_ts: int, end_ts: int = 2**62) -> None:
+        """reference addPartKey (PartKeyLuceneIndex.scala:505). end defaults to
+        'still ingesting' (Long.MaxValue analog)."""
+        self._tags[part_id] = tags
+        self._start[part_id] = start_ts
+        self._end[part_id] = end_ts
+        self._all.add(part_id)
+        for k, v in tags.items():
+            self._postings.setdefault(k, {}).setdefault(v, set()).add(part_id)
+
+    def update_end_time(self, part_id: int, end_ts: int) -> None:
+        """reference updatePartKeyWithEndTime:628 (series stopped ingesting)."""
+        self._end[part_id] = end_ts
+
+    def remove(self, part_ids: Iterable[int]) -> None:
+        for pid in part_ids:
+            tags = self._tags.pop(pid, None)
+            if tags is None:
+                continue
+            self._all.discard(pid)
+            self._start.pop(pid, None)
+            self._end.pop(pid, None)
+            for k, v in tags.items():
+                s = self._postings.get(k, {}).get(v)
+                if s is not None:
+                    s.discard(pid)
+                    if not s:
+                        del self._postings[k][v]
+
+    # -- query -------------------------------------------------------------
+
+    def _ids_for_filter(self, f: ColumnFilter) -> set[int]:
+        vals = self._postings.get(f.column, {})
+        if f.op == "=":
+            return set(vals.get(f.value, ()))
+        if f.op == "in":
+            out: set[int] = set()
+            for v in f.value:
+                out |= vals.get(v, set())
+            return out
+        if f.op == "=~" and isinstance(f.value, str) and _LITERAL_ALT.match(f.value):
+            out = set()
+            for v in f.value.split("|"):
+                out |= vals.get(v, set())
+            return out
+        # negative / general-regex filters scan the value dictionary, then
+        # must also include series missing the tag for negative matchers
+        # (PromQL: {k!="v"} matches series without k at all when v != "")
+        out = set()
+        for v, ids in vals.items():
+            if f.matches(v):
+                out |= ids
+        if f.op in ("!=", "!~", "not in") and f.matches(None):
+            tagged = set()
+            for ids in vals.values():
+                tagged |= ids
+            out |= self._all - tagged
+        return out
+
+    def part_ids_from_filters(
+        self, filters: Sequence[ColumnFilter], start_ts: int, end_ts: int, limit: int | None = None
+    ) -> np.ndarray:
+        """AND of filters + [start,end] overlap (reference partIdsFromFilters)."""
+        ids: set[int] | None = None
+        # apply equality filters first — cheapest and most selective
+        ordered = sorted(filters, key=lambda f: 0 if f.op in ("=", "in") else 1)
+        for f in ordered:
+            s = self._ids_for_filter(f)
+            ids = s if ids is None else ids & s
+            if not ids:
+                return np.empty(0, dtype=np.int32)
+        if ids is None:
+            ids = set(self._all)
+        out = [p for p in ids if self._start[p] <= end_ts and self._end[p] >= start_ts]
+        out.sort()
+        if limit is not None:
+            out = out[:limit]
+        return np.asarray(out, dtype=np.int32)
+
+    def label_names(self, filters: Sequence[ColumnFilter], start_ts: int, end_ts: int) -> list[str]:
+        """reference labelNamesEfficient:397."""
+        if not filters:
+            return sorted(self._postings.keys())
+        pids = self.part_ids_from_filters(filters, start_ts, end_ts)
+        names: set[str] = set()
+        for p in pids:
+            names |= set(self._tags[int(p)].keys())
+        return sorted(names)
+
+    def label_values(
+        self, filters: Sequence[ColumnFilter], label: str, start_ts: int, end_ts: int, limit: int | None = None
+    ) -> list[str]:
+        """reference indexValues:445 / labelValuesEfficient."""
+        if not filters:
+            vals = sorted(self._postings.get(label, {}).keys())
+        else:
+            pids = self.part_ids_from_filters(filters, start_ts, end_ts)
+            vset = {self._tags[int(p)].get(label) for p in pids}
+            vals = sorted(v for v in vset if v is not None)
+        return vals[:limit] if limit else vals
+
+    def partkeys_from_filters(
+        self, filters: Sequence[ColumnFilter], start_ts: int, end_ts: int, limit: int | None = None
+    ) -> list[Mapping[str, str]]:
+        return [self._tags[int(p)] for p in self.part_ids_from_filters(filters, start_ts, end_ts, limit)]
+
+    def start_time(self, part_id: int) -> int:
+        return self._start[part_id]
+
+    def end_time(self, part_id: int) -> int:
+        return self._end[part_id]
+
+    def tags_of(self, part_id: int) -> Mapping[str, str]:
+        return self._tags[part_id]
+
+    def __len__(self) -> int:
+        return len(self._all)
+
+    def cardinality(self, label: str) -> int:
+        return len(self._postings.get(label, {}))

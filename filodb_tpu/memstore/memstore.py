@@ -1,0 +1,94 @@
+"""Memstore facade: per-dataset shard map (reference L2:
+memstore/TimeSeriesMemStore.scala:26 — setup:85, ingest:148, startIngestion:154).
+
+This is also the ChunkSource the query engine reads (reference
+store/ChunkSource.scala:87,161): lookup + staging of series windows.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Sequence
+
+import numpy as np
+
+from ..core.filters import ColumnFilter
+from ..core.records import RecordBatch
+from ..core.schemas import Dataset
+from .shard import StoreConfig, TimeSeriesShard
+
+
+class TimeSeriesMemStore:
+    def __init__(self, store_config: StoreConfig | None = None):
+        self._datasets: dict[str, dict[int, TimeSeriesShard]] = {}
+        self._dataset_meta: dict[str, Dataset] = {}
+        self.store_config = store_config or StoreConfig()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def setup(self, dataset: Dataset, shard_nums: Sequence[int]) -> None:
+        shards = self._datasets.setdefault(dataset.name, {})
+        self._dataset_meta[dataset.name] = dataset
+        for s in shard_nums:
+            if s not in shards:
+                shards[s] = TimeSeriesShard(dataset.name, s, self.store_config)
+
+    def shard(self, dataset: str, shard_num: int) -> TimeSeriesShard:
+        return self._datasets[dataset][shard_num]
+
+    def shards(self, dataset: str) -> list[TimeSeriesShard]:
+        return list(self._datasets.get(dataset, {}).values())
+
+    def shard_nums(self, dataset: str) -> list[int]:
+        return sorted(self._datasets.get(dataset, {}).keys())
+
+    def dataset(self, name: str) -> Dataset:
+        return self._dataset_meta[name]
+
+    # -- ingest --------------------------------------------------------------
+
+    def ingest(self, dataset: str, shard_num: int, batch: RecordBatch, offset: int = -1) -> int:
+        return self.shard(dataset, shard_num).ingest(batch, offset)
+
+    def ingest_routed(self, dataset: str, batch: RecordBatch, spread: int) -> int:
+        """Route a mixed batch to owned shards by shard-key hash (gateway path)."""
+        shards = self._datasets[dataset]
+        n = 0
+        for snum, sub in batch.shard_split(spread, max(shards) + 1).items():
+            if snum in shards:
+                n += shards[snum].ingest(sub)
+        return n
+
+    # -- query side ----------------------------------------------------------
+
+    def lookup(
+        self, dataset: str, filters: Sequence[ColumnFilter], start_ts: int, end_ts: int,
+        shard_nums: Sequence[int] | None = None, limit: int | None = None,
+    ) -> list[tuple[int, np.ndarray]]:
+        """(shard_num, part_ids) per shard with matches."""
+        out = []
+        for s in shard_nums if shard_nums is not None else self.shard_nums(dataset):
+            pids = self.shard(dataset, s).lookup_partitions(filters, start_ts, end_ts, limit)
+            if len(pids):
+                out.append((s, pids))
+        return out
+
+    def label_values(self, dataset, filters, label, start_ts, end_ts, limit=None) -> list[str]:
+        vals: set[str] = set()
+        for sh in self.shards(dataset):
+            vals.update(sh.label_values(filters, label, start_ts, end_ts, limit))
+        out = sorted(vals)
+        return out[:limit] if limit else out
+
+    def label_names(self, dataset, filters, start_ts, end_ts) -> list[str]:
+        names: set[str] = set()
+        for sh in self.shards(dataset):
+            names.update(sh.label_names(filters, start_ts, end_ts))
+        return sorted(names)
+
+    def series(self, dataset, filters, start_ts, end_ts, limit=None) -> list[Mapping[str, str]]:
+        out: list[Mapping[str, str]] = []
+        for sh in self.shards(dataset):
+            out.extend(sh.partkeys(filters, start_ts, end_ts, limit))
+            if limit and len(out) >= limit:
+                return out[:limit]
+        return out

@@ -1,0 +1,251 @@
+"""One time series in memory (reference L2: memstore/TimeSeriesPartition.scala:64).
+
+The reference appends rows into per-column off-heap write buffers, then
+``switchBuffers`` (:232) seals them into immutable encoded BinaryVectors via
+``optimize()``. Here a partition appends into growable numpy buffers and seals
+fixed-max-size ``Chunk``s; sealed chunks optionally hold their codec-encoded
+form (for flush/persistence and memory savings) and/or the decoded arrays (for
+zero-cost query staging). Chunk metadata mirrors ChunkSetInfo (store/
+ChunkSetInfo.scala:60): id = start time, numRows, endTime.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Mapping
+
+import numpy as np
+
+from ..core.encodings import Encoded, decode, encode_double, encode_hist, encode_int64
+from ..core.schemas import Column, ColumnType, Schema
+
+DEFAULT_MAX_CHUNK_SIZE = 400  # samples per chunk (reference store config default)
+
+
+@dataclass
+class Chunk:
+    """Immutable sealed chunk: one time range of one series, all columns."""
+
+    start_ts: int
+    end_ts: int
+    n: int
+    # decoded columns (None if evicted to encoded-only form)
+    arrays: dict[str, np.ndarray] | None
+    # encoded columns (populated at seal when encode=True, or at flush)
+    encoded: dict[str, Encoded] | None = None
+
+    def column(self, name: str) -> np.ndarray:
+        if self.arrays is not None:
+            return self.arrays[name]
+        assert self.encoded is not None
+        return decode(self.encoded[name])
+
+    def ensure_encoded(self, schema: Schema) -> dict[str, Encoded]:
+        if self.encoded is None:
+            assert self.arrays is not None
+            self.encoded = _encode_columns(schema, self.arrays)
+        return self.encoded
+
+    def drop_decoded(self, schema: Schema) -> None:
+        """Keep only the compressed form (reference: post-optimize() state)."""
+        self.ensure_encoded(schema)
+        self.arrays = None
+
+    @property
+    def nbytes_encoded(self) -> int:
+        return sum(e.nbytes for e in self.encoded.values()) if self.encoded else 0
+
+
+def _encode_columns(schema: Schema, arrays: Mapping[str, np.ndarray]) -> dict[str, Encoded]:
+    out = {}
+    for col in schema.columns:
+        if col.name not in arrays:
+            continue
+        a = arrays[col.name]
+        if col.ctype == ColumnType.TIMESTAMP or col.ctype == ColumnType.LONG:
+            out[col.name] = encode_int64(a)
+        elif col.ctype == ColumnType.DOUBLE:
+            out[col.name] = encode_double(a)
+        elif col.ctype == ColumnType.HISTOGRAM:
+            out[col.name] = encode_hist(a)
+    return out
+
+
+class TimeSeriesPartition:
+    """Write buffers + sealed chunk list for one series."""
+
+    __slots__ = (
+        "part_id",
+        "tags",
+        "schema",
+        "partkey",
+        "chunks",
+        "_buf",
+        "_buf_len",
+        "max_chunk_size",
+        "encode_on_seal",
+        "bucket_les",
+        "flushed_until",
+    )
+
+    def __init__(
+        self,
+        part_id: int,
+        tags: Mapping[str, str],
+        schema: Schema,
+        partkey: bytes,
+        max_chunk_size: int = DEFAULT_MAX_CHUNK_SIZE,
+        encode_on_seal: bool = False,
+        bucket_les: np.ndarray | None = None,
+    ):
+        self.part_id = part_id
+        self.tags = dict(tags)
+        self.schema = schema
+        self.partkey = partkey
+        self.chunks: list[Chunk] = []
+        self._buf: dict[str, np.ndarray] | None = None
+        self._buf_len = 0
+        self.max_chunk_size = max_chunk_size
+        self.encode_on_seal = encode_on_seal
+        self.bucket_les = bucket_les
+        self.flushed_until: int = -(2**62)  # flush watermark (ts)
+
+    # -- ingest ------------------------------------------------------------
+
+    def _alloc_buf(self, values: Mapping[str, np.ndarray]) -> None:
+        cap = self.max_chunk_size
+        buf: dict[str, np.ndarray] = {"timestamp": np.empty(cap, dtype=np.int64)}
+        for name, arr in values.items():
+            if arr.ndim == 2:
+                buf[name] = np.empty((cap, arr.shape[1]), dtype=arr.dtype)
+            else:
+                buf[name] = np.empty(cap, dtype=arr.dtype)
+        self._buf = buf
+        self._buf_len = 0
+
+    def ingest(self, timestamps: np.ndarray, values: Mapping[str, np.ndarray]) -> int:
+        """Append a time-ordered sample run; seals full chunks as it goes.
+        Returns number of rows ingested (out-of-order rows are dropped, as the
+        reference does — TimeSeriesPartition ingest drops rows older than the
+        latest ingested timestamp)."""
+        if len(timestamps) == 0:
+            return 0
+        last = self.latest_ts()
+        if timestamps[0] <= last:
+            keep = timestamps > last
+            if not keep.any():
+                return 0
+            timestamps = timestamps[keep]
+            values = {k: v[keep] for k, v in values.items()}
+        n = len(timestamps)
+        written = 0
+        while written < n:
+            if self._buf is None:
+                self._alloc_buf(values)
+            room = self.max_chunk_size - self._buf_len
+            take = min(room, n - written)
+            sl = slice(written, written + take)
+            dst = slice(self._buf_len, self._buf_len + take)
+            self._buf["timestamp"][dst] = timestamps[sl]
+            for k, v in values.items():
+                self._buf[k][dst] = v[sl]
+            self._buf_len += take
+            written += take
+            if self._buf_len >= self.max_chunk_size:
+                self.switch_buffers()
+        return n
+
+    def latest_ts(self) -> int:
+        if self._buf is not None and self._buf_len:
+            return int(self._buf["timestamp"][self._buf_len - 1])
+        if self.chunks:
+            return self.chunks[-1].end_ts
+        return -(2**62)
+
+    def earliest_ts(self) -> int:
+        if self.chunks:
+            return self.chunks[0].start_ts
+        if self._buf is not None and self._buf_len:
+            return int(self._buf["timestamp"][0])
+        return 2**62
+
+    def switch_buffers(self) -> Chunk | None:
+        """Seal the current write buffer into a chunk (reference
+        switchBuffers:232 -> encodeAndReleaseBuffers:317)."""
+        if self._buf is None or self._buf_len == 0:
+            return None
+        n = self._buf_len
+        arrays = {k: v[:n].copy() for k, v in self._buf.items()}
+        chunk = Chunk(
+            start_ts=int(arrays["timestamp"][0]),
+            end_ts=int(arrays["timestamp"][-1]),
+            n=n,
+            arrays=arrays,
+        )
+        if self.encode_on_seal:
+            chunk.ensure_encoded(self.schema)
+        self.chunks.append(chunk)
+        self._buf = None
+        self._buf_len = 0
+        return chunk
+
+    # -- read --------------------------------------------------------------
+
+    def num_samples(self) -> int:
+        return sum(c.n for c in self.chunks) + self._buf_len
+
+    def chunks_in_range(self, t0: int, t1: int) -> list[Chunk]:
+        return [c for c in self.chunks if c.end_ts >= t0 and c.start_ts <= t1]
+
+    def samples_in_range(self, t0: int, t1: int, col: str) -> tuple[np.ndarray, np.ndarray]:
+        """All samples with t0 <= ts <= t1 for one column, including the open
+        write buffer. Returns (ts[int64], vals)."""
+        ts_parts: list[np.ndarray] = []
+        val_parts: list[np.ndarray] = []
+        for c in self.chunks_in_range(t0, t1):
+            ts = c.column("timestamp")
+            lo, hi = np.searchsorted(ts, [t0, t1 + 1])
+            if hi > lo:
+                ts_parts.append(ts[lo:hi])
+                val_parts.append(c.column(col)[lo:hi])
+        if self._buf is not None and self._buf_len:
+            ts = self._buf["timestamp"][: self._buf_len]
+            if ts[-1] >= t0 and ts[0] <= t1:
+                lo, hi = np.searchsorted(ts, [t0, t1 + 1])
+                if hi > lo:
+                    ts_parts.append(ts[lo:hi].copy())
+                    val_parts.append(self._buf[col][lo:hi].copy())
+        if not ts_parts:
+            ncol = self._hist_width(col)
+            empty_v = np.empty((0, ncol)) if ncol else np.empty(0)
+            return np.empty(0, dtype=np.int64), empty_v
+        return np.concatenate(ts_parts), np.concatenate(val_parts)
+
+    def _hist_width(self, col: str) -> int | None:
+        try:
+            c = self.schema.column(col)
+        except KeyError:
+            return None
+        if c.ctype == ColumnType.HISTOGRAM and self.bucket_les is not None:
+            return len(self.bucket_les)
+        return None
+
+    # -- flush / eviction ---------------------------------------------------
+
+    def unflushed_chunks(self) -> list[Chunk]:
+        return [c for c in self.chunks if c.start_ts > self.flushed_until]
+
+    def mark_flushed(self, until_ts: int) -> None:
+        self.flushed_until = max(self.flushed_until, until_ts)
+
+    def evict_before(self, cutoff_ts: int) -> int:
+        """Drop whole chunks ending before cutoff; returns samples dropped."""
+        dropped = 0
+        keep = []
+        for c in self.chunks:
+            if c.end_ts < cutoff_ts:
+                dropped += c.n
+            else:
+                keep.append(c)
+        self.chunks = keep
+        return dropped

@@ -1,0 +1,190 @@
+"""One shard of the in-memory store (reference L2: memstore/TimeSeriesShard.scala:268
+— ingest loop :939, partition creation :1193, flush pipeline :1273-1636,
+eviction :1709-1799, label queries :1908, lookup :2097).
+
+A shard owns: partkey -> partition map, the tag index, flush-group assignment,
+and retention/eviction. The reference's ingest hot loop is a per-record Scala
+loop over BinaryRecords; here ingest consumes columnar ``RecordBatch``es and
+amortizes partition lookup by grouping records per series with numpy.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Mapping, Sequence
+
+import numpy as np
+
+from ..core.filters import ColumnFilter
+from ..core.records import RecordBatch, SeriesBatch
+from ..core.schemas import Schema, canonical_partkey
+from .index import PartKeyIndex
+from .partition import DEFAULT_MAX_CHUNK_SIZE, TimeSeriesPartition
+
+NUM_FLUSH_GROUPS = 16  # reference groups-per-shard default
+
+
+@dataclass
+class ShardStats:
+    """reference TimeSeriesShardStats (TimeSeriesShard.scala:41-150)."""
+
+    rows_ingested: int = 0
+    rows_skipped: int = 0
+    partitions_created: int = 0
+    partitions_evicted: int = 0
+    chunks_flushed: int = 0
+    encoded_bytes: int = 0
+
+
+@dataclass
+class StoreConfig:
+    """Per-dataset store tuning (reference store/IngestionConfig.scala,
+    conf/timeseries-dev-source.conf:43-120)."""
+
+    max_chunk_size: int = DEFAULT_MAX_CHUNK_SIZE
+    flush_interval_ms: int = 3_600_000
+    retention_ms: int = 3 * 24 * 3_600_000
+    encode_on_seal: bool = False
+    groups_per_shard: int = NUM_FLUSH_GROUPS
+    max_partitions: int = 1_000_000
+
+
+class TimeSeriesShard:
+    def __init__(self, dataset: str, shard_num: int, config: StoreConfig | None = None):
+        self.dataset = dataset
+        self.shard_num = shard_num
+        self.config = config or StoreConfig()
+        self.index = PartKeyIndex()
+        self.partitions: dict[int, TimeSeriesPartition] = {}
+        self._by_partkey: dict[bytes, int] = {}
+        self._next_part_id = 0
+        self.stats = ShardStats()
+        self._lock = threading.RLock()
+        self._ingested_offset = -1  # stream offset watermark (Kafka analog)
+
+    # -- ingest ------------------------------------------------------------
+
+    def ingest(self, batch: RecordBatch, offset: int = -1) -> int:
+        """Ingest a columnar record batch (reference ingest:939). Returns rows
+        ingested. Records are grouped by series then appended in bulk."""
+        n = 0
+        with self._lock:
+            for sb in batch.group_by_series():
+                n += self._ingest_series(sb)
+            if offset >= 0:
+                self._ingested_offset = max(self._ingested_offset, offset)
+        self.stats.rows_ingested += n
+        return n
+
+    def ingest_series(self, sb: SeriesBatch) -> int:
+        with self._lock:
+            return self._ingest_series(sb)
+
+    def _ingest_series(self, sb: SeriesBatch) -> int:
+        pk = sb.partkey
+        pid = self._by_partkey.get(pk)
+        if pid is None:
+            pid = self._create_partition(sb.tags, sb.schema, pk, sb.bucket_les)
+        part = self.partitions[pid]
+        # enforce time order within the run
+        ts = sb.timestamps
+        if len(ts) > 1 and not (np.diff(ts) >= 0).all():
+            order = np.argsort(ts, kind="stable")
+            ts = ts[order]
+            sb = SeriesBatch(sb.schema, sb.tags, ts, {k: v[order] for k, v in sb.values.items()}, sb.bucket_les)
+        got = part.ingest(ts, sb.values)
+        self.stats.rows_skipped += len(ts) - got
+        return got
+
+    def _create_partition(
+        self, tags: Mapping[str, str], schema: Schema, pk: bytes, bucket_les=None
+    ) -> int:
+        """reference createNewPartition:1193 + index addPartKey + cardinality."""
+        if len(self.partitions) >= self.config.max_partitions:
+            raise MemoryError(f"shard {self.shard_num}: partition limit reached")
+        pid = self._next_part_id
+        self._next_part_id += 1
+        part = TimeSeriesPartition(
+            pid,
+            tags,
+            schema,
+            pk,
+            max_chunk_size=self.config.max_chunk_size,
+            encode_on_seal=self.config.encode_on_seal,
+            bucket_les=bucket_les,
+        )
+        self.partitions[pid] = part
+        self._by_partkey[pk] = pid
+        self.index.add_partkey(pid, dict(tags), start_ts=0)
+        self.stats.partitions_created += 1
+        return pid
+
+    # -- query lookup --------------------------------------------------------
+
+    def lookup_partitions(
+        self, filters: Sequence[ColumnFilter], start_ts: int, end_ts: int, limit: int | None = None
+    ) -> np.ndarray:
+        """reference lookupPartitions:2097 -> PartLookupResult."""
+        return self.index.part_ids_from_filters(filters, start_ts, end_ts, limit)
+
+    def partition(self, part_id: int) -> TimeSeriesPartition:
+        return self.partitions[int(part_id)]
+
+    def label_values(self, filters, label, start_ts, end_ts, limit=None):
+        return self.index.label_values(filters, label, start_ts, end_ts, limit)
+
+    def label_names(self, filters, start_ts, end_ts):
+        return self.index.label_names(filters, start_ts, end_ts)
+
+    def partkeys(self, filters, start_ts, end_ts, limit=None):
+        return self.index.partkeys_from_filters(filters, start_ts, end_ts, limit)
+
+    # -- flush / eviction ----------------------------------------------------
+
+    def flush_group_of(self, part_id: int) -> int:
+        """Partitions are flushed in groups round-robin (reference
+        prepareFlushGroup:1273; group = partId % groups)."""
+        return part_id % self.config.groups_per_shard
+
+    def create_flush_task(self, group: int):
+        """Collect sealed-but-unflushed chunks for one flush group; the store
+        layer persists them and then calls mark_flushed (doFlushSteps:1462)."""
+        out = []
+        with self._lock:
+            for pid, part in self.partitions.items():
+                if pid % self.config.groups_per_shard != group:
+                    continue
+                part.switch_buffers()
+                chunks = part.unflushed_chunks()
+                if chunks:
+                    out.append((part, chunks))
+        return out
+
+    def evict_for_retention(self, now_ms: int | None = None) -> int:
+        """Drop chunks older than retention; remove fully-empty partitions
+        (reference evictPartitions:1709)."""
+        now_ms = now_ms if now_ms is not None else int(time.time() * 1000)
+        cutoff = now_ms - self.config.retention_ms
+        dropped = 0
+        dead: list[int] = []
+        with self._lock:
+            for pid, part in self.partitions.items():
+                dropped += part.evict_before(cutoff)
+                if part.num_samples() == 0:
+                    dead.append(pid)
+            for pid in dead:
+                part = self.partitions.pop(pid)
+                self._by_partkey.pop(part.partkey, None)
+                self.index.remove([pid])
+                self.stats.partitions_evicted += 1
+        return dropped
+
+    @property
+    def num_partitions(self) -> int:
+        return len(self.partitions)
+
+    @property
+    def ingested_offset(self) -> int:
+        return self._ingested_offset

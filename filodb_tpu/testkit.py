@@ -1,0 +1,100 @@
+"""Synthetic data generators shared by tests and benchmarks (reference
+core/src/test/scala/filodb.core/TestData.scala:27,239 MachineMetricsData —
+synthetic machine-metric streams used across every layer's specs)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .core.histograms import PROM_DEFAULT, BucketScheme
+from .core.records import RecordBatch
+from .core.schemas import GAUGE, METRIC_TAG, PROM_COUNTER, PROM_HISTOGRAM, Schema
+
+
+def machine_metrics(
+    n_series: int = 100,
+    n_samples: int = 720,
+    start_ms: int = 1_600_000_000_000,
+    interval_ms: int = 10_000,
+    metric: str = "heap_usage0",
+    ws: str = "demo",
+    ns: str = "App-2",
+    seed: int = 42,
+) -> RecordBatch:
+    """Gauge batch: n_series hosts, regular interval, noisy values."""
+    rng = np.random.default_rng(seed)
+    ts = start_ms + np.arange(n_samples, dtype=np.int64) * interval_ms
+    tags = [
+        {METRIC_TAG: metric, "_ws_": ws, "_ns_": ns, "instance": f"host-{i}", "job": "machine"}
+        for i in range(n_series)
+    ]
+    all_ts = np.tile(ts, n_series)
+    vals = (50 + 20 * rng.standard_normal((n_series, n_samples))).ravel()
+    all_tags = [t for t in tags for _ in range(n_samples)]
+    return RecordBatch(GAUGE, all_ts, {"value": vals}, all_tags)
+
+
+def counter_batch(
+    n_series: int = 100,
+    n_samples: int = 720,
+    start_ms: int = 1_600_000_000_000,
+    interval_ms: int = 10_000,
+    metric: str = "http_requests_total",
+    ws: str = "demo",
+    ns: str = "App-2",
+    seed: int = 7,
+    resets: bool = False,
+) -> RecordBatch:
+    """Counter batch: monotonically increasing, optional resets-to-zero."""
+    rng = np.random.default_rng(seed)
+    ts = start_ms + np.arange(n_samples, dtype=np.int64) * interval_ms
+    incr = rng.uniform(0, 10, size=(n_series, n_samples))
+    vals = np.cumsum(incr, axis=1)
+    if resets:
+        for i in range(n_series):
+            k = rng.integers(n_samples // 4, 3 * n_samples // 4)
+            vals[i, k:] -= vals[i, k]  # counter restarts at 0
+    tags = [
+        {METRIC_TAG: metric, "_ws_": ws, "_ns_": ns, "instance": f"host-{i}", "job": "api"}
+        for i in range(n_series)
+    ]
+    all_tags = [t for t in tags for _ in range(n_samples)]
+    return RecordBatch(PROM_COUNTER, np.tile(ts, n_series), {"count": vals.ravel()}, all_tags)
+
+
+def histogram_batch(
+    n_series: int = 10,
+    n_samples: int = 100,
+    start_ms: int = 1_600_000_000_000,
+    interval_ms: int = 10_000,
+    metric: str = "http_request_latency",
+    scheme: BucketScheme = PROM_DEFAULT,
+    seed: int = 11,
+    schema: Schema = PROM_HISTOGRAM,
+) -> RecordBatch:
+    """Native cumulative histogram batch: [N, B] bucket counts + sum/count."""
+    rng = np.random.default_rng(seed)
+    b = scheme.num_buckets
+    ts = start_ms + np.arange(n_samples, dtype=np.int64) * interval_ms
+    tags = [
+        {METRIC_TAG: metric, "_ws_": "demo", "_ns_": "App-2", "instance": f"host-{i}"}
+        for i in range(n_series)
+    ]
+    # per-interval observations land in buckets ~ lognormal; cumulative over time
+    incr = rng.poisson(2.0, size=(n_series, n_samples, b)).astype(np.float64)
+    incr[..., -1] = incr.sum(-1)  # +Inf bucket grows with everything
+    hist = np.cumsum(np.cumsum(incr, axis=2), axis=1)
+    count = hist[..., -1]
+    total = np.cumsum(rng.uniform(0, 5, size=(n_series, n_samples)) * count / (count + 1), axis=1)
+    all_tags = [t for t in tags for _ in range(n_samples)]
+    return RecordBatch(
+        schema,
+        np.tile(ts, n_series),
+        {
+            "sum": total.ravel(),
+            "count": count.ravel(),
+            "h": hist.reshape(-1, b),
+        },
+        all_tags,
+        bucket_les=scheme.bounds(),
+    )
