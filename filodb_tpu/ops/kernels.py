@@ -1,0 +1,436 @@
+"""TPU range-function kernels (reference L4 hot path re-designed for XLA).
+
+The reference evaluates PromQL range functions per series per output step with
+iterator state machines (rangefn/RangeFunction.scala:84, RateFunctions.scala:230,
+AggrOverTimeFunctions.scala) plus Rust SIMD for inner sums
+(simd_vectors.rs:174). Here ONE jit kernel computes the whole ``[S, J]``
+output grid (S series x J output steps) from a staged ``[S, T]`` block:
+
+- Window boundaries resolve by compare-and-reduce contractions
+  (``#{ts <= t_j}``) which XLA fuses — no per-window iterators, no dynamic
+  shapes, no data-dependent control flow.
+- sum/count family reads prefix sums at the boundary indices (the parallel
+  form of the reference's chunked running aggregates).
+- Counter reset correction is a cumulative sum of drop adjustments
+  (the prefix-scan form of CounterChunkedRangeFunction's per-chunk carry).
+- rate/increase/delta implement Prometheus extrapolation semantics
+  (promql extrapolatedRate), which the reference's ChunkedRateFunctionBase
+  also follows.
+- Functions needing per-window sample *sets* (quantile_over_time, mad) sort
+  masked windows in step blocks via lax.map to bound memory.
+
+Everything is shape-static: S, T, J are padded-bucketed by staging, so the
+jit cache stays tiny across queries.
+
+Empty windows yield NaN; the serialization layer treats NaN as "no sample"
+(Prometheus absence). Inputs are NaN-free by staging contract.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .staging import StagedBlock
+
+_NAN = jnp.nan
+
+
+@dataclass(frozen=True)
+class RangeParams:
+    """Output grid + window spec. start/step/window ride as dynamic args;
+    num_steps is static (padded to 64s by the caller via pad_steps)."""
+
+    start_ms: int  # absolute ms of first output step
+    step_ms: int
+    num_steps: int
+    window_ms: int
+
+
+def pad_steps(j: int) -> int:
+    return max(64, ((j + 63) // 64) * 64)
+
+
+# ---------------------------------------------------------------------------
+# shared window machinery (all [S, J] index math)
+# ---------------------------------------------------------------------------
+
+
+def _bounds(ts, lens, out_t, window):
+    """hi/lo sample-count indices per (series, step).
+
+    Window j = (out_t[j] - window, out_t[j]]. Returns (lo, hi): sample i is in
+    the window iff lo <= i < hi. Padding slots carry TS_PAD and never match.
+    """
+    T = ts.shape[1]
+    valid = jnp.arange(T, dtype=jnp.int32)[None, :] < lens[:, None]
+    le = (ts[:, None, :] <= out_t[None, :, None]) & valid[:, None, :]
+    hi = le.sum(-1, dtype=jnp.int32)
+    lo_bound = out_t - window
+    le2 = (ts[:, None, :] <= lo_bound[None, :, None]) & valid[:, None, :]
+    lo = le2.sum(-1, dtype=jnp.int32)
+    return lo, hi
+
+
+def _gather(arr, idx):
+    """arr [S, T], idx [S, J] -> [S, J] (idx clipped; caller masks validity)."""
+    T = arr.shape[1]
+    return jnp.take_along_axis(arr, jnp.clip(idx, 0, T - 1), axis=1)
+
+
+def _prefix(vals):
+    """[S, T] -> [S, T+1] exclusive prefix sum in f32."""
+    cs = jnp.cumsum(vals, axis=1)
+    return jnp.concatenate([jnp.zeros_like(cs[:, :1]), cs], axis=1)
+
+
+def _window_mask(ts, lens, out_t, window):
+    T = ts.shape[1]
+    valid = jnp.arange(T, dtype=jnp.int32)[None, :] < lens[:, None]
+    in_win = (
+        (ts[:, None, :] <= out_t[None, :, None])
+        & (ts[:, None, :] > (out_t - window)[None, :, None])
+        & valid[:, None, :]
+    )
+    return in_win  # [S, J, T] — consumers must fuse-reduce, never materialize
+
+
+def _extrapolated(delta, t_first, t_last, count, v_first_raw, out_t, window, is_counter, as_rate):
+    """Prometheus extrapolatedRate: extrapolate the in-window delta to the
+    window edges, capped at 1.1x the average sample spacing (and at the
+    zero-crossing for counters)."""
+    f32 = delta.dtype
+    w_s = window.astype(f32) * 1e-3
+    range_start = (out_t - window)[None, :].astype(f32) * 1e-3
+    range_end = out_t[None, :].astype(f32) * 1e-3
+    tf = t_first.astype(f32) * 1e-3
+    tl = t_last.astype(f32) * 1e-3
+    sampled = tl - tf
+    cnt = count.astype(f32)
+    dur_start = tf - range_start
+    dur_end = range_end - tl
+    avg_dur = sampled / jnp.maximum(cnt - 1.0, 1.0)
+    if is_counter:
+        dur_zero = jnp.where(delta > 0, sampled * (v_first_raw / jnp.maximum(delta, 1e-30)), jnp.inf)
+        dur_start = jnp.minimum(dur_start, jnp.where(v_first_raw >= 0, dur_zero, jnp.inf))
+    thresh = avg_dur * 1.1
+    dur_start = jnp.where(dur_start >= thresh, avg_dur / 2.0, dur_start)
+    dur_end = jnp.where(dur_end >= thresh, avg_dur / 2.0, dur_end)
+    factor = (sampled + dur_start + dur_end) / jnp.maximum(sampled, 1e-30)
+    result = delta * factor
+    if as_rate:
+        result = result / w_s
+    return jnp.where(count >= 2, result, _NAN)
+
+
+# ---------------------------------------------------------------------------
+# the kernel: one jit per (func, S, T, J)
+# ---------------------------------------------------------------------------
+
+PREFIX_FUNCS = {
+    "sum_over_time",
+    "count_over_time",
+    "avg_over_time",
+    "rate",
+    "increase",
+    "delta",
+    "idelta",
+    "irate",
+    "last",
+    "last_over_time",
+    "timestamp",
+    "stddev_over_time",
+    "stdvar_over_time",
+    "min_over_time",
+    "max_over_time",
+    "deriv",
+    "predict_linear",
+    "changes",
+    "resets",
+    "present_over_time",
+    "absent_over_time",
+    "first_over_time",
+    "double_exponential_smoothing",
+    "z_score",
+}
+
+
+@functools.partial(
+    jax.jit, static_argnames=("func", "num_steps", "is_counter", "is_delta")
+)
+def range_kernel(
+    func: str,
+    ts,  # [S, T] i32
+    vals,  # [S, T] f32 (counters: reset-corrected minus baseline by staging)
+    lens,  # [S] i32
+    baseline,  # [S] f32
+    raw,  # [S, T] f32 raw-minus-baseline (== vals for non-counters)
+    start_off,  # scalar i32: first output step (offset ms)
+    step_ms,  # scalar i32
+    window,  # scalar i32
+    num_steps: int,
+    is_counter: bool = False,
+    is_delta: bool = False,
+    arg0=0.0,  # function scalar arg (quantile q, holt sf, predict horizon s)
+    arg1=0.0,  # second scalar arg (holt tf)
+):
+    """Compute [S, num_steps] results for one range function."""
+    S, T = ts.shape
+    out_t = start_off + jnp.arange(num_steps, dtype=jnp.int32) * step_ms
+    lo, hi = _bounds(ts, lens, out_t, window)
+    count = (hi - lo).astype(jnp.float32)
+    has = count > 0
+
+    def prefix_sum_of(x):
+        p = _prefix(x)  # [S, T+1] exclusive; sum over [lo, hi) = p[hi]-p[lo]
+        return _gather(p, hi) - _gather(p, lo)
+
+    # boundary samples
+    t_first = _gather(ts, lo)
+    t_last = _gather(ts, hi - 1)
+    v_last = _gather(vals, hi - 1)
+    v_first = _gather(vals, lo)
+
+    if func in ("sum_over_time",):
+        s = prefix_sum_of(vals)
+        return jnp.where(has, s, _NAN)
+    if func == "count_over_time":
+        return jnp.where(has, count, _NAN)
+    if func == "avg_over_time":
+        s = prefix_sum_of(vals)
+        return jnp.where(has, s / count, _NAN)
+    if func in ("last", "last_over_time"):
+        return jnp.where(has, v_last, _NAN)
+    if func == "first_over_time":
+        return jnp.where(has, v_first, _NAN)
+    if func == "timestamp":
+        # returns ms offsets; host adds base_ms and converts to seconds (f64)
+        return jnp.where(has, t_last.astype(jnp.float32), _NAN)
+    if func == "present_over_time":
+        return jnp.where(has, 1.0, _NAN)
+    if func == "absent_over_time":
+        # 1.0 where NO sample; presenter turns it into an absent-vector
+        return jnp.where(has, _NAN, 1.0)
+    if func in ("min_over_time", "max_over_time"):
+        m = _window_mask(ts, lens, out_t, window)
+        big = jnp.float32(np.inf if func == "min_over_time" else -np.inf)
+        w = jnp.where(m, vals[:, None, :], big)
+        r = w.min(-1) if func == "min_over_time" else w.max(-1)
+        return jnp.where(has, r, _NAN)
+    if func in ("stddev_over_time", "stdvar_over_time", "z_score"):
+        s = prefix_sum_of(vals)
+        mean = s / jnp.maximum(count, 1.0)
+        m = _window_mask(ts, lens, out_t, window)
+        dev = jnp.where(m, (vals[:, None, :] - mean[:, :, None]) ** 2, 0.0)
+        var = dev.sum(-1) / jnp.maximum(count, 1.0)
+        if func == "stdvar_over_time":
+            return jnp.where(has, var, _NAN)
+        sd = jnp.sqrt(var)
+        if func == "z_score":
+            return jnp.where(has, (v_last - mean) / jnp.maximum(sd, 1e-30), _NAN)
+        return jnp.where(has, sd, _NAN)
+    if func in ("changes", "resets"):
+        prev = jnp.concatenate([vals[:, :1], vals[:, :-1]], axis=1)
+        flag = (vals != prev) if func == "changes" else (vals < prev)
+        idx = jnp.arange(T, dtype=jnp.int32)[None, None, :]
+        pair_in = (idx > lo[:, :, None]) & (idx < hi[:, :, None])
+        n = (pair_in & flag[:, None, :]).sum(-1).astype(jnp.float32)
+        return jnp.where(has, n, _NAN)
+    if func in ("deriv", "predict_linear"):
+        # least-squares slope over (t - out_t) seconds, per window
+        m = _window_mask(ts, lens, out_t, window)
+        tc = (ts[:, None, :] - out_t[None, :, None]).astype(jnp.float32) * 1e-3
+        tc = jnp.where(m, tc, 0.0)
+        vm = jnp.where(m, vals[:, None, :], 0.0)
+        st = tc.sum(-1)
+        sv = vm.sum(-1)
+        stt = (tc * tc).sum(-1)
+        stv = (tc * vm).sum(-1)
+        n = count
+        denom = n * stt - st * st
+        slope = (n * stv - st * sv) / jnp.where(jnp.abs(denom) < 1e-30, 1.0, denom)
+        intercept = (sv - slope * st) / jnp.maximum(n, 1.0)
+        ok = (count >= 2) & (jnp.abs(denom) >= 1e-30)
+        if func == "deriv":
+            return jnp.where(ok, slope, _NAN)
+        return jnp.where(ok, intercept + slope * arg0, _NAN)
+    if func == "double_exponential_smoothing":
+        return _holt_winters(ts, vals, lens, out_t, window, lo, hi, arg0, arg1)
+
+    # counter family ------------------------------------------------------
+    if func in ("rate", "increase", "delta"):
+        if is_delta:
+            # delta-temporality counters: each sample IS the increase
+            s = prefix_sum_of(vals)
+            if func == "rate":
+                r = s / (window.astype(jnp.float32) * 1e-3)
+            else:
+                r = s
+            return jnp.where(has, r, _NAN)
+        # vals are already reset-corrected by staging for counters, so the
+        # plain in-window difference IS the corrected increase
+        dlt = v_last - v_first
+        v_first_raw = _gather(raw, lo)  # only read when is_counter (zero cap)
+        use_counter = is_counter and func != "delta"
+        return _extrapolated(
+            dlt, t_first, t_last, count, v_first_raw, out_t, window,
+            is_counter=use_counter, as_rate=(func == "rate"),
+        )
+    if func in ("irate", "idelta"):
+        t_prev = _gather(ts, hi - 2)
+        v_prev = _gather(vals, hi - 2)
+        ok = (hi - lo) >= 2
+        dt_s = (t_last - t_prev).astype(jnp.float32) * 1e-3
+        # counters: corrected-value difference across a reset equals the
+        # post-reset raw reading — Prometheus reset semantics with no branch
+        dv = v_last - v_prev
+        r = dv / jnp.maximum(dt_s, 1e-30) if func == "irate" else dv
+        return jnp.where(ok, r, _NAN)
+
+    raise ValueError(f"unknown range function {func}")
+
+
+def _holt_winters(ts, vals, lens, out_t, window, lo, hi, sf, tf):
+    """Holt's double exponential smoothing per window (reference
+    RangeFunction.scala holt-winters). Sequential in samples: lax.scan over T
+    carrying (level, trend) per (series, step)."""
+    S, T = vals.shape
+    J = out_t.shape[0]
+    idx = jnp.arange(T, dtype=jnp.int32)
+
+    def body(carry, t_i):
+        # promql holtWinters recurrence: level0 = x0; the 2nd sample sets
+        # trend = x1 - x0 and leaves level = x1; then the standard update.
+        level, trend, n_seen = carry
+        in_win = (t_i >= lo) & (t_i < hi)  # [S, J]
+        x = vals[:, t_i][:, None]  # [S, 1]
+        new_level = sf * x + (1 - sf) * (level + trend)
+        new_trend = tf * (new_level - level) + (1 - tf) * trend
+        lvl = jnp.where(
+            in_win,
+            jnp.where(n_seen == 0, x, jnp.where(n_seen == 1, x, new_level)),
+            level,
+        )
+        trd = jnp.where(
+            in_win,
+            jnp.where(
+                n_seen == 0,
+                jnp.zeros_like(trend),
+                jnp.where(n_seen == 1, x - level, new_trend),
+            ),
+            trend,
+        )
+        n2 = jnp.where(in_win, n_seen + 1, n_seen)
+        return (lvl, trd, n2), None
+
+    init = (
+        jnp.zeros((S, J), vals.dtype),
+        jnp.zeros((S, J), vals.dtype),
+        jnp.zeros((S, J), jnp.int32),
+    )
+    (level, trend, n_seen), _ = jax.lax.scan(body, init, idx)
+    return jnp.where(n_seen >= 2, level, _NAN)
+
+
+# quantile / mad: need per-window sorts — run in step blocks to bound memory
+@functools.partial(jax.jit, static_argnames=("func", "num_steps", "block"))
+def sorted_window_kernel(
+    func: str, ts, vals, lens, start_off, step_ms, window, num_steps: int, q=0.5, block: int = 16
+):
+    S, T = ts.shape
+    out_t_all = start_off + jnp.arange(num_steps, dtype=jnp.int32) * step_ms
+
+    def one_block(out_t):
+        lo, hi = _bounds(ts, lens, out_t, window)
+        count = (hi - lo).astype(jnp.float32)
+        m = _window_mask(ts, lens, out_t, window)
+        w = jnp.where(m, vals[:, None, :], jnp.inf)
+        sw = jnp.sort(w, axis=-1)
+
+        def quantile_of(sorted_w, cnt):
+            rank = jnp.clip(q, 0.0, 1.0) * jnp.maximum(cnt - 1.0, 0.0)
+            lo_i = jnp.floor(rank).astype(jnp.int32)
+            hi_i = jnp.ceil(rank).astype(jnp.int32)
+            frac = rank - lo_i.astype(jnp.float32)
+            v_lo = jnp.take_along_axis(sorted_w, lo_i[..., None], axis=-1)[..., 0]
+            v_hi = jnp.take_along_axis(sorted_w, hi_i[..., None], axis=-1)[..., 0]
+            return v_lo + (v_hi - v_lo) * frac
+
+        if func == "quantile_over_time":
+            r = quantile_of(sw, count)
+        elif func == "median_absolute_deviation_over_time":
+            med_q = 0.5 * jnp.maximum(count - 1.0, 0.0)
+            lo_i = jnp.floor(med_q).astype(jnp.int32)
+            hi_i = jnp.ceil(med_q).astype(jnp.int32)
+            frac = med_q - lo_i.astype(jnp.float32)
+            m_lo = jnp.take_along_axis(sw, lo_i[..., None], axis=-1)[..., 0]
+            m_hi = jnp.take_along_axis(sw, hi_i[..., None], axis=-1)[..., 0]
+            med = m_lo + (m_hi - m_lo) * frac
+            dev = jnp.where(m, jnp.abs(vals[:, None, :] - med[:, :, None]), jnp.inf)
+            sd = jnp.sort(dev, axis=-1)
+            v_lo2 = jnp.take_along_axis(sd, lo_i[..., None], axis=-1)[..., 0]
+            v_hi2 = jnp.take_along_axis(sd, hi_i[..., None], axis=-1)[..., 0]
+            r = v_lo2 + (v_hi2 - v_lo2) * frac
+        else:
+            raise ValueError(func)
+        return jnp.where(count > 0, r, _NAN)
+
+    blocks = out_t_all.reshape(num_steps // block, block)
+    out = jax.lax.map(one_block, blocks)  # [nb, S, block]
+    return jnp.moveaxis(out, 0, 1).reshape(S, num_steps)
+
+
+SORTED_FUNCS = {"quantile_over_time", "median_absolute_deviation_over_time"}
+
+
+# ---------------------------------------------------------------------------
+# host-facing entry
+# ---------------------------------------------------------------------------
+
+
+def run_range_function(
+    func: str,
+    block: StagedBlock,
+    params: RangeParams,
+    is_counter: bool = False,
+    is_delta: bool = False,
+    args: tuple = (),
+):
+    """Dispatch one range function over a staged block. Returns a device array
+    [S, J_padded]; caller slices [:n_series, :num_steps]."""
+    j_pad = pad_steps(params.num_steps)
+    start_off = np.int32(params.start_ms - block.base_ms)
+    if func in SORTED_FUNCS:
+        return sorted_window_kernel(
+            func,
+            block.ts,
+            block.vals,
+            block.lens,
+            start_off,
+            np.int32(params.step_ms),
+            np.int32(params.window_ms),
+            j_pad,
+            q=np.float32(args[0]) if args else np.float32(0.5),
+        )
+    a0 = np.float32(args[0]) if len(args) > 0 else np.float32(0.0)
+    a1 = np.float32(args[1]) if len(args) > 1 else np.float32(0.0)
+    return range_kernel(
+        func,
+        block.ts,
+        block.vals,
+        block.lens,
+        block.baseline,
+        block.raw if block.raw is not None else block.vals,
+        start_off,
+        np.int32(params.step_ms),
+        np.int32(params.window_ms),
+        j_pad,
+        is_counter=is_counter,
+        is_delta=is_delta,
+        arg0=a0,
+        arg1=a1,
+    )

@@ -1,0 +1,196 @@
+"""Staging: memstore chunk windows -> fixed-shape device blocks.
+
+This is the TPU-native replacement for the reference's per-series iterator
+read path (ChunkedWindowIterator, PeriodicSamplesMapper.scala:256): instead of
+cursoring over encoded off-heap vectors per window, we gather ALL samples for
+ALL selected series in [start - lookback, end] into one padded
+``[series, time]`` block, push it to HBM once, and let jit kernels compute
+every output step for every series at once.
+
+Shape discipline (SURVEY.md §7 "ragged data vs static shapes" — the #1 risk):
+- NaN samples (Prometheus staleness markers) are dropped host-side; validity
+  on device is purely "index < length", so kernels never branch on NaN inputs.
+- Timestamps become int32 ms offsets from ``base_ms`` (exact for ranges up to
+  ~24 days; queries longer than that split at the planner like the
+  reference's LongTimeRangePlanner).
+- Cumulative counters are reset-corrected HOST-SIDE in f64 (the prefix-sum
+  form of the reference's CorrectingDoubleVectorReader carry), then staged
+  minus a per-series baseline: staged values are small monotone increments, so
+  f32 keeps full precision even on 1e15-magnitude raw counters, and the device
+  needs no correction pass at all. A corrected-value difference across a reset
+  equals the post-reset raw reading — exactly Prometheus' reset semantics —
+  so rate/irate need no reset branches on device. Raw-minus-baseline offsets
+  ride along only for Prometheus' zero-crossing extrapolation cap.
+- S and T pad up to bucketed sizes so the jit cache stays small.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+# S pads to the next bucket; T pads to a multiple of 128 (TPU lane width)
+_S_BUCKETS = (8, 32, 128, 512, 1024, 2048, 4096, 8192, 16384, 32768, 65536, 131072)
+
+
+def pad_series(s: int) -> int:
+    for b in _S_BUCKETS:
+        if s <= b:
+            return b
+    return ((s + 8191) // 8192) * 8192
+
+
+def pad_time(t: int) -> int:
+    return max(128, ((t + 127) // 128) * 128)
+
+
+TS_PAD = np.int32(2**31 - 1)  # padded slots sort after every real timestamp
+
+
+@dataclass
+class StagedBlock:
+    """One staged window block: everything a range kernel needs."""
+
+    ts: np.ndarray  # [S, T] int32 ms offsets from base_ms; TS_PAD in padding
+    vals: np.ndarray  # [S, T] f32; counters: reset-corrected minus baseline
+    lens: np.ndarray  # [S] int32 valid sample count per series
+    base_ms: int  # absolute ms of offset 0
+    baseline: np.ndarray  # [S] f32 per-series value offset (counters; else 0)
+    n_series: int  # real series count (<= S)
+    part_refs: list  # (shard_num, part_id) per real series row
+    raw: np.ndarray | None = None  # [S, T] f32 raw-minus-baseline (counters)
+
+    @property
+    def shape(self):
+        return self.ts.shape
+
+
+def counter_correct(vals: np.ndarray) -> np.ndarray:
+    """f64 prefix-sum reset correction: add the prior raw value at each drop
+    (Prometheus semantics; reference CorrectingDoubleVectorReader:308)."""
+    v = vals.astype(np.float64)
+    if len(v) < 2:
+        return v
+    drops = np.where(v[1:] < v[:-1], v[:-1], 0.0)
+    corr = np.concatenate([[0.0], np.cumsum(drops)])
+    return v + corr
+
+
+def stage_series(
+    series: list[tuple[np.ndarray, np.ndarray]],
+    base_ms: int,
+    part_refs: list | None = None,
+    subtract_baseline: bool = False,
+    counter_corrected: bool = False,
+    dtype=np.float32,
+) -> StagedBlock:
+    """Build a StagedBlock from per-series (ts_ms int64, values f64) pairs.
+
+    Drops NaN samples (staleness). Pads S and T to bucketed shapes.
+    With ``counter_corrected``, values are reset-corrected in f64 first and
+    raw offsets are staged alongside (see module docstring).
+    """
+    n = len(series)
+    cleaned: list[tuple[np.ndarray, np.ndarray]] = []
+    maxlen = 1
+    for ts, vals in series:
+        keep = ~np.isnan(vals)
+        if not keep.all():
+            ts, vals = ts[keep], vals[keep]
+        cleaned.append((ts, vals))
+        maxlen = max(maxlen, len(ts))
+    S = pad_series(max(n, 1))
+    T = pad_time(maxlen)
+    out_ts = np.full((S, T), TS_PAD, dtype=np.int32)
+    out_vals = np.zeros((S, T), dtype=dtype)
+    out_raw = np.zeros((S, T), dtype=dtype) if counter_corrected else None
+    lens = np.zeros(S, dtype=np.int32)
+    baseline = np.zeros(S, dtype=dtype)
+    for i, (ts, vals) in enumerate(cleaned):
+        m = len(ts)
+        lens[i] = m
+        if m == 0:
+            continue
+        out_ts[i, :m] = (ts - base_ms).astype(np.int32)
+        if counter_corrected:
+            b = np.float64(vals[0])
+            baseline[i] = b
+            out_vals[i, :m] = (counter_correct(vals) - b).astype(dtype)
+            # raw rides along unshifted: it only feeds the zero-crossing
+            # extrapolation cap, which engages only for raw values near zero —
+            # exactly where plain f32 is exact (large raws disable the cap)
+            out_raw[i, :m] = vals.astype(dtype)
+        elif subtract_baseline:
+            b = np.float64(vals[0])
+            baseline[i] = b
+            out_vals[i, :m] = (vals.astype(np.float64) - b).astype(dtype)
+        else:
+            out_vals[i, :m] = vals.astype(dtype)
+    return StagedBlock(
+        out_ts, out_vals, lens, base_ms, baseline, n, part_refs or [], raw=out_raw
+    )
+
+
+def stage_histogram_series(
+    series: list[tuple[np.ndarray, np.ndarray]],
+    base_ms: int,
+    n_buckets: int,
+    part_refs: list | None = None,
+    subtract_baseline: bool = False,
+    dtype=np.float32,
+):
+    """Like stage_series but values are [T, B] bucket-count rows.
+
+    Returns (StagedBlock with vals [S, T, B], baseline [S, B]).
+    """
+    n = len(series)
+    maxlen = 1
+    for ts, _ in series:
+        maxlen = max(maxlen, len(ts))
+    S = pad_series(max(n, 1))
+    T = pad_time(maxlen)
+    out_ts = np.full((S, T), TS_PAD, dtype=np.int32)
+    out_vals = np.zeros((S, T, n_buckets), dtype=dtype)
+    lens = np.zeros(S, dtype=np.int32)
+    baseline = np.zeros((S, n_buckets), dtype=dtype)
+    for i, (ts, vals) in enumerate(series):
+        m = len(ts)
+        lens[i] = m
+        if m == 0:
+            continue
+        out_ts[i, :m] = (ts - base_ms).astype(np.int32)
+        if subtract_baseline:
+            b = vals[0].astype(np.float64)
+            baseline[i] = b.astype(dtype)
+            out_vals[i, :m] = (vals.astype(np.float64) - b).astype(dtype)
+        else:
+            out_vals[i, :m] = vals.astype(dtype)
+    return StagedBlock(out_ts, out_vals, lens, base_ms, baseline, n, part_refs or [])
+
+
+def stage_from_shard(
+    shard,
+    part_ids,
+    column: str,
+    start_ms: int,
+    end_ms: int,
+    is_counter: bool = False,
+    dtype=np.float32,
+) -> StagedBlock:
+    """Gather [start_ms, end_ms] samples for part_ids from a shard and stage."""
+    series = []
+    refs = []
+    hist_width = None
+    for pid in part_ids:
+        part = shard.partition(int(pid))
+        ts, vals = part.samples_in_range(start_ms, end_ms, column)
+        if vals.ndim == 2:
+            hist_width = vals.shape[1]
+        series.append((ts, vals))
+        refs.append((shard.shard_num, int(pid)))
+    if hist_width is not None:
+        return stage_histogram_series(
+            series, start_ms, hist_width, refs, subtract_baseline=is_counter, dtype=dtype
+        )
+    return stage_series(series, start_ms, refs, counter_corrected=is_counter, dtype=dtype)

@@ -1,0 +1,136 @@
+"""Pure-numpy f64 oracle for PromQL range functions — deliberately written
+per-series/per-window (the way Prometheus' promql/functions.go computes them)
+so it shares no code with the vectorized TPU kernels it cross-checks."""
+
+import numpy as np
+
+
+def windows(ts, start, step, num_steps, window):
+    """Yield (out_t, sample_indices) — window = (out_t - w, out_t]."""
+    for j in range(num_steps):
+        t = start + j * step
+        sel = np.nonzero((ts > t - window) & (ts <= t))[0]
+        yield t, sel
+
+
+def correct_counter(vals):
+    out = vals.astype(np.float64).copy()
+    corr = 0.0
+    for i in range(1, len(out)):
+        if vals[i] < vals[i - 1]:
+            corr += vals[i - 1]
+        out[i] = vals[i] + corr
+    return out
+
+
+def extrapolated(ts, raw, corrected, sel, t, window, is_counter, as_rate):
+    if len(sel) < 2:
+        return np.nan
+    tf, tl = ts[sel[0]], ts[sel[-1]]
+    delta = corrected[sel[-1]] - corrected[sel[0]]
+    range_start, range_end = (t - window) / 1e3, t / 1e3
+    tf_s, tl_s = tf / 1e3, tl / 1e3
+    sampled = tl_s - tf_s
+    dur_start = tf_s - range_start
+    dur_end = range_end - tl_s
+    avg_dur = sampled / (len(sel) - 1)
+    if is_counter and delta > 0 and raw[sel[0]] >= 0:
+        dur_zero = sampled * (raw[sel[0]] / delta)
+        if dur_zero < dur_start:
+            dur_start = dur_zero
+    thresh = avg_dur * 1.1
+    if dur_start >= thresh:
+        dur_start = avg_dur / 2
+    if dur_end >= thresh:
+        dur_end = avg_dur / 2
+    factor = (sampled + dur_start + dur_end) / sampled
+    res = delta * factor
+    if as_rate:
+        res /= window / 1e3
+    return res
+
+
+def range_function(func, ts, vals, start, step, num_steps, window,
+                   is_counter=False, is_delta=False, args=()):
+    """ts int64 ms, vals f64 (one series) -> [num_steps] f64 with NaN absents."""
+    ts = np.asarray(ts)
+    vals = np.asarray(vals, dtype=np.float64)
+    keep = ~np.isnan(vals)
+    ts, vals = ts[keep], vals[keep]
+    corrected = correct_counter(vals) if (is_counter and not is_delta) else vals
+    out = np.full(num_steps, np.nan)
+    for j, (t, sel) in enumerate(windows(ts, start, step, num_steps, window)):
+        n = len(sel)
+        if n == 0:
+            if func == "absent_over_time":
+                out[j] = 1.0
+            continue
+        w = vals[sel]
+        if func == "sum_over_time":
+            out[j] = w.sum()
+        elif func == "count_over_time":
+            out[j] = n
+        elif func == "avg_over_time":
+            out[j] = w.mean()
+        elif func == "min_over_time":
+            out[j] = w.min()
+        elif func == "max_over_time":
+            out[j] = w.max()
+        elif func in ("last", "last_over_time"):
+            out[j] = w[-1]
+        elif func == "first_over_time":
+            out[j] = w[0]
+        elif func == "present_over_time":
+            out[j] = 1.0
+        elif func == "stddev_over_time":
+            out[j] = w.std()
+        elif func == "stdvar_over_time":
+            out[j] = w.var()
+        elif func == "z_score":
+            sd = w.std()
+            out[j] = (w[-1] - w.mean()) / sd if sd > 0 else np.nan
+        elif func == "changes":
+            out[j] = int((w[1:] != w[:-1]).sum())
+        elif func == "resets":
+            out[j] = int((w[1:] < w[:-1]).sum())
+        elif func == "quantile_over_time":
+            out[j] = np.quantile(w, args[0])
+        elif func == "median_absolute_deviation_over_time":
+            med = np.quantile(w, 0.5)
+            out[j] = np.quantile(np.abs(w - med), 0.5)
+        elif func in ("rate", "increase"):
+            if is_delta:
+                s = w.sum()
+                out[j] = s / (window / 1e3) if func == "rate" else s
+            else:
+                out[j] = extrapolated(ts, vals, corrected, sel, t, window,
+                                      is_counter, as_rate=(func == "rate"))
+        elif func == "delta":
+            out[j] = extrapolated(ts, vals, vals, sel, t, window, False, False)
+        elif func == "idelta":
+            if n >= 2:
+                out[j] = w[-1] - w[-2]
+        elif func == "irate":
+            if n >= 2:
+                dv = w[-1] - w[-2]
+                if is_counter and not is_delta and dv < 0:
+                    dv = w[-1]
+                out[j] = dv / ((ts[sel[-1]] - ts[sel[-2]]) / 1e3)
+        elif func == "deriv" or func == "predict_linear":
+            if n >= 2:
+                tc = (ts[sel] - t) / 1e3
+                A = np.vstack([tc, np.ones(n)]).T
+                slope, intercept = np.linalg.lstsq(A, w, rcond=None)[0]
+                out[j] = slope if func == "deriv" else intercept + slope * args[0]
+        elif func == "double_exponential_smoothing":
+            if n >= 2:
+                sf, tf_ = args
+                level, trend = w[0], w[1] - w[0]
+                for i in range(1, n):
+                    prev = level
+                    level = sf * w[i] + (1 - sf) * (level + trend)
+                    trend = tf_ * (level - prev) + (1 - tf_) * trend
+                out[j] = level
+        else:
+            raise ValueError(func)
+    return out

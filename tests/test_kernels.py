@@ -1,0 +1,144 @@
+"""TPU range-function kernels vs numpy oracle (the SURVEY §4(f) strategy:
+every kernel cross-checked against an independent reference implementation;
+model: reference AggrOverTimeFunctionsSpec / RateFunctionsSpec /
+WindowIteratorSpec chunked-vs-sliding cross-checks)."""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.ops import kernels as K
+from filodb_tpu.ops.staging import stage_series
+
+import oracle
+
+BASE = 1_600_000_000_000
+
+
+def make_series(n_series=7, n=300, seed=0, counter=False, irregular=True, resets=False,
+                with_nans=False):
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n_series):
+        if irregular:
+            gaps = rng.integers(5_000, 15_000, n)
+            ts = BASE + np.cumsum(gaps)
+        else:
+            ts = BASE + (1 + np.arange(n, dtype=np.int64)) * 10_000
+        if counter:
+            vals = np.cumsum(rng.uniform(0, 10, n)) + 1e9  # large baseline
+            if resets and n > 20:
+                k = rng.integers(n // 3, 2 * n // 3)
+                vals[k:] -= vals[k] - rng.uniform(0, 5)
+        else:
+            vals = 50 + 20 * rng.standard_normal(n)
+        if with_nans:
+            vals[rng.integers(0, n, n // 10)] = np.nan
+        out.append((ts.astype(np.int64), vals))
+    return out
+
+
+def run_both(func, series, window_ms=300_000, step_ms=60_000, num_steps=20,
+             counter=False, delta=False, args=()):
+    start = BASE + window_ms + 60_000
+    block = stage_series(
+        [(t, v) for t, v in series], BASE, counter_corrected=counter and not delta
+    )
+    params = K.RangeParams(start, step_ms, num_steps, window_ms)
+    got = np.asarray(
+        K.run_range_function(func, block, params, is_counter=counter, is_delta=delta, args=args)
+    )[: len(series), :num_steps]
+    want = np.stack([
+        oracle.range_function(func, t, v, start, step_ms, num_steps, window_ms,
+                              is_counter=counter, is_delta=delta, args=args)
+        for t, v in series
+    ])
+    return got, want
+
+
+def check(func, series, rtol=2e-4, atol=1e-3, **kw):
+    got, want = run_both(func, series, **kw)
+    assert got.shape == want.shape
+    nan_g, nan_w = np.isnan(got), np.isnan(want)
+    np.testing.assert_array_equal(nan_g, nan_w, err_msg=f"{func}: NaN pattern differs")
+    m = ~nan_w
+    np.testing.assert_allclose(got[m], want[m], rtol=rtol, atol=atol, err_msg=func)
+
+
+GAUGE_FUNCS = [
+    "sum_over_time", "count_over_time", "avg_over_time", "min_over_time",
+    "max_over_time", "last_over_time", "first_over_time", "present_over_time",
+    "stddev_over_time", "stdvar_over_time", "changes", "resets", "idelta",
+    "deriv", "z_score",
+]
+
+
+@pytest.mark.parametrize("func", GAUGE_FUNCS)
+def test_gauge_functions_match_oracle(func):
+    check(func, make_series(n_series=7, n=300, seed=3))
+
+
+@pytest.mark.parametrize("func", GAUGE_FUNCS)
+def test_gauge_functions_regular_interval(func):
+    check(func, make_series(n_series=5, n=200, seed=4, irregular=False))
+
+
+def test_nan_staleness_dropped_before_device():
+    check("sum_over_time", make_series(n_series=5, n=200, seed=9, with_nans=True))
+    check("count_over_time", make_series(n_series=5, n=200, seed=9, with_nans=True))
+
+
+@pytest.mark.parametrize("func", ["rate", "increase", "delta", "irate"])
+def test_counter_functions_match_oracle(func):
+    check(func, make_series(n_series=7, n=300, seed=5, counter=True), counter=True, rtol=1e-3)
+
+
+@pytest.mark.parametrize("func", ["rate", "increase", "irate"])
+def test_counter_resets_corrected(func):
+    check(func, make_series(n_series=7, n=300, seed=6, counter=True, resets=True),
+          counter=True, rtol=1e-3)
+
+
+def test_delta_counter_semantics():
+    # delta-temporality: rate = sum/window
+    series = make_series(n_series=4, n=200, seed=7)
+    check("rate", series, counter=True, delta=True, rtol=1e-3)
+    check("increase", series, counter=True, delta=True, rtol=1e-3)
+
+
+def test_quantile_over_time():
+    check("quantile_over_time", make_series(n_series=5, n=200, seed=8), args=(0.9,))
+    check("quantile_over_time", make_series(n_series=5, n=200, seed=8), args=(0.0,))
+    check("quantile_over_time", make_series(n_series=5, n=200, seed=8), args=(1.0,))
+
+
+def test_mad_over_time():
+    check("median_absolute_deviation_over_time", make_series(n_series=4, n=150, seed=10))
+
+
+def test_predict_linear():
+    check("predict_linear", make_series(n_series=5, n=200, seed=11), args=(600.0,), rtol=1e-3, atol=5e-3)
+
+
+def test_holt_winters():
+    check("double_exponential_smoothing", make_series(n_series=5, n=200, seed=12),
+          args=(0.3, 0.1), rtol=1e-3)
+
+
+def test_empty_windows_are_nan():
+    # one series with a large gap: windows inside the gap must be NaN
+    ts = np.concatenate([BASE + np.arange(10) * 10_000,
+                         BASE + 10_000_000 + np.arange(10) * 10_000]).astype(np.int64)
+    vals = np.ones(20)
+    check("sum_over_time", [(ts, vals)], num_steps=40)
+
+
+def test_absent_over_time():
+    ts = (BASE + np.arange(5) * 10_000).astype(np.int64)
+    check("absent_over_time", [(ts, np.ones(5))], num_steps=40)
+
+
+def test_sparse_vs_window_shorter_than_step():
+    check("sum_over_time", make_series(3, 100, seed=13), window_ms=30_000, step_ms=120_000,
+          num_steps=10)
+    check("rate", make_series(3, 300, seed=14, counter=True), window_ms=60_000,
+          step_ms=120_000, num_steps=10, counter=True, rtol=1e-3)
