@@ -1,0 +1,151 @@
+"""Cross-series aggregation kernels (reference L4: query/exec/aggregator/ —
+RowAggregator SPI with Sum/Min/Max/Count/Avg/Stddev/Stdvar/TopK/Quantile/
+CountValues/Group over RangeVectors, AggrOverRangeVectors.scala mapReduce).
+
+The reference map-reduces per-series rows through per-aggregator state
+machines; here ``sum by (labels)`` is a masked segment-reduce over the
+``[S, J]`` result grid — one jit call for all steps and all groups — and
+cross-shard merging becomes a psum over the mesh (parallel/).
+
+NaN = absence everywhere: a NaN sample doesn't contribute, and a group with
+no members at a step yields NaN.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+SIMPLE_AGG_OPS = ("sum", "count", "avg", "min", "max", "stddev", "stdvar", "group")
+
+
+@functools.partial(jax.jit, static_argnames=("op", "num_groups"))
+def segment_aggregate(op: str, values, group_ids, num_groups: int):
+    """values [S, J] (NaN = absent), group_ids [S] int32 -> [G, J]."""
+    valid = ~jnp.isnan(values)
+    v0 = jnp.where(valid, values, 0.0)
+    count = jax.ops.segment_sum(valid.astype(values.dtype), group_ids, num_groups)
+    has = count > 0
+    if op == "count":
+        return jnp.where(has, count, jnp.nan)
+    if op == "group":
+        return jnp.where(has, 1.0, jnp.nan)
+    if op in ("sum", "avg", "stddev", "stdvar"):
+        s = jax.ops.segment_sum(v0, group_ids, num_groups)
+        if op == "sum":
+            return jnp.where(has, s, jnp.nan)
+        mean = s / jnp.maximum(count, 1.0)
+        if op == "avg":
+            return jnp.where(has, mean, jnp.nan)
+        dev = jnp.where(valid, (values - mean[group_ids]) ** 2, 0.0)
+        var = jax.ops.segment_sum(dev, group_ids, num_groups) / jnp.maximum(count, 1.0)
+        return jnp.where(has, var if op == "stdvar" else jnp.sqrt(var), jnp.nan)
+    if op in ("min", "max"):
+        big = jnp.inf if op == "min" else -jnp.inf
+        vm = jnp.where(valid, values, big)
+        r = (
+            jax.ops.segment_min(vm, group_ids, num_groups)
+            if op == "min"
+            else jax.ops.segment_max(vm, group_ids, num_groups)
+        )
+        return jnp.where(has, r, jnp.nan)
+    raise ValueError(f"unknown aggregation {op}")
+
+
+@functools.partial(jax.jit, static_argnames=("k", "bottom"))
+def topk_mask(values, k: int, bottom: bool = False):
+    """values [S, J] -> [S, J] keeping only per-step top-k (rest NaN).
+
+    Prometheus topk: at each step, the k highest series survive with their own
+    labels (reference TopBottomKRowAggregator with its k-heap per step).
+    Ties broken by series index for determinism.
+    """
+    S, J = values.shape
+    v = jnp.where(jnp.isnan(values), -jnp.inf if not bottom else jnp.inf, values)
+    vt = v.T if not bottom else -v.T  # [J, S], larger = better
+    kk = min(k, S)
+    top_vals, top_idx = jax.lax.top_k(vt, kk)  # [J, kk]
+    sel = jnp.zeros((J, S), dtype=bool)
+    sel = sel.at[jnp.arange(J)[:, None], top_idx].set(True)
+    keep = sel.T & jnp.isfinite(v)
+    return jnp.where(keep, values, jnp.nan)
+
+
+@functools.partial(jax.jit, static_argnames=("num_groups",))
+def segment_quantile(values, group_ids, num_groups: int, q):
+    """Per (group, step) quantile across series: [S, J] -> [G, J].
+
+    Sorts within groups by composite key (group asc, value asc); absent
+    values sort to the group's end. (reference QuantileRowAggregator uses
+    t-digest sketches; exact sort is affordable on device.)
+    """
+    S, J = values.shape
+    valid = ~jnp.isnan(values)
+    count = jax.ops.segment_sum(valid.astype(jnp.float32), group_ids, num_groups)  # [G,J]
+    # sort per step by (group, value) — put NaN/absent at +inf within group.
+    # lexsort as two stable argsorts (least-significant key first)
+    v = jnp.where(valid, values, jnp.inf)
+    gcol = jnp.broadcast_to(group_ids[:, None], (S, J))
+    ord1 = jnp.argsort(v, axis=0, stable=True)
+    g1 = jnp.take_along_axis(gcol, ord1, axis=0)
+    ord2 = jnp.argsort(g1, axis=0, stable=True)
+    order = jnp.take_along_axis(ord1, ord2, axis=0)  # [S, J]
+    sorted_v = jnp.take_along_axis(v, order, axis=0)
+    # start offset of each group in the sorted column = cumulative counts of
+    # all series (valid or not) in earlier groups — series count per group is
+    # step-independent
+    sizes = jax.ops.segment_sum(jnp.ones_like(group_ids, dtype=jnp.int32), group_ids, num_groups)
+    starts = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(sizes)[:-1]])  # [G]
+    rank = jnp.clip(q, 0.0, 1.0) * jnp.maximum(count - 1.0, 0.0)  # [G, J]
+    lo_i = starts[:, None] + jnp.floor(rank).astype(jnp.int32)
+    hi_i = starts[:, None] + jnp.ceil(rank).astype(jnp.int32)
+    frac = rank - jnp.floor(rank)
+    v_lo = jnp.take_along_axis(sorted_v, jnp.clip(lo_i, 0, S - 1), axis=0)
+    v_hi = jnp.take_along_axis(sorted_v, jnp.clip(hi_i, 0, S - 1), axis=0)
+    out = v_lo + (v_hi - v_lo) * frac
+    return jnp.where(count > 0, out, jnp.nan)
+
+
+def count_values(values: np.ndarray, decimals: int = 10) -> dict[str, np.ndarray]:
+    """Host-side count_values: value-string -> [J] counts (reference
+    CountValuesRowAggregator; inherently dynamic-cardinality, stays on host)."""
+    vals = np.asarray(values)
+    out: dict[str, np.ndarray] = {}
+    J = vals.shape[1]
+    for j in range(J):
+        col = vals[:, j]
+        col = col[~np.isnan(col)]
+        for x in col:
+            key = f"{x:.{decimals}g}".rstrip("0").rstrip(".") if "." in f"{x:.{decimals}g}" else f"{x:.{decimals}g}"
+            arr = out.setdefault(key, np.full(J, np.nan))
+            arr[j] = (0.0 if np.isnan(arr[j]) else arr[j]) + 1.0
+    return out
+
+
+def group_ids_for(series_labels: list[dict], by: list[str] | None, without: list[str] | None):
+    """Host-side grouping: label subset -> contiguous group ids + group labels.
+
+    by=None, without=None -> one global group (classic `sum(...)`).
+    """
+    keys = []
+    for lbls in series_labels:
+        if by is not None:
+            key = tuple((k, lbls.get(k, "")) for k in sorted(by))
+        elif without:
+            drop = set(without) | {"_metric_", "__name__"}
+            key = tuple(sorted((k, v) for k, v in lbls.items() if k not in drop))
+        else:
+            key = ()
+        keys.append(key)
+    uniq: dict[tuple, int] = {}
+    gids = np.empty(len(keys), dtype=np.int32)
+    group_labels: list[dict] = []
+    for i, k in enumerate(keys):
+        if k not in uniq:
+            uniq[k] = len(uniq)
+            group_labels.append(dict(k))
+        gids[i] = uniq[k]
+    return gids, group_labels

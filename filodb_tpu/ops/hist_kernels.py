@@ -1,0 +1,162 @@
+"""Native-histogram kernels (reference L0/L4: format/vectors/Histogram.scala
+quantile math :64-130, HistogramQuantileMapper, RateFunctions hist rate :367).
+
+Native histograms stage as ``[S, T, B]`` cumulative bucket-count blocks —
+already the ideal TPU layout. Per-bucket rate/increase/sum reuse the same
+boundary-index machinery as scalar kernels (broadcast over B);
+histogram_quantile is a vectorized interpolation over the bucket axis.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .kernels import RangeParams, _bounds, pad_steps
+from .staging import StagedBlock
+
+
+def _gather3(arr, idx):
+    """arr [S, T, B], idx [S, J] -> [S, J, B]."""
+    T = arr.shape[1]
+    return jnp.take_along_axis(arr, jnp.clip(idx, 0, T - 1)[:, :, None], axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("func", "num_steps", "is_delta"))
+def hist_range_kernel(
+    func: str,
+    ts,  # [S, T] i32
+    vals,  # [S, T, B] f32 bucket counts (cumulative; baseline-subtracted)
+    lens,  # [S] i32
+    start_off,
+    step_ms,
+    window,
+    num_steps: int,
+    is_delta: bool = False,
+):
+    """[S, num_steps, B] per-bucket results for hist rate/increase/last/sum."""
+    out_t = start_off + jnp.arange(num_steps, dtype=jnp.int32) * step_ms
+    lo, hi = _bounds(ts, lens, out_t, window)
+    count = (hi - lo).astype(jnp.float32)[:, :, None]
+    has = count > 0
+    if func in ("last", "last_over_time"):
+        return jnp.where(has, _gather3(vals, hi - 1), jnp.nan)
+    if func == "sum_over_time" or (is_delta and func in ("rate", "increase")):
+        cs = jnp.cumsum(vals, axis=1)
+        cs = jnp.concatenate([jnp.zeros_like(cs[:, :1]), cs], axis=1)
+        s = _gather3(cs, hi) - _gather3(cs, lo)
+        if func == "rate":
+            s = s / (window.astype(jnp.float32) * 1e-3)
+        return jnp.where(has, s, jnp.nan)
+    if func in ("rate", "increase", "delta"):
+        # cumulative histograms: per-bucket extrapolated increase, same
+        # Prometheus window-edge extrapolation as scalars (no zero cap —
+        # bucket counts are far from zero-crossing concerns; reference hist
+        # rate RateFunctions.scala:367 likewise extrapolates per bucket)
+        t_first = jnp.take_along_axis(ts, jnp.clip(lo, 0, ts.shape[1] - 1), axis=1)
+        t_last = jnp.take_along_axis(ts, jnp.clip(hi - 1, 0, ts.shape[1] - 1), axis=1)
+        v_first = _gather3(vals, lo)
+        v_last = _gather3(vals, hi - 1)
+        dlt = v_last - v_first  # [S, J, B]
+        f32 = vals.dtype
+        tf = t_first.astype(f32) * 1e-3
+        tl = t_last.astype(f32) * 1e-3
+        sampled = tl - tf
+        cnt = (hi - lo).astype(f32)
+        range_start = (out_t - window)[None, :].astype(f32) * 1e-3
+        range_end = out_t[None, :].astype(f32) * 1e-3
+        dur_start = tf - range_start
+        dur_end = range_end - tl
+        avg_dur = sampled / jnp.maximum(cnt - 1.0, 1.0)
+        thresh = avg_dur * 1.1
+        dur_start = jnp.where(dur_start >= thresh, avg_dur / 2.0, dur_start)
+        dur_end = jnp.where(dur_end >= thresh, avg_dur / 2.0, dur_end)
+        factor = (sampled + dur_start + dur_end) / jnp.maximum(sampled, 1e-30)
+        res = dlt * factor[:, :, None]
+        if func == "rate":
+            res = res / (window.astype(f32) * 1e-3)
+        return jnp.where((cnt >= 2)[:, :, None], res, jnp.nan)
+    raise ValueError(f"unknown histogram range function {func}")
+
+
+@jax.jit
+def histogram_quantile(q, buckets, les):
+    """Prometheus histogram_quantile over bucket-count/rate grids.
+
+    buckets [..., B] cumulative counts per le; les [B] upper bounds with
+    les[-1] = +inf. Linear interpolation within the located bucket; lower
+    bound of the first bucket is 0 when its le > 0 (promql semantics, and
+    reference Histogram.scala:64-130 quantile()).
+    """
+    B = buckets.shape[-1]
+    total = buckets[..., -1]
+    ok = (total > 0) & jnp.isfinite(total)
+    rank = jnp.clip(q, 0.0, 1.0) * total
+    # first bucket index with count >= rank
+    meets = buckets >= rank[..., None]
+    idx = jnp.argmax(meets, axis=-1)
+    idx = jnp.where(meets.any(-1), idx, B - 1)
+    c_hi = jnp.take_along_axis(buckets, idx[..., None], axis=-1)[..., 0]
+    c_lo = jnp.where(idx > 0, jnp.take_along_axis(buckets, jnp.maximum(idx - 1, 0)[..., None], axis=-1)[..., 0], 0.0)
+    le_hi = les[idx]
+    le_lo = jnp.where(idx > 0, les[jnp.maximum(idx - 1, 0)], jnp.where(les[0] > 0, 0.0, -jnp.inf))
+    # top (+inf) bucket: return the highest finite bound (promql behavior)
+    highest_finite = jnp.where(B >= 2, les[B - 2], les[0])
+    in_top = idx == B - 1
+    frac = (rank - c_lo) / jnp.maximum(c_hi - c_lo, 1e-30)
+    val = le_lo + (le_hi - le_lo) * frac
+    # q<=0 -> lower bound of first bucket; q>=1 -> highest bound
+    val = jnp.where(in_top, highest_finite, val)
+    val = jnp.where(jnp.isneginf(le_lo), le_hi, val)  # le[0] <= 0 edge
+    out = jnp.where(ok, val, jnp.nan)
+    out = jnp.where(q < 0, -jnp.inf, out)
+    out = jnp.where(q > 1, jnp.inf, out)
+    return out
+
+
+@jax.jit
+def histogram_fraction(lower, upper, buckets, les):
+    """promql histogram_fraction(lower, upper, h): fraction of observations in
+    [lower, upper] (reference Histogram.scala fraction math)."""
+
+    def cum_at(x):
+        # interpolated cumulative count at value x
+        B = buckets.shape[-1]
+        xb = jnp.searchsorted(les, x)  # first le >= x
+        xb = jnp.clip(xb, 0, B - 1)
+        c_hi = jnp.take_along_axis(buckets, jnp.broadcast_to(xb, buckets.shape[:-1])[..., None], axis=-1)[..., 0]
+        c_lo = jnp.where(
+            xb > 0,
+            jnp.take_along_axis(buckets, jnp.broadcast_to(jnp.maximum(xb - 1, 0), buckets.shape[:-1])[..., None], axis=-1)[..., 0],
+            0.0,
+        )
+        le_hi = les[xb]
+        le_lo = jnp.where(xb > 0, les[jnp.maximum(xb - 1, 0)], jnp.where(les[0] > 0, 0.0, -jnp.inf))
+        w = jnp.where(jnp.isfinite(le_hi - le_lo), (x - le_lo) / jnp.maximum(le_hi - le_lo, 1e-30), 1.0)
+        w = jnp.clip(w, 0.0, 1.0)
+        return c_lo + (c_hi - c_lo) * w
+
+    total = buckets[..., -1]
+    frac = (cum_at(upper) - cum_at(lower)) / jnp.maximum(total, 1e-30)
+    return jnp.where(total > 0, jnp.clip(frac, 0.0, 1.0), jnp.nan)
+
+
+def run_hist_range_function(
+    func: str, block: StagedBlock, params: RangeParams, is_delta: bool = False
+):
+    j_pad = pad_steps(params.num_steps)
+    start_off = np.int32(params.start_ms - block.base_ms)
+    return hist_range_kernel(
+        func,
+        block.ts,
+        block.vals,
+        block.lens,
+        start_off,
+        np.int32(params.step_ms),
+        np.int32(params.window_ms),
+        j_pad,
+        is_delta=is_delta,
+    )

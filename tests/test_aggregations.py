@@ -1,0 +1,190 @@
+"""Aggregation + histogram kernel tests (model: reference
+AggrOverRangeVectorsSpec, HistogramQuantileMapperSpec, HistogramTest)."""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.ops import aggregations as A
+from filodb_tpu.ops import hist_kernels as H
+
+
+def grid(seed=0, S=20, J=10, nan_frac=0.2):
+    rng = np.random.default_rng(seed)
+    v = rng.standard_normal((S, J)) * 10 + 50
+    if nan_frac:
+        mask = rng.random((S, J)) < nan_frac
+        v[mask] = np.nan
+    return v.astype(np.float32)
+
+
+class TestSegmentAggregate:
+    @pytest.mark.parametrize("op", ["sum", "count", "avg", "min", "max", "stddev", "stdvar", "group"])
+    def test_matches_numpy(self, op):
+        v = grid(seed=3)
+        gids = np.arange(20, dtype=np.int32) % 4
+        got = np.asarray(A.segment_aggregate(op, v, gids, 4))
+        want = np.full((4, 10), np.nan)
+        for g in range(4):
+            rows = v[gids == g].astype(np.float64)
+            for j in range(10):
+                col = rows[:, j]
+                col = col[~np.isnan(col)]
+                if len(col) == 0:
+                    continue
+                want[g, j] = {
+                    "sum": col.sum, "count": lambda: len(col), "avg": col.mean,
+                    "min": col.min, "max": col.max, "stddev": col.std,
+                    "stdvar": col.var, "group": lambda: 1.0,
+                }[op]()
+        np.testing.assert_array_equal(np.isnan(got), np.isnan(want), err_msg=op)
+        m = ~np.isnan(want)
+        np.testing.assert_allclose(got[m], want[m], rtol=1e-4, atol=1e-4, err_msg=op)
+
+    def test_all_nan_group(self):
+        v = grid(seed=4)
+        v[10:] = np.nan
+        gids = (np.arange(20) >= 10).astype(np.int32)
+        got = np.asarray(A.segment_aggregate("sum", v, gids, 2))
+        assert np.isnan(got[1]).all()
+
+
+class TestTopK:
+    def test_topk_selects_k_largest_per_step(self):
+        v = grid(seed=5, nan_frac=0)
+        got = np.asarray(A.topk_mask(v, 3))
+        for j in range(v.shape[1]):
+            kept = np.nonzero(~np.isnan(got[:, j]))[0]
+            assert len(kept) == 3
+            thresh = np.sort(v[:, j])[-3]
+            assert (v[kept, j] >= thresh).all()
+
+    def test_bottomk(self):
+        v = grid(seed=6, nan_frac=0)
+        got = np.asarray(A.topk_mask(v, 2, bottom=True))
+        for j in range(v.shape[1]):
+            kept = np.nonzero(~np.isnan(got[:, j]))[0]
+            assert len(kept) == 2
+            thresh = np.sort(v[:, j])[1]
+            assert (v[kept, j] <= thresh).all()
+
+    def test_topk_with_nans(self):
+        v = grid(seed=7, nan_frac=0.5)
+        got = np.asarray(A.topk_mask(v, 5))
+        # never selects a NaN slot
+        assert not (np.isnan(v) & ~np.isnan(got)).any()
+
+
+class TestSegmentQuantile:
+    @pytest.mark.parametrize("q", [0.0, 0.25, 0.5, 0.9, 1.0])
+    def test_matches_numpy(self, q):
+        v = grid(seed=8, nan_frac=0.15)
+        gids = np.arange(20, dtype=np.int32) % 3
+        got = np.asarray(A.segment_quantile(v, gids, 3, np.float32(q)))
+        for g in range(3):
+            for j in range(10):
+                col = v[gids == g][:, j].astype(np.float64)
+                col = col[~np.isnan(col)]
+                if len(col) == 0:
+                    assert np.isnan(got[g, j])
+                else:
+                    np.testing.assert_allclose(got[g, j], np.quantile(col, q), rtol=1e-4, atol=1e-4)
+
+
+class TestGroupIds:
+    def test_by(self):
+        labels = [{"job": "a", "inst": "1"}, {"job": "a", "inst": "2"}, {"job": "b", "inst": "1"}]
+        gids, glabels = A.group_ids_for(labels, by=["job"], without=None)
+        np.testing.assert_array_equal(gids, [0, 0, 1])
+        assert glabels == [{"job": "a"}, {"job": "b"}]
+
+    def test_without(self):
+        labels = [{"_metric_": "m", "job": "a", "inst": "1"}, {"_metric_": "m", "job": "a", "inst": "2"}]
+        gids, glabels = A.group_ids_for(labels, by=None, without=["inst"])
+        np.testing.assert_array_equal(gids, [0, 0])
+        assert glabels == [{"job": "a"}]
+
+    def test_global(self):
+        gids, glabels = A.group_ids_for([{"a": "1"}, {"b": "2"}], None, None)
+        np.testing.assert_array_equal(gids, [0, 0])
+        assert glabels == [{}]
+
+
+class TestHistogramQuantile:
+    def test_simple_uniform(self):
+        les = np.array([1.0, 2.0, 4.0, np.inf], dtype=np.float32)
+        # 10 obs per bucket -> uniform; median rank=20 -> at le=2.0
+        buckets = np.array([[10.0, 20.0, 30.0, 40.0]], dtype=np.float32)
+        got = np.asarray(H.histogram_quantile(np.float32(0.5), buckets, les))
+        np.testing.assert_allclose(got, [2.0], rtol=1e-5)
+
+    def test_interpolation_within_bucket(self):
+        les = np.array([1.0, 2.0, np.inf], dtype=np.float32)
+        buckets = np.array([[0.0, 10.0, 10.0]], dtype=np.float32)
+        # all obs in (1,2]; q=0.5 -> 1.5
+        got = np.asarray(H.histogram_quantile(np.float32(0.5), buckets, les))
+        np.testing.assert_allclose(got, [1.5], rtol=1e-5)
+
+    def test_first_bucket_lower_bound_zero(self):
+        les = np.array([2.0, 4.0, np.inf], dtype=np.float32)
+        buckets = np.array([[10.0, 10.0, 10.0]], dtype=np.float32)
+        got = np.asarray(H.histogram_quantile(np.float32(0.5), buckets, les))
+        np.testing.assert_allclose(got, [1.0], rtol=1e-5)  # interp from 0
+
+    def test_top_bucket_clamps_to_highest_finite(self):
+        les = np.array([1.0, 2.0, np.inf], dtype=np.float32)
+        buckets = np.array([[0.0, 0.0, 10.0]], dtype=np.float32)
+        got = np.asarray(H.histogram_quantile(np.float32(0.9), buckets, les))
+        np.testing.assert_allclose(got, [2.0])
+
+    def test_empty_histogram_nan(self):
+        les = np.array([1.0, np.inf], dtype=np.float32)
+        buckets = np.array([[0.0, 0.0]], dtype=np.float32)
+        assert np.isnan(np.asarray(H.histogram_quantile(np.float32(0.5), buckets, les))[0])
+
+    def test_batched_shapes(self):
+        les = np.array([1.0, 2.0, 4.0, np.inf], dtype=np.float32)
+        buckets = np.broadcast_to(
+            np.array([10.0, 20.0, 30.0, 40.0], dtype=np.float32), (5, 7, 4)
+        ).copy()
+        got = np.asarray(H.histogram_quantile(np.float32(0.5), buckets, les))
+        assert got.shape == (5, 7)
+        np.testing.assert_allclose(got, 2.0, rtol=1e-5)
+
+
+class TestHistogramFraction:
+    def test_full_range_is_one(self):
+        les = np.array([1.0, 2.0, np.inf], dtype=np.float32)
+        buckets = np.array([[5.0, 10.0, 10.0]], dtype=np.float32)
+        got = np.asarray(H.histogram_fraction(np.float32(0.0), np.float32(1e30), buckets, les))
+        np.testing.assert_allclose(got, [1.0], rtol=1e-5)
+
+    def test_half(self):
+        les = np.array([1.0, 2.0, np.inf], dtype=np.float32)
+        buckets = np.array([[10.0, 20.0, 20.0]], dtype=np.float32)
+        got = np.asarray(H.histogram_fraction(np.float32(0.0), np.float32(1.0), buckets, les))
+        np.testing.assert_allclose(got, [0.5], rtol=1e-5)
+
+
+class TestHistRange:
+    def test_hist_increase_matches_scalar_per_bucket(self):
+        from filodb_tpu.ops.staging import stage_histogram_series, stage_series
+        from filodb_tpu.ops.kernels import RangeParams, run_range_function
+        from filodb_tpu.ops.hist_kernels import run_hist_range_function
+
+        BASE = 1_600_000_000_000
+        rng = np.random.default_rng(0)
+        n, B = 200, 4
+        ts = (BASE + np.arange(1, n + 1) * 10_000).astype(np.int64)
+        incr = rng.poisson(3, size=(n, B)).astype(np.float64)
+        incr[:, -1] = incr.sum(1)
+        hist = np.cumsum(np.cumsum(incr, axis=1), axis=0)
+        hb = stage_histogram_series([(ts, hist)], BASE, B, subtract_baseline=True)
+        params = RangeParams(BASE + 400_000, 60_000, 5, 300_000)
+        got = np.asarray(run_hist_range_function("increase", hb, params))[0, :5]
+        # cross-check each bucket against the scalar kernel (counter path)
+        for b in range(B):
+            sb = stage_series([(ts, hist[:, b])], BASE, counter_corrected=True)
+            want = np.asarray(
+                run_range_function("increase", sb, params, is_counter=True)
+            )[0, :5]
+            np.testing.assert_allclose(got[:, b], want, rtol=1e-3, atol=1e-3)
