@@ -1,0 +1,268 @@
+"""PromQL parser golden tests (model: reference prometheus parser specs —
+LegacyParser/AntlrParser golden LogicalPlan assertions, Parser.scala:40-52)."""
+
+import math
+
+import pytest
+
+from filodb_tpu.core.filters import ColumnFilter
+from filodb_tpu.query import promql as P
+from filodb_tpu.query.logical import (
+    Aggregate,
+    ApplyAbsentFunction,
+    ApplyInstantFunction,
+    ApplyMiscellaneousFunction,
+    ApplySortFunction,
+    BinaryJoin,
+    PeriodicSeries,
+    PeriodicSeriesWithWindowing,
+    RawSeries,
+    ScalarBinaryOperation,
+    ScalarFixedDoublePlan,
+    ScalarTimeBasedPlan,
+    ScalarVaryingDoublePlan,
+    ScalarVectorBinaryOperation,
+    SubqueryWithWindowing,
+    TopLevelSubquery,
+)
+
+START, END, STEP = 1000.0, 2000.0, 15.0
+
+
+def plan(q):
+    return P.query_range_to_logical_plan(q, START, END, STEP)
+
+
+class TestDurations:
+    @pytest.mark.parametrize(
+        "text,ms",
+        [("5m", 300_000), ("1h30m", 5_400_000), ("30s", 30_000), ("100ms", 100),
+         ("2d", 172_800_000), ("1w", 604_800_000), ("1y", 31_536_000_000)],
+    )
+    def test_parse_duration(self, text, ms):
+        assert P.parse_duration_ms(text) == ms
+
+
+class TestSelectors:
+    def test_simple_metric(self):
+        p = plan("http_requests_total")
+        assert isinstance(p, PeriodicSeries)
+        assert ColumnFilter("_metric_", "=", "http_requests_total") in p.raw.filters
+        assert p.start_ms == 1_000_000 and p.end_ms == 2_000_000 and p.step_ms == 15_000
+
+    def test_matchers(self):
+        p = plan('cpu{job="api", env!="dev", host=~"h.*", dc!~"us|eu"}')
+        ops = {(f.column, f.op) for f in p.raw.filters}
+        assert ("job", "=") in ops and ("env", "!=") in ops
+        assert ("host", "=~") in ops and ("dc", "!~") in ops
+
+    def test_name_matcher_normalized(self):
+        p = plan('{__name__="cpu", job="api"}')
+        assert ColumnFilter("_metric_", "=", "cpu") in p.raw.filters
+
+    def test_raw_export(self):
+        p = plan("cpu[5m]")
+        assert isinstance(p, RawSeries)
+
+    def test_offset(self):
+        p = plan("cpu offset 5m")
+        assert isinstance(p, PeriodicSeries) and p.offset_ms == 300_000
+        assert p.raw.end_ms == 2_000_000 - 300_000
+
+    def test_negative_offset(self):
+        p = plan("cpu offset -5m")
+        assert p.offset_ms == -300_000
+
+    def test_at_modifier(self):
+        p = plan("cpu @ 1500")
+        assert p.at_ms == 1_500_000
+        p2 = plan("cpu @ start()")
+        assert p2.at_ms == 1_000_000
+        p3 = plan("cpu @ end()")
+        assert p3.at_ms == 2_000_000
+
+
+class TestRangeFunctions:
+    def test_rate(self):
+        p = plan("rate(http_requests_total[5m])")
+        assert isinstance(p, PeriodicSeriesWithWindowing)
+        assert p.function == "rate" and p.window_ms == 300_000
+        assert p.raw.start_ms == 1_000_000 - 300_000
+
+    def test_rate_with_offset(self):
+        p = plan("rate(cpu[5m] offset 1h)")
+        assert p.offset_ms == 3_600_000
+        assert p.raw.end_ms == 2_000_000 - 3_600_000
+
+    def test_quantile_over_time_scalar_first(self):
+        p = plan("quantile_over_time(0.99, latency[10m])")
+        assert p.function == "quantile_over_time" and p.function_args == (0.99,)
+
+    def test_predict_linear(self):
+        p = plan("predict_linear(disk_free[1h], 3600)")
+        assert p.function == "predict_linear" and p.function_args == (3600.0,)
+
+    def test_holt_winters(self):
+        p = plan("holt_winters(cpu[10m], 0.5, 0.1)")
+        assert p.function == "double_exponential_smoothing"
+        assert p.function_args == (0.5, 0.1)
+
+    @pytest.mark.parametrize("fn", [
+        "increase", "delta", "idelta", "irate", "resets", "changes", "deriv",
+        "avg_over_time", "min_over_time", "max_over_time", "sum_over_time",
+        "count_over_time", "stddev_over_time", "stdvar_over_time",
+        "last_over_time", "present_over_time", "absent_over_time",
+    ])
+    def test_all_simple_range_fns(self, fn):
+        p = plan(f"{fn}(m[5m])")
+        assert isinstance(p, PeriodicSeriesWithWindowing)
+
+
+class TestAggregations:
+    def test_sum_by(self):
+        p = plan("sum by (job) (rate(cpu[5m]))")
+        assert isinstance(p, Aggregate) and p.op == "sum" and p.by == ("job",)
+        assert isinstance(p.inner, PeriodicSeriesWithWindowing)
+
+    def test_suffix_by(self):
+        p = plan("sum(rate(cpu[5m])) by (job, dc)")
+        assert p.by == ("job", "dc")
+
+    def test_without(self):
+        p = plan("avg without (instance) (cpu)")
+        assert p.op == "avg" and p.without == ("instance",)
+
+    def test_topk(self):
+        p = plan("topk(5, cpu)")
+        assert p.op == "topk" and p.params == (5.0,)
+
+    def test_quantile_agg(self):
+        p = plan("quantile(0.9, cpu)")
+        assert p.op == "quantile" and p.params == (0.9,)
+
+    def test_count_values(self):
+        p = plan('count_values("version", build_info)')
+        assert p.op == "count_values" and p.params == ("version",)
+
+    @pytest.mark.parametrize("op", ["sum", "min", "max", "avg", "count", "stddev", "stdvar", "group"])
+    def test_all_simple_aggs(self, op):
+        p = plan(f"{op}(cpu)")
+        assert isinstance(p, Aggregate) and p.op == op
+
+
+class TestBinary:
+    def test_vector_vector(self):
+        p = plan("a + b")
+        assert isinstance(p, BinaryJoin) and p.op == "+" and p.cardinality == "one-to-one"
+
+    def test_precedence(self):
+        p = plan("a + b * c")
+        assert p.op == "+" and isinstance(p.rhs, BinaryJoin) and p.rhs.op == "*"
+
+    def test_power_right_assoc(self):
+        p = plan("2 ^ 3 ^ 2")
+        assert isinstance(p, ScalarBinaryOperation)
+        rhs = p.rhs
+        assert isinstance(rhs, ScalarBinaryOperation) and rhs.op == "^"
+
+    def test_scalar_vector(self):
+        p = plan("cpu * 8")
+        assert isinstance(p, ScalarVectorBinaryOperation) and not p.scalar_is_lhs
+
+    def test_comparison_bool(self):
+        p = plan("cpu > bool 0.5")
+        assert isinstance(p, ScalarVectorBinaryOperation) and p.return_bool
+
+    def test_on_group_left(self):
+        p = plan("a * on (job) group_left (extra) b")
+        assert p.on == ("job",) and p.cardinality == "many-to-one" and p.include == ("extra",)
+
+    def test_ignoring(self):
+        p = plan("a / ignoring (instance) b")
+        assert p.ignoring == ("instance",)
+
+    @pytest.mark.parametrize("op", ["and", "or", "unless"])
+    def test_set_ops(self, op):
+        p = plan(f"a {op} b")
+        assert isinstance(p, BinaryJoin) and p.op == op and p.cardinality == "many-to-many"
+
+    def test_unary_minus(self):
+        p = plan("-cpu")
+        assert isinstance(p, ScalarVectorBinaryOperation) and p.op == "*"
+
+
+class TestInstantAndMisc:
+    def test_instant_fn(self):
+        p = plan("abs(cpu)")
+        assert isinstance(p, ApplyInstantFunction) and p.function == "abs"
+
+    def test_clamp(self):
+        p = plan("clamp(cpu, 0, 100)")
+        assert p.function == "clamp" and p.args == (0.0, 100.0)
+
+    def test_histogram_quantile(self):
+        p = plan("histogram_quantile(0.9, rate(latency[5m]))")
+        assert p.function == "histogram_quantile" and p.args == (0.9,)
+        assert isinstance(p.inner, PeriodicSeriesWithWindowing)
+
+    def test_absent(self):
+        p = plan('absent(cpu{job="x"})')
+        assert isinstance(p, ApplyAbsentFunction)
+        assert ColumnFilter("job", "=", "x") in p.filters
+
+    def test_sort(self):
+        assert isinstance(plan("sort(cpu)"), ApplySortFunction)
+        assert plan("sort_desc(cpu)").descending
+
+    def test_label_replace(self):
+        p = plan('label_replace(cpu, "dst", "$1", "src", "(.*)")')
+        assert isinstance(p, ApplyMiscellaneousFunction)
+        assert p.str_args == ("dst", "$1", "src", "(.*)")
+
+    def test_scalar_vector_wrappers(self):
+        assert isinstance(plan("scalar(cpu)"), ScalarVaryingDoublePlan)
+        assert isinstance(plan("vector(1)"), ScalarVaryingDoublePlan)
+
+    def test_time(self):
+        assert isinstance(plan("time()"), ScalarTimeBasedPlan)
+
+    def test_number_literals(self):
+        assert plan("42").value == 42.0
+        assert plan("0x1F").value == 31.0
+        assert math.isinf(plan("Inf").value)
+        assert math.isnan(plan("NaN").value)
+        assert plan("1e3").value == 1000.0
+
+
+class TestSubqueries:
+    def test_windowed_subquery(self):
+        p = plan("max_over_time(rate(cpu[1m])[30m:1m])")
+        assert isinstance(p, SubqueryWithWindowing)
+        assert p.function == "max_over_time"
+        assert p.window_ms == 1_800_000 and p.sub_step_ms == 60_000
+        assert isinstance(p.inner, PeriodicSeriesWithWindowing)
+
+    def test_default_substep(self):
+        p = plan("avg_over_time(cpu[10m:])")
+        assert p.sub_step_ms == 60_000
+
+    def test_top_level_subquery(self):
+        p = plan("cpu[30m:5m]")
+        assert isinstance(p, TopLevelSubquery)
+        assert isinstance(p.inner, PeriodicSeries)
+        assert p.inner.step_ms == 300_000
+
+
+class TestErrors:
+    @pytest.mark.parametrize("q", [
+        "cpu{job=api}",          # unquoted value
+        "rate(cpu)",             # missing window
+        "sum(a, b)",             # too many args
+        "cpu[5m",                # unclosed
+        "and",                   # bare keyword
+        "topk(cpu)",             # missing param
+        "1 and 2",               # set op on scalars
+    ])
+    def test_rejects(self, q):
+        with pytest.raises(P.PromQLError):
+            plan(q)
