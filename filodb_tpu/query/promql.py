@@ -673,6 +673,8 @@ class Converter:
         scalar_r = _is_scalar_node(b.rhs)
         p = self.p
         if scalar_l and scalar_r:
+            if b.op in F.SET_OPS:
+                raise PromQLError(f"set operator {b.op} requires vector operands")
             lhs, rhs = self.to_plan(b.lhs), self.to_plan(b.rhs)
             return ScalarBinaryOperation(b.op, lhs, rhs, p.start_ms, p.end_ms, p.step_ms)
         if scalar_l or scalar_r:
