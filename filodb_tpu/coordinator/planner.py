@@ -1,0 +1,282 @@
+"""Query planner: LogicalPlan -> ExecPlan (reference L5:
+queryplanner/SingleClusterPlanner.scala:55 materialize:310 — shard fan-out,
+transformer pushdown onto leaves, aggregate pushdown :1137).
+
+Planning strategy (mirrors the reference):
+- selectors fan out one leaf per shard; transformers (periodic samples,
+  instant fns, scalar ops) are pushed onto every leaf so they run where the
+  data is (on device, per shard block);
+- mergeable aggregations (sum/min/max/count/avg/stddev/stdvar/group) push
+  their map phase onto the leaves and reduce at the root
+  (AggregateMapReduce -> ReduceAggregateExec, the psum path once shards are
+  mesh-resident);
+- non-mergeable aggregations (topk/quantile/count_values) and joins gather
+  full series at the root.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+from ..core.filters import ColumnFilter
+from ..query import logical as L
+from ..query.exec.joins import (
+    BinaryJoinExec,
+    ScalarPlanExec,
+    ScalarVaryingExec,
+    ScalarVectorOpExec,
+    SetOperatorExec,
+    SubqueryWindowExec,
+)
+from ..query.exec.plans import (
+    _PARTIAL_COMPONENTS,
+    AggregateMapReduce,
+    AggregatePresentExec,
+    DistConcatExec,
+    EmptyResultExec,
+    ExecPlan,
+    QueryContext,
+    RawChunkExportExec,
+    ReduceAggregateExec,
+    SelectRawPartitionsExec,
+)
+from ..query.exec.transformers import (
+    AbsentFunctionMapper,
+    InstantVectorFunctionMapper,
+    LimitFunctionMapper,
+    MiscellaneousFunctionMapper,
+    PeriodicSamplesMapper,
+    QueryError,
+    ScalarOperationMapper,
+    SortFunctionMapper,
+)
+from ..query.functions import RANGE_FUNCTIONS
+from ..query.promql import query_range_to_logical_plan, query_to_logical_plan
+
+
+class MetadataExec(ExecPlan):
+    """Label values/names & series metadata queries (reference
+    MetadataExecPlan execs)."""
+
+    def __init__(self, kind: str, filters, start_ms, end_ms, label: str | None = None, limit=1000):
+        super().__init__()
+        self.kind = kind
+        self.filters = tuple(filters)
+        self.start_ms = start_ms
+        self.end_ms = end_ms
+        self.label = label
+        self.limit = limit
+
+    def do_execute(self, ctx: QueryContext):
+        from ..query.rangevector import QueryResult
+
+        ms = ctx.memstore
+        res = QueryResult()
+        if self.kind == "label_values":
+            res.metadata = ms.label_values(ctx.dataset, self.filters, self.label, self.start_ms, self.end_ms, self.limit)
+        elif self.kind == "label_names":
+            res.metadata = ms.label_names(ctx.dataset, self.filters, self.start_ms, self.end_ms)
+        elif self.kind == "series":
+            res.metadata = [dict(t) for t in ms.series(ctx.dataset, self.filters, self.start_ms, self.end_ms, self.limit)]
+        else:
+            raise QueryError(f"unknown metadata query {self.kind}")
+        res.result_type = "metadata"
+        return res
+
+
+@dataclass
+class PlannerParams:
+    """Per-planner config (reference PlannerParams / QueryConfig)."""
+
+    spread: int = 3
+    lookback_ms: int = 300_000
+    max_series: int = 1_000_000
+
+
+class SingleClusterPlanner:
+    """Plans against the shards of one memstore cluster."""
+
+    def __init__(self, memstore, dataset: str, shard_nums: Sequence[int] | None = None,
+                 params: PlannerParams | None = None):
+        self.memstore = memstore
+        self.dataset = dataset
+        self.params = params or PlannerParams()
+        self._shards = shard_nums
+
+    def shards_for(self, filters) -> list[int]:
+        # With shard-key equality filters we could prune to 2^spread shards
+        # (reference shardsFromFilters); scanning all owned shards is always
+        # correct and the per-shard index makes misses cheap.
+        return list(self._shards) if self._shards is not None else self.memstore.shard_nums(self.dataset)
+
+    # -- entry -----------------------------------------------------------
+
+    def materialize(self, plan: L.LogicalPlan) -> ExecPlan:
+        m = self._materialize
+        return m(plan)
+
+    def _fanout(self, make_leaf, transformers) -> ExecPlan:
+        leaves = []
+        for s in self.shards_for(None):
+            leaf = make_leaf(s)
+            leaf.transformers.extend(transformers)
+            leaves.append(leaf)
+        if not leaves:
+            return EmptyResultExec()
+        if len(leaves) == 1:
+            return leaves[0]
+        return DistConcatExec(leaves)
+
+    def _materialize(self, p: L.LogicalPlan) -> ExecPlan:
+        if isinstance(p, L.PeriodicSeries):
+            mapper = PeriodicSamplesMapper(
+                p.start_ms, p.end_ms, p.step_ms, None, None, p.lookback_ms, p.offset_ms, p.at_ms
+            )
+            raw = p.raw
+            return self._fanout(
+                lambda s: SelectRawPartitionsExec(s, raw.filters, raw.start_ms, raw.end_ms, raw.column),
+                [mapper],
+            )
+        if isinstance(p, L.PeriodicSeriesWithWindowing):
+            mapper = PeriodicSamplesMapper(
+                p.start_ms, p.end_ms, p.step_ms, p.function, p.window_ms,
+                offset_ms=p.offset_ms, at_ms=p.at_ms, args=p.function_args,
+            )
+            raw = p.raw
+            return self._fanout(
+                lambda s: SelectRawPartitionsExec(s, raw.filters, raw.start_ms, raw.end_ms, raw.column),
+                [mapper],
+            )
+        if isinstance(p, L.RawSeries):
+            return self._fanout(
+                lambda s: RawChunkExportExec(s, p.filters, p.start_ms, p.end_ms, p.column), []
+            )
+        if isinstance(p, L.Aggregate):
+            return self._materialize_aggregate(p)
+        if isinstance(p, L.BinaryJoin):
+            lhs = self._materialize(p.lhs)
+            rhs = self._materialize(p.rhs)
+            if p.op in ("and", "or", "unless"):
+                return SetOperatorExec(lhs, rhs, p.op, p.on, p.ignoring)
+            return BinaryJoinExec(
+                lhs, rhs, p.op, p.cardinality, p.on, p.ignoring, p.include, p.return_bool
+            )
+        if isinstance(p, L.ScalarVectorBinaryOperation):
+            vec = self._materialize(p.vector)
+            sc = p.scalar
+            if isinstance(sc, (L.ScalarFixedDoublePlan, L.ScalarTimeBasedPlan, L.ScalarBinaryOperation)):
+                # push the mapper onto the vector subtree; scalar evaluated at
+                # execution against the vector's own grid
+                times = _plan_times(p.vector)
+                if times is not None:
+                    start, end, step = times
+                    nsteps = int((end - start) // step) + 1
+                    sexec = ScalarPlanExec(sc, start, step, nsteps)
+                    return ScalarVectorOpExec(vec, sexec, p.op, p.scalar_is_lhs, p.return_bool)
+                sexec = ScalarPlanExec(sc, getattr(sc, "start_ms", 0), getattr(sc, "step_ms", 1) or 1, 1)
+                return ScalarVectorOpExec(vec, sexec, p.op, p.scalar_is_lhs, p.return_bool)
+            if isinstance(sc, L.ScalarVaryingDoublePlan):
+                sexec = ScalarVaryingExec(self._materialize(sc.inner), sc.function)
+                return ScalarVectorOpExec(vec, sexec, p.op, p.scalar_is_lhs, p.return_bool)
+            raise QueryError(f"unsupported scalar operand {sc}")
+        if isinstance(p, L.ApplyInstantFunction):
+            inner = self._materialize(p.inner)
+            inner.transformers.append(InstantVectorFunctionMapper(p.function, p.args))
+            return inner
+        if isinstance(p, L.ApplyMiscellaneousFunction):
+            inner = self._materialize(p.inner)
+            inner.transformers.append(MiscellaneousFunctionMapper(p.function, p.str_args))
+            return inner
+        if isinstance(p, L.ApplySortFunction):
+            inner = self._materialize(p.inner)
+            inner.transformers.append(SortFunctionMapper(p.descending))
+            return inner
+        if isinstance(p, L.ApplyAbsentFunction):
+            inner = self._materialize(p.inner)
+            nsteps = int((p.end_ms - p.start_ms) // p.step_ms) + 1 if p.step_ms else 1
+            inner.transformers.append(
+                AbsentFunctionMapper(p.filters, p.start_ms, p.step_ms or 1, nsteps)
+            )
+            return inner
+        if isinstance(p, L.ApplyLimitFunction):
+            inner = self._materialize(p.inner)
+            inner.transformers.append(LimitFunctionMapper(p.limit))
+            return inner
+        if isinstance(p, (L.ScalarFixedDoublePlan, L.ScalarTimeBasedPlan, L.ScalarBinaryOperation)):
+            nsteps = int((p.end_ms - p.start_ms) // p.step_ms) + 1 if p.step_ms else 1
+            return ScalarPlanExec(p, p.start_ms, p.step_ms or 1, nsteps)
+        if isinstance(p, L.ScalarVaryingDoublePlan):
+            return ScalarVaryingExec(self._materialize(p.inner), p.function)
+        if isinstance(p, L.SubqueryWithWindowing):
+            inner = self._materialize(p.inner)
+            return SubqueryWindowExec(
+                inner, p.function, p.window_ms, p.sub_step_ms,
+                p.start_ms, p.end_ms, p.step_ms, p.offset_ms, p.function_args,
+            )
+        if isinstance(p, L.TopLevelSubquery):
+            return self._materialize(p.inner)
+        if isinstance(p, L.LabelValues):
+            return MetadataExec("label_values", p.filters, p.start_ms, p.end_ms, p.label)
+        if isinstance(p, L.LabelNames):
+            return MetadataExec("label_names", p.filters, p.start_ms, p.end_ms)
+        if isinstance(p, L.SeriesKeysByFilters):
+            return MetadataExec("series", p.filters, p.start_ms, p.end_ms)
+        raise QueryError(f"cannot materialize {type(p).__name__}")
+
+    def _materialize_aggregate(self, p: L.Aggregate) -> ExecPlan:
+        inner = self._materialize(p.inner)
+        simple = p.op in _PARTIAL_COMPONENTS
+        if simple and isinstance(inner, DistConcatExec) and not inner.transformers:
+            # push map phase onto each shard subtree (reference agg pushdown
+            # SingleClusterPlanner.scala:1137)
+            for child in inner.child_plans:
+                child.transformers.append(AggregateMapReduce(p.op, p.by, p.without))
+            return ReduceAggregateExec(inner.child_plans, p.op, p.by, p.without)
+        if simple and not isinstance(inner, DistConcatExec):
+            inner.transformers.append(AggregateMapReduce(p.op, p.by, p.without))
+            return ReduceAggregateExec([inner], p.op, p.by, p.without)
+        return AggregatePresentExec([inner], p.op, p.params, p.by, p.without)
+
+
+def _plan_times(p: L.LogicalPlan):
+    for attr in ("start_ms",):
+        if hasattr(p, "start_ms") and hasattr(p, "step_ms") and hasattr(p, "end_ms"):
+            return p.start_ms, p.end_ms, p.step_ms or 1
+    for f in getattr(p, "__dataclass_fields__", {}):
+        v = getattr(p, f)
+        if isinstance(v, L.LogicalPlan):
+            t = _plan_times(v)
+            if t is not None:
+                return t
+    return None
+
+
+class QueryEngine:
+    """Top-level facade: PromQL string -> executed result (the in-process
+    analog of QueryActor -> planner.materialize -> execute)."""
+
+    def __init__(self, memstore, dataset: str, params: PlannerParams | None = None):
+        self.memstore = memstore
+        self.dataset = dataset
+        self.planner = SingleClusterPlanner(memstore, dataset, params=params)
+
+    def context(self) -> QueryContext:
+        return QueryContext(self.memstore, self.dataset)
+
+    def query_range(self, promql: str, start_s: float, end_s: float, step_s: float):
+        plan = query_range_to_logical_plan(promql, start_s, end_s, step_s,
+                                           self.planner.params.lookback_ms)
+        exec_plan = self.planner.materialize(plan)
+        res = exec_plan.execute(self.context())
+        if res.result_type == "matrix" or res.grids:
+            res.result_type = "matrix"
+        return res
+
+    def query_instant(self, promql: str, time_s: float):
+        plan = query_to_logical_plan(promql, time_s, self.planner.params.lookback_ms)
+        exec_plan = self.planner.materialize(plan)
+        res = exec_plan.execute(self.context())
+        if res.result_type == "matrix":
+            res.result_type = "vector"
+        return res

@@ -1,0 +1,366 @@
+"""Binary joins, set operators, scalar plans, subquery execution
+(reference query/exec/BinaryJoinExec.scala, SetOperatorExec.scala,
+binaryOp/BinaryOperatorFunction, scalar execs :816-928).
+
+Join matching is host-side over label keys (cheap: #series, not #samples);
+the value arithmetic runs on the [S, J] grids on device.
+"""
+
+from __future__ import annotations
+
+import calendar
+import datetime as _dt
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.schemas import METRIC_TAG
+from ...ops import staging as ST
+from ...ops import kernels as K
+from ..rangevector import Grid, QueryResult, ScalarResult
+from .plans import ExecPlan, NonLeafExecPlan, QueryContext
+from .transformers import QueryError, _strip_metric, apply_binop
+
+
+def _match_key(labels: dict, on, ignoring) -> tuple:
+    if on is not None:
+        return tuple((k, labels.get(k, "")) for k in sorted(on))
+    drop = set(ignoring or ()) | {METRIC_TAG, "__name__"}
+    return tuple(sorted((k, v) for k, v in labels.items() if k not in drop))
+
+
+def _flatten(grids: list[Grid]) -> tuple[list[dict], np.ndarray, Grid | None]:
+    if not grids:
+        return [], np.zeros((0, 0), np.float32), None
+    meta = grids[0]
+    labels = [l for g in grids for l in g.labels]
+    J = max(g.values_np().shape[1] for g in grids)
+    vals = np.full((len(labels), J), np.nan, np.float32)
+    r = 0
+    for g in grids:
+        v = g.values_np()
+        vals[r : r + v.shape[0], : v.shape[1]] = v
+        r += v.shape[0]
+    return labels, vals, meta
+
+
+class BinaryJoinExec(NonLeafExecPlan):
+    """Arithmetic/comparison joins with one-to-one / group_left / group_right
+    cardinality (reference BinaryJoinExec)."""
+
+    def __init__(self, lhs: ExecPlan, rhs: ExecPlan, op: str, cardinality: str,
+                 on=None, ignoring=(), include=(), return_bool=False):
+        super().__init__([lhs, rhs])
+        self.op = op
+        self.cardinality = cardinality
+        self.on = on
+        self.ignoring = ignoring
+        self.include = include
+        self.return_bool = return_bool
+
+    def args_str(self):
+        return f"op={self.op} card={self.cardinality} on={self.on} ignoring={self.ignoring}"
+
+    def do_execute(self, ctx: QueryContext) -> QueryResult:
+        lres, rres = self.execute_children(ctx)
+        llabels, lvals, lmeta = _flatten(lres.grids)
+        rlabels, rvals, rmeta = _flatten(rres.grids)
+        meta = lmeta or rmeta
+        if meta is None:
+            return QueryResult()
+        rindex: dict[tuple, list[int]] = {}
+        for j, rl in enumerate(rlabels):
+            rindex.setdefault(_match_key(rl, self.on, self.ignoring), []).append(j)
+
+        out_labels: list[dict] = []
+        lhs_rows: list[int] = []
+        rhs_rows: list[int] = []
+        many_side_left = self.cardinality == "many-to-one"
+        one_to_one = self.cardinality == "one-to-one"
+        if one_to_one:
+            seen: dict[tuple, int] = {}
+            for i, ll in enumerate(llabels):
+                key = _match_key(ll, self.on, self.ignoring)
+                js = rindex.get(key, [])
+                if not js:
+                    continue
+                if len(js) > 1:
+                    raise QueryError("many-to-many matching not allowed: use group_left/group_right")
+                if key in seen:
+                    raise QueryError("multiple matches for labels on left side")
+                seen[key] = i
+                out_labels.append(self._result_labels(ll, rlabels[js[0]]))
+                lhs_rows.append(i)
+                rhs_rows.append(js[0])
+        else:
+            # group_left: many on the left; group_right: many on the right
+            many_labels, many_vals = (llabels, lvals) if many_side_left else (rlabels, rvals)
+            one_labels = rlabels if many_side_left else llabels
+            one_index: dict[tuple, list[int]] = {}
+            for j, ol in enumerate(one_labels):
+                one_index.setdefault(_match_key(ol, self.on, self.ignoring), []).append(j)
+            for i, ml in enumerate(many_labels):
+                key = _match_key(ml, self.on, self.ignoring)
+                js = one_index.get(key, [])
+                if not js:
+                    continue
+                if len(js) > 1:
+                    raise QueryError("multiple matches on the 'one' side of a grouped join")
+                j = js[0]
+                lbl = dict(_strip_metric(ml))
+                for inc in self.include:
+                    v = one_labels[j].get(inc)
+                    if v is not None:
+                        lbl[inc] = v
+                    else:
+                        lbl.pop(inc, None)
+                out_labels.append(lbl)
+                if many_side_left:
+                    lhs_rows.append(i)
+                    rhs_rows.append(j)
+                else:
+                    lhs_rows.append(j)
+                    rhs_rows.append(i)
+        if not out_labels:
+            return QueryResult()
+        a = jnp.asarray(lvals[np.asarray(lhs_rows)])
+        b = jnp.asarray(rvals[np.asarray(rhs_rows)])
+        v = apply_binop(self.op, a, b, self.return_bool)
+        return QueryResult(grids=[Grid(out_labels, meta.start_ms, meta.step_ms, meta.num_steps, v)])
+
+    def _result_labels(self, ll: dict, rl: dict) -> dict:
+        from .transformers import _CMPOPS
+
+        keep_name = self.op in _CMPOPS and not self.return_bool
+        if self.on is not None:
+            base = {k: ll.get(k, "") for k in self.on if k in ll}
+            # one-to-one with on(): result labels are the on() labels
+            out = dict(base)
+            if keep_name and METRIC_TAG in ll:
+                out[METRIC_TAG] = ll[METRIC_TAG]
+            return out
+        out = dict(ll) if keep_name else _strip_metric(ll)
+        for k in self.ignoring:
+            out.pop(k, None)
+        return out
+
+
+class SetOperatorExec(NonLeafExecPlan):
+    """and / or / unless with per-step sample semantics (reference
+    SetOperatorExec.scala:406)."""
+
+    def __init__(self, lhs: ExecPlan, rhs: ExecPlan, op: str, on=None, ignoring=()):
+        super().__init__([lhs, rhs])
+        self.op = op
+        self.on = on
+        self.ignoring = ignoring
+
+    def args_str(self):
+        return f"op={self.op} on={self.on} ignoring={self.ignoring}"
+
+    def do_execute(self, ctx: QueryContext) -> QueryResult:
+        lres, rres = self.execute_children(ctx)
+        llabels, lvals, lmeta = _flatten(lres.grids)
+        rlabels, rvals, rmeta = _flatten(rres.grids)
+        meta = lmeta or rmeta
+        if meta is None:
+            return QueryResult()
+        rkeys: dict[tuple, list[int]] = {}
+        for j, rl in enumerate(rlabels):
+            rkeys.setdefault(_match_key(rl, self.on, self.ignoring), []).append(j)
+        J = lvals.shape[1] if lvals.size else rvals.shape[1]
+        out_labels: list[dict] = []
+        rows: list[np.ndarray] = []
+        if self.op in ("and", "unless"):
+            for i, ll in enumerate(llabels):
+                js = rkeys.get(_match_key(ll, self.on, self.ignoring), [])
+                if js:
+                    present = ~np.isnan(rvals[js]).all(axis=0)
+                else:
+                    present = np.zeros(J, dtype=bool)
+                keep = present if self.op == "and" else ~present
+                row = np.where(keep, lvals[i], np.nan)
+                if not np.isnan(row).all():
+                    out_labels.append(ll)
+                    rows.append(row)
+        else:  # or
+            lkeys_per_step: dict[tuple, np.ndarray] = {}
+            for i, ll in enumerate(llabels):
+                key = _match_key(ll, self.on, self.ignoring)
+                present = ~np.isnan(lvals[i])
+                cur = lkeys_per_step.get(key)
+                lkeys_per_step[key] = present if cur is None else (cur | present)
+                out_labels.append(ll)
+                rows.append(lvals[i])
+            for j, rl in enumerate(rlabels):
+                key = _match_key(rl, self.on, self.ignoring)
+                lpresent = lkeys_per_step.get(key)
+                row = rvals[j]
+                if lpresent is not None:
+                    row = np.where(lpresent, np.nan, row)
+                if not np.isnan(row).all():
+                    out_labels.append(rl)
+                    rows.append(row)
+        vals = np.stack(rows) if rows else np.zeros((0, J), np.float32)
+        return QueryResult(grids=[Grid(out_labels, meta.start_ms, meta.step_ms, meta.num_steps, vals)])
+
+
+# ---------------------------------------------------------------------------
+# scalar plans
+# ---------------------------------------------------------------------------
+
+
+class ScalarPlanExec(ExecPlan):
+    """Evaluates ScalarFixedDoublePlan / ScalarTimeBasedPlan /
+    ScalarBinaryOperation trees to a per-step scalar."""
+
+    def __init__(self, logical, start_ms: int, step_ms: int, num_steps: int):
+        super().__init__()
+        self.logical = logical
+        self.start_ms = start_ms
+        self.step_ms = step_ms
+        self.num_steps = num_steps
+
+    def do_execute(self, ctx: QueryContext) -> QueryResult:
+        vals = eval_scalar(self.logical, self.start_ms, self.step_ms, self.num_steps, ctx)
+        res = QueryResult(scalar=ScalarResult(self.start_ms, self.step_ms, self.num_steps, vals))
+        res.result_type = "scalar"
+        return res
+
+
+def eval_scalar(plan, start_ms, step_ms, num_steps, ctx) -> np.ndarray:
+    from ..logical import (
+        ScalarBinaryOperation,
+        ScalarFixedDoublePlan,
+        ScalarTimeBasedPlan,
+        ScalarVaryingDoublePlan,
+    )
+
+    times_s = (start_ms + np.arange(num_steps, dtype=np.int64) * step_ms) / 1e3
+    if isinstance(plan, (int, float)):
+        return np.full(num_steps, float(plan))
+    if isinstance(plan, ScalarFixedDoublePlan):
+        return np.full(num_steps, plan.value)
+    if isinstance(plan, ScalarTimeBasedPlan):
+        if plan.function == "time":
+            return times_s.astype(np.float64)
+        from .transformers import _TIME_COMPONENT
+
+        fn = _TIME_COMPONENT[plan.function]
+        return np.array(
+            [fn(_dt.datetime.fromtimestamp(t, _dt.timezone.utc)) for t in times_s], dtype=np.float64
+        )
+    if isinstance(plan, ScalarBinaryOperation):
+        a = eval_scalar(plan.lhs, start_ms, step_ms, num_steps, ctx)
+        b = eval_scalar(plan.rhs, start_ms, step_ms, num_steps, ctx)
+        return np.asarray(apply_binop(plan.op, jnp.asarray(a), jnp.asarray(b), False))
+    if isinstance(plan, ScalarVaryingDoublePlan):
+        # scalar(vector): handled by ScalarVaryingExec via the planner
+        raise QueryError("scalar(vector) must be materialized via planner")
+    raise QueryError(f"cannot evaluate scalar plan {plan}")
+
+
+class ScalarVaryingExec(NonLeafExecPlan):
+    """scalar(v) and vector(s) wrappers."""
+
+    def __init__(self, child: ExecPlan, function: str):
+        super().__init__([child])
+        self.function = function
+
+    def do_execute(self, ctx: QueryContext) -> QueryResult:
+        (r,) = self.execute_children(ctx)
+        if self.function == "scalar":
+            labels, vals, meta = _flatten(r.grids)
+            if meta is None:
+                return QueryResult(scalar=None, result_type="scalar")
+            if len(labels) == 1:
+                out = vals[0].astype(np.float64)
+            else:
+                out = np.full(vals.shape[1] if vals.size else meta.num_steps, np.nan)
+            res = QueryResult(scalar=ScalarResult(meta.start_ms, meta.step_ms, meta.num_steps, out))
+            res.result_type = "scalar"
+            return res
+        # vector(s)
+        s = r.scalar
+        if s is None:
+            return QueryResult()
+        vals = np.asarray(s.values, dtype=np.float32)[None, :]
+        return QueryResult(grids=[Grid([{}], s.start_ms, s.step_ms, s.num_steps, vals)], result_type="vector")
+
+
+class ScalarVectorOpExec(NonLeafExecPlan):
+    """vector op scalar where the scalar side may itself be an exec
+    (scalar(vector), time()-based, or scalar expression)."""
+
+    def __init__(self, vector: ExecPlan, scalar: ExecPlan, op: str,
+                 scalar_is_lhs: bool, return_bool: bool = False):
+        super().__init__([vector, scalar])
+        self.op = op
+        self.scalar_is_lhs = scalar_is_lhs
+        self.return_bool = return_bool
+
+    def args_str(self):
+        return f"op={self.op} scalar_is_lhs={self.scalar_is_lhs}"
+
+    def do_execute(self, ctx: QueryContext) -> QueryResult:
+        from .transformers import ScalarOperationMapper
+
+        vres, sres = self.execute_children(ctx)
+        scalar = sres.scalar if sres.scalar is not None else ScalarResult(0, 1, 1, np.array([np.nan]))
+        mapper = ScalarOperationMapper(self.op, scalar, self.scalar_is_lhs, self.return_bool)
+        return QueryResult(grids=mapper.apply(vres.grids), stats=vres.stats)
+
+
+# ---------------------------------------------------------------------------
+# subqueries
+# ---------------------------------------------------------------------------
+
+_COUNTERISH = {"rate", "increase", "irate"}
+
+
+class SubqueryWindowExec(NonLeafExecPlan):
+    """Range function over an inner expression's step grid (reference
+    subquery materialization in DefaultPlanner): the inner result rows are
+    re-staged as series and fed through the same window kernels."""
+
+    def __init__(self, child: ExecPlan, function: str, window_ms: int, sub_step_ms: int,
+                 start_ms: int, end_ms: int, step_ms: int, offset_ms: int = 0, args=()):
+        super().__init__([child])
+        self.function = function
+        self.window_ms = window_ms
+        self.sub_step_ms = sub_step_ms
+        self.start_ms = start_ms
+        self.end_ms = end_ms
+        self.step_ms = step_ms
+        self.offset_ms = offset_ms
+        self.args = args
+
+    def args_str(self):
+        return f"fn={self.function} window={self.window_ms} substep={self.sub_step_ms}"
+
+    def do_execute(self, ctx: QueryContext) -> QueryResult:
+        (r,) = self.execute_children(ctx)
+        nsteps = int((self.end_ms - self.start_ms) // self.step_ms) + 1
+        out_grids = []
+        for g in r.grids:
+            v = g.values_np()
+            times = g.step_times_ms()
+            series = []
+            for i in range(v.shape[0]):
+                row = v[i]
+                keep = ~np.isnan(row)
+                series.append((times[keep].astype(np.int64), row[keep].astype(np.float64)))
+            block = ST.stage_series(
+                series, self.start_ms - self.window_ms - self.offset_ms,
+                counter_corrected=self.function in _COUNTERISH,
+            )
+            params = K.RangeParams(
+                self.start_ms - self.offset_ms, self.step_ms, nsteps, self.window_ms
+            )
+            vals = K.run_range_function(
+                self.function, block, params,
+                is_counter=self.function in _COUNTERISH, args=self.args,
+            )
+            out_grids.append(Grid(list(g.labels), self.start_ms, self.step_ms, nsteps, vals))
+        return QueryResult(grids=out_grids)

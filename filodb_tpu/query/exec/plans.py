@@ -1,0 +1,544 @@
+"""ExecPlan tree (reference query/exec/ExecPlan.scala — execute:356 runs the
+leaf's doExecute then folds transformers; NonLeafExecPlan:674 scatter-gathers
+children. Here children run via a dispatcher abstraction so the same tree
+shape serves in-process, mesh-sharded, and (later) remote execution).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.filters import ColumnFilter
+from ...core.schemas import METRIC_TAG, ColumnType
+from ...ops import aggregations as AGG
+from ...ops import staging as ST
+from ..rangevector import Grid, QueryResult, QueryStats, RawGrid, ScalarResult
+from .transformers import (
+    AbsentFunctionMapper,
+    PeriodicSamplesMapper,
+    QueryError,
+    _strip_metric,
+    apply_binop,
+)
+
+
+@dataclass
+class QueryContext:
+    """Per-query execution context (reference QueryContext/QuerySession)."""
+
+    memstore: Any  # TimeSeriesMemStore
+    dataset: str
+    max_series: int = 1_000_000
+    max_samples: int = 500_000_000
+    max_result_bytes: int = 1 << 30
+    deadline_s: float = 60.0
+    stats: QueryStats = field(default_factory=QueryStats)
+
+
+class ExecPlan:
+    """Base: leaf plans implement do_execute; transformers fold after."""
+
+    transformers: list
+
+    def __init__(self):
+        self.transformers = []
+
+    def execute(self, ctx: QueryContext) -> QueryResult:
+        t0 = time.perf_counter_ns()
+        res = self.do_execute(ctx)
+        for tr in self.transformers:
+            res = apply_transformer(tr, res, ctx)
+        ctx.stats.cpu_ns += time.perf_counter_ns() - t0
+        return res
+
+    def do_execute(self, ctx: QueryContext) -> QueryResult:
+        raise NotImplementedError
+
+    def children(self) -> Sequence["ExecPlan"]:
+        return ()
+
+    # -- plan printing (reference printTree golden tests) -----------------
+
+    def args_str(self) -> str:
+        return ""
+
+    def print_tree(self, level: int = 0) -> str:
+        pad = "-" * level
+        lines = []
+        for tr in reversed(self.transformers):
+            lines.append(f"{pad}T~{type(tr).__name__}({tr_args(tr)})")
+            pad = "-" * (level + len(lines))
+        lines.append(f"{pad}E~{type(self).__name__}({self.args_str()})")
+        for c in self.children():
+            lines.append(c.print_tree(level + len(lines)))
+        return "\n".join(lines)
+
+
+def tr_args(tr) -> str:
+    if isinstance(tr, PeriodicSamplesMapper):
+        return f"fn={tr.function} window={tr.window_ms} step={tr.step_ms}"
+    return ""
+
+
+def apply_transformer(tr, res: QueryResult, ctx: QueryContext) -> QueryResult:
+    if isinstance(tr, PeriodicSamplesMapper):
+        return QueryResult(grids=tr.apply_raw(res.raw_grids), stats=res.stats)
+    if isinstance(tr, AbsentFunctionMapper):
+        return QueryResult(grids=tr.apply(res.grids), stats=res.stats)
+    out_grids = tr.apply(res.grids)
+    return QueryResult(grids=out_grids, scalar=res.scalar, stats=res.stats)
+
+
+# ---------------------------------------------------------------------------
+# Leaf: select raw partitions from one shard and stage to device
+# ---------------------------------------------------------------------------
+
+
+class SelectRawPartitionsExec(ExecPlan):
+    """reference MultiSchemaPartitionsExec:26 + SelectRawPartitionsExec:161 —
+    schema discovery, partition lookup, then staging (rangeVectors analog).
+
+    Produces a QueryResult carrying RawGrids (one per schema found)."""
+
+    def __init__(
+        self,
+        shard_num: int,
+        filters: Sequence[ColumnFilter],
+        start_ms: int,
+        end_ms: int,
+        column: Optional[str] = None,
+    ):
+        super().__init__()
+        self.shard_num = shard_num
+        self.filters = tuple(filters)
+        self.start_ms = start_ms
+        self.end_ms = end_ms
+        self.column = column
+
+    def args_str(self) -> str:
+        fs = ",".join(f"{f.column}{f.op}{f.value}" for f in self.filters)
+        return f"shard={self.shard_num} filters=[{fs}] range=[{self.start_ms},{self.end_ms}]"
+
+    def do_execute(self, ctx: QueryContext) -> QueryResult:
+        shard = ctx.memstore.shard(ctx.dataset, self.shard_num)
+        pids = shard.lookup_partitions(self.filters, self.start_ms, self.end_ms)
+        if len(pids) > ctx.max_series:
+            raise QueryError(f"query selects {len(pids)} series > limit {ctx.max_series}")
+        # group by schema (multi-schema metric support)
+        by_schema: dict[str, list[int]] = {}
+        for pid in pids:
+            part = shard.partition(int(pid))
+            by_schema.setdefault(part.schema.name, []).append(int(pid))
+        res = QueryResult()
+        res.raw_grids = []
+        for schema_name, ids in by_schema.items():
+            parts = [shard.partition(p) for p in ids]
+            schema = parts[0].schema
+            col_name = self.column or schema.value_column
+            col = schema.column(col_name)
+            is_hist = col.ctype == ColumnType.HISTOGRAM
+            is_counter = col.is_counter
+            is_delta = col.is_delta
+            block = ST.stage_from_shard(
+                shard, ids, col_name, self.start_ms, self.end_ms,
+                is_counter=is_counter and not is_delta and not is_hist,
+            )
+            ctx.stats.series_scanned += len(ids)
+            ctx.stats.samples_scanned += int(block.lens.sum())
+            ctx.stats.bytes_staged += block.ts.nbytes + block.vals.nbytes
+            les = parts[0].bucket_les if is_hist else None
+            res.raw_grids.append(
+                RawGrid(
+                    block=block,
+                    labels=[dict(p.tags) for p in parts],
+                    schema_name=schema_name,
+                    value_column=col_name,
+                    is_counter=is_counter,
+                    is_delta=is_delta,
+                    is_histogram=is_hist,
+                    les=les,
+                )
+            )
+        return res
+
+
+class EmptyResultExec(ExecPlan):
+    def do_execute(self, ctx: QueryContext) -> QueryResult:
+        return QueryResult()
+
+
+class RawChunkExportExec(ExecPlan):
+    """Top-level m[5m] raw export (reference SelectRawPartitionsExec without
+    periodic mapping): returns actual samples."""
+
+    def __init__(self, shard_num, filters, start_ms, end_ms, column=None):
+        super().__init__()
+        self.shard_num = shard_num
+        self.filters = tuple(filters)
+        self.start_ms = start_ms
+        self.end_ms = end_ms
+        self.column = column
+
+    def do_execute(self, ctx: QueryContext) -> QueryResult:
+        shard = ctx.memstore.shard(ctx.dataset, self.shard_num)
+        pids = shard.lookup_partitions(self.filters, self.start_ms, self.end_ms)
+        raw = []
+        for pid in pids:
+            part = shard.partition(int(pid))
+            col = self.column or part.schema.value_column
+            ts, vals = part.samples_in_range(self.start_ms, self.end_ms, col)
+            if len(ts):
+                raw.append((dict(part.tags), ts, vals))
+        res = QueryResult(raw=raw)
+        res.result_type = "matrix"
+        return res
+
+
+# ---------------------------------------------------------------------------
+# Non-leaf plans
+# ---------------------------------------------------------------------------
+
+
+class NonLeafExecPlan(ExecPlan):
+    def __init__(self, child_plans: Sequence[ExecPlan]):
+        super().__init__()
+        self.child_plans = list(child_plans)
+
+    def children(self):
+        return self.child_plans
+
+    def execute_children(self, ctx: QueryContext) -> list[QueryResult]:
+        return [c.execute(ctx) for c in self.child_plans]
+
+
+class DistConcatExec(NonLeafExecPlan):
+    """Concatenate child results (reference DistConcatExec)."""
+
+    def do_execute(self, ctx: QueryContext) -> QueryResult:
+        out = QueryResult()
+        out.raw_grids = []
+        for r in self.execute_children(ctx):
+            out.grids.extend(r.grids)
+            if getattr(r, "raw_grids", None):
+                out.raw_grids.extend(r.raw_grids)
+            if r.raw:
+                out.raw = (out.raw or []) + r.raw
+            if r.scalar is not None:
+                out.scalar = r.scalar
+        return out
+
+
+class StitchRvsExec(NonLeafExecPlan):
+    """Merge results of time-split children: same series, disjoint step
+    ranges (reference StitchRvsExec:177)."""
+
+    def do_execute(self, ctx: QueryContext) -> QueryResult:
+        results = self.execute_children(ctx)
+        results = [r for r in results if r.grids]
+        if not results:
+            return QueryResult()
+        # build the union step grid
+        key_to_row: dict[tuple, dict] = {}
+        step = results[0].grids[0].step_ms
+        starts = [g.start_ms for r in results for g in r.grids]
+        ends = [g.start_ms + (g.num_steps - 1) * g.step_ms for r in results for g in r.grids]
+        start, end = min(starts), max(ends)
+        nsteps = int((end - start) // step) + 1
+        for r in results:
+            for g in r.grids:
+                v = g.values_np()
+                off = int((g.start_ms - start) // step)
+                for i, lbls in enumerate(g.labels):
+                    key = tuple(sorted(lbls.items()))
+                    row = key_to_row.setdefault(key, {"labels": lbls, "vals": np.full(nsteps, np.nan, np.float32)})
+                    row["vals"][off : off + g.num_steps] = np.where(
+                        np.isnan(row["vals"][off : off + g.num_steps]), v[i], row["vals"][off : off + g.num_steps]
+                    )
+        labels = [r["labels"] for r in key_to_row.values()]
+        vals = np.stack([r["vals"] for r in key_to_row.values()]) if key_to_row else np.zeros((0, nsteps), np.float32)
+        return QueryResult(grids=[Grid(labels, start, step, nsteps, vals)])
+
+
+# ---------------------------------------------------------------------------
+# Aggregation
+# ---------------------------------------------------------------------------
+
+# ops whose partial state is mergeable across shards: op -> components
+_PARTIAL_COMPONENTS = {
+    "sum": ("sum",),
+    "count": ("count",),
+    "min": ("min",),
+    "max": ("max",),
+    "group": ("group",),
+    "avg": ("sum", "count"),
+    "stddev": ("sum", "sumsq", "count"),
+    "stdvar": ("sum", "sumsq", "count"),
+}
+
+
+def _partial_aggregate(op: str, grids: list[Grid], by, without):
+    """Leaf-side map phase: per-grid segment reduce into label groups.
+    Returns (group_labels, components dict name -> [G, J] np arrays, grid
+    meta). Native-histogram sums additionally carry a "hist" [G, J, B]
+    component (reference HistSumRowAggregator)."""
+    if not grids:
+        return [], {}, None
+    meta = grids[0]
+    all_labels: list[dict] = []
+    mats: list[np.ndarray] = []
+    hists: list[np.ndarray] | None = [] if any(g.hist is not None for g in grids) else None
+    for g in grids:
+        all_labels.extend(g.labels)
+        mats.append(g.values_np())
+        if hists is not None:
+            h = g.hist_np()
+            if h is None:
+                raise QueryError("cannot aggregate histogram and scalar series together")
+            hists.append(h)
+    J = max(m.shape[1] for m in mats)
+    vals = np.full((len(all_labels), J), np.nan, np.float32)
+    r = 0
+    for m in mats:
+        vals[r : r + m.shape[0], : m.shape[1]] = m
+        r += m.shape[0]
+    gids, group_labels = AGG.group_ids_for(all_labels, list(by) if by else None, list(without) if without else None)
+    G = len(group_labels)
+    comps: dict[str, np.ndarray] = {}
+    need = _PARTIAL_COMPONENTS[op]
+    for comp in need:
+        if comp == "sumsq":
+            out = np.asarray(AGG.segment_aggregate("sum", jnp.asarray(vals) ** 2, gids, G))
+        elif comp == "group":
+            out = np.asarray(AGG.segment_aggregate("group", vals, gids, G))
+        else:
+            out = np.asarray(AGG.segment_aggregate(comp, vals, gids, G))
+        comps[comp] = out
+    if hists is not None:
+        if op != "sum":
+            raise QueryError(f"aggregation {op} not supported over native histograms (use sum)")
+        H = np.concatenate(hists, axis=0)  # [S, J, B]
+        S, Jh, B = H.shape
+        flat = np.asarray(
+            AGG.segment_aggregate("sum", jnp.asarray(H.reshape(S, Jh * B)), gids, G)
+        )
+        comps["hist"] = flat.reshape(G, Jh, B)
+    return group_labels, comps, meta
+
+
+def _merge_partials(op: str, partials):
+    """Reduce phase: merge shard partials by group label key."""
+    key_to: dict[tuple, dict] = {}
+    meta = None
+    for group_labels, comps, m in partials:
+        if m is not None:
+            meta = m
+        for gi, lbls in enumerate(group_labels):
+            key = tuple(sorted(lbls.items()))
+            slot = key_to.setdefault(key, {"labels": lbls, "comps": {}})
+            for name, arr in comps.items():
+                cur = slot["comps"].get(name)
+                row = arr[gi]
+                if cur is None:
+                    slot["comps"][name] = row.copy()
+                else:
+                    if name in ("sum", "count", "sumsq", "hist"):
+                        slot["comps"][name] = np.where(
+                            np.isnan(cur), row, np.where(np.isnan(row), cur, cur + row)
+                        )
+                    elif name == "min":
+                        slot["comps"][name] = np.fmin(cur, row)
+                    elif name == "max":
+                        slot["comps"][name] = np.fmax(cur, row)
+                    elif name == "group":
+                        slot["comps"][name] = np.fmax(cur, row)
+    return key_to, meta
+
+
+def _present(op: str, key_to, meta) -> QueryResult:
+    if meta is None:
+        return QueryResult()
+    labels, rows, hist_rows = [], [], []
+    has_hist = False
+    for slot in key_to.values():
+        c = slot["comps"]
+        if "hist" in c:
+            has_hist = True
+            hist_rows.append(c["hist"])
+            v = np.full(c["hist"].shape[0], np.nan, np.float32)
+        elif op in ("sum", "count", "min", "max", "group"):
+            v = c[op]
+        elif op == "avg":
+            v = c["sum"] / c["count"]
+        elif op in ("stddev", "stdvar"):
+            mean = c["sum"] / c["count"]
+            var = c["sumsq"] / c["count"] - mean**2
+            var = np.maximum(var, 0.0)
+            v = var if op == "stdvar" else np.sqrt(var)
+        labels.append(slot["labels"])
+        rows.append(v)
+    vals = np.stack(rows) if rows else np.zeros((0, meta.num_steps), np.float32)
+    hist = np.stack(hist_rows) if has_hist and hist_rows else None
+    return QueryResult(
+        grids=[Grid(labels, meta.start_ms, meta.step_ms, meta.num_steps, vals,
+                    hist=hist, les=meta.les if has_hist else None)]
+    )
+
+
+@dataclass
+class AggregateMapReduce:
+    """Transformer form of the map phase, pushed onto shard leaves
+    (reference AggregateMapReduce)."""
+
+    op: str
+    by: tuple | None
+    without: tuple | None
+
+    def apply(self, grids: list[Grid]) -> list[Grid]:
+        # emits a "partial grid" whose values are the partial components,
+        # encoded as stacked rows with __comp__ labels
+        group_labels, comps, meta = _partial_aggregate(self.op, grids, self.by, self.without)
+        if meta is None:
+            return []
+        out = []
+        for name, arr in comps.items():
+            is_hist = name == "hist"
+            out.append(
+                Grid(
+                    [dict(l, __comp__=name) for l in group_labels],
+                    meta.start_ms,
+                    meta.step_ms,
+                    meta.num_steps,
+                    arr if not is_hist else np.full(arr.shape[:2], np.nan, np.float32),
+                    hist=arr if is_hist else None,
+                    les=meta.les,
+                )
+            )
+        return out
+
+
+class ReduceAggregateExec(NonLeafExecPlan):
+    """reference ReduceAggregateExec + RangeVectorAggregator.mapReduce."""
+
+    def __init__(self, child_plans, op: str, by=None, without=None):
+        super().__init__(child_plans)
+        self.op = op
+        self.by = by
+        self.without = without
+
+    def args_str(self) -> str:
+        return f"op={self.op} by={self.by} without={self.without}"
+
+    def do_execute(self, ctx: QueryContext) -> QueryResult:
+        partials = []
+        for r in self.execute_children(ctx):
+            # children emit partial grids tagged with __comp__
+            by_comp: dict[str, tuple[list, list]] = {}
+            meta = None
+            comp_rows: dict[str, dict[tuple, np.ndarray]] = {}
+            labels_by_key: dict[tuple, dict] = {}
+            for g in r.grids:
+                if g.les is not None or meta is None:
+                    meta = g
+                v = g.values_np()
+                h = g.hist_np()
+                for i, l in enumerate(g.labels):
+                    comp = l.get("__comp__", self.op)
+                    base = {k: x for k, x in l.items() if k != "__comp__"}
+                    key = tuple(sorted(base.items()))
+                    labels_by_key[key] = base
+                    comp_rows.setdefault(comp, {})[key] = h[i] if comp == "hist" else v[i]
+            if meta is None:
+                continue
+            keys = list(labels_by_key)
+            group_labels = [labels_by_key[k] for k in keys]
+            comps = {}
+            for comp, rows in comp_rows.items():
+                proto = next(iter(rows.values()))
+                comps[comp] = np.stack([
+                    rows.get(k, np.full(proto.shape, np.nan, np.float32)) for k in keys
+                ])
+            partials.append((group_labels, comps, meta))
+        key_to, meta = _merge_partials(self.op, partials)
+        return _present(self.op, key_to, meta)
+
+
+class AggregatePresentExec(NonLeafExecPlan):
+    """Root aggregation for non-mergeable ops (topk/bottomk/quantile/
+    count_values): children concat full series to the root."""
+
+    def __init__(self, child_plans, op: str, params=(), by=None, without=None):
+        super().__init__(child_plans)
+        self.op = op
+        self.params = params
+        self.by = by
+        self.without = without
+
+    def args_str(self) -> str:
+        return f"op={self.op} params={self.params} by={self.by} without={self.without}"
+
+    def do_execute(self, ctx: QueryContext) -> QueryResult:
+        grids: list[Grid] = []
+        for r in self.execute_children(ctx):
+            grids.extend(r.grids)
+        if not grids:
+            return QueryResult()
+        all_labels = [l for g in grids for l in g.labels]
+        J = max(g.values_np().shape[1] for g in grids)
+        meta = grids[0]
+        vals = np.full((len(all_labels), J), np.nan, np.float32)
+        r0 = 0
+        for g in grids:
+            v = g.values_np()
+            vals[r0 : r0 + v.shape[0], : v.shape[1]] = v
+            r0 += v.shape[0]
+        op = self.op
+        if op in _PARTIAL_COMPONENTS:
+            # simple agg over an arbitrary subtree (e.g. over a join result);
+            # pass grids through directly so histogram buckets survive
+            partial = _partial_aggregate(op, grids, self.by, self.without)
+            key_to, meta2 = _merge_partials(op, [partial])
+            return _present(op, key_to, meta2)
+        gids, group_labels = AGG.group_ids_for(
+            all_labels, list(self.by) if self.by else None, list(self.without) if self.without else None
+        )
+        if op in ("topk", "bottomk", "limitk"):
+            k = max(int(self.params[0]), 1)
+            out_rows = []
+            out_labels = []
+            for gi in range(len(group_labels)):
+                rows = np.nonzero(gids == gi)[0]
+                sub = vals[rows]
+                if op == "limitk":
+                    masked = np.full_like(sub, np.nan)
+                    masked[:k] = sub[:k]
+                else:
+                    masked = np.asarray(AGG.topk_mask(jnp.asarray(sub), min(k, sub.shape[0]), bottom=(op == "bottomk")))
+                keep = ~np.all(np.isnan(masked), axis=1)
+                for ri, kept in zip(rows, keep):
+                    if kept:
+                        out_labels.append(all_labels[ri])
+                        out_rows.append(masked[np.nonzero(rows == ri)[0][0]])
+            v = np.stack(out_rows) if out_rows else np.zeros((0, J), np.float32)
+            return QueryResult(grids=[Grid(out_labels, meta.start_ms, meta.step_ms, meta.num_steps, v)])
+        if op == "quantile":
+            q = float(self.params[0])
+            res = np.asarray(
+                AGG.segment_quantile(jnp.asarray(vals), jnp.asarray(gids), len(group_labels), np.float32(q))
+            )
+            return QueryResult(grids=[Grid(group_labels, meta.start_ms, meta.step_ms, meta.num_steps, res)])
+        if op == "count_values":
+            label = str(self.params[0])
+            out_labels, out_rows = [], []
+            for gi, gl in enumerate(group_labels):
+                counts = AGG.count_values(vals[gids == gi])
+                for valstr, row in counts.items():
+                    out_labels.append(dict(gl, **{label: valstr}))
+                    out_rows.append(row[: meta.num_steps])
+            v = np.stack(out_rows).astype(np.float32) if out_rows else np.zeros((0, meta.num_steps), np.float32)
+            return QueryResult(grids=[Grid(out_labels, meta.start_ms, meta.step_ms, meta.num_steps, v)])
+        raise QueryError(f"unsupported aggregation {op}")

@@ -1,0 +1,379 @@
+"""RangeVectorTransformers (reference query/exec/RangeVectorTransformer.scala
++ PeriodicSamplesMapper.scala:61 — the operator stages folded onto a leaf
+exec's output; here each transformer maps grid batches, keeping values on
+device until the serving edge).
+"""
+
+from __future__ import annotations
+
+import calendar
+import datetime as _dt
+import re
+from dataclasses import dataclass, field
+from typing import Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.schemas import METRIC_TAG
+from ...ops import aggregations as AGG
+from ...ops import hist_kernels as HK
+from ...ops import kernels as K
+from ..rangevector import Grid, QueryResult, RawGrid, ScalarResult
+
+_DROP_NAME_KEEP = {"last_over_time", "timestamp"}  # fns that keep _metric_
+
+
+class QueryError(ValueError):
+    pass
+
+
+def _strip_metric(labels: dict) -> dict:
+    return {k: v for k, v in labels.items() if k not in (METRIC_TAG, "__name__")}
+
+
+@dataclass
+class PeriodicSamplesMapper:
+    """Materialize regular-step samples from staged raw windows: the single
+    jit kernel call replacing the reference's per-series window iterators."""
+
+    start_ms: int
+    end_ms: int
+    step_ms: int
+    function: str | None = None  # None => instant lookback (gauge last)
+    window_ms: int | None = None
+    lookback_ms: int = 300_000
+    offset_ms: int = 0
+    at_ms: int | None = None
+    args: tuple = ()
+
+    def num_steps(self) -> int:
+        return int((self.end_ms - self.start_ms) // self.step_ms) + 1
+
+    def apply_raw(self, raws: list[RawGrid]) -> list[Grid]:
+        out: list[Grid] = []
+        nsteps = self.num_steps()
+        for rg in raws:
+            func = self.function or "last"
+            window = self.window_ms if self.window_ms is not None else self.lookback_ms
+            eval_start = (self.at_ms if self.at_ms is not None else self.start_ms) - self.offset_ms
+            eval_steps = 1 if self.at_ms is not None else nsteps
+            params = K.RangeParams(eval_start, self.step_ms, eval_steps, window)
+            if rg.is_histogram:
+                vals = HK.run_hist_range_function(func, rg.block, params, is_delta=rg.is_delta)
+                scalar_vals = vals[..., -1] * jnp.nan  # placeholder [S,J]
+                g = Grid(
+                    labels=list(rg.labels),
+                    start_ms=self.start_ms,
+                    step_ms=self.step_ms,
+                    num_steps=nsteps,
+                    values=scalar_vals,
+                    hist=vals,
+                    les=rg.les,
+                )
+            else:
+                vals = K.run_range_function(
+                    func,
+                    rg.block,
+                    params,
+                    is_counter=rg.is_counter,
+                    is_delta=rg.is_delta,
+                    args=self.args,
+                )
+                if func == "timestamp":
+                    # kernel returns ms offsets; add base and convert to s
+                    v = np.asarray(vals).astype(np.float64)
+                    vals = (v + rg.block.base_ms) / 1e3 + np.where(np.isnan(v), np.nan, 0.0)
+                g = Grid(
+                    labels=list(rg.labels),
+                    start_ms=self.start_ms,
+                    step_ms=self.step_ms,
+                    num_steps=nsteps,
+                    values=vals,
+                )
+            if self.at_ms is not None:
+                # @ fixes evaluation time: broadcast the single step across grid
+                v = np.asarray(g.values)[:, :1]
+                g = g.with_values(np.repeat(v, max(nsteps, 1), axis=1))
+                if g.hist is not None:
+                    h = np.asarray(g.hist)[:, :1]
+                    g = g.with_values(g.values, np.repeat(h, max(nsteps, 1), axis=1))
+            if self.function and self.function not in _DROP_NAME_KEEP:
+                g.labels = [_strip_metric(l) for l in g.labels]
+            if self.function == "absent_over_time":
+                g = self._absent_reduce(g)
+            out.append(g)
+        return out
+
+    def _absent_reduce(self, g: Grid) -> Grid:
+        # absent iff NO series present at the step
+        v = g.values_np()
+        if v.shape[0] == 0:
+            vals = np.ones((1, g.num_steps), dtype=np.float32)
+        else:
+            present = (~np.isnan(v)).any(axis=0)
+            vals = np.where(present, np.nan, 1.0)[None, :].astype(np.float32)
+        return Grid([{}], g.start_ms, g.step_ms, g.num_steps, vals)
+
+
+# ---------------------------------------------------------------------------
+# instant functions
+# ---------------------------------------------------------------------------
+
+_ELEMENTWISE = {
+    "abs": jnp.abs, "ceil": jnp.ceil, "floor": jnp.floor, "exp": jnp.exp,
+    "ln": jnp.log, "log2": jnp.log2, "log10": jnp.log10, "sqrt": jnp.sqrt,
+    "sgn": jnp.sign, "acos": jnp.arccos, "acosh": jnp.arccosh,
+    "asin": jnp.arcsin, "asinh": jnp.arcsinh, "atan": jnp.arctan,
+    "atanh": jnp.arctanh, "cos": jnp.cos, "cosh": jnp.cosh, "sin": jnp.sin,
+    "sinh": jnp.sinh, "tan": jnp.tan, "tanh": jnp.tanh,
+    "deg": jnp.degrees, "rad": jnp.radians,
+}
+
+_TIME_COMPONENT = {
+    "minute": lambda d: d.minute, "hour": lambda d: d.hour,
+    "month": lambda d: d.month, "year": lambda d: d.year,
+    "day_of_month": lambda d: d.day, "day_of_week": lambda d: (d.weekday() + 1) % 7,
+    "day_of_year": lambda d: d.timetuple().tm_yday,
+    "days_in_month": lambda d: calendar.monthrange(d.year, d.month)[1],
+}
+
+
+@dataclass
+class InstantVectorFunctionMapper:
+    """reference InstantVectorFunctionMapper + InstantFunction.scala."""
+
+    function: str
+    args: tuple = ()
+
+    def apply(self, grids: list[Grid]) -> list[Grid]:
+        out = []
+        for g in grids:
+            out.append(self._one(g))
+        return out
+
+    def _one(self, g: Grid) -> Grid:
+        f = self.function
+        if f == "histogram_quantile":
+            if g.hist is None:
+                raise QueryError("histogram_quantile needs native-histogram input")
+            q = np.float32(self.args[0])
+            vals = HK.histogram_quantile(q, g.hist, jnp.asarray(g.les, dtype=jnp.float32))
+            return Grid([_strip_metric(l) for l in g.labels], g.start_ms, g.step_ms, g.num_steps, vals)
+        if f == "histogram_fraction":
+            if g.hist is None:
+                raise QueryError("histogram_fraction needs native-histogram input")
+            lo, hi = np.float32(self.args[0]), np.float32(self.args[1])
+            vals = HK.histogram_fraction(lo, hi, g.hist, jnp.asarray(g.les, dtype=jnp.float32))
+            return Grid([_strip_metric(l) for l in g.labels], g.start_ms, g.step_ms, g.num_steps, vals)
+        if f == "histogram_max_quantile":
+            q = np.float32(self.args[0])
+            vals = HK.histogram_quantile(q, g.hist, jnp.asarray(g.les, dtype=jnp.float32))
+            return Grid([_strip_metric(l) for l in g.labels], g.start_ms, g.step_ms, g.num_steps, vals)
+        if f == "hist_to_prom_vectors":
+            return self._hist_to_prom(g)
+        if f == "clamp":
+            v = jnp.clip(g.values, self.args[0], self.args[1])
+        elif f == "clamp_min":
+            v = jnp.maximum(g.values, self.args[0])
+        elif f == "clamp_max":
+            v = jnp.minimum(g.values, self.args[0])
+        elif f == "round":
+            to = self.args[0] if self.args else 1.0
+            v = jnp.round(jnp.asarray(g.values) / to) * to
+        elif f == "timestamp":
+            t = g.step_times_ms().astype(np.float64) / 1e3
+            vn = g.values_np()
+            v = np.where(np.isnan(vn), np.nan, t[None, :])
+        elif f in _TIME_COMPONENT:
+            times = g.step_times_ms()
+            comp = np.array(
+                [_TIME_COMPONENT[f](_dt.datetime.fromtimestamp(t / 1e3, _dt.timezone.utc)) for t in times],
+                dtype=np.float64,
+            )
+            vn = g.values_np()
+            v = np.where(np.isnan(vn), np.nan, comp[None, :])
+        elif f in _ELEMENTWISE:
+            v = _ELEMENTWISE[f](jnp.asarray(g.values))
+        else:
+            raise QueryError(f"unknown instant function {f}")
+        return Grid([_strip_metric(l) for l in g.labels], g.start_ms, g.step_ms, g.num_steps, v)
+
+    def _hist_to_prom(self, g: Grid) -> Grid:
+        """Explode native histogram into classic _bucket series (reference
+        HistToPromSeriesMapper)."""
+        if g.hist is None:
+            return g
+        h = g.hist_np()
+        S, J, B = h.shape
+        labels = []
+        rows = []
+        for i, l in enumerate(g.labels):
+            for b in range(B):
+                le = g.les[b]
+                lb = dict(l)
+                lb["le"] = "+Inf" if np.isinf(le) else f"{le:g}"
+                labels.append(lb)
+                rows.append(h[i, :, b])
+        vals = np.stack(rows) if rows else np.zeros((0, J), dtype=np.float32)
+        return Grid(labels, g.start_ms, g.step_ms, g.num_steps, vals)
+
+
+# ---------------------------------------------------------------------------
+# scalar ops
+# ---------------------------------------------------------------------------
+
+_BINOPS = {
+    "+": lambda a, b: a + b,
+    "-": lambda a, b: a - b,
+    "*": lambda a, b: a * b,
+    "/": lambda a, b: a / b,
+    "%": lambda a, b: jnp.where(b != 0, a - jnp.floor(a / b) * b, jnp.nan),
+    "^": lambda a, b: a**b,
+    "atan2": lambda a, b: jnp.arctan2(a, b),
+}
+_CMPOPS = {
+    "==": lambda a, b: a == b,
+    "!=": lambda a, b: a != b,
+    ">": lambda a, b: a > b,
+    "<": lambda a, b: a < b,
+    ">=": lambda a, b: a >= b,
+    "<=": lambda a, b: a <= b,
+}
+
+
+def apply_binop(op: str, lhs, rhs, return_bool: bool):
+    """Elementwise arithmetic/comparison with promql filter semantics."""
+    if op in _BINOPS:
+        return _BINOPS[op](lhs, rhs)
+    cmp = _CMPOPS[op](lhs, rhs)
+    if return_bool:
+        both = ~(jnp.isnan(lhs) | jnp.isnan(rhs))
+        return jnp.where(both, cmp.astype(jnp.float32), jnp.nan)
+    return jnp.where(cmp, lhs, jnp.nan)
+
+
+@dataclass
+class ScalarOperationMapper:
+    """vector op scalar (reference ScalarOperationMapper)."""
+
+    op: str
+    scalar: ScalarResult | float
+    scalar_is_lhs: bool
+    return_bool: bool = False
+
+    def apply(self, grids: list[Grid]) -> list[Grid]:
+        out = []
+        for g in grids:
+            s = self.scalar
+            sv = s.values[None, : np.asarray(g.values).shape[1]] if isinstance(s, ScalarResult) else s
+            if isinstance(sv, np.ndarray) and sv.shape[-1] < np.asarray(g.values).shape[1]:
+                sv = np.pad(sv, ((0, 0), (0, np.asarray(g.values).shape[1] - sv.shape[1])), constant_values=np.nan)
+            a, b = (sv, g.values) if self.scalar_is_lhs else (g.values, sv)
+            v = apply_binop(self.op, jnp.asarray(a, dtype=jnp.float32), jnp.asarray(b, dtype=jnp.float32), self.return_bool)
+            keep_name = self.op in _CMPOPS and not self.return_bool
+            labels = g.labels if keep_name else [_strip_metric(l) for l in g.labels]
+            out.append(Grid(labels, g.start_ms, g.step_ms, g.num_steps, v))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# misc / labels / sort / limit / absent
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class MiscellaneousFunctionMapper:
+    function: str
+    str_args: tuple = ()
+
+    def apply(self, grids: list[Grid]) -> list[Grid]:
+        if self.function == "label_replace":
+            dst, repl, src, regex_s = self.str_args
+            pat = re.compile(regex_s)
+            for g in grids:
+                new_labels = []
+                for l in g.labels:
+                    m = pat.fullmatch(l.get(src, ""))
+                    l2 = dict(l)
+                    if m:
+                        val = m.expand(repl.replace("$", "\\"))
+                        if val:
+                            l2[dst] = val
+                        else:
+                            l2.pop(dst, None)
+                    new_labels.append(l2)
+                g.labels = new_labels
+            return grids
+        if self.function == "label_join":
+            dst, sep, *srcs = self.str_args
+            for g in grids:
+                g.labels = [
+                    {**l, dst: sep.join(l.get(s, "") for s in srcs)} for l in g.labels
+                ]
+            return grids
+        raise QueryError(f"unknown misc function {self.function}")
+
+
+@dataclass
+class SortFunctionMapper:
+    """sort()/sort_desc(): orders series by value (instant queries)."""
+
+    descending: bool = False
+
+    def apply(self, grids: list[Grid]) -> list[Grid]:
+        out = []
+        for g in grids:
+            v = g.values_np()
+            key = np.where(np.isnan(v[:, -1]), -np.inf if not self.descending else np.inf, v[:, -1])
+            order = np.argsort(-key if self.descending else key, kind="stable")
+            out.append(
+                Grid([g.labels[i] for i in order], g.start_ms, g.step_ms, g.num_steps, v[order],
+                     None if g.hist is None else g.hist_np()[order], g.les)
+            )
+        return out
+
+
+@dataclass
+class LimitFunctionMapper:
+    limit: int
+
+    def apply(self, grids: list[Grid]) -> list[Grid]:
+        out = []
+        budget = self.limit
+        for g in grids:
+            if budget <= 0:
+                break
+            take = min(budget, g.n_series)
+            v = g.values_np()[:take]
+            out.append(Grid(g.labels[:take], g.start_ms, g.step_ms, g.num_steps, v))
+            budget -= take
+        return out
+
+
+@dataclass
+class AbsentFunctionMapper:
+    """absent(v): 1 when no series has a value at the step (reference
+    AbsentFunctionMapper); output labels from equality matchers."""
+
+    filters: tuple = ()
+    start_ms: int = 0
+    step_ms: int = 1
+    num_steps: int = 1
+
+    def apply(self, grids: list[Grid]) -> list[Grid]:
+        start_ms, step_ms, num_steps = self.start_ms, self.step_ms, self.num_steps
+        if grids:
+            start_ms, step_ms, num_steps = grids[0].start_ms, grids[0].step_ms, grids[0].num_steps
+        present = np.zeros(num_steps, dtype=bool)
+        for g in grids:
+            v = g.values_np()
+            if v.size:
+                present |= (~np.isnan(v)).any(axis=0)
+        vals = np.where(present, np.nan, 1.0)[None, :].astype(np.float32)
+        labels = {
+            f.column: f.value
+            for f in self.filters
+            if getattr(f, "op", "") == "=" and f.column not in (METRIC_TAG, "__name__")
+        }
+        return [Grid([labels], start_ms, step_ms, num_steps, vals)]

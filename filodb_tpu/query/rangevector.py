@@ -1,0 +1,118 @@
+"""Result model (reference core/.../query/RangeVector.scala:129 —
+RangeVector/RawDataRangeVector:365/SerializedRangeVector:504).
+
+TPU-native reframing: instead of per-series RangeVector iterators, results
+travel as **grids** — a batch of series sharing one step grid with a dense
+``[S, J]`` value matrix (NaN = absent), optionally ``[S, J, B]`` for native
+histograms. Grids stay on device through transformer chains; serialization
+pulls to host once at the edge.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Any, Sequence
+
+import numpy as np
+
+
+@dataclass
+class Grid:
+    """A batch of series on a shared step grid."""
+
+    labels: list[dict]  # [S] per-series label sets
+    start_ms: int
+    step_ms: int
+    num_steps: int
+    values: Any  # [S, J] (device or numpy); J >= num_steps (padding allowed)
+    hist: Any | None = None  # [S, J, B] bucket values when histogram-kind
+    les: np.ndarray | None = None  # [B] bucket bounds for hist
+    stale: bool = False
+
+    @property
+    def n_series(self) -> int:
+        return len(self.labels)
+
+    def step_times_ms(self) -> np.ndarray:
+        return self.start_ms + np.arange(self.num_steps, dtype=np.int64) * self.step_ms
+
+    def values_np(self) -> np.ndarray:
+        """[S, num_steps] numpy view (device fetch if needed)."""
+        v = np.asarray(self.values)
+        return v[: self.n_series, : self.num_steps]
+
+    def hist_np(self) -> np.ndarray | None:
+        if self.hist is None:
+            return None
+        h = np.asarray(self.hist)
+        return h[: self.n_series, : self.num_steps]
+
+    def with_values(self, values, hist=None) -> "Grid":
+        return replace(self, values=values, hist=hist if hist is not None else None,
+                       les=self.les if hist is not None else None)
+
+
+@dataclass
+class RawGrid:
+    """Pre-periodic staged raw chunk windows (reference RawDataRangeVector)."""
+
+    block: Any  # ops.staging.StagedBlock
+    labels: list[dict]
+    schema_name: str
+    value_column: str
+    is_counter: bool
+    is_delta: bool
+    is_histogram: bool
+    les: np.ndarray | None = None
+
+
+@dataclass
+class ScalarResult:
+    """A scalar-per-step result ([J] array) — promql scalar type."""
+
+    start_ms: int
+    step_ms: int
+    num_steps: int
+    values: np.ndarray  # [J]
+
+
+@dataclass
+class QueryStats:
+    """reference QuerySession.queryStats (ExecPlan.scala:430)."""
+
+    series_scanned: int = 0
+    samples_scanned: int = 0
+    cpu_ns: int = 0
+    device_ns: int = 0
+    bytes_staged: int = 0
+
+    def merge(self, other: "QueryStats") -> None:
+        self.series_scanned += other.series_scanned
+        self.samples_scanned += other.samples_scanned
+        self.cpu_ns += other.cpu_ns
+        self.device_ns += other.device_ns
+        self.bytes_staged += other.bytes_staged
+
+
+@dataclass
+class QueryResult:
+    """Exec output: grids (vector results), a scalar, or raw export data."""
+
+    grids: list[Grid] = field(default_factory=list)
+    raw_grids: list[RawGrid] = field(default_factory=list)  # pre-periodic staged
+    scalar: ScalarResult | None = None
+    raw: list[tuple[dict, np.ndarray, np.ndarray]] | None = None  # (labels, ts, vals)
+    stats: QueryStats = field(default_factory=QueryStats)
+    result_type: str = "matrix"  # matrix | vector | scalar | metadata
+    metadata: list | None = None  # label values / names / series results
+
+    def all_series(self):
+        """Iterate (labels, ts_ms[], values[]) dropping NaN points."""
+        for g in self.grids:
+            vals = g.values_np()
+            times = g.step_times_ms()
+            for i, lbls in enumerate(g.labels):
+                row = vals[i]
+                m = ~np.isnan(row)
+                if m.any():
+                    yield lbls, times[m], row[m]
