@@ -2,7 +2,10 @@
 
 Multi-chip hardware is not available in CI; sharding correctness is validated
 on host devices (the driver separately dry-runs __graft_entry__.dryrun_multichip).
-Must run before any jax import.
+
+Note: the environment may preload jax with a TPU platform plugin via
+sitecustomize, so setting env vars is not enough — override the live jax
+config before any backend initializes.
 """
 
 import os
@@ -11,3 +14,9 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+assert len(jax.devices()) == 8, "tests expect an 8-device virtual CPU mesh"

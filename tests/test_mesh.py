@@ -1,0 +1,100 @@
+"""Distributed mesh execution tests on the 8-device virtual CPU mesh
+(model: reference multi-jvm specs — single-host stand-in for the cluster)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from filodb_tpu.ops import kernels as K
+from filodb_tpu.ops.staging import stage_series
+from filodb_tpu.parallel import mesh as M
+
+import oracle
+
+BASE = 1_600_000_000_000
+
+
+def make_shard_blocks(n_shards=8, series_per_shard=5, n=200, seed=0):
+    rng = np.random.default_rng(seed)
+    blocks, gids, all_series = [], [], []
+    for s in range(n_shards):
+        series = []
+        for i in range(series_per_shard):
+            ts = BASE + (1 + np.arange(n, dtype=np.int64)) * 10_000
+            vals = np.cumsum(rng.uniform(0, 10, n))
+            series.append((ts, vals))
+            all_series.append((s, i, ts, vals))
+        blocks.append(stage_series(series, BASE, counter_corrected=True))
+        # two global groups: even/odd series index
+        gids.append(np.arange(series_per_shard, dtype=np.int32) % 2)
+    return blocks, gids, all_series
+
+
+def test_distributed_sum_rate_matches_oracle():
+    mesh = M.make_mesh()
+    assert mesh.devices.size == 8
+    blocks, gids, all_series = make_shard_blocks()
+    arrays = M.stack_blocks_for_mesh(blocks, gids, mesh.devices.size)
+    sharded = M.shard_arrays(mesh, *arrays)
+    num_steps = K.pad_steps(10)
+    start = BASE + 400_000
+    out = M.distributed_agg_range(
+        mesh, "rate", "sum", *sharded,
+        np.int32(start - BASE), np.int32(60_000), np.int32(300_000),
+        num_steps, 2, is_counter=True,
+    )
+    got = np.asarray(out)[:, :10]
+    want = np.zeros((2, 10))
+    for s, i, ts, vals in all_series:
+        r = oracle.range_function("rate", ts, vals, start, 60_000, 10, 300_000, is_counter=True)
+        want[i % 2] += np.where(np.isnan(r), 0, r)
+    np.testing.assert_allclose(got, want, rtol=1e-3)
+
+
+@pytest.mark.parametrize("op", ["sum", "count", "avg", "min", "max"])
+def test_distributed_ops(op):
+    mesh = M.make_mesh()
+    blocks, gids, all_series = make_shard_blocks(seed=3)
+    arrays = M.stack_blocks_for_mesh(blocks, gids, mesh.devices.size)
+    sharded = M.shard_arrays(mesh, *arrays)
+    num_steps = K.pad_steps(5)
+    start = BASE + 400_000
+    out = np.asarray(
+        M.distributed_agg_range(
+            mesh, "sum_over_time", op, *sharded,
+            np.int32(start - BASE), np.int32(60_000), np.int32(300_000),
+            num_steps, 2,
+        )
+    )[:, :5]
+    # oracle
+    per_series = []
+    for s, i, ts, vals in all_series:
+        # blocks were staged counter_corrected; sum_over_time sees the
+        # corrected-minus-baseline values, so replicate that here
+        corr = oracle.correct_counter(vals) - vals[0]
+        r = oracle.range_function("sum_over_time", ts, corr, start, 60_000, 5, 300_000)
+        per_series.append((i % 2, r))
+    want = np.full((2, 5), np.nan)
+    for g in range(2):
+        rows = np.stack([r for gg, r in per_series if gg == g])
+        if op == "sum":
+            want[g] = np.nansum(rows, axis=0)
+        elif op == "count":
+            want[g] = (~np.isnan(rows)).sum(axis=0)
+        elif op == "avg":
+            want[g] = np.nanmean(rows, axis=0)
+        elif op == "min":
+            want[g] = np.nanmin(rows, axis=0)
+        elif op == "max":
+            want[g] = np.nanmax(rows, axis=0)
+    np.testing.assert_allclose(out, want, rtol=2e-3, err_msg=op)
+
+
+def test_sharding_actually_distributes():
+    mesh = M.make_mesh()
+    blocks, gids, _ = make_shard_blocks()
+    arrays = M.stack_blocks_for_mesh(blocks, gids, mesh.devices.size)
+    sharded = M.shard_arrays(mesh, *arrays)
+    ts = sharded[0]
+    assert len(ts.sharding.device_set) == 8
