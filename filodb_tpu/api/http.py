@@ -1,0 +1,270 @@
+"""Prometheus-compatible HTTP API (reference L6:
+http/.../PrometheusApiRoute.scala:43-130 — query_range:49, query:68,
+labels:85, label-values:105; AdminRoutes health).
+
+Stdlib ThreadingHTTPServer: the API edge is not the hot path (queries run on
+device); zero extra dependencies.
+
+Endpoints:
+  GET/POST /api/v1/query_range?query&start&end&step
+  GET/POST /api/v1/query?query&time
+  GET      /api/v1/labels
+  GET      /api/v1/label/<name>/values
+  GET      /api/v1/series?match[]=...
+  GET      /api/v1/metadata (stub), /api/v1/status/buildinfo
+  GET      /admin/health
+  POST     /ingest  (JSON lines of {metric, tags, ts_ms, value} — test/dev
+           ingest transport; production path is the gateway)
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from ..coordinator.planner import QueryEngine
+from ..core.filters import ColumnFilter
+from ..query.exec.transformers import QueryError
+from ..query.promql import PromQLError, Parser as PromParser
+from . import promjson as J
+
+
+def _parse_time(s: str, default: float | None = None) -> float:
+    if s is None:
+        if default is None:
+            raise ValueError("missing time parameter")
+        return default
+    try:
+        return float(s)
+    except ValueError:
+        # RFC3339
+        import datetime as dt
+
+        return dt.datetime.fromisoformat(s.replace("Z", "+00:00")).timestamp()
+
+
+def _parse_step(s: str) -> float:
+    if s is None:
+        return 15.0
+    try:
+        return float(s)
+    except ValueError:
+        from ..query.promql import parse_duration_ms
+
+        return parse_duration_ms(s) / 1000.0
+
+
+def _matchers_from(expr: str) -> list[ColumnFilter]:
+    """Parse a series matcher like {job="x"} or metric{a="b"}."""
+    node = PromParser(expr).selector()
+    from ..core.schemas import METRIC_TAG
+
+    filters = list(node.matchers)
+    if node.metric:
+        filters.append(ColumnFilter(METRIC_TAG, "=", node.metric))
+    return [
+        ColumnFilter(METRIC_TAG, f.op, f.value) if f.column == "__name__" else f
+        for f in filters
+    ]
+
+
+class PromApiHandler(BaseHTTPRequestHandler):
+    engine: QueryEngine = None  # set by server factory
+    protocol_version = "HTTP/1.1"
+
+    # -- plumbing ---------------------------------------------------------
+
+    def log_message(self, fmt, *args):  # quiet by default
+        pass
+
+    def _send(self, code: int, payload: dict):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_body(self) -> str:
+        length = int(self.headers.get("Content-Length") or 0)
+        return self.rfile.read(length).decode() if length else ""
+
+    def _params(self) -> dict:
+        parsed = urllib.parse.urlparse(self.path)
+        qs = urllib.parse.parse_qs(parsed.query)
+        if self.command == "POST":
+            body = self._read_body()
+            ctype = self.headers.get("Content-Type", "")
+            # urllib clients default to the form content-type even for raw
+            # payloads; only parse as a form when it actually looks like one
+            if "urlencoded" in ctype and "=" in body and "\n" not in body:
+                for k, v in urllib.parse.parse_qs(body).items():
+                    qs.setdefault(k, v)
+            elif body:
+                qs["__body__"] = [body]
+        return {k: v for k, v in qs.items()}
+
+    def _q(self, params, name, default=None):
+        v = params.get(name)
+        return v[0] if v else default
+
+    # -- routing ----------------------------------------------------------
+
+    def do_GET(self):
+        self._route()
+
+    def do_POST(self):
+        self._route()
+
+    def _route(self):
+        path = urllib.parse.urlparse(self.path).path
+        try:
+            if path == "/api/v1/query_range":
+                return self._query_range()
+            if path == "/api/v1/query":
+                return self._query()
+            if path == "/api/v1/labels":
+                return self._labels()
+            m = re.fullmatch(r"/api/v1/label/([^/]+)/values", path)
+            if m:
+                return self._label_values(m.group(1))
+            if path == "/api/v1/series":
+                return self._series()
+            if path == "/api/v1/metadata":
+                return self._send(200, J.success({}))
+            if path == "/api/v1/status/buildinfo":
+                from .. import __version__
+
+                return self._send(200, J.success({"version": __version__, "application": "filodb-tpu"}))
+            if path == "/admin/health":
+                return self._send(200, {"status": "healthy", "shards": len(self.engine.memstore.shards(self.engine.dataset))})
+            if path == "/ingest":
+                return self._ingest()
+            self._send(404, J.error("not_found", f"unknown path {path}"))
+        except (PromQLError, QueryError, ValueError) as e:
+            self._send(400, J.error("bad_data", str(e)))
+        except Exception as e:  # noqa: BLE001 — the API edge must not die
+            self._send(500, J.error("internal", f"{type(e).__name__}: {e}"))
+
+    # -- endpoints --------------------------------------------------------
+
+    def _query_range(self):
+        p = self._params()
+        query = self._q(p, "query")
+        if not query:
+            return self._send(400, J.error("bad_data", "missing query"))
+        start = _parse_time(self._q(p, "start"))
+        end = _parse_time(self._q(p, "end"))
+        step = _parse_step(self._q(p, "step"))
+        res = self.engine.query_range(query, start, end, step)
+        if res.result_type == "scalar":
+            # range query over a scalar: render as matrix of the scalar
+            sc = res.scalar
+            data = {
+                "resultType": "matrix",
+                "result": [
+                    {
+                        "metric": {},
+                        "values": [
+                            [t / 1000.0, J._fmt(v)]
+                            for t, v in zip(
+                                sc.start_ms + np.arange(sc.num_steps) * sc.step_ms, sc.values
+                            )
+                        ],
+                    }
+                ]
+                if sc is not None
+                else [],
+            }
+            return self._send(200, J.success(data))
+        return self._send(200, J.success(J.render_matrix(res)))
+
+    def _query(self):
+        p = self._params()
+        query = self._q(p, "query")
+        if not query:
+            return self._send(400, J.error("bad_data", "missing query"))
+        t = _parse_time(self._q(p, "time"), default=time.time())
+        res = self.engine.query_instant(query, t)
+        if res.result_type == "scalar":
+            return self._send(200, J.success(J.render_scalar(res, t)))
+        if res.raw is not None:
+            return self._send(200, J.success(J.render_matrix(res)))
+        return self._send(200, J.success(J.render_vector(res, t)))
+
+    def _labels(self):
+        p = self._params()
+        start = _parse_time(self._q(p, "start"), 0.0)
+        end = _parse_time(self._q(p, "end"), time.time() + 1e9)
+        names = self.engine.memstore.label_names(
+            self.engine.dataset, [], int(start * 1000), int(end * 1000)
+        )
+        names = ["__name__" if n == "_metric_" else n for n in names]
+        return self._send(200, J.success(names))
+
+    def _label_values(self, label: str):
+        p = self._params()
+        if label == "__name__":
+            label = "_metric_"
+        start = _parse_time(self._q(p, "start"), 0.0)
+        end = _parse_time(self._q(p, "end"), time.time() + 1e9)
+        match = p.get("match[]", [])
+        filters = _matchers_from(match[0]) if match else []
+        vals = self.engine.memstore.label_values(
+            self.engine.dataset, filters, label, int(start * 1000), int(end * 1000)
+        )
+        return self._send(200, J.success(vals))
+
+    def _series(self):
+        p = self._params()
+        start = _parse_time(self._q(p, "start"), 0.0)
+        end = _parse_time(self._q(p, "end"), time.time() + 1e9)
+        out = []
+        for expr in p.get("match[]", []):
+            filters = _matchers_from(expr)
+            for tags in self.engine.memstore.series(
+                self.engine.dataset, filters, int(start * 1000), int(end * 1000), limit=10000
+            ):
+                out.append(J._labels_out(dict(tags)))
+        return self._send(200, J.success(out))
+
+    def _ingest(self):
+        from ..core.records import gauge_batch
+
+        p = self._params()
+        body = self._q(p, "__body__", "")
+        n = 0
+        samples = []
+        for line in body.splitlines():
+            if not line.strip():
+                continue
+            rec = json.loads(line)
+            samples.append((rec.get("tags", {}), int(rec["ts_ms"]), float(rec["value"])))
+            n += 1
+        if samples:
+            by_metric: dict[str, list] = {}
+            for tags, ts, v in samples:
+                by_metric.setdefault(tags.get("__name__", tags.get("_metric_", "unknown")), []).append((tags, ts, v))
+            for metric, recs in by_metric.items():
+                batch = gauge_batch(metric, recs)
+                self.engine.memstore.ingest_routed(self.engine.dataset, batch, spread=3)
+        return self._send(200, J.success({"ingested": n}))
+
+
+def make_server(engine: QueryEngine, host: str = "127.0.0.1", port: int = 9090) -> ThreadingHTTPServer:
+    handler = type("BoundHandler", (PromApiHandler,), {"engine": engine})
+    return ThreadingHTTPServer((host, port), handler)
+
+
+def serve_background(engine: QueryEngine, host: str = "127.0.0.1", port: int = 0):
+    """Start the API server on a thread; returns (server, actual_port)."""
+    srv = make_server(engine, host, port)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv, srv.server_address[1]

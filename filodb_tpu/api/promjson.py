@@ -1,0 +1,77 @@
+"""Prometheus HTTP API JSON rendering (reference L6:
+query/PrometheusModel.scala — result types matrix/vector/scalar, success/
+error envelopes, label normalization)."""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import numpy as np
+
+from ..core.schemas import METRIC_TAG
+from ..query.rangevector import QueryResult
+
+
+def _fmt(v: float) -> str:
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    return repr(float(v))
+
+
+def _labels_out(labels: dict) -> dict:
+    out = {}
+    for k, v in labels.items():
+        if k == METRIC_TAG:
+            out["__name__"] = v
+        elif not k.startswith("__comp__"):
+            out[k] = v
+    return out
+
+
+def render_matrix(res: QueryResult) -> dict:
+    data = []
+    if res.raw is not None:
+        for labels, ts, vals in res.raw:
+            keep = ~np.isnan(vals) if vals.ndim == 1 else np.ones(len(ts), bool)
+            data.append(
+                {
+                    "metric": _labels_out(labels),
+                    "values": [[t / 1000.0, _fmt(v)] for t, v in zip(ts[keep], vals[keep])],
+                }
+            )
+    for labels, ts, vals in res.all_series():
+        data.append(
+            {
+                "metric": _labels_out(labels),
+                "values": [[t / 1000.0, _fmt(v)] for t, v in zip(ts, vals)],
+            }
+        )
+    return {"resultType": "matrix", "result": data}
+
+
+def render_vector(res: QueryResult, time_s: float) -> dict:
+    data = []
+    for labels, ts, vals in res.all_series():
+        if len(vals):
+            data.append(
+                {"metric": _labels_out(labels), "value": [time_s, _fmt(vals[-1])]}
+            )
+    return {"resultType": "vector", "result": data}
+
+
+def render_scalar(res: QueryResult, time_s: float) -> dict:
+    v = float("nan")
+    if res.scalar is not None and len(res.scalar.values):
+        v = float(res.scalar.values[-1])
+    return {"resultType": "scalar", "result": [time_s, _fmt(v)]}
+
+
+def success(data: Any) -> dict:
+    return {"status": "success", "data": data}
+
+
+def error(err_type: str, message: str) -> dict:
+    return {"status": "error", "errorType": err_type, "error": message}

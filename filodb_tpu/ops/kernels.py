@@ -402,6 +402,17 @@ def run_range_function(
 ):
     """Dispatch one range function over a staged block. Returns a device array
     [S, J_padded]; caller slices [:n_series, :num_steps]."""
+    from .mxu_kernels import MXU_FUNCS, run_mxu_range_function
+
+    if (
+        block.regular_ts is not None
+        and func in MXU_FUNCS
+        and not (is_delta and func in ("irate", "idelta"))
+    ):
+        # shared-scrape-grid fast path: window reduction as MXU matmuls
+        return run_mxu_range_function(
+            func, block, params, is_counter=is_counter, is_delta=is_delta, args=args
+        )
     j_pad = pad_steps(params.num_steps)
     start_off = np.int32(params.start_ms - block.base_ms)
     if func in SORTED_FUNCS:

@@ -59,7 +59,11 @@ class StagedBlock:
     baseline: np.ndarray  # [S] f32 per-series value offset (counters; else 0)
     n_series: int  # real series count (<= S)
     part_refs: list  # (shard_num, part_id) per real series row
-    raw: np.ndarray | None = None  # [S, T] f32 raw-minus-baseline (counters)
+    raw: np.ndarray | None = None  # [S, T] f32 raw values (counters only)
+    # regular-grid fast path: every real series shares ONE timestamp vector
+    # and one length — window matrices become series-independent and the
+    # range kernel becomes a batched matmul on the MXU (see kernels.py)
+    regular_ts: np.ndarray | None = None  # [T] int32 shared offsets, or None
 
     @property
     def shape(self):
@@ -127,8 +131,13 @@ def stage_series(
             out_vals[i, :m] = (vals.astype(np.float64) - b).astype(dtype)
         else:
             out_vals[i, :m] = vals.astype(dtype)
+    regular = None
+    if n > 0 and (lens[:n] == lens[0]).all() and lens[0] > 0:
+        if not (out_ts[:n] != out_ts[0]).any():
+            regular = out_ts[0]
     return StagedBlock(
-        out_ts, out_vals, lens, base_ms, baseline, n, part_refs or [], raw=out_raw
+        out_ts, out_vals, lens, base_ms, baseline, n, part_refs or [],
+        raw=out_raw, regular_ts=regular,
     )
 
 

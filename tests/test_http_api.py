@@ -1,0 +1,118 @@
+"""HTTP API tests (model: reference PrometheusApiRouteSpec)."""
+
+import json
+import urllib.request
+import urllib.parse
+
+import numpy as np
+import pytest
+
+from filodb_tpu.api.http import serve_background
+from filodb_tpu.coordinator.planner import QueryEngine
+from filodb_tpu.core.schemas import Dataset
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.testkit import counter_batch, machine_metrics
+
+BASE = 1_600_000_000_000
+START_S = (BASE + 1_800_000) / 1000
+END_S = (BASE + 3_000_000) / 1000
+
+
+@pytest.fixture(scope="module")
+def api():
+    ms = TimeSeriesMemStore()
+    ms.setup(Dataset("prometheus"), range(4))
+    ms.ingest_routed("prometheus", machine_metrics(n_series=10, n_samples=360, start_ms=BASE), spread=2)
+    ms.ingest_routed("prometheus", counter_batch(n_series=10, n_samples=360, start_ms=BASE), spread=2)
+    engine = QueryEngine(ms, "prometheus")
+    srv, port = serve_background(engine)
+    yield f"http://127.0.0.1:{port}"
+    srv.shutdown()
+
+
+def get(url):
+    with urllib.request.urlopen(url, timeout=60) as r:
+        return json.loads(r.read())
+
+
+def test_query_range_sum_rate(api):
+    q = urllib.parse.quote("sum(rate(http_requests_total[5m]))")
+    out = get(f"{api}/api/v1/query_range?query={q}&start={START_S}&end={END_S}&step=60")
+    assert out["status"] == "success"
+    assert out["data"]["resultType"] == "matrix"
+    result = out["data"]["result"]
+    assert len(result) == 1
+    vals = [float(v) for _, v in result[0]["values"]]
+    assert all(v > 0 for v in vals)
+
+
+def test_query_range_metric_name_restored(api):
+    q = urllib.parse.quote("heap_usage0")
+    out = get(f"{api}/api/v1/query_range?query={q}&start={START_S}&end={END_S}&step=60")
+    assert len(out["data"]["result"]) == 10
+    assert out["data"]["result"][0]["metric"]["__name__"] == "heap_usage0"
+
+
+def test_instant_query_vector(api):
+    q = urllib.parse.quote("heap_usage0")
+    out = get(f"{api}/api/v1/query?query={q}&time={END_S}")
+    assert out["data"]["resultType"] == "vector"
+    assert len(out["data"]["result"]) == 10
+    for item in out["data"]["result"]:
+        t, v = item["value"]
+        assert t == END_S
+        float(v)
+
+
+def test_instant_scalar(api):
+    out = get(f"{api}/api/v1/query?query=42&time={END_S}")
+    assert out["data"]["resultType"] == "scalar"
+    assert float(out["data"]["result"][1]) == 42.0
+
+
+def test_labels(api):
+    out = get(f"{api}/api/v1/labels")
+    assert "__name__" in out["data"] and "instance" in out["data"]
+
+
+def test_label_values(api):
+    out = get(f"{api}/api/v1/label/__name__/values")
+    assert "heap_usage0" in out["data"]
+    assert "http_requests_total" in out["data"]
+
+
+def test_series(api):
+    q = urllib.parse.quote('heap_usage0{instance="host-1"}')
+    out = get(f"{api}/api/v1/series?match[]={q}")
+    assert len(out["data"]) == 1
+    assert out["data"][0]["__name__"] == "heap_usage0"
+
+
+def test_bad_query_is_400(api):
+    q = urllib.parse.quote("sum(")
+    try:
+        get(f"{api}/api/v1/query_range?query={q}&start=1&end=2&step=1")
+        assert False, "expected 400"
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+        body = json.loads(e.read())
+        assert body["status"] == "error"
+
+
+def test_health(api):
+    out = get(f"{api}/admin/health")
+    assert out["status"] == "healthy"
+
+
+def test_ingest_endpoint(api):
+    lines = "\n".join(
+        json.dumps({"tags": {"__name__": "pushed_metric", "src": "test"}, "ts_ms": BASE + i * 10_000, "value": float(i)})
+        for i in range(10)
+    )
+    req = urllib.request.Request(f"{api}/ingest", data=lines.encode(), method="POST")
+    with urllib.request.urlopen(req, timeout=30) as r:
+        out = json.loads(r.read())
+    assert out["data"]["ingested"] == 10
+    q = urllib.parse.quote("pushed_metric")
+    res = get(f"{api}/api/v1/query?query={q}&time={(BASE + 100_000) / 1000}")
+    assert len(res["data"]["result"]) == 1

@@ -1,0 +1,80 @@
+"""MXU (regular-grid matmul) kernel path vs the general kernel path on the
+same data — the fast path must be indistinguishable."""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.ops import kernels as K
+from filodb_tpu.ops.mxu_kernels import MXU_FUNCS
+from filodb_tpu.ops.staging import stage_series
+
+BASE = 1_600_000_000_000
+
+
+def regular_series(n_series=6, n=300, seed=0, counter=False):
+    rng = np.random.default_rng(seed)
+    ts = BASE + (1 + np.arange(n, dtype=np.int64)) * 10_000
+    out = []
+    for i in range(n_series):
+        if counter:
+            vals = np.cumsum(rng.uniform(0, 10, n)) + 1e9
+            k = n // 2 + i
+            vals[k:] -= vals[k] - rng.uniform(0, 5)  # a reset per series
+        else:
+            vals = 50 + 20 * rng.standard_normal(n)
+        out.append((ts.copy(), vals))
+    return out
+
+
+def run_path(func, series, counter, force_general, args=()):
+    block = stage_series(series, BASE, counter_corrected=counter)
+    assert block.regular_ts is not None
+    if force_general:
+        block.regular_ts = None  # disable fast path
+    params = K.RangeParams(BASE + 400_000, 60_000, 20, 300_000)
+    return np.asarray(
+        K.run_range_function(func, block, params, is_counter=counter, args=args)
+    )[: len(series), :20]
+
+
+GAUGE_MXU = sorted(MXU_FUNCS - {"rate", "increase", "irate", "timestamp"})
+
+
+@pytest.mark.parametrize("func", GAUGE_MXU)
+def test_mxu_matches_general_gauge(func):
+    series = regular_series(seed=3)
+    args = (600.0,) if func == "predict_linear" else ()
+    fast = run_path(func, series, False, False, args)
+    slow = run_path(func, series, False, True, args)
+    np.testing.assert_array_equal(np.isnan(fast), np.isnan(slow), err_msg=func)
+    m = ~np.isnan(slow)
+    np.testing.assert_allclose(fast[m], slow[m], rtol=2e-4, atol=1e-3, err_msg=func)
+
+
+@pytest.mark.parametrize("func", ["rate", "increase", "irate"])
+def test_mxu_matches_general_counter(func):
+    series = regular_series(seed=4, counter=True)
+    fast = run_path(func, series, True, False)
+    slow = run_path(func, series, True, True)
+    np.testing.assert_array_equal(np.isnan(fast), np.isnan(slow), err_msg=func)
+    m = ~np.isnan(slow)
+    np.testing.assert_allclose(fast[m], slow[m], rtol=1e-3, atol=1e-3, err_msg=func)
+
+
+def test_irregular_data_not_regular():
+    rng = np.random.default_rng(0)
+    series = []
+    for i in range(3):
+        ts = BASE + np.cumsum(rng.integers(5000, 15000, 100)).astype(np.int64)
+        series.append((ts, rng.standard_normal(100)))
+    block = stage_series(series, BASE)
+    assert block.regular_ts is None
+
+
+def test_nan_staleness_in_one_series_breaks_regularity():
+    ts = BASE + (1 + np.arange(100, dtype=np.int64)) * 10_000
+    v1 = np.random.default_rng(0).standard_normal(100)
+    v2 = v1.copy()
+    v2[10] = np.nan  # dropped at staging -> different length
+    block = stage_series([(ts, v1), (ts.copy(), v2)], BASE)
+    assert block.regular_ts is None  # must fall back to the general path
