@@ -63,6 +63,11 @@ class TimeSeriesShard:
         self.stats = ShardStats()
         self._lock = threading.RLock()
         self._ingested_offset = -1  # stream offset watermark (Kafka analog)
+        # data version for query-side staging caches: bumped on every ingest
+        # so cached HBM-resident blocks invalidate (reference analog: block
+        # memory reclaim + chunk seal versioning)
+        self.version = 0
+        self.stage_cache: dict = {}
 
     # -- ingest ------------------------------------------------------------
 
@@ -75,11 +80,15 @@ class TimeSeriesShard:
                 n += self._ingest_series(sb)
             if offset >= 0:
                 self._ingested_offset = max(self._ingested_offset, offset)
+            self.version += 1
+            self.stage_cache.clear()
         self.stats.rows_ingested += n
         return n
 
     def ingest_series(self, sb: SeriesBatch) -> int:
         with self._lock:
+            self.version += 1
+            self.stage_cache.clear()
             return self._ingest_series(sb)
 
     def _ingest_series(self, sb: SeriesBatch) -> int:

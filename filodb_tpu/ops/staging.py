@@ -69,6 +69,19 @@ class StagedBlock:
     def shape(self):
         return self.ts.shape
 
+    def to_device(self) -> "StagedBlock":
+        """Pin the block's arrays in HBM (the north-star 'decoded chunk
+        windows staged to HBM'); returns self for chaining."""
+        import jax
+
+        self.ts = jax.device_put(self.ts)
+        self.vals = jax.device_put(self.vals)
+        self.lens = jax.device_put(self.lens)
+        self.baseline = jax.device_put(self.baseline)
+        if self.raw is not None:
+            self.raw = jax.device_put(self.raw)
+        return self
+
 
 def counter_correct(vals: np.ndarray) -> np.ndarray:
     """f64 prefix-sum reset correction: add the prior raw value at each drop
