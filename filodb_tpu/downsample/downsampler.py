@@ -1,0 +1,145 @@
+"""Downsampling (reference core/.../downsample/: ChunkDownsampler.scala:38
+dMin/dMax/dSum/dCount/dAvg/tTime ADT, ShardDownsampler.scala:40 ingest-time
+emission at flush, DownsampledTimeSeriesStore query-side column rewrite
+``min_over_time(m) -> m::min``; batch job: spark-jobs DownsamplerMain).
+
+TPU-native reframing: downsampling a chunk is a vectorized period-reduce
+over its sample arrays (numpy host-side at flush; the data is already
+columnar). Downsampled series land in a separate dataset (e.g. ``ds_5m``)
+with a gauge-like multi-column schema {min,max,sum,count,avg}; the query
+planner picks the column by function (column rewrite) when serving from a
+downsample dataset.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..core.records import RecordBatch, SeriesBatch
+from ..core.schemas import Column, ColumnType, Schema
+
+# the downsample schema: one row per period with all reduced columns
+DS_GAUGE = Schema(
+    "ds-gauge",
+    [
+        Column("timestamp", ColumnType.TIMESTAMP),
+        Column("min", ColumnType.DOUBLE),
+        Column("max", ColumnType.DOUBLE),
+        Column("sum", ColumnType.DOUBLE),
+        Column("count", ColumnType.DOUBLE),
+        Column("avg", ColumnType.DOUBLE),
+    ],
+    "avg",
+)
+
+# query-side column rewrite (reference DownsampledTimeSeriesShard column
+# selection, doc/downsampling.md:89-96)
+FUNC_TO_DS_COLUMN = {
+    "min_over_time": "min",
+    "max_over_time": "max",
+    "sum_over_time": "sum",
+    "count_over_time": "count",
+    "avg_over_time": "avg",
+    "last": "avg",
+    "last_over_time": "avg",
+}
+
+
+def downsample_samples(ts: np.ndarray, vals: np.ndarray, period_ms: int):
+    """Reduce one series' samples into per-period rows.
+
+    Periods are aligned to epoch multiples of period_ms; the emitted
+    timestamp is the period end (reference tTime semantics). Vectorized via
+    np.add.reduceat on period boundaries.
+    """
+    if len(ts) == 0:
+        empty = np.empty(0)
+        return np.empty(0, dtype=np.int64), {k: empty for k in ("min", "max", "sum", "count", "avg")}
+    period = (ts // period_ms).astype(np.int64)
+    # boundaries where the period changes
+    idx = np.nonzero(np.diff(period, prepend=period[0] - 1))[0]
+    keep = ~np.isnan(vals)
+    # reduceat needs NaN-safe values
+    v0 = np.where(keep, vals, 0.0)
+    sums = np.add.reduceat(v0, idx)
+    counts = np.add.reduceat(keep.astype(np.float64), idx)
+    mins = np.minimum.reduceat(np.where(keep, vals, np.inf), idx)
+    maxs = np.maximum.reduceat(np.where(keep, vals, -np.inf), idx)
+    out_ts = (period[idx] + 1) * period_ms - 1
+    has = counts > 0
+    avg = np.where(has, sums / np.maximum(counts, 1), np.nan)
+    return out_ts[has], {
+        "min": mins[has],
+        "max": maxs[has],
+        "sum": sums[has],
+        "count": counts[has],
+        "avg": avg[has],
+    }
+
+
+@dataclass
+class ShardDownsampler:
+    """Ingest-time downsampler: at flush, reduce each sealed chunk and feed
+    the downsample dataset (reference ShardDownsampler emits downsample
+    records during doFlushSteps)."""
+
+    target_memstore: object
+    target_dataset: str
+    periods_ms: tuple[int, ...] = (300_000, 3_600_000)  # 5m, 1h
+
+    def dataset_for(self, period_ms: int) -> str:
+        return f"{self.target_dataset}_{period_ms // 60000}m"
+
+    def downsample_chunks(self, shard_num: int, part, chunks) -> int:
+        n = 0
+        col = part.schema.value_column
+        c0 = part.schema.column(col)
+        if c0.ctype != ColumnType.DOUBLE:
+            return 0  # histogram downsampling: round 2
+        for period in self.periods_ms:
+            ts_parts, val_parts = [], []
+            for c in chunks:
+                ts_parts.append(c.column("timestamp"))
+                val_parts.append(c.column(col).astype(np.float64))
+            ts = np.concatenate(ts_parts)
+            vals = np.concatenate(val_parts)
+            out_ts, cols = downsample_samples(ts, vals, period)
+            if len(out_ts) == 0:
+                continue
+            ds = self.dataset_for(period)
+            sb = SeriesBatch(DS_GAUGE, dict(part.tags), out_ts, cols)
+            self.target_memstore.shard(ds, shard_num).ingest_series(sb)
+            n += len(out_ts)
+        return n
+
+
+def batch_downsample(store, memstore, dataset: str, shard_nums, target_memstore,
+                     downsampler: ShardDownsampler) -> int:
+    """Batch job analog of spark-jobs DownsamplerMain: scan persisted chunks
+    from the column store and (re)build downsample datasets."""
+    from ..core.encodings import decode
+    from ..core.schemas import SCHEMAS, canonical_partkey
+
+    n = 0
+    for shard_num in shard_nums:
+        for header, schema_name, encs in store.read_chunks(dataset, shard_num):
+            schema = SCHEMAS.get(schema_name)
+            if schema is None:
+                continue
+            cols = dict(zip(header["cols"], encs))
+            vcol = schema.value_column
+            if vcol not in cols or schema.column(vcol).ctype != ColumnType.DOUBLE:
+                continue
+            ts = decode(cols["timestamp"])
+            vals = decode(cols[vcol]).astype(np.float64)
+            for period in downsampler.periods_ms:
+                out_ts, reduced = downsample_samples(ts, vals, period)
+                if len(out_ts) == 0:
+                    continue
+                ds = downsampler.dataset_for(period)
+                sb = SeriesBatch(DS_GAUGE, header["tags"], out_ts, reduced)
+                target_memstore.shard(ds, shard_num).ingest_series(sb)
+                n += len(out_ts)
+    return n

@@ -1,0 +1,136 @@
+"""Ingest line-protocol parsers (reference L7 gateway/:
+InfluxProtocolParser.scala / InputRecord.scala:15 PrometheusInputRecord —
+Influx line protocol and Prometheus text exposition -> ingestion records).
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Iterable
+
+import numpy as np
+
+from ..core.records import RecordBatch
+from ..core.schemas import GAUGE, METRIC_TAG, PROM_COUNTER, Schema
+
+
+def _unescape(s: str) -> str:
+    return s.replace("\\,", ",").replace("\\ ", " ").replace("\\=", "=").replace('\\"', '"')
+
+
+_INFLUX_SPLIT = re.compile(r"(?<!\\) ")
+_COMMA_SPLIT = re.compile(r"(?<!\\),")
+
+
+def parse_influx_line(line: str):
+    """One Influx line: measurement[,tag=v...] field=v[,field=v...] [ts_ns].
+
+    Yields (metric, tags, ts_ms, value) per numeric field; measurement
+    becomes the metric prefix for non-'value' fields (reference
+    InfluxProtocolParser field handling).
+    """
+    line = line.strip()
+    if not line or line.startswith("#"):
+        return
+    parts = _INFLUX_SPLIT.split(line)
+    if len(parts) < 2:
+        raise ValueError(f"bad influx line: {line!r}")
+    key_part, field_part = parts[0], parts[1]
+    ts_ms = int(parts[2]) // 1_000_000 if len(parts) > 2 else None
+    key_items = _COMMA_SPLIT.split(key_part)
+    measurement = _unescape(key_items[0])
+    tags = {}
+    for item in key_items[1:]:
+        k, _, v = item.partition("=")
+        tags[_unescape(k)] = _unescape(v)
+    for fv in _COMMA_SPLIT.split(field_part):
+        k, _, v = fv.partition("=")
+        k = _unescape(k)
+        v = v.strip()
+        if v.endswith("i"):
+            val = float(v[:-1])
+        elif v in ("t", "T", "true", "True"):
+            val = 1.0
+        elif v in ("f", "F", "false", "False"):
+            val = 0.0
+        elif v.startswith('"'):
+            continue  # string fields are not time series values
+        else:
+            val = float(v)
+        metric = measurement if k == "value" else f"{measurement}_{k}"
+        yield metric, dict(tags), ts_ms, val
+
+
+_PROM_LINE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(?P<labels>.*)\})?\s+(?P<value>\S+)(?:\s+(?P<ts>-?\d+))?$"
+)
+_PROM_LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:\\.|[^"\\])*)"')
+
+
+def parse_prom_text(text: str):
+    """Prometheus exposition format -> (metric, tags, ts_ms, value) tuples.
+    TYPE comments steer counter/gauge schema choice."""
+    types: dict[str, str] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# TYPE"):
+            parts = line.split()
+            if len(parts) >= 4:
+                types[parts[2]] = parts[3]
+            continue
+        if line.startswith("#"):
+            continue
+        m = _PROM_LINE.match(line)
+        if not m:
+            raise ValueError(f"bad prometheus line: {line!r}")
+        name = m.group("name")
+        tags = {}
+        if m.group("labels"):
+            for lm in _PROM_LABEL.finditer(m.group("labels")):
+                tags[lm.group(1)] = lm.group(2).encode().decode("unicode_escape")
+        vs = m.group("value")
+        val = float("nan") if vs in ("NaN", "nan") else float(vs)
+        ts_ms = int(m.group("ts")) if m.group("ts") else None
+        yield name, tags, ts_ms, val, types.get(name, "untyped")
+
+
+def influx_to_batch(lines: Iterable[str], default_ts_ms: int, ws="default", ns="default") -> RecordBatch:
+    tags_list, ts, vals = [], [], []
+    for line in lines:
+        for metric, tags, t, v in parse_influx_line(line) or ():
+            full = dict(tags)
+            full[METRIC_TAG] = metric
+            full.setdefault("_ws_", ws)
+            full.setdefault("_ns_", ns)
+            tags_list.append(full)
+            ts.append(t if t is not None else default_ts_ms)
+            vals.append(v)
+    return RecordBatch(
+        GAUGE, np.asarray(ts, dtype=np.int64), {"value": np.asarray(vals)}, tags_list
+    )
+
+
+def prom_text_to_batches(text: str, default_ts_ms: int, ws="default", ns="default") -> list[RecordBatch]:
+    """Split by schema: counters -> prom-counter, rest -> gauge."""
+    gauges, counters = ([], []), ([], [])
+    for name, tags, t, v, typ in parse_prom_text(text):
+        full = dict(tags)
+        full[METRIC_TAG] = name
+        full.setdefault("_ws_", ws)
+        full.setdefault("_ns_", ns)
+        bucket = counters if typ == "counter" else gauges
+        bucket[0].append(full)
+        bucket[1].append((t if t is not None else default_ts_ms, v))
+    out = []
+    for (tags_list, rows), schema, col in (
+        (gauges, GAUGE, "value"),
+        (counters, PROM_COUNTER, "count"),
+    ):
+        if tags_list:
+            ts = np.asarray([r[0] for r in rows], dtype=np.int64)
+            vals = np.asarray([r[1] for r in rows])
+            out.append(RecordBatch(schema, ts, {col: vals}, tags_list))
+    return out

@@ -1,0 +1,193 @@
+"""Persistence layer (reference L3: store/ChunkSink.scala, ChunkSource.scala,
+cassandra/CassandraColumnStore.scala:55 — chunk tables, partkey tables,
+checkpoint table).
+
+The durable backend here is a local filesystem layout (object-store-shaped:
+one append-only segment file per (shard, flush-group) plus partkey and
+checkpoint JSON journals) standing in for Cassandra. The API mirrors the
+reference's ColumnStore so a different backend can slot in.
+
+Layout under root/:
+  <dataset>/shard-<n>/chunks-g<g>.seg   — framed encoded chunk sets
+  <dataset>/shard-<n>/partkeys.jsonl    — partkey journal (tags, start, end)
+  <dataset>/checkpoints.json            — (shard, group) -> offset
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import threading
+from dataclasses import dataclass
+from typing import Iterable, Mapping, Sequence
+
+import numpy as np
+
+from ..core.encodings import Encoded
+from ..core.schemas import SCHEMAS, Schema
+from ..memstore.partition import Chunk
+
+_FRAME = struct.Struct("<IHH")  # payload len, schema_id, n_columns
+
+
+class ColumnStore:
+    """Write/read API (reference ChunkSink + ChunkSource raw reads)."""
+
+    def write_chunks(self, dataset, shard, group, part_id, partkey_tags, schema, chunks):
+        raise NotImplementedError
+
+    def write_partkey(self, dataset, shard, tags, start_ts, end_ts):
+        raise NotImplementedError
+
+    def write_checkpoint(self, dataset, shard, group, offset):
+        raise NotImplementedError
+
+    def read_checkpoints(self, dataset, shard) -> dict[int, int]:
+        raise NotImplementedError
+
+    def read_partkeys(self, dataset, shard) -> list[dict]:
+        raise NotImplementedError
+
+    def read_chunks(self, dataset, shard) -> Iterable[tuple[dict, str, list[dict]]]:
+        raise NotImplementedError
+
+
+class NullColumnStore(ColumnStore):
+    """In-memory no-op sink so shards and queries run without persistence
+    (reference NullColumnStore, ChunkSink.scala:159)."""
+
+    def __init__(self):
+        self.chunks_written = 0
+        self.partkeys_written = 0
+        self.checkpoints: dict = {}
+
+    def write_chunks(self, dataset, shard, group, part_id, partkey_tags, schema, chunks):
+        self.chunks_written += len(chunks)
+
+    def write_partkey(self, dataset, shard, tags, start_ts, end_ts):
+        self.partkeys_written += 1
+
+    def write_checkpoint(self, dataset, shard, group, offset):
+        self.checkpoints[(dataset, shard, group)] = offset
+
+    def read_checkpoints(self, dataset, shard):
+        return {
+            g: off
+            for (d, s, g), off in self.checkpoints.items()
+            if d == dataset and s == shard
+        }
+
+    def read_partkeys(self, dataset, shard):
+        return []
+
+    def read_chunks(self, dataset, shard):
+        return []
+
+
+class LocalColumnStore(ColumnStore):
+    def __init__(self, root: str):
+        self.root = root
+        self._lock = threading.Lock()
+        os.makedirs(root, exist_ok=True)
+
+    def _shard_dir(self, dataset, shard) -> str:
+        d = os.path.join(self.root, dataset, f"shard-{shard}")
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    # -- writes ----------------------------------------------------------
+
+    def write_chunks(self, dataset, shard, group, part_id, partkey_tags, schema: Schema,
+                     chunks: Sequence[Chunk]):
+        """Append framed encoded chunk sets (reference
+        CassandraColumnStore.write:207)."""
+        path = os.path.join(self._shard_dir(dataset, shard), f"chunks-g{group}.seg")
+        with self._lock, open(path, "ab") as f:
+            for c in chunks:
+                enc = c.ensure_encoded(schema)
+                header = {
+                    "tags": dict(partkey_tags),
+                    "schema": schema.name,
+                    "start": c.start_ts,
+                    "end": c.end_ts,
+                    "n": c.n,
+                    "cols": list(enc.keys()),
+                }
+                hdr = json.dumps(header).encode()
+                payloads = [e.to_bytes() for e in enc.values()]
+                f.write(_FRAME.pack(len(hdr), schema.schema_id, len(payloads)))
+                f.write(struct.pack("<I", len(hdr)))
+                f.write(hdr)
+                for p in payloads:
+                    f.write(struct.pack("<I", len(p)))
+                    f.write(p)
+
+    def write_partkey(self, dataset, shard, tags, start_ts, end_ts):
+        path = os.path.join(self._shard_dir(dataset, shard), "partkeys.jsonl")
+        with self._lock, open(path, "a") as f:
+            f.write(json.dumps({"tags": dict(tags), "start": int(start_ts), "end": int(end_ts)}) + "\n")
+
+    def write_checkpoint(self, dataset, shard, group, offset):
+        """reference CheckpointTable: per (dataset, shard, group) offsets."""
+        path = os.path.join(self.root, dataset, "checkpoints.json")
+        with self._lock:
+            data = {}
+            if os.path.exists(path):
+                with open(path) as f:
+                    data = json.load(f)
+            data[f"{shard}/{group}"] = int(offset)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(data, f)
+            os.replace(tmp, path)
+
+    # -- reads -----------------------------------------------------------
+
+    def read_checkpoints(self, dataset, shard) -> dict[int, int]:
+        path = os.path.join(self.root, dataset, "checkpoints.json")
+        if not os.path.exists(path):
+            return {}
+        with open(path) as f:
+            data = json.load(f)
+        out = {}
+        for k, v in data.items():
+            s, g = k.split("/")
+            if int(s) == shard:
+                out[int(g)] = v
+        return out
+
+    def read_partkeys(self, dataset, shard) -> list[dict]:
+        path = os.path.join(self.root, dataset, f"shard-{shard}", "partkeys.jsonl")
+        if not os.path.exists(path):
+            return []
+        out: dict[str, dict] = {}
+        with open(path) as f:
+            for line in f:
+                rec = json.loads(line)
+                key = json.dumps(rec["tags"], sort_keys=True)
+                out[key] = rec  # last write wins (end-time updates)
+        return list(out.values())
+
+    def read_chunks(self, dataset, shard):
+        """Yield (header, schema_name, [Encoded per column]) for every chunk
+        set in the shard (reference readRawPartitions:774)."""
+        d = os.path.join(self.root, dataset, f"shard-{shard}")
+        if not os.path.isdir(d):
+            return
+        for fn in sorted(os.listdir(d)):
+            if not fn.startswith("chunks-"):
+                continue
+            with open(os.path.join(d, fn), "rb") as f:
+                while True:
+                    frame = f.read(_FRAME.size)
+                    if len(frame) < _FRAME.size:
+                        break
+                    _, schema_id, n_cols = _FRAME.unpack(frame)
+                    (hlen,) = struct.unpack("<I", f.read(4))
+                    header = json.loads(f.read(hlen))
+                    encs = []
+                    for _ in range(n_cols):
+                        (plen,) = struct.unpack("<I", f.read(4))
+                        encs.append(Encoded.from_bytes(f.read(plen)))
+                    yield header, header["schema"], encs

@@ -1,0 +1,216 @@
+"""Persistence, flush, recovery, gateway, downsample tests (model: reference
+IngestionAndRecoverySpec multi-jvm flow — ingest, flush, kill, recover,
+verify query correctness — plus CsvStream / parser specs)."""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.coordinator.planner import QueryEngine
+from filodb_tpu.core.schemas import Dataset
+from filodb_tpu.downsample.downsampler import (
+    DS_GAUGE,
+    ShardDownsampler,
+    batch_downsample,
+    downsample_samples,
+)
+from filodb_tpu.gateway.parsers import (
+    influx_to_batch,
+    parse_influx_line,
+    parse_prom_text,
+    prom_text_to_batches,
+)
+from filodb_tpu.gateway.stream import CsvStream, IngestionPipeline, MemoryStream
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.memstore.shard import StoreConfig
+from filodb_tpu.store.columnstore import LocalColumnStore, NullColumnStore
+from filodb_tpu.store.flush import FlushCoordinator, recover_shard
+from filodb_tpu.testkit import machine_metrics
+
+BASE = 1_600_000_000_000
+
+
+class TestFlushAndRecovery:
+    def test_flush_write_read_roundtrip(self, tmp_path):
+        ms = TimeSeriesMemStore(StoreConfig(max_chunk_size=100))
+        ms.setup(Dataset("ds"), [0])
+        ms.ingest("ds", 0, machine_metrics(n_series=5, n_samples=250, start_ms=BASE), offset=7)
+        store = LocalColumnStore(str(tmp_path))
+        fc = FlushCoordinator(ms, store)
+        res = fc.flush_shard("ds", 0)
+        assert res.chunks_written == 5 * 3  # 250 samples / 100 -> 3 chunks
+        assert store.read_checkpoints("ds", 0)  # every group checkpointed
+        chunks = list(store.read_chunks("ds", 0))
+        assert len(chunks) == 15
+        header, schema_name, encs = chunks[0]
+        assert schema_name == "gauge"
+        assert header["n"] == 100
+
+    def test_kill_and_recover_query_correct(self, tmp_path):
+        """ingest -> flush -> 'kill' -> recover into a fresh memstore ->
+        same query answers (the reference's IngestionAndRecoverySpec)."""
+        store = LocalColumnStore(str(tmp_path))
+        batch = machine_metrics(n_series=8, n_samples=300, start_ms=BASE)
+
+        ms1 = TimeSeriesMemStore(StoreConfig(max_chunk_size=100))
+        ms1.setup(Dataset("ds"), [0])
+        ms1.ingest("ds", 0, batch, offset=0)
+        FlushCoordinator(ms1, store).flush_shard("ds", 0)
+        start_s = (BASE + 600_000) / 1000
+        end_s = (BASE + 2_400_000) / 1000
+        want = QueryEngine(ms1, "ds").query_range("avg(heap_usage0)", start_s, end_s, 60.0)
+
+        ms2 = TimeSeriesMemStore(StoreConfig(max_chunk_size=100))
+        ms2.setup(Dataset("ds"), [0])
+        replay_from = recover_shard(ms2, store, "ds", 0)
+        assert replay_from == 0
+        sh = ms2.shard("ds", 0)
+        assert sh.num_partitions == 8
+        got = QueryEngine(ms2, "ds").query_range("avg(heap_usage0)", start_s, end_s, 60.0)
+        np.testing.assert_allclose(
+            got.grids[0].values_np(), want.grids[0].values_np(), rtol=1e-5, equal_nan=True
+        )
+
+    def test_recovery_replays_unflushed_tail(self, tmp_path):
+        """Rows ingested after the last flush come back via stream replay."""
+        store = LocalColumnStore(str(tmp_path))
+        stream = MemoryStream()
+        b1 = machine_metrics(n_series=3, n_samples=100, start_ms=BASE)
+        b2 = machine_metrics(n_series=3, n_samples=100, start_ms=BASE + 100 * 10_000)
+        stream.append(b1)
+        stream.append(b2)
+
+        ms1 = TimeSeriesMemStore(StoreConfig(max_chunk_size=50))
+        ms1.setup(Dataset("ds"), [0])
+        fc = FlushCoordinator(ms1, store)
+        ms1.ingest("ds", 0, b1, offset=0)
+        fc.flush_shard("ds", 0, offset=0)
+        ms1.ingest("ds", 0, b2, offset=1)  # never flushed -> lost on kill
+
+        ms2 = TimeSeriesMemStore(StoreConfig(max_chunk_size=50))
+        ms2.setup(Dataset("ds"), [0])
+        pipe = IngestionPipeline(ms2, "ds", 0, stream)
+        pipe.recover_and_run(store)
+        part = ms2.shard("ds", 0).partitions[0]
+        assert part.num_samples() == 200  # 100 recovered + 100 replayed
+
+    def test_null_store(self):
+        ms = TimeSeriesMemStore(StoreConfig(max_chunk_size=50))
+        ms.setup(Dataset("ds"), [0])
+        ms.ingest("ds", 0, machine_metrics(n_series=2, n_samples=120, start_ms=BASE))
+        store = NullColumnStore()
+        FlushCoordinator(ms, store).flush_shard("ds", 0)
+        # 120 samples / 50-chunks -> 2 sealed + the open buffer sealed at flush
+        assert store.chunks_written == 2 * 3
+
+
+class TestGatewayParsers:
+    def test_influx_basic(self):
+        out = list(parse_influx_line("cpu,host=a,dc=us value=0.5 1600000000000000000"))
+        assert out == [("cpu", {"host": "a", "dc": "us"}, 1_600_000_000_000, 0.5)]
+
+    def test_influx_multi_field(self):
+        out = list(parse_influx_line("mem,host=a used=10i,free=20i 1600000000000000000"))
+        metrics = {m for m, *_ in out}
+        assert metrics == {"mem_used", "mem_free"}
+
+    def test_influx_escapes_and_strings(self):
+        out = list(parse_influx_line('disk,path=/var\\ log value=1.5,label="x" 1600000000000000000'))
+        assert len(out) == 1
+        assert out[0][1]["path"] == "/var log"
+
+    def test_influx_to_batch_ingestable(self):
+        batch = influx_to_batch(
+            ["cpu,host=a value=1 1600000000000000000", "cpu,host=b value=2 1600000001000000000"],
+            default_ts_ms=BASE,
+        )
+        ms = TimeSeriesMemStore()
+        ms.setup(Dataset("ds"), range(2))
+        assert ms.ingest_routed("ds", batch, spread=1) == 2
+
+    def test_prom_text(self):
+        text = """# HELP http_requests_total total
+# TYPE http_requests_total counter
+http_requests_total{method="get",code="200"} 1027 1600000000000
+http_requests_total{method="post"} 3
+# TYPE temp gauge
+temp 36.6
+"""
+        out = list(parse_prom_text(text))
+        assert len(out) == 3
+        assert out[0] == ("http_requests_total", {"method": "get", "code": "200"}, 1_600_000_000_000, 1027.0, "counter")
+        assert out[2][4] == "gauge"
+
+    def test_prom_text_to_batches_schema_split(self):
+        text = "# TYPE c counter\nc 5\ng 1\n"
+        batches = prom_text_to_batches(text, BASE)
+        names = {b.schema.name for b in batches}
+        assert names == {"gauge", "prom-counter"}
+
+
+class TestCsvStream:
+    def test_csv_roundtrip(self, tmp_path):
+        p = tmp_path / "data.csv"
+        lines = [f"cpu,host=h{i % 3},{BASE + i * 1000},{float(i)}" for i in range(100)]
+        p.write_text("\n".join(lines))
+        ms = TimeSeriesMemStore()
+        ms.setup(Dataset("ds"), [0])
+        pipe = IngestionPipeline(ms, "ds", 0, CsvStream(str(p), batch_size=30))
+        n = pipe.run()
+        assert n == 100
+        assert ms.shard("ds", 0).num_partitions == 3
+
+    def test_csv_replay_from_offset(self, tmp_path):
+        p = tmp_path / "data.csv"
+        p.write_text("\n".join(f"m,,{BASE + i * 1000},{i}" for i in range(50)))
+        got = []
+        for off, batch in CsvStream(str(p), batch_size=10).batches(from_offset=30):
+            got.extend(batch.timestamps.tolist())
+        assert len(got) == 20
+
+
+class TestDownsample:
+    def test_downsample_samples_math(self):
+        ts = BASE + np.arange(100, dtype=np.int64) * 10_000  # 10s over ~16m
+        vals = np.arange(100, dtype=np.float64)
+        out_ts, cols = downsample_samples(ts, vals, 300_000)  # 5m periods
+        assert (np.diff(out_ts) == 300_000).all()
+        # first full period: samples within [aligned_start, +5m)
+        period0 = ts // 300_000 == ts[0] // 300_000
+        np.testing.assert_allclose(cols["sum"][0], vals[period0].sum())
+        np.testing.assert_allclose(cols["min"][0], vals[period0].min())
+        np.testing.assert_allclose(cols["count"][0], period0.sum())
+
+    def test_ingest_time_downsampler(self):
+        ms = TimeSeriesMemStore(StoreConfig(max_chunk_size=100))
+        ms.setup(Dataset("ds"), [0])
+        dsm = TimeSeriesMemStore()
+        dsm.setup(Dataset("ds_5m", schemas=[DS_GAUGE]), [0])
+        dsm.setup(Dataset("ds_60m", schemas=[DS_GAUGE]), [0])
+        ms.ingest("ds", 0, machine_metrics(n_series=2, n_samples=400, start_ms=BASE))
+        shard = ms.shard("ds", 0)
+        d = ShardDownsampler(dsm, "ds")
+        for part in shard.partitions.values():
+            part.switch_buffers()
+            d.downsample_chunks(0, part, part.chunks)
+        ds_shard = dsm.shard("ds_5m", 0)
+        assert ds_shard.num_partitions == 2
+        part = ds_shard.partitions[0]
+        ts, avg = part.samples_in_range(0, 2**62, "avg")
+        assert len(ts) >= 12  # 400 samples @10s ≈ 67m -> ≥12 5m periods
+        _, mins = part.samples_in_range(0, 2**62, "min")
+        _, maxs = part.samples_in_range(0, 2**62, "max")
+        assert (mins <= maxs).all()
+
+    def test_batch_downsample_from_store(self, tmp_path):
+        store = LocalColumnStore(str(tmp_path))
+        ms = TimeSeriesMemStore(StoreConfig(max_chunk_size=100))
+        ms.setup(Dataset("ds"), [0])
+        ms.ingest("ds", 0, machine_metrics(n_series=2, n_samples=300, start_ms=BASE))
+        FlushCoordinator(ms, store).flush_shard("ds", 0)
+        dsm = TimeSeriesMemStore()
+        dsm.setup(Dataset("ds_5m", schemas=[DS_GAUGE]), [0])
+        dsm.setup(Dataset("ds_60m", schemas=[DS_GAUGE]), [0])
+        d = ShardDownsampler(dsm, "ds")
+        n = batch_downsample(store, ms, "ds", [0], dsm, d)
+        assert n > 0
+        assert dsm.shard("ds_5m", 0).num_partitions == 2
