@@ -69,7 +69,17 @@ def _unzigzag(u: np.ndarray) -> np.ndarray:
 
 
 def nibble_pack(values: np.ndarray) -> bytes:
-    """Pack a u64 array. Pure-numpy group loop (C++ fast path in native/)."""
+    """Pack a u64 array. Dispatches to the C++ library (native/codecs.cpp)
+    when built; the Python group loop below is the reference fallback."""
+    from ..native import nibble_pack_native
+
+    out = nibble_pack_native(values)
+    if out is not None:
+        return out
+    return _nibble_pack_py(values)
+
+
+def _nibble_pack_py(values: np.ndarray) -> bytes:
     v = np.ascontiguousarray(values, dtype=np.uint64)
     n = len(v)
     out = bytearray()
@@ -114,6 +124,15 @@ def nibble_pack(values: np.ndarray) -> bytes:
 
 def nibble_unpack(data: bytes, n: int) -> np.ndarray:
     """Inverse of :func:`nibble_pack`; returns u64 array of length n."""
+    from ..native import nibble_unpack_native
+
+    out = nibble_unpack_native(data, n)
+    if out is not None:
+        return out
+    return _nibble_unpack_py(data, n)
+
+
+def _nibble_unpack_py(data: bytes, n: int) -> np.ndarray:
     out = np.zeros(n, dtype=np.uint64)
     pos = 0
     i = 0

@@ -1,0 +1,117 @@
+"""ctypes bindings for the native codec library (reference analog: the Rust
+JNI shims, SimdNativeMethods.scala:15 / TantivyNativeMethods).
+
+Builds libfilodbcodecs.so from codecs.cpp with g++ on first use if missing;
+all callers fall back to the numpy implementations when no compiler exists.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_HERE = os.path.dirname(__file__)
+_SO = os.path.join(_HERE, "libfilodbcodecs.so")
+_SRC = os.path.join(_HERE, "codecs.cpp")
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-march=native", "-shared", "-fPIC", _SRC, "-o", _SO],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return True
+    except Exception:
+        return False
+
+
+def lib():
+    """The loaded native library, or None when unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+            if not _build():
+                return None
+        try:
+            L = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        L.fdb_nibble_pack.restype = ctypes.c_long
+        L.fdb_nibble_pack.argtypes = [
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_long,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_long,
+        ]
+        L.fdb_nibble_unpack.restype = ctypes.c_long
+        L.fdb_nibble_unpack.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_long,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_long,
+        ]
+        L.fdb_nan_sum.restype = ctypes.c_double
+        L.fdb_nan_sum.argtypes = [ctypes.POINTER(ctypes.c_double), ctypes.c_long]
+        L.fdb_nan_count.restype = ctypes.c_long
+        L.fdb_nan_count.argtypes = [ctypes.POINTER(ctypes.c_double), ctypes.c_long]
+        _lib = L
+        return _lib
+
+
+def nibble_pack_native(values: np.ndarray) -> bytes | None:
+    L = lib()
+    if L is None:
+        return None
+    v = np.ascontiguousarray(values, dtype=np.uint64)
+    n = len(v)
+    cap = 2 + n * 9 + (n // 8 + 1) * 2
+    out = np.empty(cap, dtype=np.uint8)
+    written = L.fdb_nibble_pack(
+        v.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)), n,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), cap,
+    )
+    if written < 0:
+        return None
+    return out[:written].tobytes()
+
+
+def nibble_unpack_native(data: bytes, n: int) -> np.ndarray | None:
+    L = lib()
+    if L is None:
+        return None
+    src = np.frombuffer(data, dtype=np.uint8)
+    out = np.empty(n, dtype=np.uint64)
+    consumed = L.fdb_nibble_unpack(
+        src.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(src),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)), n,
+    )
+    if consumed < 0:
+        return None
+    return out
+
+
+def nan_sum(values: np.ndarray) -> float:
+    L = lib()
+    v = np.ascontiguousarray(values, dtype=np.float64)
+    if L is None:
+        return float(np.nansum(v))
+    return L.fdb_nan_sum(v.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), len(v))
+
+
+def nan_count(values: np.ndarray) -> int:
+    L = lib()
+    v = np.ascontiguousarray(values, dtype=np.float64)
+    if L is None:
+        return int(np.count_nonzero(~np.isnan(v)))
+    return L.fdb_nan_count(v.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), len(v))

@@ -1,0 +1,145 @@
+// Native codec + reduction kernels (host side).
+//
+// Reference analogs: NibblePack (core/.../format/NibblePack.scala:108 pack8 /
+// :395 unpack8) and the Rust SIMD NaN-aware sum/count
+// (core/src/rust/filodb_core/src/simd_vectors.rs:174,202). The wire format
+// here matches filodb_tpu/core/encodings.py exactly (groups of 8 u64:
+// nonzero bitmask byte, then [trailing-zero-nibbles | nnibbles-1] header and
+// packed nibbles, low-nibble-first, byte-padded per group).
+//
+// Build: g++ -O3 -march=native -shared -fPIC codecs.cpp -o libfilodbcodecs.so
+
+#include <cstdint>
+#include <cstring>
+#include <cmath>
+
+extern "C" {
+
+// returns bytes written, or -1 if out_cap too small
+long fdb_nibble_pack(const uint64_t* in, long n, uint8_t* out, long out_cap) {
+    long pos = 0;
+    for (long g0 = 0; g0 < n; g0 += 8) {
+        int glen = (int)((n - g0) < 8 ? (n - g0) : 8);
+        uint8_t bitmask = 0;
+        for (int i = 0; i < glen; i++)
+            if (in[g0 + i] != 0) bitmask |= (uint8_t)(1u << i);
+        if (pos + 1 > out_cap) return -1;
+        out[pos++] = bitmask;
+        if (bitmask == 0) continue;
+        int tz_bits = 64, lz_bits = 64;
+        for (int i = 0; i < glen; i++) {
+            uint64_t x = in[g0 + i];
+            if (x == 0) continue;
+            int tz = __builtin_ctzll(x);
+            int lz = __builtin_clzll(x);
+            if (tz < tz_bits) tz_bits = tz;
+            if (lz < lz_bits) lz_bits = lz;
+        }
+        int tz_nib = tz_bits / 4;
+        int lz_nib = lz_bits / 4;
+        int nnib = 16 - tz_nib - lz_nib;
+        if (nnib < 1) nnib = 1;
+        if (pos + 1 > out_cap) return -1;
+        out[pos++] = (uint8_t)(((tz_nib & 0xF) << 4) | (nnib - 1));
+        uint32_t acc = 0;
+        int acc_n = 0;
+        for (int i = 0; i < glen; i++) {
+            uint64_t x = in[g0 + i];
+            if (x == 0) continue;
+            x >>= (tz_nib * 4);
+            for (int k = 0; k < nnib; k++) {
+                acc |= (uint32_t)((x >> (4 * k)) & 0xF) << (4 * acc_n);
+                if (++acc_n == 2) {
+                    if (pos + 1 > out_cap) return -1;
+                    out[pos++] = (uint8_t)acc;
+                    acc = 0;
+                    acc_n = 0;
+                }
+            }
+        }
+        if (acc_n) {
+            if (pos + 1 > out_cap) return -1;
+            out[pos++] = (uint8_t)acc;
+        }
+    }
+    return pos;
+}
+
+// returns bytes consumed, or -1 on malformed input
+long fdb_nibble_unpack(const uint8_t* in, long in_len, uint64_t* out, long n) {
+    long pos = 0;
+    long i = 0;
+    while (i < n) {
+        int glen = (int)((n - i) < 8 ? (n - i) : 8);
+        if (pos >= in_len) return -1;
+        uint8_t bitmask = in[pos++];
+        if (bitmask == 0) {
+            for (int b = 0; b < glen; b++) out[i + b] = 0;
+            i += glen;
+            continue;
+        }
+        if (pos >= in_len) return -1;
+        uint8_t hdr = in[pos++];
+        int tz_nib = hdr >> 4;
+        int nnib = (hdr & 0xF) + 1;
+        int n_nz = __builtin_popcount(bitmask);
+        long total_nibbles = (long)n_nz * nnib;
+        long nbytes = (total_nibbles + 1) / 2;
+        if (pos + nbytes > in_len) return -1;
+        const uint8_t* chunk = in + pos;
+        long nib_idx = 0;
+        for (int b = 0; b < glen; b++) {
+            if (!(bitmask & (1u << b))) {
+                out[i + b] = 0;
+                continue;
+            }
+            uint64_t val = 0;
+            for (int k = 0; k < nnib; k++) {
+                long ni = nib_idx + k;
+                uint8_t byte = chunk[ni >> 1];
+                uint8_t nib = (ni & 1) ? (byte >> 4) : (byte & 0xF);
+                val |= (uint64_t)nib << (4 * k);
+            }
+            nib_idx += nnib;
+            out[i + b] = val << (4 * tz_nib);
+        }
+        pos += nbytes;
+        i += glen;
+    }
+    return pos;
+}
+
+// zigzag helpers for delta-delta residual streams
+void fdb_zigzag(const int64_t* in, long n, uint64_t* out) {
+    for (long i = 0; i < n; i++)
+        out[i] = ((uint64_t)in[i] << 1) ^ (uint64_t)(in[i] >> 63);
+}
+
+void fdb_unzigzag(const uint64_t* in, long n, int64_t* out) {
+    for (long i = 0; i < n; i++)
+        out[i] = (int64_t)(in[i] >> 1) ^ -(int64_t)(in[i] & 1);
+}
+
+// Branchless NaN-zeroing sum / count (reference simd_vectors.rs:34-38:
+// NaN-as-zero via mask; unrolled so the compiler vectorizes)
+double fdb_nan_sum(const double* in, long n) {
+    double acc0 = 0, acc1 = 0, acc2 = 0, acc3 = 0;
+    long i = 0;
+    for (; i + 4 <= n; i += 4) {
+        double a = in[i], b = in[i + 1], c = in[i + 2], d = in[i + 3];
+        acc0 += (a == a) ? a : 0.0;
+        acc1 += (b == b) ? b : 0.0;
+        acc2 += (c == c) ? c : 0.0;
+        acc3 += (d == d) ? d : 0.0;
+    }
+    for (; i < n; i++) acc0 += (in[i] == in[i]) ? in[i] : 0.0;
+    return acc0 + acc1 + acc2 + acc3;
+}
+
+long fdb_nan_count(const double* in, long n) {
+    long cnt = 0;
+    for (long i = 0; i < n; i++) cnt += (in[i] == in[i]);
+    return cnt;
+}
+
+}  // extern "C"
