@@ -1,0 +1,130 @@
+"""Standalone server (reference L7: standalone/.../NewFiloServerMain.scala:25
+— boot memstore + shard recovery, start HTTP API, periodic flush + retention
+maintenance; v2-style static shard ownership, no cluster singleton).
+
+Config is a JSON dict (HOCON analog), e.g.::
+
+    {
+      "dataset": "prometheus",
+      "shards": 8,
+      "spread": 3,
+      "http_port": 9090,
+      "store_root": "/var/lib/filodb-tpu",       # omit for memory-only
+      "flush_interval_s": 3600,
+      "retention_hours": 72,
+      "max_chunk_size": 400,
+      "downsample": {"enabled": false, "periods_m": [5, 60]}
+    }
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+
+from .api.http import serve_background
+from .coordinator.planner import QueryEngine
+from .core.schemas import Dataset
+from .memstore.memstore import TimeSeriesMemStore
+from .memstore.shard import StoreConfig
+from .store.columnstore import LocalColumnStore, NullColumnStore
+from .store.flush import FlushCoordinator, recover_shard
+
+log = logging.getLogger("filodb_tpu.server")
+
+
+class FiloServer:
+    def __init__(self, config: dict | None = None):
+        cfg = dict(config or {})
+        self.dataset = cfg.get("dataset", "prometheus")
+        self.n_shards = int(cfg.get("shards", 8))
+        self.spread = int(cfg.get("spread", 3))
+        self.http_port = int(cfg.get("http_port", 9090))
+        self.flush_interval_s = float(cfg.get("flush_interval_s", 3600))
+        retention_h = float(cfg.get("retention_hours", 72))
+        self.store_config = StoreConfig(
+            max_chunk_size=int(cfg.get("max_chunk_size", 400)),
+            retention_ms=int(retention_h * 3_600_000),
+        )
+        self.memstore = TimeSeriesMemStore(self.store_config)
+        self.memstore.setup(Dataset(self.dataset), range(self.n_shards))
+        root = cfg.get("store_root")
+        self.column_store = LocalColumnStore(root) if root else NullColumnStore()
+        self.flusher = FlushCoordinator(self.memstore, self.column_store)
+        self.engine = QueryEngine(self.memstore, self.dataset)
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._http = None
+
+    # -- lifecycle --------------------------------------------------------
+
+    def recover(self) -> dict[int, int]:
+        """Rebuild shards from the column store; returns per-shard replay
+        offsets for the ingestion sources."""
+        offsets = {}
+        for s in range(self.n_shards):
+            offsets[s] = recover_shard(self.memstore, self.column_store, self.dataset, s)
+        log.info("recovered %d shards: %s", self.n_shards, offsets)
+        return offsets
+
+    def start(self, port: int | None = None) -> int:
+        self.recover()
+        self._http, actual_port = serve_background(
+            self.engine, port=self.http_port if port is None else port
+        )
+        t = threading.Thread(target=self._maintenance_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        log.info("filodb-tpu serving on :%d (%d shards)", actual_port, self.n_shards)
+        return actual_port
+
+    def stop(self):
+        self._stop.set()
+        if self._http:
+            self._http.shutdown()
+
+    def _maintenance_loop(self):
+        """Periodic flush + retention eviction (reference flush timer +
+        evictForHeadroom)."""
+        last_flush = time.time()
+        while not self._stop.wait(min(self.flush_interval_s, 60.0)):
+            now = time.time()
+            if now - last_flush >= self.flush_interval_s:
+                try:
+                    self.flusher.flush_all(self.dataset)
+                except Exception:  # noqa: BLE001
+                    log.exception("flush failed")
+                last_flush = now
+            for sh in self.memstore.shards(self.dataset):
+                sh.evict_for_retention()
+
+    def flush_now(self):
+        return self.flusher.flush_all(self.dataset)
+
+
+def main(argv=None):
+    import argparse
+
+    p = argparse.ArgumentParser("filodb-tpu-server")
+    p.add_argument("--config", help="JSON config file")
+    p.add_argument("--port", type=int, default=None)
+    args = p.parse_args(argv)
+    cfg = {}
+    if args.config:
+        with open(args.config) as f:
+            cfg = json.load(f)
+    logging.basicConfig(level=logging.INFO)
+    srv = FiloServer(cfg)
+    port = srv.start(port=args.port)
+    print(f"listening on :{port}")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,83 @@
+"""Server lifecycle + CLI tests (model: reference FiloServer boot flow +
+CliMain debug tools)."""
+
+import json
+import urllib.request
+import urllib.parse
+
+import numpy as np
+import pytest
+
+from filodb_tpu.cli import main as cli_main
+from filodb_tpu.server import FiloServer
+from filodb_tpu.testkit import machine_metrics
+
+BASE = 1_600_000_000_000
+
+
+def test_server_boot_flush_recover(tmp_path):
+    cfg = {
+        "dataset": "prometheus",
+        "shards": 2,
+        "store_root": str(tmp_path / "store"),
+        "max_chunk_size": 100,
+    }
+    srv = FiloServer(cfg)
+    port = srv.start(port=0)
+    try:
+        srv.memstore.ingest_routed(
+            "prometheus", machine_metrics(n_series=6, n_samples=250, start_ms=BASE), spread=1
+        )
+        res = srv.flush_now()
+        assert res.chunks_written > 0
+        q = urllib.parse.quote("heap_usage0")
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/api/v1/query?query={q}&time={(BASE + 2_000_000) / 1000}"
+        ) as r:
+            out = json.loads(r.read())
+        assert len(out["data"]["result"]) == 6
+    finally:
+        srv.stop()
+
+    # boot a second server on the same store: data must come back
+    srv2 = FiloServer(cfg)
+    port2 = srv2.start(port=0)
+    try:
+        assert sum(sh.num_partitions for sh in srv2.memstore.shards("prometheus")) == 6
+        q = urllib.parse.quote("avg(heap_usage0)")
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port2}/api/v1/query?query={q}&time={(BASE + 2_000_000) / 1000}"
+        ) as r:
+            out = json.loads(r.read())
+        assert len(out["data"]["result"]) == 1
+    finally:
+        srv2.stop()
+
+
+def test_cli_partkey(capsys):
+    cli_main(["partkey", 'cpu{job="api", dc="us"}'])
+    out = json.loads(capsys.readouterr().out)
+    assert out["tags"]["_metric_"] == "cpu"
+    assert "partkey_hash" in out and "shard" in out
+
+
+def test_cli_against_server(tmp_path, capsys):
+    srv = FiloServer({"dataset": "prometheus", "shards": 2})
+    port = srv.start(port=0)
+    host = f"http://127.0.0.1:{port}"
+    try:
+        csv_file = tmp_path / "in.csv"
+        csv_file.write_text(
+            "\n".join(f"cpu,host=h{i % 2},{BASE + i * 1000},{float(i)}" for i in range(20))
+        )
+        cli_main(["ingest-csv", "--host", host, str(csv_file)])
+        out = json.loads(capsys.readouterr().out)
+        assert out["data"]["ingested"] == 20
+        cli_main(["labels", "--host", host])
+        out = json.loads(capsys.readouterr().out)
+        assert "host" in out["data"]
+        cli_main(["query", "--host", host, "cpu", "--time", str((BASE + 100_000) / 1000)])
+        out = json.loads(capsys.readouterr().out)
+        assert len(out["data"]["result"]) == 2
+    finally:
+        srv.stop()
