@@ -1,0 +1,422 @@
+"""Planner hierarchy (reference L5 queryplanner/: LongTimeRangePlanner,
+HighAvailabilityPlanner.scala:491, MultiPartitionPlanner.scala:1445,
+ShardKeyRegexPlanner.scala:500, SinglePartitionPlanner.scala:129,
+FailureRoutingStrategy.scala).
+
+Composition (same layering as the reference):
+
+  SinglePartitionPlanner        picks a planner per metric/dataset
+    MultiPartitionPlanner       federates across clusters ("partitions")
+      ShardKeyRegexPlanner      fans out regex shard keys
+        HighAvailabilityPlanner fails over to a buddy cluster
+          LongTimeRangePlanner  raw vs downsample + stitch
+            SingleClusterPlanner  (planner.py)
+
+Cross-cluster execution ships subplans as PromQL over HTTP
+(PromQlRemoteExec analog) — the reference does the same for federation;
+its gRPC path is an optimization we don't need host-side.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import urllib.parse
+import urllib.request
+from dataclasses import dataclass, field, replace
+from typing import Callable, Sequence
+
+import numpy as np
+
+from ..core.filters import ColumnFilter
+from ..core.schemas import METRIC_TAG
+from ..query import logical as L
+from ..query.exec.plans import DistConcatExec, EmptyResultExec, ExecPlan, StitchRvsExec
+from ..query.exec.transformers import QueryError
+from ..query.rangevector import Grid, QueryResult
+from ..query.unparse import to_promql
+from .planner import SingleClusterPlanner
+
+
+# ---------------------------------------------------------------------------
+# Remote execution over HTTP (reference PromQlRemoteExec)
+# ---------------------------------------------------------------------------
+
+
+class PromQlRemoteExec(ExecPlan):
+    def __init__(self, endpoint: str, promql: str, start_ms: int, end_ms: int, step_ms: int):
+        super().__init__()
+        self.endpoint = endpoint
+        self.promql = promql
+        self.start_ms = start_ms
+        self.end_ms = end_ms
+        self.step_ms = step_ms
+
+    def args_str(self) -> str:
+        return f"endpoint={self.endpoint} promql={self.promql}"
+
+    def do_execute(self, ctx) -> QueryResult:
+        q = urllib.parse.quote(self.promql)
+        url = (
+            f"{self.endpoint}/api/v1/query_range?query={q}"
+            f"&start={self.start_ms / 1000}&end={self.end_ms / 1000}&step={self.step_ms / 1000}"
+        )
+        with urllib.request.urlopen(url, timeout=60) as r:
+            payload = json.loads(r.read())
+        if payload.get("status") != "success":
+            raise QueryError(f"remote exec failed: {payload}")
+        result = payload["data"]["result"]
+        num_steps = int((self.end_ms - self.start_ms) // self.step_ms) + 1
+        times = self.start_ms + np.arange(num_steps, dtype=np.int64) * self.step_ms
+        labels, rows = [], []
+        t2i = {int(t): i for i, t in enumerate(times)}
+        for series in result:
+            lbls = {
+                (METRIC_TAG if k == "__name__" else k): v
+                for k, v in series["metric"].items()
+            }
+            row = np.full(num_steps, np.nan, np.float32)
+            for t, v in series.get("values", []):
+                i = t2i.get(int(float(t) * 1000))
+                if i is not None:
+                    row[i] = float(v)
+            labels.append(lbls)
+            rows.append(row)
+        vals = np.stack(rows) if rows else np.zeros((0, num_steps), np.float32)
+        return QueryResult(grids=[Grid(labels, self.start_ms, self.step_ms, num_steps, vals)])
+
+
+# ---------------------------------------------------------------------------
+# LongTimeRangePlanner
+# ---------------------------------------------------------------------------
+
+
+class LongTimeRangePlanner:
+    """Routes old time ranges to the downsample cluster, recent ones to raw,
+    stitching at the boundary (reference LongTimeRangePlanner +
+    materializeTimeSplitPlan)."""
+
+    def __init__(self, raw_planner, downsample_planner, earliest_raw_ms: Callable[[], int]):
+        self.raw = raw_planner
+        self.ds = downsample_planner
+        self.earliest_raw_ms = earliest_raw_ms
+
+    def materialize(self, plan: L.LogicalPlan) -> ExecPlan:
+        times = _plan_range(plan)
+        if times is None:
+            return self.raw.materialize(plan)
+        start, end, step = times
+        boundary = self.earliest_raw_ms()
+        lookback = _max_lookback(plan)
+        if start >= boundary:
+            return self.raw.materialize(plan)
+        if end < boundary:
+            return self.ds.materialize(plan)
+        # split on the step grid: last ds step < first raw step
+        first_raw_step = boundary + lookback
+        first_raw_step = start + ((first_raw_step - start + step - 1) // step) * step
+        if first_raw_step > end:
+            return self.ds.materialize(plan)
+        ds_end = first_raw_step - step
+        parts = []
+        if ds_end >= start:
+            parts.append(self.ds.materialize(_with_range(plan, start, ds_end)))
+        parts.append(self.raw.materialize(_with_range(plan, first_raw_step, end)))
+        if len(parts) == 1:
+            return parts[0]
+        return StitchRvsExec(parts)
+
+
+class DownsampleClusterPlanner:
+    """Plans against a downsample dataset: rewrites the selected column by
+    range function (reference DownsampledTimeSeriesShard column rewrite,
+    ``min_over_time(m) -> m::min``, doc/downsampling.md:89-96)."""
+
+    def __init__(self, memstore, dataset: str, params=None):
+        from .planner import SingleClusterPlanner
+
+        self.inner = SingleClusterPlanner(memstore, dataset, params=params)
+
+    def materialize(self, plan: L.LogicalPlan) -> ExecPlan:
+        return self.inner.materialize(self._rewrite(plan))
+
+    def _rewrite(self, p: L.LogicalPlan) -> L.LogicalPlan:
+        from ..downsample.downsampler import FUNC_TO_DS_COLUMN
+
+        if isinstance(p, L.PeriodicSeriesWithWindowing):
+            col = FUNC_TO_DS_COLUMN.get(p.function)
+            if col:
+                return replace(p, raw=replace(p.raw, column=col))
+            return p
+        if isinstance(p, L.PeriodicSeries):
+            return replace(p, raw=replace(p.raw, column="avg"))
+        kw = {}
+        for f in getattr(p, "__dataclass_fields__", {}):
+            v = getattr(p, f)
+            if isinstance(v, L.LogicalPlan):
+                kw[f] = self._rewrite(v)
+        return replace(p, **kw) if kw else p
+
+
+# ---------------------------------------------------------------------------
+# HighAvailabilityPlanner
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class FailureTimeRange:
+    """A known-bad window of the local cluster (reference FailureProvider)."""
+
+    start_ms: int
+    end_ms: int
+
+
+class HighAvailabilityPlanner:
+    """Routes query sub-ranges overlapping local failures to a buddy cluster
+    as PromQL remote execs (reference HighAvailabilityPlanner +
+    FailureRoutingStrategy)."""
+
+    def __init__(self, local_planner, buddy_endpoint: str,
+                 failure_provider: Callable[[], Sequence[FailureTimeRange]]):
+        self.local = local_planner
+        self.buddy = buddy_endpoint
+        self.failures = failure_provider
+
+    def materialize(self, plan: L.LogicalPlan) -> ExecPlan:
+        times = _plan_range(plan)
+        failures = [f for f in self.failures()]
+        if times is None or not failures:
+            return self.local.materialize(plan)
+        start, end, step = times
+        lookback = _max_lookback(plan)
+        overlapping = [f for f in failures if f.end_ms >= start - lookback and f.start_ms <= end]
+        if not overlapping:
+            return self.local.materialize(plan)
+        # route whole steps whose lookback window touches a failure remotely
+        remote_steps = np.zeros(int((end - start) // step) + 1, dtype=bool)
+        times_arr = start + np.arange(len(remote_steps), dtype=np.int64) * step
+        for f in overlapping:
+            remote_steps |= (times_arr >= f.start_ms) & (times_arr - lookback <= f.end_ms)
+        parts: list[ExecPlan] = []
+        for is_remote, group in itertools.groupby(
+            enumerate(remote_steps), key=lambda kv: bool(kv[1])
+        ):
+            idx = [i for i, _ in group]
+            seg_start = int(times_arr[idx[0]])
+            seg_end = int(times_arr[idx[-1]])
+            sub = _with_range(plan, seg_start, seg_end)
+            if is_remote:
+                parts.append(
+                    PromQlRemoteExec(self.buddy, to_promql(sub), seg_start, seg_end, step)
+                )
+            else:
+                parts.append(self.local.materialize(sub))
+        return parts[0] if len(parts) == 1 else StitchRvsExec(parts)
+
+
+# ---------------------------------------------------------------------------
+# MultiPartitionPlanner (federation)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class PartitionAssignment:
+    """Which cluster owns a shard-key prefix (reference PartitionLocator)."""
+
+    name: str
+    endpoint: str | None  # None = local
+
+
+class MultiPartitionPlanner:
+    """Federates across FiloDB clusters keyed by shard keys (_ws_/_ns_):
+    local selectors plan locally, foreign ones become PromQL remote execs
+    (reference MultiPartitionPlanner.scala:1445)."""
+
+    def __init__(self, local_planner, locate: Callable[[dict], PartitionAssignment]):
+        self.local = local_planner
+        self.locate = locate
+
+    def _partition_of(self, plan: L.LogicalPlan) -> set[str]:
+        out = set()
+        for rs in L.leaf_raw_series(plan):
+            keys = {
+                f.column: f.value for f in rs.filters if f.op == "=" and f.column in ("_ws_", "_ns_")
+            }
+            out.add(self.locate(keys).name)
+        return out
+
+    def materialize(self, plan: L.LogicalPlan) -> ExecPlan:
+        parts = self._partition_of(plan)
+        if not parts:
+            return self.local.materialize(plan)
+        assignments = {}
+        for rs in L.leaf_raw_series(plan):
+            keys = {f.column: f.value for f in rs.filters if f.op == "="}
+            a = self.locate(keys)
+            assignments[a.name] = a
+        if len(assignments) == 1:
+            a = next(iter(assignments.values()))
+            if a.endpoint is None:
+                return self.local.materialize(plan)
+            times = _plan_range(plan)
+            if times is None:
+                raise QueryError("cannot remote-execute a plan without a time range")
+            start, end, step = times
+            return PromQlRemoteExec(a.endpoint, to_promql(plan), start, end, step)
+        # cross-partition expression: only joins/set-ops between single-
+        # partition subtrees are supported (reference behaves likewise)
+        if isinstance(plan, (L.BinaryJoin,)):
+            from ..query.exec.joins import SetOperatorExec
+            from ..query.exec.plans import ExecPlan as _EP
+
+            lhs = self.materialize(plan.lhs)
+            rhs = self.materialize(plan.rhs)
+            from ..query.exec.joins import BinaryJoinExec
+
+            if plan.op in ("and", "or", "unless"):
+                return SetOperatorExec(lhs, rhs, plan.op, plan.on, plan.ignoring)
+            return BinaryJoinExec(
+                lhs, rhs, plan.op, plan.cardinality, plan.on, plan.ignoring,
+                plan.include, plan.return_bool,
+            )
+        if isinstance(plan, L.Aggregate):
+            from ..query.exec.plans import AggregatePresentExec
+
+            inner = self.materialize(plan.inner)
+            return AggregatePresentExec([inner], plan.op, plan.params, plan.by, plan.without)
+        raise QueryError("cross-partition query shape not supported")
+
+
+class ShardKeyRegexPlanner:
+    """Expands regex/multi-value shard-key matchers into concrete key
+    combinations and fans out (reference ShardKeyRegexPlanner.scala:500)."""
+
+    def __init__(self, inner_planner, shard_key_values: Callable[[str], Sequence[str]],
+                 keys: Sequence[str] = ("_ws_", "_ns_")):
+        self.inner = inner_planner
+        self.values_of = shard_key_values
+        self.keys = keys
+
+    def materialize(self, plan: L.LogicalPlan) -> ExecPlan:
+        expansions = self._expand(plan)
+        if expansions is None:
+            return self.inner.materialize(plan)
+        plans = [self.inner.materialize(p) for p in expansions]
+        if not plans:
+            return EmptyResultExec()
+        if len(plans) == 1:
+            return plans[0]
+        if isinstance(plan, L.Aggregate) and plan.op in (
+            "sum", "min", "max", "count", "group"
+        ):
+            from ..query.exec.plans import AggregatePresentExec
+
+            return AggregatePresentExec(plans, plan.op, plan.params, plan.by, plan.without)
+        return DistConcatExec(plans)
+
+    def _expand(self, plan: L.LogicalPlan) -> list[L.LogicalPlan] | None:
+        leaves = L.leaf_raw_series(plan)
+        if not leaves:
+            return None
+        regex_keys: dict[str, list[str]] = {}
+        for rs in leaves:
+            for f in rs.filters:
+                if f.column in self.keys and f.op in ("=~", "in"):
+                    vals = (
+                        [v for v in self.values_of(f.column) if f.matches(v)]
+                        if f.op == "=~"
+                        else list(f.value)
+                    )
+                    regex_keys[f.column] = vals
+        if not regex_keys:
+            return None
+        combos = [
+            dict(zip(regex_keys.keys(), combo))
+            for combo in itertools.product(*regex_keys.values())
+        ]
+        return [_replace_shard_keys(plan, combo) for combo in combos]
+
+
+class SinglePartitionPlanner:
+    """Dispatches to a named planner per dataset/metric (reference
+    SinglePartitionPlanner.scala:129)."""
+
+    def __init__(self, planners: dict[str, object], pick: Callable[[L.LogicalPlan], str],
+                 default: str):
+        self.planners = planners
+        self.pick = pick
+        self.default = default
+
+    def materialize(self, plan: L.LogicalPlan) -> ExecPlan:
+        name = self.pick(plan) or self.default
+        return self.planners.get(name, self.planners[self.default]).materialize(plan)
+
+
+# ---------------------------------------------------------------------------
+# plan tree rewrites
+# ---------------------------------------------------------------------------
+
+
+def _plan_range(p: L.LogicalPlan):
+    if hasattr(p, "start_ms") and hasattr(p, "end_ms") and hasattr(p, "step_ms"):
+        if not isinstance(p, L.RawSeries):
+            return p.start_ms, p.end_ms, p.step_ms or 1
+    for f in getattr(p, "__dataclass_fields__", {}):
+        v = getattr(p, f)
+        if isinstance(v, L.LogicalPlan):
+            t = _plan_range(v)
+            if t is not None:
+                return t
+    return None
+
+
+def _max_lookback(p: L.LogicalPlan) -> int:
+    out = 0
+    if isinstance(p, L.PeriodicSeries):
+        out = max(out, p.lookback_ms)
+    if isinstance(p, (L.PeriodicSeriesWithWindowing, L.SubqueryWithWindowing)):
+        out = max(out, p.window_ms)
+    for f in getattr(p, "__dataclass_fields__", {}):
+        v = getattr(p, f)
+        if isinstance(v, L.LogicalPlan):
+            out = max(out, _max_lookback(v))
+    return out
+
+
+def _with_range(p: L.LogicalPlan, start_ms: int, end_ms: int) -> L.LogicalPlan:
+    """Rewrite every node's evaluation range (RawSeries windows shift to
+    cover the new grid's lookback)."""
+    if isinstance(p, L.RawSeries):
+        return p  # adjusted by parent
+    kw = {}
+    for f in p.__dataclass_fields__:
+        v = getattr(p, f)
+        if isinstance(v, L.RawSeries):
+            window = 0
+            if isinstance(p, L.PeriodicSeriesWithWindowing):
+                window = p.window_ms
+            elif isinstance(p, L.PeriodicSeries):
+                window = p.lookback_ms
+            off = getattr(p, "offset_ms", 0)
+            kw[f] = replace(v, start_ms=start_ms - window - off, end_ms=end_ms - off)
+        elif isinstance(v, L.LogicalPlan):
+            kw[f] = _with_range(v, start_ms, end_ms)
+    if hasattr(p, "start_ms") and hasattr(p, "end_ms") and not isinstance(p, L.RawSeries):
+        kw["start_ms"] = start_ms
+        kw["end_ms"] = end_ms
+    return replace(p, **kw) if kw else p
+
+
+def _replace_shard_keys(p: L.LogicalPlan, combo: dict) -> L.LogicalPlan:
+    if isinstance(p, L.RawSeries):
+        new_filters = tuple(
+            ColumnFilter(f.column, "=", combo[f.column]) if f.column in combo else f
+            for f in p.filters
+        )
+        return replace(p, filters=new_filters)
+    kw = {}
+    for f in p.__dataclass_fields__:
+        v = getattr(p, f)
+        if isinstance(v, L.LogicalPlan):
+            kw[f] = _replace_shard_keys(v, combo)
+    return replace(p, **kw) if kw else p

@@ -92,6 +92,15 @@ class ShardDownsampler:
     def dataset_for(self, period_ms: int) -> str:
         return f"{self.target_dataset}_{period_ms // 60000}m"
 
+    def _shard(self, ds: str, shard_num: int):
+        from ..core.schemas import Dataset
+
+        try:
+            return self.target_memstore.shard(ds, shard_num)
+        except KeyError:
+            self.target_memstore.setup(Dataset(ds, schemas=[DS_GAUGE]), [shard_num])
+            return self.target_memstore.shard(ds, shard_num)
+
     def downsample_chunks(self, shard_num: int, part, chunks) -> int:
         n = 0
         col = part.schema.value_column
@@ -110,7 +119,7 @@ class ShardDownsampler:
                 continue
             ds = self.dataset_for(period)
             sb = SeriesBatch(DS_GAUGE, dict(part.tags), out_ts, cols)
-            self.target_memstore.shard(ds, shard_num).ingest_series(sb)
+            self._shard(ds, shard_num).ingest_series(sb)
             n += len(out_ts)
         return n
 
@@ -140,6 +149,6 @@ def batch_downsample(store, memstore, dataset: str, shard_nums, target_memstore,
                     continue
                 ds = downsampler.dataset_for(period)
                 sb = SeriesBatch(DS_GAUGE, header["tags"], out_ts, reduced)
-                target_memstore.shard(ds, shard_num).ingest_series(sb)
+                downsampler._shard(ds, shard_num).ingest_series(sb)
                 n += len(out_ts)
     return n

@@ -1,0 +1,137 @@
+"""LogicalPlan -> PromQL string (reference
+coordinator/.../queryplanner/LogicalPlanParser.scala:286 — used by HA /
+multi-partition planners to ship subplans to peer clusters as PromQL over
+HTTP instead of serialized exec trees)."""
+
+from __future__ import annotations
+
+from ..core.filters import ColumnFilter
+from ..core.schemas import METRIC_TAG
+from . import logical as L
+from .functions import RANGE_FUNCTIONS
+
+# kernel name -> surface name (first surface name wins)
+_KERNEL_TO_SURFACE: dict[str, str] = {}
+for surface, (kernel, _, _) in RANGE_FUNCTIONS.items():
+    _KERNEL_TO_SURFACE.setdefault(kernel, surface)
+
+
+def _ms_dur(ms: int) -> str:
+    if ms % 3_600_000 == 0:
+        return f"{ms // 3_600_000}h"
+    if ms % 60_000 == 0:
+        return f"{ms // 60_000}m"
+    if ms % 1000 == 0:
+        return f"{ms // 1000}s"
+    return f"{ms}ms"
+
+
+def _selector(filters, window_ms=None, offset_ms=0) -> str:
+    metric = ""
+    matchers = []
+    for f in filters:
+        if f.column == METRIC_TAG and f.op == "=":
+            metric = f.value
+        else:
+            col = "__name__" if f.column == METRIC_TAG else f.column
+            matchers.append(f'{col}{f.op}"{f.value}"')
+    s = metric + ("{" + ",".join(matchers) + "}" if matchers else "")
+    if window_ms:
+        s += f"[{_ms_dur(window_ms)}]"
+    if offset_ms:
+        s += f" offset {_ms_dur(offset_ms)}"
+    return s
+
+
+def _args_str(args) -> str:
+    return ",".join(f"{a:g}" if isinstance(a, float) else str(a) for a in args)
+
+
+def to_promql(p: L.LogicalPlan) -> str:
+    """Render a plan subtree back to PromQL. Raises on nodes with no PromQL
+    surface form (those stay local)."""
+    if isinstance(p, L.RawSeries):
+        w = p.end_ms - p.start_ms
+        return _selector(p.filters, window_ms=w, offset_ms=p.offset_ms)
+    if isinstance(p, L.PeriodicSeries):
+        return _selector(p.raw.filters, offset_ms=p.offset_ms)
+    if isinstance(p, L.PeriodicSeriesWithWindowing):
+        surface = _KERNEL_TO_SURFACE.get(p.function, p.function)
+        _, n_scalar, scalars_first = RANGE_FUNCTIONS.get(surface, (p.function, 0, False))
+        sel = _selector(p.raw.filters, window_ms=p.window_ms, offset_ms=p.offset_ms)
+        args = list(p.function_args)
+        if args and scalars_first:
+            return f"{surface}({_args_str(args)},{sel})"
+        if args:
+            return f"{surface}({sel},{_args_str(args)})"
+        return f"{surface}({sel})"
+    if isinstance(p, L.Aggregate):
+        inner = to_promql(p.inner)
+        mod = ""
+        if p.by is not None:
+            mod = f" by ({','.join(p.by)}) "
+        elif p.without is not None:
+            mod = f" without ({','.join(p.without)}) "
+        if p.params:
+            param = p.params[0]
+            ps = f'"{param}",' if isinstance(param, str) else f"{param:g},"
+            return f"{p.op}{mod}({ps}{inner})"
+        return f"{p.op}{mod}({inner})"
+    if isinstance(p, L.BinaryJoin):
+        mod = ""
+        if p.on is not None:
+            mod += f" on ({','.join(p.on)})"
+        elif p.ignoring:
+            mod += f" ignoring ({','.join(p.ignoring)})"
+        if p.cardinality == "many-to-one":
+            mod += f" group_left ({','.join(p.include)})" if p.include else " group_left"
+        elif p.cardinality == "one-to-many":
+            mod += f" group_right ({','.join(p.include)})" if p.include else " group_right"
+        b = " bool" if p.return_bool else ""
+        return f"({to_promql(p.lhs)} {p.op}{b}{mod} {to_promql(p.rhs)})"
+    if isinstance(p, L.ScalarVectorBinaryOperation):
+        sc = to_promql(p.scalar)
+        vec = to_promql(p.vector)
+        b = " bool" if p.return_bool else ""
+        return f"({sc} {p.op}{b} {vec})" if p.scalar_is_lhs else f"({vec} {p.op}{b} {sc})"
+    if isinstance(p, L.ApplyInstantFunction):
+        inner = to_promql(p.inner)
+        if p.args:
+            from .functions import RANGE_FUNCTIONS as _RF
+
+            # histogram_quantile-style: scalar args lead
+            if p.function in ("histogram_quantile", "histogram_fraction", "histogram_max_quantile"):
+                return f"{p.function}({_args_str(p.args)},{inner})"
+            return f"{p.function}({inner},{_args_str(p.args)})"
+        return f"{p.function}({inner})"
+    if isinstance(p, L.ApplyMiscellaneousFunction):
+        strs = ",".join(f'"{s}"' for s in p.str_args)
+        return f"{p.function}({to_promql(p.inner)},{strs})"
+    if isinstance(p, L.ApplySortFunction):
+        return f"{'sort_desc' if p.descending else 'sort'}({to_promql(p.inner)})"
+    if isinstance(p, L.ApplyAbsentFunction):
+        return f"absent({to_promql(p.inner)})"
+    if isinstance(p, L.ApplyLimitFunction):
+        return to_promql(p.inner)
+    if isinstance(p, L.ScalarFixedDoublePlan):
+        return f"{p.value:g}"
+    if isinstance(p, L.ScalarTimeBasedPlan):
+        return f"{p.function}()"
+    if isinstance(p, L.ScalarBinaryOperation):
+        lhs = to_promql(p.lhs) if isinstance(p.lhs, L.LogicalPlan) else f"{p.lhs:g}"
+        rhs = to_promql(p.rhs) if isinstance(p.rhs, L.LogicalPlan) else f"{p.rhs:g}"
+        return f"({lhs} {p.op} {rhs})"
+    if isinstance(p, L.ScalarVaryingDoublePlan):
+        return f"{p.function}({to_promql(p.inner)})"
+    if isinstance(p, L.SubqueryWithWindowing):
+        surface = _KERNEL_TO_SURFACE.get(p.function, p.function)
+        inner = to_promql(p.inner)
+        sq = f"{inner}[{_ms_dur(p.window_ms)}:{_ms_dur(p.sub_step_ms)}]"
+        if p.offset_ms:
+            sq += f" offset {_ms_dur(p.offset_ms)}"
+        if p.function_args:
+            return f"{surface}({_args_str(p.function_args)},{sq})"
+        return f"{surface}({sq})"
+    if isinstance(p, L.TopLevelSubquery):
+        return to_promql(p.inner)
+    raise ValueError(f"no PromQL form for {type(p).__name__}")

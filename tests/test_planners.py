@@ -1,0 +1,250 @@
+"""Planner hierarchy tests with golden plan trees (model: reference
+LongTimeRangePlannerSpec, HighAvailabilityPlannerSpec,
+MultiPartitionPlannerSpec, ShardKeyRegexPlannerSpec — printTree golden
+assertions + execution checks)."""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.coordinator.planner import QueryEngine, SingleClusterPlanner
+from filodb_tpu.coordinator.planners import (
+    DownsampleClusterPlanner,
+    FailureTimeRange,
+    HighAvailabilityPlanner,
+    LongTimeRangePlanner,
+    MultiPartitionPlanner,
+    PartitionAssignment,
+    PromQlRemoteExec,
+    ShardKeyRegexPlanner,
+    SinglePartitionPlanner,
+)
+from filodb_tpu.core.schemas import Dataset
+from filodb_tpu.downsample.downsampler import DS_GAUGE, ShardDownsampler
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.memstore.shard import StoreConfig
+from filodb_tpu.query.exec.plans import QueryContext, StitchRvsExec
+from filodb_tpu.query.promql import query_range_to_logical_plan
+from filodb_tpu.query.unparse import to_promql
+from filodb_tpu.testkit import machine_metrics
+
+BASE = 1_600_000_000_000
+
+
+def make_ms(n_series=6, n_samples=400):
+    ms = TimeSeriesMemStore(StoreConfig(max_chunk_size=100))
+    ms.setup(Dataset("prometheus"), range(2))
+    ms.ingest_routed(
+        "prometheus", machine_metrics(n_series=n_series, n_samples=n_samples, start_ms=BASE), spread=1
+    )
+    return ms
+
+
+class TestUnparse:
+    @pytest.mark.parametrize("q", [
+        "sum(rate(http_requests_total[5m]))",
+        'sum by (job) (rate(cpu{env="prod"}[5m]))',
+        "histogram_quantile(0.9,rate(lat[5m]))",
+        "(a + b)",
+        "topk(5,cpu)",
+        "quantile_over_time(0.99,m[10m])",
+        "(cpu > bool 10)",
+        "max_over_time(rate(cpu[1m])[30m:1m])",
+        'count_values("v",build)',
+        "avg without (inst) (cpu)",
+    ])
+    def test_roundtrip_parses_back(self, q):
+        plan = query_range_to_logical_plan(q, 1000, 2000, 15)
+        s = to_promql(plan)
+        plan2 = query_range_to_logical_plan(s, 1000, 2000, 15)
+        assert to_promql(plan2) == s  # stable fixpoint
+
+
+class TestLongTimeRange:
+    def setup_method(self):
+        self.ms = make_ms()
+        # downsample store: 5m resolution of the same data
+        self.dsm = TimeSeriesMemStore()
+        self.dsm.setup(Dataset("prometheus_5m", schemas=[DS_GAUGE]), range(2))
+        d = ShardDownsampler(self.dsm, "prometheus")
+        for sh in self.ms.shards("prometheus"):
+            for part in sh.partitions.values():
+                part.switch_buffers()
+                d.downsample_chunks(sh.shard_num, part, part.chunks)
+        self.raw = SingleClusterPlanner(self.ms, "prometheus")
+        self.ds = DownsampleClusterPlanner(self.dsm, "prometheus_5m")
+        # raw data "retained" only after BASE+2000s
+        self.boundary = BASE + 2_000_000
+        self.planner = LongTimeRangePlanner(self.raw, self.ds, lambda: self.boundary)
+
+    def test_recent_query_goes_raw(self):
+        plan = query_range_to_logical_plan(
+            "avg_over_time(heap_usage0[5m])", (BASE + 2_500_000) / 1000, (BASE + 3_500_000) / 1000, 60
+        )
+        exec_plan = self.planner.materialize(plan)
+        assert "Stitch" not in exec_plan.print_tree()
+
+    def test_old_query_goes_downsample(self):
+        plan = query_range_to_logical_plan(
+            "avg_over_time(heap_usage0[5m])", (BASE + 300_000) / 1000, (BASE + 1_200_000) / 1000, 60
+        )
+        exec_plan = self.planner.materialize(plan)
+        tree = exec_plan.print_tree()
+        assert "Stitch" not in tree
+        ctx = QueryContext(self.dsm, "prometheus_5m")
+        res = exec_plan.execute(ctx)
+        assert sum(g.n_series for g in res.grids) == 6
+
+    def test_spanning_query_stitches(self):
+        plan = query_range_to_logical_plan(
+            "avg_over_time(heap_usage0[5m])", (BASE + 600_000) / 1000, (BASE + 3_500_000) / 1000, 60
+        )
+        exec_plan = self.planner.materialize(plan)
+        assert isinstance(exec_plan, StitchRvsExec)
+
+
+class TestHighAvailability:
+    def test_no_failures_local(self):
+        ms = make_ms()
+        local = SingleClusterPlanner(ms, "prometheus")
+        ha = HighAvailabilityPlanner(local, "http://buddy:9090", lambda: [])
+        plan = query_range_to_logical_plan("heap_usage0", (BASE + 600_000) / 1000, (BASE + 1_200_000) / 1000, 60)
+        assert "Remote" not in ha.materialize(plan).print_tree()
+
+    def test_failure_window_routes_remote(self):
+        ms = make_ms()
+        local = SingleClusterPlanner(ms, "prometheus")
+        fail = FailureTimeRange(BASE + 600_000, BASE + 900_000)
+        ha = HighAvailabilityPlanner(local, "http://buddy:9090", lambda: [fail])
+        plan = query_range_to_logical_plan(
+            "sum(rate(heap_usage0[5m]))", (BASE + 300_000) / 1000, (BASE + 1_800_000) / 1000, 60
+        )
+        exec_plan = ha.materialize(plan)
+        tree = exec_plan.print_tree()
+        assert "PromQlRemoteExec" in tree and "Stitch" in tree
+        remotes = [c for c in exec_plan.child_plans if isinstance(c, PromQlRemoteExec)]
+        assert remotes and remotes[0].endpoint == "http://buddy:9090"
+        assert "rate(" in remotes[0].promql
+
+    def test_total_failure_all_remote(self):
+        ms = make_ms()
+        local = SingleClusterPlanner(ms, "prometheus")
+        fail = FailureTimeRange(BASE, BASE + 10**9)
+        ha = HighAvailabilityPlanner(local, "http://buddy:9090", lambda: [fail])
+        plan = query_range_to_logical_plan("heap_usage0", (BASE + 600_000) / 1000, (BASE + 1_200_000) / 1000, 60)
+        exec_plan = ha.materialize(plan)
+        assert isinstance(exec_plan, PromQlRemoteExec)
+
+
+class TestMultiPartition:
+    def test_local_partition_plans_locally(self):
+        ms = make_ms()
+        local = SingleClusterPlanner(ms, "prometheus")
+
+        def locate(keys):
+            return PartitionAssignment("local", None)
+
+        mp = MultiPartitionPlanner(local, locate)
+        plan = query_range_to_logical_plan("heap_usage0", (BASE + 600_000) / 1000, (BASE + 1_200_000) / 1000, 60)
+        assert "Remote" not in mp.materialize(plan).print_tree()
+
+    def test_foreign_partition_goes_remote(self):
+        ms = make_ms()
+        local = SingleClusterPlanner(ms, "prometheus")
+
+        def locate(keys):
+            if keys.get("_ns_") == "App-2":
+                return PartitionAssignment("remote-1", "http://other:9090")
+            return PartitionAssignment("local", None)
+
+        mp = MultiPartitionPlanner(local, locate)
+        plan = query_range_to_logical_plan(
+            'sum(rate(m{_ws_="demo",_ns_="App-2"}[5m]))', (BASE + 600_000) / 1000, (BASE + 1_200_000) / 1000, 60
+        )
+        exec_plan = mp.materialize(plan)
+        assert isinstance(exec_plan, PromQlRemoteExec)
+        assert "sum" in exec_plan.promql and "rate" in exec_plan.promql
+
+    def test_cross_partition_join(self):
+        ms = make_ms()
+        local = SingleClusterPlanner(ms, "prometheus")
+
+        def locate(keys):
+            if keys.get("_ns_") == "other":
+                return PartitionAssignment("remote-1", "http://other:9090")
+            return PartitionAssignment("local", None)
+
+        mp = MultiPartitionPlanner(local, locate)
+        plan = query_range_to_logical_plan(
+            'a{_ns_="App-2"} + b{_ns_="other"}', (BASE + 600_000) / 1000, (BASE + 1_200_000) / 1000, 60
+        )
+        tree = mp.materialize(plan).print_tree()
+        assert "BinaryJoinExec" in tree and "PromQlRemoteExec" in tree
+
+
+class TestShardKeyRegex:
+    def test_regex_expansion_fans_out(self):
+        ms = make_ms()
+        local = SingleClusterPlanner(ms, "prometheus")
+        skr = ShardKeyRegexPlanner(local, lambda key: ["App-0", "App-1", "App-2"])
+        plan = query_range_to_logical_plan(
+            'sum(rate(heap_usage0{_ws_="demo",_ns_=~"App-1|App-2"}[5m]))',
+            (BASE + 600_000) / 1000, (BASE + 1_200_000) / 1000, 60,
+        )
+        exec_plan = skr.materialize(plan)
+        tree = exec_plan.print_tree()
+        assert "AggregatePresentExec" in tree
+        # two concrete _ns_ values -> two subtrees
+        assert tree.count("ReduceAggregateExec") == 2
+
+    def test_no_regex_passthrough(self):
+        ms = make_ms()
+        local = SingleClusterPlanner(ms, "prometheus")
+        skr = ShardKeyRegexPlanner(local, lambda key: ["App-2"])
+        plan = query_range_to_logical_plan(
+            "heap_usage0", (BASE + 600_000) / 1000, (BASE + 1_200_000) / 1000, 60)
+        res = skr.materialize(plan).execute(QueryContext(ms, "prometheus"))
+        assert sum(g.n_series for g in res.grids) == 6
+
+    def test_regex_execution_correct(self):
+        ms = make_ms()
+        local = SingleClusterPlanner(ms, "prometheus")
+        skr = ShardKeyRegexPlanner(local, lambda key: ["App-2", "App-X"])
+        plan = query_range_to_logical_plan(
+            'sum(avg_over_time(heap_usage0{_ns_=~"App-.*"}[5m]))',
+            (BASE + 600_000) / 1000, (BASE + 1_200_000) / 1000, 60,
+        )
+        res = skr.materialize(plan).execute(QueryContext(ms, "prometheus"))
+        # only App-2 has data; result identical to direct query
+        want = QueryEngine(ms, "prometheus").query_range(
+            "sum(avg_over_time(heap_usage0[5m]))", (BASE + 600_000) / 1000, (BASE + 1_200_000) / 1000, 60
+        )
+        np.testing.assert_allclose(
+            res.grids[0].values_np(), want.grids[0].values_np(), rtol=1e-5, equal_nan=True
+        )
+
+
+class TestSinglePartitionPlanner:
+    def test_picks_by_metric(self):
+        ms = make_ms()
+        a = SingleClusterPlanner(ms, "prometheus")
+        b = SingleClusterPlanner(ms, "prometheus")
+        calls = []
+
+        class Spy:
+            def __init__(self, name, inner):
+                self.name, self.inner = name, inner
+
+            def materialize(self, plan):
+                calls.append(self.name)
+                return self.inner.materialize(plan)
+
+        spp = SinglePartitionPlanner(
+            {"a": Spy("a", a), "b": Spy("b", b)},
+            pick=lambda plan: "b" if any(
+                f.value == "special" for rs in __import__("filodb_tpu.query.logical", fromlist=["leaf_raw_series"]).leaf_raw_series(plan) for f in rs.filters
+            ) else "a",
+            default="a",
+        )
+        plan = query_range_to_logical_plan("special", (BASE + 600_000) / 1000, (BASE + 1_200_000) / 1000, 60)
+        spp.materialize(plan)
+        assert calls == ["b"]
