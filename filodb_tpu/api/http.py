@@ -144,6 +144,10 @@ class PromApiHandler(BaseHTTPRequestHandler):
                 return self._send(200, J.success({"version": __version__, "application": "filodb-tpu"}))
             if path == "/admin/health":
                 return self._send(200, {"status": "healthy", "shards": len(self.engine.memstore.shards(self.engine.dataset))})
+            if path == "/metrics":
+                return self._metrics()
+            if path == "/api/v1/cardinality":
+                return self._cardinality()
             if path == "/ingest":
                 return self._ingest()
             self._send(404, J.error("not_found", f"unknown path {path}"))
@@ -232,6 +236,46 @@ class PromApiHandler(BaseHTTPRequestHandler):
                 self.engine.dataset, filters, int(start * 1000), int(end * 1000), limit=10000
             ):
                 out.append(J._labels_out(dict(tags)))
+        return self._send(200, J.success(out))
+
+    def _metrics(self):
+        """Prometheus exposition of internal metrics + per-shard stats
+        (reference TimeSeriesShardStats gauges + Kamon reporters)."""
+        from ..metrics import REGISTRY
+
+        ds = self.engine.dataset
+        for sh in self.engine.memstore.shards(ds):
+            for name, v in (
+                ("filodb_shard_partitions", sh.num_partitions),
+                ("filodb_shard_rows_ingested", sh.stats.rows_ingested),
+                ("filodb_shard_rows_skipped", sh.stats.rows_skipped),
+                ("filodb_shard_partitions_evicted", sh.stats.partitions_evicted),
+                ("filodb_shard_chunks_flushed", sh.stats.chunks_flushed),
+            ):
+                REGISTRY.gauge(name, dataset=ds, shard=str(sh.shard_num)).set(float(v))
+        body = REGISTRY.expose().encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/plain; version=0.0.4")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _cardinality(self):
+        """Per-shard-key-prefix cardinality scan (reference TsCardinalities
+        metadata plan + /api/v1/metering endpoints)."""
+        p = self._params()
+        prefix = [x for x in (self._q(p, "prefix", "") or "").split(",") if x]
+        depth = int(self._q(p, "depth", str(len(prefix) + 1)))
+        merged: dict[tuple, dict] = {}
+        for sh in self.engine.memstore.shards(self.engine.dataset):
+            for rec in sh.cardinality.scan(prefix, depth):
+                slot = merged.setdefault(
+                    rec.prefix, {"prefix": list(rec.prefix), "ts_count": 0, "active": 0, "children": 0}
+                )
+                slot["ts_count"] += rec.ts_count
+                slot["active"] += rec.active_ts_count
+                slot["children"] = max(slot["children"], rec.children)
+        out = sorted(merged.values(), key=lambda r: -r["ts_count"])
         return self._send(200, J.success(out))
 
     def _ingest(self):

@@ -265,12 +265,21 @@ class QueryEngine:
         return QueryContext(self.memstore, self.dataset)
 
     def query_range(self, promql: str, start_s: float, end_s: float, step_s: float):
+        import time as _time
+
+        from ..metrics import REGISTRY
+
+        t0 = _time.perf_counter()
         plan = query_range_to_logical_plan(promql, start_s, end_s, step_s,
                                            self.planner.params.lookback_ms)
         exec_plan = self.planner.materialize(plan)
         res = exec_plan.execute(self.context())
         if res.result_type == "matrix" or res.grids:
             res.result_type = "matrix"
+        REGISTRY.counter("filodb_queries", dataset=self.dataset).inc()
+        REGISTRY.histogram("filodb_query_latency_seconds", dataset=self.dataset).observe(
+            _time.perf_counter() - t0
+        )
         return res
 
     def query_instant(self, promql: str, time_s: float):

@@ -1,0 +1,139 @@
+"""Cardinality tracking & quotas (reference L2 ratelimit/:
+CardinalityTracker.scala:35 — a trie over shard-key prefixes counting active
+and total time series, with per-prefix quotas enforced at partition
+creation; RocksDbCardinalityStore persistence; CardinalityManager;
+TenantIngestionMetering emits per-tenant metrics).
+
+Host-side trie keyed by (_ws_, _ns_, _metric_) prefixes. The store here is
+in-memory with JSON snapshot persistence (RocksDB analog).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Mapping, Sequence
+
+from ..core.schemas import METRIC_TAG, SHARD_KEY_TAGS
+
+
+class QuotaExceededError(Exception):
+    def __init__(self, prefix, quota):
+        super().__init__(f"cardinality quota {quota} exceeded at prefix {prefix}")
+        self.prefix = prefix
+        self.quota = quota
+
+
+@dataclass
+class CardinalityRecord:
+    """Counts at one trie node (reference CardinalityRecord)."""
+
+    prefix: tuple[str, ...]
+    ts_count: int = 0  # total series ever
+    active_ts_count: int = 0  # currently ingesting
+    children: int = 0  # immediate child prefixes
+
+
+class CardinalityTracker:
+    """Trie of shard-key prefixes -> counts + quotas."""
+
+    def __init__(self, shard_key_len: int = 3):
+        self.shard_key_len = shard_key_len
+        self._counts: dict[tuple[str, ...], CardinalityRecord] = {}
+        self._child_names: dict[tuple[str, ...], set[str]] = {}
+        self._quotas: dict[tuple[str, ...], int] = {}
+        self.default_quota: int | None = None
+
+    def _prefixes(self, tags: Mapping[str, str]):
+        keys = [tags.get(k, "") for k in SHARD_KEY_TAGS[: self.shard_key_len]]
+        for i in range(self.shard_key_len + 1):
+            yield tuple(keys[:i])
+
+    def set_quota(self, prefix: Sequence[str], quota: int) -> None:
+        self._quotas[tuple(prefix)] = quota
+
+    def quota_of(self, prefix: tuple[str, ...]) -> int | None:
+        return self._quotas.get(prefix, self.default_quota if prefix else None)
+
+    # -- updates ----------------------------------------------------------
+
+    def series_created(self, tags: Mapping[str, str]) -> None:
+        """Called at partition creation (reference modifyCount). Raises
+        QuotaExceededError BEFORE counting when a prefix is at quota."""
+        prefixes = list(self._prefixes(tags))
+        for p in prefixes:
+            q = self.quota_of(p)
+            rec = self._counts.get(p)
+            if q is not None and rec is not None and rec.ts_count >= q:
+                raise QuotaExceededError(p, q)
+        for i, p in enumerate(prefixes):
+            rec = self._counts.get(p)
+            if rec is None:
+                rec = CardinalityRecord(p)
+                self._counts[p] = rec
+                if i > 0:
+                    parent = prefixes[i - 1]
+                    names = self._child_names.setdefault(parent, set())
+                    if p[-1] not in names:
+                        names.add(p[-1])
+                        self._counts[parent].children += 1
+            rec.ts_count += 1
+            rec.active_ts_count += 1
+
+    def series_stopped(self, tags: Mapping[str, str]) -> None:
+        for p in self._prefixes(tags):
+            rec = self._counts.get(p)
+            if rec and rec.active_ts_count > 0:
+                rec.active_ts_count -= 1
+
+    def series_removed(self, tags: Mapping[str, str]) -> None:
+        for p in self._prefixes(tags):
+            rec = self._counts.get(p)
+            if rec:
+                rec.ts_count = max(rec.ts_count - 1, 0)
+                rec.active_ts_count = max(rec.active_ts_count - 1, 0)
+
+    # -- queries (reference TsCardinalities exec) -------------------------
+
+    def scan(self, prefix: Sequence[str], depth: int) -> list[CardinalityRecord]:
+        """All records at the given depth under prefix."""
+        prefix = tuple(prefix)
+        out = []
+        for p, rec in self._counts.items():
+            if len(p) == depth and p[: len(prefix)] == prefix:
+                out.append(rec)
+        out.sort(key=lambda r: -r.ts_count)
+        return out
+
+    def record_of(self, prefix: Sequence[str]) -> CardinalityRecord | None:
+        return self._counts.get(tuple(prefix))
+
+    # -- persistence (RocksDB store analog) -------------------------------
+
+    def save(self, path: str) -> None:
+        data = {
+            "quotas": {"|".join(k): v for k, v in self._quotas.items()},
+            "counts": [
+                {"p": list(r.prefix), "t": r.ts_count, "a": r.active_ts_count, "c": r.children}
+                for r in self._counts.values()
+            ],
+        }
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(data, f)
+        os.replace(tmp, path)
+
+    @classmethod
+    def load(cls, path: str, shard_key_len: int = 3) -> "CardinalityTracker":
+        t = cls(shard_key_len)
+        if not os.path.exists(path):
+            return t
+        with open(path) as f:
+            data = json.load(f)
+        for k, v in data.get("quotas", {}).items():
+            t._quotas[tuple(k.split("|")) if k else ()] = v
+        for rec in data.get("counts", []):
+            p = tuple(rec["p"])
+            t._counts[p] = CardinalityRecord(p, rec["t"], rec["a"], rec["c"])
+        return t

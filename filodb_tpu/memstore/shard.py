@@ -61,6 +61,9 @@ class TimeSeriesShard:
         self._by_partkey: dict[bytes, int] = {}
         self._next_part_id = 0
         self.stats = ShardStats()
+        from .cardinality import CardinalityTracker
+
+        self.cardinality = CardinalityTracker()
         self._lock = threading.RLock()
         self._ingested_offset = -1  # stream offset watermark (Kafka analog)
         # data version for query-side staging caches: bumped on every ingest
@@ -113,6 +116,9 @@ class TimeSeriesShard:
         """reference createNewPartition:1193 + index addPartKey + cardinality."""
         if len(self.partitions) >= self.config.max_partitions:
             raise MemoryError(f"shard {self.shard_num}: partition limit reached")
+        # quota enforcement happens BEFORE any state mutates (reference
+        # CardinalityTracker.modifyCount at createNewPartition)
+        self.cardinality.series_created(tags)
         pid = self._next_part_id
         self._next_part_id += 1
         part = TimeSeriesPartition(
@@ -187,6 +193,7 @@ class TimeSeriesShard:
                 part = self.partitions.pop(pid)
                 self._by_partkey.pop(part.partkey, None)
                 self.index.remove([pid])
+                self.cardinality.series_removed(part.tags)
                 self.stats.partitions_evicted += 1
         return dropped
 

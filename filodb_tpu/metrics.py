@@ -1,0 +1,199 @@
+"""Metrics, tracing, profiling (reference aux subsystems, SURVEY.md §5:
+core/.../metrics/FilodbMetrics.scala Kamon facade + OTel export;
+Kamon spans threading ExecPlan.execute; standalone SimpleProfiler.java:19
+sampling profiler).
+
+- ``Registry``: counters / gauges / histograms with Prometheus text
+  exposition (served at /metrics by the HTTP API).
+- ``span``: lightweight tracing context manager; spans accumulate into the
+  per-query stats and an optional global trace log.
+- ``SamplingProfiler``: periodic stack sampler over all threads (the
+  SimpleProfiler analog) with top-of-stack aggregation.
+"""
+
+from __future__ import annotations
+
+import bisect
+import contextlib
+import sys
+import threading
+import time
+import traceback
+from collections import Counter, defaultdict
+from dataclasses import dataclass, field
+
+
+class Counter_:
+    __slots__ = ("value", "_lock")
+
+    def __init__(self):
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0):
+        with self._lock:
+            self.value += amount
+
+
+class Gauge:
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+    def set(self, v: float):
+        self.value = v
+
+
+class Histogram:
+    """Fixed-bucket latency histogram (seconds)."""
+
+    BOUNDS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+    def __init__(self):
+        self.counts = [0] * (len(self.BOUNDS) + 1)
+        self.sum = 0.0
+        self.total = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float):
+        i = bisect.bisect_left(self.BOUNDS, v)
+        with self._lock:
+            self.counts[i] += 1
+            self.sum += v
+            self.total += 1
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: dict[tuple[str, tuple], object] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, cls, name: str, labels: dict | None):
+        key = (name, tuple(sorted((labels or {}).items())))
+        with self._lock:
+            m = self._metrics.get(key)
+            if m is None:
+                m = cls()
+                self._metrics[key] = m
+            return m
+
+    def counter(self, name: str, **labels) -> Counter_:
+        return self._get(Counter_, name, labels)
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        return self._get(Gauge, name, labels)
+
+    def histogram(self, name: str, **labels) -> Histogram:
+        return self._get(Histogram, name, labels)
+
+    def expose(self) -> str:
+        """Prometheus text exposition of everything registered."""
+        lines = []
+        for (name, labels), m in sorted(self._metrics.items(), key=lambda kv: kv[0][0]):
+            lbl = "{" + ",".join(f'{k}="{v}"' for k, v in labels) + "}" if labels else ""
+            if isinstance(m, Counter_):
+                lines.append(f"{name}_total{lbl} {m.value:g}")
+            elif isinstance(m, Gauge):
+                lines.append(f"{name}{lbl} {m.value:g}")
+            elif isinstance(m, Histogram):
+                base = [f'{k}="{v}"' for k, v in labels]
+                cum = 0
+                for b, c in zip(m.BOUNDS, m.counts):
+                    cum += c
+                    inner = ",".join(base + [f'le="{b:g}"'])
+                    lines.append(f"{name}_bucket{{{inner}}} {cum}")
+                inner = ",".join(base + ['le="+Inf"'])
+                lines.append(f"{name}_bucket{{{inner}}} {m.total}")
+                lines.append(f"{name}_sum{lbl} {m.sum:g}")
+                lines.append(f"{name}_count{lbl} {m.total}")
+        return "\n".join(lines) + "\n"
+
+
+REGISTRY = Registry()
+
+# -- tracing ----------------------------------------------------------------
+
+_trace_local = threading.local()
+
+
+@dataclass
+class Span:
+    name: str
+    start_ns: int
+    end_ns: int = 0
+    children: list = field(default_factory=list)
+
+    @property
+    def duration_ms(self) -> float:
+        return (self.end_ns - self.start_ns) / 1e6
+
+    def tree(self, depth=0) -> str:
+        out = [f"{'  ' * depth}{self.name}: {self.duration_ms:.2f}ms"]
+        for c in self.children:
+            out.append(c.tree(depth + 1))
+        return "\n".join(out)
+
+
+@contextlib.contextmanager
+def span(name: str):
+    """Nested timing spans (Kamon.runWithSpan analog). The root span of a
+    thread is retrievable via current_trace()."""
+    s = Span(name, time.perf_counter_ns())
+    parent = getattr(_trace_local, "current", None)
+    if parent is not None:
+        parent.children.append(s)
+    else:
+        _trace_local.root = s
+    _trace_local.current = s
+    try:
+        yield s
+    finally:
+        s.end_ns = time.perf_counter_ns()
+        _trace_local.current = parent
+
+
+def current_trace() -> Span | None:
+    return getattr(_trace_local, "root", None)
+
+
+# -- sampling profiler ------------------------------------------------------
+
+
+class SamplingProfiler:
+    """Periodic all-thread stack sampler (reference SimpleProfiler.java:19,
+    launched at server start with config filodb.profiler)."""
+
+    def __init__(self, interval_s: float = 0.01, top_frames: int = 1):
+        self.interval_s = interval_s
+        self.top_frames = top_frames
+        self.samples: Counter = Counter()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self):
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=1)
+
+    def _run(self):
+        me = threading.get_ident()
+        while not self._stop.wait(self.interval_s):
+            for tid, frame in sys._current_frames().items():
+                if tid == me:
+                    continue
+                stack = traceback.extract_stack(frame, limit=self.top_frames + 4)
+                if not stack:
+                    continue
+                top = stack[-1]
+                self.samples[f"{top.name} ({top.filename.rsplit('/', 1)[-1]}:{top.lineno})"] += 1
+
+    def report(self, n: int = 20) -> str:
+        total = sum(self.samples.values()) or 1
+        lines = [f"{cnt / total * 100:5.1f}%  {name}" for name, cnt in self.samples.most_common(n)]
+        return "\n".join(lines)

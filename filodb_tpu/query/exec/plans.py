@@ -49,10 +49,14 @@ class ExecPlan:
         self.transformers = []
 
     def execute(self, ctx: QueryContext) -> QueryResult:
+        from ...metrics import span
+
         t0 = time.perf_counter_ns()
-        res = self.do_execute(ctx)
-        for tr in self.transformers:
-            res = apply_transformer(tr, res, ctx)
+        with span(type(self).__name__):
+            res = self.do_execute(ctx)
+            for tr in self.transformers:
+                with span(type(tr).__name__):
+                    res = apply_transformer(tr, res, ctx)
         ctx.stats.cpu_ns += time.perf_counter_ns() - t0
         return res
 

@@ -1,0 +1,158 @@
+"""Metrics, cardinality, tracing, profiler tests (model: reference
+CardinalityTracker specs + TimeSeriesShardStats assertions)."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from filodb_tpu.api.http import serve_background
+from filodb_tpu.coordinator.planner import QueryEngine
+from filodb_tpu.core.schemas import Dataset
+from filodb_tpu.memstore.cardinality import CardinalityTracker, QuotaExceededError
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.metrics import REGISTRY, Registry, SamplingProfiler, current_trace, span
+from filodb_tpu.testkit import machine_metrics
+
+BASE = 1_600_000_000_000
+
+
+class TestCardinalityTracker:
+    def test_counts_by_prefix(self):
+        t = CardinalityTracker()
+        for i in range(10):
+            t.series_created({"_ws_": "demo", "_ns_": f"app-{i % 2}", "_metric_": f"m{i}"})
+        assert t.record_of(()).ts_count == 10
+        assert t.record_of(("demo",)).ts_count == 10
+        assert t.record_of(("demo", "app-0")).ts_count == 5
+        assert t.record_of(("demo",)).children == 2
+
+    def test_quota_enforced(self):
+        t = CardinalityTracker()
+        t.set_quota(("demo", "app"), 3)
+        for i in range(3):
+            t.series_created({"_ws_": "demo", "_ns_": "app", "_metric_": f"m{i}"})
+        with pytest.raises(QuotaExceededError):
+            t.series_created({"_ws_": "demo", "_ns_": "app", "_metric_": "m99"})
+        # other namespaces unaffected
+        t.series_created({"_ws_": "demo", "_ns_": "other", "_metric_": "ok"})
+
+    def test_active_vs_total(self):
+        t = CardinalityTracker()
+        tags = {"_ws_": "w", "_ns_": "n", "_metric_": "m"}
+        t.series_created(tags)
+        t.series_stopped(tags)
+        rec = t.record_of(("w", "n", "m"))
+        assert rec.ts_count == 1 and rec.active_ts_count == 0
+
+    def test_scan_depth(self):
+        t = CardinalityTracker()
+        for ns in ("a", "b", "c"):
+            for i in range(int(ns == "a") * 2 + 1):
+                t.series_created({"_ws_": "w", "_ns_": ns, "_metric_": f"m{i}"})
+        recs = t.scan(("w",), 2)
+        assert [r.prefix[-1] for r in recs][0] == "a"  # sorted by count desc
+
+    def test_save_load(self, tmp_path):
+        t = CardinalityTracker()
+        t.set_quota(("w",), 100)
+        t.series_created({"_ws_": "w", "_ns_": "n", "_metric_": "m"})
+        p = str(tmp_path / "card.json")
+        t.save(p)
+        t2 = CardinalityTracker.load(p)
+        assert t2.record_of(("w", "n")).ts_count == 1
+        assert t2.quota_of(("w",)) == 100
+
+    def test_shard_integration(self):
+        ms = TimeSeriesMemStore()
+        ms.setup(Dataset("ds"), [0])
+        ms.ingest("ds", 0, machine_metrics(n_series=7, n_samples=5, start_ms=BASE))
+        sh = ms.shard("ds", 0)
+        assert sh.cardinality.record_of(()).ts_count == 7
+
+
+class TestMetricsRegistry:
+    def test_counter_gauge_histogram_expose(self):
+        r = Registry()
+        r.counter("reqs", code="200").inc(5)
+        r.gauge("up").set(1)
+        r.histogram("lat").observe(0.003)
+        r.histogram("lat").observe(0.3)
+        text = r.expose()
+        assert 'reqs_total{code="200"} 5' in text
+        assert "up 1" in text
+        assert 'lat_bucket{le="0.005"} 1' in text
+        assert 'lat_bucket{le="+Inf"} 2' in text
+        assert "lat_count 2" in text
+
+    def test_metrics_endpoint(self):
+        ms = TimeSeriesMemStore()
+        ms.setup(Dataset("prometheus"), [0])
+        ms.ingest("prometheus", 0, machine_metrics(n_series=3, n_samples=50, start_ms=BASE))
+        engine = QueryEngine(ms, "prometheus")
+        engine.query_range("heap_usage0", (BASE + 600_000) / 1000, (BASE + 900_000) / 1000, 60)
+        srv, port = serve_background(engine)
+        try:
+            with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics") as r:
+                text = r.read().decode()
+            assert "filodb_shard_partitions" in text
+            assert "filodb_queries_total" in text
+            assert "filodb_query_latency_seconds_bucket" in text
+        finally:
+            srv.shutdown()
+
+    def test_cardinality_endpoint(self):
+        ms = TimeSeriesMemStore()
+        ms.setup(Dataset("prometheus"), [0, 1])
+        ms.ingest_routed("prometheus", machine_metrics(n_series=10, n_samples=5, start_ms=BASE), spread=1)
+        srv, port = serve_background(QueryEngine(ms, "prometheus"))
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/api/v1/cardinality?prefix=demo&depth=2"
+            ) as r:
+                out = json.loads(r.read())
+            assert out["data"][0]["prefix"] == ["demo", "App-2"]
+            assert out["data"][0]["ts_count"] == 10
+        finally:
+            srv.shutdown()
+
+
+class TestTracing:
+    def test_nested_spans(self):
+        with span("root") as root:
+            with span("child1"):
+                time.sleep(0.01)
+            with span("child2"):
+                pass
+        assert len(root.children) == 2
+        assert root.duration_ms >= root.children[0].duration_ms
+        assert "child1" in root.tree()
+
+    def test_exec_plans_emit_spans(self):
+        ms = TimeSeriesMemStore()
+        ms.setup(Dataset("prometheus"), [0])
+        ms.ingest("prometheus", 0, machine_metrics(n_series=2, n_samples=50, start_ms=BASE))
+        engine = QueryEngine(ms, "prometheus")
+        with span("query") as root:
+            engine.query_range("sum(heap_usage0)", (BASE + 600_000) / 1000, (BASE + 900_000) / 1000, 60)
+        names = [c.name for c in root.children]
+        assert "ReduceAggregateExec" in names
+
+
+class TestProfiler:
+    def test_sampling_profiler_catches_busy_thread(self):
+        def busy():
+            end = time.time() + 0.4
+            while time.time() < end:
+                sum(range(1000))
+
+        t = threading.Thread(target=busy)
+        prof = SamplingProfiler(interval_s=0.005)
+        prof.start()
+        t.start()
+        t.join()
+        prof.stop()
+        assert "busy" in prof.report()
