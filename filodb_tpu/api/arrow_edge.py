@@ -1,0 +1,144 @@
+"""Arrow columnar serving edge + Flight transport (reference L5:
+coordinator/.../flight/ — FiloDBFlightProducer.scala:27, FlightQueryExecutor
+:40, FlightClientManager, ArrowSerializedRangeVectorOps; result model
+ArrowSerializedRangeVector, core/.../query/RangeVector.scala:636).
+
+Grids serialize to Arrow RecordBatches: one row per series, label set as a
+JSON utf8 column, values as a FixedSizeList<float32>[num_steps]; the step
+grid rides in schema metadata. Zero-copy on the wire via Arrow IPC; the
+Flight server executes PromQL range queries for peers (the intra-cluster
+columnar transport the reference uses between query nodes; device-mesh
+clusters use psum instead — Flight remains for cross-cluster/serving edges).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+import numpy as np
+import pyarrow as pa
+
+from ..query.rangevector import Grid, QueryResult
+
+
+def grid_to_record_batch(g: Grid) -> pa.RecordBatch:
+    vals = np.ascontiguousarray(g.values_np(), dtype=np.float32)
+    n, j = vals.shape
+    labels = pa.array([json.dumps(l, sort_keys=True) for l in g.labels], type=pa.utf8())
+    flat = pa.array(vals.ravel(), type=pa.float32())
+    values = pa.FixedSizeListArray.from_arrays(flat, j)
+    schema = pa.schema(
+        [pa.field("labels", pa.utf8()), pa.field("values", pa.list_(pa.float32(), j))],
+        metadata={
+            b"start_ms": str(g.start_ms).encode(),
+            b"step_ms": str(g.step_ms).encode(),
+            b"num_steps": str(g.num_steps).encode(),
+        },
+    )
+    return pa.RecordBatch.from_arrays([labels, values], schema=schema)
+
+
+def record_batch_to_grid(rb: pa.RecordBatch) -> Grid:
+    md = rb.schema.metadata or {}
+    start_ms = int(md[b"start_ms"])
+    step_ms = int(md[b"step_ms"])
+    num_steps = int(md[b"num_steps"])
+    labels = [json.loads(s) for s in rb.column("labels").to_pylist()]
+    lst = rb.column("values")
+    width = lst.type.list_size
+    vals = np.asarray(lst.flatten()).reshape(len(labels), width)
+    return Grid(labels, start_ms, step_ms, num_steps, vals)
+
+
+def result_to_ipc(res: QueryResult) -> bytes:
+    """All grids as one Arrow IPC stream (batch per grid)."""
+    sink = pa.BufferOutputStream()
+    writer = None
+    for g in res.grids:
+        rb = grid_to_record_batch(g)
+        if writer is None:
+            writer = pa.ipc.new_stream(sink, rb.schema)
+        writer.write_batch(rb)
+    if writer is None:  # empty result: write an empty schema stream
+        schema = pa.schema([pa.field("labels", pa.utf8())])
+        writer = pa.ipc.new_stream(sink, schema)
+    writer.close()
+    return sink.getvalue().to_pybytes()
+
+
+def ipc_to_result(data: bytes) -> QueryResult:
+    reader = pa.ipc.open_stream(pa.BufferReader(data))
+    grids = []
+    for rb in reader:
+        if rb.num_columns >= 2:
+            grids.append(record_batch_to_grid(rb))
+    return QueryResult(grids=grids)
+
+
+# ---------------------------------------------------------------------------
+# Flight server / client
+# ---------------------------------------------------------------------------
+
+try:  # pyarrow.flight needs grpc support compiled in
+    import pyarrow.flight as _flight
+
+    HAVE_FLIGHT = True
+except Exception:  # pragma: no cover
+    _flight = None
+    HAVE_FLIGHT = False
+
+
+if HAVE_FLIGHT:
+
+    class FlightQueryServer(_flight.FlightServerBase):
+        """Executes PromQL range queries for Flight peers (reference
+        FiloDBFlightProducer + FlightQueryExecutor). Ticket = JSON
+        {"query", "start", "end", "step"}."""
+
+        def __init__(self, engine, location="grpc://127.0.0.1:0"):
+            super().__init__(location)
+            self.engine = engine
+
+        def do_get(self, context, ticket):
+            req = json.loads(ticket.ticket.decode())
+            res = self.engine.query_range(
+                req["query"], float(req["start"]), float(req["end"]), float(req["step"])
+            )
+            batches = [grid_to_record_batch(g) for g in res.grids]
+            if not batches:
+                schema = pa.schema(
+                    [pa.field("labels", pa.utf8()), pa.field("values", pa.list_(pa.float32(), 1))],
+                    metadata={b"start_ms": b"0", b"step_ms": b"1", b"num_steps": b"0"},
+                )
+                return _flight.RecordBatchStream(pa.Table.from_batches([], schema=schema))
+            table = pa.Table.from_batches(batches, schema=batches[0].schema)
+            return _flight.RecordBatchStream(table)
+
+    class FlightQueryClient:
+        """Pooled client (reference FlightClientManager)."""
+
+        _clients: dict[str, "_flight.FlightClient"] = {}
+        _lock = threading.Lock()
+
+        @classmethod
+        def get(cls, endpoint: str) -> "_flight.FlightClient":
+            with cls._lock:
+                c = cls._clients.get(endpoint)
+                if c is None:
+                    c = _flight.FlightClient(endpoint)
+                    cls._clients[endpoint] = c
+                return c
+
+        @classmethod
+        def query_range(cls, endpoint, query, start_s, end_s, step_s) -> QueryResult:
+            ticket = _flight.Ticket(
+                json.dumps({"query": query, "start": start_s, "end": end_s, "step": step_s}).encode()
+            )
+            reader = cls.get(endpoint).do_get(ticket)
+            grids = []
+            for chunk in reader:
+                rb = chunk.data
+                if rb.num_rows:
+                    grids.append(record_batch_to_grid(rb))
+            return QueryResult(grids=grids)

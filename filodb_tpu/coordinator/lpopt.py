@@ -1,0 +1,129 @@
+"""Pre-aggregation rewrite (reference L4 lpopt/:
+AggLpOptimization.optimizeWithPreaggregatedDataset (AggLpOptimization.scala:36),
+rule model IncludeAggRule/ExcludeAggRule
+(query/util/HierarchicalQueryExperience.scala:28)).
+
+When an aggregation's grouping labels are covered by a pre-aggregated
+dataset's dimensions (maintained by streaming aggregation jobs), the query
+can read the much-smaller preagg metric instead of raw series. Example rule:
+metric ``http_requests_total`` preaggregated over {job, code} as
+``http_requests_total:agg`` — then ``sum by (job) (rate(m[5m]))`` rewrites
+the selector to the preagg metric; ``sum by (instance) (...)`` does not
+(instance isn't a preagg dimension).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Sequence
+
+from ..core.filters import ColumnFilter
+from ..core.schemas import METRIC_TAG
+from ..query import logical as L
+
+# aggregation ops safe to serve from a sum-preagg (reference supports the
+# additive ops; avg works because preagg keeps sum&count semantics via
+# the ::suffix columns — here we preagg per-op datasets)
+_REWRITABLE_OPS = {"sum", "count", "min", "max"}
+
+
+@dataclass(frozen=True)
+class IncludeAggRule:
+    """Metric is preaggregated retaining ONLY these tags."""
+
+    metric_regex: str
+    include_tags: frozenset[str]
+    suffix: str = ":agg"
+
+    def dims(self):
+        return self.include_tags
+
+    def covers(self, labels: Sequence[str]) -> bool:
+        return set(labels) <= self.include_tags
+
+
+@dataclass(frozen=True)
+class ExcludeAggRule:
+    """Metric is preaggregated dropping these tags (keeps the rest)."""
+
+    metric_regex: str
+    exclude_tags: frozenset[str]
+    suffix: str = ":agg"
+
+    def covers(self, labels: Sequence[str]) -> bool:
+        return not (set(labels) & self.exclude_tags)
+
+
+@dataclass
+class AggRuleProvider:
+    rules: list = None
+
+    def __post_init__(self):
+        self.rules = self.rules or []
+
+    def rule_for(self, metric: str):
+        import re
+
+        for r in self.rules:
+            if re.fullmatch(r.metric_regex, metric):
+                return r
+        return None
+
+
+def _metric_of(filters) -> str | None:
+    for f in filters:
+        if f.column == METRIC_TAG and f.op == "=":
+            return f.value
+    return None
+
+
+def _filters_covered(rule, filters) -> bool:
+    """Every non-shard-key filter tag must survive preaggregation."""
+    for f in filters:
+        if f.column in (METRIC_TAG, "_ws_", "_ns_"):
+            continue
+        if isinstance(rule, IncludeAggRule) and f.column not in rule.include_tags:
+            return False
+        if isinstance(rule, ExcludeAggRule) and f.column in rule.exclude_tags:
+            return False
+    return True
+
+
+def optimize_with_preagg(plan: L.LogicalPlan, provider: AggRuleProvider) -> L.LogicalPlan:
+    """Rewrite Aggregate(RawSeries...) subtrees to preagg metrics when the
+    rule covers both the grouping labels and the filters."""
+    if isinstance(plan, L.Aggregate):
+        if plan.op in _REWRITABLE_OPS and plan.by is not None:
+            rewritten = _try_rewrite(plan, provider)
+            if rewritten is not None:
+                return rewritten
+        return replace(plan, inner=optimize_with_preagg(plan.inner, provider))
+    kw = {}
+    for f in getattr(plan, "__dataclass_fields__", {}):
+        v = getattr(plan, f)
+        if isinstance(v, L.LogicalPlan) and not isinstance(v, L.RawSeries):
+            kw[f] = optimize_with_preagg(v, provider)
+    return replace(plan, **kw) if kw else plan
+
+
+def _try_rewrite(agg: L.Aggregate, provider: AggRuleProvider) -> L.LogicalPlan | None:
+    inner = agg.inner
+    if isinstance(inner, (L.PeriodicSeries, L.PeriodicSeriesWithWindowing)):
+        raw = inner.raw
+        metric = _metric_of(raw.filters)
+        if metric is None:
+            return None
+        rule = provider.rule_for(metric)
+        if rule is None:
+            return None
+        if not rule.covers(agg.by or ()):
+            return None
+        if not _filters_covered(rule, raw.filters):
+            return None
+        new_filters = tuple(
+            ColumnFilter(METRIC_TAG, "=", metric + rule.suffix) if f.column == METRIC_TAG and f.op == "=" else f
+            for f in raw.filters
+        )
+        new_raw = replace(raw, filters=new_filters)
+        return replace(agg, inner=replace(inner, raw=new_raw))
+    return None

@@ -1,0 +1,66 @@
+"""Arrow serialization + Flight transport tests (model: reference
+FlightQueryProducerSpec / FlightClientManagerSpec — in-process Flight
+server round-trips)."""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.api import arrow_edge as AE
+from filodb_tpu.coordinator.planner import QueryEngine
+from filodb_tpu.core.schemas import Dataset
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.query.rangevector import Grid, QueryResult
+from filodb_tpu.testkit import machine_metrics
+
+BASE = 1_600_000_000_000
+
+
+def make_grid(S=5, J=10, seed=0):
+    rng = np.random.default_rng(seed)
+    vals = rng.standard_normal((S, J)).astype(np.float32)
+    vals[0, 3] = np.nan
+    labels = [{"_metric_": "m", "host": f"h{i}"} for i in range(S)]
+    return Grid(labels, BASE, 60_000, J, vals)
+
+
+class TestArrowRoundtrip:
+    def test_record_batch_roundtrip(self):
+        g = make_grid()
+        g2 = AE.record_batch_to_grid(AE.grid_to_record_batch(g))
+        assert g2.labels == g.labels
+        assert g2.start_ms == g.start_ms and g2.step_ms == g.step_ms
+        np.testing.assert_array_equal(g2.values_np(), g.values_np())
+
+    def test_ipc_stream_roundtrip(self):
+        res = QueryResult(grids=[make_grid(seed=1), make_grid(S=3, seed=2)])
+        data = AE.result_to_ipc(res)
+        back = AE.ipc_to_result(data)
+        assert len(back.grids) == 2
+        np.testing.assert_array_equal(back.grids[0].values_np(), res.grids[0].values_np())
+
+    def test_empty_result(self):
+        back = AE.ipc_to_result(AE.result_to_ipc(QueryResult()))
+        assert back.grids == []
+
+
+@pytest.mark.skipif(not AE.HAVE_FLIGHT, reason="pyarrow.flight unavailable")
+class TestFlight:
+    def test_flight_query_roundtrip(self):
+        ms = TimeSeriesMemStore()
+        ms.setup(Dataset("prometheus"), [0])
+        ms.ingest("prometheus", 0, machine_metrics(n_series=4, n_samples=100, start_ms=BASE))
+        engine = QueryEngine(ms, "prometheus")
+        server = AE.FlightQueryServer(engine)
+        try:
+            endpoint = f"grpc://127.0.0.1:{server.port}"
+            res = AE.FlightQueryClient.query_range(
+                endpoint, "sum(heap_usage0)", (BASE + 600_000) / 1000, (BASE + 900_000) / 1000, 60
+            )
+            assert sum(g.n_series for g in res.grids) == 1
+            vals = res.grids[0].values_np()
+            assert np.isfinite(vals).all()
+            # cross-check against local execution
+            local = engine.query_range("sum(heap_usage0)", (BASE + 600_000) / 1000, (BASE + 900_000) / 1000, 60)
+            np.testing.assert_allclose(vals, local.grids[0].values_np(), rtol=1e-6)
+        finally:
+            server.shutdown()
