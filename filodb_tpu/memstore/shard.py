@@ -71,6 +71,11 @@ class TimeSeriesShard:
         # memory reclaim + chunk seal versioning)
         self.version = 0
         self.stage_cache: dict = {}
+        # on-demand paging source: set to the ColumnStore to transparently
+        # page evicted chunks back in at query time (reference
+        # OnDemandPagingShard.scala:26 + DemandPagedChunkStore)
+        self.odp_store = None
+        self.odp_stats_pages = 0
 
     # -- ingest ------------------------------------------------------------
 
@@ -196,6 +201,53 @@ class TimeSeriesShard:
                 self.cardinality.series_removed(part.tags)
                 self.stats.partitions_evicted += 1
         return dropped
+
+    def odp_page_in(self, part_ids, start_ms: int, end_ms: int) -> int:
+        """Page persisted chunks for the given partitions back into memory
+        when the query range precedes what is resident (reference
+        scanPartitions ODP override, OnDemandPagingShard.scala:147).
+        Returns chunks paged in."""
+        if self.odp_store is None:
+            return 0
+        from ..core.encodings import decode
+        from ..core.schemas import SCHEMAS, canonical_partkey
+
+        need: dict[bytes, TimeSeriesPartition] = {}
+        for pid in part_ids:
+            part = self.partitions.get(int(pid))
+            if part is not None and part.earliest_ts() > start_ms:
+                need[part.partkey] = part
+        if not need:
+            return 0
+        n = 0
+        with self._lock:
+            for header, schema_name, encs in self.odp_store.read_chunks(self.dataset, self.shard_num):
+                pk = canonical_partkey(header["tags"])
+                part = need.get(pk)
+                if part is None:
+                    continue
+                if header["end"] < start_ms or header["start"] > end_ms:
+                    continue
+                if any(c.start_ts == header["start"] for c in part.chunks):
+                    continue  # already resident
+                from .partition import Chunk
+
+                arrays = {
+                    col: decode(enc) for col, enc in zip(header["cols"], encs)
+                }
+                part.chunks.append(
+                    Chunk(header["start"], header["end"], header["n"], arrays,
+                          dict(zip(header["cols"], encs)))
+                )
+                part.mark_flushed(header["end"])
+                n += 1
+            for part in need.values():
+                part.chunks.sort(key=lambda c: c.start_ts)
+            if n:
+                self.version += 1
+                self.stage_cache.clear()
+                self.odp_stats_pages += n
+        return n
 
     @property
     def num_partitions(self) -> int:

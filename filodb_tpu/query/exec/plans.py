@@ -133,6 +133,8 @@ class SelectRawPartitionsExec(ExecPlan):
         pids = shard.lookup_partitions(self.filters, self.start_ms, self.end_ms)
         if len(pids) > ctx.max_series:
             raise QueryError(f"query selects {len(pids)} series > limit {ctx.max_series}")
+        if shard.odp_store is not None and len(pids):
+            shard.odp_page_in(pids, self.start_ms, self.end_ms)
         # group by schema (multi-schema metric support)
         by_schema: dict[str, list[int]] = {}
         for pid in pids:

@@ -52,6 +52,9 @@ class FiloServer:
         self.memstore.setup(Dataset(self.dataset), range(self.n_shards))
         root = cfg.get("store_root")
         self.column_store = LocalColumnStore(root) if root else NullColumnStore()
+        if root:
+            for sh in self.memstore.shards(self.dataset):
+                sh.odp_store = self.column_store
         self.flusher = FlushCoordinator(self.memstore, self.column_store)
         self.engine = QueryEngine(self.memstore, self.dataset)
         self._stop = threading.Event()
