@@ -1,0 +1,205 @@
+"""Cluster shard coordination (reference L5: ShardMapper.scala,
+ShardManager.scala, ShardAssignmentStrategy.scala:265, ShardStatus.scala ADT,
+v2 FiloDbClusterDiscovery.scala:6 ordinal assignment + peer health checks,
+doc/sharding.md:157-189 auto-reassignment with 2h damper).
+
+Single-process-friendly: nodes are logical endpoints; the event-driven state
+machine (status transitions, subscriptions, reassignment policy) matches the
+reference so a networked control plane can drive it later.
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Sequence
+
+
+class ShardStatus(enum.Enum):
+    UNASSIGNED = "unassigned"
+    ASSIGNED = "assigned"
+    RECOVERY = "recovery"
+    ACTIVE = "active"
+    ERROR = "error"
+    DOWN = "down"
+    STOPPED = "stopped"
+
+
+QUERYABLE = {ShardStatus.ACTIVE, ShardStatus.RECOVERY}
+
+
+@dataclass
+class ShardEvent:
+    shard: int
+    status: ShardStatus
+    node: str | None
+    ts: float = field(default_factory=time.time)
+
+
+class ShardMapper:
+    """shard -> (node, status) map + query routing (reference
+    ShardMapper.scala: status tracking, activeShards, queryShards)."""
+
+    def __init__(self, num_shards: int):
+        self.num_shards = num_shards
+        self._node: list[str | None] = [None] * num_shards
+        self._status: list[ShardStatus] = [ShardStatus.UNASSIGNED] * num_shards
+        self._subscribers: list[Callable[[ShardEvent], None]] = []
+
+    def subscribe(self, fn: Callable[[ShardEvent], None]) -> None:
+        self._subscribers.append(fn)
+
+    def update(self, shard: int, status: ShardStatus, node: str | None = None) -> None:
+        self._status[shard] = status
+        if node is not None or status in (ShardStatus.UNASSIGNED, ShardStatus.DOWN):
+            self._node[shard] = node
+        ev = ShardEvent(shard, status, self._node[shard])
+        for fn in self._subscribers:
+            fn(ev)
+
+    def node_of(self, shard: int) -> str | None:
+        return self._node[shard]
+
+    def status_of(self, shard: int) -> ShardStatus:
+        return self._status[shard]
+
+    def active_shards(self) -> list[int]:
+        return [s for s in range(self.num_shards) if self._status[s] in QUERYABLE]
+
+    def shards_of_node(self, node: str) -> list[int]:
+        return [s for s in range(self.num_shards) if self._node[s] == node]
+
+    def unassigned(self) -> list[int]:
+        return [s for s in range(self.num_shards) if self._status[s] == ShardStatus.UNASSIGNED]
+
+    def query_shards(self, shard_key_hash: int | None = None, spread: int | None = None) -> list[int]:
+        """Shards a query must touch; with a shard-key hash + spread the set
+        prunes to the 2^spread shards that key maps to (reference
+        queryShardsFromShardKey)."""
+        if shard_key_hash is None or spread is None:
+            return self.active_shards()
+        from ..core.schemas import ingestion_shard
+
+        mask = (1 << spread) - 1
+        cands = {
+            ingestion_shard(shard_key_hash, low, spread, self.num_shards) for low in range(mask + 1)
+        }
+        return sorted(s for s in cands if self._status[s] in QUERYABLE)
+
+
+class ShardAssignmentStrategy:
+    """Even spread of shards over nodes respecting capacity (reference
+    DefaultShardAssignmentStrategy)."""
+
+    def assign(self, mapper: ShardMapper, nodes: Sequence[str], shards_per_node: int):
+        out: dict[str, list[int]] = {n: [] for n in nodes}
+        load = {n: len(mapper.shards_of_node(n)) for n in nodes}
+        for s in mapper.unassigned():
+            node = min(nodes, key=lambda n: load[n]) if nodes else None
+            if node is None or load[node] >= shards_per_node:
+                continue
+            out[node].append(s)
+            load[node] += 1
+        return out
+
+
+class ShardManager:
+    """Cluster-singleton shard coordinator: node join/leave, ingestion-error
+    reassignment with a damper window (reference ShardManager.scala +
+    doc/sharding.md: a shard reassigned within the damper period is marked
+    DOWN instead of bounced again)."""
+
+    def __init__(self, num_shards: int, shards_per_node: int,
+                 reassignment_damper_s: float = 7200.0):
+        self.mapper = ShardMapper(num_shards)
+        self.strategy = ShardAssignmentStrategy()
+        self.shards_per_node = shards_per_node
+        self.damper_s = reassignment_damper_s
+        self.nodes: list[str] = []
+        self._last_reassign: dict[int, float] = {}
+
+    # -- membership -------------------------------------------------------
+
+    def node_joined(self, node: str) -> list[int]:
+        if node not in self.nodes:
+            self.nodes.append(node)
+        assigned = self.strategy.assign(self.mapper, [node], self.shards_per_node)[node]
+        for s in assigned:
+            self.mapper.update(s, ShardStatus.ASSIGNED, node)
+        return assigned
+
+    def node_left(self, node: str) -> list[int]:
+        shards = self.mapper.shards_of_node(node)
+        self.nodes = [n for n in self.nodes if n != node]
+        for s in shards:
+            self.mapper.update(s, ShardStatus.UNASSIGNED, None)
+        return self._reassign(shards)
+
+    def _reassign(self, shards: Sequence[int]) -> list[int]:
+        moved = []
+        now = time.time()
+        for s in shards:
+            last = self._last_reassign.get(s, 0)
+            if now - last < self.damper_s:
+                # bounced too recently -> stop flapping (reference damper)
+                self.mapper.update(s, ShardStatus.DOWN, None)
+                continue
+            per_node = self.strategy.assign(self.mapper, self.nodes, self.shards_per_node)
+            for node, got in per_node.items():
+                if s in got:
+                    self.mapper.update(s, ShardStatus.ASSIGNED, node)
+                    self._last_reassign[s] = now
+                    moved.append(s)
+                    break
+        return moved
+
+    # -- shard lifecycle events (from ingestion) --------------------------
+
+    def shard_active(self, shard: int) -> None:
+        self.mapper.update(shard, ShardStatus.ACTIVE, self.mapper.node_of(shard))
+
+    def shard_recovering(self, shard: int) -> None:
+        self.mapper.update(shard, ShardStatus.RECOVERY, self.mapper.node_of(shard))
+
+    def ingestion_error(self, shard: int) -> bool:
+        """IngestionError -> reassign elsewhere unless dampered (reference
+        doc/sharding.md:157-167). Returns True if reassigned."""
+        self.mapper.update(shard, ShardStatus.ERROR, self.mapper.node_of(shard))
+        self.mapper.update(shard, ShardStatus.UNASSIGNED, None)
+        return bool(self._reassign([shard]))
+
+
+class ClusterDiscovery:
+    """v2-style deterministic ordinal assignment + peer health tracking
+    (reference FiloDbClusterDiscovery: stateful-set ordinal -> shard range
+    :37-47, periodic peer pings)."""
+
+    def __init__(self, num_shards: int, num_nodes: int, failure_detection_interval_s: float = 30.0):
+        self.num_shards = num_shards
+        self.num_nodes = num_nodes
+        self.interval_s = failure_detection_interval_s
+        self._heartbeat: dict[int, float] = {}
+
+    def shards_for_ordinal(self, ordinal: int) -> list[int]:
+        if not (0 <= ordinal < self.num_nodes):
+            raise ValueError(f"ordinal {ordinal} out of range")
+        per = self.num_shards // self.num_nodes
+        extra = self.num_shards % self.num_nodes
+        start = ordinal * per + min(ordinal, extra)
+        n = per + (1 if ordinal < extra else 0)
+        return list(range(start, start + n))
+
+    def heartbeat(self, ordinal: int, ts: float | None = None) -> None:
+        self._heartbeat[ordinal] = ts if ts is not None else time.time()
+
+    def healthy_nodes(self, now: float | None = None) -> list[int]:
+        now = now if now is not None else time.time()
+        return [
+            o for o in range(self.num_nodes)
+            if now - self._heartbeat.get(o, 0) <= self.interval_s
+        ]
+
+    def down_nodes(self, now: float | None = None) -> list[int]:
+        healthy = set(self.healthy_nodes(now))
+        return [o for o in range(self.num_nodes) if o not in healthy]

@@ -1,0 +1,100 @@
+"""TCP line-protocol gateway (reference L7: GatewayServer.scala:64,124 —
+netty TCP server accepting Influx line protocol, converting to records,
+sharding by shard-key hash, feeding the ingest pipeline :335; plus
+TestTimeseriesProducer load generator).
+
+Stdlib socketserver; each connection streams newline-delimited Influx lines.
+Batches accumulate per poll interval and route to shards by spread hashing.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import threading
+import time
+
+import numpy as np
+
+from ..core.records import RecordBatch
+from ..core.schemas import GAUGE, METRIC_TAG
+from .parsers import parse_influx_line
+
+
+class GatewayServer:
+    def __init__(self, memstore, dataset: str, spread: int = 3,
+                 ws: str = "default", ns: str = "default", batch_lines: int = 1000):
+        self.memstore = memstore
+        self.dataset = dataset
+        self.spread = spread
+        self.ws = ws
+        self.ns = ns
+        self.batch_lines = batch_lines
+        self.lines_received = 0
+        self.rows_ingested = 0
+        self.parse_errors = 0
+        self._srv: socketserver.ThreadingTCPServer | None = None
+        gateway = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                buf: list[tuple] = []
+                for raw in self.rfile:
+                    line = raw.decode(errors="replace").strip()
+                    if not line:
+                        continue
+                    gateway.lines_received += 1
+                    try:
+                        for metric, tags, ts_ms, val in parse_influx_line(line) or ():
+                            full = dict(tags)
+                            full[METRIC_TAG] = metric
+                            full.setdefault("_ws_", gateway.ws)
+                            full.setdefault("_ns_", gateway.ns)
+                            buf.append((full, ts_ms or int(time.time() * 1000), val))
+                    except ValueError:
+                        gateway.parse_errors += 1
+                    if len(buf) >= gateway.batch_lines:
+                        gateway._ingest(buf)
+                        buf = []
+                if buf:
+                    gateway._ingest(buf)
+
+        self._handler = Handler
+
+    def _ingest(self, rows):
+        tags_list = [r[0] for r in rows]
+        ts = np.asarray([r[1] for r in rows], dtype=np.int64)
+        vals = np.asarray([r[2] for r in rows], dtype=np.float64)
+        batch = RecordBatch(GAUGE, ts, {"value": vals}, tags_list)
+        self.rows_ingested += self.memstore.ingest_routed(self.dataset, batch, self.spread)
+
+    def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        self._srv = socketserver.ThreadingTCPServer((host, port), self._handler)
+        self._srv.daemon_threads = True
+        t = threading.Thread(target=self._srv.serve_forever, daemon=True)
+        t.start()
+        return self._srv.server_address[1]
+
+    def stop(self):
+        if self._srv:
+            self._srv.shutdown()
+
+
+def produce_load(host: str, port: int, n_series: int, n_samples: int,
+                 metric: str = "machine_cpu", start_ms: int | None = None,
+                 interval_ms: int = 10_000) -> int:
+    """Load generator (reference TestTimeseriesProducer): pushes synthetic
+    Influx lines over TCP; returns lines sent."""
+    rng = np.random.default_rng(0)
+    start_ms = start_ms if start_ms is not None else int(time.time() * 1000)
+    sent = 0
+    with socket.create_connection((host, port)) as sock:
+        f = sock.makefile("wb")
+        for t in range(n_samples):
+            ts_ns = (start_ms + t * interval_ms) * 1_000_000
+            for s in range(n_series):
+                v = 50 + 20 * rng.standard_normal()
+                f.write(f"{metric},host=host-{s},dc=dc{s % 3} value={v:.4f} {ts_ns}\n".encode())
+                sent += 1
+        f.flush()
+    return sent

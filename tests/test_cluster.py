@@ -1,0 +1,98 @@
+"""Cluster coordination tests (model: reference ShardManagerSpec,
+ShardAssignmentStrategySpec, FilodbClusterStateSpec)."""
+
+import time
+
+import pytest
+
+from filodb_tpu.coordinator.cluster import (
+    ClusterDiscovery,
+    ShardManager,
+    ShardMapper,
+    ShardStatus,
+)
+from filodb_tpu.core.schemas import shardkey_hash
+
+
+class TestShardMapper:
+    def test_status_transitions_and_events(self):
+        m = ShardMapper(4)
+        events = []
+        m.subscribe(events.append)
+        m.update(0, ShardStatus.ASSIGNED, "node-a")
+        m.update(0, ShardStatus.RECOVERY)
+        m.update(0, ShardStatus.ACTIVE)
+        assert m.status_of(0) == ShardStatus.ACTIVE
+        assert m.node_of(0) == "node-a"
+        assert [e.status for e in events] == [
+            ShardStatus.ASSIGNED, ShardStatus.RECOVERY, ShardStatus.ACTIVE]
+
+    def test_active_shards_routing(self):
+        m = ShardMapper(4)
+        for s, st in enumerate([ShardStatus.ACTIVE, ShardStatus.RECOVERY,
+                                ShardStatus.DOWN, ShardStatus.UNASSIGNED]):
+            m.update(s, st, "n")
+        assert m.active_shards() == [0, 1]  # recovery shards still queryable
+
+    def test_query_shards_pruned_by_shard_key(self):
+        m = ShardMapper(32)
+        for s in range(32):
+            m.update(s, ShardStatus.ACTIVE, "n")
+        h = shardkey_hash({"_ws_": "w", "_ns_": "n", "_metric_": "m"})
+        shards = m.query_shards(h, spread=3)
+        assert 1 <= len(shards) <= 8
+        # same key always routes to the same shard set
+        assert shards == m.query_shards(h, spread=3)
+
+
+class TestShardManager:
+    def test_join_assigns_evenly(self):
+        mgr = ShardManager(8, shards_per_node=4)
+        a = mgr.node_joined("a")
+        b = mgr.node_joined("b")
+        assert len(a) == 4 and len(b) == 4
+        assert set(a) | set(b) == set(range(8))
+
+    def test_node_leave_reassigns(self):
+        mgr = ShardManager(8, shards_per_node=8, reassignment_damper_s=0)
+        mgr.node_joined("a")
+        mgr.node_joined("b")  # a full -> b gets nothing
+        lost = mgr.node_left("a")
+        assert set(lost) == set(range(8))
+        assert all(mgr.mapper.node_of(s) == "b" for s in range(8))
+
+    def test_ingestion_error_reassigns_once_then_dampers(self):
+        mgr = ShardManager(2, shards_per_node=2, reassignment_damper_s=3600)
+        mgr.node_joined("a")
+        mgr.node_joined("b")
+        assert mgr.ingestion_error(0) is True
+        # second error within the damper window -> shard goes DOWN
+        assert mgr.ingestion_error(0) is False
+        assert mgr.mapper.status_of(0) == ShardStatus.DOWN
+
+    def test_lifecycle_to_active(self):
+        mgr = ShardManager(1, shards_per_node=1)
+        mgr.node_joined("a")
+        mgr.shard_recovering(0)
+        assert mgr.mapper.status_of(0) == ShardStatus.RECOVERY
+        mgr.shard_active(0)
+        assert mgr.mapper.status_of(0) == ShardStatus.ACTIVE
+
+
+class TestClusterDiscovery:
+    def test_ordinal_ranges_cover_all_shards(self):
+        d = ClusterDiscovery(num_shards=10, num_nodes=3)
+        all_shards = []
+        for o in range(3):
+            all_shards.extend(d.shards_for_ordinal(o))
+        assert sorted(all_shards) == list(range(10))
+        # deterministic and contiguous
+        assert d.shards_for_ordinal(0) == [0, 1, 2, 3]
+
+    def test_health_tracking(self):
+        d = ClusterDiscovery(4, 2, failure_detection_interval_s=10)
+        now = time.time()
+        d.heartbeat(0, now)
+        d.heartbeat(1, now - 60)
+        assert d.healthy_nodes(now) == [0]
+        assert d.down_nodes(now) == [1]

@@ -1,0 +1,56 @@
+"""TCP gateway tests (model: reference GatewayServer + TestTimeseriesProducer
+round trip)."""
+
+import time
+
+import pytest
+
+from filodb_tpu.coordinator.planner import QueryEngine
+from filodb_tpu.core.schemas import Dataset
+from filodb_tpu.gateway.server import GatewayServer, produce_load
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+
+BASE = 1_600_000_000_000
+
+
+def test_gateway_ingest_roundtrip():
+    ms = TimeSeriesMemStore()
+    ms.setup(Dataset("prometheus"), range(4))
+    gw = GatewayServer(ms, "prometheus", spread=2, ws="demo", ns="App-0")
+    port = gw.start()
+    try:
+        sent = produce_load("127.0.0.1", port, n_series=10, n_samples=20, start_ms=BASE)
+        assert sent == 200
+        deadline = time.time() + 15
+        while time.time() < deadline and gw.rows_ingested < 200:
+            time.sleep(0.05)
+        assert gw.rows_ingested == 200
+        assert gw.parse_errors == 0
+        total = sum(sh.num_partitions for sh in ms.shards("prometheus"))
+        assert total == 10
+        engine = QueryEngine(ms, "prometheus")
+        res = engine.query_range(
+            "sum(machine_cpu)", (BASE + 60_000) / 1000, (BASE + 180_000) / 1000, 30
+        )
+        assert sum(g.n_series for g in res.grids) == 1
+    finally:
+        gw.stop()
+
+
+def test_gateway_bad_lines_counted():
+    ms = TimeSeriesMemStore()
+    ms.setup(Dataset("prometheus"), [0])
+    gw = GatewayServer(ms, "prometheus", spread=0)
+    port = gw.start()
+    try:
+        import socket
+
+        with socket.create_connection(("127.0.0.1", port)) as s:
+            s.sendall(b"this is not influx\ncpu,host=a value=1 1600000000000000000\n")
+        deadline = time.time() + 10
+        while time.time() < deadline and gw.rows_ingested < 1:
+            time.sleep(0.05)
+        assert gw.rows_ingested == 1
+        assert gw.parse_errors == 1
+    finally:
+        gw.stop()
