@@ -262,7 +262,9 @@ class QueryEngine:
         self.planner = SingleClusterPlanner(memstore, dataset, params=params)
 
     def context(self) -> QueryContext:
-        return QueryContext(self.memstore, self.dataset)
+        ctx = QueryContext(self.memstore, self.dataset)
+        ctx.max_series = self.planner.params.max_series
+        return ctx
 
     def query_range(self, promql: str, start_s: float, end_s: float, step_s: float):
         import time as _time
@@ -273,7 +275,9 @@ class QueryEngine:
         plan = query_range_to_logical_plan(promql, start_s, end_s, step_s,
                                            self.planner.params.lookback_ms)
         exec_plan = self.planner.materialize(plan)
-        res = exec_plan.execute(self.context())
+        ctx = self.context()
+        res = exec_plan.execute(ctx)
+        res.stats = ctx.stats  # per-query scan/latency stats ride in responses
         if res.result_type == "matrix" or res.grids:
             res.result_type = "matrix"
         REGISTRY.counter("filodb_queries", dataset=self.dataset).inc()
@@ -285,7 +289,9 @@ class QueryEngine:
     def query_instant(self, promql: str, time_s: float):
         plan = query_to_logical_plan(promql, time_s, self.planner.params.lookback_ms)
         exec_plan = self.planner.materialize(plan)
-        res = exec_plan.execute(self.context())
+        ctx = self.context()
+        res = exec_plan.execute(ctx)
+        res.stats = ctx.stats
         if res.result_type == "matrix":
             res.result_type = "vector"
         return res

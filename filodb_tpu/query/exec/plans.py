@@ -169,6 +169,11 @@ class SelectRawPartitionsExec(ExecPlan):
                 shard.stage_cache[cache_key] = block
             ctx.stats.series_scanned += len(ids)
             ctx.stats.samples_scanned += int(np.asarray(block.lens).sum())
+            if ctx.stats.samples_scanned > ctx.max_samples:
+                raise QueryError(
+                    f"query would scan {ctx.stats.samples_scanned} samples > "
+                    f"limit {ctx.max_samples}"
+                )
             les = parts[0].bucket_les if is_hist else None
             res.raw_grids.append(
                 RawGrid(

@@ -1,0 +1,109 @@
+"""Data repair / maintenance jobs (reference spark-jobs:
+repair/ChunkCopier + PartitionKeysCopier (cross-cluster data migration),
+cardbuster/CardinalityBusterMain (delete partkeys matching filters),
+DSIndexJob (copy partkey updates to downsample keyspace)).
+
+Host-side batch jobs over the column store — no Spark needed at this scale;
+each job streams segments and is restartable.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from ..core.encodings import Encoded
+from ..core.filters import ColumnFilter
+from ..core.schemas import SCHEMAS, canonical_partkey
+from .columnstore import ColumnStore, LocalColumnStore
+
+
+def copy_chunks(
+    src: ColumnStore, dst: ColumnStore, dataset: str, shard_nums: Sequence[int],
+    start_ms: int | None = None, end_ms: int | None = None,
+) -> int:
+    """Copy chunk sets between stores, optionally time-filtered (reference
+    ChunkCopier — used for cluster migration / repair)."""
+    n = 0
+    for shard in shard_nums:
+        for header, schema_name, encs in src.read_chunks(dataset, shard):
+            if start_ms is not None and header["end"] < start_ms:
+                continue
+            if end_ms is not None and header["start"] > end_ms:
+                continue
+            schema = SCHEMAS.get(schema_name)
+            if schema is None:
+                continue
+            # re-frame into the destination (decoded form not needed)
+            from ..memstore.partition import Chunk
+
+            chunk = Chunk(
+                header["start"], header["end"], header["n"], None,
+                dict(zip(header["cols"], encs)),
+            )
+            dst.write_chunks(dataset, shard, 0, -1, header["tags"], schema, [chunk])
+            n += 1
+    return n
+
+
+def copy_partkeys(
+    src: ColumnStore, dst: ColumnStore, dataset: str, shard_nums: Sequence[int]
+) -> int:
+    """reference PartitionKeysCopier / DSIndexJob."""
+    n = 0
+    for shard in shard_nums:
+        for rec in src.read_partkeys(dataset, shard):
+            dst.write_partkey(dataset, shard, rec["tags"], rec["start"], rec["end"])
+            n += 1
+    return n
+
+
+def bust_cardinality(
+    store: LocalColumnStore, dataset: str, shard_nums: Sequence[int],
+    filters: Sequence[ColumnFilter],
+) -> int:
+    """Delete partkeys (and their chunks) matching the filters (reference
+    CardinalityBusterMain — the escape hatch for cardinality explosions).
+    Rewrites the shard segments without the matching series; returns series
+    deleted."""
+    import json
+    import os
+
+    deleted = 0
+    for shard in shard_nums:
+        victims: set[bytes] = set()
+        for rec in store.read_partkeys(dataset, shard):
+            tags = rec["tags"]
+            if all(f.matches(tags.get(f.column)) for f in filters):
+                victims.add(canonical_partkey(tags))
+        if not victims:
+            continue
+        deleted += len(victims)
+        # rewrite partkey journal
+        d = store._shard_dir(dataset, shard)
+        pk_path = os.path.join(d, "partkeys.jsonl")
+        keep = [
+            rec for rec in store.read_partkeys(dataset, shard)
+            if canonical_partkey(rec["tags"]) not in victims
+        ]
+        with open(pk_path, "w") as f:
+            for rec in keep:
+                f.write(json.dumps(rec) + "\n")
+        # rewrite chunk segments without victim series
+        chunks = [
+            (header, schema_name, encs)
+            for header, schema_name, encs in store.read_chunks(dataset, shard)
+            if canonical_partkey(header["tags"]) not in victims
+        ]
+        for fn in os.listdir(d):
+            if fn.startswith("chunks-"):
+                os.remove(os.path.join(d, fn))
+        from ..memstore.partition import Chunk
+
+        for header, schema_name, encs in chunks:
+            schema = SCHEMAS.get(schema_name)
+            if schema is None:
+                continue
+            chunk = Chunk(header["start"], header["end"], header["n"], None,
+                          dict(zip(header["cols"], encs)))
+            store.write_chunks(dataset, shard, 0, -1, header["tags"], schema, [chunk])
+    return deleted

@@ -1,0 +1,95 @@
+"""Repair jobs + query limit enforcement tests (model: reference
+spark-jobs repair/cardbuster specs + QueryContext enforced limits)."""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.coordinator.planner import PlannerParams, QueryEngine, SingleClusterPlanner
+from filodb_tpu.core.filters import equals, regex
+from filodb_tpu.core.schemas import Dataset
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.memstore.shard import StoreConfig
+from filodb_tpu.query.exec.plans import QueryContext
+from filodb_tpu.query.exec.transformers import QueryError
+from filodb_tpu.query.promql import query_range_to_logical_plan
+from filodb_tpu.store.columnstore import LocalColumnStore
+from filodb_tpu.store.flush import FlushCoordinator, recover_shard
+from filodb_tpu.store.repair import bust_cardinality, copy_chunks, copy_partkeys
+from filodb_tpu.testkit import machine_metrics
+
+BASE = 1_600_000_000_000
+
+
+def flushed_store(tmp_path, n_series=6):
+    ms = TimeSeriesMemStore(StoreConfig(max_chunk_size=100))
+    ms.setup(Dataset("ds"), [0])
+    ms.ingest("ds", 0, machine_metrics(n_series=n_series, n_samples=200, start_ms=BASE))
+    store = LocalColumnStore(str(tmp_path / "src"))
+    FlushCoordinator(ms, store).flush_shard("ds", 0)
+    return ms, store
+
+
+class TestRepairJobs:
+    def test_copy_chunks_and_partkeys(self, tmp_path):
+        _, src = flushed_store(tmp_path)
+        dst = LocalColumnStore(str(tmp_path / "dst"))
+        n_chunks = copy_chunks(src, dst, "ds", [0])
+        n_keys = copy_partkeys(src, dst, "ds", [0])
+        assert n_chunks == len(list(src.read_chunks("ds", 0)))
+        assert n_keys == 6
+        # recovered memstore from the copy answers queries
+        ms2 = TimeSeriesMemStore()
+        ms2.setup(Dataset("ds"), [0])
+        recover_shard(ms2, dst, "ds", 0)
+        assert ms2.shard("ds", 0).num_partitions == 6
+
+    def test_copy_chunks_time_filtered(self, tmp_path):
+        _, src = flushed_store(tmp_path)
+        dst = LocalColumnStore(str(tmp_path / "dst2"))
+        n = copy_chunks(src, dst, "ds", [0], start_ms=BASE + 150 * 10_000)
+        assert 0 < n < len(list(src.read_chunks("ds", 0)))
+
+    def test_bust_cardinality(self, tmp_path):
+        _, store = flushed_store(tmp_path)
+        deleted = bust_cardinality(store, "ds", [0], [regex("instance", "host-[0-2]")])
+        assert deleted == 3
+        remaining = {rec["tags"]["instance"] for rec in store.read_partkeys("ds", 0)}
+        assert remaining == {"host-3", "host-4", "host-5"}
+        for header, _, _ in store.read_chunks("ds", 0):
+            assert header["tags"]["instance"] in remaining
+
+
+class TestQueryLimits:
+    def test_series_limit(self):
+        ms = TimeSeriesMemStore()
+        ms.setup(Dataset("ds"), [0])
+        ms.ingest("ds", 0, machine_metrics(n_series=20, n_samples=50, start_ms=BASE))
+        planner = SingleClusterPlanner(ms, "ds")
+        plan = query_range_to_logical_plan(
+            "heap_usage0", (BASE + 600_000) / 1000, (BASE + 900_000) / 1000, 60)
+        ep = planner.materialize(plan)
+        ctx = QueryContext(ms, "ds", max_series=5)
+        with pytest.raises(QueryError, match="series"):
+            ep.execute(ctx)
+
+    def test_sample_limit(self):
+        ms = TimeSeriesMemStore()
+        ms.setup(Dataset("ds"), [0])
+        ms.ingest("ds", 0, machine_metrics(n_series=10, n_samples=100, start_ms=BASE))
+        planner = SingleClusterPlanner(ms, "ds")
+        plan = query_range_to_logical_plan(
+            "heap_usage0", (BASE + 600_000) / 1000, (BASE + 900_000) / 1000, 60)
+        ep = planner.materialize(plan)
+        ctx = QueryContext(ms, "ds", max_samples=100)
+        with pytest.raises(QueryError, match="samples"):
+            ep.execute(ctx)
+
+    def test_under_limit_ok(self):
+        ms = TimeSeriesMemStore()
+        ms.setup(Dataset("ds"), [0])
+        ms.ingest("ds", 0, machine_metrics(n_series=3, n_samples=50, start_ms=BASE))
+        engine = QueryEngine(ms, "ds")
+        res = engine.query_range("heap_usage0", (BASE + 300_000) / 1000, (BASE + 400_000) / 1000, 60)
+        assert sum(g.n_series for g in res.grids) == 3
+        assert res.stats.series_scanned == 3
+        assert res.stats.samples_scanned > 0
