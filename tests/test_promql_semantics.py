@@ -1,0 +1,139 @@
+"""PromQL semantic edge cases end-to-end (model: reference WindowIteratorSpec
+boundary cases + exp-histogram query specs)."""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.coordinator.planner import QueryEngine
+from filodb_tpu.core.histograms import base2_exp_buckets
+from filodb_tpu.core.schemas import OTEL_EXP_DELTA_HISTOGRAM, Dataset
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.testkit import counter_batch, histogram_batch, machine_metrics
+
+BASE = 1_600_000_000_000
+
+
+@pytest.fixture(scope="module")
+def engine():
+    ms = TimeSeriesMemStore()
+    ms.setup(Dataset("prometheus"), range(4))
+    ms.ingest_routed("prometheus", machine_metrics(n_series=4, n_samples=200, start_ms=BASE), spread=2)
+    ms.ingest_routed("prometheus", counter_batch(n_series=4, n_samples=200, start_ms=BASE), spread=2)
+    scheme = base2_exp_buckets(scale=1, start_index=-4, num=12)
+    ms.ingest_routed(
+        "prometheus",
+        histogram_batch(n_series=3, n_samples=200, start_ms=BASE, scheme=scheme,
+                        metric="exp_latency", schema=OTEL_EXP_DELTA_HISTOGRAM),
+        spread=2,
+    )
+    return QueryEngine(ms, "prometheus")
+
+
+class TestLookbackBoundaries:
+    def test_sample_exactly_at_lookback_edge_excluded(self, engine):
+        # samples at BASE, BASE+10s, ... lookback 5m; eval at t: window (t-5m, t]
+        # choose t such that t - 5m == BASE exactly -> BASE sample excluded
+        t = (BASE + 300_000) / 1000
+        res = engine.query_instant("count_over_time(heap_usage0[5m])", t)
+        for _, _, vals in res.all_series():
+            # samples strictly > BASE and <= BASE+300s: 10s grid -> 30 samples
+            assert vals[-1] == 30
+
+    def test_instant_vector_uses_latest_in_lookback(self, engine):
+        t = (BASE + 1_000_000) / 1000
+        res = engine.query_instant("heap_usage0", t)
+        batch = machine_metrics(n_series=4, n_samples=200, start_ms=BASE)
+        by_inst = {g.tags["instance"]: g for g in batch.group_by_series()}
+        for lbls, ts, vals in res.all_series():
+            src = by_inst[lbls["instance"]]
+            idx = np.searchsorted(src.timestamps, t * 1000, side="right") - 1
+            np.testing.assert_allclose(vals[-1], src.values["value"][idx], rtol=1e-5)
+
+    def test_stale_beyond_lookback_absent(self, engine):
+        # evaluate far past the data end: no output points
+        t = (BASE + 200 * 10_000 + 600_000) / 1000
+        res = engine.query_instant("heap_usage0", t)
+        assert not list(res.all_series())
+
+
+class TestGridShapes:
+    def test_step_larger_than_window_leaves_gaps(self, engine):
+        res = engine.query_range(
+            "sum_over_time(heap_usage0[30s])", (BASE + 600_000) / 1000, (BASE + 1_200_000) / 1000, 120.0
+        )
+        for _, ts, vals in res.all_series():
+            assert len(vals) > 0  # sparse but present where data exists
+
+    def test_offset_shifts_results(self, engine):
+        r1 = engine.query_range("heap_usage0", (BASE + 900_000) / 1000, (BASE + 1_200_000) / 1000, 60.0)
+        r2 = engine.query_range(
+            "heap_usage0 offset 5m", (BASE + 1_200_000) / 1000, (BASE + 1_500_000) / 1000, 60.0
+        )
+        m1 = {tuple(sorted(l.items())): v for l, t, v in r1.all_series()}
+        m2 = {tuple(sorted(l.items())): v for l, t, v in r2.all_series()}
+        for k, v in m1.items():
+            np.testing.assert_allclose(m2[k], v, rtol=1e-5)
+
+    def test_at_modifier_constant_across_steps(self, engine):
+        res = engine.query_range(
+            f"heap_usage0 @ {(BASE + 1_000_000) / 1000}", (BASE + 600_000) / 1000, (BASE + 1_200_000) / 1000, 60.0
+        )
+        for _, _, vals in res.all_series():
+            assert len(set(np.round(vals, 5))) == 1
+
+
+class TestExpHistograms:
+    def test_exp_histogram_quantile_e2e(self, engine):
+        res = engine.query_range(
+            "histogram_quantile(0.9, rate(exp_latency[5m]))",
+            (BASE + 600_000) / 1000, (BASE + 1_200_000) / 1000, 60.0,
+        )
+        series = list(res.all_series())
+        assert len(series) == 3
+        for _, _, vals in series:
+            assert np.isfinite(vals).all() and (vals > 0).all()
+
+    def test_exp_histogram_sum_quantile(self, engine):
+        res = engine.query_range(
+            "histogram_quantile(0.5, sum(rate(exp_latency[5m])))",
+            (BASE + 600_000) / 1000, (BASE + 1_200_000) / 1000, 60.0,
+        )
+        assert len(list(res.all_series())) == 1
+
+
+class TestNameHandling:
+    def test_rate_drops_metric_name(self, engine):
+        res = engine.query_range(
+            "rate(http_requests_total[5m])", (BASE + 600_000) / 1000, (BASE + 900_000) / 1000, 60.0
+        )
+        for lbls, _, _ in res.all_series():
+            assert "_metric_" not in lbls and "__name__" not in lbls
+
+    def test_last_over_time_keeps_metric_name(self, engine):
+        res = engine.query_range(
+            "last_over_time(heap_usage0[5m])", (BASE + 600_000) / 1000, (BASE + 900_000) / 1000, 60.0
+        )
+        for lbls, _, _ in res.all_series():
+            assert lbls.get("_metric_") == "heap_usage0"
+
+    def test_comparison_keeps_name_without_bool(self, engine):
+        res = engine.query_range(
+            "heap_usage0 > 0", (BASE + 600_000) / 1000, (BASE + 900_000) / 1000, 60.0
+        )
+        for lbls, _, _ in res.all_series():
+            assert lbls.get("_metric_") == "heap_usage0"
+
+
+class TestInstantSubquery:
+    def test_top_level_subquery_instant(self, engine):
+        res = engine.query_instant("heap_usage0[10m:1m]", (BASE + 1_200_000) / 1000)
+        series = list(res.all_series())
+        assert len(series) == 4
+        _, ts, _ = series[0]
+        assert len(ts) >= 9  # ~10 substeps
+
+    def test_empty_selector_result(self, engine):
+        res = engine.query_range(
+            "no_such_metric", (BASE + 600_000) / 1000, (BASE + 900_000) / 1000, 60.0
+        )
+        assert not list(res.all_series())
