@@ -1,0 +1,235 @@
+"""Microbenchmark suite (reference jmh/src/main/scala/filodb.jmh/ — the 23
+JMH benchmarks, SURVEY.md §6; principal ones mirrored here). Each prints one
+JSON line; ``python -m benchmarks.run`` runs all and emits a JSON array.
+
+Unlike bench.py (the driver's single north-star number on real TPU), these
+cover the component workloads: encoding, ingestion, index lookups, gateway
+parse, planner materialization, query QPS in-memory and under ingest,
+histogram queries.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _bench(fn, n_iters=5, warmup=1):
+    for _ in range(warmup):
+        fn()
+    times = []
+    for _ in range(n_iters):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
+BASE = 1_600_000_000_000
+RESULTS = []
+
+
+def report(name, value, unit):
+    rec = {"metric": name, "value": round(value, 4), "unit": unit}
+    RESULTS.append(rec)
+    print(json.dumps(rec), flush=True)
+
+
+def bench_encoding():
+    """reference EncodingBenchmark / DoubleVectorSimdBenchmark."""
+    from filodb_tpu.core import encodings as E
+
+    rng = np.random.default_rng(0)
+    ts = BASE + np.arange(100_000, dtype=np.int64) * 10_000 + rng.integers(-50, 50, 100_000)
+    vals = 50 + rng.standard_normal(100_000)
+    dt = _bench(lambda: E.encode_int64(ts))
+    report("encode_delta_delta_100k", 100_000 / dt / 1e6, "Msamples/s")
+    dt = _bench(lambda: E.encode_double(vals))
+    report("encode_xor_double_100k", 100_000 / dt / 1e6, "Msamples/s")
+    enc = E.encode_double(vals)
+    dt = _bench(lambda: E.decode(enc))
+    report("decode_xor_double_100k", 100_000 / dt / 1e6, "Msamples/s")
+    report("xor_double_bytes_per_sample", enc.nbytes / 100_000, "bytes")
+
+
+def bench_nan_sum():
+    from filodb_tpu import native
+
+    rng = np.random.default_rng(1)
+    v = rng.standard_normal(1_000_000)
+    v[rng.integers(0, len(v), 1000)] = np.nan
+    dt = _bench(lambda: native.nan_sum(v))
+    report("native_nan_sum_1m", 1e6 / dt / 1e9, "Gsamples/s")
+
+
+def bench_ingestion():
+    """reference IngestionBenchmark: records/sec into a shard."""
+    from filodb_tpu.core.schemas import Dataset
+    from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+    from filodb_tpu.testkit import machine_metrics
+
+    batch = machine_metrics(n_series=1000, n_samples=100, start_ms=BASE)
+
+    def run():
+        ms = TimeSeriesMemStore()
+        ms.setup(Dataset("b"), [0])
+        ms.ingest("b", 0, batch)
+
+    dt = _bench(run, n_iters=3)
+    report("ingest_100k_rows", 100_000 / dt / 1e6, "Mrows/s")
+
+
+def bench_index():
+    """reference PartKeyIndexBenchmark: lookups/sec."""
+    from filodb_tpu.core.filters import equals, regex
+    from filodb_tpu.memstore.index import PartKeyIndex
+
+    idx = PartKeyIndex()
+    for i in range(100_000):
+        idx.add_partkey(i, {
+            "_metric_": f"metric_{i % 100}", "host": f"h{i % 1000}", "dc": f"dc{i % 10}",
+        }, 0)
+    f_eq = [equals("_metric_", "metric_5"), equals("dc", "dc3")]
+    dt = _bench(lambda: [idx.part_ids_from_filters(f_eq, 0, 2**62) for _ in range(100)])
+    report("index_equality_lookups", 100 / dt, "lookups/s")
+    f_re = [regex("host", "h1.*")]
+    dt = _bench(lambda: [idx.part_ids_from_filters(f_re, 0, 2**62) for _ in range(10)])
+    report("index_regex_lookups", 10 / dt, "lookups/s")
+
+
+def bench_gateway_parse():
+    """reference GatewayBenchmark: line-protocol msgs/sec."""
+    from filodb_tpu.gateway.parsers import parse_influx_line, parse_prom_text
+
+    lines = [
+        f"cpu,host=h{i},dc=dc{i % 3} value={i}.5 1600000000000000000" for i in range(10_000)
+    ]
+    dt = _bench(lambda: [list(parse_influx_line(l)) for l in lines])
+    report("influx_parse", len(lines) / dt / 1e3, "kmsgs/s")
+    text = "\n".join(f'm{i}{{h="x{i}"}} {i} 1600000000000' for i in range(10_000))
+    dt = _bench(lambda: list(parse_prom_text(text)))
+    report("prom_text_parse", 10_000 / dt / 1e3, "kmsgs/s")
+
+
+def bench_planner():
+    """reference PlannerBenchmark: plans/sec."""
+    from filodb_tpu.coordinator.planner import SingleClusterPlanner
+    from filodb_tpu.core.schemas import Dataset
+    from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+    from filodb_tpu.query.promql import query_range_to_logical_plan
+
+    ms = TimeSeriesMemStore()
+    ms.setup(Dataset("b"), range(8))
+    planner = SingleClusterPlanner(ms, "b")
+    q = 'sum by (job) (rate(http_requests_total{env="prod",dc=~"us.*"}[5m]))'
+
+    def run():
+        for _ in range(100):
+            plan = query_range_to_logical_plan(q, 1000, 5000, 15)
+            planner.materialize(plan)
+
+    dt = _bench(run)
+    report("parse_and_plan", 100 / dt, "plans/s")
+
+
+def bench_query_in_memory():
+    """reference QueryInMemoryBenchmark: 8 shards, 100 series x 720 samples
+    (2h @ 10s), sum(rate) range queries."""
+    from filodb_tpu.coordinator.planner import QueryEngine
+    from filodb_tpu.core.schemas import Dataset
+    from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+    from filodb_tpu.testkit import counter_batch, machine_metrics
+
+    ms = TimeSeriesMemStore()
+    ms.setup(Dataset("prometheus"), range(8))
+    ms.ingest_routed("prometheus", counter_batch(n_series=100, n_samples=720, start_ms=BASE), spread=3)
+    ms.ingest_routed("prometheus", machine_metrics(n_series=100, n_samples=720, start_ms=BASE), spread=3)
+    engine = QueryEngine(ms, "prometheus")
+    start, end = (BASE + 600_000) / 1000, (BASE + 7_000_000) / 1000
+
+    def q1():
+        engine.query_range("sum(rate(http_requests_total[5m]))", start, end, 60)
+
+    q1()  # warm staging cache + jit
+    dt = _bench(q1, n_iters=10)
+    report("query_sum_rate_100series_qps", 1 / dt, "qps")
+
+    def q2():
+        engine.query_range("min_over_time(heap_usage0[5m])", start, end, 60)
+
+    q2()
+    dt = _bench(q2, n_iters=10)
+    report("query_min_over_time_qps", 1 / dt, "qps")
+
+
+def bench_query_hicard():
+    """reference QueryHiCardInMemoryBenchmark: 8000 series, 2000 queried."""
+    from filodb_tpu.coordinator.planner import QueryEngine
+    from filodb_tpu.core.schemas import Dataset
+    from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+    from filodb_tpu.testkit import counter_batch
+
+    ms = TimeSeriesMemStore()
+    ms.setup(Dataset("prometheus"), range(8))
+    for ns in range(4):
+        ms.ingest_routed(
+            "prometheus",
+            counter_batch(n_series=2000, n_samples=120, start_ms=BASE, ns=f"App-{ns}"),
+            spread=3,
+        )
+    engine = QueryEngine(ms, "prometheus")
+    start, end = (BASE + 400_000) / 1000, (BASE + 1_100_000) / 1000
+
+    def q():
+        engine.query_range('sum(rate(http_requests_total{_ns_="App-1"}[5m]))', start, end, 60)
+
+    q()
+    dt = _bench(q, n_iters=5)
+    report("query_hicard_2000_of_8000_qps", 1 / dt, "qps")
+
+
+def bench_histogram_query():
+    """reference HistogramQueryBenchmark: sum(rate) + quantile over native
+    histograms."""
+    from filodb_tpu.coordinator.planner import QueryEngine
+    from filodb_tpu.core.schemas import Dataset
+    from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+    from filodb_tpu.testkit import histogram_batch
+
+    ms = TimeSeriesMemStore()
+    ms.setup(Dataset("prometheus"), range(4))
+    ms.ingest_routed("prometheus", histogram_batch(n_series=100, n_samples=240, start_ms=BASE), spread=2)
+    engine = QueryEngine(ms, "prometheus")
+    start, end = (BASE + 400_000) / 1000, (BASE + 2_200_000) / 1000
+
+    def q():
+        engine.query_range(
+            "histogram_quantile(0.9, sum(rate(http_request_latency[5m])))", start, end, 60
+        )
+
+    q()
+    dt = _bench(q, n_iters=5)
+    report("query_hist_quantile_qps", 1 / dt, "qps")
+
+
+ALL = [
+    bench_encoding, bench_nan_sum, bench_ingestion, bench_index,
+    bench_gateway_parse, bench_planner, bench_query_in_memory,
+    bench_query_hicard, bench_histogram_query,
+]
+
+
+def main():
+    only = sys.argv[1] if len(sys.argv) > 1 else None
+    for fn in ALL:
+        if only and only not in fn.__name__:
+            continue
+        fn()
+    print(json.dumps(RESULTS))
+
+
+if __name__ == "__main__":
+    main()
