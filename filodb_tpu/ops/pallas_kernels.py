@@ -1,0 +1,190 @@
+"""Pallas TPU kernel: fused window aggregation for irregular series.
+
+The general (non-shared-grid) path in kernels.py makes several passes over
+the staged ``[S, T]`` block (bounds, prefix sums, boundary gathers). This
+Pallas kernel computes ALL per-window statistics — count, sum, min, max,
+first/last timestamp, first/last value, first raw value — in ONE pass with
+the block resident in VMEM, tiled ``(BS series x BJ steps)`` over a grid
+that reuses the series block across step tiles (the block index map keeps
+ts/vals constant along the step axis, so Pallas skips the re-fetch DMA).
+
+A small jit finisher then derives any range function from these statistics
+(Prometheus extrapolation for rate/increase/delta). Runs in interpret mode
+on CPU for tests; compiled on TPU via ``interpret=False``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from .staging import StagedBlock
+
+BS = 64  # series per tile
+BJ = 16  # steps per tile
+NEG = -3.0e38  # python literals: jnp scalars would be captured consts
+POS = 3.0e38
+
+
+def _window_agg_kernel(params_ref, ts_ref, vals_ref, raw_ref, lens_ref,
+                       cnt_ref, sum_ref, min_ref, max_ref,
+                       tf_ref, tl_ref, vf_ref, vl_ref, rf_ref):
+    start = params_ref[0]
+    step = params_ref[1]
+    window = params_ref[2]
+    j0 = pl.program_id(1) * BJ
+    ts = ts_ref[:]  # [BS, T] i32
+    vals = vals_ref[:]
+    raw = raw_ref[:]
+    lens = lens_ref[:]  # [BS, 1]
+    T = ts.shape[1]
+    lane = jax.lax.broadcasted_iota(jnp.int32, (ts.shape[0], T), 1)
+    valid = lane < lens
+    IMAX = jnp.int32(2**31 - 1)
+    IMIN = jnp.int32(-(2**31) + 1)
+    for jj in range(BJ):  # static unroll: 2D vector ops only
+        t_j = start + (j0 + jj) * step
+        m = (ts <= t_j) & (ts > t_j - window) & valid
+        mf = m.astype(jnp.float32)
+        cnt = mf.sum(axis=1)
+        s = jnp.where(m, vals, 0.0).sum(axis=1)
+        mn = jnp.where(m, vals, POS).min(axis=1)
+        mx = jnp.where(m, vals, NEG).max(axis=1)
+        # boundary selection in exact int32 time (f32 would round >2^24 ms)
+        tmin = jnp.where(m, ts, IMAX).min(axis=1)
+        tmax = jnp.where(m, ts, IMIN).max(axis=1)
+        first_m = m & (ts == tmin[:, None])
+        last_m = m & (ts == tmax[:, None])
+        vf = jnp.where(first_m, vals, 0.0).sum(axis=1)
+        vl = jnp.where(last_m, vals, 0.0).sum(axis=1)
+        rf = jnp.where(first_m, raw, 0.0).sum(axis=1)
+        tmin = tmin.astype(jnp.float32)
+        tmax = tmax.astype(jnp.float32)
+        cnt_ref[:, jj] = cnt
+        sum_ref[:, jj] = s
+        min_ref[:, jj] = mn
+        max_ref[:, jj] = mx
+        tf_ref[:, jj] = tmin
+        tl_ref[:, jj] = tmax
+        vf_ref[:, jj] = vf
+        vl_ref[:, jj] = vl
+        rf_ref[:, jj] = rf
+
+
+@functools.partial(jax.jit, static_argnames=("num_steps", "interpret"))
+def window_aggregates(ts, vals, raw, lens, start_off, step_ms, window_ms,
+                      num_steps: int, interpret: bool = True):
+    """[S, T] staged block -> dict of [S, num_steps] per-window statistics."""
+    S, T = ts.shape
+    S_pad = ((S + BS - 1) // BS) * BS
+    J = ((num_steps + BJ - 1) // BJ) * BJ
+    if S_pad != S:
+        pad = ((0, S_pad - S), (0, 0))
+        ts = jnp.pad(ts, pad, constant_values=2**31 - 1)
+        vals = jnp.pad(vals, pad)
+        raw = jnp.pad(raw, pad)
+        lens = jnp.pad(lens, ((0, S_pad - S),))
+    from jax.experimental.pallas import tpu as pltpu
+
+    params = jnp.stack([start_off, step_ms, window_ms]).astype(jnp.int32)
+    lens2 = lens[:, None].astype(jnp.int32)
+    grid = (S_pad // BS, J // BJ)
+    # index maps receive the scalar-prefetch ref as a trailing arg
+    row_spec = pl.BlockSpec((BS, T), lambda i, j, *_: (i, 0))
+    out_spec = pl.BlockSpec((BS, BJ), lambda i, j, *_: (i, j))
+    out_shape = [jax.ShapeDtypeStruct((S_pad, J), jnp.float32)] * 9
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,  # params land in SMEM before the pipeline
+        grid=grid,
+        in_specs=[row_spec, row_spec, row_spec, pl.BlockSpec((BS, 1), lambda i, j, *_: (i, 0))],
+        out_specs=[out_spec] * 9,
+    )
+    outs = pl.pallas_call(
+        _window_agg_kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(params, ts, vals, raw, lens2)
+    names = ("count", "sum", "min", "max", "t_first", "t_last", "v_first", "v_last", "raw_first")
+    return dict(zip(names, outs))
+
+
+PALLAS_FUNCS = {
+    "sum_over_time", "count_over_time", "avg_over_time", "min_over_time",
+    "max_over_time", "last", "last_over_time", "first_over_time",
+    "present_over_time", "absent_over_time", "rate", "increase", "delta",
+}
+
+
+@functools.partial(jax.jit, static_argnames=("func", "is_counter", "is_delta"))
+def finish(func: str, agg: dict, start_off, step_ms, window_ms,
+           is_counter: bool = False, is_delta: bool = False):
+    """Derive a range function from the fused window statistics."""
+    cnt = agg["count"]
+    has = cnt > 0
+    nan = jnp.nan
+    if func == "sum_over_time" or (is_delta and func in ("rate", "increase")):
+        r = agg["sum"]
+        if func == "rate":
+            r = r / (window_ms.astype(jnp.float32) * 1e-3)
+        return jnp.where(has, r, nan)
+    if func == "count_over_time":
+        return jnp.where(has, cnt, nan)
+    if func == "avg_over_time":
+        return jnp.where(has, agg["sum"] / jnp.maximum(cnt, 1.0), nan)
+    if func == "min_over_time":
+        return jnp.where(has, agg["min"], nan)
+    if func == "max_over_time":
+        return jnp.where(has, agg["max"], nan)
+    if func in ("last", "last_over_time"):
+        return jnp.where(has, agg["v_last"], nan)
+    if func == "first_over_time":
+        return jnp.where(has, agg["v_first"], nan)
+    if func == "present_over_time":
+        return jnp.where(has, 1.0, nan)
+    if func == "absent_over_time":
+        return jnp.where(has, nan, 1.0)
+    if func in ("rate", "increase", "delta"):
+        J = cnt.shape[1]
+        out_t = (start_off + jnp.arange(J, dtype=jnp.int32) * step_ms).astype(jnp.float32)
+        f32 = jnp.float32
+        w_s = window_ms.astype(f32) * 1e-3
+        tf = agg["t_first"] * 1e-3
+        tl = agg["t_last"] * 1e-3
+        dlt = agg["v_last"] - agg["v_first"]
+        sampled = tl - tf
+        dur_start = tf - (out_t - window_ms.astype(f32))[None, :] * 1e-3
+        dur_end = out_t[None, :] * 1e-3 - tl
+        avg_dur = sampled / jnp.maximum(cnt - 1.0, 1.0)
+        thresh = avg_dur * 1.1
+        if is_counter and func != "delta":
+            dur_zero = jnp.where(dlt > 0, sampled * (agg["raw_first"] / jnp.maximum(dlt, 1e-30)), jnp.inf)
+            dur_start = jnp.minimum(dur_start, jnp.where(agg["raw_first"] >= 0, dur_zero, jnp.inf))
+        dur_start = jnp.where(dur_start >= thresh, avg_dur / 2.0, dur_start)
+        dur_end = jnp.where(dur_end >= thresh, avg_dur / 2.0, dur_end)
+        factor = (sampled + dur_start + dur_end) / jnp.maximum(sampled, 1e-30)
+        res = dlt * factor
+        if func == "rate":
+            res = res / w_s
+        return jnp.where(cnt >= 2, res, nan)
+    raise ValueError(f"pallas path does not support {func}")
+
+
+def run_pallas_range_function(func: str, block: StagedBlock, params,
+                              is_counter=False, is_delta=False, interpret=True):
+    from .kernels import pad_steps
+
+    J = pad_steps(params.num_steps)
+    start_off = np.int32(params.start_ms - block.base_ms)
+    raw = block.raw if block.raw is not None else block.vals
+    agg = window_aggregates(
+        block.ts, block.vals, raw, block.lens,
+        start_off, np.int32(params.step_ms), np.int32(params.window_ms), J,
+        interpret=interpret,
+    )
+    return finish(func, agg, start_off, np.int32(params.step_ms), np.int32(params.window_ms),
+                  is_counter=is_counter, is_delta=is_delta)
