@@ -91,7 +91,10 @@ def _filters_covered(rule, filters) -> bool:
 
 def optimize_with_preagg(plan: L.LogicalPlan, provider: AggRuleProvider) -> L.LogicalPlan:
     """Rewrite Aggregate(RawSeries...) subtrees to preagg metrics when the
-    rule covers both the grouping labels and the filters."""
+    rule covers both the grouping labels and the filters. ``no_optimize(...)``
+    wrappers opt a subtree out (reference NoOptimize marker)."""
+    if isinstance(plan, L.ApplyMiscellaneousFunction) and plan.function == "no_optimize":
+        return plan
     if isinstance(plan, L.Aggregate):
         if plan.op in _REWRITABLE_OPS and plan.by is not None:
             rewritten = _try_rewrite(plan, provider)

@@ -185,6 +185,18 @@ class SingleClusterPlanner:
             inner.transformers.append(InstantVectorFunctionMapper(p.function, p.args))
             return inner
         if isinstance(p, L.ApplyMiscellaneousFunction):
+            if p.function == "_filodb_chunkmeta_all":
+                from ..query.exec.plans import ChunkMetaExec
+
+                leaves = L.leaf_raw_series(p)
+                if not leaves:
+                    raise QueryError("_filodb_chunkmeta_all needs a selector")
+                raw = leaves[0]
+                plans = [
+                    ChunkMetaExec(s, raw.filters, raw.start_ms, raw.end_ms)
+                    for s in self.shards_for(None)
+                ]
+                return plans[0] if len(plans) == 1 else DistConcatExec(plans)
             inner = self._materialize(p.inner)
             inner.transformers.append(MiscellaneousFunctionMapper(p.function, p.str_args))
             return inner

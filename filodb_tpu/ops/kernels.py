@@ -339,7 +339,8 @@ def _holt_winters(ts, vals, lens, out_t, window, lo, hi, sf, tf):
 # quantile / mad: need per-window sorts — run in step blocks to bound memory
 @functools.partial(jax.jit, static_argnames=("func", "num_steps", "block"))
 def sorted_window_kernel(
-    func: str, ts, vals, lens, start_off, step_ms, window, num_steps: int, q=0.5, block: int = 16
+    func: str, ts, vals, lens, start_off, step_ms, window, num_steps: int,
+    q=0.5, arg1=0.0, block: int = 16
 ):
     S, T = ts.shape
     out_t_all = start_off + jnp.arange(num_steps, dtype=jnp.int32) * step_ms
@@ -351,8 +352,7 @@ def sorted_window_kernel(
         w = jnp.where(m, vals[:, None, :], jnp.inf)
         sw = jnp.sort(w, axis=-1)
 
-        def quantile_of(sorted_w, cnt):
-            rank = jnp.clip(q, 0.0, 1.0) * jnp.maximum(cnt - 1.0, 0.0)
+        def interp_at(sorted_w, rank):
             lo_i = jnp.floor(rank).astype(jnp.int32)
             hi_i = jnp.ceil(rank).astype(jnp.int32)
             frac = rank - lo_i.astype(jnp.float32)
@@ -360,21 +360,30 @@ def sorted_window_kernel(
             v_hi = jnp.take_along_axis(sorted_w, hi_i[..., None], axis=-1)[..., 0]
             return v_lo + (v_hi - v_lo) * frac
 
-        if func == "quantile_over_time":
-            r = quantile_of(sw, count)
-        elif func == "median_absolute_deviation_over_time":
-            med_q = 0.5 * jnp.maximum(count - 1.0, 0.0)
-            lo_i = jnp.floor(med_q).astype(jnp.int32)
-            hi_i = jnp.ceil(med_q).astype(jnp.int32)
-            frac = med_q - lo_i.astype(jnp.float32)
-            m_lo = jnp.take_along_axis(sw, lo_i[..., None], axis=-1)[..., 0]
-            m_hi = jnp.take_along_axis(sw, hi_i[..., None], axis=-1)[..., 0]
-            med = m_lo + (m_hi - m_lo) * frac
+        def mad_of(cnt):
+            med_rank = 0.5 * jnp.maximum(cnt - 1.0, 0.0)
+            med = interp_at(sw, med_rank)
             dev = jnp.where(m, jnp.abs(vals[:, None, :] - med[:, :, None]), jnp.inf)
             sd = jnp.sort(dev, axis=-1)
-            v_lo2 = jnp.take_along_axis(sd, lo_i[..., None], axis=-1)[..., 0]
-            v_hi2 = jnp.take_along_axis(sd, hi_i[..., None], axis=-1)[..., 0]
-            r = v_lo2 + (v_hi2 - v_lo2) * frac
+            return med, interp_at(sd, med_rank)
+
+        if func == "quantile_over_time":
+            rank = jnp.clip(q, 0.0, 1.0) * jnp.maximum(count - 1.0, 0.0)
+            r = interp_at(sw, rank)
+        elif func == "median_absolute_deviation_over_time":
+            _, r = mad_of(count)
+        elif func == "last_over_time_is_mad_outlier":
+            # (tolerance=q, bounds=arg1): emit the last value iff it lies
+            # outside median +/- tolerance*MAD per the bounds mode
+            # (reference LastOverTimeIsMadOutlierFunction,
+            # AggrOverTimeFunctions.scala:488)
+            med, mad = mad_of(count)
+            tmax = jnp.where(m, ts[:, None, :], -(2**31) + 1).max(-1)
+            lastv = jnp.where(m & (ts[:, None, :] == tmax[:, :, None]), vals[:, None, :], 0.0).sum(-1)
+            lower = med - q * mad
+            upper = med + q * mad
+            is_out = ((lastv < lower) & (arg1 <= 1)) | ((lastv > upper) & (arg1 >= 1))
+            r = jnp.where(is_out, lastv, _NAN)
         else:
             raise ValueError(func)
         return jnp.where(count > 0, r, _NAN)
@@ -384,7 +393,11 @@ def sorted_window_kernel(
     return jnp.moveaxis(out, 0, 1).reshape(S, num_steps)
 
 
-SORTED_FUNCS = {"quantile_over_time", "median_absolute_deviation_over_time"}
+SORTED_FUNCS = {
+    "quantile_over_time",
+    "median_absolute_deviation_over_time",
+    "last_over_time_is_mad_outlier",
+}
 
 
 # ---------------------------------------------------------------------------
@@ -426,6 +439,7 @@ def run_range_function(
             np.int32(params.window_ms),
             j_pad,
             q=np.float32(args[0]) if args else np.float32(0.5),
+            arg1=np.float32(args[1]) if len(args) > 1 else np.float32(0.0),
         )
     a0 = np.float32(args[0]) if len(args) > 0 else np.float32(0.0)
     a1 = np.float32(args[1]) if len(args) > 1 else np.float32(0.0)

@@ -195,6 +195,41 @@ class EmptyResultExec(ExecPlan):
         return QueryResult()
 
 
+class ChunkMetaExec(ExecPlan):
+    """Chunk metadata debug query (reference SelectChunkInfosExec /
+    _filodb_chunkmeta_all): per-series list of resident chunks."""
+
+    def __init__(self, shard_num, filters, start_ms, end_ms):
+        super().__init__()
+        self.shard_num = shard_num
+        self.filters = tuple(filters)
+        self.start_ms = start_ms
+        self.end_ms = end_ms
+
+    def do_execute(self, ctx: QueryContext) -> QueryResult:
+        shard = ctx.memstore.shard(ctx.dataset, self.shard_num)
+        pids = shard.lookup_partitions(self.filters, self.start_ms, self.end_ms)
+        out = []
+        for pid in pids:
+            part = shard.partition(int(pid))
+            out.append(
+                {
+                    "labels": dict(part.tags),
+                    "schema": part.schema.name,
+                    "numChunks": len(part.chunks),
+                    "bufferedSamples": part.num_samples() - sum(c.n for c in part.chunks),
+                    "chunks": [
+                        {"startTime": c.start_ts, "endTime": c.end_ts, "numRows": c.n,
+                         "encodedBytes": c.nbytes_encoded}
+                        for c in part.chunks_in_range(self.start_ms, self.end_ms)
+                    ],
+                }
+            )
+        res = QueryResult(metadata=out)
+        res.result_type = "metadata"
+        return res
+
+
 class RawChunkExportExec(ExecPlan):
     """Top-level m[5m] raw export (reference SelectRawPartitionsExec without
     periodic mapping): returns actual samples."""
@@ -253,6 +288,9 @@ class DistConcatExec(NonLeafExecPlan):
                 out.raw = (out.raw or []) + r.raw
             if r.scalar is not None:
                 out.scalar = r.scalar
+            if r.metadata is not None:
+                out.metadata = (out.metadata or []) + r.metadata
+                out.result_type = r.result_type
         return out
 
 

@@ -166,10 +166,20 @@ class InstantVectorFunctionMapper:
             lo, hi = np.float32(self.args[0]), np.float32(self.args[1])
             vals = HK.histogram_fraction(lo, hi, g.hist, jnp.asarray(g.les, dtype=jnp.float32))
             return Grid([_strip_metric(l) for l in g.labels], g.start_ms, g.step_ms, g.num_steps, vals)
-        if f == "histogram_max_quantile":
+        if f in ("histogram_max_quantile", "histogram_max_quantile_even"):
             q = np.float32(self.args[0])
             vals = HK.histogram_quantile(q, g.hist, jnp.asarray(g.les, dtype=jnp.float32))
             return Grid([_strip_metric(l) for l in g.labels], g.start_ms, g.step_ms, g.num_steps, vals)
+        if f == "histogram_bucket":
+            # select one bucket's counts from a native histogram
+            if g.hist is None:
+                raise QueryError("histogram_bucket needs native-histogram input")
+            le = float(self.args[0])
+            les = np.asarray(g.les, dtype=np.float64)
+            idx = int(np.argmin(np.abs(np.nan_to_num(les, posinf=1e308) - le)))
+            vals = jnp.asarray(g.hist)[..., idx]
+            labels = [dict(_strip_metric(l), le=("+Inf" if np.isinf(les[idx]) else f"{les[idx]:g}")) for l in g.labels]
+            return Grid(labels, g.start_ms, g.step_ms, g.num_steps, vals)
         if f == "hist_to_prom_vectors":
             return self._hist_to_prom(g)
         if f == "clamp":
@@ -181,6 +191,9 @@ class InstantVectorFunctionMapper:
         elif f == "round":
             to = self.args[0] if self.args else 1.0
             v = jnp.round(jnp.asarray(g.values) / to) * to
+        elif f == "or_vector":
+            # NaN samples replaced by the default scalar (reference OrVectorImpl)
+            v = jnp.where(jnp.isnan(jnp.asarray(g.values)), self.args[0], jnp.asarray(g.values))
         elif f == "timestamp":
             t = g.step_times_ms().astype(np.float64) / 1e3
             vn = g.values_np()
@@ -288,6 +301,8 @@ class MiscellaneousFunctionMapper:
     str_args: tuple = ()
 
     def apply(self, grids: list[Grid]) -> list[Grid]:
+        if self.function in ("optimize_with_agg", "no_optimize"):
+            return grids  # planner-level markers; no-op at execution
         if self.function == "label_replace":
             dst, repl, src, regex_s = self.str_args
             pat = re.compile(regex_s)

@@ -39,6 +39,8 @@ RANGE_FUNCTIONS: dict[str, tuple[str, int, bool]] = {
     "rate_over_delta": ("rate", 0, False),  # delta-counter rate alias
     "increase_over_delta": ("increase", 0, False),
     "avg_with_sum_and_count_over_time": ("avg_over_time", 0, False),
+    # (tolerance, bounds_mode, rv) — reference LastOverTimeIsMadOutlier
+    "last_over_time_is_mad_outlier": ("last_over_time_is_mad_outlier", 2, True),
 }
 
 # instant functions applied elementwise on [S, J] grids
@@ -46,14 +48,19 @@ INSTANT_FUNCTIONS = {
     "abs", "ceil", "exp", "floor", "ln", "log2", "log10", "sqrt", "sgn",
     "acos", "acosh", "asin", "asinh", "atan", "atanh", "cos", "cosh", "sin",
     "sinh", "tan", "tanh", "deg", "rad",
-    "clamp", "clamp_max", "clamp_min", "round",
+    "clamp", "clamp_max", "clamp_min", "round", "or_vector",
     "histogram_quantile", "histogram_fraction", "histogram_max_quantile",
+    "histogram_max_quantile_even", "histogram_bucket",
     "hist_to_prom_vectors",
     "timestamp",
 }
 
 # misc functions handled host-side on labels / ordering
-MISC_FUNCTIONS = {"label_replace", "label_join", "sort", "sort_desc", "absent", "scalar", "vector"}
+MISC_FUNCTIONS = {
+    "label_replace", "label_join", "sort", "sort_desc", "absent", "scalar",
+    "vector", "limit", "optimize_with_agg", "no_optimize",
+    "_filodb_chunkmeta_all",
+}
 
 # 0-arity or optional-vector time functions
 TIME_FUNCTIONS = {

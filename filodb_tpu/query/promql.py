@@ -624,6 +624,13 @@ class Converter:
             return ScalarVaryingDoublePlan(self.to_plan(c.args[0]), "scalar")
         if name == "vector":
             return ScalarVaryingDoublePlan(self.to_plan(c.args[0]), "vector")
+        if name == "limit":
+            if len(c.args) != 2 or not isinstance(c.args[0], NumLit):
+                raise PromQLError("limit expects (n, expr)")
+            return ApplyLimitFunction(self.to_plan(c.args[1]), int(c.args[0].value))
+        if name in ("optimize_with_agg", "no_optimize", "_filodb_chunkmeta_all"):
+            # planner/lpopt markers + chunk-metadata debug wrapper
+            return ApplyMiscellaneousFunction(self.to_plan(c.args[0]), name)
         if name in ("label_replace", "label_join"):
             inner = self.to_plan(c.args[0])
             strs = []

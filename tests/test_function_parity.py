@@ -1,0 +1,85 @@
+"""Parity tests for the remaining reference-specific functions
+(LastOverTimeIsMadOutlier, OrVector, histogram_bucket, limit, optimize
+markers, chunkmeta debug)."""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.coordinator.lpopt import AggRuleProvider, IncludeAggRule, optimize_with_preagg
+from filodb_tpu.coordinator.planner import QueryEngine
+from filodb_tpu.core.schemas import Dataset
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.memstore.shard import StoreConfig
+from filodb_tpu.query import logical as L
+from filodb_tpu.query.promql import query_range_to_logical_plan
+from filodb_tpu.testkit import histogram_batch, machine_metrics
+
+BASE = 1_600_000_000_000
+START_S = (BASE + 600_000) / 1000
+END_S = (BASE + 1_500_000) / 1000
+
+
+@pytest.fixture(scope="module")
+def engine():
+    ms = TimeSeriesMemStore(StoreConfig(max_chunk_size=100))
+    ms.setup(Dataset("prometheus"), [0, 1])
+    ms.ingest_routed("prometheus", machine_metrics(n_series=4, n_samples=200, start_ms=BASE), spread=1)
+    ms.ingest_routed("prometheus", histogram_batch(n_series=2, n_samples=150, start_ms=BASE), spread=1)
+    return QueryEngine(ms, "prometheus")
+
+
+def test_mad_outlier_flags_anomaly(engine):
+    # gauges are ~N(50,20): with tolerance 3 most windows are not outliers
+    res = engine.query_range(
+        "last_over_time_is_mad_outlier(1000, 1, heap_usage0[10m])", START_S, END_S, 60)
+    assert not list(res.all_series())  # huge tolerance -> nothing flagged
+    res2 = engine.query_range(
+        "last_over_time_is_mad_outlier(0.001, 1, heap_usage0[10m])", START_S, END_S, 60)
+    assert list(res2.all_series())  # tiny tolerance -> everything flagged
+
+
+def test_or_vector_fills_nans(engine):
+    # windows before data start are NaN; or_vector turns them into 7
+    res = engine.query_range("or_vector(sum_over_time(heap_usage0[30s]), 7)", START_S, END_S, 120)
+    for _, _, vals in res.all_series():
+        assert not np.isnan(vals).any()
+
+
+def test_histogram_bucket_selects_le(engine):
+    res = engine.query_range(
+        "histogram_bucket(0.5, rate(http_request_latency[5m]))", START_S, END_S, 60)
+    series = list(res.all_series())
+    assert len(series) == 2
+    for lbls, _, vals in series:
+        assert lbls["le"] == "0.5"
+        assert (vals >= 0).all()
+
+
+def test_limit_function(engine):
+    res = engine.query_range("limit(2, heap_usage0)", START_S, END_S, 60)
+    assert sum(g.n_series for g in res.grids) == 2
+
+
+def test_no_optimize_marker_blocks_preagg():
+    provider = AggRuleProvider([IncludeAggRule("m", frozenset({"job"}))])
+    p1 = optimize_with_preagg(
+        query_range_to_logical_plan("sum by (job) (m)", 1000, 2000, 15), provider)
+    p2 = optimize_with_preagg(
+        query_range_to_logical_plan("no_optimize(sum by (job) (m))", 1000, 2000, 15), provider)
+    m1 = [f.value for rs in L.leaf_raw_series(p1) for f in rs.filters if f.column == "_metric_"]
+    m2 = [f.value for rs in L.leaf_raw_series(p2) for f in rs.filters if f.column == "_metric_"]
+    assert m1 == ["m:agg"] and m2 == ["m"]
+
+
+def test_optimize_marker_executes_as_noop(engine):
+    res = engine.query_range("no_optimize(sum(heap_usage0))", START_S, END_S, 60)
+    assert sum(g.n_series for g in res.grids) == 1
+
+
+def test_chunkmeta_debug_query(engine):
+    res = engine.query_range("_filodb_chunkmeta_all(heap_usage0)", START_S, END_S, 60)
+    assert res.metadata is not None
+    assert len(res.metadata) == 4
+    rec = res.metadata[0]
+    assert rec["schema"] == "gauge" and rec["numChunks"] >= 2
+    assert rec["chunks"][0]["numRows"] == 100
