@@ -57,6 +57,7 @@ class ExcludeAggRule:
 @dataclass
 class AggRuleProvider:
     rules: list = None
+    enabled: bool = True  # global gate; optimize_with_agg() overrides per-query
 
     def __post_init__(self):
         self.rules = self.rules or []
@@ -89,23 +90,30 @@ def _filters_covered(rule, filters) -> bool:
     return True
 
 
-def optimize_with_preagg(plan: L.LogicalPlan, provider: AggRuleProvider) -> L.LogicalPlan:
+def optimize_with_preagg(
+    plan: L.LogicalPlan, provider: AggRuleProvider, force: bool = False
+) -> L.LogicalPlan:
     """Rewrite Aggregate(RawSeries...) subtrees to preagg metrics when the
     rule covers both the grouping labels and the filters. ``no_optimize(...)``
-    wrappers opt a subtree out (reference NoOptimize marker)."""
-    if isinstance(plan, L.ApplyMiscellaneousFunction) and plan.function == "no_optimize":
-        return plan
+    opts a subtree out; ``optimize_with_agg(...)`` forces the rewrite even
+    when the provider is globally disabled (reference NoOptimize /
+    OptimizeWithAgg markers)."""
+    if isinstance(plan, L.ApplyMiscellaneousFunction):
+        if plan.function == "no_optimize":
+            return plan
+        if plan.function == "optimize_with_agg":
+            return replace(plan, inner=optimize_with_preagg(plan.inner, provider, force=True))
     if isinstance(plan, L.Aggregate):
-        if plan.op in _REWRITABLE_OPS and plan.by is not None:
+        if (provider.enabled or force) and plan.op in _REWRITABLE_OPS and plan.by is not None:
             rewritten = _try_rewrite(plan, provider)
             if rewritten is not None:
                 return rewritten
-        return replace(plan, inner=optimize_with_preagg(plan.inner, provider))
+        return replace(plan, inner=optimize_with_preagg(plan.inner, provider, force))
     kw = {}
     for f in getattr(plan, "__dataclass_fields__", {}):
         v = getattr(plan, f)
         if isinstance(v, L.LogicalPlan) and not isinstance(v, L.RawSeries):
-            kw[f] = optimize_with_preagg(v, provider)
+            kw[f] = optimize_with_preagg(v, provider, force)
     return replace(plan, **kw) if kw else plan
 
 

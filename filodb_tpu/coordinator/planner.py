@@ -189,8 +189,11 @@ class SingleClusterPlanner:
                 from ..query.exec.plans import ChunkMetaExec
 
                 leaves = L.leaf_raw_series(p)
-                if not leaves:
-                    raise QueryError("_filodb_chunkmeta_all needs a selector")
+                if len(leaves) != 1:
+                    raise QueryError(
+                        "_filodb_chunkmeta_all needs exactly one selector, "
+                        f"got {len(leaves)}"
+                    )
                 raw = leaves[0]
                 plans = [
                     ChunkMetaExec(s, raw.filters, raw.start_ms, raw.end_ms)

@@ -82,14 +82,16 @@ def hist_range_kernel(
     raise ValueError(f"unknown histogram range function {func}")
 
 
-@jax.jit
-def histogram_quantile(q, buckets, les):
+@functools.partial(jax.jit, static_argnames=("even",))
+def histogram_quantile(q, buckets, les, even: bool = False):
     """Prometheus histogram_quantile over bucket-count/rate grids.
 
     buckets [..., B] cumulative counts per le; les [B] upper bounds with
     les[-1] = +inf. Linear interpolation within the located bucket; lower
     bound of the first bucket is 0 when its le > 0 (promql semantics, and
-    reference Histogram.scala:64-130 quantile()).
+    reference Histogram.scala:64-130 quantile()). ``even`` assumes samples
+    spread evenly over count+1 positions (reference evenDistribution,
+    Histogram.scala:96).
     """
     B = buckets.shape[-1]
     total = buckets[..., -1]
@@ -106,7 +108,8 @@ def histogram_quantile(q, buckets, les):
     # top (+inf) bucket: return the highest finite bound (promql behavior)
     highest_finite = jnp.where(B >= 2, les[B - 2], les[0])
     in_top = idx == B - 1
-    frac = (rank - c_lo) / jnp.maximum(c_hi - c_lo, 1e-30)
+    denom = (c_hi - c_lo + 1.0) if even else (c_hi - c_lo)
+    frac = (rank - c_lo) / jnp.maximum(denom, 1e-30)
     val = le_lo + (le_hi - le_lo) * frac
     # q<=0 -> lower bound of first bucket; q>=1 -> highest bound
     val = jnp.where(in_top, highest_finite, val)

@@ -168,17 +168,30 @@ class InstantVectorFunctionMapper:
             return Grid([_strip_metric(l) for l in g.labels], g.start_ms, g.step_ms, g.num_steps, vals)
         if f in ("histogram_max_quantile", "histogram_max_quantile_even"):
             q = np.float32(self.args[0])
-            vals = HK.histogram_quantile(q, g.hist, jnp.asarray(g.les, dtype=jnp.float32))
+            vals = HK.histogram_quantile(
+                q, g.hist, jnp.asarray(g.les, dtype=jnp.float32),
+                even=(f == "histogram_max_quantile_even"),
+            )
             return Grid([_strip_metric(l) for l in g.labels], g.start_ms, g.step_ms, g.num_steps, vals)
         if f == "histogram_bucket":
-            # select one bucket's counts from a native histogram
+            # select one bucket's counts; le must match a bucket bound exactly
+            # (reference HistogramBucketImpl: 1e-10 tolerance, NaN otherwise,
+            # +Inf selects the top bucket)
             if g.hist is None:
                 raise QueryError("histogram_bucket needs native-histogram input")
             le = float(self.args[0])
             les = np.asarray(g.les, dtype=np.float64)
-            idx = int(np.argmin(np.abs(np.nan_to_num(les, posinf=1e308) - le)))
-            vals = jnp.asarray(g.hist)[..., idx]
-            labels = [dict(_strip_metric(l), le=("+Inf" if np.isinf(les[idx]) else f"{les[idx]:g}")) for l in g.labels]
+            if np.isinf(le):
+                idx = len(les) - 1
+            else:
+                matches = np.nonzero(np.abs(les - le) < 1e-10)[0]
+                idx = int(matches[0]) if len(matches) else -1
+            if idx < 0:
+                vals = np.full((g.n_series, g.num_steps), np.nan, np.float32)
+            else:
+                vals = jnp.asarray(g.hist)[..., idx]
+            le_str = "+Inf" if idx >= 0 and np.isinf(les[idx]) else f"{le:g}"
+            labels = [dict(_strip_metric(l), le=le_str) for l in g.labels]
             return Grid(labels, g.start_ms, g.step_ms, g.num_steps, vals)
         if f == "hist_to_prom_vectors":
             return self._hist_to_prom(g)

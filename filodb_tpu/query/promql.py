@@ -630,6 +630,8 @@ class Converter:
             return ApplyLimitFunction(self.to_plan(c.args[1]), int(c.args[0].value))
         if name in ("optimize_with_agg", "no_optimize", "_filodb_chunkmeta_all"):
             # planner/lpopt markers + chunk-metadata debug wrapper
+            if len(c.args) != 1:
+                raise PromQLError(f"{name} expects exactly one argument")
             return ApplyMiscellaneousFunction(self.to_plan(c.args[0]), name)
         if name in ("label_replace", "label_join"):
             inner = self.to_plan(c.args[0])

@@ -89,3 +89,15 @@ class TestScope:
                 if f.column == "_metric_":
                     metrics.add(f.value)
         assert metrics == {"http_requests_total:agg", "other"}
+
+
+class TestMarkers:
+    def test_disabled_provider_skips_unless_forced(self):
+        from filodb_tpu.coordinator.lpopt import AggRuleProvider, IncludeAggRule
+        disabled = AggRuleProvider(
+            [IncludeAggRule("http_requests_total", frozenset({"job"}))], enabled=False)
+        p = optimize_with_preagg(plan("sum by (job) (http_requests_total)"), disabled)
+        assert metric_of(p) == "http_requests_total"
+        p2 = optimize_with_preagg(
+            plan("optimize_with_agg(sum by (job) (http_requests_total))"), disabled)
+        assert metric_of(p2) == "http_requests_total:agg"
