@@ -1,0 +1,38 @@
+"""Spread provider (reference L5: spread-assignment config in
+filodb-defaults.conf + SpreadChange/SpreadProvider — per-shard-key spread
+overrides so high-volume tenants fan out over more shards than the default).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Mapping, Sequence
+
+
+@dataclass(frozen=True)
+class SpreadChange:
+    """Spread override for an exact shard-key match (e.g. one _ws_/_ns_)."""
+
+    keys: tuple[tuple[str, str], ...]  # ((label, value), ...)
+    spread: int
+
+
+class SpreadProvider:
+    def __init__(self, default_spread: int = 3, overrides: Sequence[SpreadChange] = ()):
+        self.default_spread = default_spread
+        self._overrides = list(overrides)
+
+    @classmethod
+    def from_config(cls, cfg: Mapping) -> "SpreadProvider":
+        """cfg: {"default": 3, "overrides": [{"keys": {"_ws_": "w", "_ns_": "n"}, "spread": 5}]}"""
+        overrides = [
+            SpreadChange(tuple(sorted(o["keys"].items())), int(o["spread"]))
+            for o in cfg.get("overrides", ())
+        ]
+        return cls(int(cfg.get("default", 3)), overrides)
+
+    def spread_for(self, tags: Mapping[str, str]) -> int:
+        for o in self._overrides:
+            if all(tags.get(k) == v for k, v in o.keys):
+                return o.spread
+        return self.default_spread

@@ -1,0 +1,79 @@
+"""Index lifecycle metadata store (reference L2:
+memstore/IndexMetadataStore.scala — file-system & ephemeral impls tracking
+per-shard index state: Empty/Building/Synced/Refreshing + checkpoint
+timestamps; used by DownsampleIndexBootstrapper and
+DownsampleIndexCheckpointer.java to make index rebuilds restartable)."""
+
+from __future__ import annotations
+
+import enum
+import json
+import os
+import time
+from dataclasses import dataclass
+
+
+class IndexState(enum.Enum):
+    EMPTY = "empty"
+    BUILDING = "building"
+    SYNCED = "synced"
+    REFRESHING = "refreshing"
+    TRIGGER_REBUILD = "trigger_rebuild"
+
+
+@dataclass
+class IndexMetadata:
+    state: IndexState
+    checkpoint_ms: int  # data watermark the index covers
+    updated_at: float
+
+
+class EphemeralIndexMetadataStore:
+    """In-memory impl (reference EphemeralIndexMetadataStore)."""
+
+    def __init__(self):
+        self._state: dict[tuple[str, int], IndexMetadata] = {}
+
+    def get(self, dataset: str, shard: int) -> IndexMetadata:
+        return self._state.get(
+            (dataset, shard), IndexMetadata(IndexState.EMPTY, 0, 0.0)
+        )
+
+    def update(self, dataset: str, shard: int, state: IndexState, checkpoint_ms: int) -> None:
+        self._state[(dataset, shard)] = IndexMetadata(state, checkpoint_ms, time.time())
+
+
+class FileIndexMetadataStore(EphemeralIndexMetadataStore):
+    """File-backed impl (reference FileSystemBasedIndexMetadataStore /
+    DownsampleIndexCheckpointer): survives restarts so an interrupted index
+    build resumes from its checkpoint."""
+
+    def __init__(self, root: str):
+        super().__init__()
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        self._load()
+
+    def _path(self) -> str:
+        return os.path.join(self.root, "index_metadata.json")
+
+    def _load(self) -> None:
+        if not os.path.exists(self._path()):
+            return
+        with open(self._path()) as f:
+            for rec in json.load(f):
+                self._state[(rec["dataset"], rec["shard"])] = IndexMetadata(
+                    IndexState(rec["state"]), rec["checkpoint_ms"], rec["updated_at"]
+                )
+
+    def update(self, dataset: str, shard: int, state: IndexState, checkpoint_ms: int) -> None:
+        super().update(dataset, shard, state, checkpoint_ms)
+        data = [
+            {"dataset": d, "shard": s, "state": m.state.value,
+             "checkpoint_ms": m.checkpoint_ms, "updated_at": m.updated_at}
+            for (d, s), m in self._state.items()
+        ]
+        tmp = self._path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(data, f)
+        os.replace(tmp, self._path())

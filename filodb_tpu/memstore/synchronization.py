@@ -1,0 +1,63 @@
+"""Cross-cluster part-key index synchronization (reference L2:
+memstore/synchronization/ PartKeyUpdatesPublisher — shards publish partkey
+create/update events to an updates log which peer clusters (e.g. the
+downsample cluster's index) consume to keep their indexes fresh without
+full rebuilds).
+
+The log here is any object with ``append(record)``; consumers poll
+``PartKeyUpdatesConsumer.apply_to_index``.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Mapping
+
+
+@dataclass
+class PartKeyUpdate:
+    shard: int
+    tags: Mapping[str, str]
+    start_ts: int
+    end_ts: int
+    ts: float = field(default_factory=time.time)
+
+
+class PartKeyUpdatesPublisher:
+    """Attach to a shard: records partkey adds and end-time updates."""
+
+    def __init__(self, shard_num: int, capacity: int = 100_000):
+        self.shard_num = shard_num
+        self.updates: list[PartKeyUpdate] = []
+        self.capacity = capacity
+        self.dropped = 0
+
+    def publish(self, tags, start_ts, end_ts=2**62) -> None:
+        if len(self.updates) >= self.capacity:
+            self.dropped += 1
+            return
+        self.updates.append(PartKeyUpdate(self.shard_num, dict(tags), start_ts, end_ts))
+
+    def drain(self) -> list[PartKeyUpdate]:
+        out, self.updates = self.updates, []
+        return out
+
+
+class PartKeyUpdatesConsumer:
+    """Applies drained updates to a peer index (reference DSIndexJob's
+    incremental path)."""
+
+    def __init__(self, index):
+        self.index = index
+        self._next_id = 10_000_000  # ids disjoint from locally-created parts
+
+    def apply(self, updates) -> int:
+        n = 0
+        for u in updates:
+            from ..core.schemas import canonical_partkey
+
+            self.index.add_partkey(self._next_id, dict(u.tags), u.start_ts, u.end_ts)
+            self._next_id += 1
+            n += 1
+        return n

@@ -1,0 +1,71 @@
+"""Tests for auxiliary components: index metadata store, partkey sync,
+spread provider (model: reference IndexMetadataStore / synchronization /
+spread-assignment specs)."""
+
+import numpy as np
+
+from filodb_tpu.coordinator.spread import SpreadChange, SpreadProvider
+from filodb_tpu.core.filters import equals
+from filodb_tpu.memstore.index import PartKeyIndex
+from filodb_tpu.memstore.index_metadata import (
+    EphemeralIndexMetadataStore,
+    FileIndexMetadataStore,
+    IndexState,
+)
+from filodb_tpu.memstore.synchronization import (
+    PartKeyUpdatesConsumer,
+    PartKeyUpdatesPublisher,
+)
+
+
+class TestIndexMetadata:
+    def test_lifecycle(self):
+        s = EphemeralIndexMetadataStore()
+        assert s.get("ds", 0).state == IndexState.EMPTY
+        s.update("ds", 0, IndexState.BUILDING, 1000)
+        s.update("ds", 0, IndexState.SYNCED, 2000)
+        m = s.get("ds", 0)
+        assert m.state == IndexState.SYNCED and m.checkpoint_ms == 2000
+
+    def test_file_backed_survives_restart(self, tmp_path):
+        s1 = FileIndexMetadataStore(str(tmp_path))
+        s1.update("ds", 3, IndexState.BUILDING, 5000)
+        s2 = FileIndexMetadataStore(str(tmp_path))
+        m = s2.get("ds", 3)
+        assert m.state == IndexState.BUILDING and m.checkpoint_ms == 5000
+
+
+class TestPartKeySync:
+    def test_publish_drain_apply(self):
+        pub = PartKeyUpdatesPublisher(shard_num=2)
+        for i in range(5):
+            pub.publish({"_metric_": f"m{i}", "host": "a"}, start_ts=i * 100)
+        updates = pub.drain()
+        assert len(updates) == 5 and not pub.updates
+        peer = PartKeyIndex()
+        n = PartKeyUpdatesConsumer(peer).apply(updates)
+        assert n == 5
+        assert len(peer.part_ids_from_filters([equals("host", "a")], 0, 2**62)) == 5
+
+    def test_capacity_drops(self):
+        pub = PartKeyUpdatesPublisher(0, capacity=2)
+        for i in range(4):
+            pub.publish({"m": str(i)}, 0)
+        assert len(pub.updates) == 2 and pub.dropped == 2
+
+
+class TestSpreadProvider:
+    def test_default_and_override(self):
+        sp = SpreadProvider(3, [
+            SpreadChange((("_ns_", "big-app"), ("_ws_", "demo")), 6),
+        ])
+        assert sp.spread_for({"_ws_": "demo", "_ns_": "small"}) == 3
+        assert sp.spread_for({"_ws_": "demo", "_ns_": "big-app"}) == 6
+
+    def test_from_config(self):
+        sp = SpreadProvider.from_config({
+            "default": 2,
+            "overrides": [{"keys": {"_ws_": "w"}, "spread": 5}],
+        })
+        assert sp.spread_for({"_ws_": "other"}) == 2
+        assert sp.spread_for({"_ws_": "w", "_ns_": "anything"}) == 5
