@@ -92,6 +92,9 @@ class PlannerParams:
     spread: int = 3
     lookback_ms: int = 300_000
     max_series: int = 1_000_000
+    # optional jax.sharding.Mesh: distributed aggregations compile to one
+    # psum program over the shard axis instead of host-side merging
+    mesh: object | None = None
 
 
 class SingleClusterPlanner:
@@ -240,6 +243,9 @@ class SingleClusterPlanner:
         raise QueryError(f"cannot materialize {type(p).__name__}")
 
     def _materialize_aggregate(self, p: L.Aggregate) -> ExecPlan:
+        mesh_plan = self._try_mesh_aggregate(p)
+        if mesh_plan is not None:
+            return mesh_plan
         inner = self._materialize(p.inner)
         simple = p.op in _PARTIAL_COMPONENTS
         if simple and isinstance(inner, DistConcatExec) and not inner.transformers:
@@ -252,6 +258,41 @@ class SingleClusterPlanner:
             inner.transformers.append(AggregateMapReduce(p.op, p.by, p.without))
             return ReduceAggregateExec([inner], p.op, p.by, p.without)
         return AggregatePresentExec([inner], p.op, p.params, p.by, p.without)
+
+    def _try_mesh_aggregate(self, p: L.Aggregate):
+        """Mesh path: aggregate-of-range-function compiles to one psum
+        program when a device mesh is configured."""
+        mesh = self.params.mesh
+        if mesh is None:
+            return None
+        from ..parallel.exec import MESH_OPS, MeshAggregateExec
+
+        inner = p.inner
+        if p.op not in MESH_OPS:
+            return None
+        if not isinstance(inner, L.PeriodicSeriesWithWindowing):
+            return None
+        from ..ops.kernels import SORTED_FUNCS
+
+        if (
+            inner.offset_ms
+            or inner.at_ms is not None
+            or inner.function in SORTED_FUNCS
+            or inner.function_args
+        ):
+            return None
+        shards = self.shards_for(None)
+        if len(shards) > mesh.devices.size:
+            return None
+        # counter-ness resolved at execution from schemas; assume cumulative
+        # counter when the function is the counter family
+        is_counter = inner.function in ("rate", "increase", "irate")
+        return MeshAggregateExec(
+            mesh, shards, inner.raw.filters, inner.raw.start_ms, inner.raw.end_ms,
+            p.op, p.by, p.without, inner.function,
+            inner.start_ms, inner.end_ms, inner.step_ms, inner.window_ms,
+            is_counter=is_counter,
+        )
 
 
 def _plan_times(p: L.LogicalPlan):

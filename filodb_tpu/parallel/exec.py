@@ -1,0 +1,105 @@
+"""Mesh-resident distributed aggregation exec (the end-to-end wiring of
+parallel/mesh.py into the exec tree): when the planner is configured with a
+device mesh, `sum|min|max|count|avg by (...) (range_fn(...))` executes as ONE
+compiled program — per-device range kernel + local segment-reduce + psum over
+the `shard` axis — instead of host-side partial merging (reference: the
+ReduceAggregateExec network gather this replaces).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops import aggregations as AGG
+from ..ops import kernels as K
+from ..ops import staging as ST
+from ..query.exec.plans import ExecPlan, QueryContext
+from ..query.exec.transformers import QueryError, _strip_metric
+from ..query.rangevector import Grid, QueryResult
+from . import mesh as M
+
+MESH_OPS = {"sum", "count", "avg", "min", "max"}
+
+
+class MeshAggregateExec(ExecPlan):
+    """Aggregate a windowed range function across shards on the mesh."""
+
+    def __init__(self, mesh, shard_nums, filters, raw_start_ms, raw_end_ms,
+                 op: str, by, without, function: str,
+                 start_ms: int, end_ms: int, step_ms: int, window_ms: int,
+                 is_counter=False, is_delta=False):
+        super().__init__()
+        self.mesh = mesh
+        self.shard_nums = list(shard_nums)
+        self.filters = tuple(filters)
+        self.raw_start_ms = raw_start_ms
+        self.raw_end_ms = raw_end_ms
+        self.op = op
+        self.by = by
+        self.without = without
+        self.function = function
+        self.start_ms = start_ms
+        self.end_ms = end_ms
+        self.step_ms = step_ms
+        self.window_ms = window_ms
+        self.is_counter = is_counter
+        self.is_delta = is_delta
+
+    def args_str(self):
+        return (
+            f"op={self.op} fn={self.function} shards={self.shard_nums} "
+            f"devices={self.mesh.devices.size}"
+        )
+
+    def do_execute(self, ctx: QueryContext) -> QueryResult:
+        n_dev = self.mesh.devices.size
+        if len(self.shard_nums) > n_dev:
+            raise QueryError(
+                f"{len(self.shard_nums)} shards > {n_dev} mesh devices"
+            )
+        # stage per shard (host) and compute GLOBAL group numbering so the
+        # on-device segment ids agree across every shard
+        blocks, labels_per_shard = [], []
+        for s in self.shard_nums:
+            shard = ctx.memstore.shard(ctx.dataset, s)
+            pids = shard.lookup_partitions(self.filters, self.raw_start_ms, self.raw_end_ms)
+            if shard.odp_store is not None and len(pids):
+                shard.odp_page_in(pids, self.raw_start_ms, self.raw_end_ms)
+            block = ST.stage_from_shard(
+                shard, pids, self._column(ctx, shard, pids), self.raw_start_ms,
+                self.raw_end_ms, is_counter=self.is_counter and not self.is_delta,
+            )
+            labels = [dict(shard.partition(int(p)).tags) for p in pids]
+            ctx.stats.series_scanned += len(pids)
+            blocks.append(block)
+            labels_per_shard.append(labels)
+        all_labels = [l for ls in labels_per_shard for l in ls]
+        if not all_labels:
+            return QueryResult()
+        gids_all, group_labels = AGG.group_ids_for(
+            all_labels, list(self.by) if self.by else None,
+            list(self.without) if self.without else None,
+        )
+        gids_per_block, off = [], 0
+        for ls in labels_per_shard:
+            gids_per_block.append(gids_all[off : off + len(ls)].astype(np.int32))
+            off += len(ls)
+        arrays = M.stack_blocks_for_mesh(blocks, gids_per_block, n_dev)
+        sharded = M.shard_arrays(self.mesh, *arrays)
+        num_steps = int((self.end_ms - self.start_ms) // self.step_ms) + 1
+        j_pad = K.pad_steps(num_steps)
+        base = blocks[0].base_ms
+        out = M.distributed_agg_range(
+            self.mesh, self.function, self.op, *sharded,
+            np.int32(self.start_ms - base), np.int32(self.step_ms),
+            np.int32(self.window_ms), j_pad, len(group_labels),
+            is_counter=self.is_counter, is_delta=self.is_delta,
+        )
+        return QueryResult(
+            grids=[Grid(group_labels, self.start_ms, self.step_ms, num_steps, out)]
+        )
+
+    def _column(self, ctx, shard, pids) -> str | None:
+        if not len(pids):
+            return None
+        return shard.partition(int(pids[0])).schema.value_column
