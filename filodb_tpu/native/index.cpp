@@ -1,0 +1,156 @@
+// Native part-key index core (reference analog: the Rust tantivy index,
+// core/src/rust/filodb_core — ingestDocument / queryPartIds hot paths).
+//
+// Posting lists: tag key -> value -> sorted vector of part ids, plus
+// per-part start/end times for range overlap filtering. The Python wrapper
+// (memstore/index_native.py) keeps tag maps for label introspection and
+// regex filtering; this core answers the hot equality-AND + time-overlap
+// queries.
+//
+// Build: g++ -O3 -shared -fPIC index.cpp -o libfilodbindex.so
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Index {
+    // key -> value -> sorted part ids
+    std::unordered_map<std::string, std::unordered_map<std::string, std::vector<int32_t>>> postings;
+    std::unordered_map<int32_t, int64_t> start_ts;
+    std::unordered_map<int32_t, int64_t> end_ts;
+    std::vector<int32_t> all_ids;  // sorted
+};
+
+std::string make_key(const char* p, long n) { return std::string(p, (size_t)n); }
+
+void sorted_insert(std::vector<int32_t>& v, int32_t id) {
+    auto it = std::lower_bound(v.begin(), v.end(), id);
+    if (it == v.end() || *it != id) v.insert(it, id);
+}
+
+void sorted_erase(std::vector<int32_t>& v, int32_t id) {
+    auto it = std::lower_bound(v.begin(), v.end(), id);
+    if (it != v.end() && *it == id) v.erase(it);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* fdb_idx_new() { return new Index(); }
+
+void fdb_idx_free(void* h) { delete (Index*)h; }
+
+void fdb_idx_add(void* h, int32_t part_id, int32_t n_pairs,
+                 const char** keys, const long* key_lens,
+                 const char** vals, const long* val_lens,
+                 int64_t start, int64_t end) {
+    Index* idx = (Index*)h;
+    for (int32_t i = 0; i < n_pairs; i++) {
+        auto& post = idx->postings[make_key(keys[i], key_lens[i])][make_key(vals[i], val_lens[i])];
+        sorted_insert(post, part_id);
+    }
+    idx->start_ts[part_id] = start;
+    idx->end_ts[part_id] = end;
+    sorted_insert(idx->all_ids, part_id);
+}
+
+void fdb_idx_update_end(void* h, int32_t part_id, int64_t end) {
+    ((Index*)h)->end_ts[part_id] = end;
+}
+
+void fdb_idx_remove(void* h, int32_t part_id, int32_t n_pairs,
+                    const char** keys, const long* key_lens,
+                    const char** vals, const long* val_lens) {
+    Index* idx = (Index*)h;
+    for (int32_t i = 0; i < n_pairs; i++) {
+        auto kit = idx->postings.find(make_key(keys[i], key_lens[i]));
+        if (kit == idx->postings.end()) continue;
+        auto vit = kit->second.find(make_key(vals[i], val_lens[i]));
+        if (vit == kit->second.end()) continue;
+        sorted_erase(vit->second, part_id);
+        if (vit->second.empty()) kit->second.erase(vit);
+    }
+    idx->start_ts.erase(part_id);
+    idx->end_ts.erase(part_id);
+    sorted_erase(idx->all_ids, part_id);
+}
+
+// AND of equality terms + [start,end] overlap. Returns count written
+// (clipped to cap); -1 signals "no equality terms" (caller scans all).
+long fdb_idx_query(void* h, int32_t n_terms,
+                   const char** keys, const long* key_lens,
+                   const char** vals, const long* val_lens,
+                   int64_t start, int64_t end,
+                   int32_t* out, long cap) {
+    Index* idx = (Index*)h;
+    if (n_terms == 0) return -1;
+    // find smallest posting list first
+    const std::vector<int32_t>* lists[64];
+    if (n_terms > 64) return -2;
+    for (int32_t i = 0; i < n_terms; i++) {
+        auto kit = idx->postings.find(make_key(keys[i], key_lens[i]));
+        if (kit == idx->postings.end()) return 0;
+        auto vit = kit->second.find(make_key(vals[i], val_lens[i]));
+        if (vit == kit->second.end()) return 0;
+        lists[i] = &vit->second;
+    }
+    std::sort(lists, lists + n_terms,
+              [](const std::vector<int32_t>* a, const std::vector<int32_t>* b) {
+                  return a->size() < b->size();
+              });
+    long n_out = 0;
+    for (int32_t id : *lists[0]) {
+        bool ok = true;
+        for (int32_t i = 1; i < n_terms && ok; i++) {
+            const auto& l = *lists[i];
+            ok = std::binary_search(l.begin(), l.end(), id);
+        }
+        if (!ok) continue;
+        auto s = idx->start_ts.find(id);
+        auto e = idx->end_ts.find(id);
+        if (s == idx->start_ts.end() || s->second > end) continue;
+        if (e == idx->end_ts.end() || e->second < start) continue;
+        if (n_out < cap) out[n_out] = id;
+        n_out++;
+    }
+    return n_out;
+}
+
+// ids of every series matching one key=value (for regex unions in python)
+long fdb_idx_postings_of(void* h, const char* key, long key_len,
+                         const char* val, long val_len,
+                         int32_t* out, long cap) {
+    Index* idx = (Index*)h;
+    auto kit = idx->postings.find(make_key(key, key_len));
+    if (kit == idx->postings.end()) return 0;
+    auto vit = kit->second.find(make_key(val, val_len));
+    if (vit == kit->second.end()) return 0;
+    long n = (long)vit->second.size();
+    long w = n < cap ? n : cap;
+    std::memcpy(out, vit->second.data(), (size_t)w * sizeof(int32_t));
+    return n;
+}
+
+long fdb_idx_size(void* h) { return (long)((Index*)h)->all_ids.size(); }
+
+long fdb_idx_all(void* h, int64_t start, int64_t end, int32_t* out, long cap) {
+    Index* idx = (Index*)h;
+    long n_out = 0;
+    for (int32_t id : idx->all_ids) {
+        auto s = idx->start_ts.find(id);
+        auto e = idx->end_ts.find(id);
+        if (s == idx->start_ts.end() || s->second > end) continue;
+        if (e == idx->end_ts.end() || e->second < start) continue;
+        if (n_out < cap) out[n_out] = id;
+        n_out++;
+    }
+    return n_out;
+}
+
+}  // extern "C"

@@ -58,9 +58,32 @@ class TestPartition:
         np.testing.assert_array_equal(c.column("value"), vals)
 
 
+def _index_impls():
+    """Shared-behavior suite runs against BOTH index implementations
+    (reference PartKeyIndexRawSpec pattern: same spec for Lucene+Tantivy)."""
+    impls = [PartKeyIndex]
+    try:
+        from filodb_tpu.memstore.index_native import (
+            NativePartKeyIndex,
+            native_index_available,
+        )
+
+        if native_index_available():
+            impls.append(NativePartKeyIndex)
+    except Exception:
+        pass
+    return impls
+
+
+@pytest.fixture(params=_index_impls(), ids=lambda c: c.__name__)
+def index_cls(request):
+    return request.param
+
+
 class TestIndex:
-    def setup_method(self):
-        self.idx = PartKeyIndex()
+    @pytest.fixture(autouse=True)
+    def _setup(self, index_cls):
+        self.idx = index_cls()
         for i in range(100):
             self.idx.add_partkey(
                 i,
@@ -170,3 +193,20 @@ class TestShardAndMemstore:
         dropped = sh.evict_for_retention(now_ms=start + 200_000)
         assert dropped == 100  # everything beyond retention, incl. buffer seal? buffer stays
         # note: open write buffer is never evicted, only sealed chunks
+
+
+def test_native_index_backend_in_shard():
+    try:
+        from filodb_tpu.memstore.index_native import (
+            NativePartKeyIndex, native_index_available)
+    except Exception:
+        pytest.skip("native index unavailable")
+    if not native_index_available():
+        pytest.skip("native index unavailable")
+    ms = TimeSeriesMemStore(StoreConfig(index_backend="native"))
+    ms.setup(Dataset("ds"), [0])
+    sh = ms.shard("ds", 0)
+    assert isinstance(sh.index, NativePartKeyIndex)
+    ms.ingest("ds", 0, machine_metrics(n_series=10, n_samples=20))
+    pids = sh.lookup_partitions([equals("_metric_", "heap_usage0")], 0, 2**62)
+    assert len(pids) == 10
