@@ -187,7 +187,14 @@ class PromApiHandler(BaseHTTPRequestHandler):
                 else [],
             }
             return self._send(200, J.success(data))
-        return self._send(200, J.success(J.render_matrix(res)))
+        data = J.render_matrix(res)
+        data["stats"] = {
+            "seriesScanned": res.stats.series_scanned,
+            "samplesScanned": res.stats.samples_scanned,
+            "cpuNanos": res.stats.cpu_ns,
+            "bytesStaged": res.stats.bytes_staged,
+        }
+        return self._send(200, J.success(data))
 
     def _query(self):
         p = self._params()

@@ -426,6 +426,20 @@ def run_range_function(
         return run_mxu_range_function(
             func, block, params, is_counter=is_counter, is_delta=is_delta, args=args
         )
+    import os as _os
+
+    if _os.environ.get("FILODB_PALLAS") == "1":
+        from .pallas_kernels import PALLAS_FUNCS, run_pallas_range_function
+
+        if func in PALLAS_FUNCS and not args:
+            # fused one-pass VMEM kernel (compiled on TPU; interpret on CPU)
+            import jax as _jax
+
+            on_tpu = _jax.devices()[0].platform not in ("cpu",)
+            return run_pallas_range_function(
+                func, block, params, is_counter=is_counter, is_delta=is_delta,
+                interpret=not on_tpu,
+            )
     j_pad = pad_steps(params.num_steps)
     start_off = np.int32(params.start_ms - block.base_ms)
     if func in SORTED_FUNCS:
