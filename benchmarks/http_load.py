@@ -1,0 +1,96 @@
+"""HTTP load benchmark: latency percentiles for query_range against a live
+server (reference gatling/ simulations). Run: python -m benchmarks.http_load
+[concurrency] [requests]."""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+import urllib.parse
+import urllib.request
+
+import numpy as np
+
+BASE = 1_600_000_000_000
+
+
+def main(concurrency: int = 8, total_requests: int = 200):
+    from filodb_tpu.server import FiloServer
+    from filodb_tpu.testkit import counter_batch, machine_metrics
+
+    srv = FiloServer({"dataset": "prometheus", "shards": 8})
+    port = srv.start(port=0)
+    srv.memstore.ingest_routed(
+        "prometheus", counter_batch(n_series=200, n_samples=720, start_ms=BASE), spread=3)
+    srv.memstore.ingest_routed(
+        "prometheus", machine_metrics(n_series=200, n_samples=720, start_ms=BASE), spread=3)
+
+    queries = [
+        "sum(rate(http_requests_total[5m]))",
+        "sum by (instance) (rate(http_requests_total[5m]))",
+        "max_over_time(heap_usage0[5m])",
+        "heap_usage0",
+    ]
+    start_s = (BASE + 600_000) / 1000
+    end_s = (BASE + 7_000_000) / 1000
+    urls = [
+        f"http://127.0.0.1:{port}/api/v1/query_range?query={urllib.parse.quote(q)}"
+        f"&start={start_s}&end={end_s}&step=60"
+        for q in queries
+    ]
+    # warm the staging caches + jit
+    for u in urls:
+        with urllib.request.urlopen(u, timeout=300) as r:
+            assert json.loads(r.read())["status"] == "success"
+
+    latencies: list[float] = []
+    errors = [0]
+    lock = threading.Lock()
+    counter = [0]
+
+    def worker():
+        while True:
+            with lock:
+                if counter[0] >= total_requests:
+                    return
+                i = counter[0]
+                counter[0] += 1
+            u = urls[i % len(urls)]
+            t0 = time.perf_counter()
+            try:
+                with urllib.request.urlopen(u, timeout=300) as r:
+                    json.loads(r.read())
+                with lock:
+                    latencies.append(time.perf_counter() - t0)
+            except Exception:
+                with lock:
+                    errors[0] += 1
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=worker) for _ in range(concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    srv.stop()
+    lat = np.array(latencies) * 1e3
+    out = {
+        "metric": "http_query_range_latency",
+        "value": round(float(np.percentile(lat, 50)), 2),
+        "unit": "ms_p50",
+        "p95_ms": round(float(np.percentile(lat, 95)), 2),
+        "p99_ms": round(float(np.percentile(lat, 99)), 2),
+        "qps": round(len(lat) / wall, 1),
+        "errors": errors[0],
+        "concurrency": concurrency,
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    c = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+    n = int(sys.argv[2]) if len(sys.argv) > 2 else 200
+    main(c, n)

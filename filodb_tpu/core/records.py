@@ -37,11 +37,19 @@ class RecordBatch:
         return len(self.timestamps)
 
     def group_by_series(self) -> "list[SeriesBatch]":
-        """Group records by partition key, preserving time order within series."""
+        """Group records by partition key, preserving time order within series.
+
+        Hot path: producers typically repeat the same tags object for every
+        sample of a series, so partkeys memoize by object identity before
+        falling back to canonical hashing."""
         groups: dict[bytes, list[int]] = {}
         keys: dict[bytes, Mapping[str, str]] = {}
+        memo: dict[int, bytes] = {}
         for i, t in enumerate(self.tags):
-            pk = canonical_partkey(t)
+            pk = memo.get(id(t))
+            if pk is None:
+                pk = canonical_partkey(t)
+                memo[id(t)] = pk
             groups.setdefault(pk, []).append(i)
             keys.setdefault(pk, t)
         out = []
@@ -60,8 +68,17 @@ class RecordBatch:
 
     def shard_split(self, spread: int, num_shards: int) -> dict[int, "RecordBatch"]:
         """Partition a batch by destination shard (gateway shardingPipeline
-        analog, GatewayServer.scala:335)."""
-        shard_of = np.array([shard_for(t, spread, num_shards) for t in self.tags])
+        analog, GatewayServer.scala:335). Shard memoized per tags object."""
+        memo: dict[int, int] = {}
+
+        def shard_memo(t):
+            s = memo.get(id(t))
+            if s is None:
+                s = shard_for(t, spread, num_shards)
+                memo[id(t)] = s
+            return s
+
+        shard_of = np.array([shard_memo(t) for t in self.tags])
         out: dict[int, RecordBatch] = {}
         for s in np.unique(shard_of):
             ix = np.nonzero(shard_of == s)[0]
