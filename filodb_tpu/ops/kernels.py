@@ -131,32 +131,6 @@ def _extrapolated(delta, t_first, t_last, count, v_first_raw, out_t, window, is_
 # the kernel: one jit per (func, S, T, J)
 # ---------------------------------------------------------------------------
 
-PREFIX_FUNCS = {
-    "sum_over_time",
-    "count_over_time",
-    "avg_over_time",
-    "rate",
-    "increase",
-    "delta",
-    "idelta",
-    "irate",
-    "last",
-    "last_over_time",
-    "timestamp",
-    "stddev_over_time",
-    "stdvar_over_time",
-    "min_over_time",
-    "max_over_time",
-    "deriv",
-    "predict_linear",
-    "changes",
-    "resets",
-    "present_over_time",
-    "absent_over_time",
-    "first_over_time",
-    "double_exponential_smoothing",
-    "z_score",
-}
 
 
 @functools.partial(
