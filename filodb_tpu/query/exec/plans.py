@@ -131,6 +131,16 @@ class SelectRawPartitionsExec(ExecPlan):
     def do_execute(self, ctx: QueryContext) -> QueryResult:
         shard = ctx.memstore.shard(ctx.dataset, self.shard_num)
         pids = shard.lookup_partitions(self.filters, self.start_ms, self.end_ms)
+        column_override = None
+        hist_bucket_le = None
+        if not len(pids):
+            # classic-histogram suffix rewrite (reference
+            # MultiSchemaPartitionsExec :49-80): m_sum / m_count map to the
+            # histogram schema's sum/count columns; m_bucket{le=...} selects
+            # one bucket of the native histogram
+            rewritten, column_override, hist_bucket_le = _histogram_suffix_rewrite(self.filters)
+            if rewritten is not None:
+                pids = shard.lookup_partitions(rewritten, self.start_ms, self.end_ms)
         if len(pids) > ctx.max_series:
             raise QueryError(f"query selects {len(pids)} series > limit {ctx.max_series}")
         if shard.odp_store is not None and len(pids):
@@ -145,8 +155,12 @@ class SelectRawPartitionsExec(ExecPlan):
         for schema_name, ids in by_schema.items():
             parts = [shard.partition(p) for p in ids]
             schema = parts[0].schema
-            col_name = self.column or schema.value_column
-            col = schema.column(col_name)
+            col_name = self.column or column_override or schema.value_column
+            try:
+                col = schema.column(col_name)
+            except KeyError:
+                col_name = schema.value_column
+                col = schema.column(col_name)
             is_hist = col.ctype == ColumnType.HISTOGRAM
             is_counter = col.is_counter
             is_delta = col.is_delta
@@ -175,16 +189,40 @@ class SelectRawPartitionsExec(ExecPlan):
                     f"limit {ctx.max_samples}"
                 )
             les = parts[0].bucket_les if is_hist else None
+            labels = [dict(p.tags) for p in parts]
+            if is_hist and hist_bucket_le is not None and les is not None:
+                # m_bucket{le=...}: slice one bucket into a scalar block
+                les64 = np.asarray(les, dtype=np.float64)
+                if np.isinf(hist_bucket_le):
+                    b_idx = len(les64) - 1
+                else:
+                    hits = np.nonzero(np.abs(les64 - hist_bucket_le) < 1e-10)[0]
+                    b_idx = int(hits[0]) if len(hits) else -1
+                if b_idx < 0:
+                    continue  # no such bucket
+                vals3 = np.asarray(block.vals)
+                scalar_vals = np.ascontiguousarray(vals3[..., b_idx])
+                block = ST.StagedBlock(
+                    block.ts, scalar_vals, block.lens, block.base_ms,
+                    np.asarray(block.baseline)[..., b_idx]
+                    if np.asarray(block.baseline).ndim == 2 else block.baseline,
+                    block.n_series, block.part_refs, raw=scalar_vals,
+                    regular_ts=block.regular_ts,
+                )
+                le_str = "+Inf" if np.isinf(les64[b_idx]) else f"{les64[b_idx]:g}"
+                labels = [dict(l, le=le_str) for l in labels]
+                is_hist = False
+                is_counter = True
             res.raw_grids.append(
                 RawGrid(
                     block=block,
-                    labels=[dict(p.tags) for p in parts],
+                    labels=labels,
                     schema_name=schema_name,
                     value_column=col_name,
                     is_counter=is_counter,
                     is_delta=is_delta,
                     is_histogram=is_hist,
-                    les=les,
+                    les=les if is_hist else None,
                 )
             )
         return res
@@ -255,6 +293,33 @@ class RawChunkExportExec(ExecPlan):
         res = QueryResult(raw=raw)
         res.result_type = "matrix"
         return res
+
+
+def _histogram_suffix_rewrite(filters):
+    """m_sum/m_count/m_bucket -> base histogram metric + column/bucket
+    selection. Returns (rewritten_filters | None, column | None, le | None)."""
+    from ...core.schemas import METRIC_TAG
+
+    metric = None
+    for f in filters:
+        if f.column == METRIC_TAG and f.op == "=":
+            metric = f.value
+    if metric is None:
+        return None, None, None
+    for suffix, col in (("_sum", "sum"), ("_count", "count"), ("_bucket", None)):
+        if metric.endswith(suffix):
+            base = metric[: -len(suffix)]
+            le = None
+            out = []
+            for f in filters:
+                if f.column == METRIC_TAG and f.op == "=":
+                    out.append(ColumnFilter(METRIC_TAG, "=", base))
+                elif suffix == "_bucket" and f.column == "le" and f.op == "=":
+                    le = float("inf") if f.value in ("+Inf", "Inf") else float(f.value)
+                else:
+                    out.append(f)
+            return tuple(out), col, le
+    return None, None, None
 
 
 # ---------------------------------------------------------------------------

@@ -137,3 +137,54 @@ class TestInstantSubquery:
             "no_such_metric", (BASE + 600_000) / 1000, (BASE + 900_000) / 1000, 60.0
         )
         assert not list(res.all_series())
+
+
+@pytest.fixture(scope="module")
+def hist_engine():
+    ms = TimeSeriesMemStore()
+    ms.setup(Dataset("prometheus"), [0, 1])
+    ms.ingest_routed("prometheus", histogram_batch(n_series=3, n_samples=200, start_ms=BASE), spread=1)
+    return QueryEngine(ms, "prometheus")
+
+
+HS_START = (BASE + 600_000) / 1000
+HS_END = (BASE + 1_500_000) / 1000
+
+
+class TestHistogramSuffixRewrites:
+    """m_sum / m_count / m_bucket classic-histogram compatibility
+    (reference MultiSchemaPartitionsExec rewrites :49-80)."""
+
+    def test_sum_suffix_reads_sum_column(self, hist_engine):
+        res = hist_engine.query_range(
+            "rate(http_request_latency_sum[5m])", HS_START, HS_END, 60.0)
+        series = list(res.all_series())
+        assert len(series) == 3
+        for _, _, vals in series:
+            assert (vals >= 0).all()
+
+    def test_count_suffix_reads_count_column(self, hist_engine):
+        res = hist_engine.query_range(
+            "rate(http_request_latency_count[5m])", HS_START, HS_END, 60.0)
+        assert len(list(res.all_series())) == 3
+
+    def test_bucket_suffix_selects_le(self, hist_engine):
+        res = hist_engine.query_range(
+            'rate(http_request_latency_bucket{le="+Inf"}[5m])', HS_START, HS_END, 60.0)
+        series = list(res.all_series())
+        assert len(series) == 3
+        for lbls, _, vals in series:
+            assert lbls["le"] == "+Inf"
+            assert (vals >= 0).all()
+        # +Inf bucket rate equals the count-column rate
+        res2 = hist_engine.query_range(
+            "rate(http_request_latency_count[5m])", HS_START, HS_END, 60.0)
+        m1 = {l["instance"]: v for l, _, v in series}
+        m2 = {l["instance"]: v for l, _, v in res2.all_series()}
+        for k in m1:
+            np.testing.assert_allclose(m1[k], m2[k], rtol=1e-3)
+
+    def test_unknown_bucket_empty(self, hist_engine):
+        res = hist_engine.query_range(
+            'rate(http_request_latency_bucket{le="123.456"}[5m])', HS_START, HS_END, 60.0)
+        assert not list(res.all_series())
