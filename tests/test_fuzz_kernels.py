@@ -1,0 +1,101 @@
+"""Randomized cross-checking: random data shapes x random window/step
+configs x every major range function vs the numpy oracle (the
+property-style arm of the SURVEY §4(f) strategy)."""
+
+import numpy as np
+import pytest
+
+import oracle
+from filodb_tpu.ops import kernels as K
+from filodb_tpu.ops.staging import stage_series
+
+BASE = 1_600_000_000_000
+
+FUNCS_GAUGE = [
+    "sum_over_time", "avg_over_time", "min_over_time", "max_over_time",
+    "count_over_time", "last_over_time", "stddev_over_time", "changes",
+    "idelta", "deriv",
+]
+FUNCS_COUNTER = ["rate", "increase", "irate"]
+
+
+def random_case(rng):
+    n_series = int(rng.integers(1, 9))
+    n = int(rng.integers(5, 400))
+    interval = int(rng.integers(1_000, 30_000))
+    jitter = rng.random() < 0.5
+    window_ms = int(rng.integers(2, 40)) * 15_000
+    step_ms = int(rng.integers(1, 10)) * 30_000
+    num_steps = int(rng.integers(3, 40))
+    start = BASE + int(rng.integers(0, 2 * window_ms))
+    series = []
+    for _ in range(n_series):
+        if jitter:
+            gaps = rng.integers(max(interval // 2, 1), interval * 2, n)
+            ts = BASE + np.cumsum(gaps).astype(np.int64)
+        else:
+            ts = BASE + (1 + np.arange(n, dtype=np.int64)) * interval
+        series.append(ts)
+    return series, window_ms, step_ms, num_steps, start
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_fuzz_gauge_functions(seed):
+    rng = np.random.default_rng(seed)
+    tss, window, step, nsteps, start = random_case(rng)
+    series = [(ts, 50 + 20 * rng.standard_normal(len(ts))) for ts in tss]
+    func = FUNCS_GAUGE[seed % len(FUNCS_GAUGE)]
+    block = stage_series(series, BASE)
+    params = K.RangeParams(start, step, nsteps, window)
+    got = np.asarray(K.run_range_function(func, block, params))[: len(series), :nsteps]
+    want = np.stack([
+        oracle.range_function(func, t, v, start, step, nsteps, window)
+        for t, v in series
+    ])
+    np.testing.assert_array_equal(np.isnan(got), np.isnan(want),
+                                  err_msg=f"{func} seed={seed}")
+    m = ~np.isnan(want)
+    np.testing.assert_allclose(got[m], want[m], rtol=5e-4, atol=5e-3,
+                               err_msg=f"{func} seed={seed}")
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_fuzz_counter_functions(seed):
+    rng = np.random.default_rng(100 + seed)
+    tss, window, step, nsteps, start = random_case(rng)
+    series = []
+    for ts in tss:
+        vals = np.cumsum(rng.uniform(0, 10, len(ts))) + rng.uniform(0, 1e6)
+        if rng.random() < 0.5 and len(ts) > 10:  # resets
+            k = int(rng.integers(2, len(ts) - 1))
+            vals[k:] -= vals[k] - rng.uniform(0, 3)
+        series.append((ts, vals))
+    func = FUNCS_COUNTER[seed % len(FUNCS_COUNTER)]
+    block = stage_series(series, BASE, counter_corrected=True)
+    params = K.RangeParams(start, step, nsteps, window)
+    got = np.asarray(
+        K.run_range_function(func, block, params, is_counter=True)
+    )[: len(series), :nsteps]
+    want = np.stack([
+        oracle.range_function(func, t, v, start, step, nsteps, window, is_counter=True)
+        for t, v in series
+    ])
+    np.testing.assert_array_equal(np.isnan(got), np.isnan(want),
+                                  err_msg=f"{func} seed={seed}")
+    m = ~np.isnan(want)
+    np.testing.assert_allclose(got[m], want[m], rtol=2e-3, atol=1e-3,
+                               err_msg=f"{func} seed={seed}")
+
+
+def test_degenerate_shapes():
+    # single sample, single series, single step
+    block = stage_series([(np.array([BASE + 1000]), np.array([5.0]))], BASE)
+    params = K.RangeParams(BASE + 2000, 1000, 1, 10_000)
+    got = np.asarray(K.run_range_function("last_over_time", block, params))[0, 0]
+    assert got == 5.0
+    # empty series among real ones
+    block = stage_series(
+        [(np.array([], dtype=np.int64), np.array([])),
+         (np.array([BASE + 1000]), np.array([7.0]))], BASE)
+    got = np.asarray(K.run_range_function("sum_over_time", block, params))[:2, 0]
+    assert np.isnan(got[0]) and got[1] == 7.0

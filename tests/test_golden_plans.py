@@ -1,0 +1,69 @@
+"""Golden plan-tree tests (reference planner specs assert printTree string
+equality — e.g. SingleClusterPlannerSpec, PlannerHierarchySpec)."""
+
+import re
+
+import pytest
+
+from filodb_tpu.coordinator.planner import SingleClusterPlanner
+from filodb_tpu.core.schemas import Dataset
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.query.promql import query_range_to_logical_plan
+
+
+@pytest.fixture()
+def planner():
+    ms = TimeSeriesMemStore()
+    ms.setup(Dataset("prometheus"), [0, 1])
+    return SingleClusterPlanner(ms, "prometheus")
+
+
+def tree(planner, q, start=1000, end=2000, step=60):
+    plan = query_range_to_logical_plan(q, start, end, step)
+    return planner.materialize(plan).print_tree()
+
+
+def normalize(t):
+    return re.sub(r"-+", "-", t)
+
+
+def test_golden_sum_rate(planner):
+    got = tree(planner, "sum(rate(http_requests_total[5m]))")
+    want = """\
+E~ReduceAggregateExec(op=sum by=None without=None)
+-T~AggregateMapReduce()
+-T~PeriodicSamplesMapper(fn=rate window=300000 step=60000)
+-E~SelectRawPartitionsExec(shard=0 filters=[_metric_=http_requests_total] range=[700000,2000000])
+-T~AggregateMapReduce()
+-T~PeriodicSamplesMapper(fn=rate window=300000 step=60000)
+-E~SelectRawPartitionsExec(shard=1 filters=[_metric_=http_requests_total] range=[700000,2000000])"""
+    assert normalize(got) == normalize(want)
+
+
+def test_golden_instant_selector(planner):
+    got = tree(planner, "up")
+    want = normalize("""\
+E~DistConcatExec()
+-T~PeriodicSamplesMapper(fn=None window=None step=60000)
+-E~SelectRawPartitionsExec(shard=0 filters=[_metric_=up] range=[700000,2000000])
+-T~PeriodicSamplesMapper(fn=None window=None step=60000)
+-E~SelectRawPartitionsExec(shard=1 filters=[_metric_=up] range=[700000,2000000])""")
+    assert normalize(got) == want
+
+
+def test_golden_binary_join(planner):
+    got = normalize(tree(planner, "a / b"))
+    assert got.startswith("E~BinaryJoinExec(op=/ card=one-to-one")
+    assert got.count("SelectRawPartitionsExec") == 4  # 2 shards x 2 sides
+
+
+def test_golden_topk(planner):
+    got = normalize(tree(planner, "topk(3, rate(m[1m]))"))
+    assert got.startswith("E~AggregatePresentExec(op=topk params=(3.0,)")
+    assert "PeriodicSamplesMapper(fn=rate window=60000" in got
+
+
+def test_golden_scalar_op(planner):
+    got = normalize(tree(planner, "m * 2"))
+    assert got.startswith("E~ScalarVectorOpExec(op=* scalar_is_lhs=False)")
+    assert "ScalarPlanExec" in got
