@@ -1,0 +1,58 @@
+"""Server/config defaults (reference: core/src/main/resources/
+filodb-defaults.conf — 1478 lines of HOCON; here a documented JSON-shaped
+dict merged with user config files; GlobalConfig analog).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+DEFAULTS: dict = {
+    # dataset / sharding (reference filodb.dataset-configs + spread-default)
+    "dataset": "prometheus",
+    "shards": 8,
+    "spread": 3,
+    # memstore (reference filodb.memstore block)
+    "max_chunk_size": 400,
+    "retention_hours": 72,
+    "groups_per_shard": 16,
+    "max_partitions_per_shard": 1_000_000,
+    "index_backend": "python",  # or "native" (C++ posting lists)
+    # flush / persistence
+    "flush_interval_s": 3600,
+    "store_root": None,  # None = memory-only (NullColumnStore)
+    # query limits (reference filodb.query circuit breaker / limits)
+    "query": {
+        "max_series": 1_000_000,
+        "max_samples": 500_000_000,
+        "lookback_ms": 300_000,
+        "timeout_s": 60,
+    },
+    # API
+    "http_port": 9090,
+    # downsampling (reference downsample resolutions)
+    "downsample": {"enabled": False, "periods_m": [5, 60]},
+    # cardinality quotas: list of {"prefix": ["ws","ns"], "quota": N}
+    "quotas": [],
+    # profiler (reference filodb.profiler)
+    "profiler": {"enabled": False, "interval_ms": 10},
+}
+
+
+def load_config(path: str | None = None, overrides: dict | None = None) -> dict:
+    """defaults <- file <- overrides (later wins, one level deep for dicts)."""
+    cfg = json.loads(json.dumps(DEFAULTS))  # deep copy
+    layers = []
+    if path:
+        with open(path) as f:
+            layers.append(json.load(f))
+    if overrides:
+        layers.append(overrides)
+    for layer in layers:
+        for k, v in layer.items():
+            if isinstance(v, dict) and isinstance(cfg.get(k), dict):
+                cfg[k].update(v)
+            else:
+                cfg[k] = v
+    return cfg

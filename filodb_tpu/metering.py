@@ -1,0 +1,84 @@
+"""Tenant metering + label churn (reference: TenantIngestionMetering
+(coordinator, 111 LoC) publishing per-tenant cardinality metrics, and the
+spark-jobs LabelChurnFinder which sketches label-value churn with HLL).
+
+Churn here uses exact capped sets per window (HLL precision is unnecessary
+at per-shard scale; the cap bounds memory like HLL's fixed size).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from .metrics import REGISTRY
+
+
+class TenantIngestionMetering:
+    """Publishes per-tenant (ws/ns) active & total series gauges from the
+    shards' cardinality trackers. Call ``publish`` on a timer."""
+
+    def __init__(self, memstore, dataset: str):
+        self.memstore = memstore
+        self.dataset = dataset
+
+    def collect(self) -> dict[tuple[str, str], dict]:
+        merged: dict[tuple[str, str], dict] = {}
+        for sh in self.memstore.shards(self.dataset):
+            for rec in sh.cardinality.scan((), 2):
+                key = (rec.prefix[0], rec.prefix[1])
+                slot = merged.setdefault(key, {"ts_count": 0, "active": 0})
+                slot["ts_count"] += rec.ts_count
+                slot["active"] += rec.active_ts_count
+        return merged
+
+    def publish(self) -> int:
+        stats = self.collect()
+        for (ws, ns), rec in stats.items():
+            REGISTRY.gauge("filodb_tenant_ts_total", ws=ws, ns=ns).set(rec["ts_count"])
+            REGISTRY.gauge("filodb_tenant_ts_active", ws=ws, ns=ns).set(rec["active"])
+        return len(stats)
+
+
+@dataclass
+class LabelChurn:
+    label: str
+    window_values: set = field(default_factory=set)
+    prev_values: set = field(default_factory=set)
+    total_seen: int = 0
+
+
+class LabelChurnFinder:
+    """Tracks per-label value churn across roll windows: how many label
+    values are NEW relative to the previous window — the signal for
+    runaway cardinality sources (reference LabelChurnFinder)."""
+
+    def __init__(self, labels: list[str], cap_per_label: int = 100_000):
+        self._state = {l: LabelChurn(l) for l in labels}
+        self.cap = cap_per_label
+
+    def observe(self, tags) -> None:
+        for l, st in self._state.items():
+            v = tags.get(l)
+            if v is not None and len(st.window_values) < self.cap:
+                if v not in st.window_values:
+                    st.window_values.add(v)
+                    st.total_seen += 1
+
+    def roll(self) -> dict[str, dict]:
+        """Close the window; returns per-label churn stats."""
+        out = {}
+        for l, st in self._state.items():
+            new = st.window_values - st.prev_values
+            out[l] = {
+                "distinct": len(st.window_values),
+                "new": len(new),
+                "churn_ratio": len(new) / max(len(st.window_values), 1),
+            }
+            st.prev_values = st.window_values
+            st.window_values = set()
+        return out
+
+    def scan_shard(self, shard) -> None:
+        for part in list(shard.partitions.values()):
+            self.observe(part.tags)

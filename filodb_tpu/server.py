@@ -37,26 +37,49 @@ log = logging.getLogger("filodb_tpu.server")
 
 class FiloServer:
     def __init__(self, config: dict | None = None):
-        cfg = dict(config or {})
-        self.dataset = cfg.get("dataset", "prometheus")
-        self.n_shards = int(cfg.get("shards", 8))
-        self.spread = int(cfg.get("spread", 3))
-        self.http_port = int(cfg.get("http_port", 9090))
-        self.flush_interval_s = float(cfg.get("flush_interval_s", 3600))
-        retention_h = float(cfg.get("retention_hours", 72))
+        from .config import load_config
+
+        cfg = load_config(overrides=config or {})
+        self.config = cfg
+        self.dataset = cfg["dataset"]
+        self.n_shards = int(cfg["shards"])
+        self.spread = int(cfg["spread"])
+        self.http_port = int(cfg["http_port"])
+        self.flush_interval_s = float(cfg["flush_interval_s"])
         self.store_config = StoreConfig(
-            max_chunk_size=int(cfg.get("max_chunk_size", 400)),
-            retention_ms=int(retention_h * 3_600_000),
+            max_chunk_size=int(cfg["max_chunk_size"]),
+            retention_ms=int(float(cfg["retention_hours"]) * 3_600_000),
+            groups_per_shard=int(cfg["groups_per_shard"]),
+            max_partitions=int(cfg["max_partitions_per_shard"]),
+            index_backend=cfg["index_backend"],
         )
         self.memstore = TimeSeriesMemStore(self.store_config)
         self.memstore.setup(Dataset(self.dataset), range(self.n_shards))
+        for q in cfg.get("quotas", []):
+            for sh in self.memstore.shards(self.dataset):
+                sh.cardinality.set_quota(tuple(q["prefix"]), int(q["quota"]))
         root = cfg.get("store_root")
         self.column_store = LocalColumnStore(root) if root else NullColumnStore()
         if root:
             for sh in self.memstore.shards(self.dataset):
                 sh.odp_store = self.column_store
         self.flusher = FlushCoordinator(self.memstore, self.column_store)
-        self.engine = QueryEngine(self.memstore, self.dataset)
+        from .coordinator.planner import PlannerParams
+
+        qcfg = cfg["query"]
+        self.engine = QueryEngine(
+            self.memstore, self.dataset,
+            PlannerParams(
+                spread=self.spread,
+                lookback_ms=int(qcfg["lookback_ms"]),
+                max_series=int(qcfg["max_series"]),
+            ),
+        )
+        self.profiler = None
+        if cfg["profiler"]["enabled"]:
+            from .metrics import SamplingProfiler
+
+            self.profiler = SamplingProfiler(cfg["profiler"]["interval_ms"] / 1000.0)
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._http = None
@@ -74,6 +97,8 @@ class FiloServer:
 
     def start(self, port: int | None = None) -> int:
         self.recover()
+        if self.profiler is not None:
+            self.profiler.start()
         self._http, actual_port = serve_background(
             self.engine, port=self.http_port if port is None else port
         )

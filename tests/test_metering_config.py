@@ -1,0 +1,82 @@
+"""Metering, churn finder, config defaults tests (model: reference
+TenantIngestionMetering + LabelChurnFinder + GlobalConfig specs)."""
+
+import pytest
+
+from filodb_tpu.config import DEFAULTS, load_config
+from filodb_tpu.core.schemas import Dataset
+from filodb_tpu.memstore.cardinality import QuotaExceededError
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.metering import LabelChurnFinder, TenantIngestionMetering
+from filodb_tpu.metrics import REGISTRY
+from filodb_tpu.server import FiloServer
+from filodb_tpu.testkit import machine_metrics
+
+BASE = 1_600_000_000_000
+
+
+class TestMetering:
+    def test_tenant_gauges_published(self):
+        ms = TimeSeriesMemStore()
+        ms.setup(Dataset("prometheus"), [0, 1])
+        ms.ingest_routed("prometheus", machine_metrics(n_series=12, n_samples=5, start_ms=BASE), spread=1)
+        m = TenantIngestionMetering(ms, "prometheus")
+        n = m.publish()
+        assert n == 1
+        g = REGISTRY.gauge("filodb_tenant_ts_total", ws="demo", ns="App-2")
+        assert g.value == 12
+
+
+class TestChurnFinder:
+    def test_churn_across_windows(self):
+        f = LabelChurnFinder(["instance"])
+        for i in range(10):
+            f.observe({"instance": f"h{i}"})
+        first = f.roll()
+        assert first["instance"]["distinct"] == 10
+        assert first["instance"]["churn_ratio"] == 1.0
+        # second window: half repeats, half new
+        for i in range(5, 15):
+            f.observe({"instance": f"h{i}"})
+        second = f.roll()
+        assert second["instance"]["distinct"] == 10
+        assert second["instance"]["new"] == 5
+        assert second["instance"]["churn_ratio"] == 0.5
+
+    def test_scan_shard(self):
+        ms = TimeSeriesMemStore()
+        ms.setup(Dataset("ds"), [0])
+        ms.ingest("ds", 0, machine_metrics(n_series=7, n_samples=3, start_ms=BASE))
+        f = LabelChurnFinder(["instance", "job"])
+        f.scan_shard(ms.shard("ds", 0))
+        out = f.roll()
+        assert out["instance"]["distinct"] == 7
+        assert out["job"]["distinct"] == 1
+
+
+class TestConfig:
+    def test_defaults_and_overrides(self, tmp_path):
+        p = tmp_path / "cfg.json"
+        p.write_text('{"shards": 2, "query": {"max_series": 5}}')
+        cfg = load_config(str(p), overrides={"http_port": 1234})
+        assert cfg["shards"] == 2
+        assert cfg["http_port"] == 1234
+        assert cfg["query"]["max_series"] == 5
+        assert cfg["query"]["lookback_ms"] == DEFAULTS["query"]["lookback_ms"]
+
+    def test_server_applies_quotas(self):
+        srv = FiloServer({
+            "shards": 1,
+            "quotas": [{"prefix": ["demo", "App-2"], "quota": 3}],
+        })
+        ms = srv.memstore
+        with pytest.raises(QuotaExceededError):
+            ms.ingest("prometheus", 0, machine_metrics(n_series=10, n_samples=2, start_ms=BASE))
+
+    def test_server_applies_query_limits(self):
+        srv = FiloServer({"shards": 1, "query": {"max_series": 2}})
+        srv.memstore.ingest("prometheus", 0, machine_metrics(n_series=5, n_samples=3, start_ms=BASE))
+        from filodb_tpu.query.exec.transformers import QueryError
+
+        with pytest.raises(QueryError):
+            srv.engine.query_range("heap_usage0", (BASE + 60_000) / 1000, (BASE + 120_000) / 1000, 60)
