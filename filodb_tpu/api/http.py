@@ -150,6 +150,15 @@ class PromApiHandler(BaseHTTPRequestHandler):
                 return self._cardinality()
             if path == "/ingest":
                 return self._ingest()
+            if path == "/api/v1/write":
+                return self._remote_write()
+            if path == "/api/v1/read":
+                return self._remote_read()
+            if path in ("/api/v1/rules", "/api/v1/alerts"):
+                kind = "rules" if path.endswith("rules") else "alerts"
+                return self._send(200, J.success({"groups" if kind == "rules" else "alerts": []}))
+            if path == "/api/v1/status/flags" or path == "/api/v1/status/config":
+                return self._send(200, J.success({}))
             self._send(404, J.error("not_found", f"unknown path {path}"))
         except (PromQLError, QueryError, ValueError) as e:
             self._send(400, J.error("bad_data", str(e)))
@@ -284,6 +293,33 @@ class PromApiHandler(BaseHTTPRequestHandler):
                 slot["children"] = max(slot["children"], rec.children)
         out = sorted(merged.values(), key=lambda r: -r["ts_count"])
         return self._send(200, J.success(out))
+
+    def _remote_write(self):
+        """Prometheus remote write receiver (snappy+protobuf)."""
+        from .remote_storage import parse_write_request
+
+        # binary body: bypass _params (which decodes as text)
+        length = int(self.headers.get("Content-Length") or 0)
+        raw = self.rfile.read(length) if length else b""
+        n = 0
+        for batch in parse_write_request(raw):
+            n += self.engine.memstore.ingest_routed(self.engine.dataset, batch, spread=3)
+        self.send_response(204)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def _remote_read(self):
+        from .remote_storage import handle_read_request
+
+        length = int(self.headers.get("Content-Length") or 0)
+        raw = self.rfile.read(length) if length else b""
+        out = handle_read_request(raw, self.engine.memstore, self.engine.dataset)
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-protobuf")
+        self.send_header("Content-Encoding", "snappy")
+        self.send_header("Content-Length", str(len(out)))
+        self.end_headers()
+        self.wfile.write(out)
 
     def _ingest(self):
         from ..core.records import gauge_batch

@@ -1,0 +1,85 @@
+"""Prometheus remote write/read endpoints (reference L6: remote-read proto
+support in PrometheusModel.scala + remote-storage.proto; plus the remote
+WRITE receiver the gateway's Prometheus path implies).
+
+Bodies are snappy block-compressed protobuf (api/snappy.py pure-Python
+codec; api/remote.proto wire-compatible with prometheus/prompb).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.filters import ColumnFilter
+from ..core.records import RecordBatch
+from ..core.schemas import GAUGE, METRIC_TAG
+from . import snappy
+
+
+def _pb():
+    from . import remote_pb2
+
+    return remote_pb2
+
+
+def parse_write_request(body: bytes) -> list[RecordBatch]:
+    """snappy+proto WriteRequest -> RecordBatches (gauge schema; Prometheus
+    remote write carries no type info)."""
+    pb = _pb()
+    req = pb.WriteRequest()
+    req.ParseFromString(snappy.decompress(body))
+    tags_list, ts, vals = [], [], []
+    for series in req.timeseries:
+        tags = {}
+        for l in series.labels:
+            tags[METRIC_TAG if l.name == "__name__" else l.name] = l.value
+        for s in series.samples:
+            tags_list.append(tags)
+            ts.append(s.timestamp)
+            vals.append(s.value)
+    if not tags_list:
+        return []
+    return [
+        RecordBatch(
+            GAUGE,
+            np.asarray(ts, dtype=np.int64),
+            {"value": np.asarray(vals, dtype=np.float64)},
+            tags_list,
+        )
+    ]
+
+
+_MATCHER_OPS = {0: "=", 1: "!=", 2: "=~", 3: "!~"}
+
+
+def handle_read_request(body: bytes, memstore, dataset: str) -> bytes:
+    """snappy+proto ReadRequest -> snappy+proto ReadResponse with raw
+    samples per query."""
+    pb = _pb()
+    req = pb.ReadRequest()
+    req.ParseFromString(snappy.decompress(body))
+    resp = pb.ReadResponse()
+    for q in req.queries:
+        result = resp.results.add()
+        filters = []
+        for m in q.matchers:
+            name = METRIC_TAG if m.name == "__name__" else m.name
+            filters.append(ColumnFilter(name, _MATCHER_OPS[int(m.type)], m.value))
+        for shard in memstore.shards(dataset):
+            pids = shard.lookup_partitions(filters, q.start_timestamp_ms, q.end_timestamp_ms)
+            for pid in pids:
+                part = shard.partition(int(pid))
+                col = part.schema.value_column
+                try:
+                    t, v = part.samples_in_range(q.start_timestamp_ms, q.end_timestamp_ms, col)
+                except KeyError:
+                    continue
+                if v.ndim != 1 or not len(t):
+                    continue
+                series = result.timeseries.add()
+                for k, val in sorted(part.tags.items()):
+                    series.labels.add(name="__name__" if k == METRIC_TAG else k, value=val)
+                for i in range(len(t)):
+                    if not np.isnan(v[i]):
+                        series.samples.add(value=float(v[i]), timestamp=int(t[i]))
+    return snappy.compress(resp.SerializeToString())
