@@ -36,8 +36,10 @@ MXU_FUNCS = {
     "last_over_time", "first_over_time", "present_over_time",
     "absent_over_time", "timestamp", "stddev_over_time", "stdvar_over_time",
     "z_score", "rate", "increase", "delta", "idelta", "irate", "changes",
-    "resets", "deriv", "predict_linear",
+    "resets", "deriv", "predict_linear", "min_over_time", "max_over_time",
 }
+
+_TILE = 16  # tile width for the min/max hierarchy
 
 
 class WindowMatrices:
@@ -78,6 +80,34 @@ class WindowMatrices:
         # pair-membership for changes/resets: pairs (t-1, t) with both in window
         P = ((tidx > lo[None, :]) & (tidx < hi[None, :])).astype(np.float32)
         self.P = P
+        # min/max hierarchy: per window, full _TILE-wide tiles are reduced
+        # from precomputed tile mins; the <=2*_TILE edge samples are fetched
+        # by a selection one-hot MATMUL (gathers are pathologically slow on
+        # this backend; a one-hot matmul is an MXU-speed gather)
+        Lt = _TILE  # (distinct name: L above is the last-sample one-hot)
+        n_tiles = T // Lt
+        t_lo = -(-lo // Lt)  # ceil
+        t_hi = hi // Lt
+        full = np.arange(n_tiles)[None, :]
+        self.tile_mask = (
+            (full >= t_lo[:, None]) & (full < t_hi[:, None]) & (t_lo < t_hi)[:, None]
+        )  # [J, n_tiles]
+        E = np.zeros((T, J * 2 * Lt), dtype=np.float32)
+        edge_valid = np.zeros((J, 2 * Lt), dtype=bool)
+        for j in range(J):
+            if hi[j] <= lo[j]:
+                continue
+            if t_lo[j] >= t_hi[j]:  # window inside <2 tiles: all samples are edges
+                left = np.arange(lo[j], hi[j])
+                right = np.empty(0, dtype=np.int64)
+            else:
+                left = np.arange(lo[j], t_lo[j] * Lt)
+                right = np.arange(t_hi[j] * Lt, hi[j])
+            for slot, pos in enumerate(np.concatenate([left, right])[: 2 * Lt]):
+                E[pos, j * 2 * Lt + slot] = 1.0
+                edge_valid[j, slot] = True
+        self.edge_onehot = E
+        self.edge_valid = edge_valid
         # device-resident copies (transferred once, reused every query)
         import jax
 
@@ -91,6 +121,9 @@ class WindowMatrices:
         self.d_out_t = put(self.out_t.astype(np.float32))
         self.d_st = put(self.st)
         self.d_stt = put(self.stt.astype(np.float32))
+        self.d_tile_mask = put(self.tile_mask)
+        self.d_edge_onehot = put(self.edge_onehot)
+        self.d_edge_valid = put(self.edge_valid)
 
 
 def window_matrices(block: StagedBlock, start_off: int, step_ms: int,
@@ -209,6 +242,29 @@ def mxu_pair_count(flagged, P, has):
     return jnp.where(has, n, jnp.nan)
 
 
+@functools.partial(jax.jit, static_argnames=("n_valid", "is_min"))
+def mxu_minmax(vals, tile_mask, edge_onehot, edge_valid, count,
+               n_valid: int, is_min: bool = True):
+    """min/max_over_time on the regular grid: tile-hierarchy + edge samples
+    via selection matmul (no gathers). vals [S, T]; tile_mask [J, T/L];
+    edge_onehot [T, J*2L]; edge_valid [J, 2L]."""
+    S, T = vals.shape
+    L = _TILE
+    J = tile_mask.shape[0]
+    v = vals if is_min else -vals
+    sentinel = jnp.float32(3e38)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (S, T), 1)
+    vm = jnp.where(lane < n_valid, v, sentinel)
+    tmin = vm.reshape(S, T // L, L).min(-1)  # [S, T/L]
+    full = jnp.where(tile_mask[None, :, :], tmin[:, None, :], sentinel).min(-1)  # [S, J]
+    edges = jax.lax.dot(vm, edge_onehot, precision=jax.lax.Precision.HIGHEST)
+    edges = edges.reshape(S, J, 2 * L)
+    edges = jnp.where(edge_valid[None, :, :], edges, sentinel).min(-1)  # [S, J]
+    r = jnp.minimum(full, edges)
+    r = r if is_min else -r
+    return jnp.where((count > 0)[None, :], r, jnp.nan)
+
+
 @functools.partial(jax.jit, static_argnames=("predict",))
 def mxu_regression(vals, W, Wt, st, stt, count, has, lead, predict: bool = False):
     """deriv / predict_linear via least squares with host-precomputed
@@ -239,6 +295,12 @@ def run_mxu_range_function(func, block: StagedBlock, params, is_counter=False,
         prev = jnp.concatenate([vals[:, :1], vals[:, :-1]], axis=1)
         flag = (vals != prev) if func == "changes" else (vals < prev)
         return mxu_pair_count(flag.astype(jnp.float32), wm.dP, wm.d_count > 0)
+    if func in ("min_over_time", "max_over_time"):
+        return mxu_minmax(
+            jnp.asarray(block.vals), wm.d_tile_mask, wm.d_edge_onehot,
+            wm.d_edge_valid, wm.d_count,
+            n_valid=int(block.lens[0]), is_min=(func == "min_over_time"),
+        )
     if func in ("deriv", "predict_linear"):
         lead = np.float32(args[0]) if args else np.float32(0.0)
         return mxu_regression(
