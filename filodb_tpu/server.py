@@ -63,7 +63,16 @@ class FiloServer:
         if root:
             for sh in self.memstore.shards(self.dataset):
                 sh.odp_store = self.column_store
-        self.flusher = FlushCoordinator(self.memstore, self.column_store)
+        downsampler = None
+        if cfg["downsample"]["enabled"]:
+            from .downsample.downsampler import ShardDownsampler
+
+            downsampler = ShardDownsampler(
+                self.memstore, self.dataset,
+                periods_ms=tuple(int(m) * 60_000 for m in cfg["downsample"]["periods_m"]),
+            )
+        self.downsampler = downsampler
+        self.flusher = FlushCoordinator(self.memstore, self.column_store, downsampler)
         from .coordinator.planner import PlannerParams
 
         qcfg = cfg["query"]

@@ -29,9 +29,12 @@ class FlushResult:
 
 
 class FlushCoordinator:
-    def __init__(self, memstore, store: ColumnStore):
+    def __init__(self, memstore, store: ColumnStore, downsampler=None):
         self.memstore = memstore
         self.store = store
+        # optional ShardDownsampler: emits downsample records during flush
+        # (reference ShardDownsampler runs inside doFlushSteps)
+        self.downsampler = downsampler
 
     def flush_shard(self, dataset: str, shard_num: int, offset: int | None = None) -> FlushResult:
         shard = self.memstore.shard(dataset, shard_num)
@@ -46,6 +49,8 @@ class FlushCoordinator:
                 self.store.write_partkey(
                     dataset, shard_num, part.tags, part.earliest_ts(), part.latest_ts()
                 )
+                if self.downsampler is not None:
+                    self.downsampler.downsample_chunks(shard_num, part, chunks)
                 part.mark_flushed(chunks[-1].end_ts)
                 res.chunks_written += len(chunks)
                 res.partkeys_written += 1

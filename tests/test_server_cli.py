@@ -81,3 +81,29 @@ def test_cli_against_server(tmp_path, capsys):
         assert len(out["data"]["result"]) == 2
     finally:
         srv.stop()
+
+
+def test_server_downsamples_at_flush():
+    srv = FiloServer({
+        "shards": 1,
+        "max_chunk_size": 100,
+        "downsample": {"enabled": True, "periods_m": [5]},
+    })
+    srv.memstore.ingest("prometheus", 0,
+                        machine_metrics(n_series=2, n_samples=300, start_ms=BASE))
+    srv.flush_now()
+    ds_shard = srv.memstore.shard("prometheus_5m", 0)
+    assert ds_shard.num_partitions == 2
+    part = ds_shard.partitions[0]
+    ts, avg = part.samples_in_range(0, 2**62, "avg")
+    assert len(ts) >= 9  # 300 samples @10s = 50min -> >=9 5m periods
+    # downsampled data is queryable through a downsample planner
+    from filodb_tpu.coordinator.planners import DownsampleClusterPlanner
+    from filodb_tpu.query.exec.plans import QueryContext
+    from filodb_tpu.query.promql import query_range_to_logical_plan
+
+    planner = DownsampleClusterPlanner(srv.memstore, "prometheus_5m")
+    plan = query_range_to_logical_plan(
+        "max_over_time(heap_usage0[10m])", (BASE + 600_000) / 1000, (BASE + 2_400_000) / 1000, 300)
+    res = planner.materialize(plan).execute(QueryContext(srv.memstore, "prometheus_5m"))
+    assert sum(g.n_series for g in res.grids) == 2
