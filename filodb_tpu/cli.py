@@ -103,6 +103,89 @@ def cmd_partkey(args):
     )
 
 
+def cmd_downsample_batch(args):
+    """Batch downsample job (reference spark-jobs DownsamplerMain)."""
+    from .core.schemas import Dataset
+    from .downsample.downsampler import ShardDownsampler
+    from .memstore.memstore import TimeSeriesMemStore
+    from .store.columnstore import LocalColumnStore
+    from .store.flush import FlushCoordinator
+    from .downsample.downsampler import batch_downsample
+
+    store = LocalColumnStore(args.store)
+    shard_nums = sorted(
+        int(d.split("-")[1])
+        for d in __import__("os").listdir(__import__("os").path.join(args.store, args.dataset))
+        if d.startswith("shard-")
+    )
+    ms = TimeSeriesMemStore()
+    dsm = TimeSeriesMemStore()
+    d = ShardDownsampler(dsm, args.dataset,
+                         periods_ms=tuple(int(m) * 60_000 for m in args.periods.split(",")))
+    n = batch_downsample(store, ms, args.dataset, shard_nums, dsm, d)
+    # persist the downsample datasets back to the store
+    written = 0
+    for period in d.periods_ms:
+        ds_name = d.dataset_for(period)
+        if ds_name not in dsm._datasets:
+            continue
+        fc = FlushCoordinator(dsm, store)
+        for s in dsm.shard_nums(ds_name):
+            r = fc.flush_shard(ds_name, s)
+            written += r.chunks_written
+    _print({"downsampled_rows": n, "chunks_written": written})
+
+
+def cmd_cardbust(args):
+    """Delete persisted series matching a selector (reference
+    CardinalityBusterMain)."""
+    import os as _os
+
+    from .store.columnstore import LocalColumnStore
+    from .store.repair import bust_cardinality
+
+    store = LocalColumnStore(args.store)
+    filters = _matchers_from_selector(args.selector)
+    shard_nums = sorted(
+        int(d.split("-")[1])
+        for d in _os.listdir(_os.path.join(args.store, args.dataset))
+        if d.startswith("shard-")
+    )
+    deleted = bust_cardinality(store, args.dataset, shard_nums, filters)
+    _print({"series_deleted": deleted})
+
+
+def cmd_copy_store(args):
+    """Copy chunks+partkeys between stores (reference repair ChunkCopier)."""
+    import os as _os
+
+    from .store.columnstore import LocalColumnStore
+    from .store.repair import copy_chunks, copy_partkeys
+
+    src = LocalColumnStore(args.src)
+    dst = LocalColumnStore(args.dst)
+    shard_nums = sorted(
+        int(d.split("-")[1])
+        for d in _os.listdir(_os.path.join(args.src, args.dataset))
+        if d.startswith("shard-")
+    )
+    n_chunks = copy_chunks(src, dst, args.dataset, shard_nums)
+    n_keys = copy_partkeys(src, dst, args.dataset, shard_nums)
+    _print({"chunks_copied": n_chunks, "partkeys_copied": n_keys})
+
+
+def _matchers_from_selector(expr: str):
+    from .core.filters import ColumnFilter
+    from .core.schemas import METRIC_TAG
+    from .query.promql import Parser
+
+    sel = Parser(expr).selector()
+    filters = list(sel.matchers)
+    if sel.metric:
+        filters.append(ColumnFilter(METRIC_TAG, "=", sel.metric))
+    return filters
+
+
 def cmd_serve(args):
     from .server import main as server_main
 
@@ -162,6 +245,24 @@ def main(argv=None):
     sp = sub.add_parser("partkey")
     sp.add_argument("selector")
     sp.set_defaults(fn=cmd_partkey)
+
+    sp = sub.add_parser("downsample-batch")
+    sp.add_argument("--store", required=True)
+    sp.add_argument("--dataset", default="prometheus")
+    sp.add_argument("--periods", default="5,60", help="minutes, comma-separated")
+    sp.set_defaults(fn=cmd_downsample_batch)
+
+    sp = sub.add_parser("cardbust")
+    sp.add_argument("--store", required=True)
+    sp.add_argument("--dataset", default="prometheus")
+    sp.add_argument("selector")
+    sp.set_defaults(fn=cmd_cardbust)
+
+    sp = sub.add_parser("copy-store")
+    sp.add_argument("--src", required=True)
+    sp.add_argument("--dst", required=True)
+    sp.add_argument("--dataset", default="prometheus")
+    sp.set_defaults(fn=cmd_copy_store)
 
     args = p.parse_args(argv)
     args.fn(args)

@@ -107,3 +107,30 @@ def test_server_downsamples_at_flush():
         "max_over_time(heap_usage0[10m])", (BASE + 600_000) / 1000, (BASE + 2_400_000) / 1000, 300)
     res = planner.materialize(plan).execute(QueryContext(srv.memstore, "prometheus_5m"))
     assert sum(g.n_series for g in res.grids) == 2
+
+
+def test_cli_admin_jobs(tmp_path, capsys):
+    """downsample-batch, cardbust, copy-store against a flushed store."""
+    from filodb_tpu.core.schemas import Dataset
+    from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+    from filodb_tpu.memstore.shard import StoreConfig
+    from filodb_tpu.store.columnstore import LocalColumnStore
+    from filodb_tpu.store.flush import FlushCoordinator
+
+    src = str(tmp_path / "src")
+    ms = TimeSeriesMemStore(StoreConfig(max_chunk_size=100))
+    ms.setup(Dataset("prometheus"), [0])
+    ms.ingest("prometheus", 0, machine_metrics(n_series=4, n_samples=300, start_ms=BASE))
+    FlushCoordinator(ms, LocalColumnStore(src)).flush_shard("prometheus", 0)
+
+    cli_main(["downsample-batch", "--store", src, "--periods", "5"])
+    out = json.loads(capsys.readouterr().out)
+    assert out["downsampled_rows"] > 0 and out["chunks_written"] > 0
+
+    cli_main(["copy-store", "--src", src, "--dst", str(tmp_path / "dst")])
+    out = json.loads(capsys.readouterr().out)
+    assert out["partkeys_copied"] == 4
+
+    cli_main(["cardbust", "--store", src, 'heap_usage0{instance="host-0"}'])
+    out = json.loads(capsys.readouterr().out)
+    assert out["series_deleted"] == 1
