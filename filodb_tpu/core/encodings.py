@@ -256,6 +256,61 @@ def encode_hist(counts: np.ndarray) -> Encoded:
     return Encoded(FMT_DELTA2D_HIST, t * b, struct.pack("<ii", t, b) + packed)
 
 
+def encode_int_packed(vals: np.ndarray) -> Encoded:
+    """Bit-packed small ints (reference IntBinaryVector.scala: 1/2/4/8/16/32
+    nbits minimal-width packing). Values are offset by min then packed at the
+    smallest power-of-two bit width that fits."""
+    v = np.ascontiguousarray(vals, dtype=np.int64)
+    n = len(v)
+    if n == 0:
+        return Encoded(FMT_INT_PACK, 0, struct.pack("<qB", 0, 8))
+    base = int(v.min())
+    u = (v - base).astype(np.uint64)
+    vmax = int(u.max())
+    nbits = 1
+    for cand in (1, 2, 4, 8, 16, 32, 64):
+        if vmax < (1 << cand):
+            nbits = cand
+            break
+    if nbits == 64:
+        return encode_int64(vals)
+    if nbits >= 8:
+        packed = u.astype({8: np.uint8, 16: np.uint16, 32: np.uint32}[nbits]).tobytes()
+    else:
+        per_byte = 8 // nbits
+        pad = (-n) % per_byte
+        up = np.concatenate([u, np.zeros(pad, np.uint64)]).astype(np.uint8)
+        up = up.reshape(-1, per_byte)
+        shifts = (np.arange(per_byte, dtype=np.uint8) * nbits).astype(np.uint8)
+        packed = np.bitwise_or.reduce(up << shifts, axis=1).astype(np.uint8).tobytes()
+    return Encoded(FMT_INT_PACK, n, struct.pack("<qB", base, nbits) + packed)
+
+
+FMT_DICT_UTF8 = 8  # dictionary-encoded strings
+
+
+def encode_utf8_dict(strings: list) -> Encoded:
+    """Dictionary-encoded UTF8 column (reference DictUTF8Vector.scala):
+    unique blob table + per-row codes (bit-packed)."""
+    uniq: dict[str, int] = {}
+    codes = np.empty(len(strings), dtype=np.int64)
+    for i, s in enumerate(strings):
+        c = uniq.setdefault(s, len(uniq))
+        codes[i] = c
+    blob = b"\x00".join(s.encode() for s in uniq)
+    code_enc = encode_int_packed(codes)
+    payload = struct.pack("<II", len(uniq), len(blob)) + blob + code_enc.to_bytes()
+    return Encoded(FMT_DICT_UTF8, len(strings), payload)
+
+
+def decode_utf8_dict(enc: Encoded) -> list:
+    n_uniq, blob_len = struct.unpack_from("<II", enc.payload)
+    blob = enc.payload[8 : 8 + blob_len]
+    table = [b.decode() for b in blob.split(b"\x00")] if n_uniq else []
+    codes = decode(Encoded.from_bytes(enc.payload[8 + blob_len :]))
+    return [table[c] for c in codes]
+
+
 def decode(enc: Encoded) -> np.ndarray:
     """Decode any Encoded column back to its numpy array."""
     if enc.fmt == FMT_CONST_DELTA:
@@ -278,6 +333,22 @@ def decode(enc: Encoded) -> np.ndarray:
         d2 = _unzigzag(nibble_unpack(enc.payload[8:], t * b)).reshape(t, b)
         d_time = np.cumsum(d2, axis=1)
         return np.cumsum(d_time, axis=0)
+    if enc.fmt == FMT_INT_PACK:
+        base, nbits = struct.unpack_from("<qB", enc.payload)
+        data = enc.payload[9:]
+        n = enc.n
+        if n == 0:
+            return np.empty(0, dtype=np.int64)
+        if nbits >= 8:
+            dt = {8: np.uint8, 16: np.uint16, 32: np.uint32}[nbits]
+            u = np.frombuffer(data, dtype=dt, count=n).astype(np.int64)
+        else:
+            per_byte = 8 // nbits
+            raw = np.frombuffer(data, dtype=np.uint8)
+            shifts = (np.arange(per_byte, dtype=np.uint8) * nbits).astype(np.uint8)
+            mask = np.uint8((1 << nbits) - 1)
+            u = ((raw[:, None] >> shifts) & mask).reshape(-1)[:n].astype(np.int64)
+        return base + u
     raise ValueError(f"unknown wire format {enc.fmt}")
 
 

@@ -124,3 +124,38 @@ class TestHistogram:
     def test_hist_single_row(self):
         counts = np.array([[1, 2, 3, 10]], dtype=np.int64)
         np.testing.assert_array_equal(E.decode(E.encode_hist(counts)), counts)
+
+
+class TestIntPack:
+    @pytest.mark.parametrize("vmax,nbits_max", [(1, 1), (3, 2), (15, 4), (200, 8), (60000, 16), (10**9, 32)])
+    def test_roundtrip_widths(self, vmax, nbits_max):
+        rng = np.random.default_rng(vmax)
+        v = rng.integers(0, vmax + 1, 777).astype(np.int64)
+        enc = E.encode_int_packed(v)
+        assert enc.fmt == E.FMT_INT_PACK
+        np.testing.assert_array_equal(E.decode(enc), v)
+        assert enc.nbytes <= 777 * max(nbits_max // 8, 1) + 32
+
+    def test_negative_offsets(self):
+        v = np.array([-5, -3, -5, 2], dtype=np.int64)
+        np.testing.assert_array_equal(E.decode(E.encode_int_packed(v)), v)
+
+    def test_wide_falls_back(self):
+        v = np.array([0, 2**60], dtype=np.int64)
+        np.testing.assert_array_equal(E.decode(E.encode_int_packed(v)), v)
+
+    def test_empty(self):
+        assert E.decode(E.encode_int_packed(np.array([], dtype=np.int64))).size == 0
+
+
+class TestDictUTF8:
+    def test_roundtrip(self):
+        strings = ["api", "web", "api", "db", "api", "web"] * 100
+        enc = E.encode_utf8_dict(strings)
+        assert E.decode_utf8_dict(enc) == strings
+        # dictionary encoding beats raw join for repetitive values
+        assert enc.nbytes < sum(len(s) for s in strings) / 2
+
+    def test_unicode_and_empty(self):
+        strings = ["héllo", "", "日本語", ""]
+        assert E.decode_utf8_dict(E.encode_utf8_dict(strings)) == strings
