@@ -1,0 +1,81 @@
+"""Time-sharded execution with ring halo exchange vs the single-device
+kernel — windows crossing slice boundaries must be exact (the ring-attention
+halo correctness test)."""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.ops import kernels as K
+from filodb_tpu.ops.staging import stage_series
+from filodb_tpu.parallel import timeshard as TS
+
+BASE = 1_600_000_000_000
+
+
+def make_block(n_series=5, n=600, seed=0, counter=False, irregular=True):
+    rng = np.random.default_rng(seed)
+    series = []
+    for i in range(n_series):
+        if irregular:
+            ts = BASE + np.cumsum(rng.integers(5_000, 15_000, n)).astype(np.int64)
+        else:
+            ts = BASE + (1 + np.arange(n, dtype=np.int64)) * 10_000
+        if counter:
+            vals = np.cumsum(rng.uniform(0, 10, n)) + 1e9
+            k = n // 2 + i * 10
+            vals[k:] -= vals[k] - rng.uniform(0, 4)
+        else:
+            vals = 50 + 20 * rng.standard_normal(n)
+        series.append((ts, vals))
+    return series, stage_series(series, BASE, counter_corrected=counter)
+
+
+# long range: many steps so each of the 8 devices owns a span
+PARAMS = K.RangeParams(BASE + 400_000, 30_000, 160, 300_000)
+
+
+@pytest.mark.parametrize("func,counter", [
+    ("sum_over_time", False),
+    ("avg_over_time", False),
+    ("max_over_time", False),
+    ("last_over_time", False),
+    ("rate", True),
+    ("increase", True),
+])
+def test_timeshard_matches_single_device(func, counter):
+    mesh = TS.make_time_mesh()
+    assert mesh.devices.size == 8
+    _, block = make_block(counter=counter)
+    got = np.asarray(
+        TS.run_timesharded(mesh, func, block, PARAMS, is_counter=counter)
+    )[:5]
+    want = np.asarray(
+        K.run_range_function(func, block, PARAMS, is_counter=counter)
+    )[:5, : PARAMS.num_steps]
+    np.testing.assert_array_equal(np.isnan(got), np.isnan(want), err_msg=func)
+    m = ~np.isnan(want)
+    np.testing.assert_allclose(got[m], want[m], rtol=1e-4, atol=1e-4, err_msg=func)
+
+
+def test_boundary_windows_use_halo():
+    """A window entirely fed by halo samples (big gap at a slice boundary)
+    must still produce values, proving the ppermute halo works."""
+    mesh = TS.make_time_mesh()
+    _, block = make_block(n=600, seed=3)
+    params = K.RangeParams(BASE + 400_000, 30_000, 160, 600_000)  # 10m windows
+    got = np.asarray(TS.run_timesharded(mesh, "count_over_time", block, params))[:5]
+    want = np.asarray(K.run_range_function("count_over_time", block, params))[:5, :160]
+    np.testing.assert_array_equal(got, want)
+    # sanity: interior steps genuinely span slice boundaries (J_dev=20 steps
+    # per device; window 10m covers ~60 samples at ~10s spacing)
+    assert np.nanmax(want) >= 50
+
+
+def test_regular_grid_timeshard():
+    mesh = TS.make_time_mesh()
+    _, block = make_block(irregular=False)
+    got = np.asarray(TS.run_timesharded(mesh, "sum_over_time", block, PARAMS))[:5]
+    want = np.asarray(K.run_range_function("sum_over_time", block, PARAMS))[:5, :160]
+    np.testing.assert_array_equal(np.isnan(got), np.isnan(want))
+    m = ~np.isnan(want)
+    np.testing.assert_allclose(got[m], want[m], rtol=1e-4)
