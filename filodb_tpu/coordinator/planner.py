@@ -142,6 +142,9 @@ class SingleClusterPlanner:
                 [mapper],
             )
         if isinstance(p, L.PeriodicSeriesWithWindowing):
+            ts_plan = self._try_time_shard(p)
+            if ts_plan is not None:
+                return ts_plan
             mapper = PeriodicSamplesMapper(
                 p.start_ms, p.end_ms, p.step_ms, p.function, p.window_ms,
                 offset_ms=p.offset_ms, at_ms=p.at_ms, args=p.function_args,
@@ -258,6 +261,42 @@ class SingleClusterPlanner:
             inner.transformers.append(AggregateMapReduce(p.op, p.by, p.without))
             return ReduceAggregateExec([inner], p.op, p.by, p.without)
         return AggregatePresentExec([inner], p.op, p.params, p.by, p.without)
+
+    def _try_time_shard(self, p: "L.PeriodicSeriesWithWindowing"):
+        """Long non-aggregated range queries shard the TIME axis over the
+        mesh with a ring halo exchange (parallel/timeshard.py)."""
+        mesh = self.params.mesh
+        if mesh is None:
+            return None
+        from ..ops.kernels import SORTED_FUNCS
+        from ..parallel.exec import TIME_SHARD_MIN_STEPS, TimeShardRangeExec
+
+        num_steps = int((p.end_ms - p.start_ms) // (p.step_ms or 1)) + 1
+        if (
+            num_steps < TIME_SHARD_MIN_STEPS
+            or p.offset_ms
+            or p.at_ms is not None
+            or p.function_args
+            or p.function in SORTED_FUNCS
+            or p.raw.column is not None
+        ):
+            return None
+        # histograms stay on the standard path (plan-time schema peek)
+        for s in self.shards_for(None):
+            pids = self.memstore.shard(self.dataset, s).lookup_partitions(
+                p.raw.filters, p.raw.start_ms, p.raw.end_ms, limit=1
+            )
+            if len(pids):
+                part = self.memstore.shard(self.dataset, s).partition(int(pids[0]))
+                if part.schema.has_histogram:
+                    return None
+                break
+        is_counter = p.function in ("rate", "increase", "irate")
+        return TimeShardRangeExec(
+            mesh, self.shards_for(None), p.raw.filters, p.raw.start_ms, p.raw.end_ms,
+            p.function, p.start_ms, p.end_ms, p.step_ms, p.window_ms,
+            is_counter=is_counter,
+        )
 
     def _try_mesh_aggregate(self, p: L.Aggregate):
         """Mesh path: aggregate-of-range-function compiles to one psum

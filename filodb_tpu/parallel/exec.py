@@ -103,3 +103,74 @@ class MeshAggregateExec(ExecPlan):
         if not len(pids):
             return None
         return shard.partition(int(pids[0])).schema.value_column
+
+
+# planner routes non-aggregated range functions with at least this many
+# output steps to the time-sharded path (ring halo exchange)
+TIME_SHARD_MIN_STEPS = 512
+
+
+class TimeShardRangeExec(ExecPlan):
+    """Long-range windowed function over the mesh's TIME axis: all matching
+    series stage into one block whose time dimension shards across devices
+    with a ppermute lookback halo (parallel/timeshard.py)."""
+
+    def __init__(self, mesh, shard_nums, filters, raw_start_ms, raw_end_ms,
+                 function: str, start_ms: int, end_ms: int, step_ms: int,
+                 window_ms: int, is_counter=False, is_delta=False):
+        super().__init__()
+        self.mesh = mesh
+        self.shard_nums = list(shard_nums)
+        self.filters = tuple(filters)
+        self.raw_start_ms = raw_start_ms
+        self.raw_end_ms = raw_end_ms
+        self.function = function
+        self.start_ms = start_ms
+        self.end_ms = end_ms
+        self.step_ms = step_ms
+        self.window_ms = window_ms
+        self.is_counter = is_counter
+        self.is_delta = is_delta
+
+    def args_str(self):
+        return (
+            f"fn={self.function} steps~{(self.end_ms - self.start_ms) // self.step_ms + 1} "
+            f"time_devices={self.mesh.devices.size}"
+        )
+
+    def do_execute(self, ctx: QueryContext) -> QueryResult:
+        from . import timeshard as TSH
+        from ..query.exec.transformers import _strip_metric
+
+        series, labels = [], []
+        for s in self.shard_nums:
+            shard = ctx.memstore.shard(ctx.dataset, s)
+            pids = shard.lookup_partitions(self.filters, self.raw_start_ms, self.raw_end_ms)
+            if shard.odp_store is not None and len(pids):
+                shard.odp_page_in(pids, self.raw_start_ms, self.raw_end_ms)
+            for pid in pids:
+                part = shard.partition(int(pid))
+                col = part.schema.value_column
+                t, v = part.samples_in_range(self.raw_start_ms, self.raw_end_ms, col)
+                if v.ndim != 1:
+                    raise QueryError("time-sharded path supports scalar columns only")
+                series.append((t, v))
+                labels.append(dict(part.tags))
+            ctx.stats.series_scanned += len(pids)
+        if not series:
+            return QueryResult()
+        block = ST.stage_series(
+            series, self.raw_start_ms,
+            counter_corrected=self.is_counter and not self.is_delta,
+        )
+        num_steps = int((self.end_ms - self.start_ms) // self.step_ms) + 1
+        params = K.RangeParams(self.start_ms, self.step_ms, num_steps, self.window_ms)
+        out = TSH.run_timesharded(
+            self.mesh, self.function, block, params,
+            is_counter=self.is_counter, is_delta=self.is_delta,
+        )
+        labels = [_strip_metric(l) for l in labels] if self.function not in (
+            "last_over_time", "timestamp") else labels
+        return QueryResult(
+            grids=[Grid(labels, self.start_ms, self.step_ms, num_steps, out)]
+        )

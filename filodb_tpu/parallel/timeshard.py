@@ -123,14 +123,15 @@ def timesharded_range(
     standard range kernel per device on [halo | slice]. Returns
     [D, S, j_dev] step grids (device-major)."""
     D = mesh.devices.size
+    axis = mesh.axis_names[0]  # works over any single-axis mesh name
     perm = [(i, (i + 1) % D) for i in range(D)]
 
     def local(ts_l, vals_l, raw_l, lens_l, tts, tv, tr, base):
-        d = jax.lax.axis_index("time")
+        d = jax.lax.axis_index(axis)
         # halo arrives from the LEFT neighbor (ring shift right)
-        h_ts = jax.lax.ppermute(tts, "time", perm)[0]
-        h_v = jax.lax.ppermute(tv, "time", perm)[0]
-        h_r = jax.lax.ppermute(tr, "time", perm)[0]
+        h_ts = jax.lax.ppermute(tts, axis, perm)[0]
+        h_v = jax.lax.ppermute(tv, axis, perm)[0]
+        h_r = jax.lax.ppermute(tr, axis, perm)[0]
         # device 0 has no left neighbor: neutralize the wrapped halo
         h_ts = jnp.where(d == 0, jnp.int32(TS_NEG), h_ts)
         h_v = jnp.where(d == 0, 0.0, h_v)
@@ -151,9 +152,9 @@ def timesharded_range(
     return jax.shard_map(
         local,
         mesh=mesh,
-        in_specs=(P("time"), P("time"), P("time"), P("time"),
-                  P("time"), P("time"), P("time"), P()),
-        out_specs=P("time", None, None),
+        in_specs=(P(axis), P(axis), P(axis), P(axis),
+                  P(axis), P(axis), P(axis), P()),
+        out_specs=P(axis, None, None),
         check_vma=False,
     )(ts, vals, raw, lens, tail_ts, tail_vals, tail_raw, baseline)
 
@@ -166,7 +167,7 @@ def run_timesharded(mesh: Mesh, func: str, block: StagedBlock, params: K.RangePa
     ts, vals, raw, lens, tts, tv, tr, j_dev = split_time_axis(
         block, D, params.window_ms, params.start_ms, params.step_ms, params.num_steps
     )
-    dev = NamedSharding(mesh, P("time"))
+    dev = NamedSharding(mesh, P(mesh.axis_names[0]))
     rep = NamedSharding(mesh, P())
     out = timesharded_range(
         mesh, func,

@@ -74,3 +74,33 @@ def test_unsupported_shapes_fall_back(engines):
     ]:
         ep = mesh.planner.materialize(query_range_to_logical_plan(q, START_S, END_S, 60))
         assert not isinstance(ep, MeshAggregateExec), q
+
+
+class TestTimeShardInEngine:
+    def test_long_range_uses_time_shard(self, engines):
+        host, mesh = engines
+        from filodb_tpu.parallel.exec import TimeShardRangeExec
+        from filodb_tpu.query.promql import query_range_to_logical_plan
+
+        # 160 samples @10s = ~27min of data; query far more steps at 5s
+        long_end = (BASE + 1_600_000) / 1000
+        plan = query_range_to_logical_plan(
+            "rate(http_requests_total[2m])", START_S, long_end, 1.5)
+        ep = mesh.planner.materialize(plan)
+        assert isinstance(ep, TimeShardRangeExec)
+        r_mesh = ep.execute(mesh.context())
+        r_host = host.query_range("rate(http_requests_total[2m])", START_S, long_end, 1.5)
+        mh = grids_map(r_host)
+        mm = grids_map(r_mesh)
+        assert mh.keys() == mm.keys()
+        for k in mh:
+            np.testing.assert_allclose(mm[k][1], mh[k][1], rtol=2e-3)
+
+    def test_short_range_stays_standard(self, engines):
+        _, mesh = engines
+        from filodb_tpu.parallel.exec import TimeShardRangeExec
+        from filodb_tpu.query.promql import query_range_to_logical_plan
+
+        plan = query_range_to_logical_plan(
+            "rate(http_requests_total[5m])", START_S, END_S, 60)
+        assert not isinstance(mesh.planner.materialize(plan), TimeShardRangeExec)
