@@ -123,8 +123,11 @@ class FiloServer:
             self._http.shutdown()
 
     def _maintenance_loop(self):
-        """Periodic flush + retention eviction (reference flush timer +
-        evictForHeadroom)."""
+        """Periodic flush + retention eviction + tenant metering (reference
+        flush timer + evictForHeadroom + TenantIngestionMetering)."""
+        from .metering import TenantIngestionMetering
+
+        metering = TenantIngestionMetering(self.memstore, self.dataset)
         last_flush = time.time()
         while not self._stop.wait(min(self.flush_interval_s, 60.0)):
             now = time.time()
@@ -136,6 +139,10 @@ class FiloServer:
                 last_flush = now
             for sh in self.memstore.shards(self.dataset):
                 sh.evict_for_retention()
+            try:
+                metering.publish()
+            except Exception:  # noqa: BLE001
+                log.exception("metering failed")
 
     def flush_now(self):
         return self.flusher.flush_all(self.dataset)
