@@ -71,25 +71,24 @@ class PartKeyIndex:
     def _ids_for_filter(self, f: ColumnFilter) -> set[int]:
         vals = self._postings.get(f.column, {})
         if f.op == "=":
-            return set(vals.get(f.value, ()))
-        if f.op == "in":
-            out: set[int] = set()
+            out = set(vals.get(f.value, ()))
+        elif f.op == "in":
+            out = set()
             for v in f.value:
                 out |= vals.get(v, set())
-            return out
-        if f.op == "=~" and isinstance(f.value, str) and _LITERAL_ALT.match(f.value):
+        elif f.op == "=~" and isinstance(f.value, str) and _LITERAL_ALT.match(f.value):
             out = set()
             for v in f.value.split("|"):
                 out |= vals.get(v, set())
-            return out
-        # negative / general-regex filters scan the value dictionary, then
-        # must also include series missing the tag for negative matchers
-        # (PromQL: {k!="v"} matches series without k at all when v != "")
-        out = set()
-        for v, ids in vals.items():
-            if f.matches(v):
-                out |= ids
-        if f.op in ("!=", "!~", "not in") and f.matches(None):
+        else:
+            # negative / general-regex filters scan the value dictionary
+            out = set()
+            for v, ids in vals.items():
+                if f.matches(v):
+                    out |= ids
+        # PromQL: a matcher satisfied by the EMPTY string also matches series
+        # missing the tag entirely ({k!="v"}, {k=~".*"}, {k=""} ...)
+        if f.matches(None):
             tagged = set()
             for ids in vals.values():
                 tagged |= ids

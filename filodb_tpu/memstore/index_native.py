@@ -136,8 +136,9 @@ class NativePartKeyIndex(PartKeyIndex):
     # -- queries ------------------------------------------------------------
 
     def part_ids_from_filters(self, filters: Sequence[ColumnFilter], start_ts, end_ts, limit=None):
-        eq = [f for f in filters if f.op == "="]
-        rest = [f for f in filters if f.op != "="]
+        # equality with "" matches missing tags too (PromQL) — python path
+        eq = [f for f in filters if f.op == "=" and f.value != ""]
+        rest = [f for f in filters if not (f.op == "=" and f.value != "")]
         if eq and not rest:
             out = self._query_native(eq, start_ts, end_ts)
             if limit is not None:

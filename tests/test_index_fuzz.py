@@ -1,0 +1,96 @@
+"""Property-style index fuzzing: random tag universes + random filter
+combinations, both index backends vs brute-force filtering (model:
+reference PartKeyIndexRawSpec exhaustive matcher cases)."""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.core.filters import ColumnFilter
+from filodb_tpu.memstore.index import PartKeyIndex
+
+try:
+    from filodb_tpu.memstore.index_native import (
+        NativePartKeyIndex,
+        native_index_available,
+    )
+
+    IMPLS = [PartKeyIndex] + ([NativePartKeyIndex] if native_index_available() else [])
+except Exception:  # pragma: no cover
+    IMPLS = [PartKeyIndex]
+
+
+def build_universe(rng, n=500):
+    metrics = [f"metric_{i}" for i in range(8)]
+    hosts = [f"host-{i}" for i in range(25)]
+    dcs = ["us-east", "us-west", "eu", "ap"]
+    parts = []
+    for pid in range(n):
+        tags = {
+            "_metric_": metrics[rng.integers(len(metrics))],
+            "host": hosts[rng.integers(len(hosts))],
+            "dc": dcs[rng.integers(len(dcs))],
+        }
+        if rng.random() < 0.3:
+            tags["extra"] = f"e{rng.integers(3)}"
+        start = int(rng.integers(0, 10_000))
+        end = int(start + rng.integers(100, 20_000))
+        parts.append((pid, tags, start, end))
+    return parts
+
+
+def random_filters(rng):
+    out = []
+    for _ in range(rng.integers(1, 4)):
+        col = ["_metric_", "host", "dc", "extra"][rng.integers(4)]
+        op = ["=", "!=", "=~", "!~"][rng.integers(4)]
+        if op in ("=", "!="):
+            val = [f"metric_{rng.integers(8)}", f"host-{rng.integers(25)}",
+                   "us-east", f"e{rng.integers(3)}"][rng.integers(4)]
+        else:
+            val = ["metric_[0-3]", "host-1.*", "us.*", "e1|e2", ""][rng.integers(5)]
+        out.append(ColumnFilter(col, op, val))
+    return out
+
+
+def brute_force(parts, filters, start, end):
+    out = []
+    for pid, tags, s, e in parts:
+        if s > end or e < start:
+            continue
+        if all(f.matches(tags.get(f.column)) for f in filters):
+            out.append(pid)
+    return sorted(out)
+
+
+@pytest.mark.parametrize("impl", IMPLS, ids=lambda c: c.__name__)
+@pytest.mark.parametrize("seed", range(8))
+def test_random_filters_match_brute_force(impl, seed):
+    rng = np.random.default_rng(seed)
+    parts = build_universe(rng)
+    idx = impl()
+    for pid, tags, s, e in parts:
+        idx.add_partkey(pid, tags, s, e)
+    for _ in range(25):
+        filters = random_filters(rng)
+        start = int(rng.integers(0, 15_000))
+        end = int(start + rng.integers(0, 15_000))
+        got = sorted(idx.part_ids_from_filters(filters, start, end).tolist())
+        want = brute_force(parts, filters, start, end)
+        assert got == want, (filters, start, end)
+
+
+@pytest.mark.parametrize("impl", IMPLS, ids=lambda c: c.__name__)
+def test_removal_consistency(impl):
+    rng = np.random.default_rng(99)
+    parts = build_universe(rng, n=200)
+    idx = impl()
+    for pid, tags, s, e in parts:
+        idx.add_partkey(pid, tags, s, e)
+    removed = set(range(0, 200, 3))
+    idx.remove(removed)
+    kept = [p for p in parts if p[0] not in removed]
+    for _ in range(10):
+        filters = random_filters(rng)
+        got = sorted(idx.part_ids_from_filters(filters, 0, 10**9).tolist())
+        want = brute_force(kept, filters, 0, 10**9)
+        assert got == want
