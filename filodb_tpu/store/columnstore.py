@@ -171,7 +171,12 @@ class LocalColumnStore(ColumnStore):
 
     def read_chunks(self, dataset, shard):
         """Yield (header, schema_name, [Encoded per column]) for every chunk
-        set in the shard (reference readRawPartitions:774)."""
+        set in the shard (reference readRawPartitions:774).
+
+        A truncated tail (crash mid-append) ends that segment's iteration
+        cleanly — everything before the torn frame is served; the next flush
+        appends after it (the torn frame is bounded garbage the reader skips
+        forever, matching the reference's torn-write tolerance)."""
         d = os.path.join(self.root, dataset, f"shard-{shard}")
         if not os.path.isdir(d):
             return
@@ -180,14 +185,34 @@ class LocalColumnStore(ColumnStore):
                 continue
             with open(os.path.join(d, fn), "rb") as f:
                 while True:
-                    frame = f.read(_FRAME.size)
-                    if len(frame) < _FRAME.size:
-                        break
-                    _, schema_id, n_cols = _FRAME.unpack(frame)
-                    (hlen,) = struct.unpack("<I", f.read(4))
-                    header = json.loads(f.read(hlen))
-                    encs = []
-                    for _ in range(n_cols):
-                        (plen,) = struct.unpack("<I", f.read(4))
-                        encs.append(Encoded.from_bytes(f.read(plen)))
+                    try:
+                        frame = f.read(_FRAME.size)
+                        if len(frame) < _FRAME.size:
+                            break
+                        _, schema_id, n_cols = _FRAME.unpack(frame)
+                        hdr_len_raw = f.read(4)
+                        if len(hdr_len_raw) < 4:
+                            break
+                        (hlen,) = struct.unpack("<I", hdr_len_raw)
+                        hdr_raw = f.read(hlen)
+                        if len(hdr_raw) < hlen:
+                            break
+                        header = json.loads(hdr_raw)
+                        encs = []
+                        torn = False
+                        for _ in range(n_cols):
+                            plen_raw = f.read(4)
+                            if len(plen_raw) < 4:
+                                torn = True
+                                break
+                            (plen,) = struct.unpack("<I", plen_raw)
+                            payload = f.read(plen)
+                            if len(payload) < plen:
+                                torn = True
+                                break
+                            encs.append(Encoded.from_bytes(payload))
+                        if torn:
+                            break
+                    except (json.JSONDecodeError, struct.error, ValueError):
+                        break  # corrupted frame: stop this segment
                     yield header, header["schema"], encs
