@@ -60,6 +60,10 @@ class PeriodicSamplesMapper:
             eval_steps = 1 if self.at_ms is not None else nsteps
             params = K.RangeParams(eval_start, self.step_ms, eval_steps, window)
             if rg.is_histogram:
+                if func not in ("rate", "increase", "delta", "sum_over_time", "last", "last_over_time"):
+                    raise QueryError(
+                        f"function {self.function} is not supported on native histograms"
+                    )
                 vals = HK.run_hist_range_function(func, rg.block, params, is_delta=rg.is_delta)
                 scalar_vals = vals[..., -1] * jnp.nan  # placeholder [S,J]
                 g = Grid(
