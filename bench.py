@@ -120,9 +120,16 @@ def cpu_baseline(ms, ts):
 
 
 def tpu_query(ms):
-    from filodb_tpu.coordinator.planner import QueryEngine
+    import jax
 
-    engine = QueryEngine(ms, "prometheus")
+    from filodb_tpu.coordinator.planner import PlannerParams, QueryEngine
+    from filodb_tpu.parallel.mesh import make_mesh
+
+    # a device mesh (even a single chip) lets the planner compile the whole
+    # multi-shard sum(rate) into ONE kernel call (MeshAggregateExec MXU path)
+    engine = QueryEngine(
+        ms, "prometheus", PlannerParams(mesh=make_mesh(jax.devices()[:1]))
+    )
     q = "sum(rate(http_requests_total[5m]))"
 
     def run():

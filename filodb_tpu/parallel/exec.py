@@ -53,10 +53,6 @@ class MeshAggregateExec(ExecPlan):
 
     def do_execute(self, ctx: QueryContext) -> QueryResult:
         n_dev = self.mesh.devices.size
-        if len(self.shard_nums) > n_dev:
-            raise QueryError(
-                f"{len(self.shard_nums)} shards > {n_dev} mesh devices"
-            )
         # stage per shard (host) and compute GLOBAL group numbering so the
         # on-device segment ids agree across every shard
         blocks, labels_per_shard = [], []
@@ -85,18 +81,54 @@ class MeshAggregateExec(ExecPlan):
             gids_per_block.append(gids_all[off : off + len(ls)].astype(np.int32))
             off += len(ls)
         arrays = M.stack_blocks_for_mesh(blocks, gids_per_block, n_dev)
-        sharded = M.shard_arrays(self.mesh, *arrays)
         num_steps = int((self.end_ms - self.start_ms) // self.step_ms) + 1
         j_pad = K.pad_steps(num_steps)
         base = blocks[0].base_ms
-        out = M.distributed_agg_range(
-            self.mesh, self.function, self.op, *sharded,
-            np.int32(self.start_ms - base), np.int32(self.step_ms),
-            np.int32(self.window_ms), j_pad, len(group_labels),
-            is_counter=self.is_counter, is_delta=self.is_delta,
-        )
+        out = self._run_mxu(blocks, arrays, j_pad, base, len(group_labels))
+        if out is None:
+            sharded = M.shard_arrays(self.mesh, *arrays)
+            out = M.distributed_agg_range(
+                self.mesh, self.function, self.op, *sharded,
+                np.int32(self.start_ms - base), np.int32(self.step_ms),
+                np.int32(self.window_ms), j_pad, len(group_labels),
+                is_counter=self.is_counter, is_delta=self.is_delta,
+            )
         return QueryResult(
             grids=[Grid(group_labels, self.start_ms, self.step_ms, num_steps, out)]
+        )
+
+    _MXU_MESH_FUNCS = {
+        "sum_over_time", "count_over_time", "avg_over_time", "last",
+        "last_over_time", "first_over_time", "present_over_time",
+        "absent_over_time", "stddev_over_time", "stdvar_over_time",
+        "z_score", "rate", "increase", "delta", "idelta", "irate",
+    }
+
+    def _run_mxu(self, blocks, arrays, j_pad, base, num_groups):
+        """Shared-scrape-grid fast path: MXU matmul kernel inside shard_map
+        (single compiled call even when many shards pack one device)."""
+        if self.function not in self._MXU_MESH_FUNCS:
+            return None
+        r0 = blocks[0].regular_ts
+        if r0 is None:
+            return None
+        for b in blocks[1:]:
+            if b.regular_ts is None or len(b.regular_ts) != len(r0) or (b.regular_ts != r0).any():
+                return None
+        from ..ops.mxu_kernels import WindowMatrices
+
+        ts, vals, lens, baseline, raw, gids = arrays
+        n_valid = int(np.asarray(blocks[0].lens)[0])
+        wm = WindowMatrices(
+            r0, n_valid, self.start_ms - base, self.step_ms, j_pad, self.window_ms
+        )
+        return M.distributed_agg_range_mxu(
+            self.mesh, self.function, self.op,
+            vals, raw, lens, baseline, gids,
+            wm.dW, wm.dF, wm.dL, wm.dL2,
+            wm.d_count, wm.d_tf, wm.d_tl, wm.d_tl2, wm.d_out_t,
+            np.float32(self.window_ms), num_groups,
+            is_counter=self.is_counter, is_delta=self.is_delta,
         )
 
     def _column(self, ctx, shard, pids) -> str | None:

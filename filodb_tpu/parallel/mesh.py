@@ -34,6 +34,76 @@ def make_mesh(devices=None) -> Mesh:
     return Mesh(np.array(devices), axis_names=("shard",))
 
 
+def _segment_psum(op: str, grid, gids_l, num_groups: int):
+    """Local segment-reduce + psum over the shard axis (shared by the
+    general and MXU local kernels)."""
+    valid = ~jnp.isnan(grid)
+    v0 = jnp.where(valid, grid, 0.0)
+    psum = jax.lax.psum
+    if op in ("sum", "avg", "count"):
+        s = psum(jax.ops.segment_sum(v0, gids_l, num_groups), "shard")
+        c = psum(jax.ops.segment_sum(valid.astype(jnp.float32), gids_l, num_groups), "shard")
+        if op == "sum":
+            return jnp.where(c > 0, s, jnp.nan)
+        if op == "count":
+            return jnp.where(c > 0, c, jnp.nan)
+        return jnp.where(c > 0, s / jnp.maximum(c, 1.0), jnp.nan)
+    if op in ("min", "max"):
+        big = jnp.inf if op == "min" else -jnp.inf
+        vm = jnp.where(valid, grid, big)
+        if op == "min":
+            r = jax.lax.pmin(jax.ops.segment_min(vm, gids_l, num_groups), "shard")
+        else:
+            r = jax.lax.pmax(jax.ops.segment_max(vm, gids_l, num_groups), "shard")
+        c = psum(jax.ops.segment_sum(valid.astype(jnp.float32), gids_l, num_groups), "shard")
+        return jnp.where(c > 0, r, jnp.nan)
+    raise ValueError(f"unsupported mesh aggregation {op}")
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mesh", "func", "op", "num_groups", "is_counter", "is_delta"),
+)
+def distributed_agg_range_mxu(
+    mesh: Mesh,
+    func: str,
+    op: str,
+    vals, raw,  # [D*S, T] sharded
+    lens, baseline, gids,  # [D*S]
+    W, F, L, L2,  # [T, J] replicated window matrices
+    count, t_first, t_last, t_last2, out_t,  # [J] replicated
+    window_ms,
+    num_groups: int,
+    is_counter: bool = False,
+    is_delta: bool = False,
+):
+    """Regular-grid mesh aggregation: the MXU matmul kernel inside shard_map
+    (one compiled program; on one device this collapses a multi-shard query
+    to a single kernel invocation)."""
+    from ..ops.mxu_kernels import mxu_range_kernel
+
+    def local(vals_l, raw_l, lens_l, base_l, gids_l):
+        grid = mxu_range_kernel(
+            func, vals_l, raw_l, base_l, W, F, L, L2,
+            count, t_first, t_last, t_last2, out_t, window_ms,
+            is_counter=is_counter, is_delta=is_delta,
+        )
+        # padded rows (lens 0) would read as zero-valued series: mask them
+        grid = jnp.where((lens_l > 0)[:, None], grid, jnp.nan)
+        return _segment_psum(op, grid, gids_l, num_groups)
+
+    shard = P("shard")
+    row = P("shard", None)
+    rep = P()
+    return jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(row, row, shard, shard, shard),
+        out_specs=rep,
+        check_vma=False,
+    )(vals, raw, lens, baseline, gids)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("mesh", "func", "op", "num_steps", "num_groups", "is_counter", "is_delta"),
@@ -68,29 +138,7 @@ def distributed_agg_range(
             start_off, step_ms, window, num_steps,
             is_counter=is_counter, is_delta=is_delta,
         )
-        valid = ~jnp.isnan(grid)
-        v0 = jnp.where(valid, grid, 0.0)
-        psum = jax.lax.psum
-        if op in ("sum", "avg", "count"):
-            s = jax.ops.segment_sum(v0, gids_l, num_groups)
-            c = jax.ops.segment_sum(valid.astype(jnp.float32), gids_l, num_groups)
-            s = psum(s, "shard")
-            c = psum(c, "shard")
-            if op == "sum":
-                return jnp.where(c > 0, s, jnp.nan)
-            if op == "count":
-                return jnp.where(c > 0, c, jnp.nan)
-            return jnp.where(c > 0, s / jnp.maximum(c, 1.0), jnp.nan)
-        if op in ("min", "max"):
-            big = jnp.inf if op == "min" else -jnp.inf
-            vm = jnp.where(valid, grid, big)
-            if op == "min":
-                r = jax.lax.pmin(jax.ops.segment_min(vm, gids_l, num_groups), "shard")
-            else:
-                r = jax.lax.pmax(jax.ops.segment_max(vm, gids_l, num_groups), "shard")
-            c = psum(jax.ops.segment_sum(valid.astype(jnp.float32), gids_l, num_groups), "shard")
-            return jnp.where(c > 0, r, jnp.nan)
-        raise ValueError(f"unsupported mesh aggregation {op}")
+        return _segment_psum(op, grid, gids_l, num_groups)
 
     shard = P("shard")
     row = P("shard", None)
@@ -106,33 +154,37 @@ def distributed_agg_range(
 def stack_blocks_for_mesh(blocks: list[StagedBlock], gids_per_block: list[np.ndarray], n_devices: int):
     """Concatenate per-shard staged blocks into mesh-shardable arrays.
 
-    Pads every block to the same [S_dev, T] so the leading axis divides
-    evenly across devices; padded rows get group id 0 with len 0 (they
-    contribute nothing)."""
-    if len(blocks) > n_devices:
-        raise ValueError("more shard blocks than devices")
-    T = max(b.ts.shape[1] for b in blocks)
-    S_dev = max(pad_series(max(b.n_series, 1)) for b in blocks)
+    Blocks distribute round-robin over devices (several shards may share a
+    device — the single-chip case packs ALL shards into one block). Padded
+    rows get group id 0 with len 0 (they contribute nothing)."""
     D = n_devices
+    T = max(b.ts.shape[1] for b in blocks)
+    per_dev: list[list[int]] = [[] for _ in range(D)]
+    for i in range(len(blocks)):
+        per_dev[i % D].append(i)
+    S_dev = pad_series(max(1, max(
+        sum(blocks[i].n_series for i in idxs) for idxs in per_dev
+    )))
     ts = np.full((D * S_dev, T), np.int32(2**31 - 1), dtype=np.int32)
     vals = np.zeros((D * S_dev, T), dtype=np.float32)
     raw = np.zeros((D * S_dev, T), dtype=np.float32)
     lens = np.zeros(D * S_dev, dtype=np.int32)
     baseline = np.zeros(D * S_dev, dtype=np.float32)
     gids = np.zeros(D * S_dev, dtype=np.int32)
-    for d, (b, g) in enumerate(zip(blocks, gids_per_block)):
+    for d, idxs in enumerate(per_dev):
         o = d * S_dev
-        n, t = b.ts.shape
-        k = b.n_series
-        ts[o : o + k, :t] = b.ts[:k]
-        vals[o : o + k, :t] = b.vals[:k]
-        if b.raw is not None:
-            raw[o : o + k, :t] = b.raw[:k]
-        else:
-            raw[o : o + k, :t] = b.vals[:k]
-        lens[o : o + k] = b.lens[:k]
-        baseline[o : o + k] = b.baseline[:k]
-        gids[o : o + k] = g
+        for i in idxs:
+            b, g = blocks[i], gids_per_block[i]
+            t = b.ts.shape[1]
+            k = b.n_series
+            ts[o : o + k, :t] = np.asarray(b.ts)[:k]
+            vals[o : o + k, :t] = np.asarray(b.vals)[:k]
+            raw_src = b.raw if b.raw is not None else b.vals
+            raw[o : o + k, :t] = np.asarray(raw_src)[:k]
+            lens[o : o + k] = np.asarray(b.lens)[:k]
+            baseline[o : o + k] = np.asarray(b.baseline)[:k]
+            gids[o : o + k] = g
+            o += k
     return ts, vals, lens, baseline, raw, gids
 
 
