@@ -154,6 +154,8 @@ class PromApiHandler(BaseHTTPRequestHandler):
                 return self._remote_write()
             if path == "/api/v1/read":
                 return self._remote_read()
+            if path == "/api/v1/query_exemplars":
+                return self._send(200, J.success([]))
             if path in ("/api/v1/rules", "/api/v1/alerts"):
                 kind = "rules" if path.endswith("rules") else "alerts"
                 return self._send(200, J.success({"groups" if kind == "rules" else "alerts": []}))

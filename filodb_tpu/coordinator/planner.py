@@ -92,6 +92,7 @@ class PlannerParams:
     spread: int = 3
     lookback_ms: int = 300_000
     max_series: int = 1_000_000
+    deadline_s: float = 60.0
     # optional jax.sharding.Mesh: distributed aggregations compile to one
     # psum program over the shard axis instead of host-side merging
     mesh: object | None = None
@@ -359,6 +360,7 @@ class QueryEngine:
     def context(self) -> QueryContext:
         ctx = QueryContext(self.memstore, self.dataset)
         ctx.max_series = self.planner.params.max_series
+        ctx.deadline_s = self.planner.params.deadline_s
         return ctx
 
     def query_range(self, promql: str, start_s: float, end_s: float, step_s: float):

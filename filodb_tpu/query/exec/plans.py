@@ -38,6 +38,18 @@ class QueryContext:
     max_result_bytes: int = 1 << 30
     deadline_s: float = 60.0
     stats: QueryStats = field(default_factory=QueryStats)
+    _start_time: float = field(default_factory=time.monotonic)
+
+    def check_deadline(self) -> None:
+        """Enforced between plan nodes (reference per-plan enforcedLimits +
+        query timeout)."""
+        elapsed = time.monotonic() - self._start_time
+        if elapsed > self.deadline_s:
+            from .transformers import QueryError
+
+            raise QueryError(
+                f"query exceeded deadline: {elapsed:.1f}s > {self.deadline_s:.1f}s"
+            )
 
 
 class ExecPlan:
@@ -52,6 +64,7 @@ class ExecPlan:
         from ...metrics import span
 
         t0 = time.perf_counter_ns()
+        ctx.check_deadline()
         with span(type(self).__name__):
             res = self.do_execute(ctx)
             for tr in self.transformers:

@@ -82,6 +82,7 @@ class FiloServer:
                 spread=self.spread,
                 lookback_ms=int(qcfg["lookback_ms"]),
                 max_series=int(qcfg["max_series"]),
+                deadline_s=float(qcfg["timeout_s"]),
             ),
         )
         self.profiler = None

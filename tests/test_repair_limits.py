@@ -93,3 +93,14 @@ class TestQueryLimits:
         assert sum(g.n_series for g in res.grids) == 3
         assert res.stats.series_scanned == 3
         assert res.stats.samples_scanned > 0
+
+
+def test_query_deadline_enforced():
+    from filodb_tpu.coordinator.planner import PlannerParams
+
+    ms = TimeSeriesMemStore()
+    ms.setup(Dataset("ds"), [0])
+    ms.ingest("ds", 0, machine_metrics(n_series=3, n_samples=50, start_ms=BASE))
+    engine = QueryEngine(ms, "ds", PlannerParams(deadline_s=0.0))
+    with pytest.raises(QueryError, match="deadline"):
+        engine.query_range("heap_usage0", (BASE + 300_000) / 1000, (BASE + 400_000) / 1000, 60)
