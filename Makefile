@@ -1,0 +1,31 @@
+# filodb-tpu build/test/bench shortcuts
+
+NATIVE_DIR := filodb_tpu/native
+
+.PHONY: all native test bench microbench serve clean
+
+all: native
+
+native: $(NATIVE_DIR)/libfilodbcodecs.so $(NATIVE_DIR)/libfilodbindex.so
+
+$(NATIVE_DIR)/libfilodbcodecs.so: $(NATIVE_DIR)/codecs.cpp
+	g++ -O3 -march=native -shared -fPIC $< -o $@
+
+$(NATIVE_DIR)/libfilodbindex.so: $(NATIVE_DIR)/index.cpp
+	g++ -O3 -shared -fPIC $< -o $@
+
+test: native
+	python -m pytest tests/ -q
+
+bench: native
+	python bench.py
+
+microbench: native
+	python -m benchmarks.run
+
+serve:
+	python -m filodb_tpu.cli serve --config conf/timeseries-dev.json
+
+clean:
+	rm -f $(NATIVE_DIR)/*.so
+	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true

@@ -1,0 +1,5 @@
+"""``python -m filodb_tpu`` -> the CLI."""
+
+from .cli import main
+
+main()

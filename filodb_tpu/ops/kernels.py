@@ -11,8 +11,10 @@ output grid (S series x J output steps) from a staged ``[S, T]`` block:
   shapes, no data-dependent control flow.
 - sum/count family reads prefix sums at the boundary indices (the parallel
   form of the reference's chunked running aggregates).
-- Counter reset correction is a cumulative sum of drop adjustments
-  (the prefix-scan form of CounterChunkedRangeFunction's per-chunk carry).
+- Counter reset correction happens HOST-SIDE in f64 at staging
+  (staging.counter_correct — the prefix-scan form of
+  CounterChunkedRangeFunction's per-chunk carry); staged counter values are
+  already corrected, so the device needs no correction pass.
 - rate/increase/delta implement Prometheus extrapolation semantics
   (promql extrapolatedRate), which the reference's ChunkedRateFunctionBase
   also follows.
