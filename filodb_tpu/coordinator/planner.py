@@ -308,7 +308,7 @@ class SingleClusterPlanner:
         from ..parallel.exec import MESH_OPS, MeshAggregateExec
 
         inner = p.inner
-        if p.op not in MESH_OPS:
+        if p.op not in MESH_OPS and p.op != "quantile":
             return None
         if not isinstance(inner, L.PeriodicSeriesWithWindowing):
             return None
@@ -322,17 +322,22 @@ class SingleClusterPlanner:
         ):
             return None
         shards = self.shards_for(None)
-        if len(shards) > mesh.devices.size:
-            return None
         # counter-ness resolved at execution from schemas; assume cumulative
         # counter when the function is the counter family
         is_counter = inner.function in ("rate", "increase", "irate")
-        return MeshAggregateExec(
-            mesh, shards, inner.raw.filters, inner.raw.start_ms, inner.raw.end_ms,
-            p.op, p.by, p.without, inner.function,
-            inner.start_ms, inner.end_ms, inner.step_ms, inner.window_ms,
+        common = dict(
+            mesh=mesh, shard_nums=shards, filters=inner.raw.filters,
+            raw_start_ms=inner.raw.start_ms, raw_end_ms=inner.raw.end_ms,
+            by=p.by, without=p.without, function=inner.function,
+            start_ms=inner.start_ms, end_ms=inner.end_ms,
+            step_ms=inner.step_ms, window_ms=inner.window_ms,
             is_counter=is_counter,
         )
+        if p.op == "quantile":
+            from ..parallel.exec import MeshQuantileExec
+
+            return MeshQuantileExec(float(p.params[0]), **common)
+        return MeshAggregateExec(op=p.op, **common)
 
 
 def _plan_times(p: L.LogicalPlan):

@@ -51,10 +51,11 @@ class MeshAggregateExec(ExecPlan):
             f"devices={self.mesh.devices.size}"
         )
 
-    def do_execute(self, ctx: QueryContext) -> QueryResult:
+    def _stage_all(self, ctx: QueryContext):
+        """Stage every shard + GLOBAL group numbering so on-device segment
+        ids agree across shards. Returns (stacked arrays, group labels,
+        blocks) or None when nothing matches."""
         n_dev = self.mesh.devices.size
-        # stage per shard (host) and compute GLOBAL group numbering so the
-        # on-device segment ids agree across every shard
         blocks, labels_per_shard = [], []
         for s in self.shard_nums:
             shard = ctx.memstore.shard(ctx.dataset, s)
@@ -71,7 +72,7 @@ class MeshAggregateExec(ExecPlan):
             labels_per_shard.append(labels)
         all_labels = [l for ls in labels_per_shard for l in ls]
         if not all_labels:
-            return QueryResult()
+            return None
         gids_all, group_labels = AGG.group_ids_for(
             all_labels, list(self.by) if self.by else None,
             list(self.without) if self.without else None,
@@ -80,7 +81,13 @@ class MeshAggregateExec(ExecPlan):
         for ls in labels_per_shard:
             gids_per_block.append(gids_all[off : off + len(ls)].astype(np.int32))
             off += len(ls)
-        arrays = M.stack_blocks_for_mesh(blocks, gids_per_block, n_dev)
+        return M.stack_blocks_for_mesh(blocks, gids_per_block, n_dev), group_labels, blocks
+
+    def do_execute(self, ctx: QueryContext) -> QueryResult:
+        staged = self._stage_all(ctx)
+        if staged is None:
+            return QueryResult()
+        arrays, group_labels, blocks = staged
         num_steps = int((self.end_ms - self.start_ms) // self.step_ms) + 1
         j_pad = K.pad_steps(num_steps)
         base = blocks[0].base_ms
@@ -135,6 +142,41 @@ class MeshAggregateExec(ExecPlan):
         if not len(pids):
             return None
         return shard.partition(int(pids[0])).schema.value_column
+
+
+class MeshQuantileExec(MeshAggregateExec):
+    """quantile(q, range_fn(...)) over the mesh via mergeable log-linear
+    sketches + psum (reference ships t-digests between nodes; ops/sketch.py).
+    Approximate within the log-linear bin error (~2-5%)."""
+
+    def __init__(self, q: float, *args, **kw):
+        super().__init__(*args, op="quantile", **kw)
+        self.q = q
+
+    def args_str(self):
+        return f"q={self.q} fn={self.function} shards={self.shard_nums} (sketch)"
+
+    def do_execute(self, ctx: QueryContext) -> QueryResult:
+        from ..ops import sketch as SK
+
+        staged = self._stage_all(ctx)
+        if staged is None:
+            return QueryResult()
+        arrays, group_labels, blocks = staged
+        sharded = M.shard_arrays(self.mesh, *arrays)
+        num_steps = int((self.end_ms - self.start_ms) // self.step_ms) + 1
+        j_pad = K.pad_steps(num_steps)
+        base = blocks[0].base_ms
+        sk = SK.distributed_sketch_quantile(
+            self.mesh, self.function, *sharded,
+            np.int32(self.start_ms - base), np.int32(self.step_ms),
+            np.int32(self.window_ms), j_pad, len(group_labels),
+            is_counter=self.is_counter, is_delta=self.is_delta,
+        )
+        vals = SK.sketch_quantile(np.asarray(sk), self.q)[:, :num_steps].astype(np.float32)
+        return QueryResult(
+            grids=[Grid(group_labels, self.start_ms, self.step_ms, num_steps, vals)]
+        )
 
 
 # planner routes non-aggregated range functions with at least this many

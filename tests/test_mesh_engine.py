@@ -104,3 +104,21 @@ class TestTimeShardInEngine:
         plan = query_range_to_logical_plan(
             "rate(http_requests_total[5m])", START_S, END_S, 60)
         assert not isinstance(mesh.planner.materialize(plan), TimeShardRangeExec)
+
+
+def test_mesh_quantile_sketch(engines):
+    host, mesh = engines
+    from filodb_tpu.parallel.exec import MeshQuantileExec
+    from filodb_tpu.query.promql import query_range_to_logical_plan
+
+    q = "quantile(0.5, rate(http_requests_total[5m]))"
+    plan = query_range_to_logical_plan(q, START_S, END_S, 60)
+    ep = mesh.planner.materialize(plan)
+    assert isinstance(ep, MeshQuantileExec)
+    r_mesh = ep.execute(mesh.context())
+    r_host = host.query_range(q, START_S, END_S, 60)
+    got = r_mesh.grids[0].values_np()[0]
+    want = r_host.grids[0].values_np()[0]
+    m = ~np.isnan(want)
+    err = np.abs(got[m] - want[m]) / np.maximum(np.abs(want[m]), 1e-9)
+    assert (err < 0.08).all()

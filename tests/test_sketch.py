@@ -1,0 +1,103 @@
+"""Log-linear quantile sketch tests: accuracy bound, mergeability, and the
+mesh-distributed quantile(q, rate(...)) vs exact quantiles."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from filodb_tpu.ops import kernels as K
+from filodb_tpu.ops import sketch as SK
+from filodb_tpu.ops.staging import stage_series
+from filodb_tpu.parallel import mesh as M
+
+BASE = 1_600_000_000_000
+REL = 2 ** (1 / SK.SUB) - 1  # log-linear error bound per half-bin
+
+
+class TestSketchBasics:
+    @pytest.mark.parametrize("seed", range(3))
+    def test_quantile_accuracy(self, seed):
+        rng = np.random.default_rng(seed)
+        vals = np.exp(rng.uniform(-5, 5, (200, 4))).astype(np.float32)
+        vals[rng.random(vals.shape) < 0.1] = np.nan
+        gids = (np.arange(200) % 3).astype(np.int32)
+        sk = np.asarray(SK.build_sketch(vals, gids, 3))
+        for q in (0.1, 0.5, 0.9):
+            got = SK.sketch_quantile(sk, q)
+            for g in range(3):
+                for j in range(4):
+                    col = np.sort(vals[gids == g][:, j].astype(np.float64))
+                    col = col[~np.isnan(col)]
+                    # rank-based bound: sketches use "first bin with
+                    # cum >= q*n" — the result must sit within the bin error
+                    # of a nearby order statistic (rank conventions differ
+                    # from np.quantile's interpolation at small n)
+                    k = int(np.ceil(q * len(col)))
+                    lo = col[max(k - 2, 0)] * (1 - 0.05)
+                    hi = col[min(k + 1, len(col) - 1)] * (1 + 0.05)
+                    assert lo <= got[g, j] <= hi, (q, g, j, got[g, j], lo, hi)
+
+    def test_negative_and_zero_values(self):
+        vals = np.array([[-10.0, -1.0, 0.0, 1.0, 10.0]] * 4, dtype=np.float32).T
+        gids = np.zeros(5, dtype=np.int32)
+        sk = np.asarray(SK.build_sketch(vals, gids, 1))
+        med = SK.sketch_quantile(sk, 0.5)
+        np.testing.assert_allclose(med, 0.0, atol=1e-6)
+        lo = SK.sketch_quantile(sk, 0.0)
+        assert (lo < -9).all()
+
+    def test_merge_is_addition(self):
+        rng = np.random.default_rng(7)
+        a = np.exp(rng.uniform(0, 4, (100, 2))).astype(np.float32)
+        b = np.exp(rng.uniform(0, 4, (100, 2))).astype(np.float32)
+        gids = np.zeros(100, dtype=np.int32)
+        ska = np.asarray(SK.build_sketch(a, gids, 1))
+        skb = np.asarray(SK.build_sketch(b, gids, 1))
+        both = np.asarray(SK.build_sketch(np.concatenate([a, b]), np.zeros(200, np.int32), 1))
+        np.testing.assert_array_equal(ska + skb, both)
+
+    def test_empty_group_nan(self):
+        vals = np.full((10, 3), np.nan, dtype=np.float32)
+        sk = np.asarray(SK.build_sketch(vals, np.zeros(10, np.int32), 2))
+        q = SK.sketch_quantile(sk, 0.5)
+        assert np.isnan(q).all()
+
+
+class TestDistributedQuantile:
+    def test_mesh_quantile_rate(self):
+        mesh = M.make_mesh()
+        rng = np.random.default_rng(0)
+        blocks, gids, all_series = [], [], []
+        for s in range(8):
+            series = []
+            for i in range(4):
+                ts = BASE + np.cumsum(rng.integers(8_000, 12_000, 200)).astype(np.int64)
+                vals = np.cumsum(rng.uniform(0, 10, 200)) + 1e9
+                series.append((ts, vals))
+                all_series.append((ts, vals, i % 2))
+            blocks.append(stage_series(series, BASE, counter_corrected=True))
+            gids.append((np.arange(4) % 2).astype(np.int32))
+        arrays = M.stack_blocks_for_mesh(blocks, gids, 8)
+        sharded = M.shard_arrays(mesh, *arrays)
+        num_steps = K.pad_steps(10)
+        start = BASE + 400_000
+        sk = np.asarray(SK.distributed_sketch_quantile(
+            mesh, "rate", *sharded,
+            np.int32(start - BASE), np.int32(60_000), np.int32(300_000),
+            num_steps, 2, is_counter=True,
+        ))
+        got = SK.sketch_quantile(sk, 0.5)[:, :10]
+        # exact oracle quantiles
+        import oracle
+
+        rates = {0: [], 1: []}
+        for ts, vals, g in all_series:
+            r = oracle.range_function("rate", ts, vals, start, 60_000, 10, 300_000,
+                                      is_counter=True)
+            rates[g].append(r)
+        for g in (0, 1):
+            rows = np.stack(rates[g])
+            want = np.nanquantile(rows, 0.5, axis=0)
+            err = np.abs(got[g] - want) / np.maximum(np.abs(want), 1e-9)
+            assert (err < 0.08).all(), (g, got[g], want)
