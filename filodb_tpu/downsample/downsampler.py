@@ -102,11 +102,13 @@ class ShardDownsampler:
             return self.target_memstore.shard(ds, shard_num)
 
     def downsample_chunks(self, shard_num: int, part, chunks) -> int:
+        if part.schema.has_histogram:
+            return self._downsample_histogram(shard_num, part, chunks)
         n = 0
         col = part.schema.value_column
         c0 = part.schema.column(col)
         if c0.ctype != ColumnType.DOUBLE:
-            return 0  # histogram downsampling: round 2
+            return 0
         for period in self.periods_ms:
             ts_parts, val_parts = [], []
             for c in chunks:
@@ -122,6 +124,47 @@ class ShardDownsampler:
             self._shard(ds, shard_num).ingest_series(sb)
             n += len(out_ts)
         return n
+
+
+def last_per_period(ts: np.ndarray, period_ms: int):
+    """Indices of the last sample in each aligned period + period-end ts
+    (reference hLast/dLast downsamplers for cumulative schemas)."""
+    if len(ts) == 0:
+        return np.empty(0, np.int64), np.empty(0, np.int64)
+    period = (ts // period_ms).astype(np.int64)
+    starts = np.nonzero(np.diff(period, prepend=period[0] - 1))[0]
+    last_idx = np.concatenate([starts[1:] - 1, [len(ts) - 1]])
+    out_ts = (period[last_idx] + 1) * period_ms - 1
+    return last_idx, out_ts
+
+
+def _downsample_histogram(self, shard_num: int, part, chunks) -> int:
+    """Cumulative histograms downsample by taking the LAST sample of each
+    period for every column (hLast/dLast — cumulative values carry the
+    whole period's information); emitted into the same prom-histogram
+    schema so quantile queries work unchanged on downsample datasets."""
+    ts_parts = [c.column("timestamp") for c in chunks]
+    if not ts_parts:
+        return 0
+    ts = np.concatenate(ts_parts)
+    col_names = [c.name for c in part.schema.columns if c.name != "timestamp"]
+    cols = {
+        name: np.concatenate([c.column(name) for c in chunks]) for name in col_names
+    }
+    n = 0
+    for period in self.periods_ms:
+        last_idx, out_ts = last_per_period(ts, period)
+        if len(out_ts) == 0:
+            continue
+        values = {name: arr[last_idx] for name, arr in cols.items()}
+        sb = SeriesBatch(part.schema, dict(part.tags), out_ts, values,
+                         bucket_les=part.bucket_les)
+        self._shard(self.dataset_for(period), shard_num).ingest_series(sb)
+        n += len(out_ts)
+    return n
+
+
+ShardDownsampler._downsample_histogram = _downsample_histogram
 
 
 def batch_downsample(store, memstore, dataset: str, shard_nums, target_memstore,

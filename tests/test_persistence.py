@@ -254,3 +254,39 @@ class TestTornWrites:
         with open(os.path.join(d, "chunks-g0.seg"), "wb") as f:
             f.write(b"\xde\xad\xbe\xef" * 100)
         assert list(store.read_chunks("ds", 0)) in ([], list(store.read_chunks("ds", 0)))
+
+
+class TestHistogramDownsample:
+    def test_hist_downsample_hlast_and_quantile(self):
+        from filodb_tpu.coordinator.planners import DownsampleClusterPlanner
+        from filodb_tpu.query.exec.plans import QueryContext
+        from filodb_tpu.query.promql import query_range_to_logical_plan
+        from filodb_tpu.testkit import histogram_batch
+
+        ms = TimeSeriesMemStore(StoreConfig(max_chunk_size=120))
+        ms.setup(Dataset("ds"), [0])
+        ms.ingest("ds", 0, histogram_batch(n_series=2, n_samples=400, start_ms=BASE))
+        d = ShardDownsampler(ms, "ds", periods_ms=(300_000,))
+        sh = ms.shard("ds", 0)
+        for part in list(sh.partitions.values()):
+            part.switch_buffers()
+            n = d.downsample_chunks(0, part, part.chunks)
+            assert n > 0
+        ds_shard = ms.shard("ds_5m", 0)
+        assert ds_shard.num_partitions == 2
+        part = ds_shard.partitions[0]
+        assert part.schema.name == "prom-histogram"
+        ts, h = part.samples_in_range(0, 2**62, "h")
+        assert h.ndim == 2 and len(ts) >= 12
+        # cumulative: hLast values are non-decreasing over periods
+        assert (np.diff(h[:, -1]) >= 0).all()
+        # quantile query against the downsample dataset works end-to-end
+        planner = DownsampleClusterPlanner(ms, "ds_5m")
+        plan = query_range_to_logical_plan(
+            "histogram_quantile(0.9, rate(http_request_latency[10m]))",
+            (BASE + 900_000) / 1000, (BASE + 3_600_000) / 1000, 300)
+        res = planner.materialize(plan).execute(QueryContext(ms, "ds_5m"))
+        series = [v for _, _, v in res.all_series()]
+        assert len(series) == 2
+        for vals in series:
+            assert np.isfinite(vals).all() and (vals > 0).all()
