@@ -1,0 +1,100 @@
+"""Streaming pre-aggregation maintenance (the producer side of lpopt:
+reference operators run streaming aggregation jobs that materialize
+``metric:agg`` series with reduced tag sets; AggRuleProvider's rules then
+let the planner serve ``sum by`` queries from them — AggLpOptimization).
+
+The maintainer consumes flushed chunks: samples bucket onto a fixed preagg
+resolution grid, accumulate per (reduced-tags, period) across ALL matching
+series, and periods older than the watermark emit (append-only, so late
+series must flush before the watermark passes — bounded by flush cadence).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..core.records import SeriesBatch
+from ..core.schemas import GAUGE, METRIC_TAG, canonical_partkey
+from ..coordinator.lpopt import AggRuleProvider, ExcludeAggRule, IncludeAggRule
+
+
+@dataclass
+class PreaggMaintainer:
+    """Accumulates sum/count preaggregates per rule into the target
+    memstore's ``<metric>:agg`` series."""
+
+    memstore: object
+    dataset: str
+    provider: AggRuleProvider
+    resolution_ms: int = 60_000
+    # (shard, reduced_pk) -> {"tags", "sums": {period -> [sum, count]}}
+    _acc: dict = field(default_factory=dict)
+    _watermark: dict = field(default_factory=dict)  # shard -> emitted-until period
+
+    def _reduced_tags(self, rule, tags: dict) -> dict:
+        metric = tags.get(METRIC_TAG, "")
+        if isinstance(rule, IncludeAggRule):
+            out = {k: v for k, v in tags.items() if k in rule.include_tags or k == METRIC_TAG}
+        else:
+            out = {k: v for k, v in tags.items() if k not in rule.exclude_tags}
+        out[METRIC_TAG] = metric + rule.suffix
+        return out
+
+    def process_chunks(self, shard_num: int, part, chunks) -> int:
+        """Fold one partition's flushed chunks into the accumulators."""
+        metric = part.tags.get(METRIC_TAG)
+        if metric is None:
+            return 0
+        rule = self.provider.rule_for(metric)
+        if rule is None:
+            return 0
+        col = part.schema.value_column
+        c0 = part.schema.column(col)
+        from ..core.schemas import ColumnType
+
+        if c0.ctype != ColumnType.DOUBLE:
+            return 0
+        reduced = self._reduced_tags(rule, dict(part.tags))
+        key = (shard_num, canonical_partkey(reduced))
+        slot = self._acc.setdefault(key, {"tags": reduced, "sums": {}})
+        n = 0
+        for c in chunks:
+            ts = c.column("timestamp")
+            vals = c.column(col).astype(np.float64)
+            periods = (ts // self.resolution_ms).astype(np.int64)
+            keep = ~np.isnan(vals)
+            idx = np.nonzero(np.diff(periods, prepend=periods[0] - 1))[0]
+            sums = np.add.reduceat(np.where(keep, vals, 0.0), idx)
+            counts = np.add.reduceat(keep.astype(np.float64), idx)
+            for p, s, cnt in zip(periods[idx], sums, counts):
+                cur = slot["sums"].setdefault(int(p), [0.0, 0.0])
+                cur[0] += float(s)
+                cur[1] += float(cnt)
+                n += 1
+        return n
+
+    def emit(self, shard_num: int, up_to_ms: int | None = None) -> int:
+        """Flush accumulated periods older than the watermark into the
+        memstore as ``metric:agg`` gauge series (value = period sum)."""
+        emitted = 0
+        cutoff = (up_to_ms // self.resolution_ms) if up_to_ms is not None else None
+        for (s, pk), slot in list(self._acc.items()):
+            if s != shard_num:
+                continue
+            ready = sorted(
+                p for p in slot["sums"] if cutoff is None or p < cutoff
+            )
+            if not ready:
+                continue
+            ts = np.asarray(
+                [(p + 1) * self.resolution_ms - 1 for p in ready], dtype=np.int64
+            )
+            vals = np.asarray([slot["sums"][p][0] for p in ready])
+            sb = SeriesBatch(GAUGE, dict(slot["tags"]), ts, {"value": vals})
+            self.memstore.shard(self.dataset, shard_num).ingest_series(sb)
+            for p in ready:
+                del slot["sums"][p]
+            emitted += len(ready)
+        return emitted

@@ -1,0 +1,85 @@
+"""Streaming preagg maintenance end-to-end: flush feeds the maintainer,
+lpopt rewrites serve sum-by queries from the materialized :agg series."""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.coordinator.lpopt import (
+    AggRuleProvider,
+    IncludeAggRule,
+    optimize_with_preagg,
+)
+from filodb_tpu.coordinator.planner import QueryEngine
+from filodb_tpu.core.schemas import Dataset
+from filodb_tpu.downsample.preagg import PreaggMaintainer
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.memstore.shard import StoreConfig
+from filodb_tpu.testkit import machine_metrics
+
+BASE = 1_600_000_000_000
+
+
+def test_preagg_pipeline_end_to_end():
+    provider = AggRuleProvider([
+        IncludeAggRule("heap_usage0", frozenset({"job", "_ws_", "_ns_"}))
+    ])
+    ms = TimeSeriesMemStore(StoreConfig(max_chunk_size=100))
+    ms.setup(Dataset("ds"), [0])
+    # 10 series over ~33 min, all sharing job="machine"
+    ms.ingest("ds", 0, machine_metrics(n_series=10, n_samples=200, start_ms=BASE))
+    m = PreaggMaintainer(ms, "ds", provider)
+    sh = ms.shard("ds", 0)
+    for part in list(sh.partitions.values()):
+        part.switch_buffers()
+        assert m.process_chunks(0, part, part.chunks) > 0
+    emitted = m.emit(0)
+    assert emitted > 0
+
+    # the :agg series exists with the reduced tag set
+    from filodb_tpu.core.filters import equals
+
+    pids = sh.lookup_partitions([equals("_metric_", "heap_usage0:agg")], 0, 2**62)
+    assert len(pids) == 1
+    agg_part = sh.partition(pids[0])
+    assert set(agg_part.tags) == {"_metric_", "job", "_ws_", "_ns_"}
+
+    # the preagg sum matches summing the raw series per period
+    ts, vals = agg_part.samples_in_range(0, 2**62, "value")
+    raw = machine_metrics(n_series=10, n_samples=200, start_ms=BASE)
+    want = {}
+    for t, v in zip(raw.timestamps, raw.values["value"]):
+        p = int(t) // 60_000
+        want[p] = want.get(p, 0.0) + float(v)
+    for t, v in zip(ts, vals):
+        p = int(t) // 60_000
+        np.testing.assert_allclose(v, want[p], rtol=1e-9)
+
+    # lpopt rewrite now serves sum by (job) from the :agg series
+    from filodb_tpu.query.promql import query_range_to_logical_plan
+
+    plan = query_range_to_logical_plan(
+        "sum by (job) (heap_usage0)", (BASE + 600_000) / 1000, (BASE + 1_500_000) / 1000, 60)
+    opt = optimize_with_preagg(plan, provider)
+    engine = QueryEngine(ms, "ds")
+    res = engine.planner.materialize(opt).execute(engine.context())
+    series = list(res.all_series())
+    assert len(series) == 1
+    assert series[0][0] == {"job": "machine"}
+
+
+def test_emit_watermark_holds_back_recent_periods():
+    provider = AggRuleProvider([IncludeAggRule("m", frozenset())])
+    ms = TimeSeriesMemStore(StoreConfig(max_chunk_size=50))
+    ms.setup(Dataset("ds"), [0])
+    from filodb_tpu.core.records import gauge_batch
+
+    ms.ingest("ds", 0, gauge_batch("m", [({}, BASE + i * 10_000, 1.0) for i in range(50)]))
+    m = PreaggMaintainer(ms, "ds", provider)
+    sh = ms.shard("ds", 0)
+    part = next(iter(sh.partitions.values()))
+    part.switch_buffers()
+    m.process_chunks(0, part, part.chunks)
+    n_early = m.emit(0, up_to_ms=BASE + 120_000)
+    assert n_early == 2  # only the first two full minutes
+    n_rest = m.emit(0)
+    assert n_rest > 0
