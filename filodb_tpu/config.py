@@ -35,6 +35,8 @@ DEFAULTS: dict = {
     "downsample": {"enabled": False, "periods_m": [5, 60]},
     # cardinality quotas: list of {"prefix": ["ws","ns"], "quota": N}
     "quotas": [],
+    # streaming preagg rules: [{"metric_regex", "include_tags"|"exclude_tags"}]
+    "preagg_rules": [],
     # profiler (reference filodb.profiler)
     "profiler": {"enabled": False, "interval_ms": 10},
 }

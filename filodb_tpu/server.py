@@ -72,7 +72,23 @@ class FiloServer:
                 periods_ms=tuple(int(m) * 60_000 for m in cfg["downsample"]["periods_m"]),
             )
         self.downsampler = downsampler
-        self.flusher = FlushCoordinator(self.memstore, self.column_store, downsampler)
+        preagg = None
+        if cfg.get("preagg_rules"):
+            from .coordinator.lpopt import AggRuleProvider, ExcludeAggRule, IncludeAggRule
+            from .downsample.preagg import PreaggMaintainer
+
+            rules = []
+            for r in cfg["preagg_rules"]:
+                if "include_tags" in r:
+                    rules.append(IncludeAggRule(r["metric_regex"], frozenset(r["include_tags"])))
+                else:
+                    rules.append(ExcludeAggRule(r["metric_regex"], frozenset(r["exclude_tags"])))
+            self.agg_rules = AggRuleProvider(rules)
+            preagg = PreaggMaintainer(self.memstore, self.dataset, self.agg_rules)
+        else:
+            self.agg_rules = None
+        self.preagg = preagg
+        self.flusher = FlushCoordinator(self.memstore, self.column_store, downsampler, preagg)
         from .coordinator.planner import PlannerParams
 
         qcfg = cfg["query"]

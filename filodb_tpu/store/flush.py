@@ -29,12 +29,14 @@ class FlushResult:
 
 
 class FlushCoordinator:
-    def __init__(self, memstore, store: ColumnStore, downsampler=None):
+    def __init__(self, memstore, store: ColumnStore, downsampler=None, preagg=None):
         self.memstore = memstore
         self.store = store
         # optional ShardDownsampler: emits downsample records during flush
         # (reference ShardDownsampler runs inside doFlushSteps)
         self.downsampler = downsampler
+        # optional PreaggMaintainer: accumulates :agg series during flush
+        self.preagg = preagg
 
     def flush_shard(self, dataset: str, shard_num: int, offset: int | None = None) -> FlushResult:
         shard = self.memstore.shard(dataset, shard_num)
@@ -51,6 +53,8 @@ class FlushCoordinator:
                 )
                 if self.downsampler is not None:
                     self.downsampler.downsample_chunks(shard_num, part, chunks)
+                if self.preagg is not None:
+                    self.preagg.process_chunks(shard_num, part, chunks)
                 part.mark_flushed(chunks[-1].end_ts)
                 res.chunks_written += len(chunks)
                 res.partkeys_written += 1
@@ -59,6 +63,8 @@ class FlushCoordinator:
             # commitCheckpoint ordering guarantees replay covers data loss)
             self.store.write_checkpoint(dataset, shard_num, group, offset)
             res.groups_flushed += 1
+        if self.preagg is not None:
+            self.preagg.emit(shard_num)
         return res
 
     def flush_all(self, dataset: str) -> FlushResult:

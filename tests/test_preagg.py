@@ -83,3 +83,22 @@ def test_emit_watermark_holds_back_recent_periods():
     assert n_early == 2  # only the first two full minutes
     n_rest = m.emit(0)
     assert n_rest > 0
+
+
+def test_server_preagg_config():
+    from filodb_tpu.server import FiloServer
+    from filodb_tpu.core.filters import equals
+
+    srv = FiloServer({
+        "shards": 1,
+        "max_chunk_size": 100,
+        "preagg_rules": [
+            {"metric_regex": "heap_usage0", "include_tags": ["job", "_ws_", "_ns_"]},
+        ],
+    })
+    srv.memstore.ingest("prometheus", 0,
+                        machine_metrics(n_series=5, n_samples=200, start_ms=BASE))
+    srv.flush_now()
+    sh = srv.memstore.shard("prometheus", 0)
+    pids = sh.lookup_partitions([equals("_metric_", "heap_usage0:agg")], 0, 2**62)
+    assert len(pids) == 1
