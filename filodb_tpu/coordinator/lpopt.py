@@ -21,10 +21,11 @@ from ..core.filters import ColumnFilter
 from ..core.schemas import METRIC_TAG
 from ..query import logical as L
 
-# aggregation ops safe to serve from a sum-preagg (reference supports the
-# additive ops; avg works because preagg keeps sum&count semantics via
-# the ::suffix columns — here we preagg per-op datasets)
-_REWRITABLE_OPS = {"sum", "count", "min", "max"}
+# aggregation ops servable from the maintained preagg series. The
+# maintainer (downsample/preagg.py) materializes cross-series SUMS, so only
+# sum-rewrites are sound; per-op preagg datasets (min/max/count) are a
+# later-round extension.
+_REWRITABLE_OPS = {"sum"}
 
 
 @dataclass(frozen=True)

@@ -96,6 +96,9 @@ class PlannerParams:
     # optional jax.sharding.Mesh: distributed aggregations compile to one
     # psum program over the shard axis instead of host-side merging
     mesh: object | None = None
+    # optional lpopt AggRuleProvider: sum-by queries rewrite onto maintained
+    # :agg series before planning
+    agg_rules: object | None = None
 
 
 class SingleClusterPlanner:
@@ -376,6 +379,10 @@ class QueryEngine:
         t0 = _time.perf_counter()
         plan = query_range_to_logical_plan(promql, start_s, end_s, step_s,
                                            self.planner.params.lookback_ms)
+        if self.planner.params.agg_rules is not None:
+            from .lpopt import optimize_with_preagg
+
+            plan = optimize_with_preagg(plan, self.planner.params.agg_rules)
         exec_plan = self.planner.materialize(plan)
         ctx = self.context()
         res = exec_plan.execute(ctx)

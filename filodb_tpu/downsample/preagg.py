@@ -3,10 +3,22 @@ reference operators run streaming aggregation jobs that materialize
 ``metric:agg`` series with reduced tag sets; AggRuleProvider's rules then
 let the planner serve ``sum by`` queries from them — AggLpOptimization).
 
-The maintainer consumes flushed chunks: samples bucket onto a fixed preagg
-resolution grid, accumulate per (reduced-tags, period) across ALL matching
-series, and periods older than the watermark emit (append-only, so late
-series must flush before the watermark passes — bounded by flush cadence).
+Semantics (what makes ``sum by`` substitutable): at each preagg period, a
+series contributes its LAST sample in the period (gauge instant value /
+cumulative counter reading); the :agg sample is the CROSS-SERIES SUM of
+those contributions. For gauges that is the instant sum at preagg
+resolution; for cumulative counters the summed series is itself a valid
+counter, so ``rate`` over the :agg series approximates the sum of rates.
+Contributions key by source series and REPLACE on later flushes, so a
+period only emits once its watermark passes (all contributors flushed past
+it). Only ``sum`` rewrites are enabled in lpopt — the maintainer
+materializes sums, not per-op datasets.
+
+Durability note: :agg samples are emitted into normal partitions and
+persist on the NEXT flush; a crash between emit and that flush loses them
+(raw data recovers via stream replay, but :agg has no replay). Bounded by
+flush cadence; an idempotent rebuild is `cli downsample-batch`-style work
+for a later round.
 """
 
 from __future__ import annotations
@@ -18,20 +30,18 @@ import numpy as np
 from ..core.records import SeriesBatch
 from ..core.schemas import GAUGE, METRIC_TAG, canonical_partkey
 from ..coordinator.lpopt import AggRuleProvider, ExcludeAggRule, IncludeAggRule
+from .downsampler import last_per_period
 
 
 @dataclass
 class PreaggMaintainer:
-    """Accumulates sum/count preaggregates per rule into the target
-    memstore's ``<metric>:agg`` series."""
-
     memstore: object
     dataset: str
     provider: AggRuleProvider
     resolution_ms: int = 60_000
-    # (shard, reduced_pk) -> {"tags", "sums": {period -> [sum, count]}}
+    # (shard, reduced_pk) -> {"tags", "periods": {p: {src_pk: last_val}},
+    #                         "src_max_ts": {src_pk: max processed ts}}
     _acc: dict = field(default_factory=dict)
-    _watermark: dict = field(default_factory=dict)  # shard -> emitted-until period
 
     def _reduced_tags(self, rule, tags: dict) -> dict:
         metric = tags.get(METRIC_TAG, "")
@@ -48,53 +58,66 @@ class PreaggMaintainer:
         if metric is None:
             return 0
         rule = self.provider.rule_for(metric)
-        if rule is None:
-            return 0
-        col = part.schema.value_column
-        c0 = part.schema.column(col)
+        if rule is None or metric.endswith(rule.suffix):
+            return 0  # never re-aggregate :agg output (unbounded recursion)
         from ..core.schemas import ColumnType
 
-        if c0.ctype != ColumnType.DOUBLE:
+        col = part.schema.value_column
+        if part.schema.column(col).ctype != ColumnType.DOUBLE:
             return 0
         reduced = self._reduced_tags(rule, dict(part.tags))
         key = (shard_num, canonical_partkey(reduced))
-        slot = self._acc.setdefault(key, {"tags": reduced, "sums": {}})
+        slot = self._acc.setdefault(
+            key, {"tags": reduced, "periods": {}, "src_max_ts": {}}
+        )
+        src = part.partkey
         n = 0
         for c in chunks:
             ts = c.column("timestamp")
             vals = c.column(col).astype(np.float64)
-            periods = (ts // self.resolution_ms).astype(np.int64)
             keep = ~np.isnan(vals)
-            idx = np.nonzero(np.diff(periods, prepend=periods[0] - 1))[0]
-            sums = np.add.reduceat(np.where(keep, vals, 0.0), idx)
-            counts = np.add.reduceat(keep.astype(np.float64), idx)
-            for p, s, cnt in zip(periods[idx], sums, counts):
-                cur = slot["sums"].setdefault(int(p), [0.0, 0.0])
-                cur[0] += float(s)
-                cur[1] += float(cnt)
+            ts, vals = ts[keep], vals[keep]
+            if not len(ts):
+                continue
+            last_idx, _ = last_per_period(ts, self.resolution_ms)
+            for i in last_idx:
+                p = int(ts[i]) // self.resolution_ms
+                # later flushes REPLACE this series' contribution
+                slot["periods"].setdefault(p, {})[src] = float(vals[i])
                 n += 1
+            slot["src_max_ts"][src] = max(
+                slot["src_max_ts"].get(src, 0), int(ts[-1])
+            )
         return n
 
     def emit(self, shard_num: int, up_to_ms: int | None = None) -> int:
-        """Flush accumulated periods older than the watermark into the
-        memstore as ``metric:agg`` gauge series (value = period sum)."""
+        """Emit closed periods as :agg samples (cross-series sums).
+
+        A period is closed when every known contributor has flushed data
+        past its end (or when ``up_to_ms`` forces a cutoff)."""
         emitted = 0
-        cutoff = (up_to_ms // self.resolution_ms) if up_to_ms is not None else None
         for (s, pk), slot in list(self._acc.items()):
-            if s != shard_num:
+            if s != shard_num or not slot["periods"]:
                 continue
-            ready = sorted(
-                p for p in slot["sums"] if cutoff is None or p < cutoff
-            )
+            if up_to_ms is not None:
+                watermark = up_to_ms
+            elif slot["src_max_ts"]:
+                watermark = min(slot["src_max_ts"].values())
+            else:
+                continue
+            cutoff = watermark // self.resolution_ms
+            ready = sorted(p for p in slot["periods"] if p < cutoff)
             if not ready:
                 continue
             ts = np.asarray(
                 [(p + 1) * self.resolution_ms - 1 for p in ready], dtype=np.int64
             )
-            vals = np.asarray([slot["sums"][p][0] for p in ready])
+            vals = np.asarray(
+                [sum(slot["periods"][p].values()) for p in ready]
+            )
             sb = SeriesBatch(GAUGE, dict(slot["tags"]), ts, {"value": vals})
             self.memstore.shard(self.dataset, shard_num).ingest_series(sb)
             for p in ready:
-                del slot["sums"][p]
+                del slot["periods"][p]
             emitted += len(ready)
         return emitted

@@ -78,7 +78,12 @@ class FiloServer:
             from .downsample.preagg import PreaggMaintainer
 
             rules = []
-            for r in cfg["preagg_rules"]:
+            for i, r in enumerate(cfg["preagg_rules"]):
+                if "metric_regex" not in r or ("include_tags" in r) == ("exclude_tags" in r):
+                    raise ValueError(
+                        f"preagg_rules[{i}] must have metric_regex and exactly one "
+                        f"of include_tags/exclude_tags: {r}"
+                    )
                 if "include_tags" in r:
                     rules.append(IncludeAggRule(r["metric_regex"], frozenset(r["include_tags"])))
                 else:
@@ -99,6 +104,7 @@ class FiloServer:
                 lookback_ms=int(qcfg["lookback_ms"]),
                 max_series=int(qcfg["max_series"]),
                 deadline_s=float(qcfg["timeout_s"]),
+                agg_rules=self.agg_rules,
             ),
         )
         self.profiler = None

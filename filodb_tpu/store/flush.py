@@ -53,7 +53,7 @@ class FlushCoordinator:
                 )
                 if self.downsampler is not None:
                     self.downsampler.downsample_chunks(shard_num, part, chunks)
-                if self.preagg is not None:
+                if self.preagg is not None and self.preagg.dataset == dataset:
                     self.preagg.process_chunks(shard_num, part, chunks)
                 part.mark_flushed(chunks[-1].end_ts)
                 res.chunks_written += len(chunks)
@@ -63,7 +63,7 @@ class FlushCoordinator:
             # commitCheckpoint ordering guarantees replay covers data loss)
             self.store.write_checkpoint(dataset, shard_num, group, offset)
             res.groups_flushed += 1
-        if self.preagg is not None:
+        if self.preagg is not None and self.preagg.dataset == dataset:
             self.preagg.emit(shard_num)
         return res
 
