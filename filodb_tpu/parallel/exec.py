@@ -53,9 +53,26 @@ class MeshAggregateExec(ExecPlan):
 
     def _stage_all(self, ctx: QueryContext):
         """Stage every shard + GLOBAL group numbering so on-device segment
-        ids agree across shards. Returns (stacked arrays, group labels,
-        blocks) or None when nothing matches."""
+        ids agree across shards. Returns (stacked DEVICE arrays, group
+        labels, blocks) or None when nothing matches. Cached per
+        (selection, range, grouping, shard versions) so repeat queries reuse
+        the HBM-resident stack (the mesh form of the leaf staging cache)."""
         n_dev = self.mesh.devices.size
+        versions = tuple(
+            ctx.memstore.shard(ctx.dataset, s).version for s in self.shard_nums
+        )
+        key = (
+            self.filters, self.raw_start_ms, self.raw_end_ms,
+            self.by, self.without, versions, n_dev,
+            self.is_counter, self.is_delta,
+        )
+        cache = getattr(ctx.memstore, "_mesh_stage_cache", None)
+        if cache is None:
+            cache = {}
+            ctx.memstore._mesh_stage_cache = cache
+        hit = cache.get(key)
+        if hit is not None:
+            return hit
         blocks, labels_per_shard = [], []
         for s in self.shard_nums:
             shard = ctx.memstore.shard(ctx.dataset, s)
@@ -81,19 +98,24 @@ class MeshAggregateExec(ExecPlan):
         for ls in labels_per_shard:
             gids_per_block.append(gids_all[off : off + len(ls)].astype(np.int32))
             off += len(ls)
-        return M.stack_blocks_for_mesh(blocks, gids_per_block, n_dev), group_labels, blocks
+        arrays = M.stack_blocks_for_mesh(blocks, gids_per_block, n_dev)
+        sharded = M.shard_arrays(self.mesh, *arrays)  # pin the stack in HBM
+        result = (sharded, group_labels, blocks)
+        if len(cache) >= 4:
+            cache.pop(next(iter(cache)))
+        cache[key] = result
+        return result
 
     def do_execute(self, ctx: QueryContext) -> QueryResult:
         staged = self._stage_all(ctx)
         if staged is None:
             return QueryResult()
-        arrays, group_labels, blocks = staged
+        sharded, group_labels, blocks = staged
         num_steps = int((self.end_ms - self.start_ms) // self.step_ms) + 1
         j_pad = K.pad_steps(num_steps)
         base = blocks[0].base_ms
-        out = self._run_mxu(blocks, arrays, j_pad, base, len(group_labels))
+        out = self._run_mxu(blocks, sharded, j_pad, base, len(group_labels))
         if out is None:
-            sharded = M.shard_arrays(self.mesh, *arrays)
             out = M.distributed_agg_range(
                 self.mesh, self.function, self.op, *sharded,
                 np.int32(self.start_ms - base), np.int32(self.step_ms),
@@ -162,8 +184,7 @@ class MeshQuantileExec(MeshAggregateExec):
         staged = self._stage_all(ctx)
         if staged is None:
             return QueryResult()
-        arrays, group_labels, blocks = staged
-        sharded = M.shard_arrays(self.mesh, *arrays)
+        sharded, group_labels, blocks = staged
         num_steps = int((self.end_ms - self.start_ms) // self.step_ms) + 1
         j_pad = K.pad_steps(num_steps)
         base = blocks[0].base_ms
