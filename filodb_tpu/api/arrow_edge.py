@@ -28,15 +28,24 @@ def grid_to_record_batch(g: Grid) -> pa.RecordBatch:
     labels = pa.array([json.dumps(l, sort_keys=True) for l in g.labels], type=pa.utf8())
     flat = pa.array(vals.ravel(), type=pa.float32())
     values = pa.FixedSizeListArray.from_arrays(flat, j)
-    schema = pa.schema(
-        [pa.field("labels", pa.utf8()), pa.field("values", pa.list_(pa.float32(), j))],
-        metadata={
-            b"start_ms": str(g.start_ms).encode(),
-            b"step_ms": str(g.step_ms).encode(),
-            b"num_steps": str(g.num_steps).encode(),
-        },
-    )
-    return pa.RecordBatch.from_arrays([labels, values], schema=schema)
+    metadata = {
+        b"start_ms": str(g.start_ms).encode(),
+        b"step_ms": str(g.step_ms).encode(),
+        b"num_steps": str(g.num_steps).encode(),
+    }
+    fields = [pa.field("labels", pa.utf8()), pa.field("values", pa.list_(pa.float32(), j))]
+    arrays = [labels, values]
+    if g.hist is not None:
+        # native histogram buckets ride as a flattened [J*B] list per series
+        h = np.ascontiguousarray(g.hist_np(), dtype=np.float32)
+        b = h.shape[-1]
+        metadata[b"n_buckets"] = str(b).encode()
+        metadata[b"les"] = json.dumps([float(x) for x in np.asarray(g.les)]).encode()
+        hflat = pa.array(h.reshape(n, -1).ravel(), type=pa.float32())
+        arrays.append(pa.FixedSizeListArray.from_arrays(hflat, j * b))
+        fields.append(pa.field("hist", pa.list_(pa.float32(), j * b)))
+    schema = pa.schema(fields, metadata=metadata)
+    return pa.RecordBatch.from_arrays(arrays, schema=schema)
 
 
 def record_batch_to_grid(rb: pa.RecordBatch) -> Grid:
@@ -48,7 +57,13 @@ def record_batch_to_grid(rb: pa.RecordBatch) -> Grid:
     lst = rb.column("values")
     width = lst.type.list_size
     vals = np.asarray(lst.flatten()).reshape(len(labels), width)
-    return Grid(labels, start_ms, step_ms, num_steps, vals)
+    hist = les = None
+    if b"n_buckets" in md:
+        nb = int(md[b"n_buckets"])
+        les = np.asarray(json.loads(md[b"les"]), dtype=np.float64)
+        hl = rb.column("hist")
+        hist = np.asarray(hl.flatten()).reshape(len(labels), width * 0 + hl.type.list_size // nb, nb)
+    return Grid(labels, start_ms, step_ms, num_steps, vals, hist=hist, les=les)
 
 
 def result_to_ipc(res: QueryResult) -> bytes:

@@ -91,7 +91,7 @@ def compress(data: bytes) -> bytes:
     pos = 0
     n = len(data)
     while pos < n:
-        chunk = min(n - pos, 1 << 32 - 1, 65536)
+        chunk = min(n - pos, 65536)
         if chunk <= 60:
             out.append((chunk - 1) << 2)
         elif chunk <= 0xFF:

@@ -64,3 +64,19 @@ class TestFlight:
             np.testing.assert_allclose(vals, local.grids[0].values_np(), rtol=1e-6)
         finally:
             server.shutdown()
+
+
+def test_histogram_grid_roundtrip():
+    rng = np.random.default_rng(3)
+    S, J, B = 3, 6, 5
+    hist = np.cumsum(rng.poisson(2, (S, J, B)), axis=-1).astype(np.float32)
+    les = np.array([0.1, 0.5, 1.0, 5.0, np.inf])
+    g = Grid([{"_metric_": "h", "i": str(i)} for i in range(S)],
+             BASE, 60_000, J, np.full((S, J), np.nan, np.float32), hist=hist, les=les)
+    g2 = AE.record_batch_to_grid(AE.grid_to_record_batch(g))
+    assert g2.hist is not None
+    np.testing.assert_array_equal(g2.hist_np(), hist)
+    np.testing.assert_array_equal(g2.les, les)
+    # full IPC roundtrip too
+    back = AE.ipc_to_result(AE.result_to_ipc(QueryResult(grids=[g])))
+    np.testing.assert_array_equal(back.grids[0].hist_np(), hist)
