@@ -188,3 +188,33 @@ class TestHistogramSuffixRewrites:
         res = hist_engine.query_range(
             'rate(http_request_latency_bucket{le="123.456"}[5m])', HS_START, HS_END, 60.0)
         assert not list(res.all_series())
+
+
+class TestWindowedOffset:
+    def test_rate_offset_shifts_window(self, engine):
+        r1 = engine.query_range(
+            "rate(http_requests_total[5m])", (BASE + 900_000) / 1000, (BASE + 1_200_000) / 1000, 60.0)
+        r2 = engine.query_range(
+            "rate(http_requests_total[5m] offset 5m)",
+            (BASE + 1_200_000) / 1000, (BASE + 1_500_000) / 1000, 60.0)
+        m1 = {tuple(sorted(l.items())): v for l, _, v in r1.all_series()}
+        m2 = {tuple(sorted(l.items())): v for l, _, v in r2.all_series()}
+        assert m1.keys() == m2.keys()
+        for k in m1:
+            np.testing.assert_allclose(m2[k], m1[k], rtol=1e-4)
+
+    def test_agg_of_offset_window(self, engine):
+        res = engine.query_range(
+            "sum(rate(http_requests_total[5m] offset 2m))",
+            (BASE + 900_000) / 1000, (BASE + 1_200_000) / 1000, 60.0)
+        assert len(list(res.all_series())) == 1
+
+    def test_sum_without(self, engine):
+        res = engine.query_range(
+            "sum without (instance) (rate(http_requests_total[5m]))",
+            (BASE + 900_000) / 1000, (BASE + 1_200_000) / 1000, 60.0)
+        series = list(res.all_series())
+        assert len(series) == 1
+        lbls = series[0][0]
+        assert "instance" not in lbls and "_metric_" not in lbls
+        assert lbls.get("job") == "api"
