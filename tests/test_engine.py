@@ -230,3 +230,31 @@ class TestMetadata:
     def test_raw_export(self, engine):
         res = engine.query_range("heap_usage0[5m]", END_S, END_S, 1)
         assert res.raw is not None and len(res.raw) == 50
+
+
+class TestVectorComparisons:
+    def test_vector_vector_bool(self, engine):
+        res = engine.query_range(
+            "heap_usage0 >= bool on (instance) http_requests_total",
+            START_S, END_S, STEP_S)
+        series = list(res.all_series())
+        assert len(series) == 50
+        for _, _, vals in series:
+            assert set(np.unique(vals)).issubset({0.0, 1.0})
+
+    def test_vector_vector_filter_comparison(self, engine):
+        # gauge (~50) < counter (grows into thousands): eventually filtered in
+        res = engine.query_range(
+            "heap_usage0 < on (instance) http_requests_total", START_S, END_S, STEP_S)
+        for lbls, _, vals in res.all_series():
+            assert "instance" in lbls
+
+    def test_arithmetic_on_aggregates(self, engine):
+        res = engine.query_range(
+            "sum(rate(http_requests_total[5m])) / count(rate(http_requests_total[5m]))",
+            START_S, END_S, STEP_S)
+        want = engine.query_range(
+            "avg(rate(http_requests_total[5m]))", START_S, END_S, STEP_S)
+        got_v = list(res.all_series())[0][2]
+        want_v = list(want.all_series())[0][2]
+        np.testing.assert_allclose(got_v, want_v, rtol=1e-4)
