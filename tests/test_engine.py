@@ -243,11 +243,16 @@ class TestVectorComparisons:
             assert set(np.unique(vals)).issubset({0.0, 1.0})
 
     def test_vector_vector_filter_comparison(self, engine):
-        # gauge (~50) < counter (grows into thousands): eventually filtered in
+        # gauge (~50) < counter (thousands by START_S): every step passes the
+        # filter, and surviving values must be the LHS gauge values
         res = engine.query_range(
             "heap_usage0 < on (instance) http_requests_total", START_S, END_S, STEP_S)
-        for lbls, _, vals in res.all_series():
-            assert "instance" in lbls
+        series = list(res.all_series())
+        assert len(series) == 50
+        gauge = series_map(engine.query_range("heap_usage0", START_S, END_S, STEP_S))
+        for lbls, _, vals in series:
+            key = next(k for k in gauge if dict(k)["instance"] == lbls["instance"])
+            np.testing.assert_allclose(vals, gauge[key][1], rtol=1e-5)
 
     def test_arithmetic_on_aggregates(self, engine):
         res = engine.query_range(
