@@ -1,0 +1,97 @@
+"""File-tailing ingestion stream (the Kafka-shaped transport for this
+image: an append-only JSONL log on shared storage; offsets are line
+numbers, replay is a seek — the same recovery contract as
+KafkaIngestionStream.scala:26 manual commits).
+
+Record format per line: {"metric", "tags", "ts_ms", "value"} or a batch
+{"batch": [records...]}. ``follow()`` keeps reading as the file grows
+(consumer-group-of-one semantics).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Iterator
+
+import numpy as np
+
+from ..core.records import RecordBatch
+from ..core.schemas import GAUGE, METRIC_TAG
+from .stream import IngestionStream
+
+
+def _to_batch(records: list[dict]) -> RecordBatch:
+    tags_list, ts, vals = [], [], []
+    for rec in records:
+        tags = dict(rec.get("tags", {}))
+        metric = rec.get("metric") or tags.get("__name__") or tags.get(METRIC_TAG, "unknown")
+        tags.pop("__name__", None)
+        tags[METRIC_TAG] = metric
+        tags_list.append(tags)
+        ts.append(int(rec["ts_ms"]))
+        vals.append(float(rec["value"]))
+    return RecordBatch(
+        GAUGE, np.asarray(ts, dtype=np.int64),
+        {"value": np.asarray(vals, dtype=np.float64)}, tags_list,
+    )
+
+
+class JsonlTailStream(IngestionStream):
+    def __init__(self, path: str, batch_lines: int = 500):
+        self.path = path
+        self.batch_lines = batch_lines
+
+    def batches(self, from_offset: int = 0) -> Iterator[tuple[int, RecordBatch]]:
+        """One pass over the current file contents (no follow)."""
+        if not os.path.exists(self.path):
+            return
+        with open(self.path) as f:
+            yield from self._consume(f, from_offset, follow=False, stop=lambda: True)
+
+    def follow(self, from_offset: int = 0, poll_s: float = 0.2,
+               stop=lambda: False) -> Iterator[tuple[int, RecordBatch]]:
+        """Tail the file as it grows until ``stop()`` returns True."""
+        while not os.path.exists(self.path):
+            if stop():
+                return
+            time.sleep(poll_s)
+        with open(self.path) as f:
+            yield from self._consume(f, from_offset, follow=True, stop=stop, poll_s=poll_s)
+
+    def _consume(self, f, from_offset, follow, stop, poll_s: float = 0.2):
+        offset = 0
+        buf: list[dict] = []
+        buf_start = 0
+        while True:
+            line = f.readline()
+            if not line:
+                if buf:
+                    yield offset - 1, _to_batch(buf)
+                    buf = []
+                if not follow or stop():
+                    return
+                time.sleep(poll_s)
+                continue
+            if not line.endswith("\n") and follow:
+                # partial line still being written: rewind and retry
+                f.seek(f.tell() - len(line))
+                time.sleep(poll_s)
+                continue
+            if offset >= from_offset and line.strip():
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    rec = None
+                if rec:
+                    if not buf:
+                        buf_start = offset
+                    if "batch" in rec:
+                        buf.extend(rec["batch"])
+                    else:
+                        buf.append(rec)
+            offset += 1
+            if len(buf) >= self.batch_lines:
+                yield offset - 1, _to_batch(buf)
+                buf = []

@@ -290,3 +290,55 @@ class TestHistogramDownsample:
         assert len(series) == 2
         for vals in series:
             assert np.isfinite(vals).all() and (vals > 0).all()
+
+
+class TestJsonlTail:
+    def test_batch_and_replay(self, tmp_path):
+        import json
+
+        from filodb_tpu.gateway.tail import JsonlTailStream
+
+        p = tmp_path / "log.jsonl"
+        with open(p, "w") as f:
+            for i in range(100):
+                f.write(json.dumps({"metric": "m", "tags": {"h": str(i % 4)},
+                                    "ts_ms": BASE + i * 1000, "value": float(i)}) + "\n")
+        stream = JsonlTailStream(str(p), batch_lines=30)
+        ms = TimeSeriesMemStore()
+        ms.setup(Dataset("ds"), [0])
+        pipe = IngestionPipeline(ms, "ds", 0, stream)
+        assert pipe.run() == 100
+        assert ms.shard("ds", 0).num_partitions == 4
+        # replay from offset 60: 40 rows
+        got = sum(len(b) for _, b in stream.batches(from_offset=60))
+        assert got == 40
+
+    def test_follow_sees_appends(self, tmp_path):
+        import json
+        import threading
+        import time as _t
+
+        from filodb_tpu.gateway.tail import JsonlTailStream
+
+        p = tmp_path / "grow.jsonl"
+        p.write_text("")
+        stop_flag = []
+
+        def writer():
+            with open(p, "a") as f:
+                for i in range(50):
+                    f.write(json.dumps({"metric": "m", "tags": {},
+                                        "ts_ms": BASE + i * 1000, "value": 1.0}) + "\n")
+                    f.flush()
+                    _t.sleep(0.005)
+            _t.sleep(0.3)
+            stop_flag.append(True)
+
+        t = threading.Thread(target=writer)
+        t.start()
+        stream = JsonlTailStream(str(p), batch_lines=10)
+        rows = 0
+        for off, batch in stream.follow(stop=lambda: bool(stop_flag)):
+            rows += len(batch)
+        t.join()
+        assert rows == 50
