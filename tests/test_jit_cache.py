@@ -1,0 +1,55 @@
+"""Recompilation control (SURVEY §7 calls the jit cache the #1 risk):
+varying query times/windows/steps over same-shaped data must reuse a tiny
+set of compiled programs — only shape-bucket changes may compile anew."""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.coordinator.planner import QueryEngine
+from filodb_tpu.core.schemas import Dataset
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.ops import kernels as K
+from filodb_tpu.ops import mxu_kernels as MX
+from filodb_tpu.testkit import counter_batch, machine_metrics
+
+BASE = 1_600_000_000_000
+
+
+def test_query_variations_do_not_recompile():
+    ms = TimeSeriesMemStore()
+    ms.setup(Dataset("ds"), [0, 1])
+    ms.ingest_routed("ds", machine_metrics(n_series=10, n_samples=300, start_ms=BASE), spread=1)
+    ms.ingest_routed("ds", counter_batch(n_series=10, n_samples=300, start_ms=BASE), spread=1)
+    engine = QueryEngine(ms, "ds")
+
+    def run_variations():
+        for k in range(6):
+            start = (BASE + 600_000 + k * 70_000) / 1000
+            end = start + 600 + k * 60  # varying step counts (same 64-bucket)
+            engine.query_range("sum(rate(http_requests_total[5m]))", start, end, 60)
+            engine.query_range("avg_over_time(heap_usage0[3m])", start, end, 30)
+            engine.query_range("max_over_time(heap_usage0[2m])", start, end, 60)
+
+    run_variations()
+    c_range = K.range_kernel._cache_size()
+    c_mxu = MX.mxu_range_kernel._cache_size()
+    c_minmax = MX.mxu_minmax._cache_size()
+    # re-run with shifted times: NOTHING may recompile
+    run_variations()
+    assert K.range_kernel._cache_size() == c_range
+    assert MX.mxu_range_kernel._cache_size() == c_mxu
+    assert MX.mxu_minmax._cache_size() == c_minmax
+
+
+def test_step_count_bucketing_bounds_cache():
+    # num_steps pads to 64s: 1..64 steps share one compilation
+    assert K.pad_steps(1) == K.pad_steps(64) == 64
+    assert K.pad_steps(65) == K.pad_steps(128) == 128
+
+
+def test_series_count_bucketing():
+    from filodb_tpu.ops.staging import pad_series
+
+    assert pad_series(3) == pad_series(8) == 8
+    assert pad_series(9) == pad_series(32) == 32
+    assert pad_series(100_000) == 131072
