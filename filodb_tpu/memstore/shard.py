@@ -52,6 +52,9 @@ class StoreConfig:
     # "python" | "native": the C++ posting-list index (reference's tantivy
     # analog) answers equality queries ~8x faster; falls back when unbuilt
     index_backend: str = "python"
+    # staging-cache byte budget per shard (HBM/working-set guard; reference
+    # analog: BlockManager reclaim under memory pressure)
+    stage_cache_bytes: int = 2 << 30
 
 
 class TimeSeriesShard:

@@ -183,17 +183,29 @@ class SelectRawPartitionsExec(ExecPlan):
             cache_key = (
                 self.filters, self.start_ms, self.end_ms, col_name, schema_name, shard.version
             )
-            block = shard.stage_cache.get(cache_key)
-            if block is None:
+            hit = shard.stage_cache.get(cache_key)
+            if hit is not None:
+                block = hit[0]
+            else:
                 block = ST.stage_from_shard(
                     shard, ids, col_name, self.start_ms, self.end_ms,
                     is_counter=is_counter and not is_delta and not is_hist,
                 )
-                ctx.stats.bytes_staged += block.ts.nbytes + block.vals.nbytes
+                nbytes = int(
+                    block.ts.nbytes
+                    + np.asarray(block.vals).nbytes
+                    + (np.asarray(block.raw).nbytes if block.raw is not None else 0)
+                )
+                ctx.stats.bytes_staged += nbytes
                 block.to_device()
-                if len(shard.stage_cache) > 8:
-                    shard.stage_cache.pop(next(iter(shard.stage_cache)))
-                shard.stage_cache[cache_key] = block
+                # byte-budgeted eviction, oldest entry first (the staging
+                # analog of BlockManager reclaim under memory pressure)
+                budget = getattr(shard.config, "stage_cache_bytes", 2 << 30)
+                used = sum(b for _, b in shard.stage_cache.values())
+                while shard.stage_cache and used + nbytes > budget:
+                    oldest = next(iter(shard.stage_cache))
+                    used -= shard.stage_cache.pop(oldest)[1]
+                shard.stage_cache[cache_key] = (block, nbytes)
             ctx.stats.series_scanned += len(ids)
             ctx.stats.samples_scanned += int(np.asarray(block.lens).sum())
             if ctx.stats.samples_scanned > ctx.max_samples:

@@ -104,3 +104,24 @@ def test_query_deadline_enforced():
     engine = QueryEngine(ms, "ds", PlannerParams(deadline_s=0.0))
     with pytest.raises(QueryError, match="deadline"):
         engine.query_range("heap_usage0", (BASE + 300_000) / 1000, (BASE + 400_000) / 1000, 60)
+
+
+def test_stage_cache_byte_budget():
+    ms = TimeSeriesMemStore(StoreConfig(stage_cache_bytes=1))  # evict always
+    ms.setup(Dataset("ds"), [0])
+    ms.ingest("ds", 0, machine_metrics(n_series=3, n_samples=50, start_ms=BASE))
+    engine = QueryEngine(ms, "ds")
+    for k in range(4):
+        engine.query_range("heap_usage0", (BASE + 300_000 + k * 60_000) / 1000,
+                           (BASE + 400_000 + k * 60_000) / 1000, 60)
+    sh = ms.shard("ds", 0)
+    assert len(sh.stage_cache) <= 1  # budget admits at most the newest block
+
+    ms2 = TimeSeriesMemStore(StoreConfig())  # default budget keeps blocks
+    ms2.setup(Dataset("ds"), [0])
+    ms2.ingest("ds", 0, machine_metrics(n_series=3, n_samples=50, start_ms=BASE))
+    engine2 = QueryEngine(ms2, "ds")
+    for k in range(3):
+        engine2.query_range("heap_usage0", (BASE + 300_000 + k * 60_000) / 1000,
+                            (BASE + 400_000 + k * 60_000) / 1000, 60)
+    assert len(ms2.shard("ds", 0).stage_cache) == 3
