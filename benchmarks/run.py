@@ -233,3 +233,32 @@ def main():
 
 if __name__ == "__main__":
     main()
+
+
+def bench_mesh_paths():
+    """Distributed execution paths (needs >=2 devices; skipped otherwise)."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        return
+    from filodb_tpu.coordinator.planner import PlannerParams, QueryEngine
+    from filodb_tpu.core.schemas import Dataset
+    from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+    from filodb_tpu.parallel.mesh import make_mesh
+    from filodb_tpu.testkit import counter_batch
+
+    ms = TimeSeriesMemStore()
+    ms.setup(Dataset("prometheus"), range(8))
+    ms.ingest_routed("prometheus", counter_batch(n_series=400, n_samples=360, start_ms=BASE), spread=3)
+    engine = QueryEngine(ms, "prometheus", PlannerParams(mesh=make_mesh()))
+    start, end = (BASE + 400_000) / 1000, (BASE + 3_400_000) / 1000
+
+    def q():
+        engine.query_range("sum(rate(http_requests_total[5m]))", start, end, 60)
+
+    q()
+    dt = _bench(q, n_iters=10)
+    report("mesh_sum_rate_qps", 1 / dt, "qps")
+
+
+ALL.append(bench_mesh_paths)

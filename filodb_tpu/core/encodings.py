@@ -311,8 +311,23 @@ def decode_utf8_dict(enc: Encoded) -> list:
     return [table[c] for c in codes]
 
 
+class CorruptVectorError(ValueError):
+    """Decode failure on a damaged payload (reference CorruptVectorException,
+    ChunkSetInfo.scala:424 — detect, don't crash the process)."""
+
+
 def decode(enc: Encoded) -> np.ndarray:
-    """Decode any Encoded column back to its numpy array."""
+    """Decode any Encoded column back to its numpy array. Malformed payloads
+    raise CorruptVectorError."""
+    try:
+        return _decode(enc)
+    except CorruptVectorError:
+        raise
+    except (struct.error, IndexError, ValueError, ZeroDivisionError) as e:
+        raise CorruptVectorError(f"corrupt vector (fmt={enc.fmt}, n={enc.n}): {e}") from e
+
+
+def _decode(enc: Encoded) -> np.ndarray:
     if enc.fmt == FMT_CONST_DELTA:
         base, slope = struct.unpack_from("<qq", enc.payload)
         return base + slope * np.arange(enc.n, dtype=np.int64)

@@ -159,3 +159,22 @@ class TestDictUTF8:
     def test_unicode_and_empty(self):
         strings = ["héllo", "", "日本語", ""]
         assert E.decode_utf8_dict(E.encode_utf8_dict(strings)) == strings
+
+
+class TestCorruptVectors:
+    def test_truncated_payloads_raise_cleanly(self):
+        rng = np.random.default_rng(0)
+        cases = [
+            E.encode_double(50 + rng.standard_normal(200)),
+            E.encode_int64(np.cumsum(rng.integers(1, 100, 200)).astype(np.int64)),
+            E.encode_hist(np.cumsum(rng.poisson(2, (20, 8)), axis=0).astype(np.int64)),
+        ]
+        for enc in cases:
+            for cut in (1, len(enc.payload) // 2):
+                bad = E.Encoded(enc.fmt, enc.n, enc.payload[:cut])
+                with pytest.raises(E.CorruptVectorError):
+                    E.decode(bad)
+
+    def test_unknown_format(self):
+        with pytest.raises(E.CorruptVectorError):
+            E.decode(E.Encoded(99, 5, b"xx"))
