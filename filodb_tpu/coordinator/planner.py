@@ -339,7 +339,15 @@ class SingleClusterPlanner:
         if p.op == "quantile":
             from ..parallel.exec import MeshQuantileExec
 
+            if "time" in getattr(mesh, "axis_names", ()):
+                return None  # sketch path is 1D-only today
             return MeshQuantileExec(float(p.params[0]), **common)
+        if set(getattr(mesh, "axis_names", ())) == {"shard", "time"}:
+            from ..parallel.exec import Mesh2DAggregateExec
+
+            if p.op in ("sum", "count", "avg"):
+                return Mesh2DAggregateExec(op=p.op, **common)
+            return None
         return MeshAggregateExec(op=p.op, **common)
 
 

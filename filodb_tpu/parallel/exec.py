@@ -166,6 +166,111 @@ class MeshAggregateExec(ExecPlan):
         return shard.partition(int(pids[0])).schema.value_column
 
 
+def _concat_staged(bs):
+    """Row-concatenate staged blocks exactly (keeps corrected values, raw
+    sidecars, baselines — no restaging, no semantic drift)."""
+    from ..ops.staging import TS_PAD, StagedBlock, pad_series
+
+    assert len({b.base_ms for b in bs}) == 1
+    T = max(b.ts.shape[1] for b in bs)
+    S = sum(b.n_series for b in bs)
+    Sp = pad_series(max(S, 1))
+    ts = np.full((Sp, T), TS_PAD, np.int32)
+    vals = np.zeros((Sp, T), np.float32)
+    raw = np.zeros((Sp, T), np.float32)
+    lens = np.zeros(Sp, np.int32)
+    baseline = np.zeros(Sp, np.float32)
+    o = 0
+    for b in bs:
+        k, t = b.n_series, b.ts.shape[1]
+        ts[o : o + k, :t] = np.asarray(b.ts)[:k]
+        vals[o : o + k, :t] = np.asarray(b.vals)[:k]
+        src_raw = b.raw if b.raw is not None else b.vals
+        raw[o : o + k, :t] = np.asarray(src_raw)[:k]
+        lens[o : o + k] = np.asarray(b.lens)[:k]
+        baseline[o : o + k] = np.asarray(b.baseline)[:k]
+        o += k
+    reg = bs[0].regular_ts
+    regular = None
+    if reg is not None and all(
+        b.regular_ts is not None
+        and len(b.regular_ts) == len(reg)
+        and not (b.regular_ts != reg).any()
+        for b in bs[1:]
+    ):
+        regular = reg
+    return StagedBlock(ts, vals, lens, bs[0].base_ms, baseline, S, [],
+                       raw=raw, regular_ts=regular)
+
+
+class Mesh2DAggregateExec(MeshAggregateExec):
+    """sum/count/avg-by of a range function over a 2D (shard x time) mesh:
+    series psum x time ring-halo in one program (parallel/mesh2d.py)."""
+
+    def args_str(self):
+        return (
+            f"op={self.op} fn={self.function} mesh=({self.mesh.shape['shard']}x"
+            f"{self.mesh.shape['time']})"
+        )
+
+    def do_execute(self, ctx: QueryContext) -> QueryResult:
+        from . import mesh2d as M2
+
+        # per-shard staging (blocks + global gids), like the 1D path but
+        # without stacking — mesh2d splits each block's time axis itself
+        blocks, labels_per_shard = [], []
+        for s in self.shard_nums:
+            shard = ctx.memstore.shard(ctx.dataset, s)
+            pids = shard.lookup_partitions(self.filters, self.raw_start_ms, self.raw_end_ms)
+            if shard.odp_store is not None and len(pids):
+                shard.odp_page_in(pids, self.raw_start_ms, self.raw_end_ms)
+            block = ST.stage_from_shard(
+                shard, pids, self._column(ctx, shard, pids), self.raw_start_ms,
+                self.raw_end_ms, is_counter=self.is_counter and not self.is_delta,
+            )
+            labels_per_shard.append([dict(shard.partition(int(p)).tags) for p in pids])
+            blocks.append(block)
+            ctx.stats.series_scanned += len(pids)
+        all_labels = [l for ls in labels_per_shard for l in ls]
+        if not all_labels:
+            return QueryResult()
+        gids_all, group_labels = AGG.group_ids_for(
+            all_labels, list(self.by) if self.by else None,
+            list(self.without) if self.without else None,
+        )
+        gids_per_block, off = [], 0
+        Ds = self.mesh.shape["shard"]
+        # pack shard blocks round-robin onto the Ds series rows
+        merged_blocks: list = [[] for _ in range(min(Ds, len(blocks)))]
+        merged_gids: list = [[] for _ in range(len(merged_blocks))]
+        for i, (b, ls) in enumerate(zip(blocks, labels_per_shard)):
+            g = gids_all[off : off + len(ls)].astype(np.int32)
+            off += len(ls)
+            merged_blocks[i % len(merged_blocks)].append(b)
+            merged_gids[i % len(merged_gids)].append(g)
+        # mesh2d takes one block per shard row: merge each row's blocks by
+        # concatenating series host-side
+        row_blocks, row_gids = [], []
+        for bs, gs in zip(merged_blocks, merged_gids):
+            if len(bs) == 1:
+                row_blocks.append(bs[0])
+                row_gids.append(gs[0])
+            else:
+                row_blocks.append(_concat_staged(bs))
+                row_gids.append(np.concatenate(gs))
+        num_steps = int((self.end_ms - self.start_ms) // self.step_ms) + 1
+        params = K.RangeParams(self.start_ms, self.step_ms, num_steps, self.window_ms)
+        out = M2.run_mesh2d(
+            self.mesh, self.function, self.op, row_blocks, row_gids,
+            len(group_labels), params,
+            is_counter=self.is_counter, is_delta=self.is_delta,
+        )
+        return QueryResult(
+            grids=[Grid(group_labels, self.start_ms, self.step_ms, num_steps,
+                        np.asarray(out))]
+        )
+
+
 class MeshQuantileExec(MeshAggregateExec):
     """quantile(q, range_fn(...)) over the mesh via mergeable log-linear
     sketches + psum (reference ships t-digests between nodes; ops/sketch.py).

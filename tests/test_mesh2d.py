@@ -79,3 +79,32 @@ def test_mesh2d_gauge_sum():
     for g in (0, 1):
         want = np.nansum(np.stack(sums[g]), axis=0)
         np.testing.assert_allclose(got[g], want, rtol=1e-3)
+
+
+def test_mesh2d_through_engine():
+    """Planner selects the 2D exec for a (shard x time) mesh and results
+    match the host path."""
+    from filodb_tpu.coordinator.planner import PlannerParams, QueryEngine
+    from filodb_tpu.core.schemas import Dataset
+    from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+    from filodb_tpu.parallel.exec import Mesh2DAggregateExec
+    from filodb_tpu.query.promql import query_range_to_logical_plan
+    from filodb_tpu.testkit import counter_batch
+
+    ms = TimeSeriesMemStore()
+    ms.setup(Dataset("prometheus"), range(4))
+    ms.ingest_routed("prometheus", counter_batch(n_series=24, n_samples=200, start_ms=BASE), spread=2)
+    host = QueryEngine(ms, "prometheus")
+    mesh2 = QueryEngine(ms, "prometheus", PlannerParams(mesh=M2.make_mesh2d(2, 4)))
+    start_s, end_s = (BASE + 600_000) / 1000, (BASE + 1_800_000) / 1000
+    q = "sum by (instance) (rate(http_requests_total[5m]))"
+    plan = query_range_to_logical_plan(q, start_s, end_s, 60)
+    ep = mesh2.planner.materialize(plan)
+    assert isinstance(ep, Mesh2DAggregateExec)
+    r2 = ep.execute(mesh2.context())
+    r1 = host.query_range(q, start_s, end_s, 60)
+    m1 = {tuple(sorted(l.items())): v for l, _, v in r1.all_series()}
+    m2_ = {tuple(sorted(l.items())): v for l, _, v in r2.all_series()}
+    assert m1.keys() == m2_.keys()
+    for k in m1:
+        np.testing.assert_allclose(m2_[k], m1[k], rtol=2e-3)
