@@ -336,18 +336,21 @@ class SingleClusterPlanner:
             step_ms=inner.step_ms, window_ms=inner.window_ms,
             is_counter=is_counter,
         )
-        if p.op == "quantile":
-            from ..parallel.exec import MeshQuantileExec
-
-            if "time" in getattr(mesh, "axis_names", ()):
-                return None  # sketch path is 1D-only today
-            return MeshQuantileExec(float(p.params[0]), **common)
-        if set(getattr(mesh, "axis_names", ())) == {"shard", "time"}:
+        axes = set(getattr(mesh, "axis_names", ()))
+        if axes == {"shard", "time"}:
             from ..parallel.exec import Mesh2DAggregateExec
 
             if p.op in ("sum", "count", "avg"):
                 return Mesh2DAggregateExec(op=p.op, **common)
             return None
+        if "shard" not in axes:
+            # e.g. a time-only mesh: the 1D aggregation program psums over
+            # 'shard', which doesn't exist there — use the host path
+            return None
+        if p.op == "quantile":
+            from ..parallel.exec import MeshQuantileExec
+
+            return MeshQuantileExec(float(p.params[0]), **common)
         return MeshAggregateExec(op=p.op, **common)
 
 

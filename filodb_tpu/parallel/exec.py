@@ -51,25 +51,27 @@ class MeshAggregateExec(ExecPlan):
             f"devices={self.mesh.devices.size}"
         )
 
-    def _stage_all(self, ctx: QueryContext):
-        """Stage every shard + GLOBAL group numbering so on-device segment
-        ids agree across shards. Returns (stacked DEVICE arrays, group
-        labels, blocks) or None when nothing matches. Cached per
-        (selection, range, grouping, shard versions) so repeat queries reuse
-        the HBM-resident stack (the mesh form of the leaf staging cache)."""
-        n_dev = self.mesh.devices.size
-        versions = tuple(
-            ctx.memstore.shard(ctx.dataset, s).version for s in self.shard_nums
-        )
-        key = (
-            self.filters, self.raw_start_ms, self.raw_end_ms,
-            self.by, self.without, versions, n_dev,
-            self.is_counter, self.is_delta,
-        )
+    def _cache(self, ctx: QueryContext, kind: str):
         cache = getattr(ctx.memstore, "_mesh_stage_cache", None)
         if cache is None:
             cache = {}
             ctx.memstore._mesh_stage_cache = cache
+        versions = tuple(
+            ctx.memstore.shard(ctx.dataset, s).version for s in self.shard_nums
+        )
+        key = (
+            kind, self.filters, self.raw_start_ms, self.raw_end_ms,
+            self.by, self.without, versions, self.mesh.devices.size,
+            self.is_counter, self.is_delta,
+        )
+        return cache, key
+
+    def _staged_blocks(self, ctx: QueryContext):
+        """Stage every shard + GLOBAL group numbering so on-device segment
+        ids agree across shards. Returns (blocks, gids_per_block,
+        group_labels) or None; cached per (selection, range, grouping,
+        shard versions)."""
+        cache, key = self._cache(ctx, "blocks")
         hit = cache.get(key)
         if hit is not None:
             return hit
@@ -98,10 +100,26 @@ class MeshAggregateExec(ExecPlan):
         for ls in labels_per_shard:
             gids_per_block.append(gids_all[off : off + len(ls)].astype(np.int32))
             off += len(ls)
-        arrays = M.stack_blocks_for_mesh(blocks, gids_per_block, n_dev)
+        result = (blocks, gids_per_block, group_labels)
+        if len(cache) >= 8:
+            cache.pop(next(iter(cache)))
+        cache[key] = result
+        return result
+
+    def _stage_all(self, ctx: QueryContext):
+        """The 1D form: staged blocks stacked + pinned in HBM (cached)."""
+        cache, key = self._cache(ctx, "stack")
+        hit = cache.get(key)
+        if hit is not None:
+            return hit
+        staged = self._staged_blocks(ctx)
+        if staged is None:
+            return None
+        blocks, gids_per_block, group_labels = staged
+        arrays = M.stack_blocks_for_mesh(blocks, gids_per_block, self.mesh.devices.size)
         sharded = M.shard_arrays(self.mesh, *arrays)  # pin the stack in HBM
         result = (sharded, group_labels, blocks)
-        if len(cache) >= 4:
+        if len(cache) >= 8:
             cache.pop(next(iter(cache)))
         cache[key] = result
         return result
@@ -216,36 +234,17 @@ class Mesh2DAggregateExec(MeshAggregateExec):
     def do_execute(self, ctx: QueryContext) -> QueryResult:
         from . import mesh2d as M2
 
-        # per-shard staging (blocks + global gids), like the 1D path but
-        # without stacking — mesh2d splits each block's time axis itself
-        blocks, labels_per_shard = [], []
-        for s in self.shard_nums:
-            shard = ctx.memstore.shard(ctx.dataset, s)
-            pids = shard.lookup_partitions(self.filters, self.raw_start_ms, self.raw_end_ms)
-            if shard.odp_store is not None and len(pids):
-                shard.odp_page_in(pids, self.raw_start_ms, self.raw_end_ms)
-            block = ST.stage_from_shard(
-                shard, pids, self._column(ctx, shard, pids), self.raw_start_ms,
-                self.raw_end_ms, is_counter=self.is_counter and not self.is_delta,
-            )
-            labels_per_shard.append([dict(shard.partition(int(p)).tags) for p in pids])
-            blocks.append(block)
-            ctx.stats.series_scanned += len(pids)
-        all_labels = [l for ls in labels_per_shard for l in ls]
-        if not all_labels:
+        # per-shard staging (blocks + global gids) shared with the 1D path
+        # (cached); mesh2d splits each block's time axis itself
+        staged = self._staged_blocks(ctx)
+        if staged is None:
             return QueryResult()
-        gids_all, group_labels = AGG.group_ids_for(
-            all_labels, list(self.by) if self.by else None,
-            list(self.without) if self.without else None,
-        )
-        gids_per_block, off = [], 0
+        blocks, gids_per_block, group_labels = staged
         Ds = self.mesh.shape["shard"]
         # pack shard blocks round-robin onto the Ds series rows
         merged_blocks: list = [[] for _ in range(min(Ds, len(blocks)))]
         merged_gids: list = [[] for _ in range(len(merged_blocks))]
-        for i, (b, ls) in enumerate(zip(blocks, labels_per_shard)):
-            g = gids_all[off : off + len(ls)].astype(np.int32)
-            off += len(ls)
+        for i, (b, g) in enumerate(zip(blocks, gids_per_block)):
             merged_blocks[i % len(merged_blocks)].append(b)
             merged_gids[i % len(merged_gids)].append(g)
         # mesh2d takes one block per shard row: merge each row's blocks by

@@ -122,3 +122,24 @@ def test_mesh_quantile_sketch(engines):
     m = ~np.isnan(want)
     err = np.abs(got[m] - want[m]) / np.maximum(np.abs(want[m]), 1e-9)
     assert (err < 0.08).all()
+
+
+def test_time_only_mesh_aggregation_falls_back_to_host(engines):
+    """A ('time',) mesh must not route aggregations into the shard-psum
+    program (which would crash on the missing axis)."""
+    host, _ = engines
+    from filodb_tpu.coordinator.planner import PlannerParams, QueryEngine
+    from filodb_tpu.parallel.exec import MeshAggregateExec
+    from filodb_tpu.parallel.timeshard import make_time_mesh
+    from filodb_tpu.query.promql import query_range_to_logical_plan
+
+    engine = QueryEngine(host.memstore, "prometheus", PlannerParams(mesh=make_time_mesh()))
+    q = "sum(rate(http_requests_total[5m]))"
+    plan = query_range_to_logical_plan(q, START_S, END_S, 60)
+    ep = engine.planner.materialize(plan)
+    assert not isinstance(ep, MeshAggregateExec)
+    res = ep.execute(engine.context())
+    want = host.query_range(q, START_S, END_S, 60)
+    np.testing.assert_allclose(
+        res.grids[0].values_np() if res.grids else list(res.all_series())[0][2],
+        want.grids[0].values_np(), rtol=1e-3, equal_nan=True)
