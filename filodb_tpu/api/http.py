@@ -150,6 +150,10 @@ class PromApiHandler(BaseHTTPRequestHandler):
                 return self._cardinality()
             if path == "/ingest":
                 return self._ingest()
+            if path == "/ingest/prom":
+                return self._ingest_prom()
+            if path == "/ingest/influx":
+                return self._ingest_influx()
             if path == "/api/v1/write":
                 return self._remote_write()
             if path == "/api/v1/read":
@@ -295,6 +299,32 @@ class PromApiHandler(BaseHTTPRequestHandler):
                 slot["children"] = max(slot["children"], rec.children)
         out = sorted(merged.values(), key=lambda r: -r["ts_count"])
         return self._send(200, J.success(out))
+
+    def _ingest_prom(self):
+        """Prometheus text exposition ingest (push-gateway style; counters
+        route to the prom-counter schema via # TYPE comments)."""
+        import time as _time
+
+        from ..gateway.parsers import prom_text_to_batches
+
+        length = int(self.headers.get("Content-Length") or 0)
+        text = self.rfile.read(length).decode() if length else ""
+        n = 0
+        for batch in prom_text_to_batches(text, int(_time.time() * 1000)):
+            n += self.engine.memstore.ingest_routed(self.engine.dataset, batch, spread=3)
+        return self._send(200, J.success({"ingested": n}))
+
+    def _ingest_influx(self):
+        """Influx line protocol over HTTP (the TCP gateway's HTTP twin)."""
+        import time as _time
+
+        from ..gateway.parsers import influx_to_batch
+
+        length = int(self.headers.get("Content-Length") or 0)
+        text = self.rfile.read(length).decode() if length else ""
+        batch = influx_to_batch(text.splitlines(), int(_time.time() * 1000))
+        n = self.engine.memstore.ingest_routed(self.engine.dataset, batch, spread=3)
+        return self._send(200, J.success({"ingested": n}))
 
     def _remote_write(self):
         """Prometheus remote write receiver (snappy+protobuf)."""

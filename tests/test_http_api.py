@@ -116,3 +116,29 @@ def test_ingest_endpoint(api):
     q = urllib.parse.quote("pushed_metric")
     res = get(f"{api}/api/v1/query?query={q}&time={(BASE + 100_000) / 1000}")
     assert len(res["data"]["result"]) == 1
+
+
+def test_ingest_prom_text(api):
+    text = """# TYPE pushed_counter counter
+pushed_counter{src="push"} 100 1600000000000
+pushed_counter{src="push"} 110 1600000015000
+pushed_gauge 3.5 1600000000000
+"""
+    req = urllib.request.Request(f"{api}/ingest/prom", data=text.encode(), method="POST")
+    with urllib.request.urlopen(req, timeout=30) as r:
+        out = json.loads(r.read())
+    assert out["data"]["ingested"] == 3
+    q = urllib.parse.quote("pushed_counter")
+    res = get(f"{api}/api/v1/query?query={q}&time={1600000100}")
+    assert len(res["data"]["result"]) == 1
+
+
+def test_ingest_influx_http(api):
+    lines = "httpm,host=a value=1.5 1600000000000000000\nhttpm,host=b value=2.5 1600000000000000000\n"
+    req = urllib.request.Request(f"{api}/ingest/influx", data=lines.encode(), method="POST")
+    with urllib.request.urlopen(req, timeout=30) as r:
+        out = json.loads(r.read())
+    assert out["data"]["ingested"] == 2
+    q = urllib.parse.quote("httpm")
+    res = get(f"{api}/api/v1/query?query={q}&time={1600000100}")
+    assert len(res["data"]["result"]) == 2
