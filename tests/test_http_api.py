@@ -142,3 +142,26 @@ def test_ingest_influx_http(api):
     q = urllib.parse.quote("httpm")
     res = get(f"{api}/api/v1/query?query={q}&time={1600000100}")
     assert len(res["data"]["result"]) == 2
+
+
+class TestPromJsonFormat:
+    def test_value_formatting(self):
+        from filodb_tpu.api.promjson import _fmt
+
+        assert _fmt(float("nan")) == "NaN"
+        assert _fmt(float("inf")) == "+Inf"
+        assert _fmt(float("-inf")) == "-Inf"
+        assert _fmt(1.5) == "1.5"
+        assert _fmt(2.0) == "2.0"
+
+    def test_matrix_rendering_skips_nan_and_restores_name(self):
+        from filodb_tpu.api.promjson import render_matrix
+        from filodb_tpu.query.rangevector import Grid, QueryResult
+
+        vals = np.array([[1.0, np.nan, 3.0]], dtype=np.float32)
+        g = Grid([{"_metric_": "m", "a": "b"}], 1_600_000_000_000, 60_000, 3, vals)
+        out = render_matrix(QueryResult(grids=[g]))
+        assert out["resultType"] == "matrix"
+        series = out["result"][0]
+        assert series["metric"] == {"__name__": "m", "a": "b"}
+        assert [t for t, _ in series["values"]] == [1_600_000_000.0, 1_600_000_120.0]
