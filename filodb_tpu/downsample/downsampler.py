@@ -34,6 +34,12 @@ DS_GAUGE = Schema(
     "avg",
 )
 
+# register in the global schema registry so persisted ds chunks recover
+# (recover_shard resolves schemas by name)
+from ..core.schemas import SCHEMAS as _SCHEMAS
+
+_SCHEMAS.setdefault(DS_GAUGE.name, DS_GAUGE)
+
 # query-side column rewrite (reference DownsampledTimeSeriesShard column
 # selection, doc/downsampling.md:89-96)
 FUNC_TO_DS_COLUMN = {

@@ -120,10 +120,20 @@ class FiloServer:
 
     def recover(self) -> dict[int, int]:
         """Rebuild shards from the column store; returns per-shard replay
-        offsets for the ingestion sources."""
+        offsets for the ingestion sources. Downsample datasets recover too
+        (they have no replay stream — their tail rebuilds from raw flushes)."""
         offsets = {}
         for s in range(self.n_shards):
             offsets[s] = recover_shard(self.memstore, self.column_store, self.dataset, s)
+        if self.downsampler is not None:
+            from .core.schemas import Dataset as _DS
+            from .downsample.downsampler import DS_GAUGE
+
+            for period in self.downsampler.periods_ms:
+                ds = self.downsampler.dataset_for(period)
+                self.memstore.setup(_DS(ds, schemas=[DS_GAUGE]), range(self.n_shards))
+                for s in range(self.n_shards):
+                    recover_shard(self.memstore, self.column_store, ds, s)
         log.info("recovered %d shards: %s", self.n_shards, offsets)
         return offsets
 
@@ -156,19 +166,26 @@ class FiloServer:
             now = time.time()
             if now - last_flush >= self.flush_interval_s:
                 try:
-                    self.flusher.flush_all(self.dataset)
+                    self.flush_now()
                 except Exception:  # noqa: BLE001
                     log.exception("flush failed")
                 last_flush = now
-            for sh in self.memstore.shards(self.dataset):
-                sh.evict_for_retention()
+            for ds in list(self.memstore._datasets):
+                for sh in self.memstore.shards(ds):
+                    sh.evict_for_retention()
             try:
                 metering.publish()
             except Exception:  # noqa: BLE001
                 log.exception("metering failed")
 
     def flush_now(self):
-        return self.flusher.flush_all(self.dataset)
+        """Flush the primary dataset, then any downsample/aux datasets the
+        flush itself populated (so they persist and recover too)."""
+        res = self.flusher.flush_all(self.dataset)
+        for ds in list(self.memstore._datasets):
+            if ds != self.dataset:
+                self.flusher.flush_all(ds)
+        return res
 
 
 def main(argv=None):

@@ -134,3 +134,32 @@ def test_cli_admin_jobs(tmp_path, capsys):
     cli_main(["cardbust", "--store", src, 'heap_usage0{instance="host-0"}'])
     out = json.loads(capsys.readouterr().out)
     assert out["series_deleted"] == 1
+
+
+def test_downsample_datasets_persist_and_recover(tmp_path):
+    cfg = {
+        "shards": 1,
+        "max_chunk_size": 100,
+        "store_root": str(tmp_path / "store"),
+        "downsample": {"enabled": True, "periods_m": [5]},
+    }
+    srv = FiloServer(cfg)
+    srv.start(port=0)
+    try:
+        srv.memstore.ingest("prometheus", 0,
+                            machine_metrics(n_series=2, n_samples=300, start_ms=BASE))
+        srv.flush_now()
+        assert srv.memstore.shard("prometheus_5m", 0).num_partitions == 2
+    finally:
+        srv.stop()
+    # fresh boot: the downsample dataset must come back from the store
+    srv2 = FiloServer(cfg)
+    srv2.start(port=0)
+    try:
+        sh = srv2.memstore.shard("prometheus_5m", 0)
+        assert sh.num_partitions == 2
+        part = sh.partitions[0]
+        ts, avg = part.samples_in_range(0, 2**62, "avg")
+        assert len(ts) >= 9
+    finally:
+        srv2.stop()
