@@ -85,11 +85,26 @@ class NullColumnStore(ColumnStore):
         return []
 
 
+FORMAT_VERSION = 1
+
+
 class LocalColumnStore(ColumnStore):
     def __init__(self, root: str):
         self.root = root
         self._lock = threading.Lock()
         os.makedirs(root, exist_ok=True)
+        # store format versioning (refuse to misread future layouts)
+        vpath = os.path.join(root, "FORMAT")
+        if os.path.exists(vpath):
+            with open(vpath) as f:
+                ver = int(f.read().strip() or 1)
+            if ver > FORMAT_VERSION:
+                raise ValueError(
+                    f"store at {root} has format v{ver}; this build reads <= v{FORMAT_VERSION}"
+                )
+        else:
+            with open(vpath, "w") as f:
+                f.write(str(FORMAT_VERSION))
 
     def _shard_dir(self, dataset, shard) -> str:
         d = os.path.join(self.root, dataset, f"shard-{shard}")

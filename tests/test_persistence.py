@@ -342,3 +342,21 @@ class TestJsonlTail:
             rows += len(batch)
         t.join()
         assert rows == 50
+
+
+def test_store_format_versioning(tmp_path):
+    import os
+
+    from filodb_tpu.store.columnstore import FORMAT_VERSION
+
+    root = str(tmp_path / "s")
+    LocalColumnStore(root)
+    with open(os.path.join(root, "FORMAT")) as f:
+        assert int(f.read()) == FORMAT_VERSION
+    # reopening same version is fine
+    LocalColumnStore(root)
+    # future format refuses
+    with open(os.path.join(root, "FORMAT"), "w") as f:
+        f.write(str(FORMAT_VERSION + 1))
+    with pytest.raises(ValueError, match="format"):
+        LocalColumnStore(root)
