@@ -110,3 +110,22 @@ def test_rules_and_status_stubs(api):
         assert json.loads(r.read())["data"] == {"groups": []}
     with urllib.request.urlopen(f"{url}/api/v1/status/flags", timeout=30) as r:
         assert json.loads(r.read())["status"] == "success"
+
+
+class TestSnappyFuzz:
+    @pytest.mark.parametrize("seed", range(5))
+    def test_garbage_never_hangs_or_crashes(self, seed):
+        rng = np.random.default_rng(seed)
+        for _ in range(50):
+            blob = rng.integers(0, 256, rng.integers(0, 200)).astype(np.uint8).tobytes()
+            try:
+                out = snappy.decompress(blob)
+                assert isinstance(out, bytes)  # lucky valid stream
+            except (ValueError, IndexError):
+                pass  # clean rejection
+
+    def test_roundtrip_fuzz(self):
+        rng = np.random.default_rng(42)
+        for _ in range(20):
+            data = rng.integers(0, 256, rng.integers(0, 300_000)).astype(np.uint8).tobytes()
+            assert snappy.decompress(snappy.compress(data)) == data
