@@ -218,3 +218,31 @@ class TestWindowedOffset:
         lbls = series[0][0]
         assert "instance" not in lbls and "_metric_" not in lbls
         assert lbls.get("job") == "api"
+
+
+class TestMoreFunctionsE2E:
+    def test_histogram_fraction_e2e(self, hist_engine):
+        res = hist_engine.query_range(
+            "histogram_fraction(0, 1, rate(http_request_latency[5m]))",
+            HS_START, HS_END, 60.0)
+        series = list(res.all_series())
+        assert len(series) == 3
+        for _, _, vals in series:
+            assert ((vals >= 0) & (vals <= 1)).all()
+
+    def test_predict_linear_e2e(self, engine):
+        res = engine.query_range(
+            "predict_linear(heap_usage0[10m], 3600)",
+            (BASE + 900_000) / 1000, (BASE + 1_500_000) / 1000, 60.0)
+        assert len(list(res.all_series())) == 4
+
+    def test_deriv_e2e(self, engine):
+        res = engine.query_range(
+            "deriv(heap_usage0[10m])", (BASE + 900_000) / 1000, (BASE + 1_500_000) / 1000, 60.0)
+        assert len(list(res.all_series())) == 4
+
+    def test_holt_winters_e2e(self, engine):
+        res = engine.query_range(
+            "holt_winters(heap_usage0[10m], 0.5, 0.1)",
+            (BASE + 900_000) / 1000, (BASE + 1_500_000) / 1000, 60.0)
+        assert len(list(res.all_series())) == 4
