@@ -262,3 +262,25 @@ def bench_mesh_paths():
 
 
 ALL.append(bench_mesh_paths)
+
+
+def bench_serialization():
+    """Prom JSON rendering throughput (the serving-edge cost)."""
+    from filodb_tpu.api.promjson import render_matrix
+    from filodb_tpu.query.rangevector import Grid, QueryResult
+
+    rng = np.random.default_rng(0)
+    vals = rng.standard_normal((1000, 120)).astype(np.float32)
+    g = Grid([{"_metric_": "m", "i": str(i)} for i in range(1000)],
+             BASE, 60_000, 120, vals)
+    res = QueryResult(grids=[g])
+    dt = _bench(lambda: render_matrix(res))
+    report("prom_json_render", 1000 * 120 / dt / 1e6, "Msamples/s")
+
+    from filodb_tpu.api.arrow_edge import result_to_ipc
+
+    dt = _bench(lambda: result_to_ipc(res))
+    report("arrow_ipc_render", 1000 * 120 / dt / 1e6, "Msamples/s")
+
+
+ALL.append(bench_serialization)

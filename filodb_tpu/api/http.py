@@ -228,10 +228,13 @@ class PromApiHandler(BaseHTTPRequestHandler):
         p = self._params()
         start = _parse_time(self._q(p, "start"), 0.0)
         end = _parse_time(self._q(p, "end"), time.time() + 1e9)
+        limit = self._q(p, "limit")
         names = self.engine.memstore.label_names(
             self.engine.dataset, [], int(start * 1000), int(end * 1000)
         )
         names = ["__name__" if n == "_metric_" else n for n in names]
+        if limit:
+            names = names[: int(limit)]
         return self._send(200, J.success(names))
 
     def _label_values(self, label: str):
@@ -241,9 +244,11 @@ class PromApiHandler(BaseHTTPRequestHandler):
         start = _parse_time(self._q(p, "start"), 0.0)
         end = _parse_time(self._q(p, "end"), time.time() + 1e9)
         match = p.get("match[]", [])
+        limit = self._q(p, "limit")
         filters = _matchers_from(match[0]) if match else []
         vals = self.engine.memstore.label_values(
-            self.engine.dataset, filters, label, int(start * 1000), int(end * 1000)
+            self.engine.dataset, filters, label, int(start * 1000), int(end * 1000),
+            limit=int(limit) if limit else None,
         )
         return self._send(200, J.success(vals))
 

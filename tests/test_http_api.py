@@ -165,3 +165,8 @@ class TestPromJsonFormat:
         series = out["result"][0]
         assert series["metric"] == {"__name__": "m", "a": "b"}
         assert [t for t, _ in series["values"]] == [1_600_000_000.0, 1_600_000_120.0]
+
+
+def test_label_values_limit_param(api):
+    out = get(f"{api}/api/v1/label/instance/values?limit=3")
+    assert len(out["data"]) == 3
