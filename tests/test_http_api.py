@@ -170,3 +170,22 @@ class TestPromJsonFormat:
 def test_label_values_limit_param(api):
     out = get(f"{api}/api/v1/label/instance/values?limit=3")
     assert len(out["data"]) == 3
+
+
+class TestRenderShapes:
+    def test_vector_render_uses_last_nonnan(self):
+        from filodb_tpu.api.promjson import render_vector
+        from filodb_tpu.query.rangevector import Grid, QueryResult
+
+        vals = np.array([[1.0, 7.0, np.nan]], dtype=np.float32)
+        g = Grid([{"_metric_": "m"}], 1_600_000_000_000, 60_000, 3, vals)
+        out = render_vector(QueryResult(grids=[g]), 1_600_000_180.0)
+        assert out["result"][0]["value"] == [1_600_000_180.0, "7.0"]
+
+    def test_scalar_render(self):
+        from filodb_tpu.api.promjson import render_scalar
+        from filodb_tpu.query.rangevector import QueryResult, ScalarResult
+
+        res = QueryResult(scalar=ScalarResult(0, 1, 3, np.array([1.0, 2.0, 3.5])))
+        out = render_scalar(res, 42.0)
+        assert out == {"resultType": "scalar", "result": [42.0, "3.5"]}
