@@ -248,3 +248,26 @@ class TestSinglePartitionPlanner:
         plan = query_range_to_logical_plan("special", (BASE + 600_000) / 1000, (BASE + 1_200_000) / 1000, 60)
         spp.materialize(plan)
         assert calls == ["b"]
+
+
+class TestUnparseMore:
+    @pytest.mark.parametrize("q", [
+        "last_over_time(m[5m])",
+        "predict_linear(m[1h],600)",
+        "holt_winters(m[10m],0.5,0.1)",
+        'label_replace(m,"d","$1","s","(.*)")',
+        "sort_desc(sum by (a) (m))",
+        "scalar(sum(m))",
+        "vector(1)",
+        "absent(m)",
+        "(a unless on (x) b)",
+        "clamp(m,0,10)",
+        "histogram_fraction(0,0.5,rate(h[5m]))",
+        "(time() + 100)",
+        "stddev without (i) (m)",
+    ])
+    def test_fixpoint(self, q):
+        plan = query_range_to_logical_plan(q, 1000, 2000, 15)
+        s = to_promql(plan)
+        plan2 = query_range_to_logical_plan(s, 1000, 2000, 15)
+        assert to_promql(plan2) == s
