@@ -360,3 +360,21 @@ def test_store_format_versioning(tmp_path):
         f.write(str(FORMAT_VERSION + 1))
     with pytest.raises(ValueError, match="format"):
         LocalColumnStore(root)
+
+
+class TestParserEdges:
+    def test_influx_no_timestamp_uses_default(self):
+        out = list(parse_influx_line("cpu,host=a value=1.5"))
+        assert out == [("cpu", {"host": "a"}, None, 1.5)]
+        batch = influx_to_batch(["cpu,host=a value=1.5"], default_ts_ms=BASE)
+        assert batch.timestamps[0] == BASE
+
+    def test_prom_nan_value(self):
+        out = list(parse_prom_text("m 1\nm2 NaN\n"))
+        assert out[0][3] == 1.0
+        assert np.isnan(out[1][3])
+
+    def test_influx_bool_and_int_fields(self):
+        out = dict((m, v) for m, _, _, v in parse_influx_line(
+            "s up=t,down=f,count=42i 1600000000000000000"))
+        assert out == {"s_up": 1.0, "s_down": 0.0, "s_count": 42.0}
