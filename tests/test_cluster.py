@@ -96,3 +96,26 @@ class TestClusterDiscovery:
         d.heartbeat(1, now - 60)
         assert d.healthy_nodes(now) == [0]
         assert d.down_nodes(now) == [1]
+
+
+class TestClusterServerIntegration:
+    def test_shard_lifecycle_with_memstore(self):
+        """ShardManager states drive which shards a planner queries."""
+        from filodb_tpu.coordinator.planner import SingleClusterPlanner
+        from filodb_tpu.core.schemas import Dataset
+        from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+        from filodb_tpu.testkit import machine_metrics
+
+        ms = TimeSeriesMemStore()
+        ms.setup(Dataset("ds"), range(4))
+        ms.ingest_routed("ds", machine_metrics(n_series=20, n_samples=10), spread=2)
+        mgr = ShardManager(4, shards_per_node=4)
+        mgr.node_joined("self")
+        for s in range(4):
+            mgr.shard_active(s)
+        planner = SingleClusterPlanner(ms, "ds", shard_nums=mgr.mapper.active_shards())
+        assert len(planner.shards_for(None)) == 4
+        # shard 2 goes down: planner built from active shards skips it
+        mgr.mapper.update(2, ShardStatus.DOWN)
+        planner2 = SingleClusterPlanner(ms, "ds", shard_nums=mgr.mapper.active_shards())
+        assert 2 not in planner2.shards_for(None)
