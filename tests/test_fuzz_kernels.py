@@ -99,3 +99,31 @@ def test_degenerate_shapes():
          (np.array([BASE + 1000]), np.array([7.0]))], BASE)
     got = np.asarray(K.run_range_function("sum_over_time", block, params))[:2, 0]
     assert np.isnan(got[0]) and got[1] == 7.0
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_fuzz_regular_grid_mxu_path(seed):
+    """Same fuzz harness pinned to regular grids: exercises the MXU matmul
+    path across random window/step configs."""
+    rng = np.random.default_rng(500 + seed)
+    n_series = int(rng.integers(2, 7))
+    n = int(rng.integers(20, 300))
+    interval = int(rng.integers(5_000, 20_000))
+    window_ms = int(rng.integers(2, 30)) * 15_000
+    step_ms = int(rng.integers(1, 8)) * 30_000
+    nsteps = int(rng.integers(3, 30))
+    start = BASE + int(rng.integers(0, 2 * window_ms))
+    ts = BASE + (1 + np.arange(n, dtype=np.int64)) * interval
+    series = [(ts.copy(), 50 + 20 * rng.standard_normal(n)) for _ in range(n_series)]
+    func = FUNCS_GAUGE[seed % len(FUNCS_GAUGE)]
+    block = stage_series(series, BASE)
+    assert block.regular_ts is not None
+    params = K.RangeParams(start, step_ms, nsteps, window_ms)
+    got = np.asarray(K.run_range_function(func, block, params))[:n_series, :nsteps]
+    want = np.stack([
+        oracle.range_function(func, t, v, start, step_ms, nsteps, window_ms)
+        for t, v in series
+    ])
+    np.testing.assert_array_equal(np.isnan(got), np.isnan(want), err_msg=f"{func} {seed}")
+    m = ~np.isnan(want)
+    np.testing.assert_allclose(got[m], want[m], rtol=5e-4, atol=5e-3, err_msg=f"{func} {seed}")
