@@ -126,3 +126,43 @@ class TestIngestQueryConcurrency:
         for t in threads:
             t.join(timeout=120)
         assert not errors, errors[:3]
+
+
+def test_concurrent_flush_and_query(tmp_path):
+    """Flush (seals buffers, persists, downsamples) racing queries must stay
+    correct — the reference's flush-vs-query lock discipline, here via
+    immutable chunk snapshots."""
+    import threading
+
+    from filodb_tpu.store.flush import FlushCoordinator
+
+    store = LocalColumnStore(str(tmp_path))
+    ms = TimeSeriesMemStore(StoreConfig(max_chunk_size=60))
+    ms.setup(Dataset("ds"), [0])
+    ms.ingest("ds", 0, machine_metrics(n_series=4, n_samples=240, start_ms=BASE))
+    engine = QueryEngine(ms, "ds")
+    fc = FlushCoordinator(ms, store)
+    errors = []
+
+    def flusher():
+        for _ in range(5):
+            try:
+                fc.flush_shard("ds", 0)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+    def querier():
+        for _ in range(8):
+            try:
+                res = engine.query_range(
+                    "sum(heap_usage0)", (BASE + 600_000) / 1000, (BASE + 2_000_000) / 1000, 60)
+                assert sum(g.n_series for g in res.grids) == 1
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+    threads = [threading.Thread(target=flusher)] + [threading.Thread(target=querier) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors[:3]
