@@ -189,3 +189,15 @@ class TestRenderShapes:
         res = QueryResult(scalar=ScalarResult(0, 1, 3, np.array([1.0, 2.0, 3.5])))
         out = render_scalar(res, 42.0)
         assert out == {"resultType": "scalar", "result": [42.0, "3.5"]}
+
+
+def test_duration_step_and_rfc3339_times(api):
+    q = urllib.parse.quote("heap_usage0")
+    # RFC3339 timestamps + "1m" step
+    start = "2020-09-13T12:36:40+00:00"  # 1600000600
+    end = "2020-09-13T12:53:20+00:00"    # 1600001600
+    out = get(f"{api}/api/v1/query_range?query={q}&start={start}&end={end}&step=1m")
+    assert out["status"] == "success"
+    assert len(out["data"]["result"]) == 10
+    times = [t for t, _ in out["data"]["result"][0]["values"]]
+    assert times[1] - times[0] == 60.0
