@@ -193,9 +193,9 @@ class TestRenderShapes:
 
 def test_duration_step_and_rfc3339_times(api):
     q = urllib.parse.quote("heap_usage0")
-    # RFC3339 timestamps + "1m" step
-    start = "2020-09-13T12:36:40+00:00"  # 1600000600
-    end = "2020-09-13T12:53:20+00:00"    # 1600001600
+    # RFC3339 timestamps (Z form; '+00:00' would need URL-encoding) + "1m" step
+    start = "2020-09-13T12:36:40Z"  # 1600000600
+    end = "2020-09-13T12:53:20Z"    # 1600001600
     out = get(f"{api}/api/v1/query_range?query={q}&start={start}&end={end}&step=1m")
     assert out["status"] == "success"
     assert len(out["data"]["result"]) == 10
