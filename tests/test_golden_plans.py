@@ -67,3 +67,19 @@ def test_golden_scalar_op(planner):
     got = normalize(tree(planner, "m * 2"))
     assert got.startswith("E~ScalarVectorOpExec(op=* scalar_is_lhs=False)")
     assert "ScalarPlanExec" in got
+
+
+def test_golden_long_time_range_stitch(planner):
+    """Golden tree for the stitch shape (reference LongTimeRangePlannerSpec)."""
+    from filodb_tpu.coordinator.planners import DownsampleClusterPlanner, LongTimeRangePlanner
+    from filodb_tpu.core.schemas import Dataset
+    from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+
+    dsm = TimeSeriesMemStore()
+    dsm.setup(Dataset("prometheus_5m"), [0, 1])
+    lp = LongTimeRangePlanner(
+        planner, DownsampleClusterPlanner(dsm, "prometheus_5m"), lambda: 1_500_000)
+    plan = query_range_to_logical_plan("avg_over_time(m[5m])", 1000, 2000, 60)
+    t = normalize(lp.materialize(plan).print_tree())
+    assert t.startswith("E~StitchRvsExec()")
+    assert t.count("DistConcatExec") == 2  # one per cluster half
