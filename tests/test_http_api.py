@@ -201,3 +201,17 @@ def test_duration_step_and_rfc3339_times(api):
     assert len(out["data"]["result"]) == 10
     times = [t for t, _ in out["data"]["result"][0]["values"]]
     assert times[1] - times[0] == 60.0
+
+
+def test_scalar_arithmetic_instant(api):
+    out = get(f"{api}/api/v1/query?query={urllib.parse.quote('2*3+1')}&time=1000")
+    assert out["data"]["resultType"] == "scalar"
+    assert float(out["data"]["result"][1]) == 7.0
+
+
+def test_scalar_range_renders_matrix(api):
+    out = get(f"{api}/api/v1/query_range?query=5&start=1000&end=1120&step=60")
+    res = out["data"]["result"]
+    assert out["data"]["resultType"] == "matrix"
+    assert len(res) == 1 and len(res[0]["values"]) == 3
+    assert all(float(v) == 5.0 for _, v in res[0]["values"])
