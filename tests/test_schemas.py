@@ -91,3 +91,28 @@ def test_shard_split_partitions_batch():
     for s, b in split.items():
         for t in b.tags:
             assert S.shard_for(t, 2, 8) == s
+
+
+def test_histogram_schema_flags():
+    from filodb_tpu.core.schemas import (
+        DELTA_HISTOGRAM,
+        OTEL_CUMULATIVE_HISTOGRAM,
+        PROM_HISTOGRAM,
+    )
+
+    assert PROM_HISTOGRAM.has_histogram
+    assert PROM_HISTOGRAM.column("h").is_counter
+    assert DELTA_HISTOGRAM.column("h").is_delta
+    assert OTEL_CUMULATIVE_HISTOGRAM.column("min").ctype.value == "double"
+
+
+def test_base2_exp_bucket_bounds():
+    from filodb_tpu.core.histograms import base2_exp_buckets
+    import numpy as np
+
+    s = base2_exp_buckets(scale=2, start_index=0, num=8)
+    b = s.bounds()
+    assert b[0] == 0.0 and np.isinf(b[-1])
+    # growth factor 2^(2^-scale) between consecutive finite bounds
+    ratios = b[2:-1] / b[1:-2]
+    np.testing.assert_allclose(ratios, 2 ** (2**-2.0))
