@@ -173,12 +173,46 @@ def run_benchmark():
     )
 
 
+def _probe_tpu(timeout_s: int) -> bool:
+    """Check in a short-lived child that a real accelerator backend can
+    initialize AND run a matmul. The image's TPU plugin can wedge forever on
+    backend init, so this must happen in a child with a hard timeout — never
+    in the watchdog process itself."""
+    import subprocess
+
+    code = (
+        "import jax, jax.numpy as jnp\n"
+        "d = jax.devices()\n"
+        "assert d and d[0].platform != 'cpu', d\n"
+        "x = jnp.ones((256, 256), jnp.bfloat16)\n"
+        "(x @ x).block_until_ready()\n"
+        "print('TPU_OK', d[0].platform, d[0].device_kind)\n"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], timeout=timeout_s,
+            capture_output=True, text=True,
+        )
+        if proc.returncode == 0 and "TPU_OK" in proc.stdout:
+            sys.stderr.write(f"tpu probe: {proc.stdout.strip()}\n")
+            return True
+        sys.stderr.write(
+            f"tpu probe failed rc={proc.returncode}: {proc.stderr[-500:]}\n"
+        )
+    except subprocess.TimeoutExpired:
+        sys.stderr.write(f"tpu probe timed out after {timeout_s}s (wedged plugin)\n")
+    return False
+
+
 def main():
     """Watchdog wrapper: the TPU tunnel in this environment can wedge
-    indefinitely; run the workload in a child with a timeout and fall back
-    to CPU so the driver always gets its JSON line."""
+    indefinitely. Probe the accelerator with a short-timeout child FIRST;
+    only if it answers do we spend budget on the TPU worker, and the CPU
+    fallback always keeps a reserved slice of the total budget so the driver
+    gets a real JSON line either way."""
     if "--worker" in sys.argv:
         if "--cpu" in sys.argv:
+            os.environ["JAX_PLATFORMS"] = "cpu"
             import jax
 
             jax.config.update("jax_platforms", "cpu")
@@ -187,8 +221,24 @@ def main():
     import subprocess
 
     here = os.path.abspath(__file__)
-    t_dev = int(os.environ.get("FILODB_BENCH_TIMEOUT_S", 1800))
-    for args, timeout_s in ((["--worker"], t_dev), (["--worker", "--cpu"], t_dev)):
+    total = int(os.environ.get("FILODB_BENCH_TIMEOUT_S", 1800))
+    deadline = time.time() + total
+    cpu_reserve = min(600, max(300, total // 3))
+
+    attempts = []
+    probe_t = min(240, max(60, total // 6))
+    # only spend probe+TPU budget when the CPU fallback still fits after it
+    if total > cpu_reserve + probe_t + 60 and _probe_tpu(probe_t):
+        tpu_budget = max(120, int(deadline - time.time()) - cpu_reserve)
+        attempts.append((["--worker"], tpu_budget))
+    attempts.append((["--worker", "--cpu"], None))
+
+    for args, budget in attempts:
+        remaining = int(deadline - time.time())
+        if remaining < 60:
+            sys.stderr.write(f"bench budget exhausted before {args}\n")
+            break
+        timeout_s = min(budget, remaining) if budget else remaining
         try:
             proc = subprocess.run(
                 [sys.executable, here] + args,

@@ -181,6 +181,12 @@ class PromApiHandler(BaseHTTPRequestHandler):
         start = _parse_time(self._q(p, "start"))
         end = _parse_time(self._q(p, "end"))
         step = _parse_step(self._q(p, "step"))
+        if step <= 0:
+            return self._send(
+                400, J.error("bad_data", "zero or negative query resolution step")
+            )
+        if end < start:
+            return self._send(400, J.error("bad_data", "end timestamp before start"))
         res = self.engine.query_range(query, start, end, step)
         if res.result_type == "scalar":
             # range query over a scalar: render as matrix of the scalar

@@ -42,6 +42,21 @@ DEFAULTS: dict = {
 }
 
 
+def apply_platform_env() -> None:
+    """Honor ``FILODB_PLATFORM`` (e.g. "cpu", "tpu"): force the JAX platform
+    BEFORE first backend init. Deployment images may preload an accelerator
+    plugin via sitecustomize that reads env vars too late and whose backend
+    init can wedge indefinitely when the device link is down — the live jax
+    config override is the only reliable defense (same as tests/conftest.py
+    and __graft_entry__.dryrun_multichip)."""
+    plat = os.environ.get("FILODB_PLATFORM")
+    if plat:
+        os.environ["JAX_PLATFORMS"] = plat
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+
+
 def load_config(path: str | None = None, overrides: dict | None = None) -> dict:
     """defaults <- file <- overrides (later wins, one level deep for dicts)."""
     cfg = json.loads(json.dumps(DEFAULTS))  # deep copy

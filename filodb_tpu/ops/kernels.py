@@ -171,14 +171,19 @@ def range_kernel(
     v_last = _gather(vals, hi - 1)
     v_first = _gather(vals, lo)
 
-    if func in ("sum_over_time",):
-        s = prefix_sum_of(vals)
+    if func in ("sum_over_time", "avg_over_time"):
+        # masked in-window reduce, NOT a prefix-sum difference: prefix sums
+        # accumulate the full history, so p[hi]-p[lo] catastrophically cancels
+        # in f32 for large-magnitude (e.g. raw counter) values; summing only
+        # in-window samples keeps the error relative to the window sum (XLA
+        # fuses the mask into the reduction — nothing [S,J,T] materializes)
+        m = _window_mask(ts, lens, out_t, window)
+        s = jnp.where(m, vals[:, None, :], 0.0).sum(-1)
+        if func == "avg_over_time":
+            s = s / count
         return jnp.where(has, s, _NAN)
     if func == "count_over_time":
         return jnp.where(has, count, _NAN)
-    if func == "avg_over_time":
-        s = prefix_sum_of(vals)
-        return jnp.where(has, s / count, _NAN)
     if func in ("last", "last_over_time"):
         return jnp.where(has, v_last, _NAN)
     if func == "first_over_time":
@@ -210,8 +215,16 @@ def range_kernel(
             return jnp.where(has, (v_last - mean) / jnp.maximum(sd, 1e-30), _NAN)
         return jnp.where(has, sd, _NAN)
     if func in ("changes", "resets"):
-        prev = jnp.concatenate([vals[:, :1], vals[:, :-1]], axis=1)
-        flag = (vals != prev) if func == "changes" else (vals < prev)
+        # MUST see raw (uncorrected) value movement: corrected counter vals
+        # are monotone, so resets() over them would always be 0 and changes()
+        # would miss every reset. Counter blocks stage f64-exact adjacent
+        # diffs (staging mode "diff" — f32 values can't preserve tiny changes
+        # next to 1e9 reset cliffs); gauges compare raw values directly.
+        if is_counter and not is_delta:
+            flag = (vals != 0) if func == "changes" else (vals < 0)
+        else:
+            prev = jnp.concatenate([raw[:, :1], raw[:, :-1]], axis=1)
+            flag = (raw != prev) if func == "changes" else (raw < prev)
         idx = jnp.arange(T, dtype=jnp.int32)[None, None, :]
         pair_in = (idx > lo[:, :, None]) & (idx < hi[:, :, None])
         n = (pair_in & flag[:, None, :]).sum(-1).astype(jnp.float32)
@@ -257,12 +270,15 @@ def range_kernel(
             is_counter=use_counter, as_rate=(func == "rate"),
         )
     if func in ("irate", "idelta"):
+        ok = (hi - lo) >= 2
+        if func == "idelta" and is_counter and not is_delta:
+            # counter idelta reads the staged f64-exact diff of the last pair
+            return jnp.where(ok, _gather(vals, hi - 1), _NAN)
         t_prev = _gather(ts, hi - 2)
         v_prev = _gather(vals, hi - 2)
-        ok = (hi - lo) >= 2
         dt_s = (t_last - t_prev).astype(jnp.float32) * 1e-3
-        # counters: corrected-value difference across a reset equals the
-        # post-reset raw reading — Prometheus reset semantics with no branch
+        # irate on counters: corrected-value difference across a reset equals
+        # the post-reset raw reading — Prometheus reset semantics, no branch
         dv = v_last - v_prev
         r = dv / jnp.maximum(dt_s, 1e-30) if func == "irate" else dv
         return jnp.where(ok, r, _NAN)
@@ -381,6 +397,56 @@ SORTED_FUNCS = {
 # ---------------------------------------------------------------------------
 
 
+def _host_timestamp(block: StagedBlock, params: RangeParams) -> np.ndarray:
+    """timestamp() computed host-side from the int32 ts array in f64.
+
+    The device grid is f32, which represents integer ms offsets exactly only
+    up to 2^24 (~4.6h); Prometheus returns exact sample timestamps, so this
+    function never goes through the f32 kernel path. Returns absolute
+    seconds [S, J_pad] f64 (NaN = no sample in window)."""
+    j_pad = pad_steps(params.num_steps)
+    out_t = (
+        np.int64(params.start_ms - block.base_ms)
+        + np.arange(j_pad, dtype=np.int64) * params.step_ms
+    )
+    lens_np = np.asarray(block.lens)
+    S = np.asarray(block.ts).shape[0]
+    out = np.full((S, j_pad), np.nan)
+
+    def row_for(ts1: np.ndarray) -> np.ndarray:
+        hi = np.searchsorted(ts1, out_t, side="right")
+        lo = np.searchsorted(ts1, out_t - params.window_ms, side="right")
+        has = hi > lo
+        t_last = ts1[np.minimum(hi - 1, len(ts1) - 1)]
+        return np.where(has, (t_last + block.base_ms) / 1e3, np.nan)
+
+    if block.regular_ts is not None and block.n_series > 0:
+        ts1 = np.asarray(block.regular_ts)[: int(lens_np[0])].astype(np.int64)
+        out[: block.n_series] = row_for(ts1)[None, :]
+        return out
+    # irregular grids: one batched searchsorted over all series via per-row
+    # offsets (rows are sorted and TS_PAD sorts after every real offset)
+    n = block.n_series
+    if n == 0:
+        return out
+    ts_np = np.asarray(block.ts)[:n].astype(np.int64)
+    T = ts_np.shape[1]
+    lens_n = lens_np[:n].astype(np.int64)
+    stride = np.int64(1) << 33  # > any int32 ms offset incl. TS_PAD
+    row_off = (np.arange(n, dtype=np.int64) * stride)[:, None]
+    flat = (ts_np + row_off).ravel()
+    hi = np.searchsorted(flat, (out_t[None, :] + row_off).ravel(), side="right")
+    lo = np.searchsorted(
+        flat, ((out_t - params.window_ms)[None, :] + row_off).ravel(), side="right"
+    )
+    hi = np.minimum(hi.reshape(n, -1) - np.arange(n)[:, None] * T, lens_n[:, None])
+    lo = np.minimum(lo.reshape(n, -1) - np.arange(n)[:, None] * T, lens_n[:, None])
+    has = hi > lo
+    t_last = np.take_along_axis(ts_np, np.maximum(hi - 1, 0), axis=1)
+    out[:n] = np.where(has, (t_last + block.base_ms) / 1e3, np.nan)
+    return out
+
+
 def run_range_function(
     func: str,
     block: StagedBlock,
@@ -393,6 +459,8 @@ def run_range_function(
     [S, J_padded]; caller slices [:n_series, :num_steps]."""
     from .mxu_kernels import MXU_FUNCS, run_mxu_range_function
 
+    if func == "timestamp":
+        return _host_timestamp(block, params)
     if (
         block.regular_ts is not None
         and func in MXU_FUNCS

@@ -225,9 +225,12 @@ def mxu_range_kernel(
             res = res / w_s
         return jnp.where((count >= 2)[None, :], res, nan)
     if func in ("irate", "idelta"):
+        ok = count >= 2
+        if func == "idelta" and is_counter and not is_delta:
+            # counter blocks arrive diff-encoded: last pair's diff via one-hot
+            return jnp.where(ok[None, :], mm(vals, L), nan)
         vl = mm(vals, L)
         vp = mm(vals, L2)
-        ok = count >= 2
         dt_s = (t_last - t_last2).astype(f32) * 1e-3
         dv = vl - vp
         r = dv / jnp.maximum(dt_s, 1e-30)[None, :] if func == "irate" else dv
@@ -291,9 +294,16 @@ def run_mxu_range_function(func, block: StagedBlock, params, is_counter=False,
     start_off = int(params.start_ms - block.base_ms)
     wm = window_matrices(block, start_off, params.step_ms, J, params.window_ms)
     if func in ("changes", "resets"):
-        vals = jnp.asarray(block.vals)
-        prev = jnp.concatenate([vals[:, :1], vals[:, :-1]], axis=1)
-        flag = (vals != prev) if func == "changes" else (vals < prev)
+        # must see raw value movement — corrected counter vals are monotone,
+        # so resets()/changes() must not read them (kernels.py has the same
+        # rule). Counter blocks arrive diff-encoded (staging mode "diff");
+        # gauges compare raw values.
+        vals = jnp.asarray(block.raw if block.raw is not None else block.vals)
+        if is_counter and not is_delta:
+            flag = (vals != 0) if func == "changes" else (vals < 0)
+        else:
+            prev = jnp.concatenate([vals[:, :1], vals[:, :-1]], axis=1)
+            flag = (vals != prev) if func == "changes" else (vals < prev)
         return mxu_pair_count(flag.astype(jnp.float32), wm.dP, wm.d_count > 0)
     if func in ("min_over_time", "max_over_time"):
         return mxu_minmax(

@@ -100,6 +100,7 @@ def stage_series(
     part_refs: list | None = None,
     subtract_baseline: bool = False,
     counter_corrected: bool = False,
+    diff_encode: bool = False,
     dtype=np.float32,
 ) -> StagedBlock:
     """Build a StagedBlock from per-series (ts_ms int64, values f64) pairs.
@@ -107,6 +108,10 @@ def stage_series(
     Drops NaN samples (staleness). Pads S and T to bucketed shapes.
     With ``counter_corrected``, values are reset-corrected in f64 first and
     raw offsets are staged alongside (see module docstring).
+    With ``diff_encode``, slot i carries the f64-exact adjacent difference
+    v[i]-v[i-1] (slot 0 = 0): changes/resets/idelta are pure functions of the
+    diff sequence, and no single f32 shift of the *values* can preserve both
+    tiny adjacent changes and a 1e9-magnitude counter-reset cliff.
     """
     n = len(series)
     cleaned: list[tuple[np.ndarray, np.ndarray]] = []
@@ -138,6 +143,9 @@ def stage_series(
             # extrapolation cap, which engages only for raw values near zero —
             # exactly where plain f32 is exact (large raws disable the cap)
             out_raw[i, :m] = vals.astype(dtype)
+        elif diff_encode:
+            v64 = vals.astype(np.float64)
+            out_vals[i, 1:m] = np.diff(v64).astype(dtype)
         elif subtract_baseline:
             b = np.float64(vals[0])
             baseline[i] = b
@@ -199,8 +207,27 @@ def stage_from_shard(
     end_ms: int,
     is_counter: bool = False,
     dtype=np.float32,
+    mode: str | None = None,
 ) -> StagedBlock:
-    """Gather [start_ms, end_ms] samples for part_ids from a shard and stage."""
+    """Gather [start_ms, end_ms] samples for part_ids from a shard and stage.
+
+    ``mode`` selects the counter staging strategy (function-driven — the
+    reference applies counter correction only inside rate-family
+    RangeFunctions, never at the read path):
+
+    - ``"corrected"`` — reset-corrected minus baseline (rate/increase/irate)
+    - ``"shifted"``   — raw minus per-series baseline, NO reset correction:
+      exact f32 for shift-invariant functions (delta/deriv/stddev...) even on
+      1e15-magnitude counters
+    - ``"diff"``      — f64-exact adjacent differences (changes/resets/idelta)
+    - ``"raw"``       — plain raw values (value-returning functions: a plain
+      selector, last/min/max/sum_over_time, quantile...)
+
+    When mode is None, is_counter=True maps to "corrected" (legacy callers
+    that only ever stage for rate-family kernels).
+    """
+    if mode is None:
+        mode = "corrected" if is_counter else "raw"
     series = []
     refs = []
     hist_width = None
@@ -213,6 +240,13 @@ def stage_from_shard(
         refs.append((shard.shard_num, int(pid)))
     if hist_width is not None:
         return stage_histogram_series(
-            series, start_ms, hist_width, refs, subtract_baseline=is_counter, dtype=dtype
+            series, start_ms, hist_width, refs,
+            subtract_baseline=mode in ("corrected", "shifted"), dtype=dtype
         )
-    return stage_series(series, start_ms, refs, counter_corrected=is_counter, dtype=dtype)
+    return stage_series(
+        series, start_ms, refs,
+        counter_corrected=mode == "corrected",
+        subtract_baseline=mode == "shifted",
+        diff_encode=mode == "diff",
+        dtype=dtype,
+    )

@@ -115,6 +115,45 @@ def apply_transformer(tr, res: QueryResult, ctx: QueryContext) -> QueryResult:
 # Leaf: select raw partitions from one shard and stage to device
 # ---------------------------------------------------------------------------
 
+# Counter staging is FUNCTION-driven (the reference applies counter correction
+# only inside rate-family RangeFunctions — RateFunctions.scala:230 — never at
+# the read path; a plain selector over a counter returns raw samples):
+#   corrected — reset-corrected minus baseline; only these functions may read it
+_CORRECTED_FNS = frozenset({"rate", "increase", "irate"})
+#   shifted — raw minus per-series baseline (no correction): shift-invariant
+#   functions get exact f32 math even on 1e15-magnitude counters
+_SHIFTED_FNS = frozenset({
+    "delta", "deriv",
+    "stddev_over_time", "stdvar_over_time", "z_score",
+    "median_absolute_deviation_over_time",
+})
+# value-independent functions (count/present/absent_over_time, timestamp)
+# deliberately fall through to "raw": they never read staged values, so they
+# share the plain-selector block and its cache entry
+#   diff — f64-exact adjacent differences: these are pure functions of the
+#   diff sequence, and no f32 shift of the values preserves both tiny
+#   adjacent changes and a 1e9-magnitude reset cliff
+_DIFF_FNS = frozenset({"changes", "resets", "idelta"})
+#   everything else (plain selector/last, min/max/sum/avg_over_time,
+#   quantile_over_time, ...) stages raw values
+
+
+def _counter_stage_mode(transformers) -> str:
+    """Pick the staging mode for a counter column from the range function the
+    leaf's PeriodicSamplesMapper will apply (default: raw selector read)."""
+    func = None
+    for tr in transformers:
+        if isinstance(tr, PeriodicSamplesMapper):
+            func = tr.function
+            break
+    if func in _CORRECTED_FNS:
+        return "corrected"
+    if func in _SHIFTED_FNS:
+        return "shifted"
+    if func in _DIFF_FNS:
+        return "diff"
+    return "raw"
+
 
 class SelectRawPartitionsExec(ExecPlan):
     """reference MultiSchemaPartitionsExec:26 + SelectRawPartitionsExec:161 —
@@ -177,11 +216,17 @@ class SelectRawPartitionsExec(ExecPlan):
             is_hist = col.ctype == ColumnType.HISTOGRAM
             is_counter = col.is_counter
             is_delta = col.is_delta
+            stage_mode = (
+                _counter_stage_mode(self.transformers)
+                if is_counter and not is_delta and not is_hist
+                else "raw"
+            )
             # staging cache: repeated queries over the same selection reuse
             # the HBM-resident decoded block until new data arrives (the
             # north-star "decoded chunk windows staged to HBM")
             cache_key = (
-                self.filters, self.start_ms, self.end_ms, col_name, schema_name, shard.version
+                self.filters, self.start_ms, self.end_ms, col_name, schema_name,
+                shard.version, stage_mode,
             )
             hit = shard.stage_cache.get(cache_key)
             if hit is not None:
@@ -189,7 +234,7 @@ class SelectRawPartitionsExec(ExecPlan):
             else:
                 block = ST.stage_from_shard(
                     shard, ids, col_name, self.start_ms, self.end_ms,
-                    is_counter=is_counter and not is_delta and not is_hist,
+                    mode=stage_mode,
                 )
                 nbytes = int(
                     block.ts.nbytes

@@ -84,10 +84,6 @@ class PeriodicSamplesMapper:
                     is_delta=rg.is_delta,
                     args=self.args,
                 )
-                if func == "timestamp":
-                    # kernel returns ms offsets; add base and convert to s
-                    v = np.asarray(vals).astype(np.float64)
-                    vals = (v + rg.block.base_ms) / 1e3 + np.where(np.isnan(v), np.nan, 0.0)
                 g = Grid(
                     labels=list(rg.labels),
                     start_ms=self.start_ms,

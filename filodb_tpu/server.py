@@ -191,6 +191,9 @@ class FiloServer:
 def main(argv=None):
     import argparse
 
+    from .config import apply_platform_env
+
+    apply_platform_env()
     p = argparse.ArgumentParser("filodb-tpu-server")
     p.add_argument("--config", help="JSON config file")
     p.add_argument("--port", type=int, default=None)
