@@ -79,12 +79,9 @@ class ShardMapper:
         queryShardsFromShardKey)."""
         if shard_key_hash is None or spread is None:
             return self.active_shards()
-        from ..core.schemas import ingestion_shard
+        from ..core.schemas import shard_group
 
-        mask = (1 << spread) - 1
-        cands = {
-            ingestion_shard(shard_key_hash, low, spread, self.num_shards) for low in range(mask + 1)
-        }
+        cands = shard_group(shard_key_hash, spread, self.num_shards)
         return sorted(s for s in cands if self._status[s] in QUERYABLE)
 
 

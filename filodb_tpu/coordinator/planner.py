@@ -99,6 +99,11 @@ class PlannerParams:
     # optional lpopt AggRuleProvider: sum-by queries rewrite onto maintained
     # :agg series before planning
     agg_rules: object | None = None
+    # total shards in the CLUSTER (the ingest-routing modulus). None = the
+    # memstore owns the whole cluster and the modulus is inferred from it;
+    # multi-node deployments MUST set this from the ShardMapper so query-side
+    # pruning enumerates the same shard group ingest routing used.
+    num_shards: int | None = None
 
 
 class SingleClusterPlanner:
@@ -112,10 +117,65 @@ class SingleClusterPlanner:
         self._shards = shard_nums
 
     def shards_for(self, filters) -> list[int]:
-        # With shard-key equality filters we could prune to 2^spread shards
-        # (reference shardsFromFilters); scanning all owned shards is always
-        # correct and the per-shard index makes misses cheap.
-        return list(self._shards) if self._shards is not None else self.memstore.shard_nums(self.dataset)
+        """Shard fan-out for a selector (reference shardsFromFilters,
+        SingleClusterPlanner.scala:424): when every shard-key column is
+        constrained by equality filters, only the ``2^spread`` shards the
+        ingest router can place those series on are queried; otherwise all
+        owned shards are scanned. Pruning with planner spread >= ingest
+        spread is always a superset of the shards holding the data (the low
+        ``spread`` bits range over the whole group), so a too-large spread is
+        safe; configs must never shrink spread below what ingest used."""
+        owned = list(self._shards) if self._shards is not None else self.memstore.shard_nums(self.dataset)
+        if not filters:
+            return owned
+        num_shards = self.params.num_shards
+        if num_shards is None:
+            all_nums = self.memstore.shard_nums(self.dataset)
+            if not all_nums:
+                return owned
+            num_shards = max(all_nums) + 1
+        cand = self._shards_from_filters(filters, num_shards)
+        if cand is None:
+            return owned
+        owned_set = set(owned)
+        return [s for s in cand if s in owned_set]
+
+    _MAX_SHARDKEY_COMBOS = 64
+
+    def _shards_from_filters(self, filters, num_shards: int) -> list[int] | None:
+        """Candidate shards from shard-key equality filters, or None when the
+        filters don't pin every shard-key column (scan-all). Matches the
+        ingest-side routing exactly: the shard-key hash fixes the high bits,
+        the low ``spread`` bits range over the full 2^spread group."""
+        import itertools
+
+        from ..core.schemas import (
+            METRIC_TAG, PROM_METRIC_TAG, SHARD_KEY_TAGS, shard_group, shardkey_hash,
+        )
+
+        eq: dict[str, set[str]] = {}
+        for f in filters:
+            col = METRIC_TAG if f.column == PROM_METRIC_TAG else f.column
+            if f.op == "=":
+                eq.setdefault(col, set()).add(f.value)
+            elif f.op == "in":
+                eq.setdefault(col, set()).update(f.value)
+        keysets = []
+        for c in SHARD_KEY_TAGS:
+            vals = eq.get(c)
+            if not vals:
+                return None
+            keysets.append(sorted(vals))
+        n_combos = 1
+        for ks in keysets:
+            n_combos *= len(ks)
+        if n_combos > self._MAX_SHARDKEY_COMBOS:
+            return None
+        shards: set[int] = set()
+        for combo in itertools.product(*keysets):
+            skh = shardkey_hash(dict(zip(SHARD_KEY_TAGS, combo)))
+            shards |= shard_group(skh, self.params.spread, num_shards)
+        return sorted(shards)
 
     # -- entry -----------------------------------------------------------
 
@@ -123,9 +183,9 @@ class SingleClusterPlanner:
         m = self._materialize
         return m(plan)
 
-    def _fanout(self, make_leaf, transformers) -> ExecPlan:
+    def _fanout(self, make_leaf, transformers, filters=None) -> ExecPlan:
         leaves = []
-        for s in self.shards_for(None):
+        for s in self.shards_for(filters):
             leaf = make_leaf(s)
             leaf.transformers.extend(transformers)
             leaves.append(leaf)
@@ -144,6 +204,7 @@ class SingleClusterPlanner:
             return self._fanout(
                 lambda s: SelectRawPartitionsExec(s, raw.filters, raw.start_ms, raw.end_ms, raw.column),
                 [mapper],
+                filters=raw.filters,
             )
         if isinstance(p, L.PeriodicSeriesWithWindowing):
             ts_plan = self._try_time_shard(p)
@@ -157,10 +218,12 @@ class SingleClusterPlanner:
             return self._fanout(
                 lambda s: SelectRawPartitionsExec(s, raw.filters, raw.start_ms, raw.end_ms, raw.column),
                 [mapper],
+                filters=raw.filters,
             )
         if isinstance(p, L.RawSeries):
             return self._fanout(
-                lambda s: RawChunkExportExec(s, p.filters, p.start_ms, p.end_ms, p.column), []
+                lambda s: RawChunkExportExec(s, p.filters, p.start_ms, p.end_ms, p.column), [],
+                filters=p.filters,
             )
         if isinstance(p, L.Aggregate):
             return self._materialize_aggregate(p)
@@ -207,7 +270,7 @@ class SingleClusterPlanner:
                 raw = leaves[0]
                 plans = [
                     ChunkMetaExec(s, raw.filters, raw.start_ms, raw.end_ms)
-                    for s in self.shards_for(None)
+                    for s in self.shards_for(raw.filters)
                 ]
                 return plans[0] if len(plans) == 1 else DistConcatExec(plans)
             inner = self._materialize(p.inner)
@@ -286,7 +349,8 @@ class SingleClusterPlanner:
         ):
             return None
         # histograms stay on the standard path (plan-time schema peek)
-        for s in self.shards_for(None):
+        shards = self.shards_for(p.raw.filters)
+        for s in shards:
             pids = self.memstore.shard(self.dataset, s).lookup_partitions(
                 p.raw.filters, p.raw.start_ms, p.raw.end_ms, limit=1
             )
@@ -297,7 +361,7 @@ class SingleClusterPlanner:
                 break
         is_counter = p.function in ("rate", "increase", "irate")
         return TimeShardRangeExec(
-            mesh, self.shards_for(None), p.raw.filters, p.raw.start_ms, p.raw.end_ms,
+            mesh, shards, p.raw.filters, p.raw.start_ms, p.raw.end_ms,
             p.function, p.start_ms, p.end_ms, p.step_ms, p.window_ms,
             is_counter=is_counter,
         )
@@ -324,7 +388,7 @@ class SingleClusterPlanner:
             or inner.function_args
         ):
             return None
-        shards = self.shards_for(None)
+        shards = self.shards_for(inner.raw.filters)
         # counter-ness resolved at execution from schemas; assume cumulative
         # counter when the function is the counter family
         is_counter = inner.function in ("rate", "increase", "irate")

@@ -257,3 +257,14 @@ def ingestion_shard(shard_key_hash: int, part_key_hash: int, spread: int, num_sh
 
 def shard_for(tags: Mapping[str, str], spread: int, num_shards: int) -> int:
     return ingestion_shard(shardkey_hash(tags), partkey_hash(tags), spread, num_shards)
+
+
+def shard_group(shard_key_hash: int, spread: int, num_shards: int) -> set[int]:
+    """All shards a given shard-key hash can route to: the low ``spread`` bits
+    range over the full 2^spread group (reference queryShardsFromShardKey).
+    The single source of truth for query-side pruning — must stay the exact
+    image of ``ingestion_shard`` over all partition hashes."""
+    return {
+        ingestion_shard(shard_key_hash, low, spread, num_shards)
+        for low in range(1 << spread)
+    }

@@ -1,0 +1,95 @@
+"""Query-side shard pruning by shard-key hash + spread (reference
+SingleClusterPlanner.scala:424 shardsFromFilters): a selector carrying
+equality filters on every shard-key column fans out to only the 2^spread
+shards ingest routing can have placed it on."""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.coordinator.planner import PlannerParams, QueryEngine, SingleClusterPlanner
+from filodb_tpu.core.schemas import Dataset, shard_for
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.query.promql import query_range_to_logical_plan
+from filodb_tpu.testkit import counter_batch
+
+N_SHARDS = 128
+SPREAD = 3
+BASE = 1_600_000_000_000
+Q = 'sum(rate(http_requests_total{_ws_="demo",_ns_="App-2"}[5m]))'
+
+
+@pytest.fixture(scope="module")
+def ms():
+    m = TimeSeriesMemStore()
+    m.setup(Dataset("prometheus"), range(N_SHARDS))
+    m.ingest_routed("prometheus", counter_batch(n_series=64, n_samples=60, start_ms=BASE), spread=SPREAD)
+    return m
+
+
+def _materialize(ms, q):
+    pl = SingleClusterPlanner(ms, "prometheus", params=PlannerParams(spread=SPREAD))
+    start = (BASE + 400_000) / 1000
+    end = (BASE + 580_000) / 1000
+    return pl, pl.materialize(query_range_to_logical_plan(q, start, end, 60))
+
+
+def test_shardkey_filters_prune_to_2_pow_spread(ms):
+    _, ep = _materialize(ms, Q)
+    tree = ep.print_tree()
+    n_leaves = tree.count("SelectRawPartitionsExec")
+    assert 1 <= n_leaves <= 2**SPREAD, tree
+    assert n_leaves < N_SHARDS
+
+
+def test_pruned_shards_cover_ingest_routing(ms):
+    """The pruned set is exactly a superset of where ingest put the series."""
+    pl, _ = _materialize(ms, Q)
+    from filodb_tpu.core.filters import equals
+
+    filters = [equals("_metric_", "http_requests_total"), equals("_ws_", "demo"), equals("_ns_", "App-2")]
+    pruned = set(pl.shards_for(filters))
+    for i in range(64):
+        tags = {"_metric_": "http_requests_total", "_ws_": "demo", "_ns_": "App-2",
+                "instance": f"host-{i}", "job": "api"}
+        assert shard_for(tags, SPREAD, N_SHARDS) in pruned
+
+
+def test_pruned_result_matches_scan_all(ms):
+    """Engine result parity: pruned fan-out == scan-all fan-out."""
+    eng = QueryEngine(ms, "prometheus", PlannerParams(spread=SPREAD))
+    start = (BASE + 400_000) / 1000
+    end = (BASE + 580_000) / 1000
+    res_pruned = eng.query_range(Q, start, end, 60)
+    # un-keyed query scans everything (no _ws_/_ns_ filters -> no pruning)
+    res_all = eng.query_range("sum(rate(http_requests_total[5m]))", start, end, 60)
+    a = res_pruned.grids[0].values_np()
+    b = res_all.grids[0].values_np()
+    np.testing.assert_allclose(a, b, rtol=1e-6)
+    assert np.isfinite(a).any()
+
+
+def test_missing_shardkey_filter_scans_all(ms):
+    _, ep = _materialize(ms, "sum(rate(http_requests_total[5m]))")
+    assert ep.print_tree().count("SelectRawPartitionsExec") == N_SHARDS
+
+
+def test_regex_on_shardkey_scans_all(ms):
+    _, ep = _materialize(ms, 'sum(rate(http_requests_total{_ws_=~"de.*",_ns_="App-2"}[5m]))')
+    assert ep.print_tree().count("SelectRawPartitionsExec") == N_SHARDS
+
+
+def test_mesh_path_packs_only_pruned_shards(ms):
+    """VERDICT done-criterion: the mesh path packs only the pruned shards."""
+    import jax
+
+    pl = SingleClusterPlanner(
+        ms, "prometheus",
+        params=PlannerParams(spread=SPREAD, mesh=__import__("filodb_tpu.parallel.mesh", fromlist=["make_mesh"]).make_mesh(jax.devices("cpu")[:1])),
+    )
+    start = (BASE + 400_000) / 1000
+    end = (BASE + 580_000) / 1000
+    ep = pl.materialize(query_range_to_logical_plan(Q, start, end, 60))
+    from filodb_tpu.parallel.exec import MeshAggregateExec
+
+    assert isinstance(ep, MeshAggregateExec)
+    assert len(ep.shard_nums) <= 2**SPREAD
