@@ -82,6 +82,13 @@ class TimeSeriesShard:
         # OnDemandPagingShard.scala:26 + DemandPagedChunkStore)
         self.odp_store = None
         self.odp_stats_pages = 0
+        # index time-lifecycle state (reference TimeSeriesShard.scala:987-993
+        # updateIndexWithEndTime): part ids currently marked "ended" in the
+        # index, and the latest-sample watermark seen at the previous flush —
+        # a partition whose watermark is unchanged across a flush cycle has
+        # stopped ingesting and gets a real end time in the index.
+        self._ended: set[int] = set()
+        self._flush_watermark: dict[int, int] = {}
 
     def _make_index(self) -> PartKeyIndex:
         if self.config.index_backend == "native":
@@ -120,7 +127,15 @@ class TimeSeriesShard:
         pk = sb.partkey
         pid = self._by_partkey.get(pk)
         if pid is None:
-            pid = self._create_partition(sb.tags, sb.schema, pk, sb.bucket_les)
+            pid = self._create_partition(
+                sb.tags, sb.schema, pk, sb.bucket_les,
+                start_ts=int(sb.timestamps.min()) if len(sb.timestamps) else 0,
+            )
+        elif pid in self._ended:
+            # series resumed ingesting: back to the "still ingesting" sentinel
+            # (reference re-activation in getOrAddPartitionAndIngest)
+            self.index.update_end_time(pid, 2**62)
+            self._ended.discard(pid)
         part = self.partitions[pid]
         # enforce time order within the run
         ts = sb.timestamps
@@ -133,9 +148,13 @@ class TimeSeriesShard:
         return got
 
     def _create_partition(
-        self, tags: Mapping[str, str], schema: Schema, pk: bytes, bucket_les=None
+        self, tags: Mapping[str, str], schema: Schema, pk: bytes, bucket_les=None,
+        start_ts: int = 0, end_ts: int = 2**62,
     ) -> int:
-        """reference createNewPartition:1193 + index addPartKey + cardinality."""
+        """reference createNewPartition:1193 + index addPartKey + cardinality.
+        ``start_ts`` is the real first-sample time (reference passes the ingest
+        record's timestamp to addPartKey); ``end_ts`` defaults to the
+        still-ingesting sentinel."""
         if len(self.partitions) >= self.config.max_partitions:
             raise MemoryError(f"shard {self.shard_num}: partition limit reached")
         # quota enforcement happens BEFORE any state mutates (reference
@@ -154,7 +173,9 @@ class TimeSeriesShard:
         )
         self.partitions[pid] = part
         self._by_partkey[pk] = pid
-        self.index.add_partkey(pid, dict(tags), start_ts=0)
+        self.index.add_partkey(pid, dict(tags), start_ts=start_ts, end_ts=end_ts)
+        if end_ts < 2**62:
+            self._ended.add(pid)
         self.stats.partitions_created += 1
         return pid
 
@@ -199,6 +220,29 @@ class TimeSeriesShard:
                     out.append((part, chunks))
         return out
 
+    def update_index_end_times(self) -> int:
+        """Mark partitions that stopped ingesting with a real end time in the
+        index (reference updateIndexWithEndTime, TimeSeriesShard.scala:987-993
+        + PartKeyLuceneIndex.updatePartKeyWithEndTime:628). Called once per
+        flush cycle: a partition whose latest-sample watermark is unchanged
+        since the previous flush is no longer ingesting. Returns the number of
+        partitions newly marked ended."""
+        n = 0
+        with self._lock:
+            for pid, part in self.partitions.items():
+                if pid in self._ended:
+                    continue
+                latest = part.latest_ts()
+                if latest <= -(2**61):
+                    continue  # never ingested
+                if self._flush_watermark.get(pid) == latest:
+                    self.index.update_end_time(pid, latest)
+                    self._ended.add(pid)
+                    n += 1
+                else:
+                    self._flush_watermark[pid] = latest
+        return n
+
     def evict_for_retention(self, now_ms: int | None = None) -> int:
         """Drop chunks older than retention; remove fully-empty partitions
         (reference evictPartitions:1709)."""
@@ -216,6 +260,8 @@ class TimeSeriesShard:
                 self._by_partkey.pop(part.partkey, None)
                 self.index.remove([pid])
                 self.cardinality.series_removed(part.tags)
+                self._ended.discard(pid)
+                self._flush_watermark.pop(pid, None)
                 self.stats.partitions_evicted += 1
         return dropped
 
@@ -227,7 +273,7 @@ class TimeSeriesShard:
         if self.odp_store is None:
             return 0
         from ..core.encodings import decode
-        from ..core.schemas import SCHEMAS, canonical_partkey
+        from ..core.schemas import canonical_partkey
 
         need: dict[bytes, TimeSeriesPartition] = {}
         for pid in part_ids:
@@ -238,12 +284,15 @@ class TimeSeriesShard:
             return 0
         n = 0
         with self._lock:
-            for header, schema_name, encs in self.odp_store.read_chunks(self.dataset, self.shard_num):
+            # manifest-seek read: only frames of the NEEDED partitions in the
+            # queried range are touched (reference OnDemandPagingShard:147 —
+            # bytes read scale with the query, not the store)
+            for header, schema_name, encs in self.odp_store.read_chunks_selective(
+                self.dataset, self.shard_num, list(need.keys()), start_ms, end_ms
+            ):
                 pk = canonical_partkey(header["tags"])
                 part = need.get(pk)
                 if part is None:
-                    continue
-                if header["end"] < start_ms or header["start"] > end_ms:
                     continue
                 if any(c.start_ts == header["start"] for c in part.chunks):
                     continue  # already resident

@@ -15,6 +15,7 @@ Layout under root/:
 
 from __future__ import annotations
 
+import io
 import json
 import os
 import struct
@@ -52,6 +53,22 @@ class ColumnStore:
     def read_chunks(self, dataset, shard) -> Iterable[tuple[dict, str, list[dict]]]:
         raise NotImplementedError
 
+    def read_chunks_selective(
+        self, dataset, shard, partkeys, start_ms: int, end_ms: int
+    ) -> Iterable[tuple[dict, str, list]]:
+        """Read only chunk sets belonging to ``partkeys`` (canonical partkey
+        bytes) overlapping [start_ms, end_ms] (reference readRawPartitions:774
+        reads per-partition row ranges, not the whole table). Default: filter
+        over the full scan; backends with a manifest seek directly."""
+        from ..core.schemas import canonical_partkey
+
+        want = set(partkeys)
+        for header, schema_name, encs in self.read_chunks(dataset, shard):
+            if header["end"] < start_ms or header["start"] > end_ms:
+                continue
+            if canonical_partkey(header["tags"]) in want:
+                yield header, schema_name, encs
+
 
 class NullColumnStore(ColumnStore):
     """In-memory no-op sink so shards and queries run without persistence
@@ -88,10 +105,50 @@ class NullColumnStore(ColumnStore):
 FORMAT_VERSION = 1
 
 
+def _iter_frames(f, decode_payloads: bool = True):
+    """THE segment-frame parser (single source of truth for the on-disk frame
+    layout). Yields ``(offset, length, header, encs)`` for each complete frame
+    from the file's current position; ``encs`` is None when
+    ``decode_payloads`` is False. Stops cleanly at the first torn or corrupt
+    frame (reference torn-write tolerance)."""
+    while True:
+        off = f.tell()
+        try:
+            frame = f.read(_FRAME.size)
+            if len(frame) < _FRAME.size:
+                return
+            _, _schema_id, n_cols = _FRAME.unpack(frame)
+            hdr_len_raw = f.read(4)
+            if len(hdr_len_raw) < 4:
+                return
+            (hlen,) = struct.unpack("<I", hdr_len_raw)
+            hdr_raw = f.read(hlen)
+            if len(hdr_raw) < hlen:
+                return
+            header = json.loads(hdr_raw)
+            encs = [] if decode_payloads else None
+            for _ in range(n_cols):
+                plen_raw = f.read(4)
+                if len(plen_raw) < 4:
+                    return
+                (plen,) = struct.unpack("<I", plen_raw)
+                payload = f.read(plen)
+                if len(payload) < plen:
+                    return
+                if decode_payloads:
+                    encs.append(Encoded.from_bytes(payload))
+        except (json.JSONDecodeError, struct.error, ValueError, KeyError):
+            return
+        yield off, f.tell() - off, header, encs
+
+
 class LocalColumnStore(ColumnStore):
     def __init__(self, root: str):
         self.root = root
         self._lock = threading.Lock()
+        # selective-read instrumentation + cached parsed manifests
+        self.stats_selective_bytes = 0
+        self._manifest_cache: dict[tuple[str, int], tuple[float, int, list]] = {}
         os.makedirs(root, exist_ok=True)
         # store format versioning (refuse to misread future layouts)
         vpath = os.path.join(root, "FORMAT")
@@ -116,9 +173,35 @@ class LocalColumnStore(ColumnStore):
     def write_chunks(self, dataset, shard, group, part_id, partkey_tags, schema: Schema,
                      chunks: Sequence[Chunk]):
         """Append framed encoded chunk sets (reference
-        CassandraColumnStore.write:207)."""
-        path = os.path.join(self._shard_dir(dataset, shard), f"chunks-g{group}.seg")
-        with self._lock, open(path, "ab") as f:
+        CassandraColumnStore.write:207). Each frame's (partkey-hash, segment,
+        byte offset/length, time range) is journaled to the shard manifest so
+        selective ODP reads can seek straight to the needed frames (the
+        reference's per-partition Cassandra row keys play this role). Manifest
+        lines are written after their frames in program order, but OS flush
+        ordering is not guaranteed — the selective reader therefore treats
+        every entry as untrusted and skips frames that fail to parse."""
+        from ..core.schemas import canonical_partkey, hash64
+
+        seg = f"chunks-g{group}.seg"
+        path = os.path.join(self._shard_dir(dataset, shard), seg)
+        mpath = os.path.join(self._shard_dir(dataset, shard), "manifest.jsonl")
+        pk_hex = f"{hash64(canonical_partkey(partkey_tags)):016x}"
+        with self._lock:
+            # upgrading a pre-manifest shard: backfill the manifest from the
+            # existing segments ONCE, or selective reads would silently hide
+            # every chunk written before the upgrade
+            if not os.path.exists(mpath) and any(
+                fn.startswith("chunks-") for fn in os.listdir(self._shard_dir(dataset, shard))
+            ):
+                self._backfill_manifest(dataset, shard, mpath)
+        with self._lock, open(path, "ab") as f, open(mpath, "ab") as mf:
+            # a torn final line without newline would merge with our first
+            # append and corrupt ONE entry; start clean instead
+            if mf.tell() > 0:
+                with open(mpath, "rb") as chk:
+                    chk.seek(-1, os.SEEK_END)
+                    if chk.read(1) != b"\n":
+                        mf.write(b"\n")
             for c in chunks:
                 enc = c.ensure_encoded(schema)
                 header = {
@@ -131,12 +214,40 @@ class LocalColumnStore(ColumnStore):
                 }
                 hdr = json.dumps(header).encode()
                 payloads = [e.to_bytes() for e in enc.values()]
+                off = f.tell()
                 f.write(_FRAME.pack(len(hdr), schema.schema_id, len(payloads)))
                 f.write(struct.pack("<I", len(hdr)))
                 f.write(hdr)
                 for p in payloads:
                     f.write(struct.pack("<I", len(p)))
                     f.write(p)
+                mf.write((json.dumps({
+                    "pk": pk_hex, "seg": seg, "off": off, "len": f.tell() - off,
+                    "start": c.start_ts, "end": c.end_ts,
+                }) + "\n").encode())
+            self._manifest_cache.pop((dataset, shard), None)
+
+    def _backfill_manifest(self, dataset, shard, mpath):
+        """One-time manifest build for a shard written before manifests
+        existed: scan every segment frame, recording offsets. Written to a
+        temp file then renamed so a crash mid-backfill retries cleanly."""
+        from ..core.schemas import canonical_partkey, hash64
+
+        d = os.path.dirname(mpath)
+        tmp = mpath + ".tmp"
+        with open(tmp, "w") as mf:
+            for fn in sorted(os.listdir(d)):
+                if not fn.startswith("chunks-"):
+                    continue
+                with open(os.path.join(d, fn), "rb") as f:
+                    for off, length, header, _ in _iter_frames(f, decode_payloads=False):
+                        pk_hex = f"{hash64(canonical_partkey(header['tags'])):016x}"
+                        mf.write(json.dumps({
+                            "pk": pk_hex, "seg": fn, "off": off, "len": length,
+                            "start": header["start"], "end": header["end"],
+                        }) + "\n")
+        os.replace(tmp, mpath)
+        self._manifest_cache.pop((dataset, shard), None)
 
     def write_partkey(self, dataset, shard, tags, start_ts, end_ts):
         path = os.path.join(self._shard_dir(dataset, shard), "partkeys.jsonl")
@@ -199,35 +310,68 @@ class LocalColumnStore(ColumnStore):
             if not fn.startswith("chunks-"):
                 continue
             with open(os.path.join(d, fn), "rb") as f:
-                while True:
-                    try:
-                        frame = f.read(_FRAME.size)
-                        if len(frame) < _FRAME.size:
-                            break
-                        _, schema_id, n_cols = _FRAME.unpack(frame)
-                        hdr_len_raw = f.read(4)
-                        if len(hdr_len_raw) < 4:
-                            break
-                        (hlen,) = struct.unpack("<I", hdr_len_raw)
-                        hdr_raw = f.read(hlen)
-                        if len(hdr_raw) < hlen:
-                            break
-                        header = json.loads(hdr_raw)
-                        encs = []
-                        torn = False
-                        for _ in range(n_cols):
-                            plen_raw = f.read(4)
-                            if len(plen_raw) < 4:
-                                torn = True
-                                break
-                            (plen,) = struct.unpack("<I", plen_raw)
-                            payload = f.read(plen)
-                            if len(payload) < plen:
-                                torn = True
-                                break
-                            encs.append(Encoded.from_bytes(payload))
-                        if torn:
-                            break
-                    except (json.JSONDecodeError, struct.error, ValueError):
-                        break  # corrupted frame: stop this segment
+                for _off, _len, header, encs in _iter_frames(f):
+                    yield header, header["schema"], encs
+
+    def _manifest(self, dataset, shard) -> list[dict] | None:
+        """Parsed manifest entries for a shard, cached by (mtime, size).
+        None when the shard predates manifests (callers full-scan)."""
+        mpath = os.path.join(self.root, dataset, f"shard-{shard}", "manifest.jsonl")
+        if not os.path.exists(mpath):
+            return None
+        st = os.stat(mpath)
+        key = (dataset, shard)
+        cached = self._manifest_cache.get(key)
+        if cached is not None and cached[0] == st.st_mtime and cached[1] == st.st_size:
+            return cached[2]
+        entries = []
+        with open(mpath) as f:
+            for line in f:
+                try:
+                    entries.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue  # torn/merged line: later appends must stay visible
+        self._manifest_cache[key] = (st.st_mtime, st.st_size, entries)
+        return entries
+
+    def read_chunks_selective(self, dataset, shard, partkeys, start_ms, end_ms):
+        """Manifest-seek read: only frames of the requested partkeys
+        overlapping the time range are read and decoded (reference
+        OnDemandPagingShard.scala:147 + readRawPartitions:774 read only the
+        needed partitions/rows). Falls back to the filtering full scan for
+        pre-manifest stores."""
+        from ..core.schemas import canonical_partkey, hash64
+
+        entries = self._manifest(dataset, shard)
+        if entries is None:
+            yield from super().read_chunks_selective(dataset, shard, partkeys, start_ms, end_ms)
+            return
+        want = {f"{hash64(pk):016x}" for pk in partkeys}
+        pk_bytes = set(partkeys)
+        by_seg: dict[str, list[dict]] = {}
+        for e in entries:
+            if e["pk"] in want and e["end"] >= start_ms and e["start"] <= end_ms:
+                by_seg.setdefault(e["seg"], []).append(e)
+        d = os.path.join(self.root, dataset, f"shard-{shard}")
+        for seg, hits in sorted(by_seg.items()):
+            hits.sort(key=lambda e: e["off"])
+            with open(os.path.join(d, seg), "rb") as f:
+                for e in hits:
+                    f.seek(e["off"])
+                    raw = f.read(e["len"])
+                    if len(raw) < e["len"]:
+                        continue  # torn frame
+                    self.stats_selective_bytes += len(raw)
+                    # a stale manifest entry (manifest durable, frame torn,
+                    # then overwritten by a later append) yields garbage here
+                    # — _iter_frames stops without yielding and we skip it,
+                    # like the full-scan reader does
+                    got = next(_iter_frames(io.BytesIO(raw)), None)
+                    if got is None:
+                        continue
+                    _, _, header, encs = got
+                    # 64-bit hash collisions are ~impossible at TSDB scale but
+                    # cheap to exclude exactly
+                    if canonical_partkey(header["tags"]) not in pk_bytes:
+                        continue
                     yield header, header["schema"], encs

@@ -63,6 +63,10 @@ class FlushCoordinator:
             # commitCheckpoint ordering guarantees replay covers data loss)
             self.store.write_checkpoint(dataset, shard_num, group, offset)
             res.groups_flushed += 1
+        # index time lifecycle: partitions that stopped ingesting get a real
+        # end time so time-filtered lookups prune them (reference
+        # updateIndexWithEndTime inside the flush path)
+        shard.update_index_end_times()
         if self.preagg is not None and self.preagg.dataset == dataset:
             self.preagg.emit(shard_num)
         return res
@@ -88,10 +92,16 @@ def recover_shard(memstore, store: ColumnStore, dataset: str, shard_num: int) ->
 
         pk = canonical_partkey(tags)
         if pk not in shard._by_partkey:
-            # schema resolved when chunks arrive; default gauge until then
+            # schema resolved when chunks arrive; default gauge until then.
+            # Index with the persisted start/end times (reference
+            # bootstrapPartKey:797 carries the partkey table's time range);
+            # a resumed ingest reactivates the end-time sentinel.
             from ..core.schemas import GAUGE
 
-            shard._create_partition(tags, GAUGE, pk)
+            shard._create_partition(
+                tags, GAUGE, pk,
+                start_ts=int(rec.get("start", 0)), end_ts=int(rec.get("end", 2**62)),
+            )
     # 2. chunks -> partitions (decoded on load; re-encode happens on flush)
     from ..core.encodings import decode
 
@@ -103,7 +113,7 @@ def recover_shard(memstore, store: ColumnStore, dataset: str, shard_num: int) ->
         schema = SCHEMAS[schema_name]
         pid = shard._by_partkey.get(pk)
         if pid is None:
-            pid = shard._create_partition(tags, schema, pk)
+            pid = shard._create_partition(tags, schema, pk, start_ts=int(header["start"]))
         part = shard.partitions[pid]
         part.schema = schema
         arrays = {}

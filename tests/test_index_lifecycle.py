@@ -1,0 +1,118 @@
+"""Index time-lifecycle driven through SHARD INGEST (not the index directly)
+— the gap the round-1 fuzz tests missed. Reference behavior:
+TimeSeriesShard.scala:987-993 (updateIndexWithEndTime during flush) +
+PartKeyLuceneIndex.scala:628 (updatePartKeyWithEndTime) + re-activation on
+resumed ingest in getOrAddPartitionAndIngest."""
+
+import numpy as np
+
+from filodb_tpu.core.filters import equals
+from filodb_tpu.core.records import SeriesBatch
+from filodb_tpu.core.schemas import GAUGE, METRIC_TAG, Dataset
+from filodb_tpu.coordinator.planner import QueryEngine
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.store.columnstore import NullColumnStore
+from filodb_tpu.store.flush import FlushCoordinator
+
+BASE = 1_600_000_000_000
+
+
+def _gauge(tags, ts):
+    return SeriesBatch(GAUGE, tags, ts, {"value": np.linspace(1.0, 2.0, len(ts))})
+
+
+def _setup(n_live=3, n_dead=2):
+    """n_dead series stop at BASE+600s; n_live keep ingesting past BASE+1200s."""
+    ms = TimeSeriesMemStore()
+    ms.setup(Dataset("ds"), [0])
+    sh = ms.shard("ds", 0)
+    early = BASE + np.arange(60, dtype=np.int64) * 10_000        # BASE .. BASE+590s
+    late = BASE + 600_000 + np.arange(60, dtype=np.int64) * 10_000
+    for i in range(n_dead):
+        sh.ingest_series(_gauge({METRIC_TAG: "m", "instance": f"dead-{i}"}, early))
+    for i in range(n_live):
+        sh.ingest_series(_gauge({METRIC_TAG: "m", "instance": f"live-{i}"}, early))
+    return ms, sh, late
+
+
+def _flush(ms):
+    return FlushCoordinator(ms, NullColumnStore()).flush_shard("ds", 0)
+
+
+class TestStartTimeFromIngest:
+    def test_real_start_time_indexed(self):
+        ms, sh, _ = _setup()
+        f = [equals(METRIC_TAG, "m")]
+        # query entirely BEFORE the first sample: index must prune everything
+        assert len(sh.lookup_partitions(f, BASE - 1_000_000, BASE - 1)) == 0
+        # overlapping range still finds all 5
+        assert len(sh.lookup_partitions(f, BASE, BASE + 600_000)) == 5
+
+
+class TestEndTimeLifecycle:
+    def test_end_times_set_after_idle_flush_cycle(self):
+        ms, sh, late = _setup()
+        f = [equals(METRIC_TAG, "m")]
+        _flush(ms)  # first flush: records watermark, nothing marked ended
+        assert len(sh.lookup_partitions(f, BASE + 700_000, BASE + 800_000)) == 5
+        # live series keep ingesting; dead ones do not
+        for i in range(3):
+            sh.ingest_series(_gauge({METRIC_TAG: "m", "instance": f"live-{i}"}, late))
+        _flush(ms)  # watermark unchanged for dead series -> end time set
+        pids = sh.lookup_partitions(f, BASE + 700_000, BASE + 1_300_000)
+        assert len(pids) == 3
+        tags = {sh.index.tags_of(int(p))["instance"] for p in pids}
+        assert tags == {"live-0", "live-1", "live-2"}
+        # range overlapping the dead series' lifetime still matches all 5
+        assert len(sh.lookup_partitions(f, BASE, BASE + 300_000)) == 5
+
+    def test_resumed_ingest_reactivates(self):
+        ms, sh, late = _setup(n_live=1, n_dead=1)
+        _flush(ms)
+        _flush(ms)  # both idle now -> both marked ended
+        f = [equals(METRIC_TAG, "m")]
+        assert len(sh.lookup_partitions(f, BASE + 700_000, BASE + 1_300_000)) == 0
+        # dead-0 resumes: end time snaps back to the still-ingesting sentinel
+        sh.ingest_series(_gauge({METRIC_TAG: "m", "instance": "dead-0"}, late))
+        pids = sh.lookup_partitions(f, BASE + 2_000_000, BASE + 3_000_000)
+        assert len(pids) == 1
+        assert sh.index.tags_of(int(pids[0]))["instance"] == "dead-0"
+
+    def test_engine_query_outside_live_range_selects_zero(self):
+        """VERDICT done-criterion: a query outside a series' live range selects
+        0 series THROUGH THE ENGINE."""
+        ms, sh, late = _setup(n_live=1, n_dead=2)
+        for _ in range(2):
+            _flush(ms)
+        # only live-0 resumed past BASE+600s
+        sh.ingest_series(_gauge({METRIC_TAG: "m", "instance": "live-0"}, late))
+        eng = QueryEngine(ms, "ds")
+        # window starting 400s after the dead series ended (lookback 5m cannot
+        # reach their last samples)
+        start_s = (BASE + 1_000_000) / 1000
+        end_s = (BASE + 1_180_000) / 1000
+        res = eng.query_range("m", start_s, end_s, 60)
+        insts = {lbl.get("instance") for g in res.grids for lbl in g.labels}
+        assert insts == {"live-0"}
+
+    def test_recovery_restores_end_times(self):
+        from filodb_tpu.store.columnstore import LocalColumnStore
+        from filodb_tpu.store.flush import recover_shard
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as d:
+            store = LocalColumnStore(d)
+            ms, sh, late = _setup(n_live=1, n_dead=1)
+            fc = FlushCoordinator(ms, store)
+            fc.flush_shard("ds", 0)
+            for _ in range(2):
+                sh.ingest_series(_gauge({METRIC_TAG: "m", "instance": "live-0"}, late))
+                fc.flush_shard("ds", 0)
+            ms2 = TimeSeriesMemStore()
+            ms2.setup(Dataset("ds"), [0])
+            recover_shard(ms2, store, "ds", 0)
+            sh2 = ms2.shard("ds", 0)
+            f = [equals(METRIC_TAG, "m")]
+            # start times survive recovery: query before first sample is empty
+            assert len(sh2.lookup_partitions(f, BASE - 10_000, BASE - 1)) == 0
+            assert len(sh2.lookup_partitions(f, BASE, BASE + 500_000)) == 2

@@ -1,0 +1,142 @@
+"""Selective on-demand paging: page-ins read only the needed frames via the
+store manifest (reference OnDemandPagingShard.scala:147 +
+CassandraColumnStore.readRawPartitions:774 — bytes read scale with the query,
+not with the store)."""
+
+import os
+
+import numpy as np
+
+from filodb_tpu.coordinator.planner import QueryEngine
+from filodb_tpu.core.schemas import Dataset, canonical_partkey
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.memstore.shard import StoreConfig
+from filodb_tpu.store.columnstore import LocalColumnStore
+from filodb_tpu.store.flush import FlushCoordinator
+from filodb_tpu.testkit import machine_metrics
+
+BASE = 1_600_000_000_000
+
+
+def _store_bytes(root):
+    total = 0
+    for dp, _, fns in os.walk(root):
+        for fn in fns:
+            if fn.startswith("chunks-"):
+                total += os.path.getsize(os.path.join(dp, fn))
+    return total
+
+
+def _setup(tmp_path, n_series=50, n_samples=300):
+    store = LocalColumnStore(str(tmp_path))
+    ms = TimeSeriesMemStore(StoreConfig(max_chunk_size=100, retention_ms=1_000_000))
+    ms.setup(Dataset("ds"), [0])
+    sh = ms.shard("ds", 0)
+    sh.odp_store = store
+    ms.ingest("ds", 0, machine_metrics(n_series=n_series, n_samples=n_samples, start_ms=BASE))
+    FlushCoordinator(ms, store).flush_shard("ds", 0)
+    return store, ms, sh
+
+
+class TestSelectiveRead:
+    def test_manifest_written_with_frames(self, tmp_path):
+        store, ms, sh = _setup(tmp_path, n_series=4)
+        mpath = tmp_path / "ds" / "shard-0" / "manifest.jsonl"
+        assert mpath.exists()
+        entries = store._manifest("ds", 0)
+        # 4 series x 3 sealed chunks of 100 (last partial stays in buffer)
+        assert len(entries) == sum(
+            1 for _ in store.read_chunks("ds", 0)
+        )
+
+    def test_selective_matches_full_scan(self, tmp_path):
+        store, ms, sh = _setup(tmp_path, n_series=6)
+        part = next(iter(sh.partitions.values()))
+        pk = part.partkey
+        want = [
+            (h["start"], h["end"])
+            for h, _, _ in store.read_chunks("ds", 0)
+            if canonical_partkey(h["tags"]) == pk
+        ]
+        got = [
+            (h["start"], h["end"])
+            for h, _, _ in store.read_chunks_selective("ds", 0, [pk], 0, 2**62)
+        ]
+        assert sorted(got) == sorted(want) and len(got) > 0
+
+    def test_bytes_read_proportional_to_request(self, tmp_path):
+        """VERDICT done-criterion: bytes-read proportional to the queried
+        partitions, not the store."""
+        store, ms, sh = _setup(tmp_path, n_series=50)
+        total = _store_bytes(tmp_path)
+        part = next(iter(sh.partitions.values()))
+        store.stats_selective_bytes = 0
+        got = list(store.read_chunks_selective("ds", 0, [part.partkey], 0, 2**62))
+        assert len(got) == 3  # this series' sealed chunks only
+        # 1 of 50 series: selective read must touch ~2% of the store
+        assert store.stats_selective_bytes < total * 0.05
+
+    def test_time_range_prunes_frames(self, tmp_path):
+        store, ms, sh = _setup(tmp_path, n_series=4)
+        part = next(iter(sh.partitions.values()))
+        # only the first sealed chunk overlaps [BASE, BASE+500s]
+        got = list(store.read_chunks_selective("ds", 0, [part.partkey], BASE, BASE + 500_000))
+        assert len(got) == 1
+
+    def test_premanifest_store_falls_back(self, tmp_path):
+        store, ms, sh = _setup(tmp_path, n_series=4)
+        os.remove(tmp_path / "ds" / "shard-0" / "manifest.jsonl")
+        store._manifest_cache.clear()
+        part = next(iter(sh.partitions.values()))
+        got = list(store.read_chunks_selective("ds", 0, [part.partkey], 0, 2**62))
+        assert len(got) == 3
+
+    def test_premanifest_store_backfilled_on_next_flush(self, tmp_path):
+        """Upgrade path: a shard written before manifests existed gets its
+        manifest rebuilt from the segments on the next flush, so selective
+        reads see pre-upgrade chunks too."""
+        store, ms, sh = _setup(tmp_path, n_series=4, n_samples=250)
+        os.remove(tmp_path / "ds" / "shard-0" / "manifest.jsonl")
+        store._manifest_cache.clear()
+        # more data + flush -> backfill then append
+        ms.ingest("ds", 0, machine_metrics(n_series=4, n_samples=300, start_ms=BASE + 2_500_000))
+        FlushCoordinator(ms, store).flush_shard("ds", 0)
+        part = next(iter(sh.partitions.values()))
+        got = list(store.read_chunks_selective("ds", 0, [part.partkey], 0, 2**62))
+        full = [
+            h for h, _, _ in store.read_chunks("ds", 0)
+            if canonical_partkey(h["tags"]) == part.partkey
+        ]
+        assert len(got) == len(full) and len(got) >= 4
+
+    def test_torn_manifest_line_mid_file_skipped(self, tmp_path):
+        """A merged/garbage line in the middle of the manifest hides only
+        itself — later entries stay visible."""
+        store, ms, sh = _setup(tmp_path, n_series=2)
+        mpath = tmp_path / "ds" / "shard-0" / "manifest.jsonl"
+        lines = mpath.read_bytes().splitlines(keepends=True)
+        assert len(lines) >= 4
+        corrupted = lines[0] + b'{"pk": "dead' + b"".join(lines[1:])
+        mpath.write_bytes(corrupted)
+        store._manifest_cache.clear()
+        entries = store._manifest("ds", 0)
+        assert len(entries) == len(lines) - 1  # only the merged line lost
+
+
+class TestSelectiveOdpEndToEnd:
+    def test_odp_pages_only_queried_partition(self, tmp_path):
+        store, ms, sh = _setup(tmp_path, n_series=50)
+        engine = QueryEngine(ms, "ds")
+        full_start, full_end = (BASE + 600_000) / 1000, (BASE + 2_400_000) / 1000
+        want = engine.query_range(
+            'heap_usage0{instance="host-3"}', full_start, full_end, 60.0
+        ).grids[0].values_np().copy()
+        sh.evict_for_retention(now_ms=BASE + 300 * 10_000)
+        store.stats_selective_bytes = 0
+        got = engine.query_range(
+            'heap_usage0{instance="host-3"}', full_start, full_end, 60.0
+        )
+        assert sh.odp_stats_pages > 0
+        np.testing.assert_allclose(got.grids[0].values_np(), want, rtol=1e-5, equal_nan=True)
+        # one of 50 series paged in: a full-scan page-in would read ~everything
+        assert store.stats_selective_bytes < _store_bytes(tmp_path) * 0.1
