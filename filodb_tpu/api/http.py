@@ -167,7 +167,16 @@ class PromApiHandler(BaseHTTPRequestHandler):
                 return self._send(200, J.success({}))
             self._send(404, J.error("not_found", f"unknown path {path}"))
         except (PromQLError, QueryError, ValueError) as e:
-            self._send(400, J.error("bad_data", str(e)))
+            from ..coordinator.scheduler import QueryRejected
+            from ..query.exec.transformers import QueryDeadlineExceeded
+
+            if isinstance(e, QueryRejected):
+                # overload: bounded scheduler is saturated (Prometheus: 503)
+                self._send(503, J.error("unavailable", str(e)))
+            elif isinstance(e, QueryDeadlineExceeded):
+                self._send(503, J.error("timeout", str(e)))
+            else:
+                self._send(400, J.error("bad_data", str(e)))
         except Exception as e:  # noqa: BLE001 — the API edge must not die
             self._send(500, J.error("internal", f"{type(e).__name__}: {e}"))
 

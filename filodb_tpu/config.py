@@ -28,6 +28,10 @@ DEFAULTS: dict = {
         "max_samples": 500_000_000,
         "lookback_ms": 300_000,
         "timeout_s": 60,
+        # bounded shared scheduler (reference query-sched parallelism):
+        # 0 = run queries inline on the API edge threads (tests/embedding)
+        "parallelism": 8,
+        "max_queued": 64,
     },
     # API
     "http_port": 9090,

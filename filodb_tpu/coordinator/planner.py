@@ -104,6 +104,9 @@ class PlannerParams:
     # multi-node deployments MUST set this from the ShardMapper so query-side
     # pruning enumerates the same shard group ingest routing used.
     num_shards: int | None = None
+    # optional shared QueryScheduler: execution runs on its bounded pool with
+    # fail-fast admission + deadline abort (reference QueryScheduler.scala)
+    scheduler: object | None = None
 
 
 class SingleClusterPlanner:
@@ -460,7 +463,7 @@ class QueryEngine:
             plan = optimize_with_preagg(plan, self.planner.params.agg_rules)
         exec_plan = self.planner.materialize(plan)
         ctx = self.context()
-        res = exec_plan.execute(ctx)
+        res = self._run(exec_plan, ctx)
         res.stats = ctx.stats  # per-query scan/latency stats ride in responses
         if res.result_type == "matrix" or res.grids:
             res.result_type = "matrix"
@@ -470,11 +473,19 @@ class QueryEngine:
         )
         return res
 
+    def _run(self, exec_plan, ctx):
+        """Execute on the shared bounded scheduler when configured, else
+        inline on the caller's thread."""
+        sched = self.planner.params.scheduler
+        if sched is None:
+            return exec_plan.execute(ctx)
+        return sched.run(lambda: exec_plan.execute(ctx), deadline_s=ctx.deadline_s)
+
     def query_instant(self, promql: str, time_s: float):
         plan = query_to_logical_plan(promql, time_s, self.planner.params.lookback_ms)
         exec_plan = self.planner.materialize(plan)
         ctx = self.context()
-        res = exec_plan.execute(ctx)
+        res = self._run(exec_plan, ctx)
         res.stats = ctx.stats
         if res.result_type == "matrix":
             res.result_type = "vector"

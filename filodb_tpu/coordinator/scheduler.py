@@ -1,0 +1,83 @@
+"""Bounded shared query scheduler (reference QueryScheduler.scala:29-73 —
+one instrumented ForkJoinPool shared by all query execution, sized to the
+host, so N concurrent queries cannot each grab the device/compile pipeline
+at once).
+
+Semantics:
+- at most ``parallelism`` queries execute concurrently; up to ``max_queued``
+  more wait for a slot;
+- beyond that, submission fails fast with :class:`QueryRejected` (the HTTP
+  edge maps it to 503, matching Prometheus' overload behavior);
+- a query whose caller stops waiting (deadline) keeps its worker only until
+  the next ``ctx.check_deadline()`` between plan nodes, then aborts — device
+  work in flight cannot be interrupted, exactly the reference's cooperative
+  cancellation model.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FutureTimeout
+
+from ..metrics import REGISTRY
+from ..query.exec.transformers import QueryDeadlineExceeded, QueryError
+
+
+class QueryRejected(QueryError):
+    """Admission control: pool and queue are full."""
+
+
+class QueryScheduler:
+    def __init__(self, parallelism: int | None = None, max_queued: int = 64):
+        self.parallelism = parallelism or min(8, os.cpu_count() or 4)
+        self.max_queued = max_queued
+        self._pool = ThreadPoolExecutor(
+            max_workers=self.parallelism, thread_name_prefix="filodb-query"
+        )
+        # slots = running + queued; acquired non-blocking at submission
+        self._slots = threading.BoundedSemaphore(self.parallelism + max_queued)
+        self._in_flight = 0
+        self.peak_in_flight = 0
+        self._lock = threading.Lock()
+
+    @property
+    def in_flight(self) -> int:
+        return self._in_flight
+
+    def run(self, fn, deadline_s: float):
+        """Run ``fn()`` on the shared pool; wait at most ``deadline_s``.
+        Raises QueryRejected when saturated, QueryError on deadline."""
+        if not self._slots.acquire(blocking=False):
+            REGISTRY.counter("filodb_queries_rejected").inc()
+            raise QueryRejected(
+                f"query rejected: {self.parallelism} running + {self.max_queued} queued"
+            )
+
+        def _job():
+            with self._lock:
+                self._in_flight += 1
+                self.peak_in_flight = max(self.peak_in_flight, self._in_flight)
+            try:
+                return fn()
+            finally:
+                with self._lock:
+                    self._in_flight -= 1
+                self._slots.release()
+
+        fut = self._pool.submit(_job)
+        try:
+            return fut.result(timeout=deadline_s)
+        except FutureTimeout:
+            # the worker aborts at its next check_deadline(); stop waiting now
+            if fut.cancel():
+                # never started: _job's finally will not run — free the slot
+                self._slots.release()
+            REGISTRY.counter("filodb_queries_deadline_exceeded").inc()
+            raise QueryDeadlineExceeded(
+                f"query exceeded deadline: {deadline_s:.1f}s"
+            ) from None
+
+    def shutdown(self):
+        self._pool.shutdown(wait=False, cancel_futures=True)

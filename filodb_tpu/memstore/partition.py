@@ -86,6 +86,7 @@ class TimeSeriesPartition:
         "encode_on_seal",
         "bucket_les",
         "flushed_until",
+        "_hwm",
     )
 
     def __init__(
@@ -109,6 +110,9 @@ class TimeSeriesPartition:
         self.encode_on_seal = encode_on_seal
         self.bucket_les = bucket_les
         self.flushed_until: int = -(2**62)  # flush watermark (ts)
+        # ingest high-water mark: survives chunk eviction so the
+        # out-of-order/duplicate guard stays intact after tier-2 reclaim
+        self._hwm: int = -(2**62)
 
     # -- ingest ------------------------------------------------------------
 
@@ -153,14 +157,15 @@ class TimeSeriesPartition:
             written += take
             if self._buf_len >= self.max_chunk_size:
                 self.switch_buffers()
+        self._hwm = max(self._hwm, int(timestamps[-1]))
         return n
 
     def latest_ts(self) -> int:
         if self._buf is not None and self._buf_len:
-            return int(self._buf["timestamp"][self._buf_len - 1])
+            return max(int(self._buf["timestamp"][self._buf_len - 1]), self._hwm)
         if self.chunks:
-            return self.chunks[-1].end_ts
-        return -(2**62)
+            return max(self.chunks[-1].end_ts, self._hwm)
+        return self._hwm
 
     def earliest_ts(self) -> int:
         if self.chunks:
@@ -237,6 +242,48 @@ class TimeSeriesPartition:
 
     def mark_flushed(self, until_ts: int) -> None:
         self.flushed_until = max(self.flushed_until, until_ts)
+
+    def resident_bytes(self) -> int:
+        """Host-memory footprint of this series: open write buffer + decoded
+        chunk arrays + encoded forms (reference: per-TSP write buffers +
+        block-memory chunk bytes)."""
+        n = 0
+        if self._buf is not None:
+            n += sum(a.nbytes for a in self._buf.values())
+        for c in self.chunks:
+            if c.arrays is not None:
+                n += sum(a.nbytes for a in c.arrays.values())
+            n += c.nbytes_encoded
+        return n
+
+    def drop_decoded_flushed(self) -> int:
+        """Tier-1 reclaim: keep only the encoded form of flushed chunks
+        (reference: optimized BinaryVectors stay, decoded staging is
+        rebuildable). Returns bytes freed."""
+        freed = 0
+        for c in self.chunks:
+            if c.end_ts <= self.flushed_until and c.arrays is not None:
+                decoded = sum(a.nbytes for a in c.arrays.values())
+                had_enc = c.nbytes_encoded
+                c.drop_decoded(self.schema)
+                freed += decoded - (c.nbytes_encoded - had_enc)
+        return freed
+
+    def drop_flushed_chunks(self) -> int:
+        """Tier-2 reclaim: remove flushed chunks from memory entirely — ODP
+        pages them back from the column store on demand (reference
+        evictPartitions + DemandPagedChunkStore). Returns bytes freed."""
+        freed = 0
+        keep = []
+        for c in self.chunks:
+            if c.end_ts <= self.flushed_until:
+                if c.arrays is not None:
+                    freed += sum(a.nbytes for a in c.arrays.values())
+                freed += c.nbytes_encoded
+            else:
+                keep.append(c)
+        self.chunks = keep
+        return freed
 
     def evict_before(self, cutoff_ts: int) -> int:
         """Drop whole chunks ending before cutoff; returns samples dropped."""

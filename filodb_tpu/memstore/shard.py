@@ -36,6 +36,8 @@ class ShardStats:
     partitions_evicted: int = 0
     chunks_flushed: int = 0
     encoded_bytes: int = 0
+    headroom_evictions: int = 0
+    bytes_reclaimed: int = 0
 
 
 @dataclass
@@ -55,6 +57,11 @@ class StoreConfig:
     # staging-cache byte budget per shard (HBM/working-set guard; reference
     # analog: BlockManager reclaim under memory pressure)
     stage_cache_bytes: int = 2 << 30
+    # resident chunk-memory budget per shard; crossing it triggers headroom
+    # eviction (reference shard-mem-size + ensureHeadroom watermarks)
+    max_resident_bytes: int = 8 << 30
+    # eviction drives residency down to this fraction of the budget
+    evict_target_fraction: float = 0.75
 
 
 class TimeSeriesShard:
@@ -89,6 +96,16 @@ class TimeSeriesShard:
         # stopped ingesting and gets a real end time in the index.
         self._ended: set[int] = set()
         self._flush_watermark: dict[int, int] = {}
+        # evicted-partkey filter (reference evictedPartKeys BloomFilter,
+        # TimeSeriesShard.scala:540): partkeys whose chunk data was reclaimed
+        # under memory pressure — ODP and re-ingest consult it
+        self.evicted_keys: set[bytes] = set()
+        self._ingests_since_headroom_check = 0
+        # cheap residency accounting: last measured value + bytes ingested
+        # since, so the O(partitions) walk runs only when the estimate nears
+        # the budget (reference keeps an exact counter in block memory)
+        self._resident_last = 0
+        self._approx_new_bytes = 0
 
     def _make_index(self) -> PartKeyIndex:
         if self.config.index_backend == "native":
@@ -115,6 +132,16 @@ class TimeSeriesShard:
             self.version += 1
             self.stage_cache.clear()
         self.stats.rows_ingested += n
+        # periodic headroom check on the ingest path (reference
+        # ensureFreeSpace runs inside the ingest loop). The full O(partitions)
+        # walk runs only when the estimate (last measurement + bytes since)
+        # could plausibly be over budget.
+        self._approx_new_bytes += n * 24  # ts8 + value8 + overhead slack
+        self._ingests_since_headroom_check += 1
+        if self._ingests_since_headroom_check >= 64:
+            self._ingests_since_headroom_check = 0
+            if self._resident_last + self._approx_new_bytes > self.config.max_resident_bytes:
+                self.evict_for_headroom()
         return n
 
     def ingest_series(self, sb: SeriesBatch) -> int:
@@ -253,7 +280,14 @@ class TimeSeriesShard:
         with self._lock:
             for pid, part in self.partitions.items():
                 dropped += part.evict_before(cutoff)
-                if part.num_samples() == 0:
+                if part.num_samples() != 0:
+                    continue
+                # an empty partition is removed (with its index entry) only
+                # when nothing within retention could be paged back: either
+                # there is no ODP store, or its last sample predates the
+                # cutoff. Tier-2-evicted/live series keep their shell so the
+                # index can route queries to ODP.
+                if self.odp_store is None or self.index.end_time(pid) < cutoff:
                     dead.append(pid)
             for pid in dead:
                 part = self.partitions.pop(pid)
@@ -262,8 +296,60 @@ class TimeSeriesShard:
                 self.cardinality.series_removed(part.tags)
                 self._ended.discard(pid)
                 self._flush_watermark.pop(pid, None)
+                self.evicted_keys.discard(part.partkey)
                 self.stats.partitions_evicted += 1
         return dropped
+
+    def resident_bytes(self) -> int:
+        """Total host-memory footprint of this shard's series data."""
+        with self._lock:
+            return sum(p.resident_bytes() for p in self.partitions.values())
+
+    def evict_for_headroom(self, target_bytes: int | None = None) -> int:
+        """Reclaim chunk memory until residency is under the watermark
+        (reference evictForHeadroom, TimeSeriesShard.scala:1799). Two tiers,
+        least-recently-active partitions first:
+
+        1. drop decoded arrays of flushed chunks (encoded form stays queryable);
+        2. drop flushed chunks entirely — only when an ODP store is attached,
+           so queries page them back (evicted partkeys recorded in
+           ``evicted_keys``, the BloomFilter analog).
+
+        Unflushed data is never dropped. Returns bytes freed."""
+        budget = self.config.max_resident_bytes
+        resident = self.resident_bytes()
+        self._resident_last = resident
+        self._approx_new_bytes = 0
+        if target_bytes is None:
+            if resident <= budget:
+                return 0
+            target = int(budget * self.config.evict_target_fraction)
+        else:
+            target = target_bytes
+            if resident <= target:
+                return 0
+        freed = 0
+        with self._lock:
+            parts = sorted(self.partitions.values(), key=lambda p: p.latest_ts())
+            for part in parts:
+                if resident - freed <= target:
+                    break
+                freed += part.drop_decoded_flushed()
+            if resident - freed > target and self.odp_store is not None:
+                for part in parts:
+                    if resident - freed <= target:
+                        break
+                    got = part.drop_flushed_chunks()
+                    if got:
+                        freed += got
+                        self.evicted_keys.add(part.partkey)
+            if freed:
+                self._resident_last = resident - freed
+                self.version += 1
+                self.stage_cache.clear()
+                self.stats.headroom_evictions += 1
+                self.stats.bytes_reclaimed += freed
+        return freed
 
     def odp_page_in(self, part_ids, start_ms: int, end_ms: int) -> int:
         """Page persisted chunks for the given partitions back into memory

@@ -45,9 +45,9 @@ class QueryContext:
         query timeout)."""
         elapsed = time.monotonic() - self._start_time
         if elapsed > self.deadline_s:
-            from .transformers import QueryError
+            from .transformers import QueryDeadlineExceeded
 
-            raise QueryError(
+            raise QueryDeadlineExceeded(
                 f"query exceeded deadline: {elapsed:.1f}s > {self.deadline_s:.1f}s"
             )
 

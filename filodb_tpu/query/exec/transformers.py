@@ -28,6 +28,11 @@ class QueryError(ValueError):
     pass
 
 
+class QueryDeadlineExceeded(QueryError):
+    """Cooperative deadline abort (reference query timeout); the HTTP edge
+    maps it to 503 like Prometheus timeouts."""
+
+
 def _strip_metric(labels: dict) -> dict:
     return {k: v for k, v in labels.items() if k not in (METRIC_TAG, "__name__")}
 

@@ -97,6 +97,14 @@ class FiloServer:
         from .coordinator.planner import PlannerParams
 
         qcfg = cfg["query"]
+        self.scheduler = None
+        if int(qcfg.get("parallelism", 0)) > 0:
+            from .coordinator.scheduler import QueryScheduler
+
+            self.scheduler = QueryScheduler(
+                parallelism=int(qcfg["parallelism"]),
+                max_queued=int(qcfg.get("max_queued", 64)),
+            )
         self.engine = QueryEngine(
             self.memstore, self.dataset,
             PlannerParams(
@@ -105,6 +113,7 @@ class FiloServer:
                 max_series=int(qcfg["max_series"]),
                 deadline_s=float(qcfg["timeout_s"]),
                 agg_rules=self.agg_rules,
+                scheduler=self.scheduler,
             ),
         )
         self.profiler = None
@@ -154,6 +163,8 @@ class FiloServer:
         self._stop.set()
         if self._http:
             self._http.shutdown()
+        if self.scheduler is not None:
+            self.scheduler.shutdown()
 
     def _maintenance_loop(self):
         """Periodic flush + retention eviction + tenant metering (reference
@@ -173,6 +184,7 @@ class FiloServer:
             for ds in list(self.memstore._datasets):
                 for sh in self.memstore.shards(ds):
                     sh.evict_for_retention()
+                    sh.evict_for_headroom()
             try:
                 metering.publish()
             except Exception:  # noqa: BLE001

@@ -331,8 +331,58 @@ class LocalColumnStore(ColumnStore):
                     entries.append(json.loads(line))
                 except json.JSONDecodeError:
                     continue  # torn/merged line: later appends must stay visible
+        entries.extend(self._repair_manifest(dataset, shard, mpath, entries))
+        st = os.stat(mpath)  # repair may have appended
         self._manifest_cache[key] = (st.st_mtime, st.st_size, entries)
         return entries
+
+    def _repair_manifest(self, dataset, shard, mpath, entries) -> list[dict]:
+        """Re-index segment bytes beyond what the manifest covers (a crash
+        between the segment append and the manifest append orphans the frame;
+        OS flush ordering between the two files is not guaranteed either).
+        Parses frames from the first uncovered offset; appends recovered
+        entries to the manifest. Torn garbage at the boundary ends the scan,
+        exactly like the full-scan reader."""
+        from ..core.schemas import canonical_partkey, hash64
+
+        d = os.path.dirname(mpath)
+        by_seg: dict[str, list[tuple[int, int]]] = {}
+        for e in entries:
+            by_seg.setdefault(e["seg"], []).append((e["off"], e["off"] + e["len"]))
+        recovered = []
+        for fn in sorted(os.listdir(d)):
+            if not fn.startswith("chunks-"):
+                continue
+            path = os.path.join(d, fn)
+            size = os.path.getsize(path)
+            # uncovered byte ranges of this segment (an orphan can sit BETWEEN
+            # covered frames when later appends succeeded after the crash)
+            holes: list[tuple[int, int]] = []
+            pos = 0
+            for o, end in sorted(by_seg.get(fn, ())):
+                if o > pos:
+                    holes.append((pos, o))
+                pos = max(pos, end)
+            if size > pos:
+                holes.append((pos, size))
+            if not holes:
+                continue
+            with open(path, "rb") as f:
+                for hs, he in holes:
+                    f.seek(hs)
+                    for off, length, header, _ in _iter_frames(f, decode_payloads=False):
+                        if off + length > he:
+                            break
+                        pk_hex = f"{hash64(canonical_partkey(header['tags'])):016x}"
+                        recovered.append({
+                            "pk": pk_hex, "seg": fn, "off": off, "len": length,
+                            "start": header["start"], "end": header["end"],
+                        })
+        if recovered:
+            with self._lock, open(mpath, "a") as mf:
+                for e in recovered:
+                    mf.write(json.dumps(e) + "\n")
+        return recovered
 
     def read_chunks_selective(self, dataset, shard, partkeys, start_ms, end_ms):
         """Manifest-seek read: only frames of the requested partkeys

@@ -215,3 +215,39 @@ def test_scalar_range_renders_matrix(api):
     assert out["data"]["resultType"] == "matrix"
     assert len(res) == 1 and len(res[0]["values"]) == 3
     assert all(float(v) == 5.0 for _, v in res[0]["values"])
+
+
+def test_overload_returns_503():
+    """Saturated bounded scheduler -> 503 (reference: query-sched rejection)."""
+    import threading
+    import urllib.error
+
+    from filodb_tpu.coordinator.planner import PlannerParams
+    from filodb_tpu.coordinator.scheduler import QueryScheduler
+
+    ms = TimeSeriesMemStore()
+    ms.setup(Dataset("prometheus"), [0])
+    ms.ingest("prometheus", 0, machine_metrics(n_series=2, n_samples=60, start_ms=BASE))
+    sched = QueryScheduler(parallelism=1, max_queued=0)
+    engine = QueryEngine(ms, "prometheus", PlannerParams(scheduler=sched))
+    srv, port = serve_background(engine)
+    try:
+        release = threading.Event()
+        # occupy the single slot directly through the scheduler
+        t = threading.Thread(target=lambda: sched.run(lambda: release.wait(10), deadline_s=30))
+        t.start()
+        import time as _t
+
+        _t.sleep(0.1)
+        q = urllib.parse.quote("heap_usage0")
+        url = f"http://127.0.0.1:{port}/api/v1/query_range?query={q}&start={START_S}&end={END_S}&step=60"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            get(url)
+        assert ei.value.code == 503
+        release.set()
+        t.join()
+        # slot free again: the same query now succeeds
+        out = get(url)
+        assert out["status"] == "success"
+    finally:
+        srv.shutdown()

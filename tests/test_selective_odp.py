@@ -109,9 +109,27 @@ class TestSelectiveRead:
         ]
         assert len(got) == len(full) and len(got) >= 4
 
+    def test_orphaned_frame_recovered_by_manifest_repair(self, tmp_path):
+        """Review regression: a frame durable in the segment whose manifest
+        line was lost (crash between the two appends) is re-indexed on the
+        next manifest load — even when later appends wrote past it."""
+        store, ms, sh = _setup(tmp_path, n_series=3)
+        mpath = tmp_path / "ds" / "shard-0" / "manifest.jsonl"
+        lines = mpath.read_bytes().splitlines(keepends=True)
+        assert len(lines) >= 6
+        # drop a MIDDLE entry: simulates the orphan with later appends intact
+        mpath.write_bytes(b"".join(lines[:2] + lines[3:]))
+        store._manifest_cache.clear()
+        entries = store._manifest("ds", 0)
+        assert len(entries) == len(lines)  # repair recovered the orphan
+        # and the manifest file itself was healed
+        store._manifest_cache.clear()
+        assert len(store._manifest("ds", 0)) == len(lines)
+
     def test_torn_manifest_line_mid_file_skipped(self, tmp_path):
-        """A merged/garbage line in the middle of the manifest hides only
-        itself — later entries stay visible."""
+        """A merged/garbage line in the middle of the manifest corrupts only
+        itself — later entries stay visible, and the repair pass re-indexes
+        the frame the corrupted line described from the segment bytes."""
         store, ms, sh = _setup(tmp_path, n_series=2)
         mpath = tmp_path / "ds" / "shard-0" / "manifest.jsonl"
         lines = mpath.read_bytes().splitlines(keepends=True)
@@ -120,7 +138,8 @@ class TestSelectiveRead:
         mpath.write_bytes(corrupted)
         store._manifest_cache.clear()
         entries = store._manifest("ds", 0)
-        assert len(entries) == len(lines) - 1  # only the merged line lost
+        # the merged line destroyed one entry; gap repair recovered its frame
+        assert len(entries) == len(lines)
 
 
 class TestSelectiveOdpEndToEnd:
