@@ -11,7 +11,10 @@ Endpoints:
   GET      /api/v1/labels
   GET      /api/v1/label/<name>/values
   GET      /api/v1/series?match[]=...
-  GET      /api/v1/metadata (stub), /api/v1/status/buildinfo
+  GET      /api/v1/metadata (types from live schemas), /api/v1/status/buildinfo
+  GET      /api/v1/query_exemplars (OpenMetrics exemplars ingested via /ingest/prom)
+  GET      /api/v1/rules, /api/v1/alerts — always empty: no rule engine
+           exists in this build, so the empty set is the truthful answer
   GET      /admin/health
   POST     /ingest  (JSON lines of {metric, tags, ts_ms, value} — test/dev
            ingest transport; production path is the gateway)
@@ -137,7 +140,10 @@ class PromApiHandler(BaseHTTPRequestHandler):
             if path == "/api/v1/series":
                 return self._series()
             if path == "/api/v1/metadata":
-                return self._send(200, J.success({}))
+                return self._send(
+                    200,
+                    J.success(self.engine.memstore.metric_metadata(self.engine.dataset)),
+                )
             if path == "/api/v1/status/buildinfo":
                 from .. import __version__
 
@@ -159,7 +165,7 @@ class PromApiHandler(BaseHTTPRequestHandler):
             if path == "/api/v1/read":
                 return self._remote_read()
             if path == "/api/v1/query_exemplars":
-                return self._send(200, J.success([]))
+                return self._query_exemplars()
             if path in ("/api/v1/rules", "/api/v1/alerts"):
                 kind = "rules" if path.endswith("rules") else "alerts"
                 return self._send(200, J.success({"groups" if kind == "rules" else "alerts": []}))
@@ -320,18 +326,46 @@ class PromApiHandler(BaseHTTPRequestHandler):
         out = sorted(merged.values(), key=lambda r: -r["ts_count"])
         return self._send(200, J.success(out))
 
+    def _query_exemplars(self):
+        """Prometheus /api/v1/query_exemplars: exemplars of the series a
+        selector matches, within [start, end]."""
+        from ..query.logical import leaf_raw_series
+        from ..query.promql import query_to_logical_plan
+
+        p = self._params()
+        query = self._q(p, "query")
+        if not query:
+            return self._send(400, J.error("bad_data", "missing query"))
+        start = _parse_time(self._q(p, "start") or "0")
+        end = _parse_time(self._q(p, "end") or str(2**31))
+        plan = query_to_logical_plan(query, end)
+        leaves = leaf_raw_series(plan)
+        out = []
+        for leaf in leaves:
+            out.extend(
+                self.engine.memstore.query_exemplars(
+                    self.engine.dataset, leaf.filters, int(start * 1000), int(end * 1000)
+                )
+            )
+        return self._send(200, J.success(out))
+
     def _ingest_prom(self):
         """Prometheus text exposition ingest (push-gateway style; counters
         route to the prom-counter schema via # TYPE comments)."""
         import time as _time
 
-        from ..gateway.parsers import prom_text_to_batches
+        from ..gateway.parsers import prom_text_to_batches_and_exemplars
 
         length = int(self.headers.get("Content-Length") or 0)
         text = self.rfile.read(length).decode() if length else ""
         n = 0
-        for batch in prom_text_to_batches(text, int(_time.time() * 1000)):
+        now_ms = int(_time.time() * 1000)
+        batches, exs = prom_text_to_batches_and_exemplars(text, now_ms)
+        for batch in batches:
             n += self.engine.memstore.ingest_routed(self.engine.dataset, batch, spread=3)
+        # OpenMetrics exemplars ride alongside their samples
+        if exs:
+            self.engine.memstore.add_exemplars(self.engine.dataset, 3, exs)
         return self._send(200, J.success({"ingested": n}))
 
     def _ingest_influx(self):

@@ -68,9 +68,23 @@ _PROM_LINE = re.compile(
 _PROM_LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:\\.|[^"\\])*)"')
 
 
-def parse_prom_text(text: str):
-    """Prometheus exposition format -> (metric, tags, ts_ms, value) tuples.
-    TYPE comments steer counter/gauge schema choice."""
+_EXEMPLAR = re.compile(
+    r"^\{(?P<labels>.*)\}\s+(?P<value>\S+)(?:\s+(?P<ts>-?\d+(?:\.\d+)?))?$"
+)
+
+
+def _parse_labels(s: str) -> dict:
+    return {
+        lm.group(1): lm.group(2).encode().decode("unicode_escape")
+        for lm in _PROM_LABEL.finditer(s)
+    }
+
+
+def parse_prom_text(text: str, with_exemplars: bool = False):
+    """Prometheus exposition format -> (metric, tags, ts_ms, value, type)
+    tuples; with ``with_exemplars`` a sixth element carries the OpenMetrics
+    exemplar ``(labels, value, ts_ms|None)`` or None. TYPE comments steer
+    counter/gauge schema choice."""
     types: dict[str, str] = {}
     for line in text.splitlines():
         line = line.strip()
@@ -83,18 +97,38 @@ def parse_prom_text(text: str):
             continue
         if line.startswith("#"):
             continue
-        m = _PROM_LINE.match(line)
+        # OpenMetrics exemplar suffix `# {labels} value [ts]`: accept the
+        # split ONLY when both halves parse on their own; otherwise fall back
+        # to matching the whole line (so legal label values containing
+        # ' # {' keep working, and a greedy label match can never swallow a
+        # real exemplar)
+        exemplar = None
+        m = None
+        idx = line.rfind(" # {")
+        if idx != -1:
+            em = _EXEMPLAR.match(line[idx + 3:])
+            m2 = _PROM_LINE.match(line[:idx].rstrip())
+            if em and m2:
+                ex_ts = em.group("ts")
+                exemplar = (
+                    _parse_labels(em.group("labels")),
+                    float(em.group("value")),
+                    int(float(ex_ts) * 1000) if ex_ts else None,
+                )
+                m = m2
+        if m is None:
+            m = _PROM_LINE.match(line)
         if not m:
             raise ValueError(f"bad prometheus line: {line!r}")
         name = m.group("name")
-        tags = {}
-        if m.group("labels"):
-            for lm in _PROM_LABEL.finditer(m.group("labels")):
-                tags[lm.group(1)] = lm.group(2).encode().decode("unicode_escape")
+        tags = _parse_labels(m.group("labels")) if m.group("labels") else {}
         vs = m.group("value")
         val = float("nan") if vs in ("NaN", "nan") else float(vs)
         ts_ms = int(m.group("ts")) if m.group("ts") else None
-        yield name, tags, ts_ms, val, types.get(name, "untyped")
+        if with_exemplars:
+            yield name, tags, ts_ms, val, types.get(name, "untyped"), exemplar
+        else:
+            yield name, tags, ts_ms, val, types.get(name, "untyped")
 
 
 def influx_to_batch(lines: Iterable[str], default_ts_ms: int, ws="default", ns="default") -> RecordBatch:
@@ -115,8 +149,18 @@ def influx_to_batch(lines: Iterable[str], default_ts_ms: int, ws="default", ns="
 
 def prom_text_to_batches(text: str, default_ts_ms: int, ws="default", ns="default") -> list[RecordBatch]:
     """Split by schema: counters -> prom-counter, rest -> gauge."""
+    return prom_text_to_batches_and_exemplars(text, default_ts_ms, ws, ns)[0]
+
+
+def prom_text_to_batches_and_exemplars(
+    text: str, default_ts_ms: int, ws="default", ns="default"
+) -> tuple[list[RecordBatch], list]:
+    """One parse of the exposition payload yielding both the schema-split
+    sample batches and the OpenMetrics exemplars as
+    (full_tags, ts_ms, exemplar_value, exemplar_labels)."""
     gauges, counters = ([], []), ([], [])
-    for name, tags, t, v, typ in parse_prom_text(text):
+    exemplars = []
+    for name, tags, t, v, typ, ex in parse_prom_text(text, with_exemplars=True):
         full = dict(tags)
         full[METRIC_TAG] = name
         full.setdefault("_ws_", ws)
@@ -124,6 +168,12 @@ def prom_text_to_batches(text: str, default_ts_ms: int, ws="default", ns="defaul
         bucket = counters if typ == "counter" else gauges
         bucket[0].append(full)
         bucket[1].append((t if t is not None else default_ts_ms, v))
+        if ex is not None:
+            ex_labels, ex_val, ex_ts = ex
+            exemplars.append(
+                (full, ex_ts if ex_ts is not None else (t if t is not None else default_ts_ms),
+                 ex_val, ex_labels)
+            )
     out = []
     for (tags_list, rows), schema, col in (
         (gauges, GAUGE, "value"),
@@ -133,4 +183,4 @@ def prom_text_to_batches(text: str, default_ts_ms: int, ws="default", ns="defaul
             ts = np.asarray([r[0] for r in rows], dtype=np.int64)
             vals = np.asarray([r[1] for r in rows])
             out.append(RecordBatch(schema, ts, {col: vals}, tags_list))
-    return out
+    return out, exemplars

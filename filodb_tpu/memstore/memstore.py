@@ -92,3 +92,72 @@ class TimeSeriesMemStore:
             if limit and len(out) >= limit:
                 return out[:limit]
         return out
+
+    def metric_metadata(self, dataset: str) -> dict[str, list[dict]]:
+        """Prometheus /api/v1/metadata payload derived from the live schemas:
+        one entry per metric with its type (counter/gauge/histogram) taken
+        from the schema of a representative series (reference: the schemas
+        registry drives PrometheusModel metadata)."""
+        from ..core.filters import equals
+        from ..core.schemas import METRIC_TAG
+
+        out: dict[str, list[dict]] = {}
+        for sh in self.shards(dataset):
+            for metric in sh.label_values([], METRIC_TAG, 0, 2**62):
+                if metric in out:
+                    continue
+                pids = sh.lookup_partitions([equals(METRIC_TAG, metric)], 0, 2**62, limit=1)
+                if not len(pids):
+                    continue
+                schema = sh.partition(int(pids[0])).schema
+                name = schema.name
+                if "histogram" in name:
+                    mtype = "histogram"
+                elif "counter" in name:
+                    mtype = "counter"
+                elif name == "untyped":
+                    mtype = "unknown"
+                else:
+                    mtype = "gauge"
+                out[metric] = [{"type": mtype, "help": "", "unit": ""}]
+        return dict(sorted(out.items()))
+
+    # -- exemplars (OpenMetrics) ---------------------------------------------
+
+    def add_exemplars(self, dataset: str, spread: int, items) -> int:
+        """Attach exemplars to their series (items: (tags, ts_ms, value,
+        exemplar_labels)). Series that don't exist yet are skipped — exemplars
+        ride alongside samples, they never create series."""
+        from ..core.schemas import canonical_partkey, shard_for
+
+        shards = self._datasets[dataset]
+        num_shards = max(shards) + 1
+        n = 0
+        for tags, ts_ms, value, ex_labels in items:
+            snum = shard_for(tags, spread, num_shards)
+            sh = shards.get(snum)
+            if sh is None:
+                continue
+            if sh.add_exemplar(canonical_partkey(tags), ts_ms, value, ex_labels):
+                n += 1
+        return n
+
+    def query_exemplars(self, dataset, filters, start_ms: int, end_ms: int) -> list[dict]:
+        """Prometheus /api/v1/query_exemplars shape: per matching series, the
+        exemplars within [start, end]."""
+        out = []
+        for sh in self.shards(dataset):
+            for pid in sh.lookup_partitions(filters, start_ms, end_ms):
+                part = sh.partition(int(pid))
+                exs = [
+                    {
+                        "labels": lbls,
+                        "value": f"{val:g}",
+                        "timestamp": ts / 1000.0,
+                    }
+                    for ts, val, lbls in part.exemplars
+                    if start_ms <= ts <= end_ms
+                ]
+                if exs:
+                    out.append({"seriesLabels": dict(part.tags), "exemplars": exs})
+        return out

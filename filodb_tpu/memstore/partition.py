@@ -87,7 +87,10 @@ class TimeSeriesPartition:
         "bucket_les",
         "flushed_until",
         "_hwm",
+        "exemplars",
     )
+
+    MAX_EXEMPLARS = 64  # ring-buffer cap per series (OpenMetrics exemplars)
 
     def __init__(
         self,
@@ -113,6 +116,13 @@ class TimeSeriesPartition:
         # ingest high-water mark: survives chunk eviction so the
         # out-of-order/duplicate guard stays intact after tier-2 reclaim
         self._hwm: int = -(2**62)
+        # OpenMetrics exemplars: (ts_ms, value, labels) ring buffer
+        self.exemplars: list[tuple[int, float, dict]] = []
+
+    def add_exemplar(self, ts_ms: int, value: float, labels: dict) -> None:
+        self.exemplars.append((int(ts_ms), float(value), dict(labels)))
+        if len(self.exemplars) > self.MAX_EXEMPLARS:
+            del self.exemplars[: len(self.exemplars) - self.MAX_EXEMPLARS]
 
     # -- ingest ------------------------------------------------------------
 

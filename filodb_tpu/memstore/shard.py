@@ -96,9 +96,12 @@ class TimeSeriesShard:
         # stopped ingesting and gets a real end time in the index.
         self._ended: set[int] = set()
         self._flush_watermark: dict[int, int] = {}
-        # evicted-partkey filter (reference evictedPartKeys BloomFilter,
-        # TimeSeriesShard.scala:540): partkeys whose chunk data was reclaimed
-        # under memory pressure — ODP and re-ingest consult it
+        # evicted-partkey set (reference evictedPartKeys BloomFilter,
+        # TimeSeriesShard.scala:540): partkeys whose flushed chunk data was
+        # reclaimed under memory pressure. The residency check in odp_page_in
+        # (earliest_ts) already routes their queries to ODP; this set is the
+        # retention pass's signal for which empty shells still have pageable
+        # data, and surfaces as the evicted-series stat.
         self.evicted_keys: set[bytes] = set()
         self._ingests_since_headroom_check = 0
         # cheap residency accounting: last measured value + bytes ingested
@@ -299,6 +302,17 @@ class TimeSeriesShard:
                 self.evicted_keys.discard(part.partkey)
                 self.stats.partitions_evicted += 1
         return dropped
+
+    def add_exemplar(self, partkey: bytes, ts_ms: int, value: float, labels) -> bool:
+        """Attach an exemplar to an existing series (locked: partition lookup
+        and append race eviction otherwise). Returns False when the series
+        does not exist — exemplars never create series."""
+        with self._lock:
+            pid = self._by_partkey.get(partkey)
+            if pid is None:
+                return False
+            self.partitions[pid].add_exemplar(ts_ms, value, labels)
+            return True
 
     def resident_bytes(self) -> int:
         """Total host-memory footprint of this shard's series data."""

@@ -319,21 +319,30 @@ class LocalColumnStore(ColumnStore):
         mpath = os.path.join(self.root, dataset, f"shard-{shard}", "manifest.jsonl")
         if not os.path.exists(mpath):
             return None
-        st = os.stat(mpath)
         key = (dataset, shard)
+        st = os.stat(mpath)
         cached = self._manifest_cache.get(key)
         if cached is not None and cached[0] == st.st_mtime and cached[1] == st.st_size:
             return cached[2]
-        entries = []
-        with open(mpath) as f:
-            for line in f:
-                try:
-                    entries.append(json.loads(line))
-                except json.JSONDecodeError:
-                    continue  # torn/merged line: later appends must stay visible
-        entries.extend(self._repair_manifest(dataset, shard, mpath, entries))
-        st = os.stat(mpath)  # repair may have appended
-        self._manifest_cache[key] = (st.st_mtime, st.st_size, entries)
+        # hold the store lock for the read+repair: write_chunks appends the
+        # segment frame and its manifest line under the same lock, so a
+        # repair scan can never mistake a mid-flush frame for an orphan (and
+        # append a duplicate entry), and the stat taken under the lock is
+        # consistent with what was read
+        with self._lock:
+            st = os.stat(mpath)
+            entries = []
+            with open(mpath) as f:
+                for line in f:
+                    try:
+                        entries.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        continue  # torn/merged line: later appends stay visible
+            repaired = self._repair_manifest(dataset, shard, mpath, entries)
+            if repaired:
+                entries.extend(repaired)
+                st = os.stat(mpath)  # repair appended under this same lock
+            self._manifest_cache[key] = (st.st_mtime, st.st_size, entries)
         return entries
 
     def _repair_manifest(self, dataset, shard, mpath, entries) -> list[dict]:
@@ -342,7 +351,7 @@ class LocalColumnStore(ColumnStore):
         OS flush ordering between the two files is not guaranteed either).
         Parses frames from the first uncovered offset; appends recovered
         entries to the manifest. Torn garbage at the boundary ends the scan,
-        exactly like the full-scan reader."""
+        exactly like the full-scan reader. Caller MUST hold self._lock."""
         from ..core.schemas import canonical_partkey, hash64
 
         d = os.path.dirname(mpath)
@@ -379,7 +388,7 @@ class LocalColumnStore(ColumnStore):
                             "start": header["start"], "end": header["end"],
                         })
         if recovered:
-            with self._lock, open(mpath, "a") as mf:
+            with open(mpath, "a") as mf:
                 for e in recovered:
                     mf.write(json.dumps(e) + "\n")
         return recovered

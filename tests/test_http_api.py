@@ -251,3 +251,44 @@ def test_overload_returns_503():
         assert out["status"] == "success"
     finally:
         srv.shutdown()
+
+
+def test_metadata_from_schemas(api):
+    out = get(f"{api}/api/v1/metadata")
+    data = out["data"]
+    assert data["heap_usage0"][0]["type"] == "gauge"
+    assert data["http_requests_total"][0]["type"] == "counter"
+
+
+def test_exemplars_roundtrip():
+    """OpenMetrics exemplars: ingested alongside samples via /ingest/prom,
+    served by /api/v1/query_exemplars (Prometheus response shape)."""
+    import urllib.request
+
+    ms = TimeSeriesMemStore()
+    ms.setup(Dataset("prometheus"), range(4))
+    engine = QueryEngine(ms, "prometheus")
+    srv, port = serve_background(engine)
+    try:
+        body = (
+            "# TYPE http_requests_total counter\n"
+            'http_requests_total{job="api"} 42 1600000000000 '
+            '# {trace_id="abc123"} 0.67 1600000000.0\n'
+            'http_requests_total{job="api"} 99 1600000060000\n'
+        ).encode()
+        req = urllib.request.Request(f"http://127.0.0.1:{port}/ingest/prom", data=body)
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert json.loads(r.read())["data"]["ingested"] == 2
+        q = urllib.parse.quote('http_requests_total{job="api"}')
+        out = get(
+            f"http://127.0.0.1:{port}/api/v1/query_exemplars?query={q}"
+            f"&start=1599999000&end=1600001000"
+        )
+        assert out["status"] == "success"
+        assert len(out["data"]) == 1
+        ex = out["data"][0]["exemplars"][0]
+        assert ex["labels"] == {"trace_id": "abc123"}
+        assert float(ex["value"]) == 0.67
+        assert out["data"][0]["seriesLabels"]["job"] == "api"
+    finally:
+        srv.shutdown()

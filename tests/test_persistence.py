@@ -378,3 +378,29 @@ class TestParserEdges:
         out = dict((m, v) for m, _, _, v in parse_influx_line(
             "s up=t,down=f,count=42i 1600000000000000000"))
         assert out == {"s_up": 1.0, "s_down": 0.0, "s_count": 42.0}
+
+
+class TestExemplarParsing:
+    def test_label_value_containing_exemplar_marker(self):
+        """Review regression: a legal label value containing ' # {' must not
+        be mistaken for an exemplar suffix."""
+        out = list(parse_prom_text('foo{msg="x # {y} 1"} 5\n'))
+        assert out == [("foo", {"msg": "x # {y} 1"}, None, 5.0, "untyped")]
+
+    def test_exemplar_parsed_and_sample_intact(self):
+        rows = list(parse_prom_text(
+            'http_requests_total{job="api"} 42 1600000000000 '
+            '# {trace_id="abc"} 0.67 1600000000.5\n',
+            with_exemplars=True,
+        ))
+        name, tags, ts, val, typ, ex = rows[0]
+        assert (name, tags, ts, val) == ("http_requests_total", {"job": "api"}, 1600000000000, 42.0)
+        assert ex == ({"trace_id": "abc"}, 0.67, 1600000000500)
+
+    def test_exemplar_without_ts_inherits_nothing(self):
+        rows = list(parse_prom_text('m 5 # {t="x"} 1.5\n', with_exemplars=True))
+        assert rows[0][5] == ({"t": "x"}, 1.5, None)
+
+    def test_plain_lines_unchanged(self):
+        rows = list(parse_prom_text("m 1\nm2 NaN\n"))
+        assert len(rows) == 2 and rows[0][:2] == ("m", {})
