@@ -153,9 +153,11 @@ class SingleClusterPlanner:
         import itertools
 
         from ..core.schemas import (
-            METRIC_TAG, PROM_METRIC_TAG, SHARD_KEY_TAGS, shard_group, shardkey_hash,
+            METRIC_TAG, PROM_METRIC_TAG, shard_group, shardkey_hash,
         )
 
+        options = self._options()
+        skc = tuple(options.shard_key_columns)
         eq: dict[str, set[str]] = {}
         for f in filters:
             col = METRIC_TAG if f.column == PROM_METRIC_TAG else f.column
@@ -164,7 +166,7 @@ class SingleClusterPlanner:
             elif f.op == "in":
                 eq.setdefault(col, set()).update(f.value)
         keysets = []
-        for c in SHARD_KEY_TAGS:
+        for c in skc:
             vals = eq.get(c)
             if not vals:
                 return None
@@ -176,9 +178,17 @@ class SingleClusterPlanner:
             return None
         shards: set[int] = set()
         for combo in itertools.product(*keysets):
-            skh = shardkey_hash(dict(zip(SHARD_KEY_TAGS, combo)))
+            skh = shardkey_hash(dict(zip(skc, combo)), options)
             shards |= shard_group(skh, self.params.spread, num_shards)
         return sorted(shards)
+
+    def _options(self):
+        from ..core.schemas import DatasetOptions
+
+        try:
+            return self.memstore.dataset(self.dataset).options
+        except KeyError:
+            return DatasetOptions()
 
     # -- entry -----------------------------------------------------------
 
@@ -231,6 +241,9 @@ class SingleClusterPlanner:
         if isinstance(p, L.Aggregate):
             return self._materialize_aggregate(p)
         if isinstance(p, L.BinaryJoin):
+            pushed = self._try_join_pushdown(p)
+            if pushed is not None:
+                return pushed
             lhs = self._materialize(p.lhs)
             rhs = self._materialize(p.rhs)
             if p.op in ("and", "or", "unless"):
@@ -331,6 +344,65 @@ class SingleClusterPlanner:
             inner.transformers.append(AggregateMapReduce(p.op, p.by, p.without))
             return ReduceAggregateExec([inner], p.op, p.by, p.without)
         return AggregatePresentExec([inner], p.op, p.params, p.by, p.without)
+
+    def _try_join_pushdown(self, p: "L.BinaryJoin"):
+        """Per-shard binary-join pushdown (reference materializeBinaryJoin
+        pushdown, SingleClusterPlanner.scala:640-760, gated there by
+        target-schema colocation). The join runs inside each shard and the
+        results concatenate — no cross-shard gather of full series.
+
+        Sound ONLY when every pair of series that can match is guaranteed to
+        live on the same shard. With our routing
+        (shard = f(shard-key hash | partkey-hash low spread bits)) that means:
+
+        - spread == 0: placement is a pure function of the shard-key columns;
+        - the matching keys preserve every shard-key column: ``on`` ⊇ shard
+          keys, or default matching with ignoring ∩ shard keys = ∅ AND the
+          metric column NOT a shard key (default matching ignores __name__,
+          so a metric-keyed placement would let cross-metric matches cross
+          shards — the reference's target-schema gate is exactly this);
+        - plain selector sides, one-to-one or set-op cardinality.
+
+        Beneficiary: datasets sharded purely by (_ws_, _ns_) — the
+        target-schema analog — where ``foo_bucket / foo_count`` and error
+        ratios join shard-locally."""
+        if self.params.spread != 0:
+            return None
+        if p.op not in ("and", "or", "unless") and p.cardinality not in (None, "one-to-one"):
+            return None
+        if not isinstance(p.lhs, (L.PeriodicSeries, L.PeriodicSeriesWithWindowing)):
+            return None
+        if not isinstance(p.rhs, (L.PeriodicSeries, L.PeriodicSeriesWithWindowing)):
+            return None
+        options = self._options()
+        skc = set(options.shard_key_columns)
+        if p.on is not None:
+            # explicit on-list (including the empty `on()`) must cover every
+            # shard-key column or pairs can cross shards
+            if not skc <= set(p.on):
+                return None
+        else:
+            if options.metric_column in skc:
+                return None  # default matching ignores the metric name
+            if p.ignoring and set(p.ignoring) & skc:
+                return None
+        shards = sorted(set(self.shards_for(p.lhs.raw.filters))
+                        | set(self.shards_for(p.rhs.raw.filters)))
+        if len(shards) <= 1:
+            return None  # single shard: the root join is already local
+        per_shard = []
+        for s in shards:
+            sub = SingleClusterPlanner(self.memstore, self.dataset, [s], self.params)
+            lhs = sub._materialize(p.lhs)
+            rhs = sub._materialize(p.rhs)
+            if p.op in ("and", "or", "unless"):
+                per_shard.append(SetOperatorExec(lhs, rhs, p.op, p.on, p.ignoring))
+            else:
+                per_shard.append(BinaryJoinExec(
+                    lhs, rhs, p.op, p.cardinality, p.on, p.ignoring,
+                    p.include, p.return_bool,
+                ))
+        return DistConcatExec(per_shard)
 
     def _try_time_shard(self, p: "L.PeriodicSeriesWithWindowing"):
         """Long non-aggregated range queries shard the TIME axis over the

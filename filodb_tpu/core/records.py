@@ -66,15 +66,19 @@ class RecordBatch:
             )
         return out
 
-    def shard_split(self, spread: int, num_shards: int) -> dict[int, "RecordBatch"]:
+    def shard_split(self, spread: int, num_shards: int, options=None) -> dict[int, "RecordBatch"]:
         """Partition a batch by destination shard (gateway shardingPipeline
-        analog, GatewayServer.scala:335). Shard memoized per tags object."""
+        analog, GatewayServer.scala:335). Shard memoized per tags object.
+        ``options`` (DatasetOptions) selects the shard-key columns."""
+        from .schemas import DatasetOptions
+
+        options = options or DatasetOptions()
         memo: dict[int, int] = {}
 
         def shard_memo(t):
             s = memo.get(id(t))
             if s is None:
-                s = shard_for(t, spread, num_shards)
+                s = shard_for(t, spread, num_shards, options)
                 memo[id(t)] = s
             return s
 

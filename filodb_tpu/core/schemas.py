@@ -255,8 +255,11 @@ def ingestion_shard(shard_key_hash: int, part_key_hash: int, spread: int, num_sh
     return (((shard_key_hash & ~mask) | (part_key_hash & mask)) & 0x7FFFFFFF) % num_shards
 
 
-def shard_for(tags: Mapping[str, str], spread: int, num_shards: int) -> int:
-    return ingestion_shard(shardkey_hash(tags), partkey_hash(tags), spread, num_shards)
+def shard_for(
+    tags: Mapping[str, str], spread: int, num_shards: int,
+    options: DatasetOptions = DatasetOptions(),
+) -> int:
+    return ingestion_shard(shardkey_hash(tags, options), partkey_hash(tags), spread, num_shards)
 
 
 def shard_group(shard_key_hash: int, spread: int, num_shards: int) -> set[int]:

@@ -50,10 +50,12 @@ class TimeSeriesMemStore:
         return self.shard(dataset, shard_num).ingest(batch, offset)
 
     def ingest_routed(self, dataset: str, batch: RecordBatch, spread: int) -> int:
-        """Route a mixed batch to owned shards by shard-key hash (gateway path)."""
+        """Route a mixed batch to owned shards by shard-key hash (gateway path;
+        the dataset's options pick the shard-key columns)."""
         shards = self._datasets[dataset]
+        options = self._dataset_meta[dataset].options
         n = 0
-        for snum, sub in batch.shard_split(spread, max(shards) + 1).items():
+        for snum, sub in batch.shard_split(spread, max(shards) + 1, options).items():
             if snum in shards:
                 n += shards[snum].ingest(sub)
         return n
@@ -131,10 +133,11 @@ class TimeSeriesMemStore:
         from ..core.schemas import canonical_partkey, shard_for
 
         shards = self._datasets[dataset]
+        options = self._dataset_meta[dataset].options
         num_shards = max(shards) + 1
         n = 0
         for tags, ts_ms, value, ex_labels in items:
-            snum = shard_for(tags, spread, num_shards)
+            snum = shard_for(tags, spread, num_shards, options)
             sh = shards.get(snum)
             if sh is None:
                 continue

@@ -24,8 +24,8 @@ from jax.experimental import pallas as pl
 
 from .staging import StagedBlock
 
-BS = 64  # series per tile
-BJ = 16  # steps per tile
+BS = 64   # series per tile (second-to-last block dim: multiple of 8)
+BJ = 128  # steps per tile (last block dim: hardware requires a multiple of 128)
 NEG = -3.0e38  # python literals: jnp scalars would be captured consts
 POS = 3.0e38
 
@@ -46,7 +46,13 @@ def _window_agg_kernel(params_ref, ts_ref, vals_ref, raw_ref, lens_ref,
     valid = lane < lens
     IMAX = jnp.int32(2**31 - 1)
     IMIN = jnp.int32(-(2**31) + 1)
-    for jj in range(BJ):  # static unroll: 2D vector ops only
+    # column one-hot accumulation: per step jj compute [BS] stats and add
+    # stat ⊗ onehot(jj) into [BS, BJ] carries — vector-only ops (no dynamic
+    # stores), so Mosaic lowers it; a BJ=128 static unroll would explode
+    # compile time and a (BS, <128) output block is rejected by hardware
+    col = jax.lax.broadcasted_iota(jnp.int32, (1, BJ), 1)
+
+    def body(jj, accs):
         t_j = start + (j0 + jj) * step
         m = (ts <= t_j) & (ts > t_j - window) & valid
         mf = m.astype(jnp.float32)
@@ -62,17 +68,18 @@ def _window_agg_kernel(params_ref, ts_ref, vals_ref, raw_ref, lens_ref,
         vf = jnp.where(first_m, vals, 0.0).sum(axis=1)
         vl = jnp.where(last_m, vals, 0.0).sum(axis=1)
         rf = jnp.where(first_m, raw, 0.0).sum(axis=1)
-        tmin = tmin.astype(jnp.float32)
-        tmax = tmax.astype(jnp.float32)
-        cnt_ref[:, jj] = cnt
-        sum_ref[:, jj] = s
-        min_ref[:, jj] = mn
-        max_ref[:, jj] = mx
-        tf_ref[:, jj] = tmin
-        tl_ref[:, jj] = tmax
-        vf_ref[:, jj] = vf
-        vl_ref[:, jj] = vl
-        rf_ref[:, jj] = rf
+        hot = col == jj  # [1, BJ] bool
+        new = (cnt, s, mn, mx, tmin.astype(jnp.float32), tmax.astype(jnp.float32), vf, vl, rf)
+        # select, don't multiply: NaN stats (stale markers, parsed 'NaN'
+        # samples) must stay confined to their own step (NaN * 0 == NaN)
+        return tuple(a + jnp.where(hot, v[:, None], 0.0) for a, v in zip(accs, new))
+
+    zero = jnp.zeros((ts.shape[0], BJ), jnp.float32)
+    accs = jax.lax.fori_loop(0, BJ, body, (zero,) * 9)
+    for ref, acc in zip(
+        (cnt_ref, sum_ref, min_ref, max_ref, tf_ref, tl_ref, vf_ref, vl_ref, rf_ref), accs
+    ):
+        ref[:] = acc
 
 
 @functools.partial(jax.jit, static_argnames=("num_steps", "interpret"))

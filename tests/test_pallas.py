@@ -58,3 +58,23 @@ def test_padding_of_series_dimension():
     got = np.asarray(PK.run_pallas_range_function("sum_over_time", block, params))[:3, :7]
     want = np.asarray(K.run_range_function("sum_over_time", block, params))[:3, :7]
     np.testing.assert_allclose(got, want, rtol=1e-5, equal_nan=True)
+
+
+def test_nan_sample_confined_to_its_window():
+    """Review regression: one NaN sample must not poison the whole step tile
+    (the one-hot accumulation must select, not multiply)."""
+    import numpy as np
+
+    from filodb_tpu.ops import kernels as K
+    from filodb_tpu.ops import pallas_kernels as PK
+    from filodb_tpu.ops import staging as ST
+
+    base = 1_600_000_000_000
+    ts = base + np.arange(5, dtype=np.int64) * 1_000
+    vals = np.array([1.0, 2.0, np.nan, 4.0, 5.0])
+    block = ST.stage_series([(ts, vals)], base)
+    params = K.RangeParams(base + 1_000, 1_000, PK.BJ, 1_000)
+    out = np.asarray(PK.run_pallas_range_function("sum_over_time", block, params))[0, :5]
+    # windows: step k covers (t_k-1s, t_k] = exactly sample k+1
+    expect = [2.0, np.nan, 4.0, 5.0]
+    np.testing.assert_allclose(out[:4], expect, equal_nan=True)
