@@ -79,7 +79,9 @@ def _matchers_from(expr: str) -> list[ColumnFilter]:
 
 class PromApiHandler(BaseHTTPRequestHandler):
     engine: QueryEngine = None  # set by server factory
+    auth_token: str | None = None  # optional bearer auth (server factory)
     protocol_version = "HTTP/1.1"
+    GZIP_MIN_BYTES = 1024
 
     # -- plumbing ---------------------------------------------------------
 
@@ -90,6 +92,15 @@ class PromApiHandler(BaseHTTPRequestHandler):
         body = json.dumps(payload).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
+        # transparent gzip for big results (remote execs request it)
+        if (
+            len(body) >= self.GZIP_MIN_BYTES
+            and "gzip" in (self.headers.get("Accept-Encoding") or "")
+        ):
+            import gzip
+
+            body = gzip.compress(body, compresslevel=1)
+            self.send_header("Content-Encoding", "gzip")
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
@@ -127,6 +138,21 @@ class PromApiHandler(BaseHTTPRequestHandler):
 
     def _route(self):
         path = urllib.parse.urlparse(self.path).path
+        if self.auth_token and path != "/admin/health":
+            import hmac
+
+            got = self.headers.get("Authorization") or ""
+            if not hmac.compare_digest(got, f"Bearer {self.auth_token}"):
+                # drain the body first: this handler speaks HTTP/1.1
+                # keep-alive, and leftover body bytes would desync the
+                # connection's next request
+                length = int(self.headers.get("Content-Length") or 0)
+                while length > 0:
+                    chunk = self.rfile.read(min(length, 65536))
+                    if not chunk:
+                        break
+                    length -= len(chunk)
+                return self._send(401, J.error("unauthorized", "missing or bad bearer token"))
         try:
             if path == "/api/v1/query_range":
                 return self._query_range()
@@ -430,14 +456,18 @@ class PromApiHandler(BaseHTTPRequestHandler):
         return self._send(200, J.success({"ingested": n}))
 
 
-def make_server(engine: QueryEngine, host: str = "127.0.0.1", port: int = 9090) -> ThreadingHTTPServer:
-    handler = type("BoundHandler", (PromApiHandler,), {"engine": engine})
+def make_server(engine: QueryEngine, host: str = "127.0.0.1", port: int = 9090,
+                auth_token: str | None = None) -> ThreadingHTTPServer:
+    handler = type(
+        "BoundHandler", (PromApiHandler,), {"engine": engine, "auth_token": auth_token}
+    )
     return ThreadingHTTPServer((host, port), handler)
 
 
-def serve_background(engine: QueryEngine, host: str = "127.0.0.1", port: int = 0):
+def serve_background(engine: QueryEngine, host: str = "127.0.0.1", port: int = 0,
+                     auth_token: str | None = None):
     """Start the API server on a thread; returns (server, actual_port)."""
-    srv = make_server(engine, host, port)
+    srv = make_server(engine, host, port, auth_token)
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
     return srv, srv.server_address[1]

@@ -35,6 +35,9 @@ DEFAULTS: dict = {
     },
     # API
     "http_port": 9090,
+    # optional bearer token protecting /api/* (remote execs send it via
+    # FILODB_REMOTE_TOKEN); null = open
+    "http_auth_token": None,
     # downsampling (reference downsample resolutions)
     "downsample": {"enabled": False, "periods_m": [5, 60]},
     # cardinality quotas: list of {"prefix": ["ws","ns"], "quota": N}

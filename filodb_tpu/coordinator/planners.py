@@ -44,16 +44,55 @@ from .planner import SingleClusterPlanner
 
 
 class PromQlRemoteExec(ExecPlan):
-    def __init__(self, endpoint: str, promql: str, start_ms: int, end_ms: int, step_ms: int):
+    """Cross-cluster exec as PromQL-over-HTTP (reference PromQlRemoteExec —
+    which also ships retries/timeouts via sttp). Hardened: gzip transport,
+    bounded retries with backoff on transient failures, optional bearer
+    auth (FILODB_REMOTE_TOKEN or constructor)."""
+
+    RETRIES = 3
+    BACKOFF_S = (0.2, 0.8)
+
+    def __init__(self, endpoint: str, promql: str, start_ms: int, end_ms: int, step_ms: int,
+                 auth_token: str | None = None):
         super().__init__()
         self.endpoint = endpoint
         self.promql = promql
         self.start_ms = start_ms
         self.end_ms = end_ms
         self.step_ms = step_ms
+        import os as _os
+
+        self.auth_token = auth_token or _os.environ.get("FILODB_REMOTE_TOKEN")
 
     def args_str(self) -> str:
         return f"endpoint={self.endpoint} promql={self.promql}"
+
+    def _fetch(self, url: str) -> dict:
+        import gzip
+        import time as _time
+        import urllib.error
+
+        headers = {"Accept-Encoding": "gzip"}
+        if self.auth_token:
+            headers["Authorization"] = f"Bearer {self.auth_token}"
+        last_err: Exception | None = None
+        for attempt in range(self.RETRIES):
+            try:
+                req = urllib.request.Request(url, headers=headers)
+                with urllib.request.urlopen(req, timeout=60) as r:
+                    raw = r.read()
+                    if r.headers.get("Content-Encoding") == "gzip":
+                        raw = gzip.decompress(raw)
+                    return json.loads(raw)
+            except urllib.error.HTTPError as e:
+                if e.code < 500:
+                    raise QueryError(f"remote exec failed: HTTP {e.code} {e.reason}") from e
+                last_err = e  # 5xx: transient, retry
+            except (urllib.error.URLError, TimeoutError, ConnectionError) as e:
+                last_err = e
+            if attempt < self.RETRIES - 1:
+                _time.sleep(self.BACKOFF_S[min(attempt, len(self.BACKOFF_S) - 1)])
+        raise QueryError(f"remote exec failed after {self.RETRIES} attempts: {last_err}")
 
     def do_execute(self, ctx) -> QueryResult:
         q = urllib.parse.quote(self.promql)
@@ -61,8 +100,7 @@ class PromQlRemoteExec(ExecPlan):
             f"{self.endpoint}/api/v1/query_range?query={q}"
             f"&start={self.start_ms / 1000}&end={self.end_ms / 1000}&step={self.step_ms / 1000}"
         )
-        with urllib.request.urlopen(url, timeout=60) as r:
-            payload = json.loads(r.read())
+        payload = self._fetch(url)
         if payload.get("status") != "success":
             raise QueryError(f"remote exec failed: {payload}")
         result = payload["data"]["result"]

@@ -672,7 +672,16 @@ class ReduceAggregateExec(NonLeafExecPlan):
 
 class AggregatePresentExec(NonLeafExecPlan):
     """Root aggregation for non-mergeable ops (topk/bottomk/quantile/
-    count_values): children concat full series to the root."""
+    count_values): children concat full series to the root.
+
+    KNOWN SCALE LIMIT (documented, deliberate): the reference spills per-shard
+    k-heaps / t-digests through RecordContainers (aggregator/TopkRowAggregator,
+    QuantileRowAggregator) so the root only sees O(k) rows per shard; here the
+    root gathers the full matching series set and reduces in one vectorized
+    pass. Fine through ~1M series x moderate steps (one [S, J] host array);
+    the mesh path (MeshQuantileExec and per-shard top-k pre-reduction in
+    parallel/exec.py) is the road to reference-style scaling, applied when a
+    mesh is configured. ctx.max_series still bounds the gather."""
 
     def __init__(self, child_plans, op: str, params=(), by=None, without=None):
         super().__init__(child_plans)

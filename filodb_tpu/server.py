@@ -151,7 +151,8 @@ class FiloServer:
         if self.profiler is not None:
             self.profiler.start()
         self._http, actual_port = serve_background(
-            self.engine, port=self.http_port if port is None else port
+            self.engine, port=self.http_port if port is None else port,
+            auth_token=self.config.get("http_auth_token"),
         )
         t = threading.Thread(target=self._maintenance_loop, daemon=True)
         t.start()

@@ -1,0 +1,81 @@
+"""Differential parser fuzzing (reference analog: dual LegacyParser/Antlr
+shadow mode, Parser.scala:40-52 — two independent readings of every query
+cross-checked). We have ONE parser, so the differential pair here is
+parse ∘ unparse: for randomly generated expression trees, the unparsed
+PromQL must re-parse to a plan whose unparse is a fixpoint, and both plans
+must materialize to identical exec trees."""
+
+import random
+
+import pytest
+
+from filodb_tpu.coordinator.planner import SingleClusterPlanner
+from filodb_tpu.core.schemas import Dataset
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.query.promql import query_range_to_logical_plan
+from filodb_tpu.query.unparse import to_promql
+
+METRICS = ["up", "http_requests_total", "heap_usage0", "node_cpu_seconds_total"]
+LABELS = [("job", "api"), ("instance", "h1"), ("_ws_", "demo"), ("code", "500")]
+RANGE_FNS = ["rate", "increase", "irate", "delta", "avg_over_time", "sum_over_time",
+             "min_over_time", "max_over_time", "count_over_time", "last_over_time",
+             "stddev_over_time", "changes", "resets", "deriv", "present_over_time"]
+INSTANT_FNS = ["abs", "ceil", "floor", "exp", "ln", "sqrt", "sgn"]
+AGG_OPS = ["sum", "min", "max", "avg", "count", "stddev", "group"]
+BIN_OPS = ["+", "-", "*", "/", ">", "<", ">=", "<=", "!=", "=="]
+WINDOWS = ["1m", "5m", "10m", "1h"]
+MATCH_OPS = ["=", "!=", "=~", "!~"]
+
+
+def gen_selector(rng: random.Random) -> str:
+    m = rng.choice(METRICS)
+    n = rng.randint(0, 2)
+    if n == 0:
+        return m
+    parts = []
+    for k, v in rng.sample(LABELS, n):
+        op = rng.choice(MATCH_OPS)
+        val = v if op in ("=", "!=") else f"{v}.*"
+        parts.append(f'{k}{op}"{val}"')
+    return f"{m}{{{','.join(parts)}}}"
+
+
+def gen_expr(rng: random.Random, depth: int = 0) -> str:
+    roll = rng.random()
+    if depth >= 3 or roll < 0.25:
+        sel = gen_selector(rng)
+        if rng.random() < 0.6:
+            return f"{rng.choice(RANGE_FNS)}({sel}[{rng.choice(WINDOWS)}])"
+        return sel
+    if roll < 0.5:
+        by = ""
+        if rng.random() < 0.5:
+            keys = ",".join(k for k, _ in rng.sample(LABELS, rng.randint(1, 2)))
+            by = f" by ({keys})"
+        return f"{rng.choice(AGG_OPS)}{by}({gen_expr(rng, depth + 1)})"
+    if roll < 0.7:
+        return f"{rng.choice(INSTANT_FNS)}({gen_expr(rng, depth + 1)})"
+    if roll < 0.85:
+        op = rng.choice(BIN_OPS)
+        b = "bool " if op in (">", "<", ">=", "<=", "!=", "==") and rng.random() < 0.3 else ""
+        return f"({gen_expr(rng, depth + 1)}) {op} {b}{rng.random():.1f}"
+    return f"({gen_expr(rng, depth + 1)}) {rng.choice(['+', '-', '*', '/'])} ({gen_expr(rng, depth + 1)})"
+
+
+@pytest.mark.parametrize("seed", range(40))
+def test_unparse_differential(seed):
+    rng = random.Random(seed)
+    q = gen_expr(rng)
+    p1 = query_range_to_logical_plan(q, 1_600_000_400, 1_600_000_900, 60)
+    s1 = to_promql(p1)
+    p2 = query_range_to_logical_plan(s1, 1_600_000_400, 1_600_000_900, 60)
+    s2 = to_promql(p2)
+    assert s1 == s2, f"unparse not a fixpoint for {q!r}: {s1!r} vs {s2!r}"
+
+    # both plans must materialize to identical exec trees
+    ms = TimeSeriesMemStore()
+    ms.setup(Dataset("prometheus"), [0, 1])
+    pl = SingleClusterPlanner(ms, "prometheus")
+    t1 = pl.materialize(p1).print_tree()
+    t2 = pl.materialize(p2).print_tree()
+    assert t1 == t2, f"exec divergence for {q!r}"
