@@ -20,7 +20,12 @@ def main(concurrency: int = 8, total_requests: int = 200):
     from filodb_tpu.server import FiloServer
     from filodb_tpu.testkit import counter_batch, machine_metrics
 
-    srv = FiloServer({"dataset": "prometheus", "shards": 8})
+    # first-compiles can exceed the default 60s deadline on CPU; the harness
+    # measures warm latency, so give compile room
+    srv = FiloServer({
+        "dataset": "prometheus", "shards": 8,
+        "query": {"timeout_s": 300},
+    })
     port = srv.start(port=0)
     srv.memstore.ingest_routed(
         "prometheus", counter_batch(n_series=200, n_samples=720, start_ms=BASE), spread=3)

@@ -79,9 +79,22 @@ def _matchers_from(expr: str) -> list[ColumnFilter]:
 
 class PromApiHandler(BaseHTTPRequestHandler):
     engine: QueryEngine = None  # set by server factory
+    # engine answering from this process's shards only (no peer scatter);
+    # selected by the X-FiloDB-Local header peers set — the multi-host
+    # anti-recursion guard. None = same as engine. TRUST BOUNDARY: any
+    # caller presenting the header (after passing bearer auth, when
+    # configured) gets the shard-local view on the unbounded local engine —
+    # multi-host deployments should set http_auth_token so only peers (who
+    # share the token) can reach it, and keep the port off the public edge.
+    local_engine: QueryEngine = None
     auth_token: str | None = None  # optional bearer auth (server factory)
     protocol_version = "HTTP/1.1"
     GZIP_MIN_BYTES = 1024
+
+    def _engine_for_request(self) -> QueryEngine:
+        if self.local_engine is not None and self.headers.get("X-FiloDB-Local"):
+            return self.local_engine
+        return self.engine
 
     # -- plumbing ---------------------------------------------------------
 
@@ -228,7 +241,7 @@ class PromApiHandler(BaseHTTPRequestHandler):
             )
         if end < start:
             return self._send(400, J.error("bad_data", "end timestamp before start"))
-        res = self.engine.query_range(query, start, end, step)
+        res = self._engine_for_request().query_range(query, start, end, step)
         if res.result_type == "scalar":
             # range query over a scalar: render as matrix of the scalar
             sc = res.scalar
@@ -264,7 +277,7 @@ class PromApiHandler(BaseHTTPRequestHandler):
         if not query:
             return self._send(400, J.error("bad_data", "missing query"))
         t = _parse_time(self._q(p, "time"), default=time.time())
-        res = self.engine.query_instant(query, t)
+        res = self._engine_for_request().query_instant(query, t)
         if res.result_type == "scalar":
             return self._send(200, J.success(J.render_scalar(res, t)))
         if res.raw is not None:
@@ -276,8 +289,10 @@ class PromApiHandler(BaseHTTPRequestHandler):
         start = _parse_time(self._q(p, "start"), 0.0)
         end = _parse_time(self._q(p, "end"), time.time() + 1e9)
         limit = self._q(p, "limit")
-        names = self.engine.memstore.label_names(
-            self.engine.dataset, [], int(start * 1000), int(end * 1000)
+        match = p.get("match[]", [])
+        filters = _matchers_from(match[0]) if match else []
+        names = self._engine_for_request().label_names(
+            filters, int(start * 1000), int(end * 1000)
         )
         names = ["__name__" if n == "_metric_" else n for n in names]
         if limit:
@@ -293,8 +308,8 @@ class PromApiHandler(BaseHTTPRequestHandler):
         match = p.get("match[]", [])
         limit = self._q(p, "limit")
         filters = _matchers_from(match[0]) if match else []
-        vals = self.engine.memstore.label_values(
-            self.engine.dataset, filters, label, int(start * 1000), int(end * 1000),
+        vals = self._engine_for_request().label_values(
+            filters, label, int(start * 1000), int(end * 1000),
             limit=int(limit) if limit else None,
         )
         return self._send(200, J.success(vals))
@@ -306,8 +321,8 @@ class PromApiHandler(BaseHTTPRequestHandler):
         out = []
         for expr in p.get("match[]", []):
             filters = _matchers_from(expr)
-            for tags in self.engine.memstore.series(
-                self.engine.dataset, filters, int(start * 1000), int(end * 1000), limit=10000
+            for tags in self._engine_for_request().series(
+                filters, int(start * 1000), int(end * 1000), limit=10000
             ):
                 out.append(J._labels_out(dict(tags)))
         return self._send(200, J.success(out))
@@ -457,17 +472,20 @@ class PromApiHandler(BaseHTTPRequestHandler):
 
 
 def make_server(engine: QueryEngine, host: str = "127.0.0.1", port: int = 9090,
-                auth_token: str | None = None) -> ThreadingHTTPServer:
+                auth_token: str | None = None,
+                local_engine: QueryEngine | None = None) -> ThreadingHTTPServer:
     handler = type(
-        "BoundHandler", (PromApiHandler,), {"engine": engine, "auth_token": auth_token}
+        "BoundHandler", (PromApiHandler,),
+        {"engine": engine, "auth_token": auth_token, "local_engine": local_engine},
     )
     return ThreadingHTTPServer((host, port), handler)
 
 
 def serve_background(engine: QueryEngine, host: str = "127.0.0.1", port: int = 0,
-                     auth_token: str | None = None):
+                     auth_token: str | None = None,
+                     local_engine: QueryEngine | None = None):
     """Start the API server on a thread; returns (server, actual_port)."""
-    srv = make_server(engine, host, port, auth_token)
+    srv = make_server(engine, host, port, auth_token, local_engine)
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
     return srv, srv.server_address[1]

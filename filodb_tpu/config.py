@@ -38,6 +38,16 @@ DEFAULTS: dict = {
     # optional bearer token protecting /api/* (remote execs send it via
     # FILODB_REMOTE_TOKEN); null = open
     "http_auth_token": None,
+    # multi-host deployment: each process owns a shard slice and scatters
+    # queries to its peers over HTTP. "coordinator" joins the JAX
+    # distributed runtime for cross-host meshes (null = skip); env
+    # FILODB_COORDINATOR/FILODB_NUM_PROCESSES/FILODB_PROCESS_ID override.
+    # "peers": base URLs of the OTHER processes; "owned_shards": explicit
+    # shard list for this process (default: ordinal slice of "shards").
+    "distributed": {
+        "coordinator": None, "num_processes": None, "process_id": None,
+        "peers": [], "owned_shards": None,
+    },
     # downsampling (reference downsample resolutions)
     "downsample": {"enabled": False, "periods_m": [5, 60]},
     # cardinality quotas: list of {"prefix": ["ws","ns"], "quota": N}

@@ -55,11 +55,35 @@ from ..query.functions import RANGE_FUNCTIONS
 from ..query.promql import query_range_to_logical_plan, query_to_logical_plan
 
 
+def _filters_to_selector(filters) -> str:
+    """Serialize ColumnFilters back to a PromQL matcher set for peers'
+    ``match[]`` params."""
+    import re as _re
+
+    from ..core.schemas import METRIC_TAG
+
+    parts = []
+    for f in filters:
+        col = "__name__" if f.column == METRIC_TAG else f.column
+        if f.op in ("=", "!=", "=~", "!~"):
+            v = str(f.value).replace("\\", "\\\\").replace('"', '\\"')
+            parts.append(f'{col}{f.op}"{v}"')
+        elif f.op == "in":
+            parts.append(f'{col}=~"{"|".join(_re.escape(v) for v in f.value)}"')
+    return "{" + ",".join(parts) + "}"
+
+
 class MetadataExec(ExecPlan):
     """Label values/names & series metadata queries (reference
-    MetadataExecPlan execs)."""
+    MetadataExecPlan execs). With ``peers`` configured (multi-host), the
+    same query scatters to every peer (locally pinned) and the disjoint
+    per-host answers union — otherwise label/series browsing would silently
+    show only this host's shard slice."""
 
-    def __init__(self, kind: str, filters, start_ms, end_ms, label: str | None = None, limit=1000):
+    is_remote = False
+
+    def __init__(self, kind: str, filters, start_ms, end_ms, label: str | None = None,
+                 limit=None, peers: tuple = (), auth_token: str | None = None):
         super().__init__()
         self.kind = kind
         self.filters = tuple(filters)
@@ -67,6 +91,47 @@ class MetadataExec(ExecPlan):
         self.end_ms = end_ms
         self.label = label
         self.limit = limit
+        self.peers = tuple(peers)
+        self.auth_token = auth_token
+
+    def _peer_metadata(self) -> list:
+        """Concurrent per-peer fetch over the shared retrying transport."""
+        import urllib.parse
+        from concurrent.futures import ThreadPoolExecutor
+
+        from ..core.schemas import METRIC_TAG
+        from .planners import fetch_json
+
+        t = f"start={self.start_ms / 1000}&end={self.end_ms / 1000}"
+        match = urllib.parse.quote(_filters_to_selector(self.filters)) if self.filters else None
+        urls = []
+        for ep in self.peers:
+            if self.kind == "label_values":
+                label = "__name__" if self.label == METRIC_TAG else self.label
+                url = f"{ep}/api/v1/label/{urllib.parse.quote(label)}/values?{t}"
+                if match:
+                    url += f"&match[]={match}"
+            elif self.kind == "label_names":
+                url = f"{ep}/api/v1/labels?{t}"
+                if match:
+                    url += f"&match[]={match}"
+            else:  # series
+                url = f"{ep}/api/v1/series?{t}&match[]={match or urllib.parse.quote('{}')}"
+            urls.append(url)
+        out: list = []
+        with ThreadPoolExecutor(max_workers=min(8, len(urls)),
+                                thread_name_prefix="filodb-meta") as pool:
+            for data in pool.map(
+                lambda u: fetch_json(u, auth_token=self.auth_token, local_only=True), urls
+            ):
+                if self.kind == "series":
+                    out.extend(
+                        {(METRIC_TAG if k == "__name__" else k): v for k, v in d.items()}
+                        for d in data
+                    )
+                else:
+                    out.extend(data)
+        return out
 
     def do_execute(self, ctx: QueryContext):
         from ..query.rangevector import QueryResult
@@ -74,11 +139,27 @@ class MetadataExec(ExecPlan):
         ms = ctx.memstore
         res = QueryResult()
         if self.kind == "label_values":
-            res.metadata = ms.label_values(ctx.dataset, self.filters, self.label, self.start_ms, self.end_ms, self.limit)
+            vals = ms.label_values(ctx.dataset, self.filters, self.label, self.start_ms, self.end_ms, self.limit)
+            if self.peers:
+                vals = sorted(set(vals) | set(self._peer_metadata()))
+                if self.limit:
+                    vals = vals[: self.limit]
+            res.metadata = vals
         elif self.kind == "label_names":
-            res.metadata = ms.label_names(ctx.dataset, self.filters, self.start_ms, self.end_ms)
+            names = ms.label_names(ctx.dataset, self.filters, self.start_ms, self.end_ms)
+            if self.peers:
+                names = sorted(
+                    set(names)
+                    | {"_metric_" if n == "__name__" else n for n in self._peer_metadata()}
+                )
+            res.metadata = names
         elif self.kind == "series":
-            res.metadata = [dict(t) for t in ms.series(ctx.dataset, self.filters, self.start_ms, self.end_ms, self.limit)]
+            series = [dict(t) for t in ms.series(ctx.dataset, self.filters, self.start_ms, self.end_ms, self.limit)]
+            if self.peers:
+                series.extend(self._peer_metadata())  # shard-disjoint: no dedup needed
+                if self.limit:
+                    series = series[: self.limit]
+            res.metadata = series
         else:
             raise QueryError(f"unknown metadata query {self.kind}")
         res.result_type = "metadata"
@@ -107,6 +188,14 @@ class PlannerParams:
     # optional shared QueryScheduler: execution runs on its bounded pool with
     # fail-fast admission + deadline abort (reference QueryScheduler.scala)
     scheduler: object | None = None
+    # multi-host scatter: base URLs of PEER processes owning the other shard
+    # slices of this cluster. Selector-level subqueries fan out to every peer
+    # (reference: ActorPlanDispatcher scatter to peer nodes' QueryActors) and
+    # concatenate with the local leaves; peers execute locally-only (the
+    # remote exec pins X-FiloDB-Local so scatter never recurses).
+    peer_endpoints: tuple = ()
+    # bearer token for peer requests (the cluster's http_auth_token)
+    remote_auth_token: str | None = None
 
 
 class SingleClusterPlanner:
@@ -133,10 +222,15 @@ class SingleClusterPlanner:
             return owned
         num_shards = self.params.num_shards
         if num_shards is None:
-            all_nums = self.memstore.shard_nums(self.dataset)
-            if not all_nums:
-                return owned
-            num_shards = max(all_nums) + 1
+            try:
+                num_shards = self.memstore.total_shards(self.dataset)
+            except (KeyError, AttributeError):
+                all_nums = self.memstore.shard_nums(self.dataset)
+                if not all_nums:
+                    return owned
+                num_shards = max(all_nums) + 1
+        if not num_shards:
+            return owned
         cand = self._shards_from_filters(filters, num_shards)
         if cand is None:
             return owned
@@ -196,17 +290,42 @@ class SingleClusterPlanner:
         m = self._materialize
         return m(plan)
 
-    def _fanout(self, make_leaf, transformers, filters=None) -> ExecPlan:
+    def _fanout(self, make_leaf, transformers, filters=None, logical=None) -> ExecPlan:
         leaves = []
         for s in self.shards_for(filters):
             leaf = make_leaf(s)
             leaf.transformers.extend(transformers)
             leaves.append(leaf)
+        leaves.extend(self._peer_leaves(logical))
         if not leaves:
             return EmptyResultExec()
         if len(leaves) == 1:
             return leaves[0]
         return DistConcatExec(leaves)
+
+    def _peer_leaves(self, logical) -> list:
+        """Multi-host scatter: one locally-pinned remote exec per peer for
+        this selector-level subtree. Series are disjoint across hosts (shard
+        ownership), so concatenation is exact; upper transformers/aggregates
+        apply to the union at this node's parent, identically to local
+        leaves."""
+        if not self.params.peer_endpoints or logical is None:
+            return []
+        if not isinstance(logical, (L.PeriodicSeries, L.PeriodicSeriesWithWindowing)):
+            return []
+        from ..query.unparse import to_promql
+        from .planners import PromQlRemoteExec
+
+        q = to_promql(logical)
+        leaves = []
+        for ep in self.params.peer_endpoints:
+            r = PromQlRemoteExec(
+                ep, q, logical.start_ms, logical.end_ms, logical.step_ms or 1,
+                auth_token=self.params.remote_auth_token, local_only=True,
+            )
+            r.peer_logical = logical  # for aggregate pushdown rewriting
+            leaves.append(r)
+        return leaves
 
     def _materialize(self, p: L.LogicalPlan) -> ExecPlan:
         if isinstance(p, L.PeriodicSeries):
@@ -218,6 +337,7 @@ class SingleClusterPlanner:
                 lambda s: SelectRawPartitionsExec(s, raw.filters, raw.start_ms, raw.end_ms, raw.column),
                 [mapper],
                 filters=raw.filters,
+                logical=p,
             )
         if isinstance(p, L.PeriodicSeriesWithWindowing):
             ts_plan = self._try_time_shard(p)
@@ -232,8 +352,11 @@ class SingleClusterPlanner:
                 lambda s: SelectRawPartitionsExec(s, raw.filters, raw.start_ms, raw.end_ms, raw.column),
                 [mapper],
                 filters=raw.filters,
+                logical=p,
             )
         if isinstance(p, L.RawSeries):
+            # raw chunk export stays host-local (remote read serves peers'
+            # raw data from their own processes)
             return self._fanout(
                 lambda s: RawChunkExportExec(s, p.filters, p.start_ms, p.end_ms, p.column), [],
                 filters=p.filters,
@@ -320,12 +443,15 @@ class SingleClusterPlanner:
             )
         if isinstance(p, L.TopLevelSubquery):
             return self._materialize(p.inner)
-        if isinstance(p, L.LabelValues):
-            return MetadataExec("label_values", p.filters, p.start_ms, p.end_ms, p.label)
-        if isinstance(p, L.LabelNames):
-            return MetadataExec("label_names", p.filters, p.start_ms, p.end_ms)
-        if isinstance(p, L.SeriesKeysByFilters):
-            return MetadataExec("series", p.filters, p.start_ms, p.end_ms)
+        if isinstance(p, (L.LabelValues, L.LabelNames, L.SeriesKeysByFilters)):
+            kind = {"LabelValues": "label_values", "LabelNames": "label_names",
+                    "SeriesKeysByFilters": "series"}[type(p).__name__]
+            return MetadataExec(
+                kind, p.filters, p.start_ms, p.end_ms,
+                label=getattr(p, "label", None),
+                peers=self.params.peer_endpoints,
+                auth_token=self.params.remote_auth_token,
+            )
         raise QueryError(f"cannot materialize {type(p).__name__}")
 
     def _materialize_aggregate(self, p: L.Aggregate) -> ExecPlan:
@@ -337,6 +463,7 @@ class SingleClusterPlanner:
         if simple and isinstance(inner, DistConcatExec) and not inner.transformers:
             # push map phase onto each shard subtree (reference agg pushdown
             # SingleClusterPlanner.scala:1137)
+            self._push_peer_aggregate(inner.child_plans, p)
             for child in inner.child_plans:
                 child.transformers.append(AggregateMapReduce(p.op, p.by, p.without))
             return ReduceAggregateExec(inner.child_plans, p.op, p.by, p.without)
@@ -344,6 +471,29 @@ class SingleClusterPlanner:
             inner.transformers.append(AggregateMapReduce(p.op, p.by, p.without))
             return ReduceAggregateExec([inner], p.op, p.by, p.without)
         return AggregatePresentExec([inner], p.op, p.params, p.by, p.without)
+
+    # aggregation ops where re-aggregating per-peer PARTIALS with the same
+    # op is exact: sum of sums, min of mins, max of maxes, group of groups.
+    # count/avg/stddev must NOT push (count would count the partial series,
+    # avg of avgs is wrong) — those peers still return raw series.
+    _PEER_PUSH_OPS = {"sum", "min", "max", "group"}
+
+    def _push_peer_aggregate(self, children, p: "L.Aggregate") -> None:
+        """Rewrite peer remote leaves to ship the aggregate (``sum by(g)
+        (rate(m[5m]))``) instead of every raw series — the cross-host analog
+        of the per-shard map-phase pushdown: O(groups) rows over the wire,
+        not O(series). The local AggregateMapReduce/Reduce pipeline then
+        treats the peer's group partials exactly like local partials."""
+        if p.op not in self._PEER_PUSH_OPS or p.params:
+            return
+        from ..query.unparse import to_promql
+
+        for child in children:
+            leaf = getattr(child, "peer_logical", None)
+            if leaf is None:
+                continue
+            wrapped = L.Aggregate(p.op, leaf, p.params, p.by, p.without)
+            child.promql = to_promql(wrapped)
 
     def _try_join_pushdown(self, p: "L.BinaryJoin"):
         """Per-shard binary-join pushdown (reference materializeBinaryJoin
@@ -368,6 +518,8 @@ class SingleClusterPlanner:
         ratios join shard-locally."""
         if self.params.spread != 0:
             return None
+        if self.params.peer_endpoints:
+            return None  # matching pairs may span hosts
         if p.op not in ("and", "or", "unless") and p.cardinality not in (None, "one-to-one"):
             return None
         if not isinstance(p.lhs, (L.PeriodicSeries, L.PeriodicSeriesWithWindowing)):
@@ -408,7 +560,7 @@ class SingleClusterPlanner:
         """Long non-aggregated range queries shard the TIME axis over the
         mesh with a ring halo exchange (parallel/timeshard.py)."""
         mesh = self.params.mesh
-        if mesh is None:
+        if mesh is None or self.params.peer_endpoints:
             return None
         from ..ops.kernels import SORTED_FUNCS
         from ..parallel.exec import TIME_SHARD_MIN_STEPS, TimeShardRangeExec
@@ -445,7 +597,9 @@ class SingleClusterPlanner:
         """Mesh path: aggregate-of-range-function compiles to one psum
         program when a device mesh is configured."""
         mesh = self.params.mesh
-        if mesh is None:
+        if mesh is None or self.params.peer_endpoints:
+            # peer scatter runs through the standard leaf fan-out; the mesh
+            # single-psum program would aggregate local shards only
             return None
         from ..parallel.exec import MESH_OPS, MeshAggregateExec
 
@@ -552,6 +706,24 @@ class QueryEngine:
         if sched is None:
             return exec_plan.execute(ctx)
         return sched.run(lambda: exec_plan.execute(ctx), deadline_s=ctx.deadline_s)
+
+    def label_values(self, filters, label: str, start_ms: int, end_ms: int, limit=None):
+        """Metadata through the planner so multi-host peers scatter too."""
+        plan = L.LabelValues(label, tuple(filters), start_ms, end_ms)
+        ep = self.planner.materialize(plan)
+        if limit:
+            ep.limit = int(limit)
+        return ep.execute(self.context()).metadata
+
+    def label_names(self, filters, start_ms: int, end_ms: int):
+        ep = self.planner.materialize(L.LabelNames(tuple(filters), start_ms, end_ms))
+        return ep.execute(self.context()).metadata
+
+    def series(self, filters, start_ms: int, end_ms: int, limit=None):
+        ep = self.planner.materialize(L.SeriesKeysByFilters(tuple(filters), start_ms, end_ms))
+        if limit:
+            ep.limit = int(limit)
+        return ep.execute(self.context()).metadata
 
     def query_instant(self, promql: str, time_s: float):
         plan = query_to_logical_plan(promql, time_s, self.planner.params.lookback_ms)

@@ -43,23 +43,67 @@ from .planner import SingleClusterPlanner
 # ---------------------------------------------------------------------------
 
 
+_RETRIES = 3
+_BACKOFF_S = (0.2, 0.8)
+
+
+def fetch_json(url: str, auth_token: str | None = None, local_only: bool = False,
+               timeout: float = 60) -> dict | list:
+    """THE remote-HTTP fetch used by every cross-host path (query scatter,
+    federation, metadata): gzip transport, bearer auth, X-FiloDB-Local
+    pinning, bounded retries with backoff on transient failures (5xx /
+    connection errors / timeouts; 4xx fails fast). Returns the parsed
+    ``data`` payload of a successful Prometheus-shaped response."""
+    import gzip
+    import time as _time
+    import urllib.error
+    import urllib.request
+
+    headers = {"Accept-Encoding": "gzip"}
+    if auth_token:
+        headers["Authorization"] = f"Bearer {auth_token}"
+    if local_only:
+        headers["X-FiloDB-Local"] = "1"
+    last_err: Exception | None = None
+    for attempt in range(_RETRIES):
+        try:
+            req = urllib.request.Request(url, headers=headers)
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                raw = r.read()
+                if r.headers.get("Content-Encoding") == "gzip":
+                    raw = gzip.decompress(raw)
+                payload = json.loads(raw)
+            if payload.get("status") != "success":
+                raise QueryError(f"remote request failed: {payload}")
+            return payload["data"]
+        except urllib.error.HTTPError as e:
+            if e.code < 500:
+                raise QueryError(f"remote request failed: HTTP {e.code} {e.reason}") from e
+            last_err = e  # 5xx: transient, retry
+        except (urllib.error.URLError, TimeoutError, ConnectionError) as e:
+            last_err = e
+        if attempt < _RETRIES - 1:
+            _time.sleep(_BACKOFF_S[min(attempt, len(_BACKOFF_S) - 1)])
+    raise QueryError(f"remote request failed after {_RETRIES} attempts: {last_err}")
+
+
 class PromQlRemoteExec(ExecPlan):
     """Cross-cluster exec as PromQL-over-HTTP (reference PromQlRemoteExec —
-    which also ships retries/timeouts via sttp). Hardened: gzip transport,
-    bounded retries with backoff on transient failures, optional bearer
-    auth (FILODB_REMOTE_TOKEN or constructor)."""
+    which also ships retries/timeouts via sttp), over :func:`fetch_json`."""
 
-    RETRIES = 3
-    BACKOFF_S = (0.2, 0.8)
+    is_remote = True  # network-bound: NonLeafExecPlan overlaps these children
 
     def __init__(self, endpoint: str, promql: str, start_ms: int, end_ms: int, step_ms: int,
-                 auth_token: str | None = None):
+                 auth_token: str | None = None, local_only: bool = False):
         super().__init__()
         self.endpoint = endpoint
         self.promql = promql
         self.start_ms = start_ms
         self.end_ms = end_ms
         self.step_ms = step_ms
+        # multi-host scatter anti-recursion: the peer must answer from its
+        # OWN shards only (X-FiloDB-Local), never re-scatter to its peers
+        self.local_only = local_only
         import os as _os
 
         self.auth_token = auth_token or _os.environ.get("FILODB_REMOTE_TOKEN")
@@ -67,43 +111,14 @@ class PromQlRemoteExec(ExecPlan):
     def args_str(self) -> str:
         return f"endpoint={self.endpoint} promql={self.promql}"
 
-    def _fetch(self, url: str) -> dict:
-        import gzip
-        import time as _time
-        import urllib.error
-
-        headers = {"Accept-Encoding": "gzip"}
-        if self.auth_token:
-            headers["Authorization"] = f"Bearer {self.auth_token}"
-        last_err: Exception | None = None
-        for attempt in range(self.RETRIES):
-            try:
-                req = urllib.request.Request(url, headers=headers)
-                with urllib.request.urlopen(req, timeout=60) as r:
-                    raw = r.read()
-                    if r.headers.get("Content-Encoding") == "gzip":
-                        raw = gzip.decompress(raw)
-                    return json.loads(raw)
-            except urllib.error.HTTPError as e:
-                if e.code < 500:
-                    raise QueryError(f"remote exec failed: HTTP {e.code} {e.reason}") from e
-                last_err = e  # 5xx: transient, retry
-            except (urllib.error.URLError, TimeoutError, ConnectionError) as e:
-                last_err = e
-            if attempt < self.RETRIES - 1:
-                _time.sleep(self.BACKOFF_S[min(attempt, len(self.BACKOFF_S) - 1)])
-        raise QueryError(f"remote exec failed after {self.RETRIES} attempts: {last_err}")
-
     def do_execute(self, ctx) -> QueryResult:
         q = urllib.parse.quote(self.promql)
         url = (
             f"{self.endpoint}/api/v1/query_range?query={q}"
             f"&start={self.start_ms / 1000}&end={self.end_ms / 1000}&step={self.step_ms / 1000}"
         )
-        payload = self._fetch(url)
-        if payload.get("status") != "success":
-            raise QueryError(f"remote exec failed: {payload}")
-        result = payload["data"]["result"]
+        data = fetch_json(url, auth_token=self.auth_token, local_only=self.local_only)
+        result = data["result"]
         num_steps = int((self.end_ms - self.start_ms) // self.step_ms) + 1
         times = self.start_ms + np.arange(num_steps, dtype=np.int64) * self.step_ms
         labels, rows = [], []
@@ -115,7 +130,9 @@ class PromQlRemoteExec(ExecPlan):
             }
             row = np.full(num_steps, np.nan, np.float32)
             for t, v in series.get("values", []):
-                i = t2i.get(int(float(t) * 1000))
+                # round, don't truncate: the peer renders t/1000.0 and the
+                # nearest double of e.g. ...400.123 is ...400.12299999
+                i = t2i.get(round(float(t) * 1000))
                 if i is not None:
                     row[i] = float(v)
             labels.append(lbls)

@@ -21,16 +21,29 @@ class TimeSeriesMemStore:
     def __init__(self, store_config: StoreConfig | None = None):
         self._datasets: dict[str, dict[int, TimeSeriesShard]] = {}
         self._dataset_meta: dict[str, Dataset] = {}
+        self._total_shards: dict[str, int] = {}
         self.store_config = store_config or StoreConfig()
 
     # -- lifecycle -----------------------------------------------------------
 
-    def setup(self, dataset: Dataset, shard_nums: Sequence[int]) -> None:
+    def setup(self, dataset: Dataset, shard_nums: Sequence[int],
+              total_shards: int | None = None) -> None:
+        """``total_shards`` is the CLUSTER's shard count (the routing
+        modulus); REQUIRED whenever ``shard_nums`` is a partial slice
+        (multi-host), else inferred from the owned set."""
         shards = self._datasets.setdefault(dataset.name, {})
         self._dataset_meta[dataset.name] = dataset
-        for s in shard_nums:
+        nums = list(shard_nums)
+        self._total_shards[dataset.name] = max(
+            total_shards or 0, (max(nums) + 1) if nums else 0,
+            self._total_shards.get(dataset.name, 0),
+        )
+        for s in nums:
             if s not in shards:
                 shards[s] = TimeSeriesShard(dataset.name, s, self.store_config)
+
+    def total_shards(self, dataset: str) -> int:
+        return self._total_shards[dataset]
 
     def shard(self, dataset: str, shard_num: int) -> TimeSeriesShard:
         return self._datasets[dataset][shard_num]
@@ -55,7 +68,9 @@ class TimeSeriesMemStore:
         shards = self._datasets[dataset]
         options = self._dataset_meta[dataset].options
         n = 0
-        for snum, sub in batch.shard_split(spread, max(shards) + 1, options).items():
+        for snum, sub in batch.shard_split(
+            spread, self.total_shards(dataset), options
+        ).items():
             if snum in shards:
                 n += shards[snum].ingest(sub)
         return n
@@ -134,7 +149,7 @@ class TimeSeriesMemStore:
 
         shards = self._datasets[dataset]
         options = self._dataset_meta[dataset].options
-        num_shards = max(shards) + 1
+        num_shards = self.total_shards(dataset)
         n = 0
         for tags, ts_ms, value, ex_labels in items:
             snum = shard_for(tags, spread, num_shards, options)

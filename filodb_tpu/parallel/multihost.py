@@ -1,0 +1,109 @@
+"""Multi-host distributed runtime (reference: the Akka-Cluster /
+FiloDbClusterDiscovery control plane + NCCL-style data plane, SURVEY.md §2
+"Distributed communication backends" — here the JAX distributed runtime:
+one coordination service, per-process local devices, XLA collectives over
+ICI within a host/slice and DCN across hosts).
+
+Bootstrap order (each process):
+  1. ``init_distributed(...)`` BEFORE any backend touch — wires the process
+     into the global device view (reference analog: node joins the cluster
+     via discovery, NewFiloServerMain.scala:45-47);
+  2. ``make_multihost_mesh(...)`` — one global mesh over every device of
+     every process; the planner/mesh execs are unchanged (the same compiled
+     psum program now spans hosts, riding ICI within a slice and DCN
+     between them);
+  3. shard ownership: ``shards_for_process`` splits shard numbers by
+     process ordinal exactly like the v2 stateful-set discovery
+     (coordinator/cluster.py ClusterDiscovery), so ingest lands on the host
+     whose devices hold that shard's mesh slot.
+
+Mesh axis layout follows the scaling-book recipe: put the axis with the
+highest-volume collectives innermost (ICI). For us the time-halo exchange
+(ring ppermute, O(halo) per step) outranks the shard psum (O(groups x
+steps) once per query), so hybrid 2D meshes place ``time`` on ICI and
+``shard`` across DCN.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+def init_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> bool:
+    """Initialize the JAX distributed runtime for this process. Env vars
+    FILODB_COORDINATOR / FILODB_NUM_PROCESSES / FILODB_PROCESS_ID OVERRIDE
+    the arguments — the stateful-set ordinal pattern ships one config file
+    and injects the per-pod identity via env. No-ops (returns False) for
+    single-process deployments so the server can call it unconditionally."""
+    import jax
+
+    coordinator_address = os.environ.get("FILODB_COORDINATOR") or coordinator_address
+    env_np = os.environ.get("FILODB_NUM_PROCESSES")
+    num_processes = int(env_np) if env_np else (num_processes or 1)
+    env_pid = os.environ.get("FILODB_PROCESS_ID")
+    process_id = int(env_pid) if env_pid else (process_id or 0)
+    if num_processes <= 1 or not coordinator_address:
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return True
+
+
+def make_multihost_mesh(axis: str = "shard"):
+    """One global 1D mesh over every device of every process (after
+    ``init_distributed``, ``jax.devices()`` is the global view)."""
+    import jax
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()), axis_names=(axis,))
+
+
+def make_hybrid_mesh2d(shard_axis_size: int | None = None):
+    """2D ``(shard, time)`` mesh for multi-host: ``shard`` spans hosts (DCN)
+    and ``time`` stays within a host (ICI), so the per-step ring halo
+    exchange of the time axis rides the fast interconnect. Falls back to a
+    plain reshape on a single process."""
+    import jax
+    from jax.sharding import Mesh
+
+    devices = jax.devices()
+    n_proc = max(getattr(jax, "process_count", lambda: 1)(), 1)
+    shard_size = shard_axis_size or n_proc
+    if len(devices) % shard_size:
+        raise ValueError(f"{len(devices)} devices not divisible by shard axis {shard_size}")
+    time_size = len(devices) // shard_size
+    if n_proc > 1:
+        from jax.experimental import mesh_utils
+
+        arr = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=(1, time_size),
+            dcn_mesh_shape=(shard_size, 1),
+            devices=devices,
+        )
+    else:
+        arr = np.array(devices).reshape(shard_size, time_size)
+    return Mesh(arr, axis_names=("shard", "time"))
+
+
+def shards_for_process(num_shards: int, num_processes: int | None = None,
+                       process_id: int | None = None) -> list[int]:
+    """Contiguous shard ownership by process ordinal (reference
+    FiloDbClusterDiscovery.scala:37-47 ordinal -> shard assignment)."""
+    import jax
+
+    if num_processes is None:
+        num_processes = max(getattr(jax, "process_count", lambda: 1)(), 1)
+    if process_id is None:
+        process_id = getattr(jax, "process_index", lambda: 0)()
+    per = (num_shards + num_processes - 1) // num_processes
+    lo = process_id * per
+    return list(range(lo, min(lo + per, num_shards)))

@@ -406,7 +406,28 @@ class NonLeafExecPlan(ExecPlan):
         return self.child_plans
 
     def execute_children(self, ctx: QueryContext) -> list[QueryResult]:
-        return [c.execute(ctx) for c in self.child_plans]
+        """Children execute in order, EXCEPT network-bound children (remote
+        execs mark ``is_remote``) which dispatch concurrently on IO threads —
+        the reference runs children as concurrent monix Tasks; here local
+        children share the device serially while peer round-trips overlap."""
+        remotes = [
+            (i, c) for i, c in enumerate(self.child_plans)
+            if getattr(c, "is_remote", False)
+        ]
+        if len(remotes) < 1 or len(self.child_plans) < 2:
+            return [c.execute(ctx) for c in self.child_plans]
+        from concurrent.futures import ThreadPoolExecutor
+
+        results: dict[int, QueryResult] = {}
+        with ThreadPoolExecutor(max_workers=min(8, len(remotes)),
+                                thread_name_prefix="filodb-remote") as pool:
+            futs = {i: pool.submit(c.execute, ctx) for i, c in remotes}
+            for i, c in enumerate(self.child_plans):
+                if i not in futs:
+                    results[i] = c.execute(ctx)
+            for i, f in futs.items():
+                results[i] = f.result()
+        return [results[i] for i in range(len(self.child_plans))]
 
 
 class DistConcatExec(NonLeafExecPlan):

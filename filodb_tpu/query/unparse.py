@@ -26,7 +26,7 @@ def _ms_dur(ms: int) -> str:
     return f"{ms}ms"
 
 
-def _selector(filters, window_ms=None, offset_ms=0) -> str:
+def _selector(filters, window_ms=None, offset_ms=0, at_ms=None) -> str:
     metric = ""
     matchers = []
     for f in filters:
@@ -40,6 +40,10 @@ def _selector(filters, window_ms=None, offset_ms=0) -> str:
         s += f"[{_ms_dur(window_ms)}]"
     if offset_ms:
         s += f" offset {_ms_dur(offset_ms)}"
+    if at_ms is not None:
+        # full decimal form — %g would render 1.6e+09, which @ can't parse
+        at = f"{at_ms / 1000:.3f}".rstrip("0").rstrip(".")
+        s += f" @ {at}"
     return s
 
 
@@ -54,11 +58,12 @@ def to_promql(p: L.LogicalPlan) -> str:
         w = p.end_ms - p.start_ms
         return _selector(p.filters, window_ms=w, offset_ms=p.offset_ms)
     if isinstance(p, L.PeriodicSeries):
-        return _selector(p.raw.filters, offset_ms=p.offset_ms)
+        return _selector(p.raw.filters, offset_ms=p.offset_ms, at_ms=p.at_ms)
     if isinstance(p, L.PeriodicSeriesWithWindowing):
         surface = _KERNEL_TO_SURFACE.get(p.function, p.function)
         _, n_scalar, scalars_first = RANGE_FUNCTIONS.get(surface, (p.function, 0, False))
-        sel = _selector(p.raw.filters, window_ms=p.window_ms, offset_ms=p.offset_ms)
+        sel = _selector(p.raw.filters, window_ms=p.window_ms, offset_ms=p.offset_ms,
+                        at_ms=p.at_ms)
         args = list(p.function_args)
         if args and scalars_first:
             return f"{surface}({_args_str(args)},{sel})"

@@ -53,8 +53,36 @@ class FiloServer:
             max_partitions=int(cfg["max_partitions_per_shard"]),
             index_backend=cfg["index_backend"],
         )
+        # multi-host: join the JAX distributed runtime (no-op single-process)
+        # and own only this process's shard slice (reference v2 cluster:
+        # ordinal -> shards, FiloDbClusterDiscovery)
+        from .parallel.multihost import init_distributed, shards_for_process
+
+        dist_cfg = cfg.get("distributed") or {}
+        self.peers = tuple(dist_cfg.get("peers") or ())
+        self.is_distributed = init_distributed(
+            dist_cfg.get("coordinator"),
+            dist_cfg.get("num_processes"),
+            dist_cfg.get("process_id"),
+        )
+        if dist_cfg.get("owned_shards") is not None:
+            owned = list(dist_cfg["owned_shards"])  # explicit (k8s static / tests)
+        elif self.is_distributed:
+            owned = shards_for_process(self.n_shards)
+        elif self.peers:
+            # peers configured but nothing assigns THIS process a slice:
+            # every host would own (and ingest) everything, and scattered
+            # queries would double-count — refuse at startup
+            raise ValueError(
+                "distributed.peers requires distributed.owned_shards or a "
+                "JAX coordinator to assign this process's shard slice"
+            )
+        else:
+            owned = range(self.n_shards)
         self.memstore = TimeSeriesMemStore(self.store_config)
-        self.memstore.setup(Dataset(self.dataset), range(self.n_shards))
+        # total_shards pins the routing modulus to the CLUSTER size even when
+        # this process owns a partial slice
+        self.memstore.setup(Dataset(self.dataset), owned, total_shards=self.n_shards)
         for q in cfg.get("quotas", []):
             for sh in self.memstore.shards(self.dataset):
                 sh.cardinality.set_quota(tuple(q["prefix"]), int(q["quota"]))
@@ -105,17 +133,44 @@ class FiloServer:
                 parallelism=int(qcfg["parallelism"]),
                 max_queued=int(qcfg.get("max_queued", 64)),
             )
+        common = dict(
+            spread=self.spread,
+            lookback_ms=int(qcfg["lookback_ms"]),
+            max_series=int(qcfg["max_series"]),
+            deadline_s=float(qcfg["timeout_s"]),
+            agg_rules=self.agg_rules,
+            scheduler=self.scheduler,
+            num_shards=self.n_shards,
+        )
         self.engine = QueryEngine(
             self.memstore, self.dataset,
             PlannerParams(
-                spread=self.spread,
-                lookback_ms=int(qcfg["lookback_ms"]),
-                max_series=int(qcfg["max_series"]),
-                deadline_s=float(qcfg["timeout_s"]),
-                agg_rules=self.agg_rules,
-                scheduler=self.scheduler,
+                peer_endpoints=self.peers,
+                remote_auth_token=cfg.get("http_auth_token"),
+                **common,
             ),
         )
+        # peers hit this engine (X-FiloDB-Local): answers from owned shards
+        # only, never re-scatters — the multi-host anti-recursion guard.
+        # It runs OFF the bounded scheduler: scattering root queries hold
+        # scheduler workers while blocking on peer HTTP, so routing the
+        # peers' subqueries through the same pool would deadlock the cluster
+        # (every worker waiting on the other host). Subquery concurrency is
+        # bounded by the peers' own scheduler caps.
+        self.local_engine = (
+            QueryEngine(
+                self.memstore, self.dataset,
+                PlannerParams(**{**common, "scheduler": None}),
+            )
+            if self.peers else None
+        )
+        if self.peers and not cfg.get("http_auth_token"):
+            log.warning(
+                "multi-host peers configured WITHOUT http_auth_token: any "
+                "client sending X-FiloDB-Local reaches the shard-local "
+                "engine (partial results, no admission control) — set a "
+                "token so only peers can"
+            )
         self.profiler = None
         if cfg["profiler"]["enabled"]:
             from .metrics import SamplingProfiler
@@ -132,7 +187,8 @@ class FiloServer:
         offsets for the ingestion sources. Downsample datasets recover too
         (they have no replay stream — their tail rebuilds from raw flushes)."""
         offsets = {}
-        for s in range(self.n_shards):
+        owned = self.memstore.shard_nums(self.dataset)
+        for s in owned:
             offsets[s] = recover_shard(self.memstore, self.column_store, self.dataset, s)
         if self.downsampler is not None:
             from .core.schemas import Dataset as _DS
@@ -140,10 +196,11 @@ class FiloServer:
 
             for period in self.downsampler.periods_ms:
                 ds = self.downsampler.dataset_for(period)
-                self.memstore.setup(_DS(ds, schemas=[DS_GAUGE]), range(self.n_shards))
-                for s in range(self.n_shards):
+                self.memstore.setup(_DS(ds, schemas=[DS_GAUGE]), owned,
+                                    total_shards=self.n_shards)
+                for s in owned:
                     recover_shard(self.memstore, self.column_store, ds, s)
-        log.info("recovered %d shards: %s", self.n_shards, offsets)
+        log.info("recovered %d shards: %s", len(owned), offsets)
         return offsets
 
     def start(self, port: int | None = None) -> int:
@@ -153,6 +210,7 @@ class FiloServer:
         self._http, actual_port = serve_background(
             self.engine, port=self.http_port if port is None else port,
             auth_token=self.config.get("http_auth_token"),
+            local_engine=self.local_engine,
         )
         t = threading.Thread(target=self._maintenance_loop, daemon=True)
         t.start()
