@@ -355,16 +355,7 @@ class PromApiHandler(BaseHTTPRequestHandler):
         p = self._params()
         prefix = [x for x in (self._q(p, "prefix", "") or "").split(",") if x]
         depth = int(self._q(p, "depth", str(len(prefix) + 1)))
-        merged: dict[tuple, dict] = {}
-        for sh in self.engine.memstore.shards(self.engine.dataset):
-            for rec in sh.cardinality.scan(prefix, depth):
-                slot = merged.setdefault(
-                    rec.prefix, {"prefix": list(rec.prefix), "ts_count": 0, "active": 0, "children": 0}
-                )
-                slot["ts_count"] += rec.ts_count
-                slot["active"] += rec.active_ts_count
-                slot["children"] = max(slot["children"], rec.children)
-        out = sorted(merged.values(), key=lambda r: -r["ts_count"])
+        out = self._engine_for_request().ts_cardinalities(prefix, depth)
         return self._send(200, J.success(out))
 
     def _query_exemplars(self):

@@ -1,0 +1,115 @@
+"""Python client for a filodb-tpu server (reference L5 client package:
+client/LocalClient.scala QueryOps/ClusterOps ask-pattern wrappers — here a
+thin typed wrapper over the HTTP API with the same hardened transport the
+cluster uses internally: gzip, bearer auth, bounded retries).
+
+    from filodb_tpu.client import FiloClient
+    c = FiloClient("http://localhost:9090", token="...")
+    c.ingest_prom('http_requests_total{job="api"} 42 1600000000000')
+    ts, series = c.query_range('rate(http_requests_total[5m])', 1600000350, 1600000590, 60)
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.parse
+import urllib.request
+from typing import Mapping, Sequence
+
+import numpy as np
+
+from .coordinator.planners import fetch_json
+
+
+class FiloClient:
+    def __init__(self, endpoint: str, token: str | None = None, timeout: float = 60):
+        self.endpoint = endpoint.rstrip("/")
+        self.token = token
+        self.timeout = timeout
+
+    # -- queries (reference QueryOps) --------------------------------------
+
+    def _get(self, path: str, **params):
+        qs = urllib.parse.urlencode(
+            [(k, v) for k, vs in params.items() for v in (vs if isinstance(vs, (list, tuple)) else [vs]) if v is not None],
+        )
+        url = f"{self.endpoint}{path}" + (f"?{qs}" if qs else "")
+        return fetch_json(url, auth_token=self.token, timeout=self.timeout)
+
+    def query_range(self, promql: str, start_s: float, end_s: float, step_s: float):
+        """-> (times_s[np.ndarray], [{"metric": labels, "values": np.ndarray}]).
+        Values align on the shared step grid; missing steps are NaN."""
+        data = self._get(
+            "/api/v1/query_range", query=promql, start=start_s, end=end_s, step=step_s
+        )
+        # integer-ms grid arithmetic, matching the server (float floor-div
+        # would drop the last step: 0.3 // 0.1 == 2.0)
+        step_ms = max(round(step_s * 1000), 1)
+        n = round((end_s - start_s) * 1000) // step_ms + 1
+        times = start_s + np.arange(n) * (step_ms / 1000.0)
+        t2i = {round(float(t) * 1000): i for i, t in enumerate(times)}
+        series = []
+        for s in data.get("result", []):
+            row = np.full(n, np.nan)
+            for t, v in s.get("values", []):
+                i = t2i.get(round(float(t) * 1000))
+                if i is not None:
+                    row[i] = float(v)
+            series.append({"metric": s.get("metric", {}), "values": row})
+        return times, series
+
+    def query(self, promql: str, time_s: float | None = None):
+        """Instant query -> raw Prometheus ``data`` payload."""
+        return self._get("/api/v1/query", query=promql, time=time_s)
+
+    def labels(self, match: str | None = None) -> list[str]:
+        return self._get("/api/v1/labels", **{"match[]": match})
+
+    def label_values(self, label: str, match: str | None = None, limit: int | None = None) -> list[str]:
+        return self._get(f"/api/v1/label/{urllib.parse.quote(label)}/values",
+                         **{"match[]": match, "limit": limit})
+
+    def series(self, match: str) -> list[Mapping[str, str]]:
+        return self._get("/api/v1/series", **{"match[]": match})
+
+    def metadata(self) -> Mapping[str, list]:
+        return self._get("/api/v1/metadata")
+
+    def cardinality(self, prefix: Sequence[str] = (), depth: int | None = None):
+        return self._get("/api/v1/cardinality", prefix=",".join(prefix) or None, depth=depth)
+
+    def exemplars(self, promql: str, start_s: float, end_s: float):
+        return self._get("/api/v1/query_exemplars", query=promql, start=start_s, end=end_s)
+
+    # -- ingest / admin (reference ClusterOps) ------------------------------
+
+    def _post(self, path: str, body: bytes, content_type: str = "text/plain"):
+        headers = {"Content-Type": content_type}
+        if self.token:
+            headers["Authorization"] = f"Bearer {self.token}"
+        req = urllib.request.Request(
+            f"{self.endpoint}{path}", data=body, headers=headers, method="POST"
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            payload = json.loads(r.read())
+        if payload.get("status") != "success":
+            raise RuntimeError(f"ingest failed: {payload}")
+        return payload["data"]
+
+    def ingest_prom(self, exposition_text: str) -> int:
+        """Prometheus text exposition (supports # TYPE + OpenMetrics
+        exemplars). Returns rows ingested."""
+        return self._post("/ingest/prom", exposition_text.encode())["ingested"]
+
+    def ingest_influx(self, lines: str) -> int:
+        return self._post("/ingest/influx", lines.encode())["ingested"]
+
+    def ingest_rows(self, rows: Sequence[Mapping]) -> int:
+        """JSON-lines ingest: {"tags": {...}, "ts_ms": int, "value": float}."""
+        body = "\n".join(json.dumps(dict(r)) for r in rows).encode()
+        return self._post("/ingest", body, "application/json")["ingested"]
+
+    def health(self) -> Mapping:
+        url = f"{self.endpoint}/admin/health"
+        with urllib.request.urlopen(url, timeout=self.timeout) as r:
+            return json.loads(r.read())

@@ -73,6 +73,66 @@ def _filters_to_selector(filters) -> str:
     return "{" + ",".join(parts) + "}"
 
 
+def _scatter_fetch(urls, auth_token: str | None, prefix: str):
+    """Concurrent locally-pinned peer GETs over the shared retrying
+    transport; yields each peer's ``data`` payload."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from .planners import fetch_json
+
+    with ThreadPoolExecutor(max_workers=min(8, len(urls)),
+                            thread_name_prefix=prefix) as pool:
+        yield from pool.map(
+            lambda u: fetch_json(u, auth_token=auth_token, local_only=True), urls
+        )
+
+
+class TsCardinalitiesExec(ExecPlan):
+    """Cardinality scan by shard-key prefix (reference TsCardinalities
+    metadata plan / TsCardExec): merges every owned shard's cardinality trie
+    and, multi-host, the peers' locally-pinned scans."""
+
+    def __init__(self, prefix: Sequence[str], depth: int | None = None,
+                 peers: tuple = (), auth_token: str | None = None):
+        super().__init__()
+        self.prefix = tuple(prefix)
+        self.depth = depth if depth is not None else len(self.prefix) + 1
+        self.peers = tuple(peers)
+        self.auth_token = auth_token
+
+    def args_str(self) -> str:
+        return f"prefix={','.join(self.prefix)} depth={self.depth}"
+
+    def do_execute(self, ctx: QueryContext):
+        from ..query.rangevector import QueryResult
+
+        merged: dict[tuple, dict] = {}
+
+        def add(prefix: tuple, ts_count: int, active: int, children: int):
+            slot = merged.setdefault(
+                prefix, {"prefix": list(prefix), "ts_count": 0, "active": 0, "children": 0}
+            )
+            slot["ts_count"] += ts_count
+            slot["active"] += active
+            slot["children"] = max(slot["children"], children)
+
+        for sh in ctx.memstore.shards(ctx.dataset):
+            for rec in sh.cardinality.scan(list(self.prefix), self.depth):
+                add(rec.prefix, rec.ts_count, rec.active_ts_count, rec.children)
+        if self.peers:
+            import urllib.parse
+
+            q = f"prefix={urllib.parse.quote(','.join(self.prefix))}&depth={self.depth}"
+            urls = [f"{ep}/api/v1/cardinality?{q}" for ep in self.peers]
+            for data in _scatter_fetch(urls, self.auth_token, "filodb-card"):
+                for rec in data:
+                    add(tuple(rec["prefix"]), rec["ts_count"], rec["active"], rec["children"])
+        res = QueryResult()
+        res.metadata = sorted(merged.values(), key=lambda r: -r["ts_count"])
+        res.result_type = "metadata"
+        return res
+
+
 class MetadataExec(ExecPlan):
     """Label values/names & series metadata queries (reference
     MetadataExecPlan execs). With ``peers`` configured (multi-host), the
@@ -97,10 +157,8 @@ class MetadataExec(ExecPlan):
     def _peer_metadata(self) -> list:
         """Concurrent per-peer fetch over the shared retrying transport."""
         import urllib.parse
-        from concurrent.futures import ThreadPoolExecutor
 
         from ..core.schemas import METRIC_TAG
-        from .planners import fetch_json
 
         t = f"start={self.start_ms / 1000}&end={self.end_ms / 1000}"
         match = urllib.parse.quote(_filters_to_selector(self.filters)) if self.filters else None
@@ -119,18 +177,14 @@ class MetadataExec(ExecPlan):
                 url = f"{ep}/api/v1/series?{t}&match[]={match or urllib.parse.quote('{}')}"
             urls.append(url)
         out: list = []
-        with ThreadPoolExecutor(max_workers=min(8, len(urls)),
-                                thread_name_prefix="filodb-meta") as pool:
-            for data in pool.map(
-                lambda u: fetch_json(u, auth_token=self.auth_token, local_only=True), urls
-            ):
-                if self.kind == "series":
-                    out.extend(
-                        {(METRIC_TAG if k == "__name__" else k): v for k, v in d.items()}
-                        for d in data
-                    )
-                else:
-                    out.extend(data)
+        for data in _scatter_fetch(urls, self.auth_token, "filodb-meta"):
+            if self.kind == "series":
+                out.extend(
+                    {(METRIC_TAG if k == "__name__" else k): v for k, v in d.items()}
+                    for d in data
+                )
+            else:
+                out.extend(data)
         return out
 
     def do_execute(self, ctx: QueryContext):
@@ -443,6 +497,12 @@ class SingleClusterPlanner:
             )
         if isinstance(p, L.TopLevelSubquery):
             return self._materialize(p.inner)
+        if isinstance(p, L.TsCardinalities):
+            return TsCardinalitiesExec(
+                p.shard_key_prefix, p.num_groups,
+                peers=self.params.peer_endpoints,
+                auth_token=self.params.remote_auth_token,
+            )
         if isinstance(p, (L.LabelValues, L.LabelNames, L.SeriesKeysByFilters)):
             kind = {"LabelValues": "label_values", "LabelNames": "label_names",
                     "SeriesKeysByFilters": "series"}[type(p).__name__]
@@ -724,6 +784,10 @@ class QueryEngine:
         if limit:
             ep.limit = int(limit)
         return ep.execute(self.context()).metadata
+
+    def ts_cardinalities(self, prefix, depth: int | None = None):
+        plan = L.TsCardinalities(tuple(prefix), depth if depth is not None else len(tuple(prefix)) + 1)
+        return self.planner.materialize(plan).execute(self.context()).metadata
 
     def query_instant(self, promql: str, time_s: float):
         plan = query_to_logical_plan(promql, time_s, self.planner.params.lookback_ms)

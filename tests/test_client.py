@@ -1,0 +1,78 @@
+"""FiloClient tests (reference client-package specs: LocalClient
+QueryOps/ClusterOps against a running node)."""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.api.http import serve_background
+from filodb_tpu.client import FiloClient
+from filodb_tpu.coordinator.planner import QueryEngine
+from filodb_tpu.core.schemas import Dataset
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+
+BASE = 1_600_000_000_000
+
+
+@pytest.fixture(scope="module")
+def client():
+    ms = TimeSeriesMemStore()
+    ms.setup(Dataset("prometheus"), range(4))
+    engine = QueryEngine(ms, "prometheus")
+    srv, port = serve_background(engine)
+    yield FiloClient(f"http://127.0.0.1:{port}")
+    srv.shutdown()
+
+
+def test_ingest_and_query_range(client):
+    text = "# TYPE rq_total counter\n" + "\n".join(
+        f'rq_total{{job="api"}} {40 + 10 * i} {BASE + i * 60_000}' for i in range(10)
+    )
+    assert client.ingest_prom(text) == 10
+    ts, series = client.query_range(
+        "rate(rq_total[5m])", (BASE + 400_000) / 1000, (BASE + 540_000) / 1000, 60
+    )
+    assert len(series) == 1
+    assert series[0]["metric"]["job"] == "api"
+    vals = series[0]["values"]
+    assert len(vals) == len(ts) == 3
+    np.testing.assert_allclose(vals[np.isfinite(vals)], 10 / 60, rtol=1e-3)
+
+
+def test_instant_and_metadata(client):
+    client.ingest_rows([
+        {"tags": {"__name__": "g1", "kind": "x"}, "ts_ms": BASE, "value": 5.0}
+    ])
+    out = client.query("g1", (BASE + 100_000) / 1000)
+    assert out["resultType"] == "vector" and len(out["result"]) == 1
+    assert "rq_total" in client.labels() or "__name__" in client.labels()
+    assert "g1" in client.label_values("__name__")
+    md = client.metadata()
+    assert md["rq_total"][0]["type"] == "counter"
+    assert md["g1"][0]["type"] == "gauge"
+
+
+def test_series_and_cardinality_and_health(client):
+    client.ingest_rows([
+        {"tags": {"__name__": "sc_metric", "job": "api"}, "ts_ms": BASE, "value": 1.0}
+    ])
+    s = client.series('sc_metric{job="api"}')
+    assert len(s) == 1 and s[0]["__name__"] == "sc_metric"
+    card = client.cardinality()
+    assert card and card[0]["ts_count"] >= 1
+    assert client.health()["status"] == "healthy"
+
+
+def test_auth_roundtrip():
+    ms = TimeSeriesMemStore()
+    ms.setup(Dataset("prometheus"), [0])
+    engine = QueryEngine(ms, "prometheus")
+    srv, port = serve_background(engine, auth_token="tk")
+    try:
+        c = FiloClient(f"http://127.0.0.1:{port}", token="tk")
+        assert c.ingest_prom("m 1 1600000000000") == 1
+        assert "__name__" in c.labels()
+        bad = FiloClient(f"http://127.0.0.1:{port}")
+        with pytest.raises(Exception):
+            bad.labels()
+    finally:
+        srv.shutdown()
