@@ -122,7 +122,8 @@ def cmd_downsample_batch(args):
     dsm = TimeSeriesMemStore()
     d = ShardDownsampler(dsm, args.dataset,
                          periods_ms=tuple(int(m) * 60_000 for m in args.periods.split(",")))
-    n = batch_downsample(store, ms, args.dataset, shard_nums, dsm, d)
+    n = batch_downsample(store, ms, args.dataset, shard_nums, dsm, d,
+                         processes=args.processes)
     # persist the downsample datasets back to the store
     written = 0
     for period in d.periods_ms:
@@ -250,6 +251,9 @@ def main(argv=None):
     sp.add_argument("--store", required=True)
     sp.add_argument("--dataset", default="prometheus")
     sp.add_argument("--periods", default="5,60", help="minutes, comma-separated")
+    sp.add_argument("--processes", type=int, default=0,
+                    help="process-pool workers for the scan+reduce phase "
+                         "(one task per shard; the Spark-executor analog)")
     sp.set_defaults(fn=cmd_downsample_batch)
 
     sp = sub.add_parser("cardbust")

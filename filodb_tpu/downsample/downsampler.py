@@ -173,31 +173,103 @@ def _downsample_histogram(self, shard_num: int, part, chunks) -> int:
 ShardDownsampler._downsample_histogram = _downsample_histogram
 
 
-def batch_downsample(store, memstore, dataset: str, shard_nums, target_memstore,
-                     downsampler: ShardDownsampler) -> int:
-    """Batch job analog of spark-jobs DownsamplerMain: scan persisted chunks
-    from the column store and (re)build downsample datasets."""
-    from ..core.encodings import decode
-    from ..core.schemas import SCHEMAS, canonical_partkey
+def _value_columns(schemas: dict) -> dict[str, str]:
+    """{schema_name: value_column} for DOUBLE-valued schemas — the only
+    schema facts the scan+reduce phase needs, shipped to workers explicitly
+    so runtime-registered schemas survive the spawn boundary."""
+    return {
+        name: s.value_column
+        for name, s in schemas.items()
+        if s.value_column and s.column(s.value_column).ctype == ColumnType.DOUBLE
+    }
 
+
+def _downsample_shard_records(store, dataset: str, shard_num: int, periods_ms,
+                              value_cols: dict[str, str]):
+    """Scan one shard's persisted chunks and reduce each into downsample
+    records: [(period_ms, tags, out_ts, reduced_columns)]. Pure read+compute
+    — safe to run in a worker process (the Spark-executor analog)."""
+    from ..core.encodings import decode
+
+    out = []
+    for header, schema_name, encs in store.read_chunks(dataset, shard_num):
+        vcol = value_cols.get(schema_name)
+        if vcol is None:
+            continue
+        cols = dict(zip(header["cols"], encs))
+        if vcol not in cols:
+            continue
+        ts = decode(cols["timestamp"])
+        vals = decode(cols[vcol]).astype(np.float64)
+        for period in periods_ms:
+            out_ts, reduced = downsample_samples(ts, vals, period)
+            if len(out_ts):
+                out.append((period, dict(header["tags"]), out_ts, reduced))
+    return out
+
+
+def _downsample_shard_worker(store_root: str, dataset: str, shard_num: int,
+                             periods_ms, value_cols: dict[str, str]):
+    """Process-pool entry: opens its own store handle (file-backed, read
+    path is process-safe) and returns the reduced records."""
+    from ..store.columnstore import LocalColumnStore
+
+    return shard_num, _downsample_shard_records(
+        LocalColumnStore(store_root), dataset, shard_num, tuple(periods_ms), value_cols
+    )
+
+
+def batch_downsample(store, memstore, dataset: str, shard_nums, target_memstore,
+                     downsampler: ShardDownsampler, processes: int = 0) -> int:
+    """Batch job analog of spark-jobs DownsamplerMain: scan persisted chunks
+    from the column store and (re)build downsample datasets.
+
+    ``processes`` >= 1 distributes the scan+reduce phase over a spawn-based
+    process pool, one task per shard (the reference distributes Cassandra
+    token ranges over Spark executors); each shard's records ingest as its
+    worker finishes. Requires a LocalColumnStore (workers reopen it by root
+    path); other stores fall back in-process with a warning."""
+    import logging
+
+    from ..core.schemas import SCHEMAS
+
+    shard_nums = list(shard_nums)
+    value_cols = _value_columns(SCHEMAS)
     n = 0
-    for shard_num in shard_nums:
-        for header, schema_name, encs in store.read_chunks(dataset, shard_num):
-            schema = SCHEMAS.get(schema_name)
-            if schema is None:
-                continue
-            cols = dict(zip(header["cols"], encs))
-            vcol = schema.value_column
-            if vcol not in cols or schema.column(vcol).ctype != ColumnType.DOUBLE:
-                continue
-            ts = decode(cols["timestamp"])
-            vals = decode(cols[vcol]).astype(np.float64)
-            for period in downsampler.periods_ms:
-                out_ts, reduced = downsample_samples(ts, vals, period)
-                if len(out_ts) == 0:
-                    continue
-                ds = downsampler.dataset_for(period)
-                sb = SeriesBatch(DS_GAUGE, header["tags"], out_ts, reduced)
-                downsampler._shard(ds, shard_num).ingest_series(sb)
-                n += len(out_ts)
+
+    def ingest(shard_num, records):
+        nonlocal n
+        for period, tags, out_ts, reduced in records:
+            ds = downsampler.dataset_for(period)
+            sb = SeriesBatch(DS_GAUGE, tags, out_ts, reduced)
+            downsampler._shard(ds, shard_num).ingest_series(sb)
+            n += len(out_ts)
+
+    use_pool = processes >= 1
+    if use_pool and getattr(store, "root", None) is None:
+        logging.getLogger(__name__).warning(
+            "batch_downsample: store has no filesystem root; --processes "
+            "requested but running in-process"
+        )
+        use_pool = False
+    if use_pool:
+        import multiprocessing
+        from concurrent.futures import ProcessPoolExecutor, as_completed
+
+        # spawn, not fork: a forked child inherits the parent's initialized
+        # JAX/TPU backend state and can wedge on first device touch
+        ctx = multiprocessing.get_context("spawn")
+        with ProcessPoolExecutor(max_workers=min(max(processes, 1), len(shard_nums) or 1),
+                                 mp_context=ctx) as pool:
+            futs = [
+                pool.submit(_downsample_shard_worker, store.root, dataset, s,
+                            tuple(downsampler.periods_ms), value_cols)
+                for s in shard_nums
+            ]
+            for f in as_completed(futs):
+                ingest(*f.result())
+    else:
+        for s in shard_nums:
+            ingest(s, _downsample_shard_records(
+                store, dataset, s, downsampler.periods_ms, value_cols))
     return n

@@ -215,6 +215,37 @@ class TestDownsample:
         assert n > 0
         assert dsm.shard("ds_5m", 0).num_partitions == 2
 
+    def test_batch_downsample_process_pool_parity(self, tmp_path):
+        """The Spark-executor analog: the process-pool path produces exactly
+        the in-process results, shard for shard."""
+        store = LocalColumnStore(str(tmp_path))
+        ms = TimeSeriesMemStore(StoreConfig(max_chunk_size=100))
+        ms.setup(Dataset("ds"), [0, 1])
+        for s in (0, 1):
+            ms.ingest("ds", s, machine_metrics(n_series=3, n_samples=300, start_ms=BASE, seed=s))
+            FlushCoordinator(ms, store).flush_shard("ds", s)
+
+        def run(processes):
+            dsm = TimeSeriesMemStore()
+            dsm.setup(Dataset("ds_5m", schemas=[DS_GAUGE]), [0, 1])
+            dsm.setup(Dataset("ds_60m", schemas=[DS_GAUGE]), [0, 1])
+            d = ShardDownsampler(dsm, "ds")
+            n = batch_downsample(store, ms, "ds", [0, 1], dsm, d, processes=processes)
+            return n, dsm
+
+        n_seq, dsm_seq = run(0)
+        n_par, dsm_par = run(2)
+        assert n_par == n_seq > 0
+        for s in (0, 1):
+            sh_a, sh_b = dsm_seq.shard("ds_5m", s), dsm_par.shard("ds_5m", s)
+            assert sh_a.num_partitions == sh_b.num_partitions
+            for part in sh_a.partitions.values():
+                pid_b = sh_b._by_partkey[part.partkey]
+                ts_a, v_a = part.samples_in_range(0, 2**62, "avg")
+                ts_b, v_b = sh_b.partitions[pid_b].samples_in_range(0, 2**62, "avg")
+                np.testing.assert_array_equal(ts_a, ts_b)
+                np.testing.assert_allclose(v_a, v_b)
+
 
 class TestTornWrites:
     def test_truncated_segment_reads_prefix(self, tmp_path):
