@@ -79,6 +79,9 @@ def _matchers_from(expr: str) -> list[ColumnFilter]:
 
 class PromApiHandler(BaseHTTPRequestHandler):
     engine: QueryEngine = None  # set by server factory
+    # optional zero-arg flush hook (FiloServer.flush_now) behind POST
+    # /admin/flush (reference AdminRoutes; ops + crash-recovery tests)
+    flush_hook = None
     # engine answering from this process's shards only (no peer scatter);
     # selected by the X-FiloDB-Local header peers set — the multi-host
     # anti-recursion guard. None = same as engine. TRUST BOUNDARY: any
@@ -189,6 +192,15 @@ class PromApiHandler(BaseHTTPRequestHandler):
                 return self._send(200, J.success({"version": __version__, "application": "filodb-tpu"}))
             if path == "/admin/health":
                 return self._send(200, {"status": "healthy", "shards": len(self.engine.memstore.shards(self.engine.dataset))})
+            if path == "/admin/flush" and self.command == "POST":
+                if self.flush_hook is None:
+                    return self._send(404, J.error("not_found", "no flusher attached"))
+                self._read_body()  # drain: keep-alive connections desync otherwise
+                res = self.flush_hook()
+                return self._send(200, J.success({
+                    "chunks_written": res.chunks_written,
+                    "partkeys_written": res.partkeys_written,
+                }))
             if path == "/metrics":
                 return self._metrics()
             if path == "/api/v1/cardinality":
@@ -464,19 +476,22 @@ class PromApiHandler(BaseHTTPRequestHandler):
 
 def make_server(engine: QueryEngine, host: str = "127.0.0.1", port: int = 9090,
                 auth_token: str | None = None,
-                local_engine: QueryEngine | None = None) -> ThreadingHTTPServer:
+                local_engine: QueryEngine | None = None,
+                flush_hook=None) -> ThreadingHTTPServer:
     handler = type(
         "BoundHandler", (PromApiHandler,),
-        {"engine": engine, "auth_token": auth_token, "local_engine": local_engine},
+        {"engine": engine, "auth_token": auth_token, "local_engine": local_engine,
+         "flush_hook": staticmethod(flush_hook) if flush_hook else None},
     )
     return ThreadingHTTPServer((host, port), handler)
 
 
 def serve_background(engine: QueryEngine, host: str = "127.0.0.1", port: int = 0,
                      auth_token: str | None = None,
-                     local_engine: QueryEngine | None = None):
+                     local_engine: QueryEngine | None = None,
+                     flush_hook=None):
     """Start the API server on a thread; returns (server, actual_port)."""
-    srv = make_server(engine, host, port, auth_token, local_engine)
+    srv = make_server(engine, host, port, auth_token, local_engine, flush_hook)
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
     return srv, srv.server_address[1]

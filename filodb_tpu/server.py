@@ -211,6 +211,7 @@ class FiloServer:
             self.engine, port=self.http_port if port is None else port,
             auth_token=self.config.get("http_auth_token"),
             local_engine=self.local_engine,
+            flush_hook=self.flush_now,
         )
         t = threading.Thread(target=self._maintenance_loop, daemon=True)
         t.start()
@@ -251,11 +252,15 @@ class FiloServer:
 
     def flush_now(self):
         """Flush the primary dataset, then any downsample/aux datasets the
-        flush itself populated (so they persist and recover too)."""
+        flush itself populated (so they persist and recover too). Returns
+        the TOTAL across all datasets (the /admin/flush contract)."""
         res = self.flusher.flush_all(self.dataset)
         for ds in list(self.memstore._datasets):
             if ds != self.dataset:
-                self.flusher.flush_all(ds)
+                r = self.flusher.flush_all(ds)
+                res.chunks_written += r.chunks_written
+                res.partkeys_written += r.partkeys_written
+                res.groups_flushed += r.groups_flushed
         return res
 
 

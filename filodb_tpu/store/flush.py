@@ -37,8 +37,18 @@ class FlushCoordinator:
         self.downsampler = downsampler
         # optional PreaggMaintainer: accumulates :agg series during flush
         self.preagg = preagg
+        # one flush cycle at a time: concurrent flushes (maintenance loop +
+        # /admin/flush) would both collect the same unflushed chunks before
+        # either marks them flushed and double-write them to the store
+        import threading
+
+        self._lock = threading.RLock()
 
     def flush_shard(self, dataset: str, shard_num: int, offset: int | None = None) -> FlushResult:
+        with self._lock:
+            return self._flush_shard(dataset, shard_num, offset)
+
+    def _flush_shard(self, dataset: str, shard_num: int, offset: int | None = None) -> FlushResult:
         shard = self.memstore.shard(dataset, shard_num)
         res = FlushResult()
         offset = offset if offset is not None else shard.ingested_offset
@@ -73,11 +83,12 @@ class FlushCoordinator:
 
     def flush_all(self, dataset: str) -> FlushResult:
         total = FlushResult()
-        for s in self.memstore.shard_nums(dataset):
-            r = self.flush_shard(dataset, s)
-            total.chunks_written += r.chunks_written
-            total.partkeys_written += r.partkeys_written
-            total.groups_flushed += r.groups_flushed
+        with self._lock:
+            for s in self.memstore.shard_nums(dataset):
+                r = self._flush_shard(dataset, s)
+                total.chunks_written += r.chunks_written
+                total.partkeys_written += r.partkeys_written
+                total.groups_flushed += r.groups_flushed
         return total
 
 

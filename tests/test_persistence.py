@@ -435,3 +435,26 @@ class TestExemplarParsing:
     def test_plain_lines_unchanged(self):
         rows = list(parse_prom_text("m 1\nm2 NaN\n"))
         assert len(rows) == 2 and rows[0][:2] == ("m", {})
+
+
+class TestConcurrentFlush:
+    def test_racing_flushes_never_double_write(self, tmp_path):
+        """Review regression: concurrent flush cycles (maintenance loop vs
+        /admin/flush) must not write the same chunks twice."""
+        import json
+        import threading
+
+        store = LocalColumnStore(str(tmp_path))
+        ms = TimeSeriesMemStore(StoreConfig(max_chunk_size=100))
+        ms.setup(Dataset("ds"), [0])
+        ms.ingest("ds", 0, machine_metrics(n_series=4, n_samples=250, start_ms=BASE))
+        fc = FlushCoordinator(ms, store)
+        threads = [threading.Thread(target=lambda: fc.flush_all("ds")) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        frames = list(store.read_chunks("ds", 0))
+        # 4 series x 3 chunks (2 sealed + the 50-tail sealed at flush)
+        starts = [(json.dumps(h["tags"], sort_keys=True), h["start"]) for h, _, _ in frames]
+        assert len(starts) == len(set(starts)) == 12, "duplicate frames written"
