@@ -35,6 +35,14 @@ DEFAULTS: dict = {
     },
     # API
     "http_port": 9090,
+    # gRPC RemoteExec service (api/grpc_exec.py; reference PromQLGrpcServer +
+    # query_service.proto RemoteExec). null = disabled; 0 = ephemeral port.
+    # Peers declared as "grpc://host:port" in distributed.peers use it for
+    # binary plan-level scatter instead of PromQL-over-HTTP. grpc_host
+    # defaults loopback-only; multi-host deployments set "0.0.0.0" AND an
+    # http_auth_token (the service executes arbitrary queries).
+    "grpc_port": None,
+    "grpc_host": "127.0.0.1",
     # optional bearer token protecting /api/* (remote execs send it via
     # FILODB_REMOTE_TOKEN); null = open
     "http_auth_token": None,

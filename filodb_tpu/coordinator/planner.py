@@ -73,18 +73,15 @@ def _filters_to_selector(filters) -> str:
     return "{" + ",".join(parts) + "}"
 
 
-def _scatter_fetch(urls, auth_token: str | None, prefix: str):
-    """Concurrent locally-pinned peer GETs over the shared retrying
-    transport; yields each peer's ``data`` payload."""
+def _scatter_call(thunks, prefix: str):
+    """Run peer-call thunks concurrently; yields each result."""
     from concurrent.futures import ThreadPoolExecutor
 
-    from .planners import fetch_json
-
-    with ThreadPoolExecutor(max_workers=min(8, len(urls)),
+    if not thunks:
+        return
+    with ThreadPoolExecutor(max_workers=min(8, len(thunks)),
                             thread_name_prefix=prefix) as pool:
-        yield from pool.map(
-            lambda u: fetch_json(u, auth_token=auth_token, local_only=True), urls
-        )
+        yield from pool.map(lambda t: t(), thunks)
 
 
 class TsCardinalitiesExec(ExecPlan):
@@ -122,9 +119,21 @@ class TsCardinalitiesExec(ExecPlan):
         if self.peers:
             import urllib.parse
 
+            from .planners import fetch_json
+
             q = f"prefix={urllib.parse.quote(','.join(self.prefix))}&depth={self.depth}"
-            urls = [f"{ep}/api/v1/cardinality?{q}" for ep in self.peers]
-            for data in _scatter_fetch(urls, self.auth_token, "filodb-card"):
+            plan = L.TsCardinalities(self.prefix, self.depth)
+            thunks = []
+            for ep in self.peers:  # one pool across BOTH transports
+                if ep.startswith("grpc://"):
+                    from ..api.grpc_exec import remote_metadata
+
+                    thunks.append(lambda ep=ep: remote_metadata(ep, plan, self.auth_token))
+                else:
+                    url = f"{ep}/api/v1/cardinality?{q}"
+                    thunks.append(lambda url=url: fetch_json(
+                        url, auth_token=self.auth_token, local_only=True))
+            for data in _scatter_call(thunks, "filodb-card"):
                 for rec in data:
                     add(tuple(rec["prefix"]), rec["ts_count"], rec["active"], rec["children"])
         res = QueryResult()
@@ -154,16 +163,44 @@ class MetadataExec(ExecPlan):
         self.peers = tuple(peers)
         self.auth_token = auth_token
 
+    def _grpc_plan(self):
+        if self.kind == "label_values":
+            return L.LabelValues(self.label, self.filters, self.start_ms, self.end_ms)
+        if self.kind == "label_names":
+            return L.LabelNames(self.filters, self.start_ms, self.end_ms)
+        return L.SeriesKeysByFilters(self.filters, self.start_ms, self.end_ms)
+
     def _peer_metadata(self) -> list:
-        """Concurrent per-peer fetch over the shared retrying transport."""
+        """Concurrent per-peer fetch on ONE pool across both transports —
+        HTTP peers over the shared retrying transport (results normalized
+        from __name__ to internal tags), gRPC peers via plan-level
+        executePlan (already internal-tag form)."""
         import urllib.parse
 
         from ..core.schemas import METRIC_TAG
+        from .planners import fetch_json
+
+        def http_thunk(url):
+            def go():
+                data = fetch_json(url, auth_token=self.auth_token, local_only=True)
+                if self.kind == "series":
+                    return [
+                        {(METRIC_TAG if k == "__name__" else k): v for k, v in d.items()}
+                        for d in data
+                    ]
+                return list(data)
+            return go
 
         t = f"start={self.start_ms / 1000}&end={self.end_ms / 1000}"
         match = urllib.parse.quote(_filters_to_selector(self.filters)) if self.filters else None
-        urls = []
+        thunks = []
         for ep in self.peers:
+            if ep.startswith("grpc://"):
+                from ..api.grpc_exec import remote_metadata
+
+                plan = self._grpc_plan()
+                thunks.append(lambda ep=ep, plan=plan: remote_metadata(ep, plan, self.auth_token))
+                continue
             if self.kind == "label_values":
                 label = "__name__" if self.label == METRIC_TAG else self.label
                 url = f"{ep}/api/v1/label/{urllib.parse.quote(label)}/values?{t}"
@@ -175,16 +212,10 @@ class MetadataExec(ExecPlan):
                     url += f"&match[]={match}"
             else:  # series
                 url = f"{ep}/api/v1/series?{t}&match[]={match or urllib.parse.quote('{}')}"
-            urls.append(url)
+            thunks.append(http_thunk(url))
         out: list = []
-        for data in _scatter_fetch(urls, self.auth_token, "filodb-meta"):
-            if self.kind == "series":
-                out.extend(
-                    {(METRIC_TAG if k == "__name__" else k): v for k, v in d.items()}
-                    for d in data
-                )
-            else:
-                out.extend(data)
+        for data in _scatter_call(thunks, "filodb-meta"):
+            out.extend(data)
         return out
 
     def do_execute(self, ctx: QueryContext):
@@ -370,13 +401,25 @@ class SingleClusterPlanner:
         from ..query.unparse import to_promql
         from .planners import PromQlRemoteExec
 
-        q = to_promql(logical)
+        q = None
         leaves = []
         for ep in self.params.peer_endpoints:
-            r = PromQlRemoteExec(
-                ep, q, logical.start_ms, logical.end_ms, logical.step_ms or 1,
-                auth_token=self.params.remote_auth_token, local_only=True,
-            )
+            if ep.startswith("grpc://"):
+                # binary plan transport (reference executePlan): the logical
+                # subtree ships as protobuf — no unparse round-trip
+                from ..api.grpc_exec import GrpcPlanRemoteExec
+
+                r = GrpcPlanRemoteExec(
+                    ep, logical, auth_token=self.params.remote_auth_token,
+                    local_only=True,
+                )
+            else:
+                if q is None:
+                    q = to_promql(logical)
+                r = PromQlRemoteExec(
+                    ep, q, logical.start_ms, logical.end_ms, logical.step_ms or 1,
+                    auth_token=self.params.remote_auth_token, local_only=True,
+                )
             r.peer_logical = logical  # for aggregate pushdown rewriting
             leaves.append(r)
         return leaves
@@ -553,7 +596,10 @@ class SingleClusterPlanner:
             if leaf is None:
                 continue
             wrapped = L.Aggregate(p.op, leaf, p.params, p.by, p.without)
-            child.promql = to_promql(wrapped)
+            if hasattr(child, "push_aggregate"):  # gRPC: ship the plan itself
+                child.push_aggregate(wrapped)
+            else:
+                child.promql = to_promql(wrapped)
 
     def _try_join_pushdown(self, p: "L.BinaryJoin"):
         """Per-shard binary-join pushdown (reference materializeBinaryJoin

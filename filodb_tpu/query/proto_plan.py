@@ -1,0 +1,280 @@
+"""LogicalPlan / QueryResult <-> protobuf converters (reference analog:
+grpc/.../ProtoConverters.scala — ~4k lines of hand-written per-class
+message mapping for query_service.proto).
+
+Because every LogicalPlan node here is a frozen dataclass of primitives,
+tuples, and nested plans, one reflective codec over a registry of allowed
+kinds replaces all of that: encoding walks dataclass fields, decoding
+validates the kind name against the registry (the wire can never
+instantiate an unregistered class) and re-checks field names against the
+dataclass signature. Adding a plan node type requires zero converter work.
+
+Results travel as columnar frames: a ``[S, J]`` f32 matrix serializes as one
+``tobytes()`` (the device layout), not S*J row records.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import fields as dc_fields
+from dataclasses import is_dataclass
+
+import numpy as np
+
+from ..api import query_exec_pb2 as pb
+from ..core.filters import ColumnFilter
+from . import logical as L
+from .rangevector import Grid, QueryResult, QueryStats, ScalarResult
+
+# -- registry ---------------------------------------------------------------
+
+# every dataclass that may appear in a plan tree on the wire
+_KINDS: dict[str, type] = {"ColumnFilter": ColumnFilter}
+for _name in dir(L):
+    _cls = getattr(L, _name)
+    if isinstance(_cls, type) and is_dataclass(_cls) and issubclass(_cls, L.LogicalPlan):
+        _KINDS[_name] = _cls
+
+
+class PlanDecodeError(ValueError):
+    pass
+
+
+# -- plan encoding ----------------------------------------------------------
+
+
+def _encode_value(v, out: "pb.PlanValue") -> None:
+    if v is None:
+        out.none = True
+    elif isinstance(v, bool):  # before int: bool is an int subclass
+        out.bval = v
+    elif isinstance(v, (int, np.integer)):
+        out.ival = int(v)
+    elif isinstance(v, (float, np.floating)):
+        out.dval = float(v)
+    elif isinstance(v, str):
+        out.sval = v
+    elif is_dataclass(v):
+        out.node.CopyFrom(plan_to_proto(v))
+    elif isinstance(v, (tuple, list)):
+        lst = out.list
+        lst.SetInParent()  # an EMPTY tuple must still mark the oneof as set
+        for item in v:
+            _encode_value(item, lst.items.add())
+    else:
+        raise TypeError(f"cannot encode plan field value {v!r} ({type(v).__name__})")
+
+
+def plan_to_proto(plan) -> "pb.PlanNode":
+    kind = type(plan).__name__
+    if kind not in _KINDS:
+        raise TypeError(f"{kind} is not a registered plan kind")
+    node = pb.PlanNode(kind=kind)
+    for f in dc_fields(plan):
+        pf = node.fields.add(name=f.name)
+        _encode_value(getattr(plan, f.name), pf.value)
+    return node
+
+
+def _decode_value(v: "pb.PlanValue"):
+    which = v.WhichOneof("kind")
+    if which == "none" or which is None:
+        return None
+    if which == "dval":
+        return v.dval
+    if which == "ival":
+        return v.ival
+    if which == "sval":
+        return v.sval
+    if which == "bval":
+        return v.bval
+    if which == "node":
+        return proto_to_plan(v.node)
+    if which == "list":
+        return tuple(_decode_value(item) for item in v.list.items)
+    raise PlanDecodeError(f"unknown PlanValue kind {which}")
+
+
+def proto_to_plan(node: "pb.PlanNode"):
+    cls = _KINDS.get(node.kind)
+    if cls is None:
+        raise PlanDecodeError(f"unknown plan kind {node.kind!r}")
+    allowed = {f.name for f in dc_fields(cls)}
+    kw = {}
+    for f in node.fields:
+        if f.name not in allowed:
+            raise PlanDecodeError(f"{node.kind} has no field {f.name!r}")
+        kw[f.name] = _decode_value(f.value)
+    try:
+        return cls(**kw)
+    except TypeError as e:  # missing required fields etc.
+        raise PlanDecodeError(f"cannot build {node.kind}: {e}") from e
+
+
+def plan_to_bytes(plan) -> bytes:
+    return plan_to_proto(plan).SerializeToString()
+
+
+def plan_from_bytes(data: bytes):
+    return proto_to_plan(pb.PlanNode.FromString(data))
+
+
+# -- result framing ---------------------------------------------------------
+
+# series rows per GridChunk: bounds per-message size (~720 steps * 4B * 256
+# rows ~ 0.7 MB) under gRPC's default 4 MB cap with label headroom
+CHUNK_ROWS = 256
+
+
+def result_to_frames(res: QueryResult, chunk_rows: int = CHUNK_ROWS):
+    """Yield StreamFrames for a QueryResult (header/chunks per grid, then a
+    final stats frame)."""
+    for gi, g in enumerate(res.grids):
+        vals = np.ascontiguousarray(g.values_np(), np.float32)
+        hist = g.hist_np()
+        hdr = pb.StreamFrame()
+        hdr.header.grid_index = gi
+        hdr.header.start_ms = int(g.start_ms)
+        hdr.header.step_ms = int(g.step_ms)
+        hdr.header.num_steps = int(g.num_steps)
+        hdr.header.num_series = int(g.n_series)
+        hdr.header.stale = bool(g.stale)
+        if hist is not None:
+            hdr.header.has_hist = True
+            if g.les is not None:
+                hdr.header.les.extend(float(x) for x in np.asarray(g.les))
+        yield hdr
+        for lo in range(0, g.n_series, chunk_rows):
+            hi = min(lo + chunk_rows, g.n_series)
+            fr = pb.StreamFrame()
+            ch = fr.chunk
+            ch.grid_index = gi
+            ch.first_series = lo
+            for lbls in g.labels[lo:hi]:
+                sl = ch.labels.add()
+                for k in sorted(lbls):
+                    sl.pairs.add(name=k, value=str(lbls[k]))
+            ch.values_f32 = vals[lo:hi].tobytes()
+            if hist is not None:
+                ch.hist_f32 = np.ascontiguousarray(hist[lo:hi], np.float32).tobytes()
+            yield fr
+    if res.scalar is not None:
+        fr = pb.StreamFrame()
+        fr.scalar.start_ms = int(res.scalar.start_ms)
+        fr.scalar.step_ms = int(res.scalar.step_ms)
+        fr.scalar.num_steps = int(res.scalar.num_steps)
+        fr.scalar.values_f64 = np.ascontiguousarray(
+            np.asarray(res.scalar.values)[: res.scalar.num_steps], np.float64
+        ).tobytes()
+        yield fr
+    if res.metadata is not None:
+        fr = pb.StreamFrame()
+        fr.metadata.json = json.dumps(res.metadata)
+        yield fr
+    fin = pb.StreamFrame()
+    st = fin.stats
+    st.series_scanned = int(res.stats.series_scanned)
+    st.samples_scanned = int(res.stats.samples_scanned)
+    st.cpu_ns = int(res.stats.cpu_ns)
+    st.device_ns = int(res.stats.device_ns)
+    st.bytes_staged = int(res.stats.bytes_staged)
+    st.result_type = res.result_type
+    yield fin
+
+
+def error_frame(error_type: str, message: str) -> "pb.StreamFrame":
+    fr = pb.StreamFrame()
+    fr.error.error_type = error_type
+    fr.error.message = message
+    return fr
+
+
+class RemoteExecError(RuntimeError):
+    """Transport/internal remote failure. In-band TYPED errors (rejection,
+    deadline, query) re-raise as their local exception classes instead, so
+    the origin's API edge maps them to the same status codes as local
+    failures (503 backpressure / 503 timeout / 400 bad query)."""
+
+    def __init__(self, error_type: str, message: str):
+        super().__init__(f"{error_type}: {message}")
+        self.error_type = error_type
+
+
+def _raise_remote_error(error_type: str, message: str):
+    if error_type == "QueryRejected":
+        from ..coordinator.scheduler import QueryRejected
+
+        raise QueryRejected(f"remote: {message}")
+    if error_type == "DeadlineExceeded":
+        from .exec.transformers import QueryDeadlineExceeded
+
+        raise QueryDeadlineExceeded(f"remote: {message}")
+    if error_type in ("QueryError", "PlanDecodeError"):
+        from .exec.transformers import QueryError
+
+        raise QueryError(f"remote {error_type}: {message}")
+    raise RemoteExecError(error_type, message)
+
+
+def frames_to_result(frames) -> QueryResult:
+    """Assemble a QueryResult from a StreamFrame iterator; raises
+    RemoteExecError on an in-band error frame."""
+    res = QueryResult()
+    headers: dict[int, pb.GridHeader] = {}
+    rows: dict[int, list] = {}
+    for fr in frames:
+        which = fr.WhichOneof("frame")
+        if which == "header":
+            h = fr.header
+            headers[h.grid_index] = h
+            rows.setdefault(h.grid_index, [])
+        elif which == "chunk":
+            rows.setdefault(fr.chunk.grid_index, []).append(fr.chunk)
+        elif which == "scalar":
+            s = fr.scalar
+            res.scalar = ScalarResult(
+                s.start_ms, s.step_ms, s.num_steps,
+                np.frombuffer(s.values_f64, np.float64).copy(),
+            )
+            res.result_type = "scalar"
+        elif which == "metadata":
+            res.metadata = json.loads(fr.metadata.json)
+            res.result_type = "metadata"
+        elif which == "stats":
+            st = fr.stats
+            res.stats = QueryStats(
+                series_scanned=st.series_scanned,
+                samples_scanned=st.samples_scanned,
+                cpu_ns=st.cpu_ns,
+                device_ns=st.device_ns,
+                bytes_staged=st.bytes_staged,
+            )
+            if st.result_type:
+                res.result_type = st.result_type
+        elif which == "error":
+            _raise_remote_error(fr.error.error_type, fr.error.message)
+    for gi in sorted(headers):
+        h = headers[gi]
+        nb = len(h.les)
+        labels: list[dict] = []
+        vparts: list[np.ndarray] = []
+        hparts: list[np.ndarray] = []
+        for ch in sorted(rows.get(gi, ()), key=lambda c: c.first_series):
+            for sl in ch.labels:
+                labels.append({p.name: p.value for p in sl.pairs})
+            v = np.frombuffer(ch.values_f32, np.float32)
+            vparts.append(v.reshape(-1, h.num_steps) if h.num_steps else v.reshape(len(ch.labels), 0))
+            if h.has_hist and ch.hist_f32:
+                hn = np.frombuffer(ch.hist_f32, np.float32)
+                hparts.append(hn.reshape(-1, h.num_steps, nb))
+        if len(labels) != h.num_series:
+            raise RemoteExecError(
+                "Internal", f"grid {gi}: got {len(labels)} series, header says {h.num_series}"
+            )
+        vals = (np.concatenate(vparts) if vparts
+                else np.zeros((0, h.num_steps), np.float32)).copy()
+        hist = np.concatenate(hparts).copy() if hparts else None
+        les = np.asarray(h.les, np.float64) if h.has_hist and nb else None
+        res.grids.append(Grid(labels, h.start_ms, h.step_ms, h.num_steps, vals,
+                              hist=hist, les=les, stale=h.stale))
+    return res

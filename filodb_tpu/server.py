@@ -179,6 +179,8 @@ class FiloServer:
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._http = None
+        self._grpc = None
+        self.grpc_port = cfg.get("grpc_port")
 
     # -- lifecycle --------------------------------------------------------
 
@@ -213,6 +215,16 @@ class FiloServer:
             local_engine=self.local_engine,
             flush_hook=self.flush_now,
         )
+        if self.grpc_port is not None:
+            from .api.grpc_exec import serve_grpc
+
+            self._grpc, self.grpc_port = serve_grpc(
+                self.engine, port=int(self.grpc_port),
+                auth_token=self.config.get("http_auth_token"),
+                local_engine=self.local_engine,
+                host=self.config.get("grpc_host") or "127.0.0.1",
+            )
+            log.info("filodb-tpu gRPC RemoteExec on :%d", self.grpc_port)
         t = threading.Thread(target=self._maintenance_loop, daemon=True)
         t.start()
         self._threads.append(t)
@@ -223,6 +235,8 @@ class FiloServer:
         self._stop.set()
         if self._http:
             self._http.shutdown()
+        if self._grpc is not None:
+            self._grpc.stop(grace=0.5)
         if self.scheduler is not None:
             self.scheduler.shutdown()
 
