@@ -64,6 +64,41 @@ class StoreConfig:
     evict_target_fraction: float = 0.75
 
 
+class EvictablePartIdQueueSet:
+    """Dedup FIFO of headroom-eviction candidates (reference
+    memstore/EvictablePartIdQueueSet.scala — offer dedups; eviction consumes
+    from the head). Partitions enter when a flush task is cut for them (they
+    will soon have flushed chunks to reclaim) or when ODP pages chunks back
+    in; they leave when tier-2 eviction reclaims them or the partition is
+    removed. ``evict_for_headroom`` walks ONLY this set — partitions that
+    never flushed anything (nothing reclaimable) are never touched, and no
+    per-call sort of the whole partition map happens. A re-offer moves the
+    entry to the BACK, so the head is the least-recently-flushed (coldest)
+    partition — hot series that flush every cycle keep migrating away from
+    the eviction front."""
+
+    __slots__ = ("_q",)
+
+    def __init__(self):
+        self._q: dict[int, None] = {}  # insertion-ordered dedup set
+
+    def offer(self, part_id: int) -> None:
+        self._q.pop(part_id, None)  # move-to-back on re-offer
+        self._q[part_id] = None
+
+    def remove(self, part_id: int) -> None:
+        self._q.pop(part_id, None)
+
+    def snapshot(self) -> list[int]:
+        return list(self._q)
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    def __contains__(self, part_id: int) -> bool:
+        return part_id in self._q
+
+
 class TimeSeriesShard:
     def __init__(self, dataset: str, shard_num: int, config: StoreConfig | None = None):
         self.dataset = dataset
@@ -89,6 +124,8 @@ class TimeSeriesShard:
         # OnDemandPagingShard.scala:26 + DemandPagedChunkStore)
         self.odp_store = None
         self.odp_stats_pages = 0
+        # headroom-eviction candidates (reference EvictablePartIdQueueSet)
+        self.evictable = EvictablePartIdQueueSet()
         # index time-lifecycle state (reference TimeSeriesShard.scala:987-993
         # updateIndexWithEndTime): part ids currently marked "ended" in the
         # index, and the latest-sample watermark seen at the previous flush —
@@ -248,6 +285,7 @@ class TimeSeriesShard:
                 chunks = part.unflushed_chunks()
                 if chunks:
                     out.append((part, chunks))
+                    self.evictable.offer(pid)  # reclaimable once persisted
         return out
 
     def update_index_end_times(self) -> int:
@@ -300,6 +338,7 @@ class TimeSeriesShard:
                 self._ended.discard(pid)
                 self._flush_watermark.pop(pid, None)
                 self.evicted_keys.discard(part.partkey)
+                self.evictable.remove(pid)
                 self.stats.partitions_evicted += 1
         return dropped
 
@@ -322,7 +361,7 @@ class TimeSeriesShard:
     def evict_for_headroom(self, target_bytes: int | None = None) -> int:
         """Reclaim chunk memory until residency is under the watermark
         (reference evictForHeadroom, TimeSeriesShard.scala:1799). Two tiers,
-        least-recently-active partitions first:
+        least-recently-flushed candidates first:
 
         1. drop decoded arrays of flushed chunks (encoded form stays queryable);
         2. drop flushed chunks entirely — only when an ODP store is attached,
@@ -344,19 +383,25 @@ class TimeSeriesShard:
                 return 0
         freed = 0
         with self._lock:
-            parts = sorted(self.partitions.values(), key=lambda p: p.latest_ts())
-            for part in parts:
+            # walk ONLY the evictable candidate set (dedup FIFO ~
+            # least-recently-flushed), never the whole partition map
+            # (reference EvictablePartIdQueueSet consumption)
+            cands = [self.partitions[pid] for pid in self.evictable.snapshot()
+                     if pid in self.partitions]
+            for part in cands:
                 if resident - freed <= target:
                     break
                 freed += part.drop_decoded_flushed()
             if resident - freed > target and self.odp_store is not None:
-                for part in parts:
+                for part in cands:
                     if resident - freed <= target:
                         break
                     got = part.drop_flushed_chunks()
                     if got:
                         freed += got
                         self.evicted_keys.add(part.partkey)
+                        # fully reclaimed: re-enters the queue at next flush
+                        self.evictable.remove(part.part_id)
             if freed:
                 self._resident_last = resident - freed
                 self.version += 1
@@ -409,6 +454,7 @@ class TimeSeriesShard:
                 n += 1
             for part in need.values():
                 part.chunks.sort(key=lambda c: c.start_ts)
+                self.evictable.offer(part.part_id)  # paged-in = re-evictable
             if n:
                 self.version += 1
                 self.stage_cache.clear()

@@ -141,6 +141,7 @@ def recover_shard(memstore, store: ColumnStore, dataset: str, shard_num: int) ->
         # append + occasional sort is enough
         part.chunks.append(chunk)
         part.mark_flushed(chunk.end_ts)
+        shard.evictable.offer(part.part_id)  # recovered chunks are reclaimable
     for part in shard.partitions.values():
         part.chunks.sort(key=lambda c: c.start_ts)
     shard.version += 1

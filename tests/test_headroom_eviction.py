@@ -160,3 +160,68 @@ class TestHeadroomEviction:
         ms.ingest("ds", 0, machine_metrics(n_series=2, n_samples=100, start_ms=BASE))
         assert sh.evict_for_headroom() == 0
         assert sh.stats.headroom_evictions == 0
+
+
+class TestEvictablePartIdQueueSet:
+    """Dedup FIFO of eviction candidates (reference
+    EvictablePartIdQueueSet.scala): eviction touches only partitions that
+    flushed something, never the whole partition map."""
+
+    def test_offer_dedups_and_reoffer_moves_to_back(self):
+        """Head = least-recently-flushed: a hot partition that re-flushes
+        migrates away from the eviction front."""
+        from filodb_tpu.memstore.shard import EvictablePartIdQueueSet
+
+        q = EvictablePartIdQueueSet()
+        for pid in (3, 1, 3, 2, 1):
+            q.offer(pid)
+        assert q.snapshot() == [3, 2, 1]  # 3 and 1 re-offered -> moved back
+        assert len(q) == 3 and 2 in q
+        q.remove(1)
+        assert q.snapshot() == [3, 2] and 1 not in q
+
+    def test_flush_populates_candidates_and_eviction_consumes(self, tmp_path):
+        store = LocalColumnStore(str(tmp_path))
+        ms = TimeSeriesMemStore(_cfg())
+        ms.setup(Dataset("ds"), [0])
+        sh = ms.shard("ds", 0)
+        sh.odp_store = store
+        fc = FlushCoordinator(ms, store)
+        ms.ingest("ds", 0, machine_metrics(n_series=8, n_samples=400, start_ms=BASE))
+        assert len(sh.evictable) == 0  # nothing flushed yet
+        fc.flush_shard("ds", 0)
+        assert len(sh.evictable) == 8  # every flushed partition is a candidate
+        # tier-2 eviction to (near) zero: consumed candidates leave the queue
+        freed = sh.evict_for_headroom(target_bytes=0)
+        assert freed > 0
+        assert len(sh.evictable) == 0
+        assert len(sh.evicted_keys) == 8
+
+    def test_never_flushed_partitions_are_not_candidates(self):
+        ms = TimeSeriesMemStore(_cfg())
+        ms.setup(Dataset("ds"), [0])
+        sh = ms.shard("ds", 0)
+        ms.ingest("ds", 0, machine_metrics(n_series=5, n_samples=300, start_ms=BASE))
+        # unflushed-only shard: eviction has no candidates and frees nothing
+        assert sh.evict_for_headroom(target_bytes=0) == 0
+        assert len(sh.evictable) == 0
+
+    def test_recovery_reoffers_candidates(self, tmp_path):
+        from filodb_tpu.store.flush import recover_shard
+
+        store = LocalColumnStore(str(tmp_path))
+        ms = TimeSeriesMemStore(_cfg())
+        ms.setup(Dataset("ds"), [0])
+        sh = ms.shard("ds", 0)
+        sh.odp_store = store
+        fc = FlushCoordinator(ms, store)
+        ms.ingest("ds", 0, machine_metrics(n_series=6, n_samples=200, start_ms=BASE))
+        fc.flush_shard("ds", 0)
+        # fresh store process: recovery must repopulate the candidate set
+        ms2 = TimeSeriesMemStore(_cfg())
+        ms2.setup(Dataset("ds"), [0])
+        recover_shard(ms2, store, "ds", 0)
+        sh2 = ms2.shard("ds", 0)
+        assert len(sh2.evictable) == 6
+        sh2.odp_store = store
+        assert sh2.evict_for_headroom(target_bytes=0) > 0
