@@ -137,6 +137,33 @@ def cmd_downsample_batch(args):
     _print({"downsampled_rows": n, "chunks_written": written})
 
 
+def cmd_churn_find(args):
+    """Find churning labels in a persisted store (reference spark-jobs
+    LabelChurnFinder: HLL sketches of total-vs-active label values)."""
+    import os as _os
+    import time as _time
+
+    from .downsample.churn import LabelChurnFinder
+    from .store.columnstore import LocalColumnStore
+
+    store = LocalColumnStore(args.store)
+    shard_nums = sorted(
+        int(d.split("-")[1])
+        for d in _os.listdir(_os.path.join(args.store, args.dataset))
+        if d.startswith("shard-")
+    )
+    finder = LabelChurnFinder(
+        store, args.dataset, shard_nums, now_ms=int(_time.time() * 1000),
+        active_ms=int(args.active_hours * 3_600_000),
+    )
+    rows = finder.report(min_total=args.min_total, min_ratio=args.min_ratio)
+    _print([
+        {"prefix": list(r.prefix), "label": r.label, "total": r.total,
+         "active": r.active, "ratio": round(r.ratio, 2)}
+        for r in rows
+    ])
+
+
 def cmd_cardbust(args):
     """Delete persisted series matching a selector (reference
     CardinalityBusterMain)."""
@@ -255,6 +282,16 @@ def main(argv=None):
                     help="process-pool workers for the scan+reduce phase "
                          "(one task per shard; the Spark-executor analog)")
     sp.set_defaults(fn=cmd_downsample_batch)
+
+    sp = sub.add_parser("churn-find")
+    sp.add_argument("--store", required=True)
+    sp.add_argument("--dataset", default="prometheus")
+    sp.add_argument("--active-hours", type=float, default=2.0,
+                    help="liveness window: series ended within this many "
+                         "hours count as active")
+    sp.add_argument("--min-total", type=int, default=100)
+    sp.add_argument("--min-ratio", type=float, default=2.0)
+    sp.set_defaults(fn=cmd_churn_find)
 
     sp = sub.add_parser("cardbust")
     sp.add_argument("--store", required=True)

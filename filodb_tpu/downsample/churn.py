@@ -1,0 +1,161 @@
+"""Label-churn finder (reference spark-jobs
+``LabelChurnFinder``: a Spark job that scans the partkey tables and builds
+HyperLogLog sketches of per-label distinct-value counts — total vs active —
+to find labels whose values churn, the classic cardinality-killer).
+
+Batch-job shape mirrors the batch downsampler: per-shard scans build local
+sketches concurrently; HLL registers merge associatively at the driver
+(numpy ``maximum``), exactly the Spark executor → driver merge. Output is a
+report of ``(workspace, namespace, label)`` rows where
+``total_distinct / active_distinct`` exceeds a churn threshold: a label
+with 50k historical values but 200 live ones is re-keying itself (pod
+hashes, build ids) and deserves a quota or a drop rule.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
+from typing import Iterable, Sequence
+
+import numpy as np
+
+from ..core.schemas import METRIC_TAG
+
+DEFAULT_PRECISION = 12  # 4096 registers, ~1.6% standard error
+
+
+class HllSketch:
+    """Vectorized HyperLogLog over uint8 registers (stable 64-bit hashes via
+    blake2b so sketches merge across processes/hosts)."""
+
+    __slots__ = ("p", "m", "regs")
+
+    def __init__(self, precision: int = DEFAULT_PRECISION):
+        self.p = precision
+        self.m = 1 << precision
+        self.regs = np.zeros(self.m, np.uint8)
+
+    @staticmethod
+    def _hash64(value: str) -> int:
+        return int.from_bytes(
+            hashlib.blake2b(value.encode(), digest_size=8).digest(), "little"
+        )
+
+    def add(self, value: str) -> None:
+        h = self._hash64(value)
+        idx = h >> (64 - self.p)
+        rest = h & ((1 << (64 - self.p)) - 1)
+        # rank = leading zeros of the remaining bits + 1
+        rank = (64 - self.p) - rest.bit_length() + 1
+        if rank > self.regs[idx]:
+            self.regs[idx] = rank
+
+    def add_all(self, values: Iterable[str]) -> None:
+        for v in values:
+            self.add(v)
+
+    def merge(self, other: "HllSketch") -> "HllSketch":
+        assert self.p == other.p
+        np.maximum(self.regs, other.regs, out=self.regs)
+        return self
+
+    def estimate(self) -> float:
+        m = float(self.m)
+        alpha = 0.7213 / (1.0 + 1.079 / m)
+        est = alpha * m * m / float(np.sum(np.exp2(-self.regs.astype(np.float64))))
+        if est <= 2.5 * m:
+            zeros = int(np.count_nonzero(self.regs == 0))
+            if zeros:
+                return m * float(np.log(m / zeros))  # linear counting
+        return est
+
+
+@dataclass
+class ChurnRecord:
+    """One (shard-key prefix, label) churn finding."""
+
+    prefix: tuple[str, ...]  # (_ws_, _ns_)
+    label: str
+    total: int  # distinct values over all series ever persisted
+    active: int  # distinct values among currently-live series
+
+    @property
+    def ratio(self) -> float:
+        return self.total / max(self.active, 1)
+
+
+class LabelChurnFinder:
+    """Scan a column store's partkeys and sketch per-label churn.
+
+    ``active_ms`` defines liveness: a series is active when its persisted
+    end time is within ``active_ms`` of ``now_ms`` (end-time updates ride
+    the flush path, store/flush.py)."""
+
+    def __init__(self, store, dataset: str, shard_nums: Sequence[int],
+                 now_ms: int, active_ms: int = 2 * 3_600_000,
+                 precision: int = DEFAULT_PRECISION,
+                 shard_key_columns: tuple[str, ...] = ("_ws_", "_ns_")):
+        self.store = store
+        self.dataset = dataset
+        self.shard_nums = list(shard_nums)
+        self.now_ms = now_ms
+        self.active_ms = active_ms
+        self.precision = precision
+        self.skc = shard_key_columns
+
+    # -- per-shard map phase ---------------------------------------------
+
+    def _scan_shard(self, shard: int) -> dict[tuple, tuple[HllSketch, HllSketch]]:
+        """(prefix, label) -> (total sketch, active sketch) for one shard."""
+        out: dict[tuple, tuple[HllSketch, HllSketch]] = {}
+        cutoff = self.now_ms - self.active_ms
+        for rec in self.store.read_partkeys(self.dataset, shard):
+            tags = rec["tags"]
+            prefix = tuple(tags.get(c, "") for c in self.skc)
+            is_active = rec.get("end", 0) >= cutoff
+            for label, value in tags.items():
+                if label in self.skc or label == METRIC_TAG:
+                    continue
+                key = (prefix, label)
+                pair = out.get(key)
+                if pair is None:
+                    pair = (HllSketch(self.precision), HllSketch(self.precision))
+                    out[key] = pair
+                pair[0].add(value)
+                if is_active:
+                    pair[1].add(value)
+        return out
+
+    # -- driver-side reduce phase ----------------------------------------
+
+    def scan(self, workers: int = 4) -> dict[tuple, tuple[HllSketch, HllSketch]]:
+        merged: dict[tuple, tuple[HllSketch, HllSketch]] = {}
+        with ThreadPoolExecutor(max_workers=max(1, min(workers, len(self.shard_nums) or 1)),
+                                thread_name_prefix="filodb-churn") as pool:
+            for shard_map in pool.map(self._scan_shard, self.shard_nums):
+                for key, (tot, act) in shard_map.items():
+                    have = merged.get(key)
+                    if have is None:
+                        merged[key] = (tot, act)
+                    else:
+                        have[0].merge(tot)
+                        have[1].merge(act)
+        return merged
+
+    def report(self, min_total: int = 100, min_ratio: float = 2.0,
+               workers: int = 4) -> list[ChurnRecord]:
+        """Labels with ≥min_total distinct values and total/active ≥
+        min_ratio, worst churn first."""
+        out = []
+        for (prefix, label), (tot, act) in self.scan(workers).items():
+            total = int(round(tot.estimate()))
+            active = int(round(act.estimate()))
+            if total < min_total:
+                continue
+            rec = ChurnRecord(prefix, label, total, active)
+            if rec.ratio >= min_ratio:
+                out.append(rec)
+        out.sort(key=lambda r: -r.ratio)
+        return out

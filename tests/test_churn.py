@@ -1,0 +1,113 @@
+"""Label-churn finder (reference spark-jobs LabelChurnFinder — HLL sketches
+of total vs active distinct label values per (ws, ns, label))."""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.downsample.churn import ChurnRecord, HllSketch, LabelChurnFinder
+from filodb_tpu.store.columnstore import LocalColumnStore
+
+NOW = 1_600_100_000_000
+HOUR = 3_600_000
+
+
+class TestHllSketch:
+    def test_small_range_near_exact(self):
+        s = HllSketch()
+        s.add_all(f"v{i}" for i in range(100))
+        assert abs(s.estimate() - 100) <= 3  # linear-counting regime
+
+    def test_large_range_within_error(self):
+        s = HllSketch()
+        s.add_all(f"value-{i}" for i in range(20_000))
+        assert abs(s.estimate() - 20_000) / 20_000 < 0.05
+
+    def test_duplicates_not_counted(self):
+        s = HllSketch()
+        for _ in range(5):
+            s.add_all(f"v{i}" for i in range(500))
+        assert abs(s.estimate() - 500) <= 15
+
+    def test_merge_is_union(self):
+        a, b, u = HllSketch(), HllSketch(), HllSketch()
+        a.add_all(f"x{i}" for i in range(3000))
+        b.add_all(f"x{i}" for i in range(1500, 4500))  # overlaps a
+        u.add_all(f"x{i}" for i in range(4500))
+        a.merge(b)
+        assert a.estimate() == pytest.approx(u.estimate())  # register-exact
+
+    def test_hash_is_process_stable(self):
+        # blake2b, not python hash(): sketches built in other processes
+        # (Spark-executor analog) must merge meaningfully
+        assert HllSketch._hash64("pod-abc123") == HllSketch._hash64("pod-abc123")
+
+
+def _store_with_partkeys(tmp_path, n_shards=2):
+    """Synthesize a persisted partkey population:
+
+    - label 'pod': 400 historical values, only 10 still active -> churner
+    - label 'instance': 20 values, all active -> stable
+    """
+    store = LocalColumnStore(str(tmp_path))
+    for i in range(400):
+        shard = i % n_shards
+        active = i < 10
+        end = NOW - 10_000 if active else NOW - 50 * HOUR
+        tags = {
+            "_ws_": "demo", "_ns_": "app", "_metric_": "http_requests_total",
+            "pod": f"pod-{i:04d}", "instance": f"inst-{i % 20}",
+        }
+        store.write_partkey("prometheus", shard, tags, NOW - 100 * HOUR, end)
+    return store
+
+
+class TestLabelChurnFinder:
+    def test_flags_churner_not_stable_label(self, tmp_path):
+        store = _store_with_partkeys(tmp_path)
+        finder = LabelChurnFinder(store, "prometheus", [0, 1], now_ms=NOW,
+                                  active_ms=2 * HOUR)
+        rows = finder.report(min_total=50, min_ratio=2.0)
+        labels = [r.label for r in rows]
+        assert "pod" in labels
+        assert "instance" not in labels  # 20 total / 20 active: no churn
+        pod = rows[labels.index("pod")]
+        assert pod.prefix == ("demo", "app")
+        assert abs(pod.total - 400) / 400 < 0.1
+        assert pod.active <= 15  # ~10 live values
+        assert pod.ratio > 20
+
+    def test_cross_shard_values_dedup(self, tmp_path):
+        """The same value written in every shard counts once (HLL union),
+        unlike a naive per-shard sum."""
+        store = LocalColumnStore(str(tmp_path))
+        for shard in range(4):
+            for i in range(50):
+                store.write_partkey(
+                    "prometheus", shard,
+                    {"_ws_": "w", "_ns_": "n", "_metric_": "m", "zone": f"z{i}"},
+                    NOW - HOUR, NOW,
+                )
+        finder = LabelChurnFinder(store, "prometheus", range(4), now_ms=NOW)
+        sketches = finder.scan()
+        tot, act = sketches[(("w", "n"), "zone")]
+        assert abs(tot.estimate() - 50) <= 3
+        assert abs(act.estimate() - 50) <= 3
+
+    def test_shard_key_and_metric_tags_excluded(self, tmp_path):
+        store = _store_with_partkeys(tmp_path)
+        finder = LabelChurnFinder(store, "prometheus", [0, 1], now_ms=NOW)
+        for (prefix, label) in finder.scan():
+            assert label not in ("_ws_", "_ns_", "_metric_")
+
+    def test_cli_churn_find(self, tmp_path, capsys):
+        from filodb_tpu.cli import main
+
+        _store_with_partkeys(tmp_path)
+        main(["churn-find", "--store", str(tmp_path), "--min-total", "50"])
+        out = capsys.readouterr().out
+        assert "pod" in out and "ratio" in out
+
+
+class TestChurnRecord:
+    def test_ratio_guards_zero_active(self):
+        assert ChurnRecord(("w", "n"), "l", 100, 0).ratio == 100.0
