@@ -82,6 +82,8 @@ class PromApiHandler(BaseHTTPRequestHandler):
     # optional zero-arg flush hook (FiloServer.flush_now) behind POST
     # /admin/flush (reference AdminRoutes; ops + crash-recovery tests)
     flush_hook = None
+    members_hook = None
+    join_hook = None
     # engine answering from this process's shards only (no peer scatter);
     # selected by the X-FiloDB-Local header peers set — the multi-host
     # anti-recursion guard. None = same as engine. TRUST BOUNDARY: any
@@ -192,6 +194,22 @@ class PromApiHandler(BaseHTTPRequestHandler):
                 return self._send(200, J.success({"version": __version__, "application": "filodb-tpu"}))
             if path == "/admin/health":
                 return self._send(200, {"status": "healthy", "shards": len(self.engine.memstore.shards(self.engine.dataset))})
+            if path == "/__members":
+                # cluster membership contract (reference akka-bootstrapper's
+                # /__members endpoint; coordinator/bootstrap.py). POST with
+                # {"url": ...} announces the caller (one-RTT join): we learn
+                # them, they get the member list back.
+                if self.members_hook is None:
+                    return self._send(404, J.error("not_found", "no bootstrapper attached"))
+                if self.command == "POST":
+                    try:
+                        body = json.loads(self._read_body() or b"{}")
+                    except ValueError:
+                        return self._send(400, J.error("bad_data", "invalid JSON body"))
+                    url = body.get("url")
+                    if url and self.join_hook is not None:
+                        self.join_hook(str(url), node_id=body.get("id"))
+                return self._send(200, J.success(self.members_hook()))
             if path == "/admin/flush" and self.command == "POST":
                 if self.flush_hook is None:
                     return self._send(404, J.error("not_found", "no flusher attached"))
@@ -478,6 +496,9 @@ def make_server(engine: QueryEngine, host: str = "127.0.0.1", port: int = 9090,
                 auth_token: str | None = None,
                 local_engine: QueryEngine | None = None,
                 flush_hook=None) -> ThreadingHTTPServer:
+    # membership hooks (members_hook/join_hook) are wired as class attrs on
+    # the returned server's RequestHandlerClass AFTER start — the registry
+    # needs the bound port for its self URL (server.py seed bootstrap)
     handler = type(
         "BoundHandler", (PromApiHandler,),
         {"engine": engine, "auth_token": auth_token, "local_engine": local_engine,

@@ -52,9 +52,15 @@ DEFAULTS: dict = {
     # FILODB_COORDINATOR/FILODB_NUM_PROCESSES/FILODB_PROCESS_ID override.
     # "peers": base URLs of the OTHER processes; "owned_shards": explicit
     # shard list for this process (default: ordinal slice of "shards").
+    # "seeds": bootstrap URLs polled for /__members at startup (the
+    # akka-bootstrapper whitelist analog); discovered members become query
+    # peers dynamically and a refresh loop ages dead ones out.
+    # "advertise_url": this node's URL as peers should reach it (required
+    # with seeds unless the default http://127.0.0.1:<port> is reachable).
     "distributed": {
         "coordinator": None, "num_processes": None, "process_id": None,
         "peers": [], "owned_shards": None,
+        "seeds": [], "advertise_url": None, "refresh_interval_s": 30,
     },
     # downsampling (reference downsample resolutions)
     "downsample": {"enabled": False, "periods_m": [5, 60]},

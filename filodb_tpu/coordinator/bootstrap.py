@@ -1,0 +1,219 @@
+"""Cluster seed bootstrap + membership (reference akka-bootstrapper:
+``ClusterSeedDiscovery.scala:13,70`` — a joining node asks configured seeds
+for the current members via the ``/__members`` HTTP contract, joins them,
+or self-seeds when it is the head of the whitelist and nobody answers).
+
+TPU-repo reframing: there is no Akka cluster to join — membership IS the
+peer list the query planners scatter to. So bootstrap resolves straight to
+``PlannerParams.peer_endpoints``: a node polls seed URLs (the whitelist
+analog; consul/DNS sources would plug in behind ``fetch``), unions the
+member lists, advertises itself, and a refresh loop keeps polling members
+so joins propagate gossip-style and dead nodes age out of the scatter set
+(the failure-detector analog of the reference's retries + health checks).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+log = logging.getLogger("filodb_tpu.bootstrap")
+
+
+class BootstrapError(RuntimeError):
+    pass
+
+
+class MemberRegistry:
+    """Known cluster members with last-seen times; thread-safe.
+
+    ``node_id`` is a process-unique identity carried in the /__members
+    payload: URL string equality cannot detect a node reaching ITSELF under
+    an alias (advertise_url vs 127.0.0.1), which would make it scatter to
+    its own shards and double-count — the id comparison can."""
+
+    def __init__(self, self_url: str, prune_after_s: float = 90.0):
+        import uuid
+
+        self.self_url = self_url.rstrip("/")
+        self.node_id = uuid.uuid4().hex
+        self.prune_after_s = prune_after_s
+        self._seen: dict[str, float] = {self.self_url: float("inf")}
+        self._aliases: set[str] = set()  # other URLs that turned out to be US
+        self._lock = threading.Lock()
+
+    def mark_self_alias(self, url: str) -> None:
+        """This url answered with OUR node id: it is this node under another
+        name. Exclude it from membership forever (hearsay re-mentions are
+        ignored too) — scattering to ourselves would double-count shards."""
+        url = url.rstrip("/")
+        with self._lock:
+            self._aliases.add(url)
+            self._seen.pop(url, None)
+
+    def _is_self(self, url: str) -> bool:
+        return url == self.self_url or url in self._aliases
+
+    def touch(self, urls, now: float | None = None) -> None:
+        """DIRECT contact (they answered us, or they reached us): refreshes
+        liveness. Hearsay must go through :meth:`learn` instead — otherwise
+        nodes keep re-reporting a dead member to each other and it never
+        ages out."""
+        now = time.time() if now is None else now
+        with self._lock:
+            for u in urls:
+                u = str(u).rstrip("/")
+                if u and u not in self._aliases:
+                    self._seen[u] = max(self._seen.get(u, 0.0), now)
+
+    def learn(self, urls, now: float | None = None) -> list[str]:
+        """Indirect mention: adds unknown members (so we start polling them)
+        without refreshing known ones. Returns newly-learned members."""
+        now = time.time() if now is None else now
+        new = []
+        with self._lock:
+            for u in urls:
+                u = str(u).rstrip("/")
+                if u and u not in self._seen and u not in self._aliases:
+                    new.append(u)
+                    self._seen[u] = now
+        return new
+
+    def prune(self, now: float | None = None) -> list[str]:
+        """Drop members not seen within the window; returns the dropped."""
+        now = time.time() if now is None else now
+        with self._lock:
+            dead = [u for u, ts in self._seen.items()
+                    if now - ts > self.prune_after_s]
+            for u in dead:
+                del self._seen[u]
+        return dead
+
+    def members(self) -> list[str]:
+        with self._lock:
+            return sorted(self._seen)
+
+    def peers(self) -> tuple[str, ...]:
+        """Everyone but self — the planner scatter set."""
+        return tuple(u for u in self.members() if u != self.self_url)
+
+    def snapshot(self) -> dict:
+        """The /__members payload."""
+        return {"self": self.self_url, "id": self.node_id,
+                "members": self.members()}
+
+
+class SeedBootstrapper:
+    """Join (or found) a cluster from a static seed list."""
+
+    def __init__(self, registry: MemberRegistry, seeds, auth_token: str | None = None,
+                 fetch=None, on_change=None, poll_timeout_s: float = 5.0):
+        self.registry = registry
+        self.seeds = [s.rstrip("/") for s in seeds]
+        self.auth_token = auth_token
+        if fetch is None:
+            from .planners import fetch_json
+
+            fetch = fetch_json
+        self._fetch = fetch  # url -> decoded /__members "data" payload
+        self.on_change = on_change  # called with registry.peers() on change
+        # short per-member timeout: a blackholed member must not stall the
+        # refresh loop past the prune window
+        self.poll_timeout_s = poll_timeout_s
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- discovery --------------------------------------------------------
+
+    def _poll(self, urls) -> tuple[list[str], list[str]]:
+        """Announce ourselves to each url (concurrently, short timeout) and
+        collect its member list — the one-RTT join: the peer learns us from
+        the POST body, we learn the cluster from the response. An answer
+        carrying OUR node id is ourselves under an alias and is dropped
+        (and remembered, so we never poll that alias again).
+        Returns (responders, mentioned)."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        targets = [u for u in urls if not self.registry._is_self(u)]
+
+        def ask(u):
+            try:
+                return u, self._fetch(
+                    f"{u}/__members", auth_token=self.auth_token,
+                    data={"url": self.registry.self_url,
+                          "id": self.registry.node_id},
+                    timeout=self.poll_timeout_s,
+                )
+            except Exception as e:  # noqa: BLE001 — unreachable seed is normal
+                log.debug("seed %s unreachable: %s", u, e)
+                return u, None
+
+        responders: list[str] = []
+        mentioned: list[str] = []
+        if not targets:
+            return responders, mentioned
+        with ThreadPoolExecutor(max_workers=min(8, len(targets)),
+                                thread_name_prefix="filodb-seed") as pool:
+            for u, data in pool.map(ask, targets):
+                if data is None:
+                    continue
+                if data.get("id") == self.registry.node_id:
+                    log.warning("seed %s is this node under an alias; ignoring", u)
+                    self.registry.mark_self_alias(u)
+                    continue
+                responders.append(u)
+                mentioned.extend(data.get("members", ()))
+        return responders, mentioned
+
+    def _absorb(self, responders, mentioned) -> None:
+        before = self.registry.peers()
+        self.registry.touch(responders)
+        self.registry.learn(mentioned)
+        self.registry.prune()
+        after = self.registry.peers()
+        if after != before and self.on_change:
+            self.on_change(after)
+
+    def bootstrap(self, retries: int = 5, backoff_s: float = 1.0) -> list[str]:
+        """Reference join flow: poll seeds; join whoever answers. When nobody
+        answers and we are the HEAD of the seed list, found a new cluster
+        (self-seed); otherwise retry — a non-head node must not split-brain
+        a fresh cluster into existence (ClusterSeedDiscovery:70)."""
+        for attempt in range(max(1, retries)):
+            responders, mentioned = self._poll(self.seeds)
+            if responders:
+                self._absorb(responders, mentioned)
+                return self.registry.members()
+            head = self.seeds[0] if self.seeds else self.registry.self_url
+            if self.registry._is_self(head):
+                log.info("self-seeding new cluster as %s", head)
+                return self.registry.members()
+            if attempt < retries - 1:
+                self._stop.wait(backoff_s * (attempt + 1))
+        raise BootstrapError(
+            f"no seed answered after {retries} attempts: {self.seeds}"
+        )
+
+    # -- refresh loop ------------------------------------------------------
+
+    def refresh_once(self) -> None:
+        """Poll every known member (gossip-style: joins propagate without
+        every node listing every seed), absorb answers, prune the dead."""
+        responders, mentioned = self._poll(self.registry.members())
+        self._absorb(responders, mentioned)
+
+    def start(self, interval_s: float = 30.0) -> None:
+        def loop():
+            while not self._stop.wait(interval_s):
+                try:
+                    self.refresh_once()
+                except Exception:  # noqa: BLE001
+                    log.exception("membership refresh failed")
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="filodb-bootstrap")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()

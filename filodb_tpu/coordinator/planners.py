@@ -48,12 +48,13 @@ _BACKOFF_S = (0.2, 0.8)
 
 
 def fetch_json(url: str, auth_token: str | None = None, local_only: bool = False,
-               timeout: float = 60) -> dict | list:
+               timeout: float = 60, data: dict | None = None) -> dict | list:
     """THE remote-HTTP fetch used by every cross-host path (query scatter,
-    federation, metadata): gzip transport, bearer auth, X-FiloDB-Local
-    pinning, bounded retries with backoff on transient failures (5xx /
-    connection errors / timeouts; 4xx fails fast). Returns the parsed
-    ``data`` payload of a successful Prometheus-shaped response."""
+    federation, metadata, membership): gzip transport, bearer auth,
+    X-FiloDB-Local pinning, bounded retries with backoff on transient
+    failures (5xx / connection errors / timeouts; 4xx fails fast). ``data``
+    switches to a JSON POST. Returns the parsed ``data`` payload of a
+    successful Prometheus-shaped response."""
     import gzip
     import time as _time
     import urllib.error
@@ -64,10 +65,14 @@ def fetch_json(url: str, auth_token: str | None = None, local_only: bool = False
         headers["Authorization"] = f"Bearer {auth_token}"
     if local_only:
         headers["X-FiloDB-Local"] = "1"
+    body = None
+    if data is not None:
+        body = json.dumps(data).encode()
+        headers["Content-Type"] = "application/json"
     last_err: Exception | None = None
     for attempt in range(_RETRIES):
         try:
-            req = urllib.request.Request(url, headers=headers)
+            req = urllib.request.Request(url, data=body, headers=headers)
             with urllib.request.urlopen(req, timeout=timeout) as r:
                 raw = r.read()
                 if r.headers.get("Content-Encoding") == "gzip":

@@ -60,6 +60,9 @@ class FiloServer:
 
         dist_cfg = cfg.get("distributed") or {}
         self.peers = tuple(dist_cfg.get("peers") or ())
+        self.seeds = tuple(dist_cfg.get("seeds") or ())
+        self.advertise_url = dist_cfg.get("advertise_url")
+        self.refresh_interval_s = float(dist_cfg.get("refresh_interval_s") or 30)
         self.is_distributed = init_distributed(
             dist_cfg.get("coordinator"),
             dist_cfg.get("num_processes"),
@@ -69,13 +72,13 @@ class FiloServer:
             owned = list(dist_cfg["owned_shards"])  # explicit (k8s static / tests)
         elif self.is_distributed:
             owned = shards_for_process(self.n_shards)
-        elif self.peers:
-            # peers configured but nothing assigns THIS process a slice:
-            # every host would own (and ingest) everything, and scattered
-            # queries would double-count — refuse at startup
+        elif self.peers or self.seeds:
+            # peers/seeds configured but nothing assigns THIS process a
+            # slice: every host would own (and ingest) everything, and
+            # scattered queries would double-count — refuse at startup
             raise ValueError(
-                "distributed.peers requires distributed.owned_shards or a "
-                "JAX coordinator to assign this process's shard slice"
+                "distributed.peers/seeds requires distributed.owned_shards "
+                "or a JAX coordinator to assign this process's shard slice"
             )
         else:
             owned = range(self.n_shards)
@@ -162,9 +165,9 @@ class FiloServer:
                 self.memstore, self.dataset,
                 PlannerParams(**{**common, "scheduler": None}),
             )
-            if self.peers else None
+            if (self.peers or self.seeds) else None
         )
-        if self.peers and not cfg.get("http_auth_token"):
+        if (self.peers or self.seeds) and not cfg.get("http_auth_token"):
             log.warning(
                 "multi-host peers configured WITHOUT http_auth_token: any "
                 "client sending X-FiloDB-Local reaches the shard-local "
@@ -181,6 +184,8 @@ class FiloServer:
         self._http = None
         self._grpc = None
         self.grpc_port = cfg.get("grpc_port")
+        self.bootstrapper = None
+        self.registry = None
 
     # -- lifecycle --------------------------------------------------------
 
@@ -215,6 +220,49 @@ class FiloServer:
             local_engine=self.local_engine,
             flush_hook=self.flush_now,
         )
+        if self.seeds:
+            # seed bootstrap (reference akka-bootstrapper): discover peers
+            # via /__members, expose our own membership, keep refreshing so
+            # joins propagate and dead peers age out of the scatter set
+            from .coordinator.bootstrap import MemberRegistry, SeedBootstrapper
+
+            self_url = self.advertise_url or f"http://127.0.0.1:{actual_port}"
+            self.registry = MemberRegistry(self_url)
+
+            def on_change(peers):
+                # compose with any statically configured peers (e.g. grpc://
+                # endpoints) — discovery must never drop them from scatter
+                merged = tuple(dict.fromkeys(self.peers + tuple(peers)))
+                log.info("cluster membership changed: peers=%s", list(merged))
+                self.engine.planner.params.peer_endpoints = merged
+
+            self.bootstrapper = SeedBootstrapper(
+                self.registry, self.seeds,
+                auth_token=self.config.get("http_auth_token"),
+                on_change=on_change,
+            )
+            def on_join(url, node_id=None):
+                if node_id and node_id == self.registry.node_id:
+                    self.registry.mark_self_alias(url)  # our own announce
+                    return
+                new = self.registry.learn([url])
+                self.registry.touch([url])  # they reached us: direct contact
+                if new:
+                    on_change(self.registry.peers())
+
+            self._http.RequestHandlerClass.members_hook = staticmethod(self.registry.snapshot)
+            self._http.RequestHandlerClass.join_hook = staticmethod(on_join)
+
+            def join():
+                try:
+                    self.bootstrapper.bootstrap()
+                except Exception:  # noqa: BLE001
+                    log.exception("seed bootstrap failed; refresh loop keeps trying")
+                self.bootstrapper.start(self.refresh_interval_s)
+
+            t0 = threading.Thread(target=join, daemon=True, name="filodb-join")
+            t0.start()
+            self._threads.append(t0)
         if self.grpc_port is not None:
             from .api.grpc_exec import serve_grpc
 
@@ -233,6 +281,8 @@ class FiloServer:
 
     def stop(self):
         self._stop.set()
+        if self.bootstrapper is not None:
+            self.bootstrapper.stop()
         if self._http:
             self._http.shutdown()
         if self._grpc is not None:
