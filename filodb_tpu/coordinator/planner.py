@@ -573,7 +573,52 @@ class SingleClusterPlanner:
         if simple and not isinstance(inner, DistConcatExec):
             inner.transformers.append(AggregateMapReduce(p.op, p.by, p.without))
             return ReduceAggregateExec([inner], p.op, p.by, p.without)
+        if (p.op in ("topk", "bottomk") and p.params
+                and isinstance(inner, DistConcatExec) and not inner.transformers):
+            # per-shard candidate pre-reduction (exact; see
+            # TopkCandidateFilter): root gathers O(shards*k), not O(series).
+            # Peer leaves ship the topk ITSELF (the peer's per-step winners
+            # are the exact candidate set) so O(k) rows cross the wire, not
+            # the peer's full matching series.
+            from ..query.exec.transformers import TopkCandidateFilter
+
+            k = max(int(p.params[0]), 1)
+            for child in inner.child_plans:
+                if getattr(child, "peer_logical", None) is not None:
+                    self._rewrite_peer_leaf(child, p)
+                else:
+                    child.transformers.append(
+                        TopkCandidateFilter(k, p.op == "bottomk", p.by, p.without)
+                    )
+        elif (p.op == "count_values" and p.params
+              and isinstance(inner, DistConcatExec) and not inner.transformers):
+            # per-shard counting (exact: disjoint series sum at the root;
+            # see CountValuesMapReduce) — O(groups x values) crosses the
+            # gather, not O(series). Peers ship count_values itself: their
+            # partial count rows merge by sum like local partials.
+            from ..query.exec.plans import CountValuesMergeExec
+            from ..query.exec.transformers import CountValuesMapReduce
+
+            for child in inner.child_plans:
+                if getattr(child, "peer_logical", None) is not None:
+                    self._rewrite_peer_leaf(child, p)
+                else:
+                    child.transformers.append(
+                        CountValuesMapReduce(str(p.params[0]), p.by, p.without)
+                    )
+            return CountValuesMergeExec(inner.child_plans)
         return AggregatePresentExec([inner], p.op, p.params, p.by, p.without)
+
+    def _rewrite_peer_leaf(self, child, p: "L.Aggregate") -> None:
+        """Ship the whole aggregate to a peer leaf instead of its raw
+        series (plan-level for gRPC, unparsed PromQL for HTTP)."""
+        from ..query.unparse import to_promql
+
+        wrapped = L.Aggregate(p.op, child.peer_logical, p.params, p.by, p.without)
+        if hasattr(child, "push_aggregate"):
+            child.push_aggregate(wrapped)
+        else:
+            child.promql = to_promql(wrapped)
 
     # aggregation ops where re-aggregating per-peer PARTIALS with the same
     # op is exact: sum of sums, min of mins, max of maxes, group of groups.
@@ -589,17 +634,9 @@ class SingleClusterPlanner:
         treats the peer's group partials exactly like local partials."""
         if p.op not in self._PEER_PUSH_OPS or p.params:
             return
-        from ..query.unparse import to_promql
-
         for child in children:
-            leaf = getattr(child, "peer_logical", None)
-            if leaf is None:
-                continue
-            wrapped = L.Aggregate(p.op, leaf, p.params, p.by, p.without)
-            if hasattr(child, "push_aggregate"):  # gRPC: ship the plan itself
-                child.push_aggregate(wrapped)
-            else:
-                child.promql = to_promql(wrapped)
+            if getattr(child, "peer_logical", None) is not None:
+                self._rewrite_peer_leaf(child, p)
 
     def _try_join_pushdown(self, p: "L.BinaryJoin"):
         """Per-shard binary-join pushdown (reference materializeBinaryJoin

@@ -691,18 +691,55 @@ class ReduceAggregateExec(NonLeafExecPlan):
         return _present(self.op, key_to, meta)
 
 
+class CountValuesMergeExec(NonLeafExecPlan):
+    """Root merge for pushed-down count_values: children's partial count
+    rows (CountValuesMapReduce) merge by identical label set with SUM —
+    exact because shards own disjoint series."""
+
+    def do_execute(self, ctx: QueryContext) -> QueryResult:
+        grids = []
+        for r in self.execute_children(ctx):
+            grids.extend(r.grids)
+        if not grids:
+            return QueryResult()
+        meta = grids[0]
+        J = meta.num_steps
+        merged: dict[tuple, np.ndarray] = {}
+        keys: dict[tuple, dict] = {}
+        for g in grids:
+            vals = g.values_np()
+            for i, lbls in enumerate(g.labels):
+                key = tuple(sorted(lbls.items()))
+                row = vals[i, :J]
+                have = merged.get(key)
+                if have is None:
+                    merged[key] = np.array(row, np.float32)
+                    keys[key] = lbls
+                else:
+                    # NaN-aware sum: count + absent = count
+                    a, b = have, row
+                    both = np.isfinite(a) & np.isfinite(b)
+                    only_b = ~np.isfinite(a) & np.isfinite(b)
+                    a[both] += b[both]
+                    a[only_b] = b[only_b]
+        labels = [keys[k] for k in merged]
+        v = (np.stack(list(merged.values())) if merged
+             else np.zeros((0, J), np.float32))
+        return QueryResult(grids=[Grid(labels, meta.start_ms, meta.step_ms, J, v)])
+
+
 class AggregatePresentExec(NonLeafExecPlan):
     """Root aggregation for non-mergeable ops (topk/bottomk/quantile/
     count_values): children concat full series to the root.
 
-    KNOWN SCALE LIMIT (documented, deliberate): the reference spills per-shard
-    k-heaps / t-digests through RecordContainers (aggregator/TopkRowAggregator,
-    QuantileRowAggregator) so the root only sees O(k) rows per shard; here the
-    root gathers the full matching series set and reduces in one vectorized
-    pass. Fine through ~1M series x moderate steps (one [S, J] host array);
-    the mesh path (MeshQuantileExec and per-shard top-k pre-reduction in
-    parallel/exec.py) is the road to reference-style scaling, applied when a
-    mesh is configured. ctx.max_series still bounds the gather."""
+    Scale: topk/bottomk children carry a TopkCandidateFilter map phase (the
+    reference TopkRowAggregator k-heap-spill analog) so the root gathers
+    O(shards*k) candidate rows, exactly; count_values pushes per-shard
+    counting (CountValuesMapReduce + CountValuesMergeExec); quantile scales
+    via the mesh sketch path (MeshQuantileExec) when a mesh is configured.
+    limitk and aggregates over arbitrary subtrees (joins) still gather the
+    full series set (one [S, J] host array, fine through ~1M series x
+    moderate steps; ctx.max_series bounds the gather)."""
 
     def __init__(self, child_plans, op: str, params=(), by=None, without=None):
         super().__init__(child_plans)

@@ -410,3 +410,98 @@ class AbsentFunctionMapper:
             if getattr(f, "op", "") == "=" and f.column not in (METRIC_TAG, "__name__")
         }
         return [Grid([labels], start_ms, step_ms, num_steps, vals)]
+
+
+@dataclass
+class TopkCandidateFilter:
+    """Per-shard map phase for root topk/bottomk (reference
+    TopBottomKRowAggregator's per-node k-heaps spilled via RecordContainers):
+    drop series that are NOT in this shard's per-(group, step) top-k at any
+    step. Exact, not approximate — if a series misses its shard-local top-k
+    at step j there are already >= k shard-local series beating it there, so
+    it cannot be in the global top-k at step j either; shipping a SUPERSET
+    of candidates never changes the root's exact reduction. Cuts the root
+    gather from O(series) to O(shards * k) rows per group."""
+
+    k: int
+    bottom: bool = False
+    by: tuple | None = None
+    without: tuple | None = None
+
+    def apply(self, grids: list[Grid]) -> list[Grid]:
+        from ...ops import aggregations as AGG
+
+        out = []
+        for g in grids:
+            if g.hist is not None or g.n_series <= self.k:
+                out.append(g)
+                continue
+            vals = g.values_np()
+            gids, group_labels = AGG.group_ids_for(
+                g.labels, list(self.by) if self.by else None,
+                list(self.without) if self.without else None,
+            )
+            keep = np.zeros(g.n_series, dtype=bool)
+            fill = np.inf if self.bottom else -np.inf
+            for gi in range(len(group_labels)):
+                rows = np.nonzero(gids == gi)[0]
+                if len(rows) <= self.k:
+                    keep[rows] = True
+                    continue
+                v = vals[rows]
+                vv = np.where(np.isnan(v), fill, v)
+                # kth best per step; >= / <= keeps ties (superset: still exact)
+                if self.bottom:
+                    thresh = np.partition(vv, self.k - 1, axis=0)[self.k - 1]
+                    cand = (vv <= thresh) & np.isfinite(v)
+                else:
+                    thresh = np.partition(vv, -self.k, axis=0)[-self.k]
+                    cand = (vv >= thresh) & np.isfinite(v)
+                keep[rows] |= cand.any(axis=1)
+            rows = np.nonzero(keep)[0]
+            out.append(Grid([g.labels[i] for i in rows], g.start_ms, g.step_ms,
+                            g.num_steps, vals[rows]))
+        return out
+
+
+@dataclass
+class CountValuesMapReduce:
+    """Per-shard map phase for root count_values (reference
+    CountValuesRowAggregator's per-node count maps spilled via
+    RecordContainers): emit one row per (group, value-string) holding this
+    shard's per-step counts. Shards own disjoint series, so the root merge
+    is an exact SUM of identical-label rows — O(groups x distinct-values)
+    crosses the gather, not O(series)."""
+
+    label: str
+    by: tuple | None = None
+    without: tuple | None = None
+
+    def apply(self, grids: list[Grid]) -> list[Grid]:
+        from ...ops import aggregations as AGG
+
+        if not grids:
+            return grids
+        all_labels = [l for g in grids for l in g.labels]
+        if not all_labels:
+            return [grids[0]]
+        J = max(g.values_np().shape[1] for g in grids)
+        vals = np.full((len(all_labels), J), np.nan, np.float32)
+        r0 = 0
+        for g in grids:
+            v = g.values_np()
+            vals[r0:r0 + v.shape[0], : v.shape[1]] = v
+            r0 += v.shape[0]
+        gids, group_labels = AGG.group_ids_for(
+            all_labels, list(self.by) if self.by else None,
+            list(self.without) if self.without else None,
+        )
+        meta = grids[0]
+        out_labels, out_rows = [], []
+        for gi, gl in enumerate(group_labels):
+            for valstr, row in AGG.count_values(vals[gids == gi]).items():
+                out_labels.append(dict(gl, **{self.label: valstr}))
+                out_rows.append(row[: meta.num_steps])
+        v = (np.stack(out_rows).astype(np.float32) if out_rows
+             else np.zeros((0, meta.num_steps), np.float32))
+        return [Grid(out_labels, meta.start_ms, meta.step_ms, meta.num_steps, v)]

@@ -1,0 +1,228 @@
+"""Per-shard topk/bottomk candidate pre-reduction (reference
+TopBottomKRowAggregator k-heap spill: root sees O(k) rows per node, not the
+full series set)."""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.coordinator.planner import QueryEngine
+from filodb_tpu.core.schemas import Dataset
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.query.exec.transformers import TopkCandidateFilter
+from filodb_tpu.query.rangevector import Grid
+from filodb_tpu.testkit import machine_metrics
+
+BASE = 1_600_000_000_000
+
+
+def _grid(vals, labels=None):
+    vals = np.asarray(vals, np.float32)
+    labels = labels or [{"i": str(i)} for i in range(vals.shape[0])]
+    return Grid(labels, BASE, 60_000, vals.shape[1], vals)
+
+
+class TestTopkCandidateFilter:
+    def test_keeps_exactly_the_per_step_winners_union(self):
+        # series 0 wins step 0, series 3 wins step 1, series 1 is runner-up
+        # both steps; series 2 never reaches top-2
+        g = _grid([[9.0, 1.0], [8.0, 7.0], [1.0, 2.0], [2.0, 8.0]])
+        out = TopkCandidateFilter(k=2).apply([g])[0]
+        assert [l["i"] for l in out.labels] == ["0", "1", "3"]
+
+    def test_bottomk(self):
+        g = _grid([[9.0, 1.0], [8.0, 7.0], [1.0, 2.0], [2.0, 8.0]])
+        out = TopkCandidateFilter(k=1, bottom=True).apply([g])[0]
+        assert [l["i"] for l in out.labels] == ["0", "2"]  # step-1 / step-0 minima
+
+    def test_ties_kept_superset_is_exact(self):
+        g = _grid([[5.0], [5.0], [5.0], [1.0]])
+        out = TopkCandidateFilter(k=1).apply([g])[0]
+        # all three tied series survive (superset) — the root decides
+        assert [l["i"] for l in out.labels] == ["0", "1", "2"]
+
+    def test_grouping_is_per_group(self):
+        labels = [{"job": "a", "i": "0"}, {"job": "a", "i": "1"},
+                  {"job": "b", "i": "2"}, {"job": "b", "i": "3"}]
+        g = _grid([[9.0], [1.0], [2.0], [8.0]], labels)
+        out = TopkCandidateFilter(k=1, by=("job",)).apply([g])[0]
+        # one winner PER job group, even though job=b values are all lower
+        # than job=a's winner
+        assert [l["i"] for l in out.labels] == ["0", "3"]
+
+    def test_nan_rows_dropped(self):
+        g = _grid([[np.nan, np.nan], [1.0, 2.0], [3.0, 4.0]])
+        out = TopkCandidateFilter(k=2).apply([g])[0]
+        assert [l["i"] for l in out.labels] == ["1", "2"]
+
+    def test_small_grid_passthrough(self):
+        g = _grid([[1.0], [2.0]])
+        assert TopkCandidateFilter(k=5).apply([g])[0] is g
+
+
+class TestEngineTopkParity:
+    @pytest.fixture(scope="class")
+    def engine(self):
+        ms = TimeSeriesMemStore()
+        ms.setup(Dataset("prometheus"), range(8))
+        ms.ingest_routed(
+            "prometheus",
+            machine_metrics(n_series=40, n_samples=60, start_ms=BASE),
+            spread=3,
+        )
+        return QueryEngine(ms, "prometheus")
+
+    def test_pushdown_filter_is_planned_per_shard(self, engine):
+        from filodb_tpu.query.promql import query_range_to_logical_plan
+
+        plan = query_range_to_logical_plan(
+            "topk(3, heap_usage0)", (BASE + 400_000) / 1000, (BASE + 900_000) / 1000, 60)
+        tree = engine.planner.materialize(plan)
+        assert "TopkCandidateFilter" in tree.print_tree()
+
+    def test_topk_equals_full_matrix_oracle(self, engine):
+        s, e = (BASE + 400_000) / 1000, (BASE + 900_000) / 1000
+        full = engine.query_range("heap_usage0", s, e, 60)
+        fv = np.vstack([g.values_np() for g in full.grids])
+        fl = [l for g in full.grids for l in g.labels]
+        k = 3
+        res = engine.query_range(f"topk({k}, heap_usage0)", s, e, 60)
+        got = {}
+        for g in res.grids:
+            vals = g.values_np()
+            for i, lbl in enumerate(g.labels):
+                got[str(sorted(lbl.items()))] = vals[i]
+        # oracle: per step, k highest finite values survive with own labels
+        J = fv.shape[1]
+        want = {str(sorted(l.items())): np.full(J, np.nan, np.float32) for l in fl}
+        for j in range(J):
+            col = fv[:, j]
+            finite = np.nonzero(np.isfinite(col))[0]
+            top = finite[np.argsort(-col[finite], kind="stable")][:k]
+            for i in top:
+                want[str(sorted(fl[i].items()))][j] = col[i]
+        want = {kk: v for kk, v in want.items() if np.isfinite(v).any()}
+        assert set(got) == set(want)
+        for kk in want:
+            np.testing.assert_allclose(got[kk], want[kk], rtol=1e-5, equal_nan=True)
+
+    def test_bottomk_through_engine(self, engine):
+        s, e = (BASE + 400_000) / 1000, (BASE + 900_000) / 1000
+        res = engine.query_range("bottomk(2, heap_usage0)", s, e, 60)
+        vals = np.vstack([g.values_np() for g in res.grids])
+        # at most k finite values per step
+        assert (np.isfinite(vals).sum(axis=0) <= 2).all()
+
+
+class TestCountValuesPushdown:
+    @pytest.fixture(scope="class")
+    def engine(self):
+        ms = TimeSeriesMemStore()
+        ms.setup(Dataset("prometheus"), range(8))
+        ms.ingest_routed(
+            "prometheus",
+            machine_metrics(n_series=30, n_samples=40, start_ms=BASE),
+            spread=3,
+        )
+        return QueryEngine(ms, "prometheus")
+
+    def test_planned_as_per_shard_count_plus_merge(self, engine):
+        from filodb_tpu.query.promql import query_range_to_logical_plan
+
+        plan = query_range_to_logical_plan(
+            'count_values("v", heap_usage0)',
+            (BASE + 400_000) / 1000, (BASE + 900_000) / 1000, 60)
+        tree = engine.planner.materialize(plan)
+        printed = tree.print_tree()
+        assert "CountValuesMergeExec" in printed
+        assert "CountValuesMapReduce" in printed
+
+    def test_counts_match_full_matrix_oracle(self, engine):
+        s, e = (BASE + 400_000) / 1000, (BASE + 900_000) / 1000
+        full = engine.query_range("heap_usage0", s, e, 60)
+        fv = np.vstack([g.values_np() for g in full.grids])
+        res = engine.query_range('count_values("v", heap_usage0)', s, e, 60)
+        # total counted samples per step must equal finite samples per step
+        got_total = np.zeros(fv.shape[1])
+        for g in res.grids:
+            v = g.values_np()
+            got_total += np.where(np.isfinite(v), v, 0.0).sum(axis=0)
+        np.testing.assert_array_equal(got_total, np.isfinite(fv).sum(axis=0))
+        # and each reported (value, step) count matches a direct tally
+        for g in res.grids:
+            v = g.values_np()
+            for i, lbl in enumerate(g.labels):
+                x = float(lbl["v"])
+                for j in range(v.shape[1]):
+                    if np.isfinite(v[i, j]):
+                        want = np.sum(np.isclose(fv[:, j], x, rtol=1e-9, atol=0))
+                        assert v[i, j] == want, (lbl, j)
+
+
+class TestPeerPushdown:
+    """Multi-host: peers ship the topk/count_values themselves — O(k) /
+    O(values) rows cross the wire, not the peer's full series set."""
+
+    def _planner(self):
+        from filodb_tpu.coordinator.planner import PlannerParams, SingleClusterPlanner
+
+        ms = TimeSeriesMemStore()
+        ms.setup(Dataset("prometheus"), range(4))
+        return SingleClusterPlanner(
+            ms, "prometheus",
+            params=PlannerParams(num_shards=4, peer_endpoints=("grpc://peer:7",)),
+        )
+
+    def test_topk_shipped_to_peer(self):
+        from filodb_tpu.api.grpc_exec import GrpcPlanRemoteExec
+        from filodb_tpu.query import logical as L
+        from filodb_tpu.query.promql import query_range_to_logical_plan
+
+        pl = self._planner()
+        plan = query_range_to_logical_plan(
+            "topk(3, rate(http_requests_total[5m]))", 1_600_000_400, 1_600_000_900, 60)
+        tree = pl.materialize(plan)
+        remotes = [p for p in _walk(tree) if isinstance(p, GrpcPlanRemoteExec)]
+        assert len(remotes) == 1
+        shipped = remotes[0].logical_plan
+        assert isinstance(shipped, L.Aggregate) and shipped.op == "topk"
+        assert shipped.params == (3.0,)
+        assert not remotes[0].transformers  # nothing applied post-fetch
+
+    def test_count_values_shipped_to_peer_and_merged(self):
+        from filodb_tpu.api.grpc_exec import GrpcPlanRemoteExec
+        from filodb_tpu.query import logical as L
+        from filodb_tpu.query.promql import query_range_to_logical_plan
+
+        pl = self._planner()
+        plan = query_range_to_logical_plan(
+            'count_values("v", http_requests_total)', 1_600_000_400, 1_600_000_900, 60)
+        tree = pl.materialize(plan)
+        assert type(tree).__name__ == "CountValuesMergeExec"
+        remotes = [p for p in _walk(tree) if isinstance(p, GrpcPlanRemoteExec)]
+        assert len(remotes) == 1
+        shipped = remotes[0].logical_plan
+        assert isinstance(shipped, L.Aggregate) and shipped.op == "count_values"
+
+    def test_http_peer_gets_unparsed_topk(self):
+        from filodb_tpu.coordinator.planner import PlannerParams, SingleClusterPlanner
+        from filodb_tpu.coordinator.planners import PromQlRemoteExec
+        from filodb_tpu.query.promql import query_range_to_logical_plan
+
+        ms = TimeSeriesMemStore()
+        ms.setup(Dataset("prometheus"), range(4))
+        pl = SingleClusterPlanner(
+            ms, "prometheus",
+            params=PlannerParams(num_shards=4, peer_endpoints=("http://peer:9",)),
+        )
+        plan = query_range_to_logical_plan(
+            "topk(2, rate(http_requests_total[5m]))", 1_600_000_400, 1_600_000_900, 60)
+        tree = pl.materialize(plan)
+        remotes = [p for p in _walk(tree) if isinstance(p, PromQlRemoteExec)]
+        assert len(remotes) == 1
+        assert remotes[0].promql.startswith("topk(2,")
+
+
+def _walk(plan):
+    yield plan
+    for c in plan.children():
+        yield from _walk(c)
