@@ -282,5 +282,17 @@ def bench_serialization():
     dt = _bench(lambda: result_to_ipc(res))
     report("arrow_ipc_render", 1000 * 120 / dt / 1e6, "Msamples/s")
 
+    # gRPC columnar stream frames (query/proto_plan.py): serialize + parse
+    from filodb_tpu.query.proto_plan import frames_to_result, result_to_frames
+
+    def grpc_roundtrip():
+        wire = [f.SerializeToString() for f in result_to_frames(res)]
+        from filodb_tpu.api.query_exec_pb2 import StreamFrame
+
+        return frames_to_result(StreamFrame.FromString(b) for b in wire)
+
+    dt = _bench(grpc_roundtrip)
+    report("grpc_frames_roundtrip", 1000 * 120 / dt / 1e6, "Msamples/s")
+
 
 ALL.append(bench_serialization)

@@ -7,6 +7,12 @@ cluster uses internally: gzip, bearer auth, bounded retries).
     c = FiloClient("http://localhost:9090", token="...")
     c.ingest_prom('http_requests_total{job="api"} 42 1600000000000')
     ts, series = c.query_range('rate(http_requests_total[5m])', 1600000350, 1600000590, 60)
+
+With ``grpc_endpoint`` set, query_range/query ride the binary gRPC
+RemoteExec transport (columnar grid frames — no JSON parse of O(series x
+steps) samples); ingest/metadata/admin stay on HTTP:
+
+    c = FiloClient("http://host:9090", grpc_endpoint="grpc://host:7777")
 """
 
 from __future__ import annotations
@@ -21,11 +27,20 @@ import numpy as np
 from .coordinator.planners import fetch_json
 
 
+def _public_labels(lbls: Mapping[str, str]) -> dict:
+    """Internal tags -> Prometheus form (the JSON edge's mapping)."""
+    from .core.schemas import METRIC_TAG
+
+    return {("__name__" if k == METRIC_TAG else k): v for k, v in lbls.items()}
+
+
 class FiloClient:
-    def __init__(self, endpoint: str, token: str | None = None, timeout: float = 60):
+    def __init__(self, endpoint: str, token: str | None = None, timeout: float = 60,
+                 grpc_endpoint: str | None = None):
         self.endpoint = endpoint.rstrip("/")
         self.token = token
         self.timeout = timeout
+        self.grpc_endpoint = grpc_endpoint
 
     # -- queries (reference QueryOps) --------------------------------------
 
@@ -39,14 +54,28 @@ class FiloClient:
     def query_range(self, promql: str, start_s: float, end_s: float, step_s: float):
         """-> (times_s[np.ndarray], [{"metric": labels, "values": np.ndarray}]).
         Values align on the shared step grid; missing steps are NaN."""
-        data = self._get(
-            "/api/v1/query_range", query=promql, start=start_s, end=end_s, step=step_s
-        )
         # integer-ms grid arithmetic, matching the server (float floor-div
         # would drop the last step: 0.3 // 0.1 == 2.0)
         step_ms = max(round(step_s * 1000), 1)
         n = round((end_s - start_s) * 1000) // step_ms + 1
         times = start_s + np.arange(n) * (step_ms / 1000.0)
+        if self.grpc_endpoint:
+            res = self._grpc_exec(promql, start_s, end_s, step_ms)
+            series = []
+            if res.scalar is not None:  # scalar expression, e.g. 1+1
+                row = np.full(n, np.nan)
+                sv = np.asarray(res.scalar.values)[:n]
+                row[: len(sv)] = sv
+                series.append({"metric": {}, "values": row})
+            for g in res.grids:
+                vals = g.values_np()
+                for i, lbls in enumerate(g.labels):
+                    series.append({"metric": _public_labels(lbls),
+                                   "values": vals[i, :n].astype(np.float64)})
+            return times, series
+        data = self._get(
+            "/api/v1/query_range", query=promql, start=start_s, end=end_s, step=step_s
+        )
         t2i = {round(float(t) * 1000): i for i, t in enumerate(times)}
         series = []
         for s in data.get("result", []):
@@ -58,8 +87,37 @@ class FiloClient:
             series.append({"metric": s.get("metric", {}), "values": row})
         return times, series
 
+    def _grpc_exec(self, promql, start_s, end_s, step_ms, instant=False):
+        from .api.grpc_exec import exec_promql
+
+        return exec_promql(
+            self.grpc_endpoint, promql,
+            round(start_s * 1000), round(end_s * 1000), step_ms,
+            auth_token=self.token, instant=instant, timeout_s=self.timeout,
+        )
+
     def query(self, promql: str, time_s: float | None = None):
         """Instant query -> raw Prometheus ``data`` payload."""
+        if self.grpc_endpoint:
+            import time as _time
+
+            t = time_s if time_s is not None else _time.time()
+            res = self._grpc_exec(promql, t, t, 1000, instant=True)
+            if res.scalar is not None:
+                sv = np.asarray(res.scalar.values)
+                v = sv[np.isfinite(sv)][-1] if np.isfinite(sv).any() else float("nan")
+                return {"resultType": "scalar", "result": [t, str(v)]}
+            result = []
+            for g in res.grids:
+                vals = g.values_np()
+                ts = g.step_times_ms()
+                for i, lbls in enumerate(g.labels):
+                    fin = np.isfinite(vals[i])
+                    if fin.any():
+                        j = int(np.nonzero(fin)[0][-1])
+                        result.append({"metric": _public_labels(lbls),
+                                       "value": [ts[j] / 1000.0, str(vals[i, j])]})
+            return {"resultType": "vector", "result": result}
         return self._get("/api/v1/query", query=promql, time=time_s)
 
     def labels(self, match: str | None = None) -> list[str]:

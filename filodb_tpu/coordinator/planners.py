@@ -318,6 +318,12 @@ class MultiPartitionPlanner:
             a = next(iter(assignments.values()))
             if a.endpoint is None:
                 return self.local.materialize(plan)
+            if a.endpoint.startswith("grpc://"):
+                # federation over the binary plan transport (reference
+                # MultiPartitionPlanner's gRPC remote exec path)
+                from ..api.grpc_exec import GrpcPlanRemoteExec
+
+                return GrpcPlanRemoteExec(a.endpoint, plan)
             times = _plan_range(plan)
             if times is None:
                 raise QueryError("cannot remote-execute a plan without a time range")

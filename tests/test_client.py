@@ -1,6 +1,8 @@
 """FiloClient tests (reference client-package specs: LocalClient
 QueryOps/ClusterOps against a running node)."""
 
+import json
+
 import numpy as np
 import pytest
 
@@ -76,3 +78,71 @@ def test_auth_roundtrip():
             bad.labels()
     finally:
         srv.shutdown()
+
+
+class TestGrpcClient:
+    @pytest.fixture(scope="class")
+    def pair(self):
+        from filodb_tpu.api.grpc_exec import serve_grpc
+        from filodb_tpu.testkit import counter_batch
+
+        ms = TimeSeriesMemStore()
+        ms.setup(Dataset("prometheus"), range(4))
+        ms.ingest_routed(
+            "prometheus", counter_batch(n_series=6, n_samples=60, start_ms=BASE),
+            spread=2,
+        )
+        engine = QueryEngine(ms, "prometheus")
+        hsrv, hport = serve_background(engine)
+        gsrv, gport = serve_grpc(engine, port=0, host="127.0.0.1")
+        http_c = FiloClient(f"http://127.0.0.1:{hport}")
+        grpc_c = FiloClient(f"http://127.0.0.1:{hport}",
+                            grpc_endpoint=f"grpc://127.0.0.1:{gport}")
+        yield http_c, grpc_c
+        hsrv.shutdown()
+        gsrv.stop(grace=0)
+
+    def test_query_range_parity(self, pair):
+        """The binary transport returns the same grid as the JSON path."""
+        http_c, grpc_c = pair
+        args = ("sum(rate(http_requests_total[5m]))",
+                (BASE + 400_000) / 1000, (BASE + 900_000) / 1000, 60)
+        t1, s1 = http_c.query_range(*args)
+        t2, s2 = grpc_c.query_range(*args)
+        np.testing.assert_array_equal(t1, t2)
+        assert len(s1) == len(s2) == 1
+        np.testing.assert_allclose(s2[0]["values"], s1[0]["values"], rtol=1e-5)
+
+    def test_instant_query_parity(self, pair):
+        http_c, grpc_c = pair
+        t = (BASE + 600_000) / 1000
+        h = http_c.query("http_requests_total", t)
+        g = grpc_c.query("http_requests_total", t)
+        assert g["resultType"] == "vector"
+        hk = sorted(json.dumps(r["metric"], sort_keys=True) for r in h["result"])
+        gk = sorted(json.dumps(r["metric"], sort_keys=True) for r in g["result"])
+        assert hk == gk
+        assert all("__name__" in r["metric"] for r in g["result"])
+
+    def test_metadata_still_http(self, pair):
+        _, grpc_c = pair
+        assert "job" in grpc_c.labels() or "__name__" in grpc_c.labels()
+
+
+def test_grpc_scalar_query(request):
+    """Scalar expressions over the binary transport (review: these were
+    silently dropped — only grids were read)."""
+    from filodb_tpu.api.grpc_exec import serve_grpc
+
+    ms = TimeSeriesMemStore()
+    ms.setup(Dataset("prometheus"), range(2))
+    engine = QueryEngine(ms, "prometheus")
+    gsrv, gport = serve_grpc(engine, port=0, host="127.0.0.1")
+    request.addfinalizer(lambda: gsrv.stop(grace=0))
+    c = FiloClient("http://unused:1", grpc_endpoint=f"grpc://127.0.0.1:{gport}")
+    out = c.query("1+1", (BASE + 60_000) / 1000)
+    assert out["resultType"] == "scalar"
+    assert float(out["result"][1]) == 2.0
+    ts, series = c.query_range("3*2", (BASE + 60_000) / 1000, (BASE + 180_000) / 1000, 60)
+    assert len(series) == 1
+    np.testing.assert_allclose(series[0]["values"], 6.0)

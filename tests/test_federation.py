@@ -103,3 +103,33 @@ def test_ha_failover_executes_remotely(two_clusters):
         tw, vw = want_map[k]
         np.testing.assert_array_equal(tg, tw)
         np.testing.assert_allclose(vg, vw, rtol=1e-3)
+
+
+def test_remote_partition_query_over_grpc(two_clusters):
+    """Federation over the binary plan transport: the foreign-partition
+    subtree ships as protobuf to cluster B's gRPC RemoteExec."""
+    from filodb_tpu.api.grpc_exec import serve_grpc
+
+    srv_a, srv_b, _, _ = two_clusters
+    gsrv, gport = serve_grpc(srv_b.engine, port=0, host="127.0.0.1")
+    try:
+        local = SingleClusterPlanner(srv_a.memstore, "prometheus")
+
+        def locate(keys):
+            if keys.get("_ns_") == "App-B":
+                return PartitionAssignment("b", f"grpc://127.0.0.1:{gport}")
+            return PartitionAssignment("a", None)
+
+        mp = MultiPartitionPlanner(local, locate)
+        q = 'sum(rate(http_requests_total{_ns_="App-B"}[5m]))'
+        plan = query_range_to_logical_plan(q, START_S, END_S, 60)
+        tree = mp.materialize(plan)
+        assert type(tree).__name__ == "GrpcPlanRemoteExec"
+        res = tree.execute(QueryContext(srv_a.memstore, "prometheus"))
+        want = QueryEngine(srv_b.memstore, "prometheus").query_range(
+            q, START_S, END_S, 60)
+        np.testing.assert_allclose(
+            res.grids[0].values_np(), want.grids[0].values_np(),
+            rtol=1e-3, equal_nan=True)
+    finally:
+        gsrv.stop(grace=0)
