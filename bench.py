@@ -157,8 +157,11 @@ def run_benchmark():
     # cross-check: TPU result must match the CPU oracle
     n = min(len(tpu_vals), len(cpu_vals))
     ok = np.allclose(tpu_vals[:n], cpu_vals[:n], rtol=5e-3)
+    import jax
+
+    backend = jax.devices()[0].platform  # honest label: "cpu" on fallback
     sys.stderr.write(
-        f"tpu_p50={tpu_ms:.2f}ms cpu_p50={cpu_ms:.2f}ms match={ok} "
+        f"{backend}_p50={tpu_ms:.2f}ms numpy_p50={cpu_ms:.2f}ms match={ok} "
         f"series/sec={N_SERIES / (tpu_ms / 1e3):.3g}\n"
     )
     print(

@@ -198,9 +198,13 @@ class SeedBootstrapper:
     # -- refresh loop ------------------------------------------------------
 
     def refresh_once(self) -> None:
-        """Poll every known member (gossip-style: joins propagate without
-        every node listing every seed), absorb answers, prune the dead."""
-        responders, mentioned = self._poll(self.registry.members())
+        """Poll every known member AND the configured seeds (gossip-style:
+        joins propagate without every node listing every seed; re-polling
+        seeds lets a node that bootstrapped alone — or whose whole peer set
+        was pruned during a rolling restart — rejoin when seeds return),
+        absorb answers, prune the dead."""
+        targets = list(dict.fromkeys(self.registry.members() + self.seeds))
+        responders, mentioned = self._poll(targets)
         self._absorb(responders, mentioned)
 
     def start(self, interval_s: float = 30.0) -> None:

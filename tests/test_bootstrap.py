@@ -126,6 +126,21 @@ class TestSeedBootstrapper:
         SeedBootstrapper(reg, [B], fetch=fetch, poll_timeout_s=5.0).bootstrap()
         assert seen["timeout"] == 5.0
 
+    def test_refresh_repolls_seeds_after_failed_bootstrap(self):
+        """A node isolated at startup (seed down, bootstrap failed) must
+        rejoin when the seed comes back — the refresh loop re-polls the
+        configured seeds, not just known members."""
+        cluster = {}
+        reg = MemberRegistry(B)
+        boot = SeedBootstrapper(reg, [A], fetch=_fake_cluster(cluster))
+        with pytest.raises(BootstrapError):
+            boot.bootstrap(retries=1, backoff_s=0.01)
+        assert reg.peers() == ()
+        cluster[A] = {A}  # seed comes back up
+        boot.refresh_once()
+        assert reg.peers() == (A,)
+        assert B in cluster[A]  # and we announced ourselves to it
+
     def test_refresh_prunes_dead_members(self):
         cluster = {B: {B}}
         reg = MemberRegistry(A, prune_after_s=0.0)  # immediate aging
