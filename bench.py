@@ -141,11 +141,19 @@ def tpu_query(ms):
     t0 = time.perf_counter()
     res, out = run()  # compile + stage + cache warm
     sys.stderr.write(f"warmup (stage+compile): {time.perf_counter()-t0:.1f}s\n")
+    # deadline-aware: on a degraded tunnel each run can take seconds — trim
+    # the run count (min 3) so the worker still reports a REAL accelerator
+    # p50 inside its budget instead of being killed mid-loop
+    deadline = float(os.environ.get("FILODB_BENCH_WORKER_DEADLINE", 0)) or None
     times = []
-    for _ in range(TIMED_RUNS):
+    for i in range(TIMED_RUNS):
         t0 = time.perf_counter()
         res, out = run()
         times.append(time.perf_counter() - t0)
+        if (deadline and len(times) >= 3
+                and time.time() + np.median(times) * 2 > deadline):
+            sys.stderr.write(f"deadline near: stopping after {len(times)} runs\n")
+            break
     vals = res.grids[0].values_np()[0]
     return float(np.median(times) * 1e3), vals, res
 
@@ -243,12 +251,15 @@ def main():
             break
         timeout_s = min(budget, remaining) if budget else remaining
         try:
+            env = dict(os.environ,
+                       FILODB_BENCH_WORKER_DEADLINE=str(time.time() + timeout_s - 30))
             proc = subprocess.run(
                 [sys.executable, here] + args,
                 timeout=timeout_s,
                 capture_output=True,
                 text=True,
                 cwd=os.path.dirname(here),
+                env=env,
             )
             sys.stderr.write(proc.stderr[-2000:])
             lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
