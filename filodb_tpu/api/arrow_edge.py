@@ -106,20 +106,31 @@ except Exception:  # pragma: no cover
 
 if HAVE_FLIGHT:
 
+    # serialized-plan ticket marker (reference FlightKryoSerDeser ships
+    # ExecPlans over Flight tickets via Kryo; here the registry-validated
+    # plan protobuf from query/proto_plan.py)
+    PLAN_TICKET_MAGIC = b"PLAN\x00"
+
     class FlightQueryServer(_flight.FlightServerBase):
-        """Executes PromQL range queries for Flight peers (reference
-        FiloDBFlightProducer + FlightQueryExecutor). Ticket = JSON
-        {"query", "start", "end", "step"}."""
+        """Executes queries for Flight peers (reference FiloDBFlightProducer
+        + FlightQueryExecutor). Ticket = JSON {"query", "start", "end",
+        "step"} for PromQL, or PLAN_TICKET_MAGIC + plan protobuf."""
 
         def __init__(self, engine, location="grpc://127.0.0.1:0"):
             super().__init__(location)
             self.engine = engine
 
         def do_get(self, context, ticket):
-            req = json.loads(ticket.ticket.decode())
-            res = self.engine.query_range(
-                req["query"], float(req["start"]), float(req["end"]), float(req["step"])
-            )
+            raw = ticket.ticket
+            if raw.startswith(PLAN_TICKET_MAGIC):
+                from ..query.proto_plan import plan_from_bytes
+
+                res = self.engine.execute_plan(plan_from_bytes(raw[len(PLAN_TICKET_MAGIC):]))
+            else:
+                req = json.loads(raw.decode())
+                res = self.engine.query_range(
+                    req["query"], float(req["start"]), float(req["end"]), float(req["step"])
+                )
             batches = [grid_to_record_batch(g) for g in res.grids]
             if not batches:
                 schema = pa.schema(
@@ -146,10 +157,7 @@ if HAVE_FLIGHT:
                 return c
 
         @classmethod
-        def query_range(cls, endpoint, query, start_s, end_s, step_s) -> QueryResult:
-            ticket = _flight.Ticket(
-                json.dumps({"query": query, "start": start_s, "end": end_s, "step": step_s}).encode()
-            )
+        def _collect(cls, endpoint, ticket) -> QueryResult:
             reader = cls.get(endpoint).do_get(ticket)
             grids = []
             for chunk in reader:
@@ -157,3 +165,19 @@ if HAVE_FLIGHT:
                 if rb.num_rows:
                     grids.append(record_batch_to_grid(rb))
             return QueryResult(grids=grids)
+
+        @classmethod
+        def query_range(cls, endpoint, query, start_s, end_s, step_s) -> QueryResult:
+            ticket = _flight.Ticket(
+                json.dumps({"query": query, "start": start_s, "end": end_s, "step": step_s}).encode()
+            )
+            return cls._collect(endpoint, ticket)
+
+        @classmethod
+        def execute_plan(cls, endpoint, logical_plan) -> QueryResult:
+            """Ship a LogicalPlan subtree as a protobuf ticket (reference
+            SingleClusterFlightPlanDispatcher + FlightKryoSerDeser)."""
+            from ..query.proto_plan import plan_to_bytes
+
+            ticket = _flight.Ticket(PLAN_TICKET_MAGIC + plan_to_bytes(logical_plan))
+            return cls._collect(endpoint, ticket)

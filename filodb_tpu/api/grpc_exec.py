@@ -126,19 +126,8 @@ class _RemoteExecServicer:
 
         def run():
             plan = proto_to_plan(request.plan)
-            if eng.planner.params.agg_rules is not None:
-                from ..coordinator.lpopt import optimize_with_preagg
-
-                plan = optimize_with_preagg(plan, eng.planner.params.agg_rules)
-            exec_plan = eng.planner.materialize(plan)
-            ctx = eng.context()
-            if p.deadline_s:
-                ctx.deadline_s = min(ctx.deadline_s, p.deadline_s)
-            if p.max_series:
-                ctx.max_series = min(ctx.max_series, p.max_series)
-            res = eng._run(exec_plan, ctx)
-            res.stats = ctx.stats
-            return res
+            return eng.execute_plan(plan, deadline_s=p.deadline_s,
+                                    max_series=p.max_series)
 
         yield from self._stream(run)
 

@@ -850,6 +850,25 @@ class QueryEngine:
             return exec_plan.execute(ctx)
         return sched.run(lambda: exec_plan.execute(ctx), deadline_s=ctx.deadline_s)
 
+    def execute_plan(self, plan, deadline_s: float = 0.0, max_series: int = 0):
+        """Execute an already-built LogicalPlan — THE entry for plan-level
+        remote transports (gRPC ExecutePlan, Flight plan tickets), so every
+        transport shares the same pre-agg rewrite, limits, and scheduler
+        path as PromQL queries."""
+        if self.planner.params.agg_rules is not None:
+            from .lpopt import optimize_with_preagg
+
+            plan = optimize_with_preagg(plan, self.planner.params.agg_rules)
+        exec_plan = self.planner.materialize(plan)
+        ctx = self.context()
+        if deadline_s:
+            ctx.deadline_s = min(ctx.deadline_s, deadline_s)
+        if max_series:
+            ctx.max_series = min(ctx.max_series, max_series)
+        res = self._run(exec_plan, ctx)
+        res.stats = ctx.stats
+        return res
+
     def label_values(self, filters, label: str, start_ms: int, end_ms: int, limit=None):
         """Metadata through the planner so multi-host peers scatter too."""
         plan = L.LabelValues(label, tuple(filters), start_ms, end_ms)

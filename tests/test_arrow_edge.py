@@ -80,3 +80,30 @@ def test_histogram_grid_roundtrip():
     # full IPC roundtrip too
     back = AE.ipc_to_result(AE.result_to_ipc(QueryResult(grids=[g])))
     np.testing.assert_array_equal(back.grids[0].hist_np(), hist)
+
+
+@pytest.mark.skipif(not AE.HAVE_FLIGHT, reason="pyarrow.flight unavailable")
+class TestFlightPlanTicket:
+    def test_plan_protobuf_ticket(self):
+        """Plan-serialization over Flight tickets (reference
+        FlightKryoSerDeser): the protobuf plan executes identically to the
+        PromQL ticket."""
+        from filodb_tpu.query.promql import query_range_to_logical_plan
+
+        ms = TimeSeriesMemStore()
+        ms.setup(Dataset("prometheus"), [0])
+        ms.ingest("prometheus", 0, machine_metrics(n_series=4, n_samples=100, start_ms=BASE))
+        engine = QueryEngine(ms, "prometheus")
+        server = AE.FlightQueryServer(engine)
+        try:
+            endpoint = f"grpc://127.0.0.1:{server.port}"
+            s, e = (BASE + 600_000) / 1000, (BASE + 900_000) / 1000
+            plan = query_range_to_logical_plan("sum(heap_usage0)", s, e, 60)
+            via_plan = AE.FlightQueryClient.execute_plan(endpoint, plan)
+            via_promql = AE.FlightQueryClient.query_range(
+                endpoint, "sum(heap_usage0)", s, e, 60)
+            np.testing.assert_allclose(
+                via_plan.grids[0].values_np(), via_promql.grids[0].values_np(),
+                rtol=1e-6)
+        finally:
+            server.shutdown()
