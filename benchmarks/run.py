@@ -112,6 +112,11 @@ def bench_gateway_parse():
     text = "\n".join(f'm{i}{{h="x{i}"}} {i} 1600000000000' for i in range(10_000))
     dt = _bench(lambda: list(parse_prom_text(text)))
     report("prom_text_parse", 10_000 / dt / 1e3, "kmsgs/s")
+    # full ingest-side batch build: native scanner + key memo vs regex path
+    from filodb_tpu.gateway.parsers import prom_text_to_batches_and_exemplars
+
+    dt = _bench(lambda: prom_text_to_batches_and_exemplars(text, 0))
+    report("prom_text_to_batches", 10_000 / dt / 1e3, "kmsgs/s")
 
 
 def bench_planner():

@@ -80,6 +80,39 @@ def _parse_labels(s: str) -> dict:
     }
 
 
+def _parse_sample_line(line: str):
+    """One sample line -> (name, tags, ts_ms|None, value, exemplar|None).
+
+    The OpenMetrics exemplar suffix `# {labels} value [ts]` is accepted ONLY
+    when both halves parse on their own; otherwise the whole line must match
+    (so legal label values containing ' # {' keep working, and a greedy
+    label match can never swallow a real exemplar)."""
+    exemplar = None
+    m = None
+    idx = line.rfind(" # {")
+    if idx != -1:
+        em = _EXEMPLAR.match(line[idx + 3:])
+        m2 = _PROM_LINE.match(line[:idx].rstrip())
+        if em and m2:
+            ex_ts = em.group("ts")
+            exemplar = (
+                _parse_labels(em.group("labels")),
+                float(em.group("value")),
+                int(float(ex_ts) * 1000) if ex_ts else None,
+            )
+            m = m2
+    if m is None:
+        m = _PROM_LINE.match(line)
+    if not m:
+        raise ValueError(f"bad prometheus line: {line!r}")
+    name = m.group("name")
+    tags = _parse_labels(m.group("labels")) if m.group("labels") else {}
+    vs = m.group("value")
+    val = float("nan") if vs in ("NaN", "nan") else float(vs)
+    ts_ms = int(m.group("ts")) if m.group("ts") else None
+    return name, tags, ts_ms, val, exemplar
+
+
 def parse_prom_text(text: str, with_exemplars: bool = False):
     """Prometheus exposition format -> (metric, tags, ts_ms, value, type)
     tuples; with ``with_exemplars`` a sixth element carries the OpenMetrics
@@ -97,34 +130,7 @@ def parse_prom_text(text: str, with_exemplars: bool = False):
             continue
         if line.startswith("#"):
             continue
-        # OpenMetrics exemplar suffix `# {labels} value [ts]`: accept the
-        # split ONLY when both halves parse on their own; otherwise fall back
-        # to matching the whole line (so legal label values containing
-        # ' # {' keep working, and a greedy label match can never swallow a
-        # real exemplar)
-        exemplar = None
-        m = None
-        idx = line.rfind(" # {")
-        if idx != -1:
-            em = _EXEMPLAR.match(line[idx + 3:])
-            m2 = _PROM_LINE.match(line[:idx].rstrip())
-            if em and m2:
-                ex_ts = em.group("ts")
-                exemplar = (
-                    _parse_labels(em.group("labels")),
-                    float(em.group("value")),
-                    int(float(ex_ts) * 1000) if ex_ts else None,
-                )
-                m = m2
-        if m is None:
-            m = _PROM_LINE.match(line)
-        if not m:
-            raise ValueError(f"bad prometheus line: {line!r}")
-        name = m.group("name")
-        tags = _parse_labels(m.group("labels")) if m.group("labels") else {}
-        vs = m.group("value")
-        val = float("nan") if vs in ("NaN", "nan") else float(vs)
-        ts_ms = int(m.group("ts")) if m.group("ts") else None
+        name, tags, ts_ms, val, exemplar = _parse_sample_line(line)
         if with_exemplars:
             yield name, tags, ts_ms, val, types.get(name, "untyped"), exemplar
         else:
@@ -152,12 +158,96 @@ def prom_text_to_batches(text: str, default_ts_ms: int, ws="default", ns="defaul
     return prom_text_to_batches_and_exemplars(text, default_ts_ms, ws, ns)[0]
 
 
+# cross-call series-key memo for the native scanner: the SAME exposition keys
+# arrive every scrape interval, so label parsing is O(new series). Template
+# dicts are copied before use; cleared when it outgrows the cap.
+_KEY_CACHE: dict[tuple, dict] = {}
+_KEY_CACHE_CAP = 500_000
+
+
+def _native_prom_batches(text: str, default_ts_ms: int, ws: str, ns: str):
+    """Native-scanner fast path; None when the lib is unavailable."""
+    from .. import native as N
+
+    payload = text.encode()
+    recs = N.parse_prom_records(payload)
+    if recs is None:
+        return None
+    if len(_KEY_CACHE) > _KEY_CACHE_CAP:
+        _KEY_CACHE.clear()
+    gauges, counters = ([], []), ([], [])
+    exemplars = []
+    for off, ln, v, t, tc, fl in zip(
+        recs["key_off"].tolist(), recs["key_len"].tolist(),
+        recs["value"].tolist(), recs["ts_ms"].tolist(),
+        recs["type_code"].tolist(), recs["flags"].tolist(),
+    ):
+        ex = None
+        if fl & 1:  # deferred line (exemplar/unusual): full Python semantics,
+            # including raising for genuinely bad lines. strip() for the wider
+            # Unicode whitespace the byte scanner can't trim.
+            line = payload[off:off + ln].decode().strip()
+            name, tags, t2, v, ex = _parse_sample_line(line)
+            t = t2 if t2 is not None else N.TS_ABSENT
+            full = dict(tags)
+            full[METRIC_TAG] = name
+            full.setdefault("_ws_", ws)
+            full.setdefault("_ns_", ns)
+        else:
+            ck = (payload[off:off + ln], ws, ns)
+            tmpl = _KEY_CACHE.get(ck)
+            if tmpl is None:
+                ks = ck[0].decode()
+                i = ks.find("{")
+                if i == -1:
+                    name, tags = ks, {}
+                else:
+                    name, tags = ks[:i], _parse_labels(ks[i + 1:-1])
+                tmpl = dict(tags)
+                tmpl[METRIC_TAG] = name
+                tmpl.setdefault("_ws_", ws)
+                tmpl.setdefault("_ns_", ns)
+                _KEY_CACHE[ck] = tmpl
+            full = dict(tmpl)
+        bucket = counters if tc == 1 else gauges
+        ts_ms = t if t != N.TS_ABSENT else default_ts_ms
+        bucket[0].append(full)
+        bucket[1].append((ts_ms, v))
+        if ex is not None:
+            ex_labels, ex_val, ex_ts = ex
+            exemplars.append(
+                (full, ex_ts if ex_ts is not None else ts_ms, ex_val, ex_labels)
+            )
+    return _assemble_batches(gauges, counters), exemplars
+
+
+def _assemble_batches(gauges, counters) -> list[RecordBatch]:
+    out = []
+    for (tags_list, rows), schema, col in (
+        (gauges, GAUGE, "value"),
+        (counters, PROM_COUNTER, "count"),
+    ):
+        if tags_list:
+            ts = np.asarray([r[0] for r in rows], dtype=np.int64)
+            vals = np.asarray([r[1] for r in rows])
+            out.append(RecordBatch(schema, ts, {col: vals}, tags_list))
+    return out
+
+
 def prom_text_to_batches_and_exemplars(
     text: str, default_ts_ms: int, ws="default", ns="default"
 ) -> tuple[list[RecordBatch], list]:
     """One parse of the exposition payload yielding both the schema-split
     sample batches and the OpenMetrics exemplars as
-    (full_tags, ts_ms, exemplar_value, exemplar_labels)."""
+    (full_tags, ts_ms, exemplar_value, exemplar_labels).
+
+    Scans natively when libfilodbprom is available (gateway-parser analog:
+    the C++ scanner tokenizes; label dicts come from a per-series-key memo),
+    falling back to the pure-Python regex parser — both paths are
+    differential-tested against each other."""
+    native = _native_prom_batches(text, default_ts_ms, ws, ns)
+    if native is not None:
+        return native
     gauges, counters = ([], []), ([], [])
     exemplars = []
     for name, tags, t, v, typ, ex in parse_prom_text(text, with_exemplars=True):
@@ -174,13 +264,4 @@ def prom_text_to_batches_and_exemplars(
                 (full, ex_ts if ex_ts is not None else (t if t is not None else default_ts_ms),
                  ex_val, ex_labels)
             )
-    out = []
-    for (tags_list, rows), schema, col in (
-        (gauges, GAUGE, "value"),
-        (counters, PROM_COUNTER, "count"),
-    ):
-        if tags_list:
-            ts = np.asarray([r[0] for r in rows], dtype=np.int64)
-            vals = np.asarray([r[1] for r in rows])
-            out.append(RecordBatch(schema, ts, {col: vals}, tags_list))
-    return out, exemplars
+    return _assemble_batches(gauges, counters), exemplars

@@ -115,3 +115,85 @@ def nan_count(values: np.ndarray) -> int:
     if L is None:
         return int(np.count_nonzero(~np.isnan(v)))
     return L.fdb_nan_count(v.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), len(v))
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text-exposition scanner (promparse.cpp -> libfilodbprom.so)
+# ---------------------------------------------------------------------------
+
+_PROM_SO = os.path.join(_HERE, "libfilodbprom.so")
+_PROM_SRC = os.path.join(_HERE, "promparse.cpp")
+_prom_lib = None
+_prom_tried = False
+
+# must mirror FdbPromRec in promparse.cpp (x86-64 struct layout, 8-aligned)
+PROM_REC_DTYPE = np.dtype(
+    {
+        "names": ["key_off", "key_len", "value", "ts_ms", "type_code", "flags"],
+        "formats": [np.uint32, np.uint32, np.float64, np.int64, np.uint8, np.uint8],
+        "offsets": [0, 4, 8, 16, 24, 25],
+        "itemsize": 32,
+    }
+)
+
+TS_ABSENT = np.iinfo(np.int64).min
+
+
+def prom_lib():
+    global _prom_lib, _prom_tried
+    if _prom_lib is not None or _prom_tried:
+        return _prom_lib
+    with _lock:
+        if _prom_lib is not None or _prom_tried:
+            return _prom_lib
+        _prom_tried = True
+        try:  # binary-only deployments may ship the .so without the source
+            stale = (not os.path.exists(_PROM_SO)
+                     or os.path.getmtime(_PROM_SO) < os.path.getmtime(_PROM_SRC))
+        except OSError:
+            stale = not os.path.exists(_PROM_SO)
+        if stale:
+            try:
+                subprocess.run(
+                    ["g++", "-O3", "-march=native", "-std=c++17", "-shared",
+                     "-fPIC", _PROM_SRC, "-o", _PROM_SO],
+                    check=True, capture_output=True, timeout=120,
+                )
+            except Exception:
+                return None
+        try:
+            L = ctypes.CDLL(_PROM_SO)
+        except OSError:
+            return None
+        L.fdb_parse_prom.restype = ctypes.c_long
+        L.fdb_parse_prom.argtypes = [
+            ctypes.c_char_p, ctypes.c_long,
+            ctypes.c_void_p, ctypes.c_long,
+        ]
+        _prom_lib = L
+        return _prom_lib
+
+
+# splitlines() separators the byte scanner cannot see (multi-byte UTF-8):
+# payloads containing them take the pure-Python path for exact parity
+_UNICODE_SEPS = (b"\xc2\x85", b"\xe2\x80\xa8", b"\xe2\x80\xa9")
+
+
+def parse_prom_records(payload: bytes):
+    """Scan a Prometheus exposition payload natively. Returns a structured
+    array (PROM_REC_DTYPE) of records, or None when the native lib is
+    unavailable (callers fall back to the Python parser). Never raises on
+    content: lines the scanner can't tokenize exactly like Python come back
+    flagged (flags=1) for per-line Python parsing."""
+    L = prom_lib()
+    if L is None:
+        return None
+    if any(s in payload for s in _UNICODE_SEPS):
+        return None
+    # every record consumes at least one line; count all separator bytes
+    max_out = sum(payload.count(s) for s in b"\n\r\v\f\x1c\x1d\x1e") + 2
+    out = np.zeros(max_out, dtype=PROM_REC_DTYPE)
+    n = L.fdb_parse_prom(payload, len(payload), out.ctypes.data, max_out)
+    if n < 0:  # defensive: max_out is sized from separator count
+        return None
+    return out[:n]

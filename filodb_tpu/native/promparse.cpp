@@ -1,0 +1,201 @@
+// Native Prometheus text-exposition scanner (host ingest hot path).
+//
+// Reference analog: the gateway's JVM parsers (gateway/.../InputRecord.scala:15
+// PrometheusInputRecord + the netty pipeline) — the reference parses ingest
+// protocols in native-compiled code; here a C++ scanner tokenizes the payload
+// in one pass and hands Python COLUMNAR records: a (offset, len) span of the
+// series key (`name{labels}` exactly as spelled), the parsed value, optional
+// timestamp, and the # TYPE-resolved type code. Python memoizes label parsing
+// per unique key span (scrapes repeat the same series every interval), so the
+// per-record Python work is O(new series), not O(samples).
+//
+// Parity contract: the scanner NEVER rejects a line. Anything it cannot
+// tokenize exactly like the Python parser would — exemplar suffixes, value
+// tokens with '_'/hex chars, '+'-signed or overflowing timestamps, stray
+// braces, unusual whitespace — is DEFERRED: emitted as a whole-line span with
+// flags=1, and Python applies its full regex semantics (including raising
+// ValueError for genuinely bad lines). Acceptance behavior is therefore
+// identical with and without the native lib; only speed differs. Known
+// micro-corner: a deferred line whose metric name the scanner could not even
+// start to read carries type_code=0, so an exotic line like
+// "\xc2\xa0name ..." (Unicode-space-prefixed) for a TYPEd metric would
+// schema-route as untyped — Python itself only reaches such lines via its
+// wider Unicode stripping. (Payloads containing U+0085/U+2028/U+2029 line
+// separators skip the native path entirely; see parse_prom_records.)
+//
+// Build: g++ -O3 -march=native -std=c++17 -shared -fPIC promparse.cpp -o libfilodbprom.so
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <string_view>
+#include <unordered_map>
+
+namespace {
+
+struct FdbPromRec {
+    uint32_t key_off;
+    uint32_t key_len;
+    double value;
+    int64_t ts_ms;     // INT64_MIN = absent
+    uint8_t type_code; // 0 untyped, 1 counter, 2 gauge, 3 histogram, 4 summary
+    uint8_t flags;     // 1 = deferred line (span = whole line; Python parses)
+    uint16_t _pad;
+};
+
+const int64_t TS_ABSENT = INT64_MIN;
+
+inline bool is_space(char c) { return c == ' ' || c == '\t' || c == '\r'; }
+// line separators, matching str.splitlines' ASCII/C1 set (\n \r \v \f and
+// the \x1c-\x1e file/group/record separators; \r\n collapses because the
+// empty in-between line is skipped)
+inline bool is_sep(char c) {
+    return c == '\n' || c == '\r' || c == '\v' || c == '\f' ||
+           c == '\x1c' || c == '\x1d' || c == '\x1e';
+}
+inline bool name_start(char c) {
+    return (c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z') || c == '_' || c == ':';
+}
+inline bool name_char(char c) { return name_start(c) || (c >= '0' && c <= '9'); }
+
+uint8_t type_code_of(std::string_view t) {
+    if (t == "counter") return 1;
+    if (t == "gauge") return 2;
+    if (t == "histogram") return 3;
+    if (t == "summary") return 4;
+    return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns the record count, or -2 when out is too small. buf must be
+// NUL-terminated at buf[len] (CPython bytes are), so strtod/strtoll cannot
+// overrun.
+long fdb_parse_prom(const char* buf, long len, FdbPromRec* out, long max_out) {
+    std::unordered_map<std::string_view, uint8_t> types;
+    long n = 0;
+    long pos = 0;
+    while (pos < len) {
+        long line_start = pos;
+        long eol = pos;
+        while (eol < len && !is_sep(buf[eol])) eol++;
+        pos = eol + 1;
+        long b = line_start, e = eol;
+        while (b < e && is_space(buf[b])) b++;
+        while (e > b && is_space(buf[e - 1])) e--;
+        if (b == e) continue;
+        if (buf[b] == '#') {
+            // exactly `# TYPE` prefix (Python: stripped.startswith("# TYPE")),
+            // then whitespace-split tokens: parts[2]=name, parts[3]=type
+            if (e - b >= 6 && std::memcmp(buf + b, "# TYPE", 6) == 0) {
+                long p = b;
+                std::string_view parts[4];
+                int np = 0;
+                while (p < e && np < 4) {
+                    while (p < e && is_space(buf[p])) p++;
+                    long t0 = p;
+                    while (p < e && !is_space(buf[p])) p++;
+                    if (p > t0) parts[np++] = std::string_view(buf + t0, (size_t)(p - t0));
+                }
+                if (np >= 4) types[parts[2]] = type_code_of(parts[3]);
+            }
+            continue;
+        }
+        if (n >= max_out) return -2;
+
+        uint8_t tcode = 0;
+        long p = b;
+        bool defer = false;
+        long key_end = b;
+        double v = 0.0;
+        int64_t ts = TS_ABSENT;
+
+        // name (identical charset to the Python regex)
+        if (!name_start(buf[p])) {
+            defer = true;
+        } else {
+            while (p < e && name_char(buf[p])) p++;
+            std::string_view nm(buf + b, (size_t)(p - b));
+            auto it = types.find(nm);
+            if (it != types.end()) tcode = it->second;
+        }
+        // exemplar suffix " # {" anywhere -> Python handles the whole line
+        if (!defer) {
+            for (long q = b; q + 3 < e; q++) {
+                if (buf[q] == ' ' && buf[q + 1] == '#' && buf[q + 2] == ' ' &&
+                    buf[q + 3] == '{') {
+                    defer = true;
+                    break;
+                }
+            }
+        }
+        // optional {labels} — quote-aware scan to the closing brace
+        if (!defer && p < e && buf[p] == '{') {
+            bool in_q = false;
+            p++;
+            for (;; p++) {
+                if (p >= e) { defer = true; break; }
+                char c = buf[p];
+                if (in_q) {
+                    if (c == '\\') { p++; continue; }
+                    if (c == '"') in_q = false;
+                } else if (c == '"') {
+                    in_q = true;
+                } else if (c == '}') {
+                    p++;
+                    break;
+                }
+            }
+        }
+        if (!defer) {
+            key_end = p;
+            // value token: must be whitespace-delimited and fully consumed by
+            // strtod, with no chars strtod and Python float() disagree on
+            // ('x'/'X' hex floats, '_' digit separators)
+            if (p >= e || !is_space(buf[p])) defer = true;
+            while (!defer && p < e && is_space(buf[p])) p++;
+            if (!defer && p >= e) defer = true;
+            if (!defer) {
+                long tok = p;
+                while (p < e && !is_space(buf[p])) p++;
+                for (long q = tok; q < p; q++) {
+                    char c = buf[q];
+                    if (c == 'x' || c == 'X' || c == '_') { defer = true; break; }
+                }
+                if (!defer) {
+                    char* endp = nullptr;
+                    v = strtod(buf + tok, &endp);
+                    if (endp - buf != p) defer = true;
+                }
+            }
+            // optional timestamp: Python accepts -?\d+ only, as int64
+            if (!defer) {
+                while (p < e && is_space(buf[p])) p++;
+                if (p < e) {
+                    if (buf[p] == '+') {
+                        defer = true;  // Python's regex rejects '+'
+                    } else {
+                        errno = 0;
+                        char* endt = nullptr;
+                        long long t = strtoll(buf + p, &endt, 10);
+                        if (endt - buf != e || errno == ERANGE) defer = true;
+                        else ts = (int64_t)t;
+                    }
+                }
+            }
+        }
+        if (defer) {
+            out[n++] = FdbPromRec{(uint32_t)b, (uint32_t)(e - b), 0.0,
+                                  TS_ABSENT, tcode, 1, 0};
+        } else {
+            out[n++] = FdbPromRec{(uint32_t)b, (uint32_t)(key_end - b), v, ts,
+                                  tcode, 0, 0};
+        }
+    }
+    return n;
+}
+
+}  // extern "C"

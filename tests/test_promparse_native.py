@@ -1,0 +1,186 @@
+"""Native exposition scanner vs the Python regex parser (reference analog:
+the gateway's compiled InputRecord parsers; test model: the codec
+native-vs-python parity suites)."""
+
+import random
+
+import numpy as np
+import pytest
+
+from filodb_tpu import native as N
+from filodb_tpu.gateway.parsers import (
+    _native_prom_batches,
+    prom_text_to_batches_and_exemplars,
+)
+
+pytestmark = pytest.mark.skipif(
+    N.prom_lib() is None, reason="native prom scanner unavailable"
+)
+
+BASE = 1_600_000_000_000
+
+
+def _python_reference(text, default_ts, ws="default", ns="default"):
+    """The pure-Python path, bypassing the native fast path."""
+    from filodb_tpu.core.schemas import GAUGE, METRIC_TAG, PROM_COUNTER
+    from filodb_tpu.gateway import parsers as P
+
+    gauges, counters = ([], []), ([], [])
+    exemplars = []
+    for name, tags, t, v, typ, ex in P.parse_prom_text(text, with_exemplars=True):
+        full = dict(tags)
+        full[METRIC_TAG] = name
+        full.setdefault("_ws_", ws)
+        full.setdefault("_ns_", ns)
+        bucket = counters if typ == "counter" else gauges
+        bucket[0].append(full)
+        bucket[1].append((t if t is not None else default_ts, v))
+        if ex is not None:
+            ex_labels, ex_val, ex_ts = ex
+            exemplars.append(
+                (full, ex_ts if ex_ts is not None else (t if t is not None else default_ts),
+                 ex_val, ex_labels))
+    return P._assemble_batches(gauges, counters), exemplars
+
+
+def _batches_equal(a, b):
+    assert len(a) == len(b)
+    for ba, bb in zip(a, b):
+        assert ba.schema.name == bb.schema.name
+        assert list(ba.tags) == list(bb.tags)
+        np.testing.assert_array_equal(ba.timestamps, bb.timestamps)
+        for col in ba.values:
+            np.testing.assert_array_equal(
+                ba.values[col], bb.values[col], err_msg=col)
+
+
+CORPUS = """\
+# HELP http_requests_total total requests
+# TYPE http_requests_total counter
+http_requests_total{job="api",code="200"} 1027 1600000000000
+http_requests_total{job="api",code="500"} 3 1600000000000
+# TYPE temp gauge
+temp{site="a b",note="x=y,z"} -3.25
+temp 0.5 1600000060000
+plain_metric 42
+nan_metric NaN 1600000000000
+inf_metric +Inf
+neg_inf -Inf 1600000000001
+esc{v="quote\\"inside",w="back\\\\slash"} 7
+colon:name:total 1 1600000000002
+"""
+
+
+class TestNativeParity:
+    def test_corpus_matches_python(self):
+        got = prom_text_to_batches_and_exemplars(CORPUS, BASE)
+        want = _python_reference(CORPUS, BASE)
+        _batches_equal(got[0], want[0])
+        assert got[1] == want[1]
+
+    def test_exemplar_lines(self):
+        text = (
+            "# TYPE rq counter\n"
+            'rq{job="x"} 5 1600000000000 # {trace_id="abc"} 0.5 1600000000.5\n'
+            'rq{job="y"} 6 # {trace_id="def"} 1.5\n'
+        )
+        got_b, got_ex = prom_text_to_batches_and_exemplars(text, BASE)
+        want_b, want_ex = _python_reference(text, BASE)
+        _batches_equal(got_b, want_b)
+        assert got_ex == want_ex
+        assert len(got_ex) == 2
+
+    def test_hash_inside_label_value(self):
+        # ' # {' inside a quoted label value must not be eaten as exemplar
+        text = 'm{note="a # {weird} value"} 1 1600000000000\n'
+        got = prom_text_to_batches_and_exemplars(text, BASE)
+        want = _python_reference(text, BASE)
+        _batches_equal(got[0], want[0])
+        assert got[0][0].tags[0]["note"] == "a # {weird} value"
+
+    def test_bad_lines_raise_like_python(self):
+        for bad in ["{no_name} 1", "m 1 2 3", "m{a=}", "m{a=\"x\"} notanumber",
+                    "m{unclosed=\"x\" 1", "m{a=\"1\"} 5 12.5"]:
+            with pytest.raises(ValueError):
+                prom_text_to_batches_and_exemplars(bad + "\n", BASE)
+            with pytest.raises(ValueError):
+                _python_reference(bad + "\n", BASE)
+
+    @pytest.mark.parametrize("seed", range(10))
+    def test_fuzzed_payloads_match(self, seed):
+        rng = random.Random(seed)
+        lines = []
+        for i in range(rng.randint(50, 200)):
+            name = rng.choice(["up", "rq_total", "mem_bytes", "x:y_total"])
+            if rng.random() < 0.15:
+                lines.append(f"# TYPE {name} {rng.choice(['counter', 'gauge', 'histogram'])}")
+                continue
+            nl = rng.randint(0, 3)
+            labels = ",".join(
+                f'{rng.choice("abcdwxyz")}{j}="{rng.choice(["v", "a b", "q,r", "e=f"])}{rng.randint(0, 99)}"'
+                for j in range(nl)
+            )
+            val = rng.choice(["1", "-2.5", "3e7", "NaN", "+Inf", "0.001", "1e-9"])
+            ts = f" {BASE + rng.randint(0, 10 ** 6)}" if rng.random() < 0.7 else ""
+            body = f"{name}{{{labels}}}" if nl else name
+            lines.append(f"{body} {val}{ts}")
+        text = "\n".join(lines) + "\n"
+        got = prom_text_to_batches_and_exemplars(text, BASE)
+        want = _python_reference(text, BASE)
+        _batches_equal(got[0], want[0])
+        assert got[1] == want[1]
+
+    def test_key_cache_reuse_is_copy_safe(self):
+        text = 'm{a="1"} 5 1600000000000\n'
+        b1, _ = _native_prom_batches(text, BASE, "default", "default")
+        b1[0].tags[0]["mutated"] = "yes"
+        b2, _ = _native_prom_batches(text, BASE, "default", "default")
+        assert "mutated" not in b2[0].tags[0]
+
+    def test_ws_ns_distinct_cache_entries(self):
+        text = "m 1 1600000000000\n"
+        a, _ = _native_prom_batches(text, BASE, "w1", "n1")
+        b, _ = _native_prom_batches(text, BASE, "w2", "n2")
+        assert a[0].tags[0]["_ws_"] == "w1"
+        assert b[0].tags[0]["_ws_"] == "w2"
+
+
+class TestReviewDivergences:
+    """Regression corpus from the review: inputs where strtod/byte-scanning
+    semantics could diverge from Python — each must behave IDENTICALLY on
+    both paths (accept with same data, or raise on both)."""
+
+    CASES = [
+        "m 0x10 1600000000000",        # hex float: Python rejects
+        "m 1_0",                        # underscore literal: Python accepts (10.0)
+        "m 1 +1600000000000",           # '+'-signed ts: Python rejects
+        "m 1 99999999999999999999",     # ts overflow: Python raises
+        "#TYPE m counter\nm 1",         # no space: NOT a TYPE line for Python
+        "# TYPEX m counter\nm 1",       # startswith quirk: IS a TYPE line
+        "m 1\rn 2",                     # \r is a line separator
+        "\x0cm 1",                      # \f separator
+        'm{a="x"}} 1',                  # stray brace: Python's greedy regex accepts
+        "m 1\u00a0",                   # Unicode trailing whitespace
+        "m 1\u2028n 2",                # U+2028 separator -> python path wholesale
+        "m infinity",                   # strtod-only spelling... float() accepts too
+    ]
+
+    @pytest.mark.parametrize("case", CASES)
+    def test_same_outcome_both_paths(self, case):
+        text = case + "\n"
+        try:
+            want = _python_reference(text, BASE)
+            want_err = None
+        except (ValueError, OverflowError) as e:
+            want, want_err = None, type(e)
+        try:
+            got = prom_text_to_batches_and_exemplars(text, BASE)
+            got_err = None
+        except (ValueError, OverflowError) as e:
+            got, got_err = None, type(e)
+        if want_err is not None:
+            assert got_err is not None, f"native accepted what python rejects: {case!r}"
+        else:
+            assert got_err is None, f"native rejected what python accepts: {case!r}"
+            _batches_equal(got[0], want[0])
+            assert got[1] == want[1]
