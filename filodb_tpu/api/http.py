@@ -438,7 +438,7 @@ class PromApiHandler(BaseHTTPRequestHandler):
 
         length = int(self.headers.get("Content-Length") or 0)
         text = self.rfile.read(length).decode() if length else ""
-        batch = influx_to_batch(text.splitlines(), int(_time.time() * 1000))
+        batch = influx_to_batch(text, int(_time.time() * 1000))
         n = self.engine.memstore.ingest_routed(self.engine.dataset, batch, spread=3)
         return self._send(200, J.success({"ingested": n}))
 

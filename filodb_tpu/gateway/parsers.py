@@ -137,7 +137,68 @@ def parse_prom_text(text: str, with_exemplars: bool = False):
             yield name, tags, ts_ms, val, types.get(name, "untyped")
 
 
-def influx_to_batch(lines: Iterable[str], default_ts_ms: int, ws="default", ns="default") -> RecordBatch:
+def _native_influx_batch(text: str, default_ts_ms: int, ws: str, ns: str):
+    """Native-scanner fast path (see promparse.cpp); None when unavailable.
+    Tag/metric dicts come from a memo keyed by the raw (series-key, field)
+    byte spans — repeated writers pay label parsing once per series."""
+    from .. import native as N
+
+    payload = text.encode()
+    recs = N.parse_influx_records(payload)
+    if recs is None:
+        return None
+    if len(_KEY_CACHE) > _KEY_CACHE_CAP:
+        _KEY_CACHE.clear()
+    tags_list, ts, vals = [], [], []
+    for koff, klen, foff, flen, v, t, fl in zip(
+        recs["key_off"].tolist(), recs["key_len"].tolist(),
+        recs["field_off"].tolist(), recs["field_len"].tolist(),
+        recs["value"].tolist(), recs["ts_ms"].tolist(), recs["flags"].tolist(),
+    ):
+        if fl & 1:  # deferred line: exact Python semantics (may raise)
+            line = payload[koff:koff + klen].decode().strip()
+            for metric, tags, t2, v2 in parse_influx_line(line) or ():
+                full = dict(tags)
+                full[METRIC_TAG] = metric
+                full.setdefault("_ws_", ws)
+                full.setdefault("_ns_", ns)
+                tags_list.append(full)
+                ts.append(t2 if t2 is not None else default_ts_ms)
+                vals.append(v2)
+            continue
+        ck = (payload[koff:koff + klen], payload[foff:foff + flen], ws, ns)
+        tmpl = _KEY_CACHE.get(ck)
+        if tmpl is None:
+            key_items = _COMMA_SPLIT.split(ck[0].decode())
+            measurement = _unescape(key_items[0])
+            tags = {}
+            for item in key_items[1:]:
+                k, _, vv = item.partition("=")
+                tags[_unescape(k)] = _unescape(vv)
+            field = _unescape(ck[1].decode())
+            metric = measurement if field == "value" else f"{measurement}_{field}"
+            tmpl = dict(tags)
+            tmpl[METRIC_TAG] = metric
+            tmpl.setdefault("_ws_", ws)
+            tmpl.setdefault("_ns_", ns)
+            _KEY_CACHE[ck] = tmpl
+        tags_list.append(dict(tmpl))
+        ts.append(t if t != N.TS_ABSENT else default_ts_ms)
+        vals.append(v)
+    return RecordBatch(
+        GAUGE, np.asarray(ts, dtype=np.int64), {"value": np.asarray(vals)}, tags_list
+    )
+
+
+def influx_to_batch(lines: "Iterable[str] | str", default_ts_ms: int,
+                    ws="default", ns="default") -> RecordBatch:
+    """Influx line protocol -> one gauge RecordBatch. A str payload takes
+    the native scanner fast path when available."""
+    if isinstance(lines, str):
+        native = _native_influx_batch(lines, default_ts_ms, ws, ns)
+        if native is not None:
+            return native
+        lines = lines.splitlines()
     tags_list, ts, vals = [], [], []
     for line in lines:
         for metric, tags, t, v in parse_influx_line(line) or ():

@@ -197,3 +197,39 @@ def parse_prom_records(payload: bytes):
     if n < 0:  # defensive: max_out is sized from separator count
         return None
     return out[:n]
+
+
+INFLUX_REC_DTYPE = np.dtype(
+    {
+        "names": ["key_off", "key_len", "field_off", "field_len", "value",
+                  "ts_ms", "flags"],
+        "formats": [np.uint32, np.uint32, np.uint32, np.uint32, np.float64,
+                    np.int64, np.uint8],
+        "offsets": [0, 4, 8, 12, 16, 24, 32],
+        "itemsize": 40,
+    }
+)
+
+
+def parse_influx_records(payload: bytes):
+    """Scan an Influx line-protocol payload natively; None when the lib is
+    unavailable. Same defer contract as parse_prom_records."""
+    L = prom_lib()
+    if L is None:
+        return None
+    if any(s in payload for s in _UNICODE_SEPS):
+        return None
+    if not hasattr(L, "_influx_bound"):
+        L.fdb_parse_influx.restype = ctypes.c_long
+        L.fdb_parse_influx.argtypes = [
+            ctypes.c_char_p, ctypes.c_long, ctypes.c_void_p, ctypes.c_long,
+        ]
+        L._influx_bound = True
+    # a line can hold many fields: size by commas+lines (upper bound)
+    max_out = (sum(payload.count(s) for s in b"\n\r\v\f\x1c\x1d\x1e")
+               + payload.count(b",") + 2)
+    out = np.zeros(max_out, dtype=INFLUX_REC_DTYPE)
+    n = L.fdb_parse_influx(payload, len(payload), out.ctypes.data, max_out)
+    if n < 0:
+        return None
+    return out[:n]

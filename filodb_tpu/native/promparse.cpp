@@ -47,6 +47,9 @@ struct FdbPromRec {
 const int64_t TS_ABSENT = INT64_MIN;
 
 inline bool is_space(char c) { return c == ' ' || c == '\t' || c == '\r'; }
+// line-EDGE trimming matches str.strip(): also \x1f, which Python considers
+// whitespace (isspace) though neither splitlines nor regex \s treats it so
+inline bool is_strip(char c) { return is_space(c) || c == '\x1f'; }
 // line separators, matching str.splitlines' ASCII/C1 set (\n \r \v \f and
 // the \x1c-\x1e file/group/record separators; \r\n collapses because the
 // empty in-between line is skipped)
@@ -84,8 +87,8 @@ long fdb_parse_prom(const char* buf, long len, FdbPromRec* out, long max_out) {
         while (eol < len && !is_sep(buf[eol])) eol++;
         pos = eol + 1;
         long b = line_start, e = eol;
-        while (b < e && is_space(buf[b])) b++;
-        while (e > b && is_space(buf[e - 1])) e--;
+        while (b < e && is_strip(buf[b])) b++;
+        while (e > b && is_strip(buf[e - 1])) e--;
         if (b == e) continue;
         if (buf[b] == '#') {
             // exactly `# TYPE` prefix (Python: stripped.startswith("# TYPE")),
@@ -95,9 +98,9 @@ long fdb_parse_prom(const char* buf, long len, FdbPromRec* out, long max_out) {
                 std::string_view parts[4];
                 int np = 0;
                 while (p < e && np < 4) {
-                    while (p < e && is_space(buf[p])) p++;
+                    while (p < e && is_strip(buf[p])) p++;
                     long t0 = p;
-                    while (p < e && !is_space(buf[p])) p++;
+                    while (p < e && !is_strip(buf[p])) p++;
                     if (p > t0) parts[np++] = std::string_view(buf + t0, (size_t)(p - t0));
                 }
                 if (np >= 4) types[parts[2]] = type_code_of(parts[3]);
@@ -193,6 +196,159 @@ long fdb_parse_prom(const char* buf, long len, FdbPromRec* out, long max_out) {
         } else {
             out[n++] = FdbPromRec{(uint32_t)b, (uint32_t)(key_end - b), v, ts,
                                   tcode, 0, 0};
+        }
+    }
+    return n;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Influx line protocol (reference gateway/.../InfluxProtocolParser.scala).
+// Same defer contract: any token the scanner can't classify exactly like
+// parse_influx_line goes back as a whole-line flags=1 record.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct FdbInfluxRec {
+    uint32_t key_off;    // measurement[,tag=v...] span (raw, escapes intact)
+    uint32_t key_len;
+    uint32_t field_off;  // field key span (raw); unused when deferred
+    uint32_t field_len;
+    double value;
+    int64_t ts_ms;       // INT64_MIN = absent
+    uint8_t flags;       // 1 = deferred line (key span = whole line)
+    uint8_t _pad[7];
+};
+
+// split points mirror Python's (?<!\\) lookbehind: a separator counts unless
+// the SINGLE preceding char is a backslash
+inline long find_unescaped(const char* buf, long from, long to, char sep) {
+    for (long p = from; p < to; p++)
+        if (buf[p] == sep && (p == from || buf[p - 1] != '\\')) return p;
+    return to;
+}
+
+// Python str.partition: first occurrence, escapes NOT honored
+inline long find_plain(const char* buf, long from, long to, char sep) {
+    for (long p = from; p < to; p++)
+        if (buf[p] == sep) return p;
+    return to;
+}
+
+inline bool token_clean_double(const char* buf, long b, long e, double* out) {
+    for (long q = b; q < e; q++) {
+        char c = buf[q];
+        // 'x'/'X' hex floats, '_' digit separators, parens in nan(...)
+        if (c == 'x' || c == 'X' || c == '_' || c == '(' || c == ')') return false;
+    }
+    char* endp = nullptr;
+    double v = strtod(buf + b, &endp);
+    if (endp - buf != e || b == e) return false;
+    *out = v;
+    return true;
+}
+
+inline bool tok_eq(const char* buf, long b, long e, const char* s) {
+    size_t n = strlen(s);
+    return (size_t)(e - b) == n && std::memcmp(buf + b, s, n) == 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns emitted record count or -2 when out is too small.
+long fdb_parse_influx(const char* buf, long len, FdbInfluxRec* out, long max_out) {
+    long n = 0;
+    long pos = 0;
+    while (pos < len) {
+        long line_start = pos;
+        long eol = pos;
+        while (eol < len && !is_sep(buf[eol])) eol++;
+        pos = eol + 1;
+        long b = line_start, e = eol;
+        while (b < e && is_strip(buf[b])) b++;
+        while (e > b && is_strip(buf[e - 1])) e--;
+        if (b == e || buf[b] == '#') continue;
+
+        bool defer = false;
+        // any non-ASCII byte: Python's wider Unicode strip/split semantics
+        for (long q = b; q < e && !defer; q++)
+            if ((unsigned char)buf[q] >= 0x80) defer = true;
+
+        long sp1 = defer ? e : find_unescaped(buf, b, e, ' ');
+        if (!defer && sp1 >= e) defer = true;  // needs key + fields
+        long key_b = b, key_e = sp1;
+        long f_b = 0, f_e = 0;
+        int64_t ts = TS_ABSENT;
+        if (!defer) {
+            f_b = sp1 + 1;
+            long sp2 = find_unescaped(buf, f_b, e, ' ');
+            f_e = sp2;
+            if (sp2 < e) {  // third token = ns timestamp; extras ignored
+                long t_b = sp2 + 1;
+                long t_e = find_unescaped(buf, t_b, e, ' ');
+                for (long q = t_b; q < t_e && !defer; q++)
+                    if (buf[q] == '_') defer = true;  // int("1_0") quirk
+                if (!defer) {
+                    errno = 0;
+                    char* endt = nullptr;
+                    long long t = strtoll(buf + t_b, &endt, 10);
+                    if (endt - buf != t_e || t_b == t_e || errno == ERANGE) defer = true;
+                    else ts = (int64_t)(t / 1000000);  // ns -> ms (trunc, like //)
+                    // Python's // floors; match for negatives
+                    if (!defer && t < 0 && t % 1000000 != 0) ts -= 1;
+                }
+            }
+        }
+        if (defer) {
+            if (n >= max_out) return -2;
+            out[n++] = FdbInfluxRec{(uint32_t)b, (uint32_t)(e - b), 0, 0, 0.0,
+                                    TS_ABSENT, 1, {0}};
+            continue;
+        }
+        // one record per field, splitting fields on unescaped commas
+        long fp = f_b;
+        long line_first = n;  // roll back to a single defer record if needed
+        while (fp <= f_e) {
+            long fc = find_unescaped(buf, fp, f_e, ',');
+            long eq = find_plain(buf, fp, fc, '=');  // partition() semantics
+            long vb = (eq < fc) ? eq + 1 : fc;  // missing '=' -> empty value
+            long ve = fc;
+            while (vb < ve && is_strip(buf[vb])) vb++;   // Python v.strip()
+            while (ve > vb && is_strip(buf[ve - 1])) ve--;
+            double v = 0.0;
+            bool emit = true;
+            // EXACT Python ordering (parse_influx_line): endswith('i') first,
+            // then booleans, then string skip, then plain float
+            if (vb < ve && buf[ve - 1] == 'i') {
+                if (!token_clean_double(buf, vb, ve - 1, &v)) { defer = true; break; }
+            } else if (tok_eq(buf, vb, ve, "t") || tok_eq(buf, vb, ve, "T") ||
+                       tok_eq(buf, vb, ve, "true") || tok_eq(buf, vb, ve, "True")) {
+                v = 1.0;
+            } else if (tok_eq(buf, vb, ve, "f") || tok_eq(buf, vb, ve, "F") ||
+                       tok_eq(buf, vb, ve, "false") || tok_eq(buf, vb, ve, "False")) {
+                v = 0.0;
+            } else if (vb < ve && buf[vb] == '"') {
+                emit = false;  // string field: not a time series value
+            } else {
+                if (!token_clean_double(buf, vb, ve, &v)) { defer = true; break; }
+            }
+            if (emit) {
+                if (n >= max_out) return -2;
+                out[n++] = FdbInfluxRec{(uint32_t)key_b, (uint32_t)(key_e - key_b),
+                                        (uint32_t)fp, (uint32_t)(eq < fc ? eq - fp : fc - fp),
+                                        v, ts, 0, {0}};
+            }
+            fp = fc + 1;
+        }
+        if (defer) {
+            n = line_first;
+            if (n >= max_out) return -2;
+            out[n++] = FdbInfluxRec{(uint32_t)b, (uint32_t)(e - b), 0, 0, 0.0,
+                                    TS_ABSENT, 1, {0}};
         }
     }
     return n;

@@ -184,3 +184,100 @@ class TestReviewDivergences:
             assert got_err is None, f"native rejected what python accepts: {case!r}"
             _batches_equal(got[0], want[0])
             assert got[1] == want[1]
+
+
+class TestInfluxNativeParity:
+    """Native Influx scanner vs parse_influx_line (same defer contract)."""
+
+    CORPUS = [
+        "cpu,host=h1,dc=us value=0.5 1600000000000000000",
+        "cpu,host=h2 usage_user=1.5,usage_sys=2.5 1600000000000000000",
+        "mem free=1024i,cached=2048i",
+        "status,svc=api up=t,degraded=f 1600000001000000000",
+        'notes,host=h1 msg="astring",level=3 1600000002000000000',
+        "esc\\,metric,ta\\ g=v\\=1 value=9 1600000003000000000",
+        "bools a=true,b=False,c=T",
+        "neg v=-42.5 -1500000",
+        "# a comment",
+        "",
+        "m value=3e7",
+    ]
+
+    def _python(self, text, default_ts):
+        from filodb_tpu.core.schemas import METRIC_TAG
+        from filodb_tpu.gateway.parsers import parse_influx_line
+
+        tags_list, ts, vals = [], [], []
+        for line in text.splitlines():
+            for metric, tags, t, v in parse_influx_line(line) or ():
+                full = dict(tags)
+                full[METRIC_TAG] = metric
+                full.setdefault("_ws_", "default")
+                full.setdefault("_ns_", "default")
+                tags_list.append(full)
+                ts.append(t if t is not None else default_ts)
+                vals.append(v)
+        return tags_list, ts, vals
+
+    def test_corpus_matches_python(self):
+        from filodb_tpu.gateway.parsers import influx_to_batch
+
+        text = "\n".join(self.CORPUS) + "\n"
+        batch = influx_to_batch(text, BASE)
+        wt, wts, wv = self._python(text, BASE)
+        assert list(batch.tags) == wt
+        np.testing.assert_array_equal(batch.timestamps, np.asarray(wts, np.int64))
+        np.testing.assert_array_equal(batch.values["value"], np.asarray(wv))
+
+    BAD = ["m", "m f=", "m f=abc", "m f=1 notanint", "m f=1_0", "m f=0x10",
+           "m  f=1", "m f=1 1_0",
+           # review regressions: escaped '=' before real '=', \x1f strip,
+           # glibc nan(...), quoted value with i-suffix
+           "m a\\==1", "\x1fm f=1", "m f=nan(123)", 'm f="x"i']
+
+    @pytest.mark.parametrize("case", BAD)
+    def test_divergence_cases_same_outcome(self, case):
+        from filodb_tpu.gateway.parsers import influx_to_batch
+
+        text = case + "\n"
+        try:
+            want = self._python(text, BASE)
+            want_err = None
+        except (ValueError, OverflowError) as e:
+            want, want_err = None, type(e)
+        try:
+            got = influx_to_batch(text, BASE)
+            got_err = None
+        except (ValueError, OverflowError) as e:
+            got, got_err = None, type(e)
+        if want_err is not None:
+            assert got_err is not None, f"native accepted, python rejects: {case!r}"
+        else:
+            assert got_err is None, f"native rejected, python accepts: {case!r}"
+            assert list(got.tags) == want[0]
+            np.testing.assert_array_equal(got.values["value"], np.asarray(want[2]))
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_fuzzed_influx_match(self, seed):
+        from filodb_tpu.gateway.parsers import influx_to_batch
+
+        rng = random.Random(1000 + seed)
+        lines = []
+        for _ in range(rng.randint(30, 120)):
+            meas = rng.choice(["cpu", "mem", "disk\\ io"])
+            tags = "".join(
+                f",{rng.choice('abcd')}={rng.choice(['v1', 'x\\,y', 'p\\=q'])}"
+                for _ in range(rng.randint(0, 2))
+            )
+            fields = ",".join(
+                f"{rng.choice(['value', 'usage', 'free'])}={rng.choice(['1.5', '2i', 't', 'f', '3e4', '-0.25'])}"
+                for _ in range(rng.randint(1, 3))
+            )
+            ts = f" {1_600_000_000_000_000_000 + rng.randint(0, 10 ** 9)}" if rng.random() < 0.8 else ""
+            lines.append(f"{meas}{tags} {fields}{ts}")
+        text = "\n".join(lines) + "\n"
+        batch = influx_to_batch(text, BASE)
+        wt, wts, wv = self._python(text, BASE)
+        assert list(batch.tags) == wt
+        np.testing.assert_array_equal(batch.timestamps, np.asarray(wts, np.int64))
+        np.testing.assert_array_equal(batch.values["value"], np.asarray(wv))
