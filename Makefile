@@ -6,7 +6,7 @@ NATIVE_DIR := filodb_tpu/native
 
 all: native
 
-native: $(NATIVE_DIR)/libfilodbcodecs.so $(NATIVE_DIR)/libfilodbindex.so $(NATIVE_DIR)/libfilodbprom.so
+native: $(NATIVE_DIR)/libfilodbcodecs.so $(NATIVE_DIR)/libfilodbindex.so $(NATIVE_DIR)/libfilodbprom.so $(NATIVE_DIR)/libfilodbrender.so
 
 $(NATIVE_DIR)/libfilodbcodecs.so: $(NATIVE_DIR)/codecs.cpp
 	g++ -O3 -march=native -shared -fPIC $< -o $@
@@ -15,6 +15,9 @@ $(NATIVE_DIR)/libfilodbindex.so: $(NATIVE_DIR)/index.cpp
 	g++ -O3 -shared -fPIC $< -o $@
 
 $(NATIVE_DIR)/libfilodbprom.so: $(NATIVE_DIR)/promparse.cpp
+	g++ -O3 -march=native -std=c++17 -shared -fPIC $< -o $@
+
+$(NATIVE_DIR)/libfilodbrender.so: $(NATIVE_DIR)/promrender.cpp
 	g++ -O3 -march=native -std=c++17 -shared -fPIC $< -o $@
 
 test: native

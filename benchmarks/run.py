@@ -220,14 +220,70 @@ def bench_histogram_query():
     report("query_hist_quantile_qps", 1 / dt, "qps")
 
 
+def bench_jitter_query():
+    """Regular vs jittered scrape grids on the engine fast paths (VERDICT r2
+    weak #2: the irregular-timestamp gap). Reference semantics contract:
+    PeriodicSamplesMapper.scala:256 window iterators over arbitrary ts."""
+    import jax
+
+    from filodb_tpu.coordinator.planner import PlannerParams, QueryEngine
+    from filodb_tpu.core.records import SeriesBatch
+    from filodb_tpu.core.schemas import Dataset, METRIC_TAG, PROM_COUNTER, shard_for
+    from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+    from filodb_tpu.parallel.mesh import make_mesh
+
+    rng = np.random.default_rng(5)
+    n, n_series = 720, 4000
+    nominal = BASE + np.arange(n, dtype=np.int64) * 10_000
+    start, end = (BASE + 600_000) / 1000, (BASE + 7_000_000) / 1000
+
+    def build(jitter):
+        ms = TimeSeriesMemStore()
+        ms.setup(Dataset("prometheus"), range(8))
+        incr = rng.uniform(0, 10, size=(n_series, n))
+        vals = np.cumsum(incr, axis=1) + 1e9
+        for i in range(n_series):
+            tags = {METRIC_TAG: "rq_total", "_ws_": "w", "_ns_": "n",
+                    "inst": f"h{i}"}
+            shard = shard_for(tags, spread=3, num_shards=8)
+            ts = nominal
+            if jitter:
+                ts = nominal + np.rint(
+                    rng.uniform(-jitter, jitter, n) * 10_000).astype(np.int64)
+            ms.shard("prometheus", shard).ingest_series(
+                SeriesBatch(PROM_COUNTER, tags, ts, {"count": vals[i]})
+            )
+        return QueryEngine(ms, "prometheus",
+                           PlannerParams(mesh=make_mesh(jax.devices()[:1])))
+
+    results = {}
+    for label, jitter in (("regular", 0.0), ("jitter1pct", 0.01),
+                          ("jitter5pct", 0.05), ("jitter20pct", 0.2)):
+        engine = build(jitter)
+
+        def q():
+            r = engine.query_range("sum(rate(rq_total[5m]))", start, end, 60)
+            np.asarray(r.grids[0].values_np())
+
+        q()  # warm
+        dt = _bench(q, n_iters=10)
+        results[label] = dt
+        report(f"query_sum_rate_4k_{label}_p50", dt * 1e3, "ms")
+    report("jitter5pct_vs_regular_ratio",
+           results["jitter5pct"] / results["regular"], "x")
+
+
 ALL = [
     bench_encoding, bench_nan_sum, bench_ingestion, bench_index,
     bench_gateway_parse, bench_planner, bench_query_in_memory,
-    bench_query_hicard, bench_histogram_query,
+    bench_query_hicard, bench_histogram_query, bench_jitter_query,
 ]
 
 
 def main():
+    from filodb_tpu.config import apply_platform_env
+
+    apply_platform_env()  # FILODB_PLATFORM=cpu must win over a wedged plugin
     only = sys.argv[1] if len(sys.argv) > 1 else None
     for fn in ALL:
         if only and only not in fn.__name__:

@@ -19,6 +19,7 @@ clients re-raise typed QueryErrors.
 from __future__ import annotations
 
 import logging
+import os
 import threading
 from concurrent import futures
 
@@ -262,7 +263,9 @@ class GrpcPlanRemoteExec(ExecPlan):
         super().__init__()
         self.endpoint = endpoint
         self.logical_plan = logical_plan
-        self.auth_token = auth_token
+        # same env fallback as PromQlRemoteExec so token-protected federation
+        # works over either transport
+        self.auth_token = auth_token or os.environ.get("FILODB_REMOTE_TOKEN")
         self.local_only = local_only
         self.timeout_s = timeout_s
 

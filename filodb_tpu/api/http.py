@@ -95,6 +95,7 @@ class PromApiHandler(BaseHTTPRequestHandler):
     auth_token: str | None = None  # optional bearer auth (server factory)
     protocol_version = "HTTP/1.1"
     GZIP_MIN_BYTES = 1024
+    STREAM_MIN_SAMPLES = 200_000  # above this, query_range streams chunked
 
     def _engine_for_request(self) -> QueryEngine:
         if self.local_engine is not None and self.headers.get("X-FiloDB-Local"):
@@ -122,6 +123,18 @@ class PromApiHandler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
+
+    def _send_chunked(self, code: int, chunks):
+        """Stream an iterable of byte chunks with chunked transfer encoding
+        (HTTP/1.1 keep-alive safe); memory stays bounded by one chunk."""
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        for chunk in chunks:
+            if chunk:
+                self.wfile.write(f"{len(chunk):X}\r\n".encode() + chunk + b"\r\n")
+        self.wfile.write(b"0\r\n\r\n")
 
     def _read_body(self) -> str:
         length = int(self.headers.get("Content-Length") or 0)
@@ -292,13 +305,22 @@ class PromApiHandler(BaseHTTPRequestHandler):
                 else [],
             }
             return self._send(200, J.success(data))
-        data = J.render_matrix(res)
-        data["stats"] = {
+        stats = {
             "seriesScanned": res.stats.series_scanned,
             "samplesScanned": res.stats.samples_scanned,
             "cpuNanos": res.stats.cpu_ns,
             "bytesStaged": res.stats.bytes_staged,
         }
+        # large results stream chunked: memory stays bounded instead of
+        # holding matrix + full JSON string (reference executeStreaming,
+        # ExecPlan.scala:146); small ones keep the gzip-capable dict path
+        n_samples = sum(g.n_series * g.num_steps for g in res.grids)
+        if res.raw is not None:
+            n_samples += sum(len(t) for _, t, _ in res.raw)
+        if n_samples >= self.STREAM_MIN_SAMPLES:
+            return self._send_chunked(200, J.stream_matrix(res, stats))
+        data = J.render_matrix(res)
+        data["stats"] = stats
         return self._send(200, J.success(data))
 
     def _query(self):

@@ -4,6 +4,7 @@ error envelopes, label normalization)."""
 
 from __future__ import annotations
 
+import json
 import math
 from typing import Any
 
@@ -67,6 +68,74 @@ def render_scalar(res: QueryResult, time_s: float) -> dict:
     if res.scalar is not None and len(res.scalar.values):
         v = float(res.scalar.values[-1])
     return {"resultType": "scalar", "result": [time_s, _fmt(v)]}
+
+
+def _values_fragment(ts_s: np.ndarray, vals: np.ndarray) -> bytes:
+    """[[t,"v"],...] fragment for one series; native renderer when built
+    (promrender.cpp, ~100x the Python loop), Python fallback otherwise.
+    Both skip NaN samples and render specials as NaN/+Inf/-Inf."""
+    from .. import native as N
+
+    frag = N.render_values(ts_s, vals)
+    if frag is not None:
+        return frag
+    keep = ~np.isnan(vals)
+    parts = (
+        f'[{json.dumps(float(t))},"{_fmt(v)}"]'
+        for t, v in zip(ts_s[keep], vals[keep])
+    )
+    return ("[" + ",".join(parts) + "]").encode()
+
+
+def stream_matrix(res: QueryResult, stats: dict | None = None,
+                  chunk_target: int = 1 << 18):
+    """Generator of JSON byte chunks for a matrix result envelope.
+
+    The serving-edge answer to reference executeStreaming
+    (query/exec/ExecPlan.scala:146) + SerializedRangeVector: root-node memory
+    stays bounded by ``chunk_target`` + one series fragment instead of the
+    whole rendered matrix (a 100k-series raw export is ~10M samples; the
+    non-streaming path held matrix + JSON string concurrently)."""
+    buf = bytearray()
+    buf += b'{"status":"success","data":{"resultType":"matrix","result":['
+    first = True
+
+    def emit(labels, ts_s, vals, keep_empty):
+        nonlocal first
+        frag = _values_fragment(ts_s, vals)
+        if frag == b"[]" and not keep_empty:
+            return None
+        head = b"" if first else b","
+        first = False
+        return (
+            head + b'{"metric":'
+            + json.dumps(_labels_out(labels)).encode()
+            + b',"values":' + frag + b"}"
+        )
+
+    if res.raw is not None:
+        for labels, ts, vals in res.raw:
+            piece = emit(labels, ts.astype(np.float64) / 1e3, vals, True)
+            if piece:
+                buf += piece
+            if len(buf) >= chunk_target:
+                yield bytes(buf)
+                buf.clear()
+    for g in res.grids:
+        ts_s = g.step_times_ms().astype(np.float64) / 1e3
+        vals = g.values_np()
+        for i, labels in enumerate(g.labels):
+            piece = emit(labels, ts_s, vals[i], False)
+            if piece:
+                buf += piece
+            if len(buf) >= chunk_target:
+                yield bytes(buf)
+                buf.clear()
+    buf += b"]"
+    if stats is not None:
+        buf += b',"stats":' + json.dumps(stats).encode()
+    buf += b"}}"
+    yield bytes(buf)
 
 
 def success(data: Any) -> dict:

@@ -67,11 +67,21 @@ class FiloClient:
                 sv = np.asarray(res.scalar.values)[:n]
                 row[: len(sv)] = sv
                 series.append({"metric": {}, "values": row})
+            req_start_ms = round(start_s * 1000)
             for g in res.grids:
                 vals = g.values_np()
+                # align onto the client grid like the HTTP branch: a grid may
+                # start offset from the request or carry fewer steps
+                # (offset/lookback edges) — place by timestamp, NaN-pad gaps
+                gt = g.step_times_ms()
+                idx = (gt - req_start_ms) // step_ms
+                ok = ((gt - req_start_ms) % step_ms == 0) & (idx >= 0) & (idx < n)
+                src = np.nonzero(ok)[0]
+                dst = idx[ok]
                 for i, lbls in enumerate(g.labels):
-                    series.append({"metric": _public_labels(lbls),
-                                   "values": vals[i, :n].astype(np.float64)})
+                    row = np.full(n, np.nan)
+                    row[dst] = vals[i, src].astype(np.float64)
+                    series.append({"metric": _public_labels(lbls), "values": row})
             return times, series
         data = self._get(
             "/api/v1/query_range", query=promql, start=start_s, end=end_s, step=step_s

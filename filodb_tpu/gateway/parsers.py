@@ -248,6 +248,10 @@ def _native_prom_batches(text: str, default_ts_ms: int, ws: str, ns: str):
             # including raising for genuinely bad lines. strip() for the wider
             # Unicode whitespace the byte scanner can't trim.
             line = payload[off:off + ln].decode().strip()
+            if not line or line.startswith("#"):
+                # Unicode whitespace (e.g. U+00A0) can hide a comment/blank
+                # from the byte scanner; parse_prom_text skips these.
+                continue
             name, tags, t2, v, ex = _parse_sample_line(line)
             t = t2 if t2 is not None else N.TS_ABSENT
             full = dict(tags)

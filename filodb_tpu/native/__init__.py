@@ -233,3 +233,74 @@ def parse_influx_records(payload: bytes):
     if n < 0:
         return None
     return out[:n]
+
+
+# ---------------------------------------------------------------------------
+# Prometheus JSON sample renderer (promrender.cpp -> libfilodbrender.so)
+# ---------------------------------------------------------------------------
+
+_RENDER_SO = os.path.join(_HERE, "libfilodbrender.so")
+_RENDER_SRC = os.path.join(_HERE, "promrender.cpp")
+_render_lib = None
+_render_tried = False
+
+
+def render_lib():
+    global _render_lib, _render_tried
+    if _render_lib is not None or _render_tried:
+        return _render_lib
+    with _lock:
+        if _render_lib is not None or _render_tried:
+            return _render_lib
+        _render_tried = True
+        try:
+            stale = (not os.path.exists(_RENDER_SO)
+                     or os.path.getmtime(_RENDER_SO) < os.path.getmtime(_RENDER_SRC))
+        except OSError:
+            stale = not os.path.exists(_RENDER_SO)
+        if stale:
+            try:
+                subprocess.run(
+                    ["g++", "-O3", "-march=native", "-std=c++17", "-shared",
+                     "-fPIC", _RENDER_SRC, "-o", _RENDER_SO],
+                    check=True, capture_output=True, timeout=120,
+                )
+            except Exception:
+                return None
+        try:
+            L = ctypes.CDLL(_RENDER_SO)
+        except OSError:
+            return None
+        for name, vt in (("fdb_render_values_f64", ctypes.POINTER(ctypes.c_double)),
+                         ("fdb_render_values_f32", ctypes.POINTER(ctypes.c_float))):
+            fn = getattr(L, name)
+            fn.restype = ctypes.c_long
+            fn.argtypes = [ctypes.POINTER(ctypes.c_double), vt,
+                           ctypes.c_long, ctypes.c_char_p, ctypes.c_long]
+        _render_lib = L
+        return _render_lib
+
+
+def render_values(ts_s: np.ndarray, vals: np.ndarray):
+    """Render [[t,"v"],...] (NaN samples skipped) natively; None when the
+    lib is unavailable (callers fall back to the Python renderer)."""
+    L = render_lib()
+    if L is None:
+        return None
+    ts = np.ascontiguousarray(ts_s, dtype=np.float64)
+    n = len(ts)
+    cap = 64 * n + 16
+    out = ctypes.create_string_buffer(cap)
+    if vals.dtype == np.float32:
+        v = np.ascontiguousarray(vals, dtype=np.float32)
+        nw = L.fdb_render_values_f32(
+            ts.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            v.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), n, out, cap)
+    else:
+        v = np.ascontiguousarray(vals, dtype=np.float64)
+        nw = L.fdb_render_values_f64(
+            ts.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            v.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), n, out, cap)
+    if nw < 0:
+        return None
+    return out.raw[:nw]

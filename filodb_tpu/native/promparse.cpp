@@ -166,7 +166,7 @@ long fdb_parse_prom(const char* buf, long len, FdbPromRec* out, long max_out) {
                 while (p < e && !is_space(buf[p])) p++;
                 for (long q = tok; q < p; q++) {
                     char c = buf[q];
-                    if (c == 'x' || c == 'X' || c == '_') { defer = true; break; }
+                    if (c == 'x' || c == 'X' || c == '_' || c == '(' || c == ')') { defer = true; break; }
                 }
                 if (!defer) {
                     char* endp = nullptr;

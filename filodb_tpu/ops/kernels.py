@@ -470,6 +470,21 @@ def run_range_function(
         return run_mxu_range_function(
             func, block, params, is_counter=is_counter, is_delta=is_delta, args=args
         )
+    if (
+        block.nominal_ts is not None
+        and not (is_delta and func in ("irate", "idelta"))
+        and not args
+    ):
+        from .mxu_jitter import JITTER_FUNCS, run_jitter_range_function
+
+        if func in JITTER_FUNCS:
+            # near-regular (jittered scrape) fast path: certain-membership
+            # matmul + per-series boundary corrections (mxu_jitter.py)
+            res = run_jitter_range_function(
+                func, block, params, is_counter=is_counter, is_delta=is_delta
+            )
+            if res is not None:
+                return res
     import os as _os
 
     pallas_mode = _os.environ.get("FILODB_PALLAS", "auto")

@@ -1,0 +1,382 @@
+"""Near-regular (jittered) grid range kernels on the MXU.
+
+Real Prometheus scrape timestamps jitter around the scrape interval; the
+exact-shared-grid MXU path (mxu_kernels.py) requires identical timestamps
+across series, so jittered data used to drop to the ~40x-slower gather path.
+This module keeps it on the MXU with EXACT semantics (the window-membership
+contract of the reference's window iterators, PeriodicSamplesMapper.scala:256):
+
+Staging detects blocks where every series has the same sample count and each
+sample lies within half a nominal interval of a shared nominal grid
+(staging.StagedBlock.nominal_ts / ts_dev / maxdev_ms). Then for any window
+boundary at most ONE nominal slot has per-series-uncertain membership:
+
+- slots with nominal time in (b + maxdev, e - maxdev] are in the window for
+  EVERY series -> one shared certain-membership matrix W0 (an MXU matmul);
+- the <=1 uncertain slot per boundary (klo at the lower edge, khi at the
+  upper) is resolved per series from the staged deviations: its value/time
+  is fetched with a one-hot MATMUL (an MXU-speed gather) and its membership
+  is an elementwise compare of the deviation against the boundary offset.
+
+So sum/count/first/last/rate/... become `certain part (shared matmul) +
+per-series boundary corrections (elementwise)`, and the whole evaluation
+stays matmul-dominated. Precision: boundary times are computed RELATIVE to
+each window's start in f32 ms (exact below ~4.6h windows; beyond that the
+sub-10ms rounding is far inside the oracle tolerance).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .staging import StagedBlock
+
+# supported under jitter; everything else falls back to the general kernels
+JITTER_FUNCS = {
+    "sum_over_time", "count_over_time", "avg_over_time", "last",
+    "last_over_time", "first_over_time", "present_over_time",
+    "absent_over_time", "stddev_over_time", "stdvar_over_time", "z_score",
+    "rate", "increase", "delta", "idelta", "irate",
+    "min_over_time", "max_over_time",
+}
+
+_TILE = 16  # tile width for the min/max hierarchy (matches mxu_kernels)
+
+
+class JitterWindowMatrices:
+    """Host-precomputed certain/uncertain window structure for one
+    (nominal grid, output grid, window) triple."""
+
+    def __init__(self, nominal_ts: np.ndarray, n_valid: int, maxdev_ms: int,
+                 start_off: int, step_ms: int, num_steps: int, window_ms: int):
+        R = nominal_ts[:n_valid].astype(np.int64)
+        T = len(nominal_ts)
+        J = num_steps
+        m = n_valid
+        out_t = start_off + np.arange(J, dtype=np.int64) * step_ms
+        b = out_t - window_ms
+        e = out_t
+        md = int(maxdev_ms)
+        # klo == khi (a single sample uncertain at BOTH boundaries) is only
+        # possible for windows not wider than the deviation band; caller
+        # falls back to the general path
+        self.ok = window_ms > 2 * md
+        if not self.ok:
+            return
+        clo = np.searchsorted(R, b + md, side="right")
+        chi = np.searchsorted(R, e - md, side="right")
+        count0 = np.maximum(chi - clo, 0)
+        klo_a = np.searchsorted(R, b - md, side="right")
+        klo_b = np.searchsorted(R, b + md, side="right")
+        khi_a = np.searchsorted(R, e - md, side="right")
+        khi_b = np.searchsorted(R, e + md, side="right")
+        # staging guarantees 2*maxdev < min nominal interval, so each
+        # boundary band contains at most one slot
+        has_klo = (klo_b - klo_a) == 1
+        has_khi = (khi_b - khi_a) == 1
+        klo = np.where(has_klo, klo_a, 0)
+        khi = np.where(has_khi, khi_a, 0)
+        chi = np.minimum(chi, m)
+        c0pos = count0 > 0
+        c0ge2 = count0 >= 2
+
+        tidx = np.arange(T)[:, None]
+        W0 = ((tidx >= clo[None, :]) & (tidx < chi[None, :])).astype(np.float32)
+
+        def onehot(idx, mask):
+            M = np.zeros((T, J), dtype=np.float32)
+            cols = np.nonzero(mask)[0]
+            M[idx[cols], cols] = 1.0
+            return M
+
+        F0 = onehot(clo, c0pos)
+        L0 = onehot(chi - 1, c0pos)
+        L2 = onehot(chi - 2, c0ge2)
+        Klo = onehot(klo, has_klo)
+        Khi = onehot(khi, has_khi)
+        # [T, 6, J] -> [T, 6J]: ONE matmul per input array fetches every piece
+        self.CM = np.stack([W0, F0, L0, L2, Klo, Khi], axis=1).reshape(T, 6 * J)
+
+        def rel(idx, mask):
+            """nominal time of slot idx relative to each window's start b."""
+            r = R[np.clip(idx, 0, m - 1)] - b
+            return np.where(mask, r, 0).astype(np.float32)
+
+        self.count0 = count0.astype(np.float32)
+        self.c0pos = c0pos
+        self.c0ge2 = c0ge2
+        self.has_klo = has_klo
+        self.has_khi = has_khi
+        self.F0_rel = rel(clo, c0pos)
+        self.L0_rel = rel(chi - 1, c0pos)
+        self.L2_rel = rel(chi - 2, c0ge2)
+        self.Klo_rel = rel(klo, has_klo)
+        self.Khi_rel = rel(khi, has_khi)
+        # membership thresholds for the uncertain slots, as deviation bounds:
+        # klo in window  <=>  ts > b  <=>  dev > b - R[klo]
+        # khi in window  <=>  ts <= e <=>  dev <= e - R[khi]
+        self.blo_rel = np.where(
+            has_klo, b - R[np.clip(klo, 0, m - 1)], 2 * md + 1
+        ).astype(np.float32)
+        self.ehi_rel = np.where(
+            has_khi, e - R[np.clip(khi, 0, m - 1)], -(2 * md) - 1
+        ).astype(np.float32)
+
+        # min/max tile hierarchy over the certain range [clo, chi)
+        Lt = _TILE
+        n_tiles = T // Lt
+        t_lo = -(-clo // Lt)
+        t_hi = chi // Lt
+        full = np.arange(n_tiles)[None, :]
+        self.tile_mask = (
+            (full >= t_lo[:, None]) & (full < t_hi[:, None]) & (t_lo < t_hi)[:, None]
+        )
+        E = np.zeros((T, J * 2 * Lt), dtype=np.float32)
+        edge_valid = np.zeros((J, 2 * Lt), dtype=bool)
+        for j in range(J):
+            if chi[j] <= clo[j]:
+                continue
+            if t_lo[j] >= t_hi[j]:
+                left = np.arange(clo[j], chi[j])
+                right = np.empty(0, dtype=np.int64)
+            else:
+                left = np.arange(clo[j], t_lo[j] * Lt)
+                right = np.arange(t_hi[j] * Lt, chi[j])
+            for slot, pos in enumerate(np.concatenate([left, right])[: 2 * Lt]):
+                E[pos, j * 2 * Lt + slot] = 1.0
+                edge_valid[j, slot] = True
+        self.edge_onehot = E
+        self.edge_valid = edge_valid
+
+        put = jax.device_put
+        self.dCM = put(self.CM)
+        self.d_count0 = put(self.count0)
+        self.d_c0pos = put(self.c0pos)
+        self.d_c0ge2 = put(self.c0ge2)
+        self.d_has_klo = put(self.has_klo)
+        self.d_has_khi = put(self.has_khi)
+        self.d_F0_rel = put(self.F0_rel)
+        self.d_L0_rel = put(self.L0_rel)
+        self.d_L2_rel = put(self.L2_rel)
+        self.d_Klo_rel = put(self.Klo_rel)
+        self.d_Khi_rel = put(self.Khi_rel)
+        self.d_blo_rel = put(self.blo_rel)
+        self.d_ehi_rel = put(self.ehi_rel)
+        self.d_tile_mask = put(self.tile_mask)
+        self.d_edge_onehot = put(self.edge_onehot)
+        self.d_edge_valid = put(self.edge_valid)
+
+
+def jitter_window_matrices(block: StagedBlock, start_off: int, step_ms: int,
+                           num_steps: int, window_ms: int) -> JitterWindowMatrices:
+    cache = getattr(block, "_jwm_cache", None)
+    if cache is None:
+        cache = {}
+        setattr(block, "_jwm_cache", cache)
+    key = (int(start_off), int(step_ms), int(num_steps), int(window_ms))
+    wm = cache.get(key)
+    if wm is None:
+        wm = JitterWindowMatrices(
+            np.asarray(block.nominal_ts), int(np.asarray(block.lens)[0]),
+            block.maxdev_ms, start_off, step_ms, num_steps, window_ms,
+        )
+        cache[key] = wm
+    return wm
+
+
+@functools.partial(jax.jit, static_argnames=("func", "is_counter", "is_delta"))
+def jitter_range_kernel(
+    func: str,
+    vals,  # [S, T] f32
+    dev,  # [S, T] f32 per-sample deviation from the nominal grid (ms)
+    raw,  # [S, T] f32 (counters; == vals otherwise)
+    CM,  # [T, 6J]: W0|F0|L0|L2|Klo|Khi stacked
+    count0, c0pos, c0ge2, has_klo, has_khi,  # [J]
+    F0_rel, L0_rel, L2_rel, Klo_rel, Khi_rel, blo_rel, ehi_rel,  # [J] f32
+    window_ms,
+    is_counter: bool = False,
+    is_delta: bool = False,
+):
+    f32 = jnp.float32
+    nan = jnp.nan
+    S = vals.shape[0]
+    J = CM.shape[1] // 6
+
+    def mm(x):
+        a = jax.lax.dot(x, CM, precision=jax.lax.Precision.HIGHEST)
+        return a.reshape(S, 6, J)
+
+    A = mm(vals)
+    sW, vF0, vL0, vL2, vKlo, vKhi = (A[:, i, :] for i in range(6))
+    D = mm(dev)
+    dF0, dL0, dL2, dKlo, dKhi = (D[:, i, :] for i in range(1, 6))
+
+    in_lo = has_klo[None, :] & (dKlo > blo_rel[None, :])
+    in_hi = has_khi[None, :] & (dKhi <= ehi_rel[None, :])
+    cnt = count0[None, :] + in_lo + in_hi
+    has = cnt > 0
+    w_s = window_ms.astype(f32) * 1e-3
+
+    def w3(m1, a, m2, b_, c):
+        return jnp.where(m1, a, jnp.where(m2, b_, c))
+
+    if func == "sum_over_time" or (is_delta and func in ("rate", "increase")):
+        s = sW + jnp.where(in_lo, vKlo, 0.0) + jnp.where(in_hi, vKhi, 0.0)
+        if func == "rate":
+            s = s / w_s
+        return jnp.where(has, s, nan)
+    if func == "count_over_time":
+        return jnp.where(has, cnt, nan)
+    if func == "avg_over_time":
+        s = sW + jnp.where(in_lo, vKlo, 0.0) + jnp.where(in_hi, vKhi, 0.0)
+        return jnp.where(has, s / jnp.maximum(cnt, 1.0), nan)
+    if func == "present_over_time":
+        return jnp.where(has, 1.0, nan)
+    if func == "absent_over_time":
+        return jnp.where(has, nan, 1.0)
+
+    # ordered in-window sample selection: [klo?] certain[clo..chi) [khi?]
+    v_first = w3(in_lo, vKlo, c0pos[None, :], vF0, vKhi)
+    v_last = w3(in_hi, vKhi, c0pos[None, :], vL0, vKlo)
+    tf_rel = w3(in_lo, Klo_rel[None, :] + dKlo, c0pos[None, :],
+                F0_rel[None, :] + dF0, Khi_rel[None, :] + dKhi)
+    tl_rel = w3(in_hi, Khi_rel[None, :] + dKhi, c0pos[None, :],
+                L0_rel[None, :] + dL0, Klo_rel[None, :] + dKlo)
+
+    if func in ("last", "last_over_time"):
+        return jnp.where(has, v_last, nan)
+    if func == "first_over_time":
+        return jnp.where(has, v_first, nan)
+    if func in ("stddev_over_time", "stdvar_over_time", "z_score"):
+        A2 = mm(vals * vals)
+        sW2 = A2[:, 0, :]
+        s = sW + jnp.where(in_lo, vKlo, 0.0) + jnp.where(in_hi, vKhi, 0.0)
+        s2 = sW2 + jnp.where(in_lo, vKlo * vKlo, 0.0) + jnp.where(in_hi, vKhi * vKhi, 0.0)
+        c = jnp.maximum(cnt, 1.0)
+        mean = s / c
+        var = jnp.maximum(s2 / c - mean * mean, 0.0)
+        if func == "stdvar_over_time":
+            return jnp.where(has, var, nan)
+        sd = jnp.sqrt(var)
+        if func == "stddev_over_time":
+            return jnp.where(has, sd, nan)
+        return jnp.where(has, (v_last - mean) / jnp.maximum(sd, 1e-30), nan)
+    if func in ("rate", "increase", "delta"):
+        dlt = v_last - v_first
+        sampled = (tl_rel - tf_rel) * 1e-3
+        dur_start = tf_rel * 1e-3
+        dur_end = (window_ms.astype(f32) - tl_rel) * 1e-3
+        avg_dur = sampled / jnp.maximum(cnt - 1.0, 1.0)
+        thresh = avg_dur * 1.1
+        if is_counter and func != "delta":
+            Ar = mm(raw)
+            v_first_raw = w3(in_lo, Ar[:, 4, :], c0pos[None, :], Ar[:, 1, :], Ar[:, 5, :])
+            dur_zero = jnp.where(
+                dlt > 0, sampled * (v_first_raw / jnp.maximum(dlt, 1e-30)), jnp.inf
+            )
+            ds = jnp.minimum(dur_start, jnp.where(v_first_raw >= 0, dur_zero, jnp.inf))
+        else:
+            ds = dur_start
+        ds = jnp.where(ds >= thresh, avg_dur / 2.0, ds)
+        de = jnp.where(dur_end >= thresh, avg_dur / 2.0, dur_end)
+        factor = (sampled + ds + de) / jnp.maximum(sampled, 1e-30)
+        res = dlt * factor
+        if func == "rate":
+            res = res / w_s
+        return jnp.where(cnt >= 2, res, nan)
+    if func in ("irate", "idelta"):
+        ok2 = cnt >= 2
+        if func == "idelta" and is_counter and not is_delta:
+            # diff-encoded counters: the staged value AT the last in-window
+            # sample is already the f64-exact last-pair difference
+            return jnp.where(ok2, v_last, nan)
+        v_prev = jnp.where(
+            in_hi,
+            jnp.where(c0pos[None, :], vL0, vKlo),
+            jnp.where(c0ge2[None, :], vL2, vKlo),
+        )
+        tp_rel = jnp.where(
+            in_hi,
+            jnp.where(c0pos[None, :], L0_rel[None, :] + dL0, Klo_rel[None, :] + dKlo),
+            jnp.where(c0ge2[None, :], L2_rel[None, :] + dL2, Klo_rel[None, :] + dKlo),
+        )
+        dt_s = (tl_rel - tp_rel) * 1e-3
+        dv = v_last - v_prev
+        r = dv / jnp.maximum(dt_s, 1e-30) if func == "irate" else dv
+        return jnp.where(ok2, r, nan)
+    raise ValueError(f"jitter kernel does not support {func}")
+
+
+@functools.partial(jax.jit, static_argnames=("n_valid", "is_min"))
+def jitter_minmax(vals, dev, CM, tile_mask, edge_onehot, edge_valid,
+                  count0, has_klo, has_khi, blo_rel, ehi_rel,
+                  n_valid: int, is_min: bool = True):
+    """min/max over the certain range via the tile hierarchy + edge one-hots
+    (mxu_kernels.mxu_minmax structure), then fold in the <=2 per-series
+    uncertain boundary samples."""
+    S, T = vals.shape
+    Lt = _TILE
+    J = tile_mask.shape[0]
+    v = vals if is_min else -vals
+    sentinel = jnp.float32(3e38)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (S, T), 1)
+    vm = jnp.where(lane < n_valid, v, sentinel)
+    tmin = vm.reshape(S, T // Lt, Lt).min(-1)
+    certain = jnp.where(tile_mask[None, :, :], tmin[:, None, :], sentinel).min(-1)
+    edges = jax.lax.dot(vm, edge_onehot, precision=jax.lax.Precision.HIGHEST)
+    edges = edges.reshape(S, J, 2 * Lt)
+    edges = jnp.where(edge_valid[None, :, :], edges, sentinel).min(-1)
+    r = jnp.minimum(certain, edges)
+
+    A = jax.lax.dot(v, CM, precision=jax.lax.Precision.HIGHEST).reshape(S, 6, J)
+    vKlo, vKhi = A[:, 4, :], A[:, 5, :]
+    D = jax.lax.dot(dev, CM, precision=jax.lax.Precision.HIGHEST).reshape(S, 6, J)
+    dKlo, dKhi = D[:, 4, :], D[:, 5, :]
+    in_lo = has_klo[None, :] & (dKlo > blo_rel[None, :])
+    in_hi = has_khi[None, :] & (dKhi <= ehi_rel[None, :])
+    r = jnp.minimum(r, jnp.where(in_lo, vKlo, sentinel))
+    r = jnp.minimum(r, jnp.where(in_hi, vKhi, sentinel))
+    cnt = count0[None, :] + in_lo + in_hi
+    r = r if is_min else -r
+    return jnp.where(cnt > 0, r, jnp.nan)
+
+
+def run_jitter_range_function(func, block: StagedBlock, params,
+                              is_counter=False, is_delta=False, args=()):
+    """Entry: dispatch one jittered-grid range function. Returns a device
+    array [S, J_padded], or None when this (window, grid) combination can't
+    use the jitter path (caller falls back to the general kernels)."""
+    from .kernels import pad_steps
+
+    J = pad_steps(params.num_steps)
+    start_off = int(params.start_ms - block.base_ms)
+    wm = jitter_window_matrices(block, start_off, params.step_ms, J, params.window_ms)
+    if not wm.ok:
+        return None
+    dev = block.ts_dev
+    if func in ("min_over_time", "max_over_time"):
+        return jitter_minmax(
+            jnp.asarray(block.vals), dev, wm.dCM, wm.d_tile_mask,
+            wm.d_edge_onehot, wm.d_edge_valid, wm.d_count0,
+            wm.d_has_klo, wm.d_has_khi, wm.d_blo_rel, wm.d_ehi_rel,
+            n_valid=int(np.asarray(block.lens)[0]),
+            is_min=(func == "min_over_time"),
+        )
+    raw = block.raw if block.raw is not None else block.vals
+    return jitter_range_kernel(
+        func,
+        block.vals,
+        dev,
+        raw,
+        wm.dCM,
+        wm.d_count0, wm.d_c0pos, wm.d_c0ge2, wm.d_has_klo, wm.d_has_khi,
+        wm.d_F0_rel, wm.d_L0_rel, wm.d_L2_rel, wm.d_Klo_rel, wm.d_Khi_rel,
+        wm.d_blo_rel, wm.d_ehi_rel,
+        np.float32(params.window_ms),
+        is_counter=is_counter,
+        is_delta=is_delta,
+    )

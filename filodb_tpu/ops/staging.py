@@ -64,6 +64,16 @@ class StagedBlock:
     # and one length — window matrices become series-independent and the
     # range kernel becomes a batched matmul on the MXU (see kernels.py)
     regular_ts: np.ndarray | None = None  # [T] int32 shared offsets, or None
+    # near-regular (jittered) fast path: every series has the same sample
+    # COUNT and each sample sits within half a scrape interval of a shared
+    # nominal grid. Window membership then deviates from the nominal-grid
+    # answer by at most one sample per window boundary, which mxu_jitter.py
+    # resolves per-series with one-hot-matmul gathers — keeping real-world
+    # jittered scrapes on the MXU path (reference semantics contract:
+    # PeriodicSamplesMapper.scala:256 window iterators over arbitrary ts)
+    nominal_ts: np.ndarray | None = None  # [T] int32 shared nominal offsets
+    ts_dev: np.ndarray | None = None  # [S, T] f32 per-sample deviation (ms)
+    maxdev_ms: int = 0  # bound on |ts - nominal|; < half min nominal interval
 
     @property
     def shape(self):
@@ -80,6 +90,8 @@ class StagedBlock:
         self.baseline = jax.device_put(self.baseline)
         if self.raw is not None:
             self.raw = jax.device_put(self.raw)
+        if self.ts_dev is not None:
+            self.ts_dev = jax.device_put(self.ts_dev)
         return self
 
 
@@ -153,13 +165,95 @@ def stage_series(
         else:
             out_vals[i, :m] = vals.astype(dtype)
     regular = None
+    nominal = None
+    ts_dev = None
+    maxdev = 0
     if n > 0 and (lens[:n] == lens[0]).all() and lens[0] > 0:
         if not (out_ts[:n] != out_ts[0]).any():
             regular = out_ts[0]
+        elif lens[0] >= 2:
+            # near-regular detection: shared nominal grid = per-slot midrange
+            # (minimax-optimal: minimizes the max deviation), deviations must
+            # stay under half the minimum nominal interval so at most ONE
+            # sample per window boundary has uncertain membership
+            # (see mxu_jitter.py)
+            m = int(lens[0])
+            real = out_ts[:n, :m].astype(np.int64)
+            nom = (real.min(axis=0) + real.max(axis=0)) // 2
+            dev = real - nom[None, :]
+            md = int(np.abs(dev).max())
+            min_int = int(np.diff(nom).min()) if m >= 2 else 0
+            if min_int > 0 and 2 * md < min_int:
+                nominal = np.full(T, TS_PAD, dtype=np.int32)
+                nominal[:m] = nom.astype(np.int32)
+                ts_dev = np.zeros((S, T), dtype=np.float32)
+                ts_dev[:n, :m] = dev.astype(np.float32)
+                maxdev = md
     return StagedBlock(
         out_ts, out_vals, lens, base_ms, baseline, n, part_refs or [],
-        raw=out_raw, regular_ts=regular,
+        raw=out_raw, regular_ts=regular, nominal_ts=nominal, ts_dev=ts_dev,
+        maxdev_ms=maxdev,
     )
+
+
+def harmonize_nominal(blocks) -> bool:
+    """Rewrite per-shard near-regular blocks onto ONE common nominal grid so
+    a mesh kernel can share a single certain/uncertain window structure
+    across shards (parallel/exec.py). Each shard staged independently and
+    estimated its own nominal grid; the common grid is the midrange of the
+    per-block grids, deviations are recomputed exactly from the int
+    timestamps, and the safety bound (2*maxdev < min interval) is re-checked
+    against the common grid. Returns False (blocks untouched) when the
+    blocks can't be harmonized."""
+    real = [b for b in blocks if b.n_series > 0]
+    if not real:
+        return False
+    noms = []
+    m = None
+    for b in real:
+        lens = np.asarray(b.lens)
+        if not (lens[: b.n_series] == lens[0]).all() or lens[0] == 0:
+            return False
+        if m is None:
+            m = int(lens[0])
+        elif int(lens[0]) != m:
+            return False
+        if b.regular_ts is not None:
+            noms.append(np.asarray(b.regular_ts)[:m].astype(np.int64))
+        elif b.nominal_ts is not None:
+            noms.append(np.asarray(b.nominal_ts)[:m].astype(np.int64))
+        else:
+            return False
+    if len({b.base_ms for b in real}) != 1:
+        return False
+    nom_mat = np.stack(noms)
+    common = (nom_mat.min(axis=0) + nom_mat.max(axis=0)) // 2
+    if m >= 2:
+        min_int = int(np.diff(common).min())
+    else:
+        return False
+    devs, md = [], 0
+    for b in real:
+        ts = np.asarray(b.ts)[: b.n_series, :m].astype(np.int64)
+        d = ts - common[None, :]
+        md = max(md, int(np.abs(d).max()))
+        devs.append(d)
+    if min_int <= 0 or 2 * md >= min_int:
+        return False
+    for b, d in zip(real, devs):
+        T = b.ts.shape[1]
+        S = b.vals.shape[0]
+        nominal = np.full(T, TS_PAD, dtype=np.int32)
+        nominal[:m] = common.astype(np.int32)
+        ts_dev = np.zeros((S, T), dtype=np.float32)
+        ts_dev[: b.n_series, :m] = d.astype(np.float32)
+        b.nominal_ts = nominal
+        b.ts_dev = ts_dev
+        b.maxdev_ms = md
+        b.regular_ts = b.regular_ts if md == 0 else None
+        if hasattr(b, "_jwm_cache"):
+            del b._jwm_cache
+    return True
 
 
 def stage_histogram_series(
@@ -197,6 +291,72 @@ def stage_histogram_series(
         else:
             out_vals[i, :m] = vals.astype(dtype)
     return StagedBlock(out_ts, out_vals, lens, base_ms, baseline, n, part_refs or [])
+
+
+def _slot_align(shard, part_ids, column, series, start_ms: int, end_ms: int):
+    """Repair ragged staging of near-regular grids at the read-range edges.
+
+    A sample whose jittered timestamp falls just outside [start_ms, end_ms]
+    is excluded for SOME series, so per-series sample counts differ by 1-2
+    and the near-regular detection (and with it the MXU jitter path) fails.
+    Re-read with a one-interval margin, map every sample to its nominal slot,
+    and trim all series to the common slot range that can contribute to any
+    window. Dropped edge slots provably can't: a slot with nominal time
+    g <= start - maxdev has true ts <= start for every series (windows need
+    ts > bound >= start - window... bound >= start_ms here because start_ms
+    is the staged lower bound = earliest window start), and one with
+    g > end + maxdev has ts > end >= every window end.
+
+    Returns the slot-aligned series list, or None when the data isn't
+    near-regular (caller keeps the original packed staging)."""
+    lens = [len(t) for t, _ in series]
+    if not lens or min(lens) < 2 or max(lens) - min(lens) > 2:
+        return None
+    ref = series[int(np.argmax(lens))][0]
+    diffs = np.diff(ref)
+    # endpoint-based estimate: per-sample jitter contributes only
+    # O(maxdev / n) error, where a median of jittered diffs drifts by
+    # O(n * median_error) across the span
+    interval = float(ref[-1] - ref[0]) / (len(ref) - 1)
+    if interval <= 0 or (np.abs(diffs - interval) > 0.45 * interval).any():
+        return None
+    anchor = float(ref[0])
+    margin = int(round(interval))
+    per = []
+    md = 0.0
+    for pid in part_ids:
+        ts, v = shard.partition(int(pid)).samples_in_range(
+            start_ms - margin, end_ms + margin, column
+        )
+        if v.ndim == 2 or len(ts) < 2:
+            return None
+        keep = ~np.isnan(v)
+        if not keep.all():
+            return None  # staleness holes: packed staging handles them
+        k = np.rint((ts.astype(np.float64) - anchor) / interval).astype(np.int64)
+        if (np.diff(k) != 1).any():
+            return None  # missed scrapes: not slot-contiguous
+        md = max(md, float(np.abs(ts - (anchor + k * interval)).max()))
+        per.append((k, ts, v))
+    if 2.0 * md >= 0.9 * interval:
+        return None
+    # slots that could contribute to any window of the staged range
+    k_need_lo = int(np.ceil((start_ms - md - anchor) / interval - 1e-9))
+    while anchor + k_need_lo * interval <= start_ms - md:
+        k_need_lo += 1
+    k_need_hi = int(np.floor((end_ms + md - anchor) / interval + 1e-9))
+    while anchor + k_need_hi * interval > end_ms + md:
+        k_need_hi -= 1
+    k_lo = max(k[0] for k, _, _ in per)
+    k_hi = min(k[-1] for k, _, _ in per)
+    if k_lo > k_need_lo or k_hi < k_need_hi or k_need_hi < k_need_lo:
+        return None  # a needed slot is genuinely missing for some series
+    out = []
+    width = k_need_hi - k_need_lo + 1
+    for k, ts, v in per:
+        o = k_need_lo - int(k[0])
+        out.append((ts[o : o + width], v[o : o + width]))
+    return out
 
 
 def stage_from_shard(
@@ -243,10 +403,22 @@ def stage_from_shard(
             series, start_ms, hist_width, refs,
             subtract_baseline=mode in ("corrected", "shifted"), dtype=dtype
         )
-    return stage_series(
-        series, start_ms, refs,
-        counter_corrected=mode == "corrected",
-        subtract_baseline=mode == "shifted",
-        diff_encode=mode == "diff",
-        dtype=dtype,
-    )
+
+    def _stage(sr):
+        return stage_series(
+            sr, start_ms, refs,
+            counter_corrected=mode == "corrected",
+            subtract_baseline=mode == "shifted",
+            diff_encode=mode == "diff",
+            dtype=dtype,
+        )
+
+    block = _stage(series)
+    if (
+        block.regular_ts is None and block.nominal_ts is None
+        and block.n_series > 1
+    ):
+        aligned = _slot_align(shard, part_ids, column, series, start_ms, end_ms)
+        if aligned is not None:
+            block = _stage(aligned)
+    return block

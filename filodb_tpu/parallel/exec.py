@@ -100,6 +100,16 @@ class MeshAggregateExec(ExecPlan):
         all_labels = [l for ls in labels_per_shard for l in ls]
         if not all_labels:
             return None
+        # per-shard staging estimates per-block nominal grids independently;
+        # put every near-regular block on ONE common grid so the mesh kernel
+        # can share a single window structure (no-op for exact shared grids)
+        r0 = blocks[0].regular_ts
+        all_exact = r0 is not None and all(
+            b.regular_ts is not None and len(b.regular_ts) == len(r0)
+            and not (b.regular_ts != r0).any() for b in blocks[1:]
+        )
+        if not all_exact:
+            ST.harmonize_nominal(blocks)
         gids_all, group_labels = AGG.group_ids_for(
             all_labels, list(self.by) if self.by else None,
             list(self.without) if self.without else None,
@@ -124,9 +134,21 @@ class MeshAggregateExec(ExecPlan):
         if staged is None:
             return None
         blocks, gids_per_block, group_labels = staged
-        arrays = M.stack_blocks_for_mesh(blocks, gids_per_block, self.mesh.devices.size)
-        sharded = M.shard_arrays(self.mesh, *arrays)  # pin the stack in HBM
-        result = (sharded, group_labels, blocks)
+        nb = [b for b in blocks if b.n_series > 0]
+        jittered = bool(nb) and all(b.nominal_ts is not None for b in nb)
+        arrays = M.stack_blocks_for_mesh(
+            blocks, gids_per_block, self.mesh.devices.size, with_dev=jittered
+        )
+        sharded = M.shard_arrays(self.mesh, *arrays[:6])  # pin the stack in HBM
+        dev_sh = None
+        if jittered:
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            dev_sh = jax.device_put(
+                arrays[6], NamedSharding(self.mesh, P("shard", None))
+            )
+        result = (sharded, group_labels, blocks, dev_sh)
         if len(cache) >= 8:
             cache.pop(next(iter(cache)))
         cache[key] = result
@@ -136,11 +158,12 @@ class MeshAggregateExec(ExecPlan):
         staged = self._stage_all(ctx)
         if staged is None:
             return QueryResult()
-        sharded, group_labels, blocks = staged
+        sharded, group_labels, blocks, dev_sh = staged
         num_steps = int((self.end_ms - self.start_ms) // self.step_ms) + 1
         j_pad = K.pad_steps(num_steps)
         base = blocks[0].base_ms
-        out = self._run_mxu(blocks, sharded, j_pad, base, len(group_labels))
+        out = self._run_mxu(blocks, sharded, j_pad, base, len(group_labels),
+                            dev_sh=dev_sh)
         if out is None:
             out = M.distributed_agg_range(
                 self.mesh, self.function, self.op, *sharded,
@@ -159,17 +182,19 @@ class MeshAggregateExec(ExecPlan):
         "z_score", "rate", "increase", "delta", "idelta", "irate",
     }
 
-    def _run_mxu(self, blocks, arrays, j_pad, base, num_groups):
+    def _run_mxu(self, blocks, arrays, j_pad, base, num_groups, dev_sh=None):
         """Shared-scrape-grid fast path: MXU matmul kernel inside shard_map
-        (single compiled call even when many shards pack one device)."""
+        (single compiled call even when many shards pack one device). Falls
+        through to the jittered-grid MXU path when the grids are only
+        NEAR-regular (ops/mxu_jitter.py)."""
         if self.function not in self._MXU_MESH_FUNCS:
             return None
         r0 = blocks[0].regular_ts
-        if r0 is None:
-            return None
-        for b in blocks[1:]:
-            if b.regular_ts is None or len(b.regular_ts) != len(r0) or (b.regular_ts != r0).any():
-                return None
+        if r0 is None or any(
+            b.regular_ts is None or len(b.regular_ts) != len(r0)
+            or (b.regular_ts != r0).any() for b in blocks[1:]
+        ):
+            return self._run_jitter(blocks, arrays, j_pad, base, num_groups, dev_sh)
         from ..ops.mxu_kernels import WindowMatrices
 
         ts, vals, lens, baseline, raw, gids = arrays
@@ -194,6 +219,54 @@ class MeshAggregateExec(ExecPlan):
             vals, raw, lens, baseline, gids,
             wm.dW, wm.dF, wm.dL, wm.dL2,
             wm.d_count, wm.d_tf, wm.d_tl, wm.d_tl2, wm.d_out_t,
+            np.float32(self.window_ms), num_groups,
+            is_counter=self.is_counter, is_delta=self.is_delta,
+        )
+
+    def _run_jitter(self, blocks, arrays, j_pad, base, num_groups, dev_sh):
+        """Near-regular grids: one shared certain/uncertain window structure
+        (built on the harmonized common nominal grid) + the jitter kernel
+        inside shard_map."""
+        if dev_sh is None:
+            return None
+        if self.is_delta and self.function in ("irate", "idelta"):
+            return None
+        nb = [b for b in blocks if b.n_series > 0]
+        if not nb or any(b.nominal_ts is None for b in nb):
+            return None
+        from ..ops.mxu_jitter import JitterWindowMatrices
+        from ..ops.staging import TS_PAD
+
+        ts, vals, lens, baseline, raw, gids = arrays
+        b0 = nb[0]
+        n_valid = int(np.asarray(b0.lens)[0])
+        T_stack = vals.shape[1]
+        nominal = np.full(T_stack, TS_PAD, dtype=np.int32)
+        nominal[:n_valid] = np.asarray(b0.nominal_ts)[:n_valid]
+        wm_key = (
+            "jit", nominal.tobytes(), n_valid, b0.maxdev_ms,
+            self.start_ms - base, self.step_ms, j_pad, self.window_ms,
+        )
+        with _WM_LOCK:
+            wm = _WM_CACHE.get(wm_key)
+        if wm is None:
+            wm = JitterWindowMatrices(
+                nominal, n_valid, b0.maxdev_ms,
+                self.start_ms - base, self.step_ms, j_pad, self.window_ms,
+            )
+            with _WM_LOCK:
+                while len(_WM_CACHE) >= 16:
+                    _WM_CACHE.pop(next(iter(_WM_CACHE)), None)
+                _WM_CACHE[wm_key] = wm
+        if not wm.ok:
+            return None
+        return M.distributed_agg_range_jitter(
+            self.mesh, self.function, self.op,
+            vals, raw, dev_sh, lens, gids,
+            wm.dCM, wm.d_count0, wm.d_c0pos, wm.d_c0ge2,
+            wm.d_has_klo, wm.d_has_khi,
+            wm.d_F0_rel, wm.d_L0_rel, wm.d_L2_rel, wm.d_Klo_rel, wm.d_Khi_rel,
+            wm.d_blo_rel, wm.d_ehi_rel,
             np.float32(self.window_ms), num_groups,
             is_counter=self.is_counter, is_delta=self.is_delta,
         )
@@ -308,7 +381,7 @@ class MeshQuantileExec(MeshAggregateExec):
         staged = self._stage_all(ctx)
         if staged is None:
             return QueryResult()
-        sharded, group_labels, blocks = staged
+        sharded, group_labels, blocks, _dev_sh = staged
         num_steps = int((self.end_ms - self.start_ms) // self.step_ms) + 1
         j_pad = K.pad_steps(num_steps)
         base = blocks[0].base_ms

@@ -106,6 +106,51 @@ def distributed_agg_range_mxu(
 
 @functools.partial(
     jax.jit,
+    static_argnames=("mesh", "func", "op", "num_groups", "is_counter", "is_delta"),
+)
+def distributed_agg_range_jitter(
+    mesh: Mesh,
+    func: str,
+    op: str,
+    vals, raw, dev,  # [D*S, T] sharded
+    lens, gids,  # [D*S]
+    CM,  # [T, 6J] replicated certain/uncertain one-hot stack (mxu_jitter)
+    count0, c0pos, c0ge2, has_klo, has_khi,  # [J] replicated
+    F0_rel, L0_rel, L2_rel, Klo_rel, Khi_rel, blo_rel, ehi_rel,  # [J]
+    window_ms,
+    num_groups: int,
+    is_counter: bool = False,
+    is_delta: bool = False,
+):
+    """Near-regular (jittered) grid mesh aggregation: the certain-membership
+    matmul + per-series boundary-correction kernel (ops/mxu_jitter.py) inside
+    shard_map, so jittered real-world scrape data keeps the single-program
+    multi-shard MXU path."""
+    from ..ops.mxu_jitter import jitter_range_kernel
+
+    def local(vals_l, raw_l, dev_l, lens_l, gids_l):
+        grid = jitter_range_kernel(
+            func, vals_l, dev_l, raw_l, CM,
+            count0, c0pos, c0ge2, has_klo, has_khi,
+            F0_rel, L0_rel, L2_rel, Klo_rel, Khi_rel, blo_rel, ehi_rel,
+            window_ms, is_counter=is_counter, is_delta=is_delta,
+        )
+        grid = jnp.where((lens_l > 0)[:, None], grid, jnp.nan)
+        return _segment_psum(op, grid, gids_l, num_groups)
+
+    shard = P("shard")
+    row = P("shard", None)
+    return jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(row, row, row, shard, shard),
+        out_specs=P(),
+        check_vma=False,
+    )(vals, raw, dev, lens, gids)
+
+
+@functools.partial(
+    jax.jit,
     static_argnames=("mesh", "func", "op", "num_steps", "num_groups", "is_counter", "is_delta"),
 )
 def distributed_agg_range(
@@ -151,12 +196,15 @@ def distributed_agg_range(
     )(ts, vals, lens, baseline, raw, gids)
 
 
-def stack_blocks_for_mesh(blocks: list[StagedBlock], gids_per_block: list[np.ndarray], n_devices: int):
+def stack_blocks_for_mesh(blocks: list[StagedBlock], gids_per_block: list[np.ndarray], n_devices: int,
+                          with_dev: bool = False):
     """Concatenate per-shard staged blocks into mesh-shardable arrays.
 
     Blocks distribute round-robin over devices (several shards may share a
     device — the single-chip case packs ALL shards into one block). Padded
-    rows get group id 0 with len 0 (they contribute nothing)."""
+    rows get group id 0 with len 0 (they contribute nothing).
+    With ``with_dev``, also returns the stacked [D*S, T] timestamp-deviation
+    matrix for the jittered-grid mesh path (zeros where a block has none)."""
     D = n_devices
     T = max(b.ts.shape[1] for b in blocks)
     per_dev: list[list[int]] = [[] for _ in range(D)]
@@ -171,6 +219,7 @@ def stack_blocks_for_mesh(blocks: list[StagedBlock], gids_per_block: list[np.nda
     lens = np.zeros(D * S_dev, dtype=np.int32)
     baseline = np.zeros(D * S_dev, dtype=np.float32)
     gids = np.zeros(D * S_dev, dtype=np.int32)
+    dev = np.zeros((D * S_dev, T), dtype=np.float32) if with_dev else None
     for d, idxs in enumerate(per_dev):
         o = d * S_dev
         for i in idxs:
@@ -184,7 +233,11 @@ def stack_blocks_for_mesh(blocks: list[StagedBlock], gids_per_block: list[np.nda
             lens[o : o + k] = np.asarray(b.lens)[:k]
             baseline[o : o + k] = np.asarray(b.baseline)[:k]
             gids[o : o + k] = g
+            if with_dev and b.ts_dev is not None:
+                dev[o : o + k, :t] = np.asarray(b.ts_dev)[:k]
             o += k
+    if with_dev:
+        return ts, vals, lens, baseline, raw, gids, dev
     return ts, vals, lens, baseline, raw, gids
 
 

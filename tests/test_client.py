@@ -146,3 +146,24 @@ def test_grpc_scalar_query(request):
     ts, series = c.query_range("3*2", (BASE + 60_000) / 1000, (BASE + 180_000) / 1000, 60)
     assert len(series) == 1
     np.testing.assert_allclose(series[0]["values"], 6.0)
+
+
+def test_grpc_grid_alignment_offset_and_short(monkeypatch):
+    """Advisor regression: the gRPC branch must align returned grids onto the
+    client grid by timestamp (like the HTTP branch), not assume each grid
+    exactly matches the requested (start, step, n)."""
+    from filodb_tpu.query.rangevector import Grid, QueryResult
+
+    start_s, end_s, step_s = 100.0, 100.0 + 5 * 60, 60.0
+    # grid starts one step late and carries only 3 of the 6 requested steps
+    g = Grid(labels=[{"job": "x"}], start_ms=160_000, step_ms=60_000,
+             num_steps=3, values=np.array([[1.0, 2.0, 3.0]]))
+    c = FiloClient("http://unused:1", grpc_endpoint="grpc://unused:2")
+    monkeypatch.setattr(FiloClient, "_grpc_exec",
+                        lambda self, *a, **k: QueryResult(grids=[g]))
+    ts, series = c.query_range("m", start_s, end_s, step_s)
+    assert len(series) == 1
+    row = series[0]["values"]
+    assert len(row) == 6
+    assert np.isnan(row[0]) and np.isnan(row[4]) and np.isnan(row[5])
+    np.testing.assert_array_equal(row[1:4], [1.0, 2.0, 3.0])

@@ -357,3 +357,18 @@ class TestTwoServerGrpcScatter:
         finally:
             a.stop()
             b.stop()
+
+
+def test_plan_remote_env_token_fallback(monkeypatch):
+    """Advisor regression: GrpcPlanRemoteExec must fall back to
+    FILODB_REMOTE_TOKEN like PromQlRemoteExec, so token-protected gRPC
+    federation authenticates without explicit plumbing."""
+    from filodb_tpu.api.grpc_exec import GrpcPlanRemoteExec
+
+    monkeypatch.setenv("FILODB_REMOTE_TOKEN", "env-tok")
+    p = GrpcPlanRemoteExec("grpc://h:1", logical_plan=None)
+    assert p.auth_token == "env-tok"
+    p2 = GrpcPlanRemoteExec("grpc://h:1", logical_plan=None, auth_token="explicit")
+    assert p2.auth_token == "explicit"
+    monkeypatch.delenv("FILODB_REMOTE_TOKEN")
+    assert GrpcPlanRemoteExec("grpc://h:1", logical_plan=None).auth_token is None

@@ -1,0 +1,195 @@
+"""Jittered-grid MXU path (ops/mxu_jitter.py) vs the general kernel path on
+the same data — the fast path must be semantically indistinguishable for
+arbitrary per-sample timestamp jitter within the staging bound (the window
+semantics contract: reference PeriodicSamplesMapper.scala:256)."""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.ops import kernels as K
+from filodb_tpu.ops.mxu_jitter import JITTER_FUNCS
+from filodb_tpu.ops.staging import stage_series
+
+BASE = 1_600_000_000_000
+INTERVAL = 10_000
+
+
+def jittered_series(n_series=6, n=300, seed=0, counter=False, jitter=0.05):
+    """Nominal 10s grid with per-sample uniform jitter of +/- jitter*interval."""
+    rng = np.random.default_rng(seed)
+    nominal = BASE + (1 + np.arange(n, dtype=np.int64)) * INTERVAL
+    out = []
+    for i in range(n_series):
+        dev = rng.uniform(-jitter, jitter, n) * INTERVAL
+        ts = nominal + np.rint(dev).astype(np.int64)
+        if counter:
+            vals = np.cumsum(rng.uniform(0, 10, n)) + 1e9
+            k = n // 2 + i
+            vals[k:] -= vals[k] - rng.uniform(0, 5)  # a reset per series
+        else:
+            vals = 50 + 20 * rng.standard_normal(n)
+        out.append((ts, vals))
+    return out
+
+
+def run_path(func, series, counter, force_general, window_ms=300_000,
+             diff=False):
+    block = stage_series(
+        series, BASE, counter_corrected=counter and not diff, diff_encode=diff
+    )
+    assert block.nominal_ts is not None, "staging must detect the jittered grid"
+    assert block.regular_ts is None
+    if force_general:
+        block.nominal_ts = None
+    params = K.RangeParams(BASE + 400_000, 60_000, 20, window_ms)
+    return np.asarray(
+        K.run_range_function(
+            func, block, params, is_counter=counter or diff
+        )
+    )[: len(series), :20]
+
+
+GAUGE_FUNCS = sorted(JITTER_FUNCS - {"rate", "increase", "irate"})
+COUNTER_FUNCS = ["rate", "increase", "irate"]
+
+
+@pytest.mark.parametrize("jitter", [0.01, 0.05, 0.2, 0.3])
+@pytest.mark.parametrize("func", GAUGE_FUNCS)
+def test_jitter_matches_general_gauge(func, jitter):
+    series = jittered_series(seed=3, jitter=jitter)
+    fast = run_path(func, series, False, False)
+    slow = run_path(func, series, False, True)
+    np.testing.assert_array_equal(np.isnan(fast), np.isnan(slow), err_msg=func)
+    m = ~np.isnan(slow)
+    np.testing.assert_allclose(fast[m], slow[m], rtol=2e-4, atol=1e-3, err_msg=func)
+
+
+@pytest.mark.parametrize("jitter", [0.01, 0.05, 0.2, 0.3])
+@pytest.mark.parametrize("func", COUNTER_FUNCS)
+def test_jitter_matches_general_counter(func, jitter):
+    series = jittered_series(seed=4, counter=True, jitter=jitter)
+    fast = run_path(func, series, True, False)
+    slow = run_path(func, series, True, True)
+    np.testing.assert_array_equal(np.isnan(fast), np.isnan(slow), err_msg=func)
+    m = ~np.isnan(slow)
+    np.testing.assert_allclose(fast[m], slow[m], rtol=1e-3, atol=1e-3, err_msg=func)
+
+
+def test_counter_idelta_diff_encoded():
+    series = jittered_series(seed=5, counter=True)
+    fast = run_path("idelta", series, True, False, diff=True)
+    slow = run_path("idelta", series, True, True, diff=True)
+    np.testing.assert_array_equal(np.isnan(fast), np.isnan(slow))
+    m = ~np.isnan(slow)
+    np.testing.assert_allclose(fast[m], slow[m], rtol=1e-3, atol=1e-3)
+
+
+def test_boundary_membership_is_exact():
+    """Samples sitting exactly ON a window boundary: (lo, hi] semantics must
+    survive the certain/uncertain decomposition bit-for-bit."""
+    n = 60
+    nominal = BASE + (1 + np.arange(n, dtype=np.int64)) * INTERVAL
+    # series 0: every 6th sample jittered late to land exactly on a step
+    # boundary (in: ts <= out_t); series 1 jittered just past it (out)
+    steps = BASE + 400_000 + np.arange(5, dtype=np.int64) * 60_000
+    ts0, ts1 = nominal.copy(), nominal.copy()
+    for st in steps:
+        k = int(np.argmin(np.abs(nominal - st)))
+        ts0[k] = st          # exactly on the upper boundary -> in window
+        ts1[k] = st + 1      # one ms past -> out of this window
+    rng = np.random.default_rng(0)
+    series = [(ts0, rng.standard_normal(n)), (ts1, rng.standard_normal(n))]
+    fast = run_path("count_over_time", series, False, False)
+    slow = run_path("count_over_time", series, False, True)
+    np.testing.assert_array_equal(fast, slow)
+
+
+def test_tiny_window_falls_back():
+    """window <= 2*maxdev can't isolate one uncertain slot per boundary;
+    the dispatcher must transparently use the general path."""
+    series = jittered_series(seed=6, jitter=0.3)
+    # maxdev ~3000ms -> window 4000ms < 2*maxdev
+    fast = run_path("sum_over_time", series, False, False, window_ms=4_000)
+    slow = run_path("sum_over_time", series, False, True, window_ms=4_000)
+    np.testing.assert_array_equal(np.isnan(fast), np.isnan(slow))
+    m = ~np.isnan(slow)
+    np.testing.assert_allclose(fast[m], slow[m], rtol=2e-4, atol=1e-3)
+
+
+def test_staging_detection_bounds():
+    """Jitter below half-interval -> nominal grid detected; above -> not."""
+    rng = np.random.default_rng(1)
+    n = 100
+    nominal = BASE + np.arange(n, dtype=np.int64) * INTERVAL
+
+    def mk(jfrac):
+        out = []
+        for _ in range(4):
+            dev = rng.uniform(-jfrac, jfrac, n) * INTERVAL
+            out.append((nominal + np.rint(dev).astype(np.int64),
+                        rng.standard_normal(n)))
+        return stage_series(out, BASE)
+
+    ok = mk(0.2)
+    assert ok.nominal_ts is not None and ok.ts_dev is not None
+    assert ok.maxdev_ms * 2 < INTERVAL
+    too_much = mk(0.9)  # adjacent samples can cross -> no safe nominal grid
+    assert too_much.nominal_ts is None
+
+
+def test_exact_grid_still_uses_exact_path():
+    ts = BASE + (1 + np.arange(100, dtype=np.int64)) * INTERVAL
+    rng = np.random.default_rng(2)
+    series = [(ts.copy(), rng.standard_normal(100)) for _ in range(3)]
+    block = stage_series(series, BASE)
+    assert block.regular_ts is not None
+    assert block.nominal_ts is None
+
+
+def test_engine_e2e_jittered_mesh_matches_no_mesh():
+    """Full path: jittered ingest -> PromQL sum(rate) through QueryEngine
+    with a device mesh (jitter MXU mesh kernel) vs the engine without a mesh
+    (per-block dispatch) — results must agree."""
+    import jax
+
+    from filodb_tpu.core.records import SeriesBatch
+    from filodb_tpu.core.schemas import Dataset, METRIC_TAG, PROM_COUNTER, shard_for
+    from filodb_tpu.coordinator.planner import PlannerParams, QueryEngine
+    from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+    from filodb_tpu.parallel.mesh import make_mesh
+
+    rng = np.random.default_rng(11)
+    n = 240
+    nominal = BASE + np.arange(n, dtype=np.int64) * INTERVAL
+
+    def build():
+        ms = TimeSeriesMemStore()
+        ms.setup(Dataset("prometheus"), range(4))
+        for i in range(40):
+            dev = np.rint(rng.uniform(-0.05, 0.05, n) * INTERVAL).astype(np.int64)
+            vals = np.cumsum(rng.uniform(0, 10, n)) + 1e9
+            tags = {METRIC_TAG: "rq_total", "_ws_": "w", "_ns_": "n",
+                    "inst": f"h{i}"}
+            shard = shard_for(tags, spread=2, num_shards=4)
+            ms.shard("prometheus", shard).ingest_series(
+                SeriesBatch(PROM_COUNTER, tags, nominal + dev, {"count": vals})
+            )
+        return ms
+
+    rng = np.random.default_rng(11)
+    ms1 = build()
+    rng = np.random.default_rng(11)
+    ms2 = build()
+    start_s = (BASE + 400_000) / 1000
+    end_s = (BASE + 2_000_000) / 1000
+    q = "sum(rate(rq_total[5m]))"
+    e_mesh = QueryEngine(ms1, "prometheus",
+                         PlannerParams(mesh=make_mesh(jax.devices()[:1])))
+    e_plain = QueryEngine(ms2, "prometheus")
+    r1 = e_mesh.query_range(q, start_s, end_s, 60.0)
+    r2 = e_plain.query_range(q, start_s, end_s, 60.0)
+    v1 = r1.grids[0].values_np()[0]
+    v2 = r2.grids[0].values_np()[0]
+    np.testing.assert_array_equal(np.isnan(v1), np.isnan(v2))
+    m = ~np.isnan(v2)
+    np.testing.assert_allclose(v1[m], v2[m], rtol=1e-3)

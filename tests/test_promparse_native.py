@@ -163,6 +163,11 @@ class TestReviewDivergences:
         "m 1\u00a0",                   # Unicode trailing whitespace
         "m 1\u2028n 2",                # U+2028 separator -> python path wholesale
         "m infinity",                   # strtod-only spelling... float() accepts too
+        "m1 5\n # HELP m1 x",     # NBSP-prefixed comment: python skips it
+        "m1 5\n ",                # NBSP-only line: python skips it
+        "m3 nan()",                     # C99 nan(): strtod accepts, float() rejects
+        "m3 nan(abc)",                  # C99 nan(chars): same
+        "m3 (1)",                       # parens alone: both reject
     ]
 
     @pytest.mark.parametrize("case", CASES)

@@ -1,0 +1,148 @@
+"""Native JSON sample renderer (promrender.cpp) vs the Python renderer, and
+the chunked-streaming serving edge (reference PrometheusModel.scala render +
+executeStreaming ExecPlan.scala:146)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from filodb_tpu import native as N
+from filodb_tpu.api import promjson as J
+from filodb_tpu.query.rangevector import Grid, QueryResult
+
+BASE = 1_600_000_000_000
+
+needs_native = pytest.mark.skipif(
+    N.render_lib() is None, reason="native render lib unavailable"
+)
+
+
+def _parse_frag(frag: bytes):
+    return [(t, float(v)) for t, v in json.loads(frag)]
+
+
+class TestNativeRenderParity:
+    CASES = [
+        np.array([1.5, np.nan, -np.inf, np.inf, 0.0, -0.0]),
+        np.array([1e-300, 1e300, 1e-05, 123456789.123456789, -2.5e-10]),
+        np.array([np.nan, np.nan]),
+        np.array([], dtype=np.float64),
+        np.random.default_rng(0).standard_normal(500) * 1e6,
+    ]
+
+    @needs_native
+    @pytest.mark.parametrize("idx", range(len(CASES)))
+    @pytest.mark.parametrize("dtype", [np.float32, np.float64])
+    def test_native_matches_python(self, idx, dtype):
+        vals = self.CASES[idx].astype(dtype)
+        ts = (BASE + np.arange(len(vals), dtype=np.int64) * 10_000) / 1e3
+        native = N.render_values(ts, vals)
+        assert native is not None
+        # python reference fragment
+        keep = ~np.isnan(vals)
+        want = [
+            [float(t), J._fmt(v)] for t, v in zip(ts[keep], vals[keep])
+        ]
+        got = json.loads(native)
+        assert len(got) == len(want)
+        for (gt, gv), (wt, wv) in zip(got, want):
+            assert gt == wt
+            if wv == "NaN":
+                assert gv == "NaN"
+            elif wv in ("+Inf", "-Inf"):
+                assert gv == wv
+            else:
+                # shortest-roundtrip forms may differ textually ("2" vs
+                # "2.0") but must parse to the identical double
+                assert float(gv) == float(wv)
+
+    @needs_native
+    def test_f32_widens_like_python(self):
+        # float(np.float32(0.1)) == 0.10000000149011612: the native cast
+        # must produce a string parsing to exactly that double
+        vals = np.array([0.1], dtype=np.float32)
+        ts = np.array([1600000000.0])
+        frag = json.loads(N.render_values(ts, vals))
+        assert float(frag[0][1]) == float(np.float32(0.1))
+
+
+def _result(n_series=30, n_steps=40, with_raw=False):
+    rng = np.random.default_rng(1)
+    vals = rng.standard_normal((n_series, n_steps)).astype(np.float32)
+    vals[0, :] = np.nan  # all-NaN series must be dropped like render_matrix
+    vals[1, ::3] = np.nan
+    g = Grid([{"_metric_": "m", "i": str(i)} for i in range(n_series)],
+             BASE, 60_000, n_steps, vals)
+    res = QueryResult(grids=[g])
+    if with_raw:
+        ts = BASE + np.arange(17, dtype=np.int64) * 10_000
+        res.raw = [({"_metric_": "raw0"}, ts, rng.standard_normal(17))]
+    return res
+
+
+class TestStreamMatrix:
+    @pytest.mark.parametrize("with_raw", [False, True])
+    def test_stream_equals_dict_render(self, with_raw):
+        res = _result(with_raw=with_raw)
+        stats = {"seriesScanned": 3}
+        body = b"".join(J.stream_matrix(res, stats))
+        got = json.loads(body)
+        want_data = J.render_matrix(res)
+        assert got["status"] == "success"
+        assert got["data"]["resultType"] == "matrix"
+        assert got["data"]["stats"] == stats
+        got_rows = got["data"]["result"]
+        want_rows = want_data["result"]
+        assert len(got_rows) == len(want_rows)
+        for gr, wr in zip(got_rows, want_rows):
+            assert gr["metric"] == wr["metric"]
+            assert len(gr["values"]) == len(wr["values"])
+            for (gt, gv), (wt, wv) in zip(gr["values"], wr["values"]):
+                assert float(gt) == float(wt)
+                if wv in ("NaN", "+Inf", "-Inf"):
+                    assert gv == wv
+                else:
+                    assert float(gv) == float(wv)
+
+    def test_small_chunk_target_yields_many_chunks(self):
+        res = _result(n_series=50)
+        chunks = list(J.stream_matrix(res, None, chunk_target=1024))
+        assert len(chunks) > 3
+        json.loads(b"".join(chunks))  # still one valid document
+
+
+class TestHttpStreaming:
+    def test_query_range_streams_chunked_above_threshold(self, monkeypatch):
+        import urllib.request
+
+        from filodb_tpu.api.http import PromApiHandler, serve_background
+        from filodb_tpu.coordinator.planner import QueryEngine
+        from filodb_tpu.core.schemas import Dataset
+        from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+        from filodb_tpu.testkit import counter_batch
+
+        ms = TimeSeriesMemStore()
+        ms.setup(Dataset("prometheus"), range(2))
+        ms.ingest_routed(
+            "prometheus",
+            counter_batch(n_series=20, n_samples=120, start_ms=BASE),
+            spread=1,
+        )
+        engine = QueryEngine(ms, "prometheus")
+        monkeypatch.setattr(PromApiHandler, "STREAM_MIN_SAMPLES", 100)
+        srv, port = serve_background(engine)
+        try:
+            url = (
+                f"http://127.0.0.1:{port}/api/v1/query_range?"
+                f"query=http_requests_total&start={(BASE + 400_000) / 1000}"
+                f"&end={(BASE + 1_000_000) / 1000}&step=60"
+            )
+            with urllib.request.urlopen(url) as resp:
+                assert resp.headers.get("Transfer-Encoding") == "chunked"
+                doc = json.loads(resp.read())
+            assert doc["status"] == "success"
+            assert len(doc["data"]["result"]) == 20
+            assert doc["data"]["stats"]["seriesScanned"] == 20
+        finally:
+            srv.shutdown()
