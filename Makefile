@@ -2,7 +2,7 @@
 
 NATIVE_DIR := filodb_tpu/native
 
-.PHONY: all native test bench microbench serve clean
+.PHONY: all native test bench microbench serve clean tpu-watch tpu-watch-bg
 
 all: native
 
@@ -31,6 +31,14 @@ microbench: native
 
 serve:
 	python -m filodb_tpu.cli serve --config conf/timeseries-dev.json
+
+# probe the TPU tunnel all session; harvest + commit an attested bench number
+# the moment a healthy window appears (tools/tpu_watch.py)
+tpu-watch: native
+	python tools/tpu_watch.py
+
+tpu-watch-bg: native
+	nohup python tools/tpu_watch.py >> tpu_watch_stdout.txt 2>&1 & echo "tpu-watch pid $$!"
 
 clean:
 	rm -f $(NATIVE_DIR)/*.so

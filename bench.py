@@ -32,7 +32,9 @@ STEP_S = 60.0
 START_S = (BASE + 400_000) / 1000
 END_S = (BASE + N_SAMPLES * INTERVAL_MS - 200_000) / 1000
 N_SHARDS = 8
-TIMED_RUNS = 15
+# the watchdog (tools/tpu_watch.py) shrinks this in quick mode to minimize
+# tunnel exposure while a healthy window lasts
+TIMED_RUNS = int(os.environ.get("FILODB_BENCH_RUNS", 15))
 
 
 def build_memstore():
@@ -104,9 +106,21 @@ def cpu_baseline(ms, ts):
         corr = np.concatenate([np.zeros((vals.shape[0], 1)), np.cumsum(drops, axis=1)], axis=1)
         cv = vals + corr
         if shared:
+            # one 1-D searchsorted + column fancy-indexing for ALL series:
+            # the strongest CPU form of the shared-grid workload (r02 form —
+            # benchmark-integrity contract, VERDICT r3 weak #3: the baseline
+            # must not silently pay the per-row gather cost here)
             t0 = tmat[0]
-            hi = np.searchsorted(t0, out_t, side="right")[None, :].repeat(S, 0)
-            lo = np.searchsorted(t0, out_t - WINDOW_MS, side="right")[None, :].repeat(S, 0)
+            hi1 = np.searchsorted(t0, out_t, side="right")
+            lo1 = np.searchsorted(t0, out_t - WINDOW_MS, side="right")
+            cnt = (hi1 - lo1)[None, :]
+            lo_c = np.minimum(lo1, T - 1)
+            hi_c = np.minimum(hi1 - 1, T - 1)
+            tf = (t0[lo_c].astype(np.float64) / 1e3)[None, :]
+            tl = (t0[hi_c].astype(np.float64) / 1e3)[None, :]
+            vf = cv[:, lo_c]
+            vl = cv[:, hi_c]
+            raw_f = vals[:, lo_c]
         else:
             stride = np.int64(1) << 42
             row_off = (np.arange(S, dtype=np.int64) * stride)[:, None]
@@ -115,12 +129,12 @@ def cpu_baseline(ms, ts):
             lo = np.searchsorted(flat, ((out_t - WINDOW_MS)[None, :] + row_off).ravel(), side="right")
             hi = hi.reshape(S, -1) - np.arange(S)[:, None] * T
             lo = lo.reshape(S, -1) - np.arange(S)[:, None] * T
-        cnt = hi - lo
-        tf = np.take_along_axis(tmat, np.minimum(lo, T - 1), 1).astype(np.float64) / 1e3
-        tl = np.take_along_axis(tmat, np.minimum(hi - 1, T - 1), 1).astype(np.float64) / 1e3
-        vf = np.take_along_axis(cv, np.minimum(lo, T - 1), 1)
-        vl = np.take_along_axis(cv, np.minimum(hi - 1, T - 1), 1)
-        raw_f = np.take_along_axis(vals, np.minimum(lo, T - 1), 1)
+            cnt = hi - lo
+            tf = np.take_along_axis(tmat, np.minimum(lo, T - 1), 1).astype(np.float64) / 1e3
+            tl = np.take_along_axis(tmat, np.minimum(hi - 1, T - 1), 1).astype(np.float64) / 1e3
+            vf = np.take_along_axis(cv, np.minimum(lo, T - 1), 1)
+            vl = np.take_along_axis(cv, np.minimum(hi - 1, T - 1), 1)
+            raw_f = np.take_along_axis(vals, np.minimum(lo, T - 1), 1)
         dlt = vl - vf
         sampled = tl - tf
         dur_start = tf - (out_t / 1e3 - WINDOW_MS / 1e3)[None, :]

@@ -70,10 +70,20 @@ def render_scalar(res: QueryResult, time_s: float) -> dict:
     return {"resultType": "scalar", "result": [time_s, _fmt(v)]}
 
 
+def _ts3(t: float) -> str:
+    """Fixed 3-decimal seconds (Prometheus' millisecond convention),
+    byte-identical to the native renderer's llround-based form for the
+    non-negative timestamps Prometheus uses."""
+    ms = int(math.floor(t * 1000.0 + 0.5))
+    return f"{ms // 1000}.{ms % 1000:03d}"
+
+
 def _values_fragment(ts_s: np.ndarray, vals: np.ndarray) -> bytes:
     """[[t,"v"],...] fragment for one series; native renderer when built
-    (promrender.cpp, ~100x the Python loop), Python fallback otherwise.
-    Both skip NaN samples and render specials as NaN/+Inf/-Inf."""
+    (promrender.cpp), Python fallback otherwise. Both skip NaN samples,
+    render timestamps as fixed 3-decimal seconds, and render specials as
+    NaN/+Inf/-Inf — the two paths emit identical bytes for finite values
+    whose shortest repr agrees between std::to_chars and Python repr."""
     from .. import native as N
 
     frag = N.render_values(ts_s, vals)
@@ -81,7 +91,7 @@ def _values_fragment(ts_s: np.ndarray, vals: np.ndarray) -> bytes:
         return frag
     keep = ~np.isnan(vals)
     parts = (
-        f'[{json.dumps(float(t))},"{_fmt(v)}"]'
+        f'[{_ts3(float(t))},"{_fmt(v)}"]'
         for t, v in zip(ts_s[keep], vals[keep])
     )
     return ("[" + ",".join(parts) + "]").encode()
@@ -115,6 +125,16 @@ def stream_matrix(res: QueryResult, stats: dict | None = None,
 
     if res.raw is not None:
         for labels, ts, vals in res.raw:
+            if vals.ndim != 1:
+                # 2-D (histogram-column) raw values would be read as a flat
+                # f64 buffer by the native renderer — silently wrong bytes.
+                # Callers must route such results to render_matrix (http.py
+                # checks before choosing the streaming path).
+                raise ValueError(
+                    "stream_matrix: raw values must be 1-D (got "
+                    f"ndim={vals.ndim}); histogram raw export is not "
+                    "streamable"
+                )
             piece = emit(labels, ts.astype(np.float64) / 1e3, vals, True)
             if piece:
                 buf += piece

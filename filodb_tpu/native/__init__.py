@@ -243,6 +243,7 @@ _RENDER_SO = os.path.join(_HERE, "libfilodbrender.so")
 _RENDER_SRC = os.path.join(_HERE, "promrender.cpp")
 _render_lib = None
 _render_tried = False
+_render_scratch = threading.local()
 
 
 def render_lib():
@@ -276,7 +277,7 @@ def render_lib():
             fn = getattr(L, name)
             fn.restype = ctypes.c_long
             fn.argtypes = [ctypes.POINTER(ctypes.c_double), vt,
-                           ctypes.c_long, ctypes.c_char_p, ctypes.c_long]
+                           ctypes.c_long, ctypes.c_void_p, ctypes.c_long]
         _render_lib = L
         return _render_lib
 
@@ -290,17 +291,26 @@ def render_values(ts_s: np.ndarray, vals: np.ndarray):
     ts = np.ascontiguousarray(ts_s, dtype=np.float64)
     n = len(ts)
     cap = 64 * n + 16
-    out = ctypes.create_string_buffer(cap)
+    # thread-local reusable scratch + a copy of only the written bytes: the
+    # previous create_string_buffer + .raw[:nw] zero-filled AND copied the
+    # full 64*n capacity every call (and freshly-mapped pages fault during
+    # the render), capping large renders at ~2-3 Msamples/s by memory traffic
+    out = getattr(_render_scratch, "buf", None)
+    if out is None or len(out) < cap:
+        out = np.empty(max(cap, 1 << 20), dtype=np.uint8)
+        _render_scratch.buf = out
     if vals.dtype == np.float32:
         v = np.ascontiguousarray(vals, dtype=np.float32)
         nw = L.fdb_render_values_f32(
             ts.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
-            v.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), n, out, cap)
+            v.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), n,
+            out.ctypes.data, cap)
     else:
         v = np.ascontiguousarray(vals, dtype=np.float64)
         nw = L.fdb_render_values_f64(
             ts.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
-            v.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), n, out, cap)
+            v.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), n,
+            out.ctypes.data, cap)
     if nw < 0:
         return None
-    return out.raw[:nw]
+    return out[:nw].tobytes()

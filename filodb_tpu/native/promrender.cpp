@@ -2,23 +2,55 @@
 //
 // Renders one series' samples as the JSON fragment
 //     [[<t_seconds>,"<value>"],[...],...]
-// skipping NaN samples (Prometheus absence). Numbers use std::to_chars
-// shortest round-trip form; specials render as "NaN"/"+Inf"/"-Inf" exactly
-// like the Python renderer (api/promjson.py _fmt). The f32 variant widens to
-// double first — identical to Python's float(np.float32(x)).
+// skipping NaN samples (Prometheus absence). Timestamps render in fixed
+// 3-decimal seconds (Prometheus' millisecond convention, e.g.
+// 1600000000.000) — byte-identical to the Python fallback in
+// api/promjson.py. Values use std::to_chars shortest round-trip form;
+// specials render as "NaN"/"+Inf"/"-Inf". The f32 variant widens to double
+// first — identical to Python's float(np.float32(x)).
 //
 // Reference analog: prometheus/.../query/PrometheusModel.scala:256 (the JVM
-// circe render); measured 0.30 Msamples/s in pure Python, ~40+ Msamples/s
-// here.
+// circe render). Measured on this machine (benchmarks/run.py bench_render,
+// 2M random-f64 samples, warm): ~0.3 Msamples/s pure Python, >10 Msamples/s
+// through this path (see BENCH_LOCAL.json for the number of record).
 //
 // Build: g++ -O3 -march=native -std=c++17 -shared -fPIC promrender.cpp \
 //        -o libfilodbrender.so
 
 #include <charconv>
 #include <cmath>
+#include <cstdint>
 #include <cstring>
 
 namespace {
+
+// fixed 3-decimal seconds from a seconds-as-double timestamp; ~2x the
+// throughput of to_chars shortest-form and format-stable across platforms.
+// Matches the Python fallback's int(floor(t*1000+0.5)) exactly for the
+// non-negative timestamps Prometheus uses (llround = round-half-away).
+inline char* render_ts(char* p, double t_sec) {
+    long long ms = llround(t_sec * 1000.0);
+    long long sec = ms / 1000;
+    long long frac = ms % 1000;
+    if (ms < 0) {  // pre-epoch: render sign, then magnitude
+        *p++ = '-';
+        sec = -sec;
+        frac = -frac;
+    }
+    char tmp[20];
+    char* q = tmp + 20;
+    do {
+        *--q = char('0' + sec % 10);
+        sec /= 10;
+    } while (sec);
+    std::memcpy(p, q, tmp + 20 - q);
+    p += tmp + 20 - q;
+    *p++ = '.';
+    *p++ = char('0' + frac / 100);
+    *p++ = char('0' + (frac / 10) % 10);
+    *p++ = char('0' + frac % 10);
+    return p;
+}
 
 long render(const double* ts, const double* vals_d, const float* vals_f,
             long n, char* out, long cap) {
@@ -34,9 +66,7 @@ long render(const double* ts, const double* vals_d, const float* vals_f,
         if (!first) *p++ = ',';
         first = false;
         *p++ = '[';
-        auto r = std::to_chars(p, e, ts[i]);
-        if (r.ec != std::errc()) return -1;
-        p = r.ptr;
+        p = render_ts(p, ts[i]);
         *p++ = ',';
         *p++ = '"';
         if (std::isinf(v)) {

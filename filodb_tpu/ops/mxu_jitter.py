@@ -100,6 +100,17 @@ class JitterWindowMatrices:
         Khi = onehot(khi, has_khi)
         # [T, 6, J] -> [T, 6J]: ONE matmul per input array fetches every piece
         self.CM = np.stack([W0, F0, L0, L2, Klo, Khi], axis=1).reshape(T, 6 * J)
+        # gather-form of the five one-hot selections (CPU backend: a take is
+        # ~100x cheaper than the stacked matmul; TPU keeps the MXU one-hots).
+        # Clipped positions yield garbage exactly where the one-hot column
+        # is all-zero — every use is gated by the c0pos/has_* masks.
+        self.idx = np.stack([
+            np.clip(clo, 0, T - 1),
+            np.clip(chi - 1, 0, T - 1),
+            np.clip(chi - 2, 0, T - 1),
+            np.clip(klo, 0, T - 1),
+            np.clip(khi, 0, T - 1),
+        ]).astype(np.int32)
 
         def rel(idx, mask):
             """nominal time of slot idx relative to each window's start b."""
@@ -137,6 +148,7 @@ class JitterWindowMatrices:
         )
         E = np.zeros((T, J * 2 * Lt), dtype=np.float32)
         edge_valid = np.zeros((J, 2 * Lt), dtype=bool)
+        edge_idx = np.zeros((J, 2 * Lt), dtype=np.int32)
         for j in range(J):
             if chi[j] <= clo[j]:
                 continue
@@ -149,8 +161,10 @@ class JitterWindowMatrices:
             for slot, pos in enumerate(np.concatenate([left, right])[: 2 * Lt]):
                 E[pos, j * 2 * Lt + slot] = 1.0
                 edge_valid[j, slot] = True
+                edge_idx[j, slot] = pos
         self.edge_onehot = E
         self.edge_valid = edge_valid
+        self.edge_idx = edge_idx
 
         put = jax.device_put
         self.dCM = put(self.CM)
@@ -169,6 +183,8 @@ class JitterWindowMatrices:
         self.d_tile_mask = put(self.tile_mask)
         self.d_edge_onehot = put(self.edge_onehot)
         self.d_edge_valid = put(self.edge_valid)
+        self.d_idx = put(self.idx)
+        self.d_edge_idx = put(self.edge_idx)
 
 
 def jitter_window_matrices(block: StagedBlock, start_off: int, step_ms: int,

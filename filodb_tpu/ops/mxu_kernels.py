@@ -94,6 +94,7 @@ class WindowMatrices:
         )  # [J, n_tiles]
         E = np.zeros((T, J * 2 * Lt), dtype=np.float32)
         edge_valid = np.zeros((J, 2 * Lt), dtype=bool)
+        edge_idx = np.zeros((J, 2 * Lt), dtype=np.int32)
         for j in range(J):
             if hi[j] <= lo[j]:
                 continue
@@ -106,8 +107,20 @@ class WindowMatrices:
             for slot, pos in enumerate(np.concatenate([left, right])[: 2 * Lt]):
                 E[pos, j * 2 * Lt + slot] = 1.0
                 edge_valid[j, slot] = True
+                edge_idx[j, slot] = pos
         self.edge_onehot = E
         self.edge_valid = edge_valid
+        # gather-form of the one-hot selections for backends where a gather
+        # beats a matmul (CPU; the TPU branch keeps the MXU one-hots):
+        # row 0 = first-sample, 1 = last, 2 = second-to-last positions.
+        # Out-of-range windows clip to valid positions; every use is gated
+        # by has/count masks, matching the one-hot's all-zero columns.
+        self.idx = np.stack([
+            np.clip(lo, 0, T - 1),
+            np.clip(hi - 1, 0, T - 1),
+            np.clip(hi - 2, 0, T - 1),
+        ]).astype(np.int32)
+        self.edge_idx = edge_idx
         # device-resident copies (transferred once, reused every query)
         import jax
 
@@ -124,6 +137,8 @@ class WindowMatrices:
         self.d_tile_mask = put(self.tile_mask)
         self.d_edge_onehot = put(self.edge_onehot)
         self.d_edge_valid = put(self.edge_valid)
+        self.d_idx = put(self.idx)
+        self.d_edge_idx = put(self.edge_idx)
 
 
 def window_matrices(block: StagedBlock, start_off: int, step_ms: int,
@@ -151,11 +166,18 @@ def mxu_range_kernel(
     count, t_first, t_last, t_last2,  # [J]
     out_t,  # [J] f64 ms
     window_ms,
+    idx=None,  # [3, J] i32 first/last/last2 positions (CPU gather form)
     is_counter: bool = False,
     is_delta: bool = False,
     arg0=0.0,
 ):
-    """Compute [S, J] results with matmuls on the MXU."""
+    """Compute [S, J] results with matmuls on the MXU.
+
+    The F/L/L2 one-hot matmuls are MXU-speed gathers on TPU; on the CPU
+    backend a real gather (jnp.take with the idx rows) is ~100x cheaper, so
+    the fetch strategy is chosen per backend at trace time. Gathered values
+    at clipped positions are garbage exactly where the one-hot column is
+    all-zero — both are discarded by the has/count gates."""
     f32 = jnp.float32
     has = count > 0
     w_s = window_ms.astype(f32) * 1e-3
@@ -163,6 +185,15 @@ def mxu_range_kernel(
 
     def mm(x, M):
         return jax.lax.dot(x, M, precision=jax.lax.Precision.HIGHEST)
+
+    if idx is not None and jax.default_backend() == "cpu":
+        gF = lambda x: jnp.take(x, idx[0], axis=1)
+        gL = lambda x: jnp.take(x, idx[1], axis=1)
+        gL2 = lambda x: jnp.take(x, idx[2], axis=1)
+    else:
+        gF = lambda x: mm(x, F)
+        gL = lambda x: mm(x, L)
+        gL2 = lambda x: mm(x, L2)
 
     if func == "sum_over_time" or (is_delta and func in ("rate", "increase")):
         s = mm(vals, W)
@@ -174,9 +205,9 @@ def mxu_range_kernel(
     if func == "avg_over_time":
         return jnp.where(has, mm(vals, W) / jnp.maximum(count, 1.0), nan)
     if func in ("last", "last_over_time"):
-        return jnp.where(has, mm(vals, L), nan)
+        return jnp.where(has, gL(vals), nan)
     if func == "first_over_time":
-        return jnp.where(has, mm(vals, F), nan)
+        return jnp.where(has, gF(vals), nan)
     if func == "present_over_time":
         return jnp.where(has, 1.0, nan)[None, :] * jnp.ones_like(vals[:, :1])
     if func == "absent_over_time":
@@ -194,11 +225,11 @@ def mxu_range_kernel(
         sd = jnp.sqrt(var)
         if func == "stddev_over_time":
             return jnp.where(has, sd, nan)
-        vl = mm(vals, L)
+        vl = gL(vals)
         return jnp.where(has, (vl - mean) / jnp.maximum(sd, 1e-30), nan)
     if func in ("rate", "increase", "delta"):
-        vf = mm(vals, F)
-        vl = mm(vals, L)
+        vf = gF(vals)
+        vl = gL(vals)
         dlt = vl - vf
         tf = t_first.astype(f32) * 1e-3
         tl = t_last.astype(f32) * 1e-3
@@ -210,7 +241,7 @@ def mxu_range_kernel(
         avg_dur = sampled / jnp.maximum(count - 1.0, 1.0)
         thresh = avg_dur * 1.1
         if is_counter and func != "delta":
-            v_first_raw = mm(raw, F)
+            v_first_raw = gF(raw)
             dur_zero = jnp.where(
                 dlt > 0, sampled[None, :] * (v_first_raw / jnp.maximum(dlt, 1e-30)), jnp.inf
             )
@@ -228,9 +259,9 @@ def mxu_range_kernel(
         ok = count >= 2
         if func == "idelta" and is_counter and not is_delta:
             # counter blocks arrive diff-encoded: last pair's diff via one-hot
-            return jnp.where(ok[None, :], mm(vals, L), nan)
-        vl = mm(vals, L)
-        vp = mm(vals, L2)
+            return jnp.where(ok[None, :], gL(vals), nan)
+        vl = gL(vals)
+        vp = gL2(vals)
         dt_s = (t_last - t_last2).astype(f32) * 1e-3
         dv = vl - vp
         r = dv / jnp.maximum(dt_s, 1e-30)[None, :] if func == "irate" else dv
@@ -247,10 +278,12 @@ def mxu_pair_count(flagged, P, has):
 
 @functools.partial(jax.jit, static_argnames=("n_valid", "is_min"))
 def mxu_minmax(vals, tile_mask, edge_onehot, edge_valid, count,
-               n_valid: int, is_min: bool = True):
+               n_valid: int, is_min: bool = True, edge_idx=None):
     """min/max_over_time on the regular grid: tile-hierarchy + edge samples
-    via selection matmul (no gathers). vals [S, T]; tile_mask [J, T/L];
-    edge_onehot [T, J*2L]; edge_valid [J, 2L]."""
+    via selection matmul (gathers are pathologically slow on the TPU
+    backend; on CPU the gather form via edge_idx is far cheaper than the
+    wide [T, J*2L] matmul). vals [S, T]; tile_mask [J, T/L];
+    edge_onehot [T, J*2L]; edge_valid [J, 2L]; edge_idx [J, 2L] i32."""
     S, T = vals.shape
     L = _TILE
     J = tile_mask.shape[0]
@@ -260,7 +293,10 @@ def mxu_minmax(vals, tile_mask, edge_onehot, edge_valid, count,
     vm = jnp.where(lane < n_valid, v, sentinel)
     tmin = vm.reshape(S, T // L, L).min(-1)  # [S, T/L]
     full = jnp.where(tile_mask[None, :, :], tmin[:, None, :], sentinel).min(-1)  # [S, J]
-    edges = jax.lax.dot(vm, edge_onehot, precision=jax.lax.Precision.HIGHEST)
+    if edge_idx is not None and jax.default_backend() == "cpu":
+        edges = jnp.take(vm, edge_idx.reshape(-1), axis=1)
+    else:
+        edges = jax.lax.dot(vm, edge_onehot, precision=jax.lax.Precision.HIGHEST)
     edges = edges.reshape(S, J, 2 * L)
     edges = jnp.where(edge_valid[None, :, :], edges, sentinel).min(-1)  # [S, J]
     r = jnp.minimum(full, edges)
@@ -310,6 +346,7 @@ def run_mxu_range_function(func, block: StagedBlock, params, is_counter=False,
             jnp.asarray(block.vals), wm.d_tile_mask, wm.d_edge_onehot,
             wm.d_edge_valid, wm.d_count,
             n_valid=int(block.lens[0]), is_min=(func == "min_over_time"),
+            edge_idx=wm.d_edge_idx,
         )
     if func in ("deriv", "predict_linear"):
         lead = np.float32(args[0]) if args else np.float32(0.0)
@@ -331,6 +368,7 @@ def run_mxu_range_function(func, block: StagedBlock, params, is_counter=False,
         wm.d_tl2,
         wm.d_out_t,
         np.float32(params.window_ms),
+        idx=wm.d_idx,
         is_counter=is_counter,
         is_delta=is_delta,
     )

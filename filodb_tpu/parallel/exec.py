@@ -234,12 +234,28 @@ class MeshAggregateExec(ExecPlan):
         nb = [b for b in blocks if b.n_series > 0]
         if not nb or any(b.nominal_ts is None for b in nb):
             return None
+        b0 = nb[0]
+        n_valid = int(np.asarray(b0.lens)[0])
+        # the kernel applies b0's window structure (nominal grid, maxdev,
+        # n_valid) to EVERY shard's rows, so it is only sound when
+        # harmonize_nominal actually succeeded. Its return value isn't
+        # recorded through the stage cache, so re-verify here: every block on
+        # the identical common grid, same maxdev, all series the same length
+        # — otherwise fall back to the general gather path.
+        nom0 = np.asarray(b0.nominal_ts)[:n_valid]
+        for b in nb:
+            lens_b = np.asarray(b.lens)[: b.n_series]
+            if (
+                b.maxdev_ms != b0.maxdev_ms
+                or not (lens_b == n_valid).all()
+                or len(np.asarray(b.nominal_ts)) < n_valid
+                or (np.asarray(b.nominal_ts)[:n_valid] != nom0).any()
+            ):
+                return None
         from ..ops.mxu_jitter import JitterWindowMatrices
         from ..ops.staging import TS_PAD
 
         ts, vals, lens, baseline, raw, gids = arrays
-        b0 = nb[0]
-        n_valid = int(np.asarray(b0.lens)[0])
         T_stack = vals.shape[1]
         nominal = np.full(T_stack, TS_PAD, dtype=np.int32)
         nominal[:n_valid] = np.asarray(b0.nominal_ts)[:n_valid]

@@ -1,0 +1,170 @@
+"""TPU tunnel watchdog: harvest an attested on-TPU benchmark number the
+moment ANY healthy tunnel window appears during the round.
+
+The TPU plugin in this environment wedges for hours at a time and can
+recover without warning; the end-of-round bench alone has missed every
+healthy window for three rounds running. This watchdog runs for the whole
+working session:
+
+- every ``PROBE_EVERY_S`` seconds, probe the accelerator in a short-timeout
+  child process (backend init + a small matmul — the plugin wedges on init,
+  so the probe must never run in the watchdog process itself);
+- log every attempt with a timestamp to ``TPU_WATCH_LOG.txt`` (an empty
+  round's log is the proof that zero healthy windows existed);
+- on the first healthy probe, immediately run the quick-mode bench
+  (25k series, few timed runs, persistent jit cache = minimal tunnel
+  exposure), then escalate to the full 100k-series north-star workload;
+- append every successful measurement as timestamped JSON to
+  ``BENCH_TPU_ATTESTED.json`` and git-commit that artifact right away, so
+  a later wedge (or the end of the round) cannot lose it.
+
+Run via ``make tpu-watch`` (foreground) or ``make tpu-watch-bg``.
+Workload contract: reference QueryInMemoryBenchmark.scala:121-125 scaled to
+the driver's 100k-series target (BASELINE.md north star).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+LOG = os.path.join(REPO, "TPU_WATCH_LOG.txt")
+OUT = os.path.join(REPO, "BENCH_TPU_ATTESTED.json")
+
+PROBE_EVERY_S = int(os.environ.get("TPU_WATCH_PROBE_EVERY_S", 120))
+PROBE_TIMEOUT_S = int(os.environ.get("TPU_WATCH_PROBE_TIMEOUT_S", 30))
+DEADLINE_S = float(os.environ.get("TPU_WATCH_DEADLINE_S", 11.0 * 3600))
+QUICK_SERIES = int(os.environ.get("TPU_WATCH_QUICK_SERIES", 25_000))
+FULL_SERIES = int(os.environ.get("TPU_WATCH_FULL_SERIES", 100_000))
+
+_PROBE_CODE = (
+    "import jax, jax.numpy as jnp\n"
+    "d = jax.devices()\n"
+    "assert d and d[0].platform != 'cpu', d\n"
+    "x = jnp.ones((256, 256), jnp.bfloat16)\n"
+    "(x @ x).block_until_ready()\n"
+    "print('TPU_OK', d[0].platform, d[0].device_kind)\n"
+)
+
+
+def log(msg: str) -> None:
+    line = f"{time.strftime('%Y-%m-%dT%H:%M:%S%z')} {msg}"
+    print(line, flush=True)
+    with open(LOG, "a") as f:
+        f.write(line + "\n")
+
+
+def probe() -> bool:
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _PROBE_CODE], timeout=PROBE_TIMEOUT_S,
+            capture_output=True, text=True,
+        )
+    except subprocess.TimeoutExpired:
+        log(f"probe TIMEOUT after {PROBE_TIMEOUT_S}s (wedged plugin)")
+        return False
+    if proc.returncode == 0 and "TPU_OK" in proc.stdout:
+        log(f"probe OK: {proc.stdout.strip()}")
+        return True
+    log(f"probe FAIL rc={proc.returncode}: {proc.stderr.strip()[-300:]}")
+    return False
+
+
+def run_bench(series: int, runs: int, timeout_s: int) -> dict | None:
+    """One bench.py --worker child on the real backend; returns its JSON."""
+    env = dict(
+        os.environ,
+        FILODB_BENCH_SERIES=str(series),
+        FILODB_BENCH_RUNS=str(runs),
+        FILODB_BENCH_WORKER_DEADLINE=str(time.time() + timeout_s - 20),
+        JAX_COMPILATION_CACHE_DIR=os.path.join(REPO, ".jax_cache"),
+    )
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            [sys.executable, BENCH, "--worker"], timeout=timeout_s,
+            capture_output=True, text=True, cwd=REPO, env=env,
+        )
+    except subprocess.TimeoutExpired:
+        log(f"bench series={series} TIMEOUT after {timeout_s}s")
+        return None
+    lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+    tail = proc.stderr.strip().splitlines()[-3:]
+    log(f"bench series={series} rc={proc.returncode} {time.time()-t0:.0f}s "
+        + " | ".join(tail))
+    if proc.returncode == 0 and lines:
+        try:
+            return json.loads(lines[-1])
+        except ValueError:
+            return None
+    return None
+
+
+def attest(parsed: dict, kind: str) -> None:
+    """Append a measurement to BENCH_TPU_ATTESTED.json and commit it."""
+    entries = []
+    if os.path.exists(OUT):
+        try:
+            with open(OUT) as f:
+                entries = json.load(f)["measurements"]
+        except (ValueError, KeyError):
+            entries = []
+    entries.append(dict(parsed, kind=kind,
+                        attested_at=time.strftime("%Y-%m-%dT%H:%M:%S%z")))
+    with open(OUT, "w") as f:
+        json.dump({"measurements": entries}, f, indent=1)
+        f.write("\n")
+    log(f"ATTESTED {kind}: {json.dumps(parsed)}")
+    # commit only these two artifacts, retrying around index.lock races with
+    # the interactive session
+    for attempt in range(5):
+        r = subprocess.run(
+            ["git", "commit", "-m", f"tpu-watch: attested {kind} TPU measurement",
+             "--", os.path.basename(OUT), os.path.basename(LOG)],
+            cwd=REPO, capture_output=True, text=True,
+        )
+        if r.returncode == 0:
+            log("committed attested artifact")
+            return
+        if "index.lock" not in r.stderr:
+            log(f"commit failed (non-lock): {r.stderr.strip()[-200:]}")
+            return
+        time.sleep(3 * (attempt + 1))
+    log("commit failed: persistent index.lock")
+
+
+def main() -> None:
+    deadline = time.time() + DEADLINE_S
+    log(f"watchdog start: probe every {PROBE_EVERY_S}s, timeout {PROBE_TIMEOUT_S}s, "
+        f"deadline in {DEADLINE_S/3600:.1f}h")
+    have_quick = have_full = False
+    n_probes = n_ok = 0
+    while time.time() < deadline and not have_full:
+        n_probes += 1
+        if probe():
+            n_ok += 1
+            if not have_quick:
+                got = run_bench(QUICK_SERIES, runs=5, timeout_s=420)
+                if got and got.get("backend") != "cpu":
+                    attest(got, "quick")
+                    have_quick = True
+                else:
+                    continue  # window closed mid-bench: back to probing
+            if have_quick and not have_full:
+                got = run_bench(FULL_SERIES, runs=15, timeout_s=1500)
+                if got and got.get("backend") != "cpu":
+                    attest(got, "full")
+                    have_full = True
+                    break
+        time.sleep(PROBE_EVERY_S)
+    log(f"watchdog done: {n_probes} probes, {n_ok} healthy, "
+        f"quick={have_quick} full={have_full}")
+
+
+if __name__ == "__main__":
+    main()
