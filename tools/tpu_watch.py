@@ -120,9 +120,20 @@ def attest(parsed: dict, kind: str) -> None:
         json.dump({"measurements": entries}, f, indent=1)
         f.write("\n")
     log(f"ATTESTED {kind}: {json.dumps(parsed)}")
-    # commit only these two artifacts, retrying around index.lock races with
-    # the interactive session
+    # stage then commit only these two artifacts (OUT starts untracked, so a
+    # pathspec-limited commit without add would abort), retrying around
+    # index.lock races with the interactive session
     for attempt in range(5):
+        a = subprocess.run(
+            ["git", "add", "--", os.path.basename(OUT), os.path.basename(LOG)],
+            cwd=REPO, capture_output=True, text=True,
+        )
+        if a.returncode != 0:
+            if "index.lock" in a.stderr:
+                time.sleep(3 * (attempt + 1))
+                continue
+            log(f"add failed (non-lock): {a.stderr.strip()[-200:]}")
+            return
         r = subprocess.run(
             ["git", "commit", "-m", f"tpu-watch: attested {kind} TPU measurement",
              "--", os.path.basename(OUT), os.path.basename(LOG)],
