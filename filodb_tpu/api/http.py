@@ -317,10 +317,7 @@ class PromApiHandler(BaseHTTPRequestHandler):
         n_samples = sum(g.n_series * g.num_steps for g in res.grids)
         if res.raw is not None:
             n_samples += sum(len(t) for _, t, _ in res.raw)
-        streamable = res.raw is None or all(
-            v.ndim == 1 for _, _, v in res.raw
-        )
-        if streamable and n_samples >= self.STREAM_MIN_SAMPLES:
+        if n_samples >= self.STREAM_MIN_SAMPLES:
             return self._send_chunked(200, J.stream_matrix(res, stats))
         data = J.render_matrix(res)
         data["stats"] = stats

@@ -22,6 +22,14 @@ def _fmt(v: float) -> str:
     return repr(float(v))
 
 
+def _fmt_val(v):
+    """Scalar samples format as Prometheus value strings; 2-D (histogram
+    bucket-row) samples as a list of them."""
+    if np.ndim(v) == 0:
+        return _fmt(float(v))
+    return [_fmt(float(x)) for x in v]
+
+
 def _labels_out(labels: dict) -> dict:
     out = {}
     for k, v in labels.items():
@@ -40,7 +48,7 @@ def render_matrix(res: QueryResult) -> dict:
             data.append(
                 {
                     "metric": _labels_out(labels),
-                    "values": [[t / 1000.0, _fmt(v)] for t, v in zip(ts[keep], vals[keep])],
+                    "values": [[t / 1000.0, _fmt_val(v)] for t, v in zip(ts[keep], vals[keep])],
                 }
             )
     for labels, ts, vals in res.all_series():
@@ -72,10 +80,12 @@ def render_scalar(res: QueryResult, time_s: float) -> dict:
 
 def _ts3(t: float) -> str:
     """Fixed 3-decimal seconds (Prometheus' millisecond convention),
-    byte-identical to the native renderer's llround-based form for the
-    non-negative timestamps Prometheus uses."""
-    ms = int(math.floor(t * 1000.0 + 0.5))
-    return f"{ms // 1000}.{ms % 1000:03d}"
+    byte-identical to the native renderer's llround-based form: half-away
+    rounding, and negatives render as sign + magnitude of the truncating
+    div/mod (t=-0.5 -> "-0.500", never "-1.500")."""
+    ms = int(math.floor(abs(t) * 1000.0 + 0.5))
+    sign = "-" if (t < 0 and ms > 0) else ""
+    return f"{sign}{ms // 1000}.{ms % 1000:03d}"
 
 
 def _values_fragment(ts_s: np.ndarray, vals: np.ndarray) -> bytes:
@@ -110,11 +120,8 @@ def stream_matrix(res: QueryResult, stats: dict | None = None,
     buf += b'{"status":"success","data":{"resultType":"matrix","result":['
     first = True
 
-    def emit(labels, ts_s, vals, keep_empty):
+    def emit_frag(labels, frag):
         nonlocal first
-        frag = _values_fragment(ts_s, vals)
-        if frag == b"[]" and not keep_empty:
-            return None
         head = b"" if first else b","
         first = False
         return (
@@ -123,18 +130,31 @@ def stream_matrix(res: QueryResult, stats: dict | None = None,
             + b',"values":' + frag + b"}"
         )
 
+    def emit(labels, ts_s, vals, keep_empty):
+        frag = _values_fragment(ts_s, vals)
+        if frag == b"[]" and not keep_empty:
+            return None
+        return emit_frag(labels, frag)
+
     if res.raw is not None:
         for labels, ts, vals in res.raw:
             if vals.ndim != 1:
                 # 2-D (histogram-column) raw values would be read as a flat
-                # f64 buffer by the native renderer — silently wrong bytes.
-                # Callers must route such results to render_matrix (http.py
-                # checks before choosing the streaming path).
-                raise ValueError(
-                    "stream_matrix: raw values must be 1-D (got "
-                    f"ndim={vals.ndim}); histogram raw export is not "
-                    "streamable"
+                # f64 buffer by the native renderer — degrade this series to
+                # a Python row-list fragment (same shape as render_matrix's
+                # output, with this path's fixed 3-decimal timestamps; no
+                # 500 for callers that skip http.py's pre-filter)
+                rows = ",".join(
+                    f'[{_ts3(t / 1000.0)},{json.dumps(_fmt_val(v))}]'
+                    for t, v in zip(ts, vals)
                 )
+                piece = emit_frag(labels, ("[" + rows + "]").encode())
+                if piece:
+                    buf += piece
+                if len(buf) >= chunk_target:
+                    yield bytes(buf)
+                    buf.clear()
+                continue
             piece = emit(labels, ts.astype(np.float64) / 1e3, vals, True)
             if piece:
                 buf += piece

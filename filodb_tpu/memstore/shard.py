@@ -52,7 +52,8 @@ class StoreConfig:
     groups_per_shard: int = NUM_FLUSH_GROUPS
     max_partitions: int = 1_000_000
     # "python" | "native": the C++ posting-list index (reference's tantivy
-    # analog) answers equality queries ~8x faster; falls back when unbuilt
+    # analog; BENCH_LOCAL.json index_* metrics record both backends) is the
+    # fast path for equality queries; falls back when unbuilt
     index_backend: str = "python"
     # staging-cache byte budget per shard (HBM/working-set guard; reference
     # analog: BlockManager reclaim under memory pressure)

@@ -10,9 +10,9 @@
 // first — identical to Python's float(np.float32(x)).
 //
 // Reference analog: prometheus/.../query/PrometheusModel.scala:256 (the JVM
-// circe render). Measured on this machine (benchmarks/run.py bench_render,
-// 2M random-f64 samples, warm): ~0.3 Msamples/s pure Python, >10 Msamples/s
-// through this path (see BENCH_LOCAL.json for the number of record).
+// circe render). Throughput numbers of record: BENCH_LOCAL.json metrics
+// prom_render_native_2M_random / _2M_integral / prom_render_python_100k_random
+// (benchmarks/run.py bench_render measures all three).
 //
 // Build: g++ -O3 -march=native -std=c++17 -shared -fPIC promrender.cpp \
 //        -o libfilodbrender.so
@@ -24,10 +24,38 @@
 
 namespace {
 
-// fixed 3-decimal seconds from a seconds-as-double timestamp; ~2x the
+// two-digit-pair lookup: halves the division chain in the hot itoa loops
+// ("00".."99" as 200 contiguous bytes)
+constexpr char kDigitPairs[201] =
+    "00010203040506070809101112131415161718192021222324"
+    "25262728293031323334353637383940414243444546474849"
+    "50515253545556575859606162636465666768697071727374"
+    "75767778798081828384858687888990919293949596979899";
+
+inline char* emit_u64(char* p, unsigned long long v) {
+    char tmp[20];
+    char* q = tmp + 20;
+    while (v >= 100) {
+        unsigned d = unsigned(v % 100) * 2;
+        v /= 100;
+        *--q = kDigitPairs[d + 1];
+        *--q = kDigitPairs[d];
+    }
+    if (v >= 10) {
+        unsigned d = unsigned(v) * 2;
+        *--q = kDigitPairs[d + 1];
+        *--q = kDigitPairs[d];
+    } else {
+        *--q = char('0' + v);
+    }
+    std::memcpy(p, q, tmp + 20 - q);
+    return p + (tmp + 20 - q);
+}
+
+// fixed 3-decimal seconds from a seconds-as-double timestamp; ~4x the
 // throughput of to_chars shortest-form and format-stable across platforms.
-// Matches the Python fallback's int(floor(t*1000+0.5)) exactly for the
-// non-negative timestamps Prometheus uses (llround = round-half-away).
+// Matches the Python fallback's sign + magnitude-of-truncating-div/mod form
+// exactly (llround = round-half-away; promjson._ts3).
 inline char* render_ts(char* p, double t_sec) {
     long long ms = llround(t_sec * 1000.0);
     long long sec = ms / 1000;
@@ -37,19 +65,37 @@ inline char* render_ts(char* p, double t_sec) {
         sec = -sec;
         frac = -frac;
     }
-    char tmp[20];
-    char* q = tmp + 20;
-    do {
-        *--q = char('0' + sec % 10);
-        sec /= 10;
-    } while (sec);
-    std::memcpy(p, q, tmp + 20 - q);
-    p += tmp + 20 - q;
+    p = emit_u64(p, (unsigned long long)sec);
     *p++ = '.';
-    *p++ = char('0' + frac / 100);
-    *p++ = char('0' + (frac / 10) % 10);
+    unsigned d = unsigned(frac / 10) * 2;  // frac < 1000
+    *p++ = kDigitPairs[d];
+    *p++ = kDigitPairs[d + 1];
     *p++ = char('0' + frac % 10);
     return p;
+}
+
+// integral |v| < 1e15 with <= 4 trailing zeros: the fixed digit string is
+// provably std::to_chars' shortest choice (scientific needs sig+5 bytes
+// when sig >= 2, sig+4 when sig == 1, vs sig+zeros fixed — to_chars
+// resolves length ties in favor of fixed), so emit it directly via the
+// pair table instead of running the full Ryu shortest-form search.
+// Counter/gauge exports are overwhelmingly integral, so this branch is the
+// common case at the serving edge.
+inline bool try_render_integral(char*& p, double v) {
+    double av = v < 0 ? -v : v;
+    if (!(av < 1e15)) return false;
+    unsigned long long u = (unsigned long long)av;
+    if ((double)u != av) return false;
+    unsigned long long z = 0;  // trailing-zero count
+    unsigned long long t = u;
+    while (z <= 4 && t != 0 && t % 10 == 0) {
+        t /= 10;
+        z++;
+    }
+    if (z > 4) return false;
+    if (std::signbit(v)) *p++ = '-';  // covers -0.0 -> "-0" like to_chars
+    p = emit_u64(p, u);
+    return true;
 }
 
 long render(const double* ts, const double* vals_d, const float* vals_f,
@@ -72,7 +118,7 @@ long render(const double* ts, const double* vals_d, const float* vals_f,
         if (std::isinf(v)) {
             std::memcpy(p, v > 0 ? "+Inf" : "-Inf", 4);
             p += 4;
-        } else {
+        } else if (!try_render_integral(p, v)) {
             auto r2 = std::to_chars(p, e, v);
             if (r2.ec != std::errc()) return -1;
             p = r2.ptr;

@@ -98,12 +98,17 @@ class JitterWindowMatrices:
         L2 = onehot(chi - 2, c0ge2)
         Klo = onehot(klo, has_klo)
         Khi = onehot(khi, has_khi)
-        # [T, 6, J] -> [T, 6J]: ONE matmul per input array fetches every piece
-        self.CM = np.stack([W0, F0, L0, L2, Klo, Khi], axis=1).reshape(T, 6 * J)
-        # gather-form of the five one-hot selections (CPU backend: a take is
-        # ~100x cheaper than the stacked matmul; TPU keeps the MXU one-hots).
-        # Clipped positions yield garbage exactly where the one-hot column
-        # is all-zero — every use is gated by the c0pos/has_* masks.
+        # certain-membership matrix: the ONE matmul every sum-family function
+        # needs (same cost class as the regular-grid path's W)
+        self.W0 = W0
+        # the five boundary/edge selections, in BOTH fetch forms: stacked
+        # one-hots for an MXU matmul (TPU), and gather indices for jnp.take
+        # (CPU, where a take is ~100x cheaper than the matmul). The kernel
+        # slices out only the rows the requested function needs, so e.g.
+        # rate never pays for an L2 fetch and count pays for no vals fetch
+        # at all. Clipped positions yield garbage exactly where the one-hot
+        # column is all-zero — every use is gated by the c0pos/has_* masks.
+        self.SEL = np.stack([F0, L0, L2, Klo, Khi], axis=1).reshape(T, 5 * J)
         self.idx = np.stack([
             np.clip(clo, 0, T - 1),
             np.clip(chi - 1, 0, T - 1),
@@ -167,7 +172,8 @@ class JitterWindowMatrices:
         self.edge_idx = edge_idx
 
         put = jax.device_put
-        self.dCM = put(self.CM)
+        self.d_W0 = put(self.W0)
+        self.d_SEL = put(self.SEL)
         self.d_count0 = put(self.count0)
         self.d_c0pos = put(self.c0pos)
         self.d_c0ge2 = put(self.c0ge2)
@@ -204,33 +210,57 @@ def jitter_window_matrices(block: StagedBlock, start_off: int, step_ms: int,
     return wm
 
 
-@functools.partial(jax.jit, static_argnames=("func", "is_counter", "is_delta"))
+# rows of SEL / idx, by name
+_F0, _L0, _L2, _KLO, _KHI = range(5)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("func", "is_counter", "is_delta", "fetch")
+)
 def jitter_range_kernel(
     func: str,
     vals,  # [S, T] f32
     dev,  # [S, T] f32 per-sample deviation from the nominal grid (ms)
     raw,  # [S, T] f32 (counters; == vals otherwise)
-    CM,  # [T, 6J]: W0|F0|L0|L2|Klo|Khi stacked
+    W0,  # [T, J] certain-membership matrix
+    SEL,  # [T, 5J]: F0|L0|L2|Klo|Khi one-hot stack
+    idx,  # [5, J] i32 gather-form of the same selections (or None)
     count0, c0pos, c0ge2, has_klo, has_khi,  # [J]
     F0_rel, L0_rel, L2_rel, Klo_rel, Khi_rel, blo_rel, ehi_rel,  # [J] f32
     window_ms,
     is_counter: bool = False,
     is_delta: bool = False,
+    fetch: str = "auto",
 ):
+    """Each branch fetches ONLY the selections it needs — the certain-window
+    matmul (x @ W0) is paid only by the sum family, and rate/irate reduce to
+    a handful of one-hot fetches + elementwise math, the same cost class as
+    the regular-grid kernel. ``fetch`` picks the selection strategy: "matmul"
+    (MXU one-hots), "gather" (jnp.take — far cheaper on CPU), or "auto"
+    (backend-chosen at trace time)."""
     f32 = jnp.float32
     nan = jnp.nan
-    S = vals.shape[0]
-    J = CM.shape[1] // 6
+    from .mxu_kernels import use_gather_fetch
 
-    def mm(x):
-        a = jax.lax.dot(x, CM, precision=jax.lax.Precision.HIGHEST)
-        return a.reshape(S, 6, J)
+    S, T = vals.shape
+    J = W0.shape[1]
+    use_gather = use_gather_fetch(fetch, idx)
 
-    A = mm(vals)
-    sW, vF0, vL0, vL2, vKlo, vKhi = (A[:, i, :] for i in range(6))
-    D = mm(dev)
-    dF0, dL0, dL2, dKlo, dKhi = (D[:, i, :] for i in range(1, 6))
+    def sel(x, rows):
+        """Fetch the named selection rows of x as [S, len(rows), J]."""
+        r = np.array(rows)
+        if use_gather:
+            g = jnp.take(x, idx[r].reshape(-1), axis=1)
+            return g.reshape(S, len(rows), J)
+        M = SEL.reshape(T, 5, J)[:, r, :].reshape(T, len(rows) * J)
+        a = jax.lax.dot(x, M, precision=jax.lax.Precision.HIGHEST)
+        return a.reshape(S, len(rows), J)
 
+    def mmW0(x):
+        return jax.lax.dot(x, W0, precision=jax.lax.Precision.HIGHEST)
+
+    # boundary membership: needed by every function
+    dKlo, dKhi = (a for a in sel(dev, (_KLO, _KHI)).swapaxes(0, 1))
     in_lo = has_klo[None, :] & (dKlo > blo_rel[None, :])
     in_hi = has_khi[None, :] & (dKhi <= ehi_rel[None, :])
     cnt = count0[None, :] + in_lo + in_hi
@@ -240,38 +270,45 @@ def jitter_range_kernel(
     def w3(m1, a, m2, b_, c):
         return jnp.where(m1, a, jnp.where(m2, b_, c))
 
+    # the one definition of the ordered last-sample selection rule
+    # ([klo?] certain[clo..chi) [khi?]); first/prev variants stay inline at
+    # their single use sites
+    def vlast(vL0, vKlo, vKhi):
+        return w3(in_hi, vKhi, c0pos[None, :], vL0, vKlo)
+
+    def tlast(dL0):
+        return w3(in_hi, Khi_rel[None, :] + dKhi, c0pos[None, :],
+                  L0_rel[None, :] + dL0, Klo_rel[None, :] + dKlo)
+
     if func == "sum_over_time" or (is_delta and func in ("rate", "increase")):
-        s = sW + jnp.where(in_lo, vKlo, 0.0) + jnp.where(in_hi, vKhi, 0.0)
+        vKlo, vKhi = (a for a in sel(vals, (_KLO, _KHI)).swapaxes(0, 1))
+        s = mmW0(vals) + jnp.where(in_lo, vKlo, 0.0) + jnp.where(in_hi, vKhi, 0.0)
         if func == "rate":
             s = s / w_s
         return jnp.where(has, s, nan)
     if func == "count_over_time":
         return jnp.where(has, cnt, nan)
     if func == "avg_over_time":
-        s = sW + jnp.where(in_lo, vKlo, 0.0) + jnp.where(in_hi, vKhi, 0.0)
+        vKlo, vKhi = (a for a in sel(vals, (_KLO, _KHI)).swapaxes(0, 1))
+        s = mmW0(vals) + jnp.where(in_lo, vKlo, 0.0) + jnp.where(in_hi, vKhi, 0.0)
         return jnp.where(has, s / jnp.maximum(cnt, 1.0), nan)
     if func == "present_over_time":
         return jnp.where(has, 1.0, nan)
     if func == "absent_over_time":
         return jnp.where(has, nan, 1.0)
-
-    # ordered in-window sample selection: [klo?] certain[clo..chi) [khi?]
-    v_first = w3(in_lo, vKlo, c0pos[None, :], vF0, vKhi)
-    v_last = w3(in_hi, vKhi, c0pos[None, :], vL0, vKlo)
-    tf_rel = w3(in_lo, Klo_rel[None, :] + dKlo, c0pos[None, :],
-                F0_rel[None, :] + dF0, Khi_rel[None, :] + dKhi)
-    tl_rel = w3(in_hi, Khi_rel[None, :] + dKhi, c0pos[None, :],
-                L0_rel[None, :] + dL0, Klo_rel[None, :] + dKlo)
-
-    if func in ("last", "last_over_time"):
-        return jnp.where(has, v_last, nan)
-    if func == "first_over_time":
-        return jnp.where(has, v_first, nan)
     if func in ("stddev_over_time", "stdvar_over_time", "z_score"):
-        A2 = mm(vals * vals)
-        sW2 = A2[:, 0, :]
-        s = sW + jnp.where(in_lo, vKlo, 0.0) + jnp.where(in_hi, vKhi, 0.0)
-        s2 = sW2 + jnp.where(in_lo, vKlo * vKlo, 0.0) + jnp.where(in_hi, vKhi * vKhi, 0.0)
+        if func == "z_score":
+            vL0, vKlo, vKhi = (
+                a for a in sel(vals, (_L0, _KLO, _KHI)).swapaxes(0, 1)
+            )
+        else:
+            vKlo, vKhi = (a for a in sel(vals, (_KLO, _KHI)).swapaxes(0, 1))
+        s = mmW0(vals) + jnp.where(in_lo, vKlo, 0.0) + jnp.where(in_hi, vKhi, 0.0)
+        s2 = (
+            mmW0(vals * vals)
+            + jnp.where(in_lo, vKlo * vKlo, 0.0)
+            + jnp.where(in_hi, vKhi * vKhi, 0.0)
+        )
         c = jnp.maximum(cnt, 1.0)
         mean = s / c
         var = jnp.maximum(s2 / c - mean * mean, 0.0)
@@ -280,8 +317,31 @@ def jitter_range_kernel(
         sd = jnp.sqrt(var)
         if func == "stddev_over_time":
             return jnp.where(has, sd, nan)
-        return jnp.where(has, (v_last - mean) / jnp.maximum(sd, 1e-30), nan)
+        return jnp.where(
+            has, (vlast(vL0, vKlo, vKhi) - mean) / jnp.maximum(sd, 1e-30), nan
+        )
+
+    # ordered in-window sample selection: [klo?] certain[clo..chi) [khi?]
+    if func == "first_over_time":
+        vF0, vKlo, vKhi = (
+            a for a in sel(vals, (_F0, _KLO, _KHI)).swapaxes(0, 1)
+        )
+        return jnp.where(has, w3(in_lo, vKlo, c0pos[None, :], vF0, vKhi), nan)
+    if func in ("last", "last_over_time"):
+        vL0, vKlo, vKhi = (
+            a for a in sel(vals, (_L0, _KLO, _KHI)).swapaxes(0, 1)
+        )
+        return jnp.where(has, vlast(vL0, vKlo, vKhi), nan)
     if func in ("rate", "increase", "delta"):
+        vF0, vL0, vKlo, vKhi = (
+            a for a in sel(vals, (_F0, _L0, _KLO, _KHI)).swapaxes(0, 1)
+        )
+        dF0, dL0 = (a for a in sel(dev, (_F0, _L0)).swapaxes(0, 1))
+        v_first = w3(in_lo, vKlo, c0pos[None, :], vF0, vKhi)
+        v_last = vlast(vL0, vKlo, vKhi)
+        tf_rel = w3(in_lo, Klo_rel[None, :] + dKlo, c0pos[None, :],
+                    F0_rel[None, :] + dF0, Khi_rel[None, :] + dKhi)
+        tl_rel = tlast(dL0)
         dlt = v_last - v_first
         sampled = (tl_rel - tf_rel) * 1e-3
         dur_start = tf_rel * 1e-3
@@ -289,8 +349,10 @@ def jitter_range_kernel(
         avg_dur = sampled / jnp.maximum(cnt - 1.0, 1.0)
         thresh = avg_dur * 1.1
         if is_counter and func != "delta":
-            Ar = mm(raw)
-            v_first_raw = w3(in_lo, Ar[:, 4, :], c0pos[None, :], Ar[:, 1, :], Ar[:, 5, :])
+            rF0, rKlo, rKhi = (
+                a for a in sel(raw, (_F0, _KLO, _KHI)).swapaxes(0, 1)
+            )
+            v_first_raw = w3(in_lo, rKlo, c0pos[None, :], rF0, rKhi)
             dur_zero = jnp.where(
                 dlt > 0, sampled * (v_first_raw / jnp.maximum(dlt, 1e-30)), jnp.inf
             )
@@ -309,7 +371,16 @@ def jitter_range_kernel(
         if func == "idelta" and is_counter and not is_delta:
             # diff-encoded counters: the staged value AT the last in-window
             # sample is already the f64-exact last-pair difference
-            return jnp.where(ok2, v_last, nan)
+            vL0, vKlo, vKhi = (
+                a for a in sel(vals, (_L0, _KLO, _KHI)).swapaxes(0, 1)
+            )
+            return jnp.where(ok2, vlast(vL0, vKlo, vKhi), nan)
+        vL0, vL2, vKlo, vKhi = (
+            a for a in sel(vals, (_L0, _L2, _KLO, _KHI)).swapaxes(0, 1)
+        )
+        v_last = vlast(vL0, vKlo, vKhi)
+        dL0, dL2 = (a for a in sel(dev, (_L0, _L2)).swapaxes(0, 1))
+        tl_rel = tlast(dL0)
         v_prev = jnp.where(
             in_hi,
             jnp.where(c0pos[None, :], vL0, vKlo),
@@ -327,31 +398,45 @@ def jitter_range_kernel(
     raise ValueError(f"jitter kernel does not support {func}")
 
 
-@functools.partial(jax.jit, static_argnames=("n_valid", "is_min"))
-def jitter_minmax(vals, dev, CM, tile_mask, edge_onehot, edge_valid,
-                  count0, has_klo, has_khi, blo_rel, ehi_rel,
-                  n_valid: int, is_min: bool = True):
+@functools.partial(jax.jit, static_argnames=("n_valid", "is_min", "fetch"))
+def jitter_minmax(vals, dev, SEL, idx, tile_mask, edge_onehot, edge_valid,
+                  edge_idx, count0, has_klo, has_khi, blo_rel, ehi_rel,
+                  n_valid: int, is_min: bool = True, fetch: str = "auto"):
     """min/max over the certain range via the tile hierarchy + edge one-hots
     (mxu_kernels.mxu_minmax structure), then fold in the <=2 per-series
-    uncertain boundary samples."""
+    uncertain boundary samples. ``fetch`` as in jitter_range_kernel."""
+    from .mxu_kernels import use_gather_fetch
+
     S, T = vals.shape
     Lt = _TILE
     J = tile_mask.shape[0]
+    use_gather = use_gather_fetch(fetch, idx)
     v = vals if is_min else -vals
     sentinel = jnp.float32(3e38)
     lane = jax.lax.broadcasted_iota(jnp.int32, (S, T), 1)
     vm = jnp.where(lane < n_valid, v, sentinel)
     tmin = vm.reshape(S, T // Lt, Lt).min(-1)
     certain = jnp.where(tile_mask[None, :, :], tmin[:, None, :], sentinel).min(-1)
-    edges = jax.lax.dot(vm, edge_onehot, precision=jax.lax.Precision.HIGHEST)
+    if use_gather and edge_idx is not None:
+        edges = jnp.take(vm, edge_idx.reshape(-1), axis=1)
+    else:
+        edges = jax.lax.dot(vm, edge_onehot, precision=jax.lax.Precision.HIGHEST)
     edges = edges.reshape(S, J, 2 * Lt)
     edges = jnp.where(edge_valid[None, :, :], edges, sentinel).min(-1)
     r = jnp.minimum(certain, edges)
 
-    A = jax.lax.dot(v, CM, precision=jax.lax.Precision.HIGHEST).reshape(S, 6, J)
-    vKlo, vKhi = A[:, 4, :], A[:, 5, :]
-    D = jax.lax.dot(dev, CM, precision=jax.lax.Precision.HIGHEST).reshape(S, 6, J)
-    dKlo, dKhi = D[:, 4, :], D[:, 5, :]
+    def sel_kk(x):
+        if use_gather:
+            return jnp.take(x, idx[3:5].reshape(-1), axis=1).reshape(S, 2, J)
+        M = SEL.reshape(T, 5, J)[:, 3:5, :].reshape(T, 2 * J)
+        return jax.lax.dot(
+            x, M, precision=jax.lax.Precision.HIGHEST
+        ).reshape(S, 2, J)
+
+    A = sel_kk(v)
+    vKlo, vKhi = A[:, 0, :], A[:, 1, :]
+    D = sel_kk(dev)
+    dKlo, dKhi = D[:, 0, :], D[:, 1, :]
     in_lo = has_klo[None, :] & (dKlo > blo_rel[None, :])
     in_hi = has_khi[None, :] & (dKhi <= ehi_rel[None, :])
     r = jnp.minimum(r, jnp.where(in_lo, vKlo, sentinel))
@@ -373,14 +458,18 @@ def run_jitter_range_function(func, block: StagedBlock, params,
     wm = jitter_window_matrices(block, start_off, params.step_ms, J, params.window_ms)
     if not wm.ok:
         return None
+    from .mxu_kernels import fetch_strategy
+
     dev = block.ts_dev
+    fetch = fetch_strategy()
     if func in ("min_over_time", "max_over_time"):
         return jitter_minmax(
-            jnp.asarray(block.vals), dev, wm.dCM, wm.d_tile_mask,
-            wm.d_edge_onehot, wm.d_edge_valid, wm.d_count0,
+            jnp.asarray(block.vals), dev, wm.d_SEL, wm.d_idx, wm.d_tile_mask,
+            wm.d_edge_onehot, wm.d_edge_valid, wm.d_edge_idx, wm.d_count0,
             wm.d_has_klo, wm.d_has_khi, wm.d_blo_rel, wm.d_ehi_rel,
             n_valid=int(np.asarray(block.lens)[0]),
             is_min=(func == "min_over_time"),
+            fetch=fetch,
         )
     raw = block.raw if block.raw is not None else block.vals
     return jitter_range_kernel(
@@ -388,11 +477,14 @@ def run_jitter_range_function(func, block: StagedBlock, params,
         block.vals,
         dev,
         raw,
-        wm.dCM,
+        wm.d_W0,
+        wm.d_SEL,
+        wm.d_idx,
         wm.d_count0, wm.d_c0pos, wm.d_c0ge2, wm.d_has_klo, wm.d_has_khi,
         wm.d_F0_rel, wm.d_L0_rel, wm.d_L2_rel, wm.d_Klo_rel, wm.d_Khi_rel,
         wm.d_blo_rel, wm.d_ehi_rel,
         np.float32(params.window_ms),
         is_counter=is_counter,
         is_delta=is_delta,
+        fetch=fetch,
     )

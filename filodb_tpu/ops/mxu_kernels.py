@@ -42,6 +42,41 @@ MXU_FUNCS = {
 _TILE = 16  # tile width for the min/max hierarchy
 
 
+def fetch_strategy(override: str | None = None) -> str:
+    """Resolve the one-hot-selection fetch strategy for the MXU kernels.
+
+    "matmul" fetches via one-hot matmuls (MXU-speed gathers on TPU);
+    "gather" via jnp.take (~100x cheaper on the CPU backend); "auto" picks
+    per backend at trace time. FILODB_MXU_FETCH forces a strategy globally —
+    the parity test suite uses it to execute the TPU matmul path on CPU.
+    The result is a static jit argument, so a forced run never reuses a
+    cached auto-mode executable."""
+    import os
+
+    f = override or os.environ.get("FILODB_MXU_FETCH", "auto")
+    if f not in ("auto", "matmul", "gather"):
+        raise ValueError(f"bad fetch strategy {f!r}")
+    return f
+
+
+def use_gather_fetch(fetch: str, idx) -> bool:
+    """Resolve a fetch strategy to a concrete choice at trace time (the one
+    shared rule for all MXU kernels): gather when forced, or in auto mode on
+    the CPU backend where jnp.take beats the one-hot matmul. A forced
+    "gather" at a call site that supplies no gather indices is a miswiring —
+    raise rather than silently compare the matmul path against itself."""
+    if idx is None:
+        if fetch == "gather":
+            raise ValueError(
+                "fetch='gather' forced but this call site provides no gather "
+                "indices (idx=None)"
+            )
+        return False
+    return fetch == "gather" or (
+        fetch == "auto" and jax.default_backend() == "cpu"
+    )
+
+
 class WindowMatrices:
     """Host-precomputed per-(grid, window) matrices for one shared ts."""
 
@@ -156,7 +191,9 @@ def window_matrices(block: StagedBlock, start_off: int, step_ms: int,
     return wm
 
 
-@functools.partial(jax.jit, static_argnames=("func", "is_counter", "is_delta"))
+@functools.partial(
+    jax.jit, static_argnames=("func", "is_counter", "is_delta", "fetch")
+)
 def mxu_range_kernel(
     func: str,
     vals,  # [S, T] f32
@@ -170,6 +207,7 @@ def mxu_range_kernel(
     is_counter: bool = False,
     is_delta: bool = False,
     arg0=0.0,
+    fetch: str = "auto",
 ):
     """Compute [S, J] results with matmuls on the MXU.
 
@@ -186,7 +224,7 @@ def mxu_range_kernel(
     def mm(x, M):
         return jax.lax.dot(x, M, precision=jax.lax.Precision.HIGHEST)
 
-    if idx is not None and jax.default_backend() == "cpu":
+    if use_gather_fetch(fetch, idx):
         gF = lambda x: jnp.take(x, idx[0], axis=1)
         gL = lambda x: jnp.take(x, idx[1], axis=1)
         gL2 = lambda x: jnp.take(x, idx[2], axis=1)
@@ -276,9 +314,10 @@ def mxu_pair_count(flagged, P, has):
     return jnp.where(has, n, jnp.nan)
 
 
-@functools.partial(jax.jit, static_argnames=("n_valid", "is_min"))
+@functools.partial(jax.jit, static_argnames=("n_valid", "is_min", "fetch"))
 def mxu_minmax(vals, tile_mask, edge_onehot, edge_valid, count,
-               n_valid: int, is_min: bool = True, edge_idx=None):
+               n_valid: int, is_min: bool = True, edge_idx=None,
+               fetch: str = "auto"):
     """min/max_over_time on the regular grid: tile-hierarchy + edge samples
     via selection matmul (gathers are pathologically slow on the TPU
     backend; on CPU the gather form via edge_idx is far cheaper than the
@@ -293,7 +332,7 @@ def mxu_minmax(vals, tile_mask, edge_onehot, edge_valid, count,
     vm = jnp.where(lane < n_valid, v, sentinel)
     tmin = vm.reshape(S, T // L, L).min(-1)  # [S, T/L]
     full = jnp.where(tile_mask[None, :, :], tmin[:, None, :], sentinel).min(-1)  # [S, J]
-    if edge_idx is not None and jax.default_backend() == "cpu":
+    if use_gather_fetch(fetch, edge_idx):
         edges = jnp.take(vm, edge_idx.reshape(-1), axis=1)
     else:
         edges = jax.lax.dot(vm, edge_onehot, precision=jax.lax.Precision.HIGHEST)
@@ -346,7 +385,7 @@ def run_mxu_range_function(func, block: StagedBlock, params, is_counter=False,
             jnp.asarray(block.vals), wm.d_tile_mask, wm.d_edge_onehot,
             wm.d_edge_valid, wm.d_count,
             n_valid=int(block.lens[0]), is_min=(func == "min_over_time"),
-            edge_idx=wm.d_edge_idx,
+            edge_idx=wm.d_edge_idx, fetch=fetch_strategy(),
         )
     if func in ("deriv", "predict_linear"):
         lead = np.float32(args[0]) if args else np.float32(0.0)
@@ -371,4 +410,5 @@ def run_mxu_range_function(func, block: StagedBlock, params, is_counter=False,
         idx=wm.d_idx,
         is_counter=is_counter,
         is_delta=is_delta,
+        fetch=fetch_strategy(),
     )

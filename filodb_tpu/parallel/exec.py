@@ -276,15 +276,18 @@ class MeshAggregateExec(ExecPlan):
                 _WM_CACHE[wm_key] = wm
         if not wm.ok:
             return None
+        from ..ops.mxu_kernels import fetch_strategy
+
         return M.distributed_agg_range_jitter(
             self.mesh, self.function, self.op,
             vals, raw, dev_sh, lens, gids,
-            wm.dCM, wm.d_count0, wm.d_c0pos, wm.d_c0ge2,
+            wm.d_W0, wm.d_SEL, wm.d_idx, wm.d_count0, wm.d_c0pos, wm.d_c0ge2,
             wm.d_has_klo, wm.d_has_khi,
             wm.d_F0_rel, wm.d_L0_rel, wm.d_L2_rel, wm.d_Klo_rel, wm.d_Khi_rel,
             wm.d_blo_rel, wm.d_ehi_rel,
             np.float32(self.window_ms), num_groups,
             is_counter=self.is_counter, is_delta=self.is_delta,
+            fetch=fetch_strategy(),
         )
 
     def _column(self, ctx, shard, pids) -> str | None:

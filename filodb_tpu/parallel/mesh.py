@@ -106,7 +106,9 @@ def distributed_agg_range_mxu(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("mesh", "func", "op", "num_groups", "is_counter", "is_delta"),
+    static_argnames=(
+        "mesh", "func", "op", "num_groups", "is_counter", "is_delta", "fetch"
+    ),
 )
 def distributed_agg_range_jitter(
     mesh: Mesh,
@@ -114,13 +116,16 @@ def distributed_agg_range_jitter(
     op: str,
     vals, raw, dev,  # [D*S, T] sharded
     lens, gids,  # [D*S]
-    CM,  # [T, 6J] replicated certain/uncertain one-hot stack (mxu_jitter)
+    W0,  # [T, J] replicated certain-membership matrix (mxu_jitter)
+    SEL,  # [T, 5J] replicated boundary one-hot stack
+    idx,  # [5, J] i32 replicated gather form (or None)
     count0, c0pos, c0ge2, has_klo, has_khi,  # [J] replicated
     F0_rel, L0_rel, L2_rel, Klo_rel, Khi_rel, blo_rel, ehi_rel,  # [J]
     window_ms,
     num_groups: int,
     is_counter: bool = False,
     is_delta: bool = False,
+    fetch: str = "auto",
 ):
     """Near-regular (jittered) grid mesh aggregation: the certain-membership
     matmul + per-series boundary-correction kernel (ops/mxu_jitter.py) inside
@@ -130,10 +135,10 @@ def distributed_agg_range_jitter(
 
     def local(vals_l, raw_l, dev_l, lens_l, gids_l):
         grid = jitter_range_kernel(
-            func, vals_l, dev_l, raw_l, CM,
+            func, vals_l, dev_l, raw_l, W0, SEL, idx,
             count0, c0pos, c0ge2, has_klo, has_khi,
             F0_rel, L0_rel, L2_rel, Klo_rel, Khi_rel, blo_rel, ehi_rel,
-            window_ms, is_counter=is_counter, is_delta=is_delta,
+            window_ms, is_counter=is_counter, is_delta=is_delta, fetch=fetch,
         )
         grid = jnp.where((lens_l > 0)[:, None], grid, jnp.nan)
         return _segment_psum(op, grid, gids_l, num_groups)

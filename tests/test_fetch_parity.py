@@ -1,0 +1,194 @@
+"""Forced-fetch parity: the TPU matmul fetch path executed on CPU.
+
+The MXU kernels choose their one-hot-selection fetch strategy per backend at
+trace time (gather on CPU, one-hot matmul on TPU) — so without forcing, CI on
+the CPU backend would never execute the exact code that runs on the real
+chip. FILODB_MXU_FETCH forces a strategy (ops/mxu_kernels.fetch_strategy);
+these tests assert gather <-> matmul equality across the function matrix for
+both the regular-grid and jittered-grid paths, plus the harmonize
+re-verification fallback (the round-4 advisor high-severity class: per-shard
+grids must never be silently mis-aggregated).
+
+Window-semantics contract: reference PeriodicSamplesMapper.scala:256.
+"""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.ops import kernels as K
+from filodb_tpu.ops.mxu_jitter import JITTER_FUNCS, run_jitter_range_function
+from filodb_tpu.ops.mxu_kernels import MXU_FUNCS, run_mxu_range_function
+from filodb_tpu.ops.staging import harmonize_nominal, stage_series
+
+BASE = 1_600_000_000_000
+INTERVAL = 10_000
+
+
+def _series(n_series=6, n=300, seed=0, counter=False, jitter=0.0):
+    rng = np.random.default_rng(seed)
+    nominal = BASE + (1 + np.arange(n, dtype=np.int64)) * INTERVAL
+    out = []
+    for i in range(n_series):
+        ts = nominal
+        if jitter:
+            ts = nominal + np.rint(
+                rng.uniform(-jitter, jitter, n) * INTERVAL
+            ).astype(np.int64)
+        if counter:
+            vals = np.cumsum(rng.uniform(0, 10, n)) + 1e9
+            k = n // 2 + i
+            vals[k:] -= vals[k] - rng.uniform(0, 5)
+        else:
+            vals = 50 + 20 * rng.standard_normal(n)
+        out.append((ts, vals))
+    return out
+
+
+def _run_forced(monkeypatch, fetch, runner, func, series, counter, args=()):
+    monkeypatch.setenv("FILODB_MXU_FETCH", fetch)
+    block = stage_series(series, BASE, counter_corrected=counter)
+    params = K.RangeParams(BASE + 400_000, 60_000, 20, 300_000)
+    out = runner(func, block, params, is_counter=counter, args=args)
+    assert out is not None
+    return np.asarray(out)[: len(series), :20]
+
+
+@pytest.mark.parametrize("func", sorted(MXU_FUNCS))
+def test_regular_gather_matmul_parity(func, monkeypatch):
+    counter = func in ("rate", "increase", "irate")
+    series = _series(seed=11, counter=counter)
+    args = (600.0,) if func == "predict_linear" else ()
+    g = _run_forced(monkeypatch, "gather", run_mxu_range_function,
+                    func, series, counter, args)
+    m = _run_forced(monkeypatch, "matmul", run_mxu_range_function,
+                    func, series, counter, args)
+    np.testing.assert_array_equal(g, m, err_msg=func)
+
+
+@pytest.mark.parametrize("func", sorted(JITTER_FUNCS))
+def test_jitter_gather_matmul_parity(func, monkeypatch):
+    counter = func in ("rate", "increase", "irate")
+    series = _series(seed=12, counter=counter, jitter=0.05)
+    g = _run_forced(monkeypatch, "gather", run_jitter_range_function,
+                    func, series, counter)
+    m = _run_forced(monkeypatch, "matmul", run_jitter_range_function,
+                    func, series, counter)
+    np.testing.assert_array_equal(g, m, err_msg=func)
+
+
+def test_forced_matmul_matches_general_path(monkeypatch):
+    """The matmul fetch (the code the real TPU runs) must match the general
+    gather-path oracle, not just the CPU fetch twin."""
+    series = _series(seed=13, counter=True, jitter=0.05)
+    params = K.RangeParams(BASE + 400_000, 60_000, 20, 300_000)
+    monkeypatch.setenv("FILODB_MXU_FETCH", "matmul")
+    block = stage_series(series, BASE, counter_corrected=True)
+    assert block.nominal_ts is not None
+    fast = np.asarray(
+        run_jitter_range_function("rate", block, params, is_counter=True)
+    )[: len(series), :20]
+    monkeypatch.delenv("FILODB_MXU_FETCH")
+    general = stage_series(series, BASE, counter_corrected=True)
+    general.nominal_ts = None  # force the general per-sample path
+    slow = np.asarray(
+        K.run_range_function("rate", general, params, is_counter=True)
+    )[: len(series), :20]
+    np.testing.assert_array_equal(np.isnan(fast), np.isnan(slow))
+    ok = ~np.isnan(slow)
+    np.testing.assert_allclose(fast[ok], slow[ok], rtol=1e-3, atol=1e-3)
+
+
+def test_bad_fetch_strategy_rejected(monkeypatch):
+    from filodb_tpu.ops.mxu_kernels import fetch_strategy
+
+    monkeypatch.setenv("FILODB_MXU_FETCH", "bogus")
+    with pytest.raises(ValueError):
+        fetch_strategy()
+
+
+# ---- harmonize re-verification regression (round-4 advisor high severity) --
+
+
+def _jitter_blocks(per_shard_counts, seed=5):
+    """One near-regular staged block per shard; shard i drops
+    per_shard_counts[i] trailing samples, so sample counts differ."""
+    rng = np.random.default_rng(seed)
+    blocks = []
+    for s, drop in enumerate(per_shard_counts):
+        series = []
+        for i in range(3):
+            n = 120 - drop
+            dev = np.rint(rng.uniform(-0.1, 0.1, n) * INTERVAL).astype(np.int64)
+            ts = BASE + (1 + np.arange(n, dtype=np.int64)) * INTERVAL + dev
+            series.append((ts, np.cumsum(rng.uniform(0, 10, n))))
+        blocks.append(stage_series(series, BASE, counter_corrected=True))
+    return blocks
+
+
+def test_harmonize_rejects_unequal_counts():
+    blocks = _jitter_blocks([0, 0, 1])
+    assert all(b.nominal_ts is not None for b in blocks)
+    assert harmonize_nominal(blocks) is False
+    # and blocks are untouched: each keeps its own grid
+    assert all(b.nominal_ts is not None for b in blocks)
+
+
+def test_mesh_engine_unequal_counts_matches_host(monkeypatch):
+    """One whole shard misses the last scrape: every shard stages
+    near-regular internally, but per-shard sample counts differ INSIDE the
+    queried range, so the jitter mesh kernel (which applies one shard's
+    window structure to every row) must NOT run — the re-verify in
+    parallel/exec.py falls back, and results still match the host path."""
+    import jax
+
+    import filodb_tpu.parallel.mesh as PM
+    from filodb_tpu.coordinator.planner import PlannerParams, QueryEngine
+    from filodb_tpu.core.records import SeriesBatch
+    from filodb_tpu.core.schemas import Dataset, METRIC_TAG, PROM_COUNTER, shard_for
+    from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+    from filodb_tpu.parallel.mesh import make_mesh
+
+    rng = np.random.default_rng(9)
+    n = 120
+    assigns = []
+    for i in range(64):
+        tags = {METRIC_TAG: "rq_total", "_ws_": "w", "_ns_": "n",
+                "inst": f"h{i}"}
+        assigns.append((tags, shard_for(tags, spread=3, num_shards=8)))
+    shards_seen = {s for _, s in assigns}
+    assert len(shards_seen) > 1
+    short_shard = min(shards_seen)  # this ENTIRE shard misses the last scrape
+
+    ms = TimeSeriesMemStore()
+    ms.setup(Dataset("prometheus"), range(8))
+    for tags, shard in assigns:
+        dev = np.rint(rng.uniform(-0.1, 0.1, n) * INTERVAL).astype(np.int64)
+        ts = BASE + (1 + np.arange(n, dtype=np.int64)) * INTERVAL + dev
+        vals = np.cumsum(rng.uniform(0, 10, n)) + 1e9
+        if shard == short_shard:
+            ts, vals = ts[:-1], vals[:-1]
+        ms.shard("prometheus", shard).ingest_series(
+            SeriesBatch(PROM_COUNTER, tags, ts, {"count": vals})
+        )
+    host = QueryEngine(ms, "prometheus")
+    mesh = QueryEngine(ms, "prometheus",
+                       PlannerParams(mesh=make_mesh(jax.devices()[:1])))
+    # end past the LAST scrape (slot 120 at BASE+1_200_000) so the staged
+    # range actually contains the count mismatch
+    start, end = (BASE + 400_000) / 1000, (BASE + 1_250_000) / 1000
+
+    def jitter_kernel_must_not_run(*a, **k):
+        raise AssertionError(
+            "distributed_agg_range_jitter ran on shards with unequal counts"
+        )
+
+    monkeypatch.setattr(
+        PM, "distributed_agg_range_jitter", jitter_kernel_must_not_run
+    )
+    rh = host.query_range("sum(rate(rq_total[5m]))", start, end, 60)
+    rm = mesh.query_range("sum(rate(rq_total[5m]))", start, end, 60)
+    vh = np.asarray(rh.grids[0].values_np())
+    vm = np.asarray(rm.grids[0].values_np())
+    np.testing.assert_array_equal(np.isnan(vh), np.isnan(vm))
+    ok = ~np.isnan(vh)
+    np.testing.assert_allclose(vm[ok], vh[ok], rtol=2e-3)

@@ -141,7 +141,8 @@ def test_distributed_jitter_sum_rate_matches_oracle():
     ts_a, vals_a, lens_a, base_a, raw_a, gids_a = sharded
     out = M.distributed_agg_range_jitter(
         mesh, "rate", "sum", vals_a, raw_a, dev_sh, lens_a, gids_a,
-        wm.dCM, wm.d_count0, wm.d_c0pos, wm.d_c0ge2, wm.d_has_klo, wm.d_has_khi,
+        wm.d_W0, wm.d_SEL, wm.d_idx,
+        wm.d_count0, wm.d_c0pos, wm.d_c0ge2, wm.d_has_klo, wm.d_has_khi,
         wm.d_F0_rel, wm.d_L0_rel, wm.d_L2_rel, wm.d_Klo_rel, wm.d_Khi_rel,
         wm.d_blo_rel, wm.d_ehi_rel,
         np.float32(300_000), 2, is_counter=True,
