@@ -239,12 +239,15 @@ def bench_jitter_query():
     from filodb_tpu.memstore.memstore import TimeSeriesMemStore
     from filodb_tpu.parallel.mesh import make_mesh
 
+    import os
+
     rng = np.random.default_rng(5)
-    n, n_series = 720, 4000
+    n = 720
+    n_series = int(os.environ.get("FILODB_BENCH_JITTER_SERIES", 4000))
     nominal = BASE + np.arange(n, dtype=np.int64) * 10_000
     start, end = (BASE + 600_000) / 1000, (BASE + 7_000_000) / 1000
 
-    def build(jitter):
+    def build(jitter, hole_frac=0.0):
         ms = TimeSeriesMemStore()
         ms.setup(Dataset("prometheus"), range(8))
         incr = rng.uniform(0, 10, size=(n_series, n))
@@ -254,19 +257,30 @@ def bench_jitter_query():
                     "inst": f"h{i}"}
             shard = shard_for(tags, spread=3, num_shards=8)
             ts = nominal
+            v = vals[i]
             if jitter:
                 ts = nominal + np.rint(
                     rng.uniform(-jitter, jitter, n) * 10_000).astype(np.int64)
+            if hole_frac:
+                keep = np.ones(n, bool)
+                drop = rng.choice(np.arange(1, n - 1),
+                                  size=max(1, int(hole_frac * n)),
+                                  replace=False)
+                keep[drop] = False
+                ts, v = ts[keep], v[keep]
             ms.shard("prometheus", shard).ingest_series(
-                SeriesBatch(PROM_COUNTER, tags, ts, {"count": vals[i]})
+                SeriesBatch(PROM_COUNTER, tags, ts, {"count": v})
             )
         return QueryEngine(ms, "prometheus",
                            PlannerParams(mesh=make_mesh(jax.devices()[:1])))
 
     results = {}
-    for label, jitter in (("regular", 0.0), ("jitter1pct", 0.01),
-                          ("jitter5pct", 0.05), ("jitter20pct", 0.2)):
-        engine = build(jitter)
+    for label, jitter, holes in (
+        ("regular", 0.0, 0.0), ("jitter1pct", 0.01, 0.0),
+        ("jitter5pct", 0.05, 0.0), ("jitter20pct", 0.2, 0.0),
+        ("jitter5pct_holes0.5pct", 0.05, 0.005),
+    ):
+        engine = build(jitter, holes)
 
         def q():
             r = engine.query_range("sum(rate(rq_total[5m]))", start, end, 60)
@@ -275,9 +289,12 @@ def bench_jitter_query():
         q()  # warm
         dt = _bench(q, n_iters=10)
         results[label] = dt
-        report(f"query_sum_rate_4k_{label}_p50", dt * 1e3, "ms")
+        tag = f"{n_series // 1000}k"
+        report(f"query_sum_rate_{tag}_{label}_p50", dt * 1e3, "ms")
     report("jitter5pct_vs_regular_ratio",
            results["jitter5pct"] / results["regular"], "x")
+    report("jitter_holes_vs_regular_ratio",
+           results["jitter5pct_holes0.5pct"] / results["regular"], "x")
 
 
 ALL = [

@@ -485,6 +485,21 @@ def run_range_function(
             )
             if res is not None:
                 return res
+    if (
+        block.mgrid is not None
+        and not (is_delta and func in ("irate", "idelta"))
+        and not args
+    ):
+        from .mxu_jitter import JITTER_FUNCS, run_masked_jitter_range_function
+
+        if func in JITTER_FUNCS:
+            # missing-scrape fast path: validity masks on the nominal grid
+            # (a dropped scrape must not cost the 40x general-path penalty)
+            res = run_masked_jitter_range_function(
+                func, block, params, is_counter=is_counter, is_delta=is_delta
+            )
+            if res is not None:
+                return res
     import os as _os
 
     pallas_mode = _os.environ.get("FILODB_PALLAS", "auto")

@@ -193,21 +193,32 @@ class JitterWindowMatrices:
         self.d_edge_idx = put(self.edge_idx)
 
 
-def jitter_window_matrices(block: StagedBlock, start_off: int, step_ms: int,
-                           num_steps: int, window_ms: int) -> JitterWindowMatrices:
-    cache = getattr(block, "_jwm_cache", None)
+def _cached_window_matrices(block, cache_attr: str, nominal_ts, n_valid: int,
+                            maxdev_ms: int, start_off: int, step_ms: int,
+                            num_steps: int, window_ms: int) -> JitterWindowMatrices:
+    """One per-block memoization discipline for both the aligned-jitter and
+    masked grid sources (keyed on the query window parameters)."""
+    cache = getattr(block, cache_attr, None)
     if cache is None:
         cache = {}
-        setattr(block, "_jwm_cache", cache)
+        setattr(block, cache_attr, cache)
     key = (int(start_off), int(step_ms), int(num_steps), int(window_ms))
     wm = cache.get(key)
     if wm is None:
         wm = JitterWindowMatrices(
-            np.asarray(block.nominal_ts), int(np.asarray(block.lens)[0]),
-            block.maxdev_ms, start_off, step_ms, num_steps, window_ms,
+            np.asarray(nominal_ts), n_valid, maxdev_ms,
+            start_off, step_ms, num_steps, window_ms,
         )
         cache[key] = wm
     return wm
+
+
+def jitter_window_matrices(block: StagedBlock, start_off: int, step_ms: int,
+                           num_steps: int, window_ms: int) -> JitterWindowMatrices:
+    return _cached_window_matrices(
+        block, "_jwm_cache", block.nominal_ts, int(np.asarray(block.lens)[0]),
+        block.maxdev_ms, start_off, step_ms, num_steps, window_ms,
+    )
 
 
 # rows of SEL / idx, by name
@@ -444,6 +455,300 @@ def jitter_minmax(vals, dev, SEL, idx, tile_mask, edge_onehot, edge_valid,
     cnt = count0[None, :] + in_lo + in_hi
     r = r if is_min else -r
     return jnp.where(cnt > 0, r, jnp.nan)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("func", "is_counter", "is_delta", "fetch")
+)
+def jitter_masked_kernel(
+    func: str,
+    vals,  # [S, T] f32 slot-aligned, 0 at holes
+    dev,  # [S, T] f32 deviation from nominal, 0 at holes
+    raw,  # [S, T] f32 raw (counters; == vals otherwise)
+    valid,  # [S, T] f32 1.0 = real sample
+    cc,  # [S, T] f32 cumulative valid count
+    ffv, ffd, bfv, bfd, ff2v, ff2d, bfraw,  # [S, T] host-precomputed fills
+    W0,  # [T, J]
+    SEL,  # [T, 5J]
+    idx,  # [5, J] i32 or None
+    c0pos_g,  # [J] bool: grid-level certain range non-empty
+    has_klo, has_khi,  # [J] bool
+    F0_rel, L0_rel, Klo_rel, Khi_rel, blo_rel, ehi_rel,  # [J] f32
+    window_ms,
+    is_counter: bool = False,
+    is_delta: bool = False,
+    fetch: str = "auto",
+):
+    """Missing-scrape variant of jitter_range_kernel: per-slot validity masks
+    replace the equal-count assumption. Per-series window counts come from
+    the validity prefix sum (cc[chi-1] - cc[clo] + valid[clo], shared-index
+    fetches — no extra matmul), and first/last selections read the
+    host-precomputed forward/backward fills at SHARED slot indices — so a
+    dropped scrape costs a few fetches, not a fall to the general path.
+    Same window-semantics contract: PeriodicSamplesMapper.scala:256."""
+    from .mxu_kernels import use_gather_fetch
+
+    f32 = jnp.float32
+    nan = jnp.nan
+    S, T = vals.shape
+    J = W0.shape[1]
+    use_gather = use_gather_fetch(fetch, idx)
+
+    def sel(x, rows):
+        r = np.array(rows)
+        if use_gather:
+            g = jnp.take(x, idx[r].reshape(-1), axis=1)
+            return g.reshape(S, len(rows), J)
+        M = SEL.reshape(T, 5, J)[:, r, :].reshape(T, len(rows) * J)
+        a = jax.lax.dot(x, M, precision=jax.lax.Precision.HIGHEST)
+        return a.reshape(S, len(rows), J)
+
+    def mmW0(x):
+        return jax.lax.dot(x, W0, precision=jax.lax.Precision.HIGHEST)
+
+    dKlo, dKhi = (a for a in sel(dev, (_KLO, _KHI)).swapaxes(0, 1))
+    vaKlo, vaKhi = (a for a in sel(valid, (_KLO, _KHI)).swapaxes(0, 1))
+    in_lo = has_klo[None, :] & (dKlo > blo_rel[None, :]) & (vaKlo > 0)
+    in_hi = has_khi[None, :] & (dKhi <= ehi_rel[None, :]) & (vaKhi > 0)
+    # per-series certain-range sample count from the validity prefix sum:
+    # count over [clo, chi) = cc[chi-1] - cc[clo] + valid[clo]; the gather
+    # form reads clipped garbage where the grid's certain range is empty, so
+    # gate on the grid-level c0pos (the matmul's zero columns do the same)
+    ccF0, ccL0 = (a for a in sel(cc, (_F0, _L0)).swapaxes(0, 1))
+    vaF0 = sel(valid, (_F0,))[:, 0, :]
+    cnt0v = jnp.where(c0pos_g[None, :], ccL0 - ccF0 + vaF0, 0.0)
+    cnt = cnt0v + in_lo + in_hi
+    has = cnt > 0
+    c0pos = cnt0v > 0
+    c0ge2 = cnt0v >= 2
+    w_s = window_ms.astype(f32) * 1e-3
+
+    def w3(m1, a, m2, b_, c):
+        return jnp.where(m1, a, jnp.where(m2, b_, c))
+
+    def vlast(vL0f, vKlo, vKhi):
+        return w3(in_hi, vKhi, c0pos, vL0f, vKlo)
+
+    if func == "sum_over_time" or (is_delta and func in ("rate", "increase")):
+        vKlo, vKhi = (a for a in sel(vals, (_KLO, _KHI)).swapaxes(0, 1))
+        s = mmW0(vals) + jnp.where(in_lo, vKlo, 0.0) + jnp.where(in_hi, vKhi, 0.0)
+        if func == "rate":
+            s = s / w_s
+        return jnp.where(has, s, nan)
+    if func == "count_over_time":
+        return jnp.where(has, cnt, nan)
+    if func == "avg_over_time":
+        vKlo, vKhi = (a for a in sel(vals, (_KLO, _KHI)).swapaxes(0, 1))
+        s = mmW0(vals) + jnp.where(in_lo, vKlo, 0.0) + jnp.where(in_hi, vKhi, 0.0)
+        return jnp.where(has, s / jnp.maximum(cnt, 1.0), nan)
+    if func == "present_over_time":
+        return jnp.where(has, 1.0, nan)
+    if func == "absent_over_time":
+        return jnp.where(has, nan, 1.0)
+    if func in ("stddev_over_time", "stdvar_over_time", "z_score"):
+        vKlo, vKhi = (a for a in sel(vals, (_KLO, _KHI)).swapaxes(0, 1))
+        s = mmW0(vals) + jnp.where(in_lo, vKlo, 0.0) + jnp.where(in_hi, vKhi, 0.0)
+        s2 = (
+            mmW0(vals * vals)
+            + jnp.where(in_lo, vKlo * vKlo, 0.0)
+            + jnp.where(in_hi, vKhi * vKhi, 0.0)
+        )
+        c = jnp.maximum(cnt, 1.0)
+        mean = s / c
+        var = jnp.maximum(s2 / c - mean * mean, 0.0)
+        if func == "stdvar_over_time":
+            return jnp.where(has, var, nan)
+        sd = jnp.sqrt(var)
+        if func == "stddev_over_time":
+            return jnp.where(has, sd, nan)
+        ffvL0 = sel(ffv, (_L0,))[:, 0, :]
+        v_last = vlast(ffvL0, vKlo, vKhi)
+        return jnp.where(has, (v_last - mean) / jnp.maximum(sd, 1e-30), nan)
+    if func == "first_over_time":
+        vKlo, vKhi = (a for a in sel(vals, (_KLO, _KHI)).swapaxes(0, 1))
+        bfvF0 = sel(bfv, (_F0,))[:, 0, :]
+        return jnp.where(has, w3(in_lo, vKlo, c0pos, bfvF0, vKhi), nan)
+    if func in ("last", "last_over_time"):
+        vKlo, vKhi = (a for a in sel(vals, (_KLO, _KHI)).swapaxes(0, 1))
+        ffvL0 = sel(ffv, (_L0,))[:, 0, :]
+        return jnp.where(has, vlast(ffvL0, vKlo, vKhi), nan)
+    if func in ("rate", "increase", "delta"):
+        vKlo, vKhi = (a for a in sel(vals, (_KLO, _KHI)).swapaxes(0, 1))
+        bfvF0, bfdF0 = (a[:, 0, :] for a in (sel(bfv, (_F0,)), sel(bfd, (_F0,))))
+        ffvL0, ffdL0 = (a[:, 0, :] for a in (sel(ffv, (_L0,)), sel(ffd, (_L0,))))
+        v_first = w3(in_lo, vKlo, c0pos, bfvF0, vKhi)
+        v_last = vlast(ffvL0, vKlo, vKhi)
+        tf_rel = w3(in_lo, Klo_rel[None, :] + dKlo, c0pos,
+                    F0_rel[None, :] + bfdF0, Khi_rel[None, :] + dKhi)
+        tl_rel = w3(in_hi, Khi_rel[None, :] + dKhi, c0pos,
+                    L0_rel[None, :] + ffdL0, Klo_rel[None, :] + dKlo)
+        dlt = v_last - v_first
+        sampled = (tl_rel - tf_rel) * 1e-3
+        dur_start = tf_rel * 1e-3
+        dur_end = (window_ms.astype(f32) - tl_rel) * 1e-3
+        avg_dur = sampled / jnp.maximum(cnt - 1.0, 1.0)
+        thresh = avg_dur * 1.1
+        if is_counter and func != "delta":
+            rKlo, rKhi = (a for a in sel(raw, (_KLO, _KHI)).swapaxes(0, 1))
+            bfrawF0 = sel(bfraw, (_F0,))[:, 0, :]
+            v_first_raw = w3(in_lo, rKlo, c0pos, bfrawF0, rKhi)
+            dur_zero = jnp.where(
+                dlt > 0, sampled * (v_first_raw / jnp.maximum(dlt, 1e-30)), jnp.inf
+            )
+            ds = jnp.minimum(dur_start, jnp.where(v_first_raw >= 0, dur_zero, jnp.inf))
+        else:
+            ds = dur_start
+        ds = jnp.where(ds >= thresh, avg_dur / 2.0, ds)
+        de = jnp.where(dur_end >= thresh, avg_dur / 2.0, dur_end)
+        factor = (sampled + ds + de) / jnp.maximum(sampled, 1e-30)
+        res = dlt * factor
+        if func == "rate":
+            res = res / w_s
+        return jnp.where(cnt >= 2, res, nan)
+    if func in ("irate", "idelta"):
+        ok2 = cnt >= 2
+        vKlo, vKhi = (a for a in sel(vals, (_KLO, _KHI)).swapaxes(0, 1))
+        ffvL0 = sel(ffv, (_L0,))[:, 0, :]
+        v_last = vlast(ffvL0, vKlo, vKhi)
+        if func == "idelta" and is_counter and not is_delta:
+            # diff-encoded counters: the staged value AT the last in-window
+            # sample is already the f64-exact last-pair difference
+            return jnp.where(ok2, v_last, nan)
+        ffdL0 = sel(ffd, (_L0,))[:, 0, :]
+        ff2vL0 = sel(ff2v, (_L0,))[:, 0, :]
+        ff2dL0 = sel(ff2d, (_L0,))[:, 0, :]
+        tl_rel = w3(in_hi, Khi_rel[None, :] + dKhi, c0pos,
+                    L0_rel[None, :] + ffdL0, Klo_rel[None, :] + dKlo)
+        v_prev = jnp.where(
+            in_hi,
+            jnp.where(c0pos, ffvL0, vKlo),
+            jnp.where(c0ge2, ff2vL0, vKlo),
+        )
+        tp_rel = jnp.where(
+            in_hi,
+            jnp.where(c0pos, L0_rel[None, :] + ffdL0, Klo_rel[None, :] + dKlo),
+            jnp.where(c0ge2, L0_rel[None, :] + ff2dL0, Klo_rel[None, :] + dKlo),
+        )
+        dt_s = (tl_rel - tp_rel) * 1e-3
+        dv = v_last - v_prev
+        r = dv / jnp.maximum(dt_s, 1e-30) if func == "irate" else dv
+        return jnp.where(ok2, r, nan)
+    raise ValueError(f"masked jitter kernel does not support {func}")
+
+
+@functools.partial(jax.jit, static_argnames=("is_min", "fetch"))
+def jitter_masked_minmax(vals, dev, valid, cc, SEL, idx, tile_mask,
+                         edge_onehot, edge_valid, edge_idx, c0pos_g,
+                         has_klo, has_khi, blo_rel, ehi_rel,
+                         is_min: bool = True, fetch: str = "auto"):
+    """Missing-scrape min/max: validity-masked tile hierarchy + edge fetches
+    over the certain range, then the <=2 per-series boundary samples. Holes
+    carry the sentinel, so validity gating is automatic for value fetches."""
+    from .mxu_kernels import use_gather_fetch
+
+    S, T = vals.shape
+    Lt = _TILE
+    J = tile_mask.shape[0]
+    use_gather = use_gather_fetch(fetch, idx)
+    v = vals if is_min else -vals
+    sentinel = jnp.float32(3e38)
+    vm = jnp.where(valid > 0, v, sentinel)
+    tmin = vm.reshape(S, T // Lt, Lt).min(-1)
+    certain = jnp.where(tile_mask[None, :, :], tmin[:, None, :], sentinel).min(-1)
+    if use_gather and edge_idx is not None:
+        edges = jnp.take(vm, edge_idx.reshape(-1), axis=1)
+    else:
+        # matmul fetch reads 0 at holes, not the sentinel: re-mask with a
+        # fetched validity so holes can't contaminate the minimum
+        edges = jax.lax.dot(vm * jnp.where(valid > 0, 1.0, 0.0), edge_onehot,
+                            precision=jax.lax.Precision.HIGHEST)
+        eva = jax.lax.dot(valid, edge_onehot,
+                          precision=jax.lax.Precision.HIGHEST)
+        edges = jnp.where(eva > 0, edges, sentinel)
+    edges = edges.reshape(S, J, 2 * Lt)
+    edges = jnp.where(edge_valid[None, :, :], edges, sentinel).min(-1)
+    r = jnp.minimum(certain, edges)
+
+    def sel_rows(x, lo, hi):
+        if use_gather:
+            return jnp.take(x, idx[lo:hi].reshape(-1), axis=1).reshape(
+                S, hi - lo, J)
+        M = SEL.reshape(T, 5, J)[:, lo:hi, :].reshape(T, (hi - lo) * J)
+        return jax.lax.dot(
+            x, M, precision=jax.lax.Precision.HIGHEST
+        ).reshape(S, hi - lo, J)
+
+    def sel_kk(x):
+        return sel_rows(x, 3, 5)
+
+    D = sel_kk(dev)
+    dKlo, dKhi = D[:, 0, :], D[:, 1, :]
+    VA = sel_kk(valid)
+    vaKlo, vaKhi = VA[:, 0, :], VA[:, 1, :]
+    in_lo = has_klo[None, :] & (dKlo > blo_rel[None, :]) & (vaKlo > 0)
+    in_hi = has_khi[None, :] & (dKhi <= ehi_rel[None, :]) & (vaKhi > 0)
+    A = sel_kk(v)
+    vKlo, vKhi = A[:, 0, :], A[:, 1, :]
+    r = jnp.minimum(r, jnp.where(in_lo, vKlo, sentinel))
+    r = jnp.minimum(r, jnp.where(in_hi, vKhi, sentinel))
+    # per-series certain count via the validity prefix sum (see
+    # jitter_masked_kernel)
+    CF = sel_rows(cc, 0, 2)
+    vaF0 = sel_rows(valid, 0, 1)[:, 0, :]
+    cnt0v = jnp.where(
+        c0pos_g[None, :], CF[:, 1, :] - CF[:, 0, :] + vaF0, 0.0
+    )
+    cnt = cnt0v + in_lo + in_hi
+    r = r if is_min else -r
+    return jnp.where(cnt > 0, r, jnp.nan)
+
+
+def masked_window_matrices(block: StagedBlock, start_off: int, step_ms: int,
+                           num_steps: int, window_ms: int) -> JitterWindowMatrices:
+    g = block.mgrid
+    return _cached_window_matrices(
+        block, "_mwm_cache", g.nominal_ts, g.n_valid, g.maxdev_ms,
+        start_off, step_ms, num_steps, window_ms,
+    )
+
+
+def run_masked_jitter_range_function(func, block: StagedBlock, params,
+                                     is_counter=False, is_delta=False,
+                                     args=()):
+    """Entry: dispatch one missing-scrape range function over block.mgrid.
+    Returns a device array [S, J_padded], or None when this (window, grid)
+    combination can't use the masked path (caller falls back)."""
+    from .kernels import pad_steps
+    from .mxu_kernels import fetch_strategy
+
+    g = block.mgrid
+    J = pad_steps(params.num_steps)
+    start_off = int(params.start_ms - block.base_ms)
+    wm = masked_window_matrices(block, start_off, params.step_ms, J,
+                                params.window_ms)
+    if not wm.ok:
+        return None
+    fetch = fetch_strategy()
+    if func in ("min_over_time", "max_over_time"):
+        return jitter_masked_minmax(
+            g.vals, g.dev, g.valid, g.cc, wm.d_SEL, wm.d_idx,
+            wm.d_tile_mask, wm.d_edge_onehot, wm.d_edge_valid, wm.d_edge_idx,
+            wm.d_c0pos, wm.d_has_klo, wm.d_has_khi, wm.d_blo_rel,
+            wm.d_ehi_rel,
+            is_min=(func == "min_over_time"), fetch=fetch,
+        )
+    raw = g.raw if g.raw is not None else g.vals
+    bfraw = g.bfraw if g.bfraw is not None else g.bfv
+    return jitter_masked_kernel(
+        func, g.vals, g.dev, raw, g.valid, g.cc,
+        g.ffv, g.ffd, g.bfv, g.bfd, g.ff2v, g.ff2d, bfraw,
+        wm.d_W0, wm.d_SEL, wm.d_idx,
+        wm.d_c0pos, wm.d_has_klo, wm.d_has_khi,
+        wm.d_F0_rel, wm.d_L0_rel, wm.d_Klo_rel, wm.d_Khi_rel,
+        wm.d_blo_rel, wm.d_ehi_rel,
+        np.float32(params.window_ms),
+        is_counter=is_counter, is_delta=is_delta, fetch=fetch,
+    )
 
 
 def run_jitter_range_function(func, block: StagedBlock, params,

@@ -47,6 +47,283 @@ def pad_time(t: int) -> int:
 
 TS_PAD = np.int32(2**31 - 1)  # padded slots sort after every real timestamp
 
+# masked (missing-scrape) grid detection: tolerate up to this fraction of
+# holes before dropping to the general gather path
+MAX_HOLE_FRAC = 0.05
+
+
+@dataclass
+class MaskedGrid:
+    """Slot-aligned sidecar for near-regular data with MISSED scrapes.
+
+    The packed block arrays stay canonical (the general kernels and every
+    other consumer read those); this sidecar maps each sample to its nominal
+    slot and carries per-slot validity plus host-precomputed forward/backward
+    fills, so the masked jitter kernel (ops/mxu_jitter.jitter_masked_kernel)
+    can evaluate first/last/rate with shared-index fetches instead of
+    per-series scans. All [S, T] f32; holes carry 0. Fill semantics:
+
+    - ffv/ffd: value / time-offset of the LAST valid slot <= t
+      (ffd = R[t'] - R[t] + dev[s, t'], small by construction)
+    - bfv/bfd: value / time-offset of the FIRST valid slot >= t
+    - ff2v/ff2d: value / time-offset of the SECOND-TO-LAST valid slot <= t
+    - bfraw: backward fill of raw values (counter extrapolation cap only)
+
+    Window-semantics contract: reference PeriodicSamplesMapper.scala:256 —
+    the same windows the reference's iterators produce over data with gaps.
+    """
+
+    nominal_ts: np.ndarray  # [T] int32 ms offsets of the slot grid
+    n_valid: int  # real slot count (grid width; <= T)
+    interval_ms: float  # refined nominal interval (grid = t0 + k*interval)
+    maxdev_ms: int
+    valid: np.ndarray  # [S, T] f32 1.0 = real sample
+    vals: np.ndarray  # [S, T] f32 transformed values, 0 at holes
+    dev: np.ndarray  # [S, T] f32 ts deviation from nominal, 0 at holes
+    raw: np.ndarray | None  # [S, T] f32 raw values (counters), 0 at holes
+    ffv: np.ndarray
+    ffd: np.ndarray
+    bfv: np.ndarray
+    bfd: np.ndarray
+    ff2v: np.ndarray
+    ff2d: np.ndarray
+    bfraw: np.ndarray | None
+    # cumulative valid count (prefix sum): per-series window counts become
+    # two shared-index fetches instead of a [S,T]x[T,J] matmul
+    cc: np.ndarray | None = None
+
+    def to_device(self):
+        import jax
+
+        for f in ("valid", "vals", "dev", "raw", "ffv", "ffd", "bfv", "bfd",
+                  "ff2v", "ff2d", "bfraw", "cc"):
+            a = getattr(self, f)
+            if a is not None:
+                setattr(self, f, jax.device_put(a))
+        return self
+
+
+def _snap_slots(cleaned) -> tuple[float, float, list] | None:
+    """Estimate a shared nominal grid for series with missed scrapes.
+
+    Returns (interval_ms, t0_ms, [per-series slot indices]) or None when the
+    data isn't near-regular-with-holes. Holes make per-series sample counts
+    differ, so the equal-count detection above can't see these blocks."""
+    if not cleaned or any(len(ts) < 2 for ts, _ in cleaned):
+        return None
+    ref = max((ts for ts, _ in cleaned), key=len)
+    d = np.diff(ref)
+    if not len(d) or (d <= 0).any():
+        return None
+    est = float(np.median(d))
+    if est <= 0:
+        return None
+    k = np.rint(d / est)
+    if (k < 1).any():
+        return None
+    # least-squares interval refinement over the reference series
+    interval = float(d.sum()) / float(k.sum())
+    if interval <= 0:
+        return None
+    t0 = float(ref[0])
+    ks = []
+    for ts, _ in cleaned:
+        ki = np.rint((ts.astype(np.float64) - t0) / interval).astype(np.int64)
+        if len(ki) > 1 and (np.diff(ki) < 1).any():
+            return None  # two samples snapped to one slot: not this grid
+        ks.append(ki)
+    return interval, t0, ks
+
+
+def masked_fills(valid, m_vals, m_dev, m_raw, R):
+    """Host-precomputed forward/backward fills over slot-aligned masked
+    arrays (the MaskedGrid fill semantics); R is the full-length int64
+    nominal offset vector. Returns (ffv, ffd, bfv, bfd, ff2v, ff2d, bfraw)."""
+    T = valid.shape[1]
+    V = valid > 0
+    tind = np.arange(T)
+
+    def gather(a, idx):
+        return np.take_along_axis(a, np.clip(idx, 0, T - 1), axis=1)
+
+    ffi = np.maximum.accumulate(np.where(V, tind[None, :], -1), axis=1)
+    rev = np.maximum.accumulate(np.where(V[:, ::-1], tind[None, :], -1), axis=1)
+    bfi = np.where(rev[:, ::-1] >= 0, T - 1 - rev[:, ::-1], T)
+    ff2i = np.where(ffi >= 1, gather(ffi, ffi - 1), -1)
+    Rf = R.astype(np.float64)
+
+    def fill(vsrc, idx):
+        ok = (idx >= 0) & (idx < T)
+        v = np.where(ok, gather(vsrc, idx), 0.0).astype(np.float32)
+        dd = np.where(
+            ok,
+            (Rf[np.clip(idx, 0, T - 1)] - Rf[tind[None, :]])
+            + gather(m_dev, idx),
+            0.0,
+        ).astype(np.float32)
+        return v, dd
+
+    ffv, ffd = fill(m_vals, ffi)
+    bfv, bfd = fill(m_vals, bfi)
+    ff2v, ff2d = fill(m_vals, ff2i)
+    bfraw = fill(m_raw, bfi)[0] if m_raw is not None else None
+    return ffv, ffd, bfv, bfd, ff2v, ff2d, bfraw
+
+
+def _build_masked_grid(cleaned, base_ms, out_vals, out_raw, lens,
+                       T: int, S: int, grid=None) -> MaskedGrid | None:
+    """Slot-align already-transformed packed values onto a shared nominal
+    grid with validity holes; returns None when the bound or hole-fraction
+    checks fail. ``grid`` forces a (interval_ms, t0_abs_ms) pair — the
+    harmonize path uses it to put every shard on ONE common grid (slot 0 at
+    t0; per-block widths may differ, validity masks absorb the difference).
+    """
+    if grid is None:
+        snap = _snap_slots(cleaned)
+        if snap is None:
+            return None
+        interval, t0, ks = snap
+        kmin = min(int(k[0]) for k in ks)
+    else:
+        interval, t0 = grid
+        ks = []
+        for ts, _ in cleaned:
+            ki = np.rint((ts.astype(np.float64) - t0) / interval).astype(np.int64)
+            if (ki < 0).any() or (len(ki) > 1 and (np.diff(ki) < 1).any()):
+                return None
+            ks.append(ki)
+        kmin = 0
+    kmax = max(int(k[-1]) for k in ks)
+    width = kmax - kmin + 1
+    # holes stretch the slot span beyond the packed sample width, so the
+    # sidecar sizes itself by SLOT count (may exceed the packed block's T —
+    # the masked kernel touches only sidecar arrays)
+    T = max(T, pad_time(width))
+    total = sum(len(k) for k in ks)
+    if grid is None and total < len(ks) * width * (1.0 - MAX_HOLE_FRAC):
+        return None
+    # nominal slot times as exact ints; deviations measured against them
+    nom_abs = np.rint(t0 + (kmin + np.arange(T, dtype=np.float64)) * interval
+                      ).astype(np.int64)
+    md = 0
+    valid = np.zeros((S, T), dtype=np.float32)
+    m_vals = np.zeros((S, T), dtype=np.float32)
+    m_dev = np.zeros((S, T), dtype=np.float32)
+    m_raw = np.zeros((S, T), dtype=np.float32) if out_raw is not None else None
+    for i, ((ts, _), ki) in enumerate(zip(cleaned, ks)):
+        slots = (ki - kmin).astype(np.int64)
+        dv = ts - nom_abs[slots]
+        md = max(md, int(np.abs(dv).max()))
+        valid[i, slots] = 1.0
+        m_vals[i, slots] = out_vals[i, : lens[i]]
+        m_dev[i, slots] = dv.astype(np.float32)
+        if m_raw is not None:
+            m_raw[i, slots] = out_raw[i, : lens[i]]
+    if 2 * md >= interval:
+        return None  # same safety bound as the aligned jitter path
+    R = (nom_abs - base_ms).astype(np.int64)
+    if R.max() > 2**31 - 2 or R.min() < -(2**31):
+        return None
+    ffv, ffd, bfv, bfd, ff2v, ff2d, bfraw = masked_fills(
+        valid, m_vals, m_dev, m_raw, R
+    )
+    nominal = np.full(T, TS_PAD, dtype=np.int32)
+    nominal[:width] = R[:width].astype(np.int32)
+    return MaskedGrid(
+        nominal_ts=nominal, n_valid=width, interval_ms=float(interval),
+        maxdev_ms=md, valid=valid, vals=m_vals, dev=m_dev, raw=m_raw,
+        ffv=ffv, ffd=ffd, bfv=bfv, bfd=bfd, ff2v=ff2v, ff2d=ff2d,
+        bfraw=bfraw, cc=np.cumsum(valid, axis=1, dtype=np.float64
+                                  ).astype(np.float32),
+    )
+
+
+def harmonize_masked(blocks) -> bool:
+    """Rewrite per-shard masked (missing-scrape) grids onto ONE common
+    nominal grid so the mesh kernel can share a single window structure
+    (parallel/exec.py). Per-shard staging snapped each block to its own
+    anchor; the common grid takes the earliest anchor and the mean interval,
+    and every block's sidecar is rebuilt against it from the packed arrays.
+    Per-block widths may differ — validity masks make shorter blocks exact.
+    Returns False (blocks untouched) when grids can't be reconciled."""
+    real = [b for b in blocks if b.n_series > 0]
+    if not real:
+        return False
+    if len({b.base_ms for b in real}) != 1:
+        return False
+    base = real[0].base_ms
+    ints, anchors = [], []
+    for b in real:
+        # grid evidence per block: a masked grid, OR a (possibly trivially)
+        # regular/near-regular grid — e.g. a single-series shard stages as
+        # "regular" even when it has holes, but still snaps onto the common
+        # grid below
+        if b.mgrid is not None:
+            src = np.asarray(b.mgrid.nominal_ts)[: b.mgrid.n_valid]
+        elif b.regular_ts is not None or b.nominal_ts is not None:
+            m = int(np.asarray(b.lens)[0])
+            grid = b.regular_ts if b.regular_ts is not None else b.nominal_ts
+            src = np.asarray(grid)[:m]
+        else:
+            return False
+        src = src.astype(np.int64)
+        if len(src) < 2:
+            return False
+        d = np.diff(src)
+        if (d <= 0).any():
+            return False
+        est = float(np.median(d))
+        k = np.rint(d / est)
+        if est <= 0 or (k < 1).any():
+            return False
+        ints.append(float(d.sum()) / float(k.sum()))
+        anchors.append(int(src[0]))
+    interval = float(np.mean(ints))
+    if interval <= 0 or max(
+        abs(x - interval) for x in ints
+    ) > 0.01 * interval:
+        return False
+    t0_abs = float(min(anchors) + base)
+    rebuilt = []
+    for b in real:
+        n = b.n_series
+        ts_np = np.asarray(b.ts)
+        lens = np.asarray(b.lens)
+        cleaned = [
+            (ts_np[i, : lens[i]].astype(np.int64) + base, None)
+            for i in range(n)
+        ]
+        mg = _build_masked_grid(
+            cleaned, base, np.asarray(b.vals),
+            np.asarray(b.raw) if b.raw is not None else None,
+            lens, b.ts.shape[1], b.vals.shape[0], grid=(interval, t0_abs),
+        )
+        if mg is None:
+            return False
+        rebuilt.append(mg)
+    md = max(mg.maxdev_ms for mg in rebuilt)
+    if 2 * md >= interval:
+        return False
+    width = max(mg.n_valid for mg in rebuilt)
+    if any(width > mg.valid.shape[1] for mg in rebuilt):
+        return False  # a block can't advertise slots its sidecar can't hold
+    for b, mg in zip(real, rebuilt):
+        # unify the advertised grid: same width everywhere (validity masks
+        # cover slots a block has no samples for), same maxdev bound
+        T = len(mg.nominal_ts)
+        R = np.rint(
+            (t0_abs - base) + np.arange(T, dtype=np.float64) * interval
+        ).astype(np.int64)
+        nominal = np.full(T, TS_PAD, dtype=np.int32)
+        nominal[:width] = R[:width].astype(np.int32)
+        mg.nominal_ts = nominal
+        mg.n_valid = width
+        mg.maxdev_ms = md
+        b.mgrid = mg
+        if hasattr(b, "_mwm_cache"):
+            del b._mwm_cache
+    return True
+
 
 @dataclass
 class StagedBlock:
@@ -74,6 +351,10 @@ class StagedBlock:
     nominal_ts: np.ndarray | None = None  # [T] int32 shared nominal offsets
     ts_dev: np.ndarray | None = None  # [S, T] f32 per-sample deviation (ms)
     maxdev_ms: int = 0  # bound on |ts - nominal|; < half min nominal interval
+    # missing-scrape fast path: near-regular grid with HOLES (a dropped
+    # scrape breaks the equal-count detection above). Slot-aligned masked
+    # sidecar; packed arrays above stay canonical. See MaskedGrid.
+    mgrid: "MaskedGrid | None" = None
 
     @property
     def shape(self):
@@ -92,6 +373,8 @@ class StagedBlock:
             self.raw = jax.device_put(self.raw)
         if self.ts_dev is not None:
             self.ts_dev = jax.device_put(self.ts_dev)
+        if self.mgrid is not None:
+            self.mgrid.to_device()
         return self
 
 
@@ -168,6 +451,7 @@ def stage_series(
     nominal = None
     ts_dev = None
     maxdev = 0
+    mgrid = None
     if n > 0 and (lens[:n] == lens[0]).all() and lens[0] > 0:
         if not (out_ts[:n] != out_ts[0]).any():
             regular = out_ts[0]
@@ -189,10 +473,16 @@ def stage_series(
                 ts_dev = np.zeros((S, T), dtype=np.float32)
                 ts_dev[:n, :m] = dev.astype(np.float32)
                 maxdev = md
+    if n > 1 and regular is None and nominal is None:
+        # unequal counts (or equal counts on misaligned slots): try the
+        # missing-scrape masked grid before resigning to the general path
+        mgrid = _build_masked_grid(
+            cleaned[:n], base_ms, out_vals, out_raw, lens, T, S
+        )
     return StagedBlock(
         out_ts, out_vals, lens, base_ms, baseline, n, part_refs or [],
         raw=out_raw, regular_ts=regular, nominal_ts=nominal, ts_dev=ts_dev,
-        maxdev_ms=maxdev,
+        maxdev_ms=maxdev, mgrid=mgrid,
     )
 
 

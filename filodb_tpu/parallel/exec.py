@@ -26,6 +26,41 @@ from . import mesh as M
 _WM_CACHE: dict = {}
 _WM_LOCK = threading.Lock()
 
+
+def _get_wm(wm_key, ctor):
+    """Get-or-create a device-resident window-matrices object in the shared
+    bounded cache (one lock/eviction discipline for every mesh fast path)."""
+    with _WM_LOCK:
+        wm = _WM_CACHE.get(wm_key)
+    if wm is None:
+        wm = ctor()
+        with _WM_LOCK:
+            while len(_WM_CACHE) >= 16:
+                _WM_CACHE.pop(next(iter(_WM_CACHE)), None)
+            _WM_CACHE[wm_key] = wm
+    return wm
+
+
+def _harmonized_masked_grid(nb):
+    """The masked mesh kernel applies one block's window structure to every
+    shard's rows — sound only when harmonize_masked succeeded. Re-verify
+    from the blocks (the stage cache doesn't record the harmonize result):
+    returns the common MaskedGrid descriptor, or None."""
+    if not nb or any(b.mgrid is None for b in nb):
+        return None
+    g0 = nb[0].mgrid
+    nom0 = np.asarray(g0.nominal_ts)[: g0.n_valid]
+    for b in nb:
+        g = b.mgrid
+        if (
+            g.maxdev_ms != g0.maxdev_ms
+            or g.n_valid != g0.n_valid
+            or len(np.asarray(g.nominal_ts)) < g0.n_valid
+            or (np.asarray(g.nominal_ts)[: g0.n_valid] != nom0).any()
+        ):
+            return None
+    return g0
+
 MESH_OPS = {"sum", "count", "avg", "min", "max"}
 
 
@@ -109,7 +144,10 @@ class MeshAggregateExec(ExecPlan):
             and not (b.regular_ts != r0).any() for b in blocks[1:]
         )
         if not all_exact:
-            ST.harmonize_nominal(blocks)
+            if not ST.harmonize_nominal(blocks):
+                # unequal counts (a dropped scrape somewhere): try the
+                # missing-scrape masked common grid instead
+                ST.harmonize_masked(blocks)
         gids_all, group_labels = AGG.group_ids_for(
             all_labels, list(self.by) if self.by else None,
             list(self.without) if self.without else None,
@@ -141,6 +179,7 @@ class MeshAggregateExec(ExecPlan):
         )
         sharded = M.shard_arrays(self.mesh, *arrays[:6])  # pin the stack in HBM
         dev_sh = None
+        msk_sh = None
         if jittered:
             import jax
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -148,7 +187,22 @@ class MeshAggregateExec(ExecPlan):
             dev_sh = jax.device_put(
                 arrays[6], NamedSharding(self.mesh, P("shard", None))
             )
-        result = (sharded, group_labels, blocks, dev_sh)
+        if self.function in self._MXU_MESH_FUNCS and (
+            _harmonized_masked_grid(nb) is not None
+        ):
+            # missing-scrape masked path (harmonized in _staged_blocks):
+            # stack + pin the slot-aligned sidecars — only when the grid
+            # identity check the kernel needs actually holds, so a failed
+            # harmonize never pays for 12 stacked arrays it can't use
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            row = NamedSharding(self.mesh, P("shard", None))
+            msk_sh = tuple(
+                jax.device_put(a, row)
+                for a in M.stack_masked_for_mesh(blocks, self.mesh.devices.size)
+            )
+        result = (sharded, group_labels, blocks, dev_sh, msk_sh)
         if len(cache) >= 8:
             cache.pop(next(iter(cache)))
         cache[key] = result
@@ -158,12 +212,12 @@ class MeshAggregateExec(ExecPlan):
         staged = self._stage_all(ctx)
         if staged is None:
             return QueryResult()
-        sharded, group_labels, blocks, dev_sh = staged
+        sharded, group_labels, blocks, dev_sh, msk_sh = staged
         num_steps = int((self.end_ms - self.start_ms) // self.step_ms) + 1
         j_pad = K.pad_steps(num_steps)
         base = blocks[0].base_ms
         out = self._run_mxu(blocks, sharded, j_pad, base, len(group_labels),
-                            dev_sh=dev_sh)
+                            dev_sh=dev_sh, msk_sh=msk_sh)
         if out is None:
             out = M.distributed_agg_range(
                 self.mesh, self.function, self.op, *sharded,
@@ -182,11 +236,13 @@ class MeshAggregateExec(ExecPlan):
         "z_score", "rate", "increase", "delta", "idelta", "irate",
     }
 
-    def _run_mxu(self, blocks, arrays, j_pad, base, num_groups, dev_sh=None):
+    def _run_mxu(self, blocks, arrays, j_pad, base, num_groups, dev_sh=None,
+                 msk_sh=None):
         """Shared-scrape-grid fast path: MXU matmul kernel inside shard_map
         (single compiled call even when many shards pack one device). Falls
         through to the jittered-grid MXU path when the grids are only
-        NEAR-regular (ops/mxu_jitter.py)."""
+        NEAR-regular (ops/mxu_jitter.py), then to the masked missing-scrape
+        path when scrapes were dropped."""
         if self.function not in self._MXU_MESH_FUNCS:
             return None
         r0 = blocks[0].regular_ts
@@ -194,7 +250,11 @@ class MeshAggregateExec(ExecPlan):
             b.regular_ts is None or len(b.regular_ts) != len(r0)
             or (b.regular_ts != r0).any() for b in blocks[1:]
         ):
-            return self._run_jitter(blocks, arrays, j_pad, base, num_groups, dev_sh)
+            out = self._run_jitter(blocks, arrays, j_pad, base, num_groups, dev_sh)
+            if out is None:
+                out = self._run_masked(blocks, arrays, j_pad, base, num_groups,
+                                       msk_sh)
+            return out
         from ..ops.mxu_kernels import WindowMatrices
 
         ts, vals, lens, baseline, raw, gids = arrays
@@ -204,16 +264,10 @@ class MeshAggregateExec(ExecPlan):
         # precompute + ~16 device_puts (dashboards repeat identical queries)
         wm_key = (r0.tobytes(), n_valid, self.start_ms - base, self.step_ms,
                   j_pad, self.window_ms)
-        with _WM_LOCK:
-            wm = _WM_CACHE.get(wm_key)
-        if wm is None:
-            wm = WindowMatrices(
-                r0, n_valid, self.start_ms - base, self.step_ms, j_pad, self.window_ms
-            )
-            with _WM_LOCK:
-                while len(_WM_CACHE) >= 16:
-                    _WM_CACHE.pop(next(iter(_WM_CACHE)), None)
-                _WM_CACHE[wm_key] = wm
+        wm = _get_wm(wm_key, lambda: WindowMatrices(
+            r0, n_valid, self.start_ms - base, self.step_ms, j_pad,
+            self.window_ms,
+        ))
         return M.distributed_agg_range_mxu(
             self.mesh, self.function, self.op,
             vals, raw, lens, baseline, gids,
@@ -221,6 +275,54 @@ class MeshAggregateExec(ExecPlan):
             wm.d_count, wm.d_tf, wm.d_tl, wm.d_tl2, wm.d_out_t,
             np.float32(self.window_ms), num_groups,
             is_counter=self.is_counter, is_delta=self.is_delta,
+        )
+
+    def _run_masked(self, blocks, arrays, j_pad, base, num_groups, msk_sh):
+        """Missing-scrape grids: one shared window structure on the
+        harmonized common nominal grid + the masked jitter kernel inside
+        shard_map (validity masks absorb per-shard holes and width
+        differences)."""
+        if msk_sh is None:
+            return None
+        if self.is_delta and self.function in ("irate", "idelta"):
+            return None
+        g0 = _harmonized_masked_grid([b for b in blocks if b.n_series > 0])
+        if g0 is None:
+            return None
+        from ..ops.mxu_jitter import JitterWindowMatrices
+        from ..ops.mxu_kernels import fetch_strategy
+        from ..ops.staging import TS_PAD
+
+        ts, vals, lens, baseline, raw, gids = arrays
+        (m_vals, m_dev, m_raw, valid, cc, ffv, ffd, bfv, bfd, ff2v, ff2d,
+         bfraw) = msk_sh
+        # sidecar slot width rules the window matrices (holes can stretch
+        # the slot span beyond the packed T)
+        T_stack = m_vals.shape[1]
+        nominal = np.full(T_stack, TS_PAD, dtype=np.int32)
+        nominal[: g0.n_valid] = np.asarray(g0.nominal_ts)[: g0.n_valid]
+        wm_key = (
+            "msk", nominal.tobytes(), g0.n_valid, g0.maxdev_ms,
+            self.start_ms - base, self.step_ms, j_pad, self.window_ms,
+        )
+        wm = _get_wm(wm_key, lambda: JitterWindowMatrices(
+            nominal, g0.n_valid, g0.maxdev_ms,
+            self.start_ms - base, self.step_ms, j_pad, self.window_ms,
+        ))
+        if not wm.ok:
+            return None
+        return M.distributed_agg_range_masked(
+            self.mesh, self.function, self.op,
+            m_vals, m_dev, m_raw, valid, cc,
+            ffv, ffd, bfv, bfd, ff2v, ff2d, bfraw,
+            lens, gids,
+            wm.d_W0, wm.d_SEL, wm.d_idx,
+            wm.d_c0pos, wm.d_has_klo, wm.d_has_khi,
+            wm.d_F0_rel, wm.d_L0_rel, wm.d_Klo_rel, wm.d_Khi_rel,
+            wm.d_blo_rel, wm.d_ehi_rel,
+            np.float32(self.window_ms), num_groups,
+            is_counter=self.is_counter, is_delta=self.is_delta,
+            fetch=fetch_strategy(),
         )
 
     def _run_jitter(self, blocks, arrays, j_pad, base, num_groups, dev_sh):
@@ -263,17 +365,10 @@ class MeshAggregateExec(ExecPlan):
             "jit", nominal.tobytes(), n_valid, b0.maxdev_ms,
             self.start_ms - base, self.step_ms, j_pad, self.window_ms,
         )
-        with _WM_LOCK:
-            wm = _WM_CACHE.get(wm_key)
-        if wm is None:
-            wm = JitterWindowMatrices(
-                nominal, n_valid, b0.maxdev_ms,
-                self.start_ms - base, self.step_ms, j_pad, self.window_ms,
-            )
-            with _WM_LOCK:
-                while len(_WM_CACHE) >= 16:
-                    _WM_CACHE.pop(next(iter(_WM_CACHE)), None)
-                _WM_CACHE[wm_key] = wm
+        wm = _get_wm(wm_key, lambda: JitterWindowMatrices(
+            nominal, n_valid, b0.maxdev_ms,
+            self.start_ms - base, self.step_ms, j_pad, self.window_ms,
+        ))
         if not wm.ok:
             return None
         from ..ops.mxu_kernels import fetch_strategy
@@ -400,7 +495,7 @@ class MeshQuantileExec(MeshAggregateExec):
         staged = self._stage_all(ctx)
         if staged is None:
             return QueryResult()
-        sharded, group_labels, blocks, _dev_sh = staged
+        sharded, group_labels, blocks, _dev_sh, _msk_sh = staged
         num_steps = int((self.end_ms - self.start_ms) // self.step_ms) + 1
         j_pad = K.pad_steps(num_steps)
         base = blocks[0].base_ms

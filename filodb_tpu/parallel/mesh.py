@@ -156,6 +156,57 @@ def distributed_agg_range_jitter(
 
 @functools.partial(
     jax.jit,
+    static_argnames=(
+        "mesh", "func", "op", "num_groups", "is_counter", "is_delta", "fetch"
+    ),
+)
+def distributed_agg_range_masked(
+    mesh: Mesh,
+    func: str,
+    op: str,
+    vals, dev, raw, valid, cc,  # [D*S, T] sharded slot-aligned masked arrays
+    ffv, ffd, bfv, bfd, ff2v, ff2d, bfraw,  # [D*S, T] sharded fills
+    lens, gids,  # [D*S]
+    W0, SEL, idx,  # replicated window structure (mxu_jitter)
+    c0pos_g, has_klo, has_khi,  # [J] replicated
+    F0_rel, L0_rel, Klo_rel, Khi_rel, blo_rel, ehi_rel,  # [J]
+    window_ms,
+    num_groups: int,
+    is_counter: bool = False,
+    is_delta: bool = False,
+    fetch: str = "auto",
+):
+    """Missing-scrape mesh aggregation: the masked jitter kernel
+    (ops/mxu_jitter.jitter_masked_kernel) inside shard_map, so a dropped
+    scrape keeps multi-shard queries on the single-program MXU path."""
+    from ..ops.mxu_jitter import jitter_masked_kernel
+
+    def local(vals_l, dev_l, raw_l, valid_l, cc_l, ffv_l, ffd_l, bfv_l,
+              bfd_l, ff2v_l, ff2d_l, bfraw_l, lens_l, gids_l):
+        grid = jitter_masked_kernel(
+            func, vals_l, dev_l, raw_l, valid_l, cc_l,
+            ffv_l, ffd_l, bfv_l, bfd_l, ff2v_l, ff2d_l, bfraw_l,
+            W0, SEL, idx, c0pos_g, has_klo, has_khi,
+            F0_rel, L0_rel, Klo_rel, Khi_rel, blo_rel, ehi_rel,
+            window_ms, is_counter=is_counter, is_delta=is_delta, fetch=fetch,
+        )
+        grid = jnp.where((lens_l > 0)[:, None], grid, jnp.nan)
+        return _segment_psum(op, grid, gids_l, num_groups)
+
+    shard = P("shard")
+    row = P("shard", None)
+    return jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(row,) * 12 + (shard, shard),
+        out_specs=P(),
+        check_vma=False,
+    )(vals, dev, raw, valid, cc, ffv, ffd, bfv, bfd, ff2v, ff2d, bfraw,
+      lens, gids)
+
+
+@functools.partial(
+    jax.jit,
     static_argnames=("mesh", "func", "op", "num_steps", "num_groups", "is_counter", "is_delta"),
 )
 def distributed_agg_range(
@@ -201,6 +252,64 @@ def distributed_agg_range(
     )(ts, vals, lens, baseline, raw, gids)
 
 
+def _mesh_layout(blocks: list[StagedBlock], n_devices: int):
+    """Shared row layout for every mesh stacker: round-robin blocks over
+    devices, one padded row band per device."""
+    D = n_devices
+    T = max(b.ts.shape[1] for b in blocks)
+    per_dev: list[list[int]] = [[] for _ in range(D)]
+    for i in range(len(blocks)):
+        per_dev[i % D].append(i)
+    S_dev = pad_series(max(1, max(
+        sum(blocks[i].n_series for i in idxs) for idxs in per_dev
+    )))
+    return per_dev, S_dev, T
+
+
+def stack_masked_for_mesh(blocks: list[StagedBlock], n_devices: int):
+    """Stack the MaskedGrid sidecars (missing-scrape mesh path) using the
+    SAME row layout as stack_blocks_for_mesh, recomputing the fills over the
+    stacked width so padding columns carry correct forward/backward fills.
+    Caller guarantees every non-empty block has a harmonized mgrid.
+    Returns (vals, dev, raw, valid, cc, ffv, ffd, bfv, bfd, ff2v, ff2d,
+    bfraw), all [D*S, T] f32."""
+    from ..ops.staging import masked_fills
+
+    per_dev, S_dev, _ = _mesh_layout(blocks, n_devices)
+    # masked sidecars size by SLOT span, which can exceed the packed T
+    T = max(b.mgrid.valid.shape[1] for b in blocks if b.mgrid is not None)
+    D = n_devices
+    N = D * S_dev
+    vals = np.zeros((N, T), dtype=np.float32)
+    dev = np.zeros((N, T), dtype=np.float32)
+    raw = np.zeros((N, T), dtype=np.float32)
+    valid = np.zeros((N, T), dtype=np.float32)
+    g0 = next(b.mgrid for b in blocks if b.n_series > 0)
+    interval = g0.interval_ms
+    R0 = int(np.asarray(g0.nominal_ts)[0])
+    R = np.rint(R0 + np.arange(T, dtype=np.float64) * interval).astype(np.int64)
+    for d, idxs in enumerate(per_dev):
+        o = d * S_dev
+        for i in idxs:
+            b = blocks[i]
+            k = b.n_series
+            if k == 0:
+                continue
+            g = b.mgrid
+            t = g.valid.shape[1]
+            valid[o : o + k, :t] = np.asarray(g.valid)[:k]
+            vals[o : o + k, :t] = np.asarray(g.vals)[:k]
+            dev[o : o + k, :t] = np.asarray(g.dev)[:k]
+            raw_src = g.raw if g.raw is not None else g.vals
+            raw[o : o + k, :t] = np.asarray(raw_src)[:k]
+            o += k
+    ffv, ffd, bfv, bfd, ff2v, ff2d, bfraw = masked_fills(
+        valid, vals, dev, raw, R
+    )
+    cc = np.cumsum(valid, axis=1, dtype=np.float64).astype(np.float32)
+    return vals, dev, raw, valid, cc, ffv, ffd, bfv, bfd, ff2v, ff2d, bfraw
+
+
 def stack_blocks_for_mesh(blocks: list[StagedBlock], gids_per_block: list[np.ndarray], n_devices: int,
                           with_dev: bool = False):
     """Concatenate per-shard staged blocks into mesh-shardable arrays.
@@ -211,13 +320,7 @@ def stack_blocks_for_mesh(blocks: list[StagedBlock], gids_per_block: list[np.nda
     With ``with_dev``, also returns the stacked [D*S, T] timestamp-deviation
     matrix for the jittered-grid mesh path (zeros where a block has none)."""
     D = n_devices
-    T = max(b.ts.shape[1] for b in blocks)
-    per_dev: list[list[int]] = [[] for _ in range(D)]
-    for i in range(len(blocks)):
-        per_dev[i % D].append(i)
-    S_dev = pad_series(max(1, max(
-        sum(blocks[i].n_series for i in idxs) for idxs in per_dev
-    )))
+    per_dev, S_dev, T = _mesh_layout(blocks, n_devices)
     ts = np.full((D * S_dev, T), np.int32(2**31 - 1), dtype=np.int32)
     vals = np.zeros((D * S_dev, T), dtype=np.float32)
     raw = np.zeros((D * S_dev, T), dtype=np.float32)
