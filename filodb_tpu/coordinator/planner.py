@@ -460,6 +460,8 @@ class SingleClusterPlanner:
             )
         if isinstance(p, L.Aggregate):
             return self._materialize_aggregate(p)
+        if isinstance(p, L.PartialAggregate):
+            return self._materialize_partial_aggregate(p)
         if isinstance(p, L.BinaryJoin):
             pushed = self._try_join_pushdown(p)
             if pushed is not None:
@@ -557,6 +559,30 @@ class SingleClusterPlanner:
             )
         raise QueryError(f"cannot materialize {type(p).__name__}")
 
+    def _materialize_partial_aggregate(self, p: "L.PartialAggregate") -> ExecPlan:
+        """Execute the map phase only and return __comp__-labeled mergeable
+        components — what a federation peer runs for a pushed-down
+        aggregate (reference partial AggregateItem exchange,
+        RowAggregator.scala:28,114)."""
+        from ..query.exec.plans import (
+            PartialReduceExec,
+            SketchMapReduce,
+        )
+
+        inner = self._materialize(p.inner)
+        if p.op == "quantile":
+            mapper = SketchMapReduce(p.by, p.without)
+        elif p.op in _PARTIAL_COMPONENTS:
+            mapper = AggregateMapReduce(p.op, p.by, p.without)
+        else:
+            raise QueryError(f"no mergeable partial form for {p.op}")
+        if isinstance(inner, DistConcatExec) and not inner.transformers:
+            for child in inner.child_plans:
+                child.transformers.append(mapper)
+            return PartialReduceExec(inner.child_plans, p.op, p.by, p.without)
+        inner.transformers.append(mapper)
+        return PartialReduceExec([inner], p.op, p.by, p.without)
+
     def _materialize_aggregate(self, p: L.Aggregate) -> ExecPlan:
         mesh_plan = self._try_mesh_aggregate(p)
         if mesh_plan is not None:
@@ -566,9 +592,12 @@ class SingleClusterPlanner:
         if simple and isinstance(inner, DistConcatExec) and not inner.transformers:
             # push map phase onto each shard subtree (reference agg pushdown
             # SingleClusterPlanner.scala:1137)
-            self._push_peer_aggregate(inner.child_plans, p)
+            pushed_partial = self._push_peer_aggregate(inner.child_plans, p)
             for child in inner.child_plans:
-                child.transformers.append(AggregateMapReduce(p.op, p.by, p.without))
+                if id(child) not in pushed_partial:
+                    child.transformers.append(
+                        AggregateMapReduce(p.op, p.by, p.without)
+                    )
             return ReduceAggregateExec(inner.child_plans, p.op, p.by, p.without)
         if simple and not isinstance(inner, DistConcatExec):
             inner.transformers.append(AggregateMapReduce(p.op, p.by, p.without))
@@ -607,6 +636,30 @@ class SingleClusterPlanner:
                         CountValuesMapReduce(str(p.params[0]), p.by, p.without)
                     )
             return CountValuesMergeExec(inner.child_plans)
+        elif (p.op == "quantile" and p.params
+              and isinstance(inner, DistConcatExec) and not inner.transformers):
+            # distributed quantile over plan-transport peers: everyone ships
+            # per-group mergeable sketch counts, O(groups x B) on the wire
+            # instead of O(series) raw rows (reference QuantileRowAggregator
+            # t-digest exchange). Local-only quantile stays on the exact
+            # path below; HTTP peers can't ship sketches (PromQL transport).
+            peers = [c for c in inner.child_plans
+                     if getattr(c, "peer_logical", None) is not None]
+            if peers and all(hasattr(c, "push_aggregate") for c in peers):
+                from ..query.exec.plans import QuantileMergeExec, SketchMapReduce
+
+                for child in inner.child_plans:
+                    if getattr(child, "peer_logical", None) is not None:
+                        child.push_aggregate(L.PartialAggregate(
+                            "quantile", child.peer_logical, (), p.by, p.without
+                        ))
+                    else:
+                        child.transformers.append(
+                            SketchMapReduce(p.by, p.without)
+                        )
+                return QuantileMergeExec(
+                    inner.child_plans, float(p.params[0]), p.by, p.without
+                )
         return AggregatePresentExec([inner], p.op, p.params, p.by, p.without)
 
     def _rewrite_peer_leaf(self, child, p: "L.Aggregate") -> None:
@@ -620,23 +673,39 @@ class SingleClusterPlanner:
         else:
             child.promql = to_promql(wrapped)
 
-    # aggregation ops where re-aggregating per-peer PARTIALS with the same
-    # op is exact: sum of sums, min of mins, max of maxes, group of groups.
-    # count/avg/stddev must NOT push (count would count the partial series,
-    # avg of avgs is wrong) — those peers still return raw series.
+    # aggregation ops where re-aggregating per-peer FINAL rows with the
+    # same op is exact: sum of sums, min of mins, max of maxes, group of
+    # groups — the only pushdown expressible over the PromQL (HTTP)
+    # transport. count/avg/stddev over HTTP peers still return raw series.
     _PEER_PUSH_OPS = {"sum", "min", "max", "group"}
 
-    def _push_peer_aggregate(self, children, p: "L.Aggregate") -> None:
-        """Rewrite peer remote leaves to ship the aggregate (``sum by(g)
-        (rate(m[5m]))``) instead of every raw series — the cross-host analog
-        of the per-shard map-phase pushdown: O(groups) rows over the wire,
-        not O(series). The local AggregateMapReduce/Reduce pipeline then
-        treats the peer's group partials exactly like local partials."""
-        if p.op not in self._PEER_PUSH_OPS or p.params:
-            return
+    def _push_peer_aggregate(self, children, p: "L.Aggregate") -> set:
+        """Rewrite peer remote leaves to ship the aggregate instead of
+        every raw series — the cross-host analog of the per-shard map-phase
+        pushdown: O(groups) rows over the wire, not O(series).
+
+        Plan-transport (gRPC) peers receive L.PartialAggregate and return
+        mergeable __comp__ components, so count/avg/stddev/stdvar push too
+        (reference RowAggregator.scala:28,114 AggregateItem exchange);
+        PromQL (HTTP) peers can only express the exact-re-aggregation ops
+        (_PEER_PUSH_OPS) and ship final rows. Returns the id()s of children
+        now returning PARTIAL components (they must not get the local
+        AggregateMapReduce transformer — their grids are already partials).
+        """
+        pushed_partial: set = set()
+        if p.params:
+            return pushed_partial
         for child in children:
-            if getattr(child, "peer_logical", None) is not None:
+            if getattr(child, "peer_logical", None) is None:
+                continue
+            if hasattr(child, "push_aggregate") and p.op in _PARTIAL_COMPONENTS:
+                child.push_aggregate(L.PartialAggregate(
+                    p.op, child.peer_logical, p.params, p.by, p.without
+                ))
+                pushed_partial.add(id(child))
+            elif p.op in self._PEER_PUSH_OPS:
                 self._rewrite_peer_leaf(child, p)
+        return pushed_partial
 
     def _try_join_pushdown(self, p: "L.BinaryJoin"):
         """Per-shard binary-join pushdown (reference materializeBinaryJoin

@@ -106,6 +106,10 @@ class MeshAggregateExec(ExecPlan):
             kind, self.filters, self.raw_start_ms, self.raw_end_ms,
             self.by, self.without, versions, self.mesh.devices.size,
             self.is_counter, self.is_delta,
+            # the "stack" entry embeds msk_sh, which is built only for MXU
+            # mesh functions — a non-member function must not decide the
+            # cached value for member functions (or vice versa)
+            self.function in self._MXU_MESH_FUNCS,
         )
         return cache, key
 

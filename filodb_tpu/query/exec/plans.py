@@ -570,7 +570,7 @@ def _merge_partials(op: str, partials):
                 if cur is None:
                     slot["comps"][name] = row.copy()
                 else:
-                    if name in ("sum", "count", "sumsq", "hist"):
+                    if name in ("sum", "count", "sumsq", "hist", "sketch"):
                         slot["comps"][name] = np.where(
                             np.isnan(cur), row, np.where(np.isnan(row), cur, cur + row)
                         )
@@ -626,23 +626,71 @@ class AggregateMapReduce:
         # emits a "partial grid" whose values are the partial components,
         # encoded as stacked rows with __comp__ labels
         group_labels, comps, meta = _partial_aggregate(self.op, grids, self.by, self.without)
-        if meta is None:
-            return []
-        out = []
-        for name, arr in comps.items():
-            is_hist = name == "hist"
-            out.append(
-                Grid(
-                    [dict(l, __comp__=name) for l in group_labels],
-                    meta.start_ms,
-                    meta.step_ms,
-                    meta.num_steps,
-                    arr if not is_hist else np.full(arr.shape[:2], np.nan, np.float32),
-                    hist=arr if is_hist else None,
-                    les=meta.les,
-                )
+        return partials_to_grids(group_labels, comps, meta)
+
+
+# component names whose [G, J, B] payload rides the Grid.hist field
+_CUBE_COMPS = ("hist", "sketch")
+
+
+def partials_to_grids(group_labels, comps, meta) -> list[Grid]:
+    """Encode per-group partial components as ``__comp__``-labeled grids —
+    the ONE wire/in-memory form for mergeable aggregation state, shared by
+    the shard map phase, the peer-level PartialAggregate executor, and the
+    gRPC result frames (reference: serialized RangeVectorAggregator partial
+    AggregateItems)."""
+    if meta is None:
+        return []
+    out = []
+    for name, arr in comps.items():
+        is_cube = name in _CUBE_COMPS
+        out.append(
+            Grid(
+                [dict(l, __comp__=name) for l in group_labels],
+                meta.start_ms,
+                meta.step_ms,
+                meta.num_steps,
+                arr if not is_cube else np.full(arr.shape[:2], np.nan, np.float32),
+                hist=arr if is_cube else None,
+                les=meta.les if name == "hist" else None,
             )
-        return out
+        )
+    return out
+
+
+def collect_partials(result: QueryResult, default_op: str):
+    """Decode a child's ``__comp__``-labeled grids back into the
+    (group_labels, comps, meta) partial form (inverse of
+    partials_to_grids). Rows without a __comp__ label are treated as
+    already-final values of ``default_op`` — the exact-re-aggregation form
+    sum/min/max/group peers return."""
+    meta = None
+    comp_rows: dict[str, dict[tuple, np.ndarray]] = {}
+    labels_by_key: dict[tuple, dict] = {}
+    for g in result.grids:
+        if g.les is not None or meta is None:
+            meta = g
+        v = g.values_np()
+        h = g.hist_np()
+        for i, l in enumerate(g.labels):
+            comp = l.get("__comp__", default_op)
+            base = {k: x for k, x in l.items() if k != "__comp__"}
+            key = tuple(sorted(base.items()))
+            labels_by_key[key] = base
+            comp_rows.setdefault(comp, {})[key] = (
+                h[i] if comp in _CUBE_COMPS else v[i]
+            )
+    if meta is None:
+        return None
+    keys = list(labels_by_key)
+    group_labels = [labels_by_key[k] for k in keys]
+    comps = {}
+    for comp, rows in comp_rows.items():
+        proto = next(iter(rows.values()))
+        comps[comp] = np.stack([
+            rows.get(k, np.full(proto.shape, np.nan, np.float32)) for k in keys
+        ])
+    return group_labels, comps, meta
 
 
 class ReduceAggregateExec(NonLeafExecPlan):
@@ -660,35 +708,126 @@ class ReduceAggregateExec(NonLeafExecPlan):
     def do_execute(self, ctx: QueryContext) -> QueryResult:
         partials = []
         for r in self.execute_children(ctx):
-            # children emit partial grids tagged with __comp__
-            by_comp: dict[str, tuple[list, list]] = {}
-            meta = None
-            comp_rows: dict[str, dict[tuple, np.ndarray]] = {}
-            labels_by_key: dict[tuple, dict] = {}
-            for g in r.grids:
-                if g.les is not None or meta is None:
-                    meta = g
-                v = g.values_np()
-                h = g.hist_np()
-                for i, l in enumerate(g.labels):
-                    comp = l.get("__comp__", self.op)
-                    base = {k: x for k, x in l.items() if k != "__comp__"}
-                    key = tuple(sorted(base.items()))
-                    labels_by_key[key] = base
-                    comp_rows.setdefault(comp, {})[key] = h[i] if comp == "hist" else v[i]
-            if meta is None:
-                continue
-            keys = list(labels_by_key)
-            group_labels = [labels_by_key[k] for k in keys]
-            comps = {}
-            for comp, rows in comp_rows.items():
-                proto = next(iter(rows.values()))
-                comps[comp] = np.stack([
-                    rows.get(k, np.full(proto.shape, np.nan, np.float32)) for k in keys
-                ])
-            partials.append((group_labels, comps, meta))
+            # children emit partial grids tagged with __comp__ (rows without
+            # the tag are exact-re-aggregation peer results of self.op)
+            p = collect_partials(r, self.op)
+            if p is not None:
+                partials.append(p)
         key_to, meta = _merge_partials(self.op, partials)
         return _present(self.op, key_to, meta)
+
+
+class PartialReduceExec(NonLeafExecPlan):
+    """Reduce phase WITHOUT the present phase: merges children's partial
+    components and re-emits them as ``__comp__``-labeled grids. This is the
+    executor of L.PartialAggregate — what a federation peer runs so only
+    O(groups) mergeable components cross the wire (reference
+    RowAggregator.scala:28,114; AggrOverRangeVectors.scala:224)."""
+
+    def __init__(self, child_plans, op: str, by=None, without=None):
+        super().__init__(child_plans)
+        self.op = op
+        self.by = by
+        self.without = without
+
+    def args_str(self) -> str:
+        return f"op={self.op} by={self.by} without={self.without}"
+
+    def do_execute(self, ctx: QueryContext) -> QueryResult:
+        partials = []
+        for r in self.execute_children(ctx):
+            p = collect_partials(r, self.op)
+            if p is not None:
+                partials.append(p)
+        key_to, meta = _merge_partials(self.op, partials)
+        if meta is None:
+            return QueryResult()
+        group_labels = [slot["labels"] for slot in key_to.values()]
+        names = sorted({n for slot in key_to.values() for n in slot["comps"]})
+        comps = {}
+        for name in names:
+            proto = next(
+                slot["comps"][name] for slot in key_to.values()
+                if name in slot["comps"]
+            )
+            comps[name] = np.stack([
+                slot["comps"].get(
+                    name, np.full(proto.shape, np.nan, np.float32)
+                )
+                for slot in key_to.values()
+            ])
+        return QueryResult(grids=partials_to_grids(group_labels, comps, meta))
+
+
+@dataclass
+class SketchMapReduce:
+    """Transformer form of the quantile map phase: per-group log-linear
+    sketch counts (ops/sketch.py), encoded as a ``__comp__="sketch"`` grid
+    whose [G, J, B] counts ride the hist field. Sketches merge by addition
+    across shards and peers (reference QuantileRowAggregator's serialized
+    t-digests)."""
+
+    by: tuple | None
+    without: tuple | None
+
+    def apply(self, grids: list[Grid]) -> list[Grid]:
+        from ...ops import sketch as SK
+
+        if not grids:
+            return []
+        meta = grids[0]
+        all_labels = [l for g in grids for l in g.labels]
+        mats = [g.values_np()[: g.n_series, : g.num_steps] for g in grids]
+        vals = np.concatenate(mats, axis=0) if len(mats) > 1 else mats[0]
+        gids, group_labels = AGG.group_ids_for(
+            all_labels, list(self.by) if self.by else None,
+            list(self.without) if self.without else None,
+        )
+        counts = np.asarray(
+            SK.build_sketch(jnp.asarray(vals), jnp.asarray(gids), len(group_labels))
+        )
+        return partials_to_grids(group_labels, {"sketch": counts}, meta)
+
+
+class QuantileMergeExec(NonLeafExecPlan):
+    """Root merge for distributed quantile: children return per-group
+    sketch partials (SketchMapReduce locally, PartialAggregate on peers);
+    merged sketches present via log-linear interpolation. Cross-node
+    quantile is approximate (~2.2% relative at SUB=32) exactly like the
+    reference's t-digest exchange."""
+
+    def __init__(self, child_plans, q: float, by=None, without=None):
+        super().__init__(child_plans)
+        self.q = q
+        self.by = by
+        self.without = without
+
+    def args_str(self) -> str:
+        return f"q={self.q} by={self.by} without={self.without}"
+
+    def do_execute(self, ctx: QueryContext) -> QueryResult:
+        from ...ops import sketch as SK
+
+        partials = []
+        for r in self.execute_children(ctx):
+            p = collect_partials(r, "sketch")
+            if p is not None:
+                partials.append(p)
+        key_to, meta = _merge_partials("quantile", partials)
+        if meta is None:
+            return QueryResult()
+        labels, rows = [], []
+        for slot in key_to.values():
+            counts = slot["comps"].get("sketch")
+            if counts is None:
+                continue
+            labels.append(slot["labels"])
+            rows.append(SK.sketch_quantile(counts[None], self.q)[0])
+        vals = (np.stack(rows).astype(np.float32) if rows
+                else np.zeros((0, meta.num_steps), np.float32))
+        return QueryResult(
+            grids=[Grid(labels, meta.start_ms, meta.step_ms, meta.num_steps, vals)]
+        )
 
 
 class CountValuesMergeExec(NonLeafExecPlan):

@@ -95,6 +95,24 @@ class Aggregate(LogicalPlan):
 
 
 @dataclass(frozen=True)
+class PartialAggregate(LogicalPlan):
+    """Map phase of an aggregation WITHOUT the present phase: the executor
+    returns per-group mergeable components (``__comp__``-labeled grids —
+    (sum,count) for avg, (sum,sumsq,count) for stddev, sketch counts for
+    quantile) instead of finished values. Federation ships this to peers so
+    O(groups) components cross the wire, not O(series) raw rows, and the
+    coordinator's reduce phase merges peer partials exactly like local
+    shard partials (reference RowAggregator.scala:28,114 mergeable
+    aggregate items, AggrOverRangeVectors.scala:224)."""
+
+    op: str  # any op in exec.plans._PARTIAL_COMPONENTS, or "quantile"
+    inner: LogicalPlan
+    params: tuple = ()
+    by: Optional[tuple[str, ...]] = None
+    without: Optional[tuple[str, ...]] = None
+
+
+@dataclass(frozen=True)
 class BinaryJoin(LogicalPlan):
     lhs: LogicalPlan
     op: str

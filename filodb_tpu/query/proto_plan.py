@@ -139,13 +139,19 @@ def result_to_frames(res: QueryResult, chunk_rows: int = CHUNK_ROWS):
         hdr.header.num_steps = int(g.num_steps)
         hdr.header.num_series = int(g.n_series)
         hdr.header.stale = bool(g.stale)
+        rows_per_chunk = chunk_rows
         if hist is not None:
             hdr.header.has_hist = True
+            hdr.header.hist_bins = int(hist.shape[2])
             if g.les is not None:
                 hdr.header.les.extend(float(x) for x in np.asarray(g.les))
+            # wide cubes (quantile sketches: B ~ 4k bins) must not blow the
+            # 4 MB message cap at the dense worst case
+            dense_row = int(hist.shape[1]) * int(hist.shape[2]) * 4
+            rows_per_chunk = max(1, min(chunk_rows, (3 << 20) // max(dense_row, 1)))
         yield hdr
-        for lo in range(0, g.n_series, chunk_rows):
-            hi = min(lo + chunk_rows, g.n_series)
+        for lo in range(0, g.n_series, rows_per_chunk):
+            hi = min(lo + rows_per_chunk, g.n_series)
             fr = pb.StreamFrame()
             ch = fr.chunk
             ch.grid_index = gi
@@ -156,7 +162,19 @@ def result_to_frames(res: QueryResult, chunk_rows: int = CHUNK_ROWS):
                     sl.pairs.add(name=k, value=str(lbls[k]))
             ch.values_f32 = vals[lo:hi].tobytes()
             if hist is not None:
-                ch.hist_f32 = np.ascontiguousarray(hist[lo:hi], np.float32).tobytes()
+                cube = np.ascontiguousarray(hist[lo:hi], np.float32)
+                flat = cube.ravel()
+                nz = np.flatnonzero(flat)
+                if nz.size * 8 < flat.size * 4:
+                    # sparse cube: log-linear sketches are mostly zeros.
+                    # An all-zero chunk still writes one (idx, 0.0) entry so
+                    # the decoder can tell it from "no hist in this chunk".
+                    if nz.size == 0:
+                        nz = np.array([0], np.int64)
+                    ch.hist_idx_i32 = nz.astype(np.int32).tobytes()
+                    ch.hist_f32 = flat[nz].tobytes()
+                else:
+                    ch.hist_f32 = cube.tobytes()
             yield fr
     if res.scalar is not None:
         fr = pb.StreamFrame()
@@ -255,7 +273,7 @@ def frames_to_result(frames) -> QueryResult:
             _raise_remote_error(fr.error.error_type, fr.error.message)
     for gi in sorted(headers):
         h = headers[gi]
-        nb = len(h.les)
+        nb = int(h.hist_bins) or len(h.les)
         labels: list[dict] = []
         vparts: list[np.ndarray] = []
         hparts: list[np.ndarray] = []
@@ -266,6 +284,11 @@ def frames_to_result(frames) -> QueryResult:
             vparts.append(v.reshape(-1, h.num_steps) if h.num_steps else v.reshape(len(ch.labels), 0))
             if h.has_hist and ch.hist_f32:
                 hn = np.frombuffer(ch.hist_f32, np.float32)
+                if ch.hist_idx_i32:
+                    idx = np.frombuffer(ch.hist_idx_i32, np.int32)
+                    dense = np.zeros(len(ch.labels) * h.num_steps * nb, np.float32)
+                    dense[idx] = hn
+                    hn = dense
                 hparts.append(hn.reshape(-1, h.num_steps, nb))
         if len(labels) != h.num_series:
             raise RemoteExecError(

@@ -277,7 +277,8 @@ class TestGrpcPeerPlanning:
         tree = pl.materialize(plan)
         remotes = [p for p in _walk(tree) if isinstance(p, GrpcPlanRemoteExec)]
         assert len(remotes) == 1
-        assert isinstance(remotes[0].logical_plan, L.Aggregate)  # pushdown happened
+        # pushdown happened: the peer computes mergeable components
+        assert isinstance(remotes[0].logical_plan, L.PartialAggregate)
         assert remotes[0].logical_plan.op == "sum"
         assert remotes[0].local_only
 
