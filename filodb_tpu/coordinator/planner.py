@@ -281,6 +281,10 @@ class PlannerParams:
     peer_endpoints: tuple = ()
     # bearer token for peer requests (the cluster's http_auth_token)
     remote_auth_token: str | None = None
+    # coalesce concurrent IDENTICAL queries into one execution (dashboard
+    # fan-out: one kernel launch serves every copy). In-flight sharing only,
+    # never a cache — see coordinator.scheduler.SingleFlight.
+    coalesce_identical: bool = True
 
 
 class SingleClusterPlanner:
@@ -877,9 +881,12 @@ class QueryEngine:
     analog of QueryActor -> planner.materialize -> execute)."""
 
     def __init__(self, memstore, dataset: str, params: PlannerParams | None = None):
+        from .scheduler import SingleFlight
+
         self.memstore = memstore
         self.dataset = dataset
         self.planner = SingleClusterPlanner(memstore, dataset, params=params)
+        self._single_flight = SingleFlight()
 
     def context(self) -> QueryContext:
         ctx = QueryContext(self.memstore, self.dataset)
@@ -888,11 +895,34 @@ class QueryEngine:
         return ctx
 
     def query_range(self, promql: str, start_s: float, end_s: float, step_s: float):
+        """PromQL range query. Concurrent identical queries coalesce into
+        ONE plan+stage+kernel execution (reference: the shared
+        QueryScheduler pool, QueryScheduler.scala:29-73, plus single-flight
+        result sharing for the dashboard fan-out pattern). Serving metrics
+        count every CALLER (followers included), not executions — the
+        coalescing factor must not deflate served QPS or the latency
+        histogram."""
         import time as _time
 
         from ..metrics import REGISTRY
 
         t0 = _time.perf_counter()
+        if self.planner.params.coalesce_identical:
+            res = self._single_flight.run(
+                (self.dataset, promql, float(start_s), float(end_s), float(step_s)),
+                lambda: self._query_range_uncoalesced(promql, start_s, end_s, step_s),
+                timeout_s=self.planner.params.deadline_s,
+            )
+        else:
+            res = self._query_range_uncoalesced(promql, start_s, end_s, step_s)
+        REGISTRY.counter("filodb_queries", dataset=self.dataset).inc()
+        REGISTRY.histogram("filodb_query_latency_seconds", dataset=self.dataset).observe(
+            _time.perf_counter() - t0
+        )
+        return res
+
+    def _query_range_uncoalesced(self, promql: str, start_s: float,
+                                 end_s: float, step_s: float):
         plan = query_range_to_logical_plan(promql, start_s, end_s, step_s,
                                            self.planner.params.lookback_ms)
         if self.planner.params.agg_rules is not None:
@@ -905,10 +935,6 @@ class QueryEngine:
         res.stats = ctx.stats  # per-query scan/latency stats ride in responses
         if res.result_type == "matrix" or res.grids:
             res.result_type = "matrix"
-        REGISTRY.counter("filodb_queries", dataset=self.dataset).inc()
-        REGISTRY.histogram("filodb_query_latency_seconds", dataset=self.dataset).observe(
-            _time.perf_counter() - t0
-        )
         return res
 
     def _run(self, exec_plan, ctx):

@@ -29,6 +29,61 @@ class QueryRejected(QueryError):
     """Admission control: pool and queue are full."""
 
 
+class SingleFlight:
+    """Coalesce concurrent IDENTICAL queries into one execution.
+
+    Dashboards fan the same panel query out N times within milliseconds;
+    without coalescing each copy pays its own staging lookup + kernel
+    launch + render. The first arrival for a key becomes the leader and
+    executes; followers that arrive while it runs share its result (and its
+    exception). In-flight only — nothing is cached after completion, so a
+    shared answer is exactly as fresh as the followers' own execution would
+    have been. Compatible-query batching beyond exact identity happens
+    below this layer: the mesh stage cache shares staged blocks and window
+    matrices across queries that differ only in function/aggregation.
+
+    Caveat: a follower whose deadline exceeds the leader's inherits the
+    leader's deadline failure; identical queries almost always carry
+    identical deadlines (same dashboard), so this trade is taken for the
+    16x fan-out win."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._flights: dict = {}
+
+    def run(self, key, fn, timeout_s: float):
+        from concurrent.futures import Future
+
+        with self._lock:
+            fut = self._flights.get(key)
+            leader = fut is None
+            if leader:
+                fut = Future()
+                self._flights[key] = fut
+        if not leader:
+            REGISTRY.counter("filodb_queries_coalesced").inc()
+            try:
+                return fut.result(timeout=timeout_s)
+            except FutureTimeout:
+                REGISTRY.counter("filodb_queries_deadline_exceeded").inc()
+                raise QueryDeadlineExceeded(
+                    f"query exceeded deadline: {timeout_s:.1f}s (coalesced)"
+                ) from None
+        try:
+            result = fn()
+        except BaseException as e:
+            with self._lock:
+                self._flights.pop(key, None)
+            fut.set_exception(e)
+            raise
+        # deregister BEFORE resolving: an arrival after completion must run
+        # its own flight (sharing is for concurrent queries, never a cache)
+        with self._lock:
+            self._flights.pop(key, None)
+        fut.set_result(result)
+        return result
+
+
 class QueryScheduler:
     def __init__(self, parallelism: int | None = None, max_queued: int = 64):
         self.parallelism = parallelism or min(8, os.cpu_count() or 4)
