@@ -107,6 +107,51 @@ def bench_index():
     report("index_regex_lookups", 10 / dt, "lookups/s")
 
 
+def bench_index_1m():
+    """1M-partkey index at the reference PartKeyIndexBenchmark scale:
+    equality vs range-aware regex vs label-values on the NATIVE backend
+    (tantivy analog). Bar (VERDICT r4 item 8): prefix regex within ~4x of
+    equality at 1M partkeys. FILODB_BENCH_INDEX_SERIES overrides the scale."""
+    import os
+
+    from filodb_tpu.core.filters import equals, regex
+    from filodb_tpu.memstore.index_native import (
+        NativePartKeyIndex,
+        native_index_available,
+    )
+
+    if not native_index_available():
+        return
+    n = int(os.environ.get("FILODB_BENCH_INDEX_SERIES", 1_000_000))
+    idx = NativePartKeyIndex()
+    t0 = time.perf_counter()
+    for i in range(n):
+        idx.add_partkey(i, {
+            "_metric_": f"metric_{i % 1000}", "host": f"h{i % 10_000}",
+            "dc": f"dc{i % 10}", "_ws_": "demo", "_ns_": f"ns{i % 20}",
+        }, 0)
+    report(f"index_build_{n // 1000}k", n / (time.perf_counter() - t0), "keys/s")
+    tag = f"{n // 1000}k"
+    # ~n/1000 result ids for every probe below, so rates compare the LOOKUP
+    # machinery, not differing result sizes
+    f_eq = [equals("_metric_", "metric_5")]
+    dt = _bench(lambda: [idx.part_ids_from_filters(f_eq, 0, 2**62) for _ in range(50)])
+    eq_rate = 50 / dt
+    report(f"index_eq_lookups_{tag}", eq_rate, "lookups/s")
+    # prefix regex: h123 + h1230..h1239 of 10k host values (~= eq result size)
+    f_pre = [regex("host", "h123.*")]
+    dt = _bench(lambda: [idx.part_ids_from_filters(f_pre, 0, 2**62) for _ in range(50)])
+    pre_rate = 50 / dt
+    report(f"index_prefix_regex_lookups_{tag}", pre_rate, "lookups/s")
+    report("index_prefix_regex_vs_eq", eq_rate / pre_rate, "x")
+    # general anchored regex with a literal prefix + tail match
+    f_re = [regex("host", "h12[0-9]?")]
+    dt = _bench(lambda: [idx.part_ids_from_filters(f_re, 0, 2**62) for _ in range(50)])
+    report(f"index_regex_lookups_{tag}", 50 / dt, "lookups/s")
+    dt = _bench(lambda: [idx.label_values([], "_metric_", 0, 2**62) for _ in range(20)])
+    report(f"index_label_values_{tag}", 20 / dt, "lookups/s")
+
+
 def bench_gateway_parse():
     """reference GatewayBenchmark: line-protocol msgs/sec."""
     from filodb_tpu.gateway.parsers import parse_influx_line, parse_prom_text
@@ -299,8 +344,9 @@ def bench_jitter_query():
 
 ALL = [
     bench_encoding, bench_nan_sum, bench_ingestion, bench_index,
-    bench_gateway_parse, bench_planner, bench_query_in_memory,
-    bench_query_hicard, bench_histogram_query, bench_jitter_query,
+    bench_index_1m, bench_gateway_parse, bench_planner,
+    bench_query_in_memory, bench_query_hicard, bench_histogram_query,
+    bench_jitter_query,
 ]
 
 

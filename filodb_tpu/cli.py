@@ -105,6 +105,30 @@ def cmd_partkey(args):
 
 def cmd_downsample_batch(args):
     """Batch downsample job (reference spark-jobs DownsamplerMain)."""
+    if getattr(args, "distributed", False):
+        import os as _os
+
+        from .downsample.distributed import job_complete, run_worker
+
+        shard_nums = sorted(
+            int(d.split("-")[1])
+            for d in _os.listdir(_os.path.join(args.store, args.dataset))
+            if d.startswith("shard-") and d.split("-")[1].isdigit()
+        )
+        rep = run_worker(
+            args.store, args.dataset, shard_nums,
+            tuple(int(m) * 60_000 for m in args.periods.split(",")),
+            worker_id=args.worker_id or None, label=args.job_label,
+            stale_s=args.stale_s,
+        )
+        _print({
+            "worker": rep.worker_id, "shards_done": rep.shards_done,
+            "shards_skipped": rep.shards_skipped,
+            "claims_broken": rep.claims_broken, "samples": rep.samples,
+            "job_complete": job_complete(args.store, args.dataset,
+                                         shard_nums, args.job_label),
+        })
+        return
     from .core.schemas import Dataset
     from .downsample.downsampler import ShardDownsampler
     from .memstore.memstore import TimeSeriesMemStore
@@ -281,6 +305,14 @@ def main(argv=None):
     sp.add_argument("--processes", type=int, default=0,
                     help="process-pool workers for the scan+reduce phase "
                          "(one task per shard; the Spark-executor analog)")
+    sp.add_argument("--distributed", action="store_true",
+                    help="run as ONE worker of a multi-process job: claim "
+                         "shards via the store root, commit atomically, "
+                         "break stale claims (reference DownsamplerMain "
+                         "over executors; rerun to resume after crashes)")
+    sp.add_argument("--worker-id", default="")
+    sp.add_argument("--job-label", default="default")
+    sp.add_argument("--stale-s", type=float, default=30.0)
     sp.set_defaults(fn=cmd_downsample_batch)
 
     sp = sub.add_parser("churn-find")

@@ -1,0 +1,232 @@
+"""Distributed batch downsampler: many worker PROCESSES over one store.
+
+The reference distributes DownsamplerMain over Spark executors by Cassandra
+token range (spark-jobs/.../chunk/DownsamplerMain.scala,
+CassandraColumnStore.getScanSplits:500) with userTimeStart checkpoints.
+Here the work unit is the shard and the coordination substrate is the
+column store's filesystem root (the analog of the reference's checkpoint
+tables), so any number of workers on any host sharing the store can run
+the job with NO coordinator process:
+
+- work assignment: each worker walks the shard list and atomically CLAIMS
+  a shard (O_EXCL claim file naming the worker); the bootstrap cluster's
+  ``/__members`` list, when given, orders each worker's walk by member
+  ordinal so workers start on disjoint slices and claim contention is the
+  exception, not the rule;
+- per-worker checkpoints: a shard's downsampled output is flushed to a
+  worker-private staging directory and atomically renamed into place, then
+  a ``done`` marker commits it — a crash at ANY point leaves either
+  nothing or a committed shard, never a half-read double-count;
+- straggler reassignment: claim files carry a heartbeat (mtime, refreshed
+  by the worker); a claim older than ``stale_s`` is broken by any other
+  worker and the shard is redone (safe: commit is atomic, redo overwrites).
+
+Run via ``python -m filodb_tpu.cli downsample-batch --distributed`` in N
+processes, or call :func:`run_worker` directly.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ..core.records import SeriesBatch
+from ..core.schemas import SCHEMAS, Dataset
+from .downsampler import DS_GAUGE
+
+
+@dataclass
+class WorkerReport:
+    worker_id: str
+    shards_done: list = field(default_factory=list)
+    shards_skipped: list = field(default_factory=list)
+    claims_broken: list = field(default_factory=list)
+    samples: int = 0
+
+
+def _job_dir(store_root: str, dataset: str, label: str) -> str:
+    return os.path.join(store_root, dataset, f"downsample-job-{label}")
+
+
+def _claim_path(job: str, shard: int) -> str:
+    return os.path.join(job, f"shard-{shard}.claim")
+
+
+def _done_path(job: str, shard: int) -> str:
+    return os.path.join(job, f"shard-{shard}.done")
+
+
+def _try_claim(job: str, shard: int, worker_id: str, stale_s: float,
+               report: WorkerReport) -> bool:
+    """Atomically claim a shard; break claims whose heartbeat went stale
+    (the straggler-reassignment path)."""
+    path = _claim_path(job, shard)
+    payload = json.dumps({"worker": worker_id, "t": time.time()}).encode()
+    try:
+        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        os.write(fd, payload)
+        os.close(fd)
+        return True
+    except FileExistsError:
+        pass
+    try:
+        age = time.time() - os.path.getmtime(path)
+    except OSError:
+        return False  # claim vanished: owner just finished or released
+    if age <= stale_s:
+        return False
+    # stale heartbeat: break the claim by atomic replace — exactly one of
+    # several concurrent breakers wins the subsequent O_EXCL retry because
+    # the unlink+create race leaves at most one creator succeeding
+    try:
+        os.unlink(path)
+    except OSError:
+        return False
+    try:
+        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        os.write(fd, payload)
+        os.close(fd)
+        report.claims_broken.append(shard)
+        return True
+    except FileExistsError:
+        return False
+
+
+def _release(job: str, shard: int, worker_id: str) -> None:
+    """Release a claim ONLY if we still own it — a worker whose stale claim
+    was broken must not delete the new owner's claim (which would re-open
+    the shard to a third worker mid-redo)."""
+    path = _claim_path(job, shard)
+    try:
+        with open(path) as f:
+            owner = json.load(f).get("worker")
+        if owner == worker_id:
+            os.unlink(path)
+    except (OSError, ValueError):
+        pass
+
+
+def member_ordered_shards(shard_nums, members, self_url: str | None):
+    """Order a worker's shard walk by its ``/__members`` ordinal so workers
+    start on disjoint slices (assignment hint; claims stay the correctness
+    mechanism). Unknown membership degrades to the natural order."""
+    shard_nums = list(shard_nums)
+    if not members or self_url is None:
+        return shard_nums
+    ring = sorted(members)
+    if self_url not in ring:
+        return shard_nums
+    k = ring.index(self_url)
+    n = len(ring)
+    mine = [s for s in shard_nums if s % n == k]
+    rest = [s for s in shard_nums if s % n != k]
+    return mine + rest
+
+
+def _flush_shard_output(store_root: str, dataset: str, shard: int,
+                        periods_ms, value_cols, worker_id: str,
+                        downsample_resolution_names) -> int:
+    """Read one shard's raw chunks, reduce, and COMMIT the downsample
+    datasets for that shard via staging-dir + atomic rename."""
+    from ..memstore.memstore import TimeSeriesMemStore
+    from ..store.columnstore import LocalColumnStore
+    from ..store.flush import FlushCoordinator
+    from .downsampler import _downsample_shard_records
+
+    store = LocalColumnStore(store_root)
+    records = _downsample_shard_records(store, dataset, shard,
+                                        tuple(periods_ms), value_cols)
+    staging_root = os.path.join(store_root, f".ds-staging-{worker_id}")
+    shutil.rmtree(staging_root, ignore_errors=True)
+    staging = LocalColumnStore(staging_root)
+    ms = TimeSeriesMemStore()
+    by_ds: dict[str, int] = {}
+    n = 0
+    for period, tags, out_ts, reduced in records:
+        ds = downsample_resolution_names[int(period)]
+        if ds not in by_ds:
+            ms.setup(Dataset(ds, schemas=[DS_GAUGE]), [shard])
+            by_ds[ds] = 1
+        ms.shard(ds, shard).ingest_series(SeriesBatch(DS_GAUGE, tags, out_ts, reduced))
+        n += len(out_ts)
+    fc = FlushCoordinator(ms, staging)
+    for ds in by_ds:
+        fc.flush_shard(ds, shard)
+        src = os.path.join(staging_root, ds, f"shard-{shard}")
+        dst = os.path.join(store_root, ds, f"shard-{shard}")
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        shutil.rmtree(dst, ignore_errors=True)  # leftovers of a dead worker
+        os.rename(src, dst)
+    shutil.rmtree(staging_root, ignore_errors=True)
+    return n
+
+
+def run_worker(store_root: str, dataset: str, shard_nums, periods_ms,
+               worker_id: str | None = None, label: str = "default",
+               stale_s: float = 30.0, heartbeat_s: float = 5.0,
+               members=None, self_url: str | None = None) -> WorkerReport:
+    """Claim-process-commit loop over the shard list; returns the worker's
+    report. Run one of these per process; re-running after ANY crash
+    resumes exactly where the job left off (done markers skip committed
+    shards, stale claims get broken and redone)."""
+    from .downsampler import _value_columns
+
+    worker_id = worker_id or f"{os.uname().nodename}-{os.getpid()}"
+    report = WorkerReport(worker_id=worker_id)
+    job = _job_dir(store_root, dataset, label)
+    os.makedirs(job, exist_ok=True)
+    value_cols = _value_columns(SCHEMAS)
+    # resolution naming matches ShardDownsampler.dataset_for so batch output
+    # lands in the same datasets the ingest-time downsampler feeds
+    res_names = {
+        int(p): f"{dataset}_{int(p) // 60_000}m" for p in periods_ms
+    }
+    order = member_ordered_shards(shard_nums, members, self_url)
+    stop_hb = threading.Event()
+
+    def heartbeat(path: str):
+        while not stop_hb.wait(heartbeat_s):
+            try:
+                os.utime(path)
+            except FileNotFoundError:
+                return  # claim broken by another worker: stop beating
+            except OSError:
+                continue  # transient FS error must not kill the heartbeat
+
+    crash_after = os.environ.get("FILODB_DS_CRASH_AFTER_CLAIM")
+    for shard in order:
+        if os.path.exists(_done_path(job, shard)):
+            report.shards_skipped.append(shard)
+            continue
+        if not _try_claim(job, shard, worker_id, stale_s, report):
+            report.shards_skipped.append(shard)
+            continue
+        if crash_after is not None and int(crash_after) == shard:
+            os._exit(17)  # test hook: die holding the claim (straggler)
+        stop_hb.clear()
+        hb = threading.Thread(target=heartbeat,
+                              args=(_claim_path(job, shard),), daemon=True)
+        hb.start()
+        try:
+            n = _flush_shard_output(store_root, dataset, shard, periods_ms,
+                                    value_cols, worker_id, res_names)
+            with open(_done_path(job, shard), "w") as f:
+                json.dump({"worker": worker_id, "samples": n,
+                           "t": time.time()}, f)
+            report.shards_done.append(shard)
+            report.samples += n
+        finally:
+            stop_hb.set()
+            hb.join(timeout=heartbeat_s + 1)
+            _release(job, shard, worker_id)
+    return report
+
+
+def job_complete(store_root: str, dataset: str, shard_nums,
+                 label: str = "default") -> bool:
+    job = _job_dir(store_root, dataset, label)
+    return all(os.path.exists(_done_path(job, s)) for s in shard_nums)

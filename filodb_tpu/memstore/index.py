@@ -22,7 +22,9 @@ import numpy as np
 
 from ..core.filters import ColumnFilter
 
-_LITERAL_ALT = re.compile(r"^[\w.+-]+(\|[\w.+-]*)*$")
+# alternations of pure literals only: '.' and '+' are regex metacharacters
+# ('ab+' must regex-match 'abb', never look up the literal value "ab+")
+_LITERAL_ALT = re.compile(r"^[\w-]+(\|[\w-]*)*$")
 
 
 class PartKeyIndex:

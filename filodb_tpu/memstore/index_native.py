@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+import re
 import subprocess
 import threading
 from typing import Iterable, Mapping, Sequence
@@ -72,8 +73,51 @@ def _load():
         L.fdb_idx_all.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, c_i32p, ctypes.c_long]
         L.fdb_idx_size.restype = ctypes.c_long
         L.fdb_idx_size.argtypes = [ctypes.c_void_p]
+        L.fdb_idx_values_prefix.restype = ctypes.c_long
+        L.fdb_idx_values_prefix.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_long,
+            ctypes.c_char_p, ctypes.c_long,
+            ctypes.c_char_p, ctypes.c_long, c_longp,
+        ]
+        L.fdb_idx_union.restype = ctypes.c_long
+        L.fdb_idx_union.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_long,
+            ctypes.c_int32, c_charpp, c_longp,
+            ctypes.c_int64, ctypes.c_int64, c_i32p, ctypes.c_long,
+        ]
+        L.fdb_idx_union_prefix.restype = ctypes.c_long
+        L.fdb_idx_union_prefix.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_long,
+            ctypes.c_char_p, ctypes.c_long,
+            ctypes.c_int64, ctypes.c_int64, c_i32p, ctypes.c_long,
+        ]
         _lib = L
         return _lib
+
+
+# first regex metacharacter ends the literal prefix (conservative: a
+# backslash escape also stops it)
+_META = re.compile(r"[.^$*+?()[\]{}|\\]")
+
+
+def regex_literal_prefix(pattern: str) -> tuple[str, str]:
+    """Split an anchored regex into (safe literal prefix, remainder) — the
+    range-aware regex trick (reference tantivy_utils): ``http_5.*`` scans
+    only the ``http_5``-prefixed slice of the value dictionary.
+
+    Safety: every full match MUST start with the returned prefix. A
+    quantifier right after the literal run makes its last char optional
+    (``ab*`` matches "a"), so it is dropped; an alternation anywhere can
+    bypass the prefix entirely (``abc|z``), so the prefix collapses to ""."""
+    if "|" in pattern:
+        return "", pattern
+    m = _META.search(pattern)
+    if m is None:
+        return pattern, ""
+    prefix, remainder = pattern[: m.start()], pattern[m.start():]
+    if remainder[:1] in ("*", "?", "{") and prefix:
+        prefix = prefix[:-1]
+    return prefix, remainder
 
 
 def native_index_available() -> bool:
@@ -138,22 +182,111 @@ class NativePartKeyIndex(PartKeyIndex):
     def part_ids_from_filters(self, filters: Sequence[ColumnFilter], start_ts, end_ts, limit=None):
         # equality with "" matches missing tags too (PromQL) — python path
         eq = [f for f in filters if f.op == "=" and f.value != ""]
-        rest = [f for f in filters if not (f.op == "=" and f.value != "")]
-        if eq and not rest:
-            out = self._query_native(eq, start_ts, end_ts)
-            if limit is not None:
-                out = out[:limit]
-            return out
+        # positive anchored regexes that can't match a MISSING tag take the
+        # native prefix-range path; everything else stays python
+        rex = [
+            f for f in filters
+            if f.op == "=~" and isinstance(f.value, str) and not f.matches(None)
+        ]
+        rest = [f for f in filters if not (f.op == "=" and f.value != "") and f not in rex]
+        if not eq and not rex:
+            return super().part_ids_from_filters(filters, start_ts, end_ts, limit)
+        cands = None
         if eq:
             cands = self._query_native(eq, start_ts, end_ts)
+        for f in rex:
+            ids = self._query_regex_native(f, start_ts, end_ts)
+            cands = ids if cands is None else np.intersect1d(
+                cands, ids, assume_unique=True
+            )
+            if not len(cands):
+                return np.empty(0, dtype=np.int32)
+        if rest:
             keep = [
                 p for p in cands.tolist()
                 if all(f.matches(self._tags[p].get(f.column)) for f in rest)
             ]
-            if limit is not None:
-                keep = keep[:limit]
-            return np.asarray(keep, dtype=np.int32)
-        return super().part_ids_from_filters(filters, start_ts, end_ts, limit)
+            cands = np.asarray(keep, dtype=np.int32)
+        if limit is not None:
+            cands = cands[:limit]
+        return cands
+
+    def _query_regex_native(self, f: ColumnFilter, start_ts, end_ts) -> np.ndarray:
+        """Range-aware anchored regex: narrow the value dictionary to the
+        literal-prefix slice in C++, regex-match only that slice, union the
+        postings natively (reference tantivy_utils range-aware regex;
+        PartKeyTantivyIndex.scala:38)."""
+        pattern = f.value
+        key = f.column.encode()
+        cap = max(len(self._all), 1)
+        out = np.empty(cap, dtype=np.int32)
+        optr = out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+        if _LITERAL_ALT.match(pattern):
+            # pure literal alternation (a|b|c): native union, no regex
+            enc = [v.encode() for v in pattern.split("|")]
+            n = len(enc)
+            got = self._L.fdb_idx_union(
+                self._h, key, len(key), n,
+                (ctypes.c_char_p * n)(*enc),
+                (ctypes.c_long * n)(*[len(v) for v in enc]),
+                start_ts, end_ts, optr, cap,
+            )
+            return out[: min(got, cap)]
+        prefix, remainder = regex_literal_prefix(pattern)
+        if remainder == "" or remainder == ".*":
+            # pure literal (handled as exact value) or pure prefix match:
+            # no per-value regex anywhere
+            if remainder == "":
+                got = self._L.fdb_idx_union(
+                    self._h, key, len(key), 1,
+                    (ctypes.c_char_p * 1)(prefix.encode()),
+                    (ctypes.c_long * 1)(len(prefix.encode())),
+                    start_ts, end_ts, optr, cap,
+                )
+            else:
+                p = prefix.encode()
+                got = self._L.fdb_idx_union_prefix(
+                    self._h, key, len(key), p, len(p),
+                    start_ts, end_ts, optr, cap,
+                )
+            return out[: min(got, cap)]
+        # general anchored regex: fetch the prefix-narrowed candidate
+        # values, regex-match them host-side, union the survivors natively
+        rx = re.compile(pattern)
+        values = self._values_with_prefix(key, prefix.encode())
+        matched = [v for v in values if rx.fullmatch(v) is not None]
+        if not matched:
+            return np.empty(0, dtype=np.int32)
+        enc = [v.encode() for v in matched]
+        n = len(enc)
+        got = self._L.fdb_idx_union(
+            self._h, key, len(key), n,
+            (ctypes.c_char_p * n)(*enc),
+            (ctypes.c_long * n)(*[len(v) for v in enc]),
+            start_ts, end_ts, optr, cap,
+        )
+        return out[: min(got, cap)]
+
+    def _values_with_prefix(self, key: bytes, prefix: bytes) -> list[str]:
+        cap = 1 << 16
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            used = ctypes.c_long(0)
+            n = self._L.fdb_idx_values_prefix(
+                self._h, key, len(key), prefix, len(prefix),
+                buf, cap, ctypes.byref(used),
+            )
+            if used.value <= cap:
+                break
+            cap = used.value + 16
+        out = []
+        raw = buf.raw
+        off = 0
+        for _ in range(n):
+            ln = int.from_bytes(raw[off : off + 4], "little")
+            out.append(raw[off + 4 : off + 4 + ln].decode())
+            off += 4 + ln
+        return out
 
     def _query_native(self, eq_filters, start_ts, end_ts) -> np.ndarray:
         n = len(eq_filters)

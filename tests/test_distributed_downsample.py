@@ -1,0 +1,162 @@
+"""Distributed batch downsampler (downsample/distributed.py): 2-process
+jobs with atomic shard commits, claim heartbeats, stale-claim breaking, and
+kill/resume (reference spark-jobs DownsamplerMain over executors +
+CassandraColumnStore.getScanSplits:500 work splitting)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from filodb_tpu.core.schemas import Dataset
+from filodb_tpu.downsample.distributed import (
+    _claim_path,
+    _job_dir,
+    job_complete,
+    member_ordered_shards,
+    run_worker,
+)
+from filodb_tpu.downsample.downsampler import (
+    ShardDownsampler,
+    batch_downsample,
+)
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.store.columnstore import LocalColumnStore
+from filodb_tpu.store.flush import FlushCoordinator, recover_shard
+from filodb_tpu.testkit import machine_metrics
+
+BASE = 1_600_000_000_000
+PERIODS = (300_000,)  # 5m
+
+
+def _seed_store(root, n_shards=4, n_series=6, n_samples=400):
+    from filodb_tpu.memstore.shard import StoreConfig
+
+    store = LocalColumnStore(str(root))
+    ms = TimeSeriesMemStore(StoreConfig(max_chunk_size=100))
+    ms.setup(Dataset("ds"), range(n_shards))
+    for s in range(n_shards):
+        ms.ingest("ds", s, machine_metrics(
+            n_series=n_series, n_samples=n_samples, start_ms=BASE + s,
+        ))
+    fc = FlushCoordinator(ms, store)
+    for s in range(n_shards):
+        fc.flush_shard("ds", s)
+    return store, ms
+
+
+def _oracle_totals(store, ms, n_shards):
+    """Single-process batch_downsample result: per-shard sample totals and
+    value checksums of the 5m dataset."""
+    dsm = TimeSeriesMemStore()
+    d = ShardDownsampler(dsm, "ds", periods_ms=PERIODS)
+    batch_downsample(store, ms, "ds", range(n_shards), dsm, d)
+    out = {}
+    for s in range(n_shards):
+        sh = dsm.shard("ds_5m", s)
+        tot = 0.0
+        n = 0
+        for pid in sh.lookup_partitions([], 0, 2**62):
+            ts, vals = sh.partition(int(pid)).samples_in_range(0, 2**62, "avg")
+            tot += float(np.nansum(vals))
+            n += len(ts)
+        out[s] = (n, round(tot, 6))
+    return out
+
+
+def _recovered_totals(root, n_shards):
+    store = LocalColumnStore(str(root))
+    dsm = TimeSeriesMemStore()
+    dsm.setup(Dataset("ds_5m"), range(n_shards))
+    out = {}
+    for s in range(n_shards):
+        recover_shard(dsm, store, "ds_5m", s)
+        sh = dsm.shard("ds_5m", s)
+        tot = 0.0
+        n = 0
+        for pid in sh.lookup_partitions([], 0, 2**62):
+            ts, vals = sh.partition(int(pid)).samples_in_range(0, 2**62, "avg")
+            tot += float(np.nansum(vals))
+            n += len(ts)
+        out[s] = (n, round(tot, 6))
+    return out
+
+
+def test_two_workers_split_the_job(tmp_path):
+    store, ms = _seed_store(tmp_path)
+    want = _oracle_totals(store, ms, 4)
+    r1 = run_worker(str(tmp_path), "ds", range(4), PERIODS, worker_id="w1",
+                    members=["w1", "w2"], self_url="w1")
+    r2 = run_worker(str(tmp_path), "ds", range(4), PERIODS, worker_id="w2",
+                    members=["w1", "w2"], self_url="w2")
+    assert sorted(r1.shards_done + r2.shards_done) == [0, 1, 2, 3]
+    assert job_complete(str(tmp_path), "ds", range(4))
+    assert _recovered_totals(tmp_path, 4) == want
+
+
+def test_rerun_skips_committed_shards(tmp_path):
+    store, ms = _seed_store(tmp_path)
+    r1 = run_worker(str(tmp_path), "ds", range(4), PERIODS, worker_id="w1")
+    assert sorted(r1.shards_done) == [0, 1, 2, 3]
+    r2 = run_worker(str(tmp_path), "ds", range(4), PERIODS, worker_id="w2")
+    assert r2.shards_done == [] and sorted(r2.shards_skipped) == [0, 1, 2, 3]
+
+
+def test_member_ordering_disjoint_start():
+    a = member_ordered_shards(range(8), ["u1", "u2"], "u1")
+    b = member_ordered_shards(range(8), ["u1", "u2"], "u2")
+    assert set(a[:4]).isdisjoint(b[:4])
+    assert sorted(a) == sorted(b) == list(range(8))
+
+
+def test_stale_claim_broken_fresh_claim_respected(tmp_path):
+    _seed_store(tmp_path, n_shards=1)
+    job = _job_dir(str(tmp_path), "ds", "default")
+    os.makedirs(job, exist_ok=True)
+    # a fresh claim by a live worker blocks the shard
+    with open(_claim_path(job, 0), "w") as f:
+        json.dump({"worker": "alive"}, f)
+    r = run_worker(str(tmp_path), "ds", [0], PERIODS, worker_id="w2",
+                   stale_s=60.0)
+    assert r.shards_done == [] and r.shards_skipped == [0]
+    # backdate the claim beyond stale_s: the straggler gets reassigned
+    old = os.path.getmtime(_claim_path(job, 0)) - 120
+    os.utime(_claim_path(job, 0), (old, old))
+    r = run_worker(str(tmp_path), "ds", [0], PERIODS, worker_id="w2",
+                   stale_s=60.0)
+    assert r.shards_done == [0] and r.claims_broken == [0]
+
+
+def test_kill_and_resume_two_processes(tmp_path):
+    """The done-criterion from the round verdict: worker 1 is KILLED while
+    holding a claim (no commit); worker 2 breaks the stale claim, redoes
+    the shard, and the final store equals the single-process oracle."""
+    store, ms = _seed_store(tmp_path)
+    want = _oracle_totals(store, ms, 4)
+    env = dict(
+        os.environ, FILODB_DS_CRASH_AFTER_CLAIM="2",
+        JAX_PLATFORMS="cpu", FILODB_PLATFORM="cpu",
+    )
+    code = (
+        "import jax; jax.config.update('jax_platforms','cpu')\n"
+        "from filodb_tpu.downsample.distributed import run_worker\n"
+        f"run_worker({str(tmp_path)!r}, 'ds', range(4), (300000,), "
+        "worker_id='victim')\n"
+    )
+    p = subprocess.run([sys.executable, "-c", code], env=env, timeout=300,
+                       capture_output=True, text=True)
+    assert p.returncode == 17, p.stderr[-500:]
+    job = _job_dir(str(tmp_path), "ds", "default")
+    assert os.path.exists(_claim_path(job, 2)), "victim died holding a claim"
+    assert not os.path.exists(os.path.join(job, "shard-2.done"))
+    # backdate the orphaned claim (stand-in for waiting out stale_s)
+    old = os.path.getmtime(_claim_path(job, 2)) - 120
+    os.utime(_claim_path(job, 2), (old, old))
+    r2 = run_worker(str(tmp_path), "ds", range(4), PERIODS,
+                    worker_id="rescuer", stale_s=60.0)
+    assert 2 in r2.shards_done and 2 in r2.claims_broken
+    assert job_complete(str(tmp_path), "ds", range(4))
+    assert _recovered_totals(tmp_path, 4) == want

@@ -1,0 +1,131 @@
+"""Native range-aware regex index path (native/index.cpp prefix-range scan
++ union; reference: tantivy_utils' range-aware regex,
+PartKeyTantivyIndex.scala:38). The prefix extraction must be SAFE — a wrong
+prefix silently drops matching series — so the nasty cases (quantifier
+eating the last literal char, alternations bypassing the prefix) are pinned
+here in addition to the randomized backend-parity fuzzing."""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.core.filters import ColumnFilter, regex
+from filodb_tpu.memstore.index import PartKeyIndex
+
+pytest.importorskip("filodb_tpu.memstore.index_native")
+from filodb_tpu.memstore.index_native import (  # noqa: E402
+    NativePartKeyIndex,
+    native_index_available,
+    regex_literal_prefix,
+)
+
+if not native_index_available():  # pragma: no cover
+    pytest.skip("native index unavailable", allow_module_level=True)
+
+
+@pytest.mark.parametrize("pattern,prefix", [
+    ("http_5.*", "http_5"),
+    ("http_.*_total", "http_"),
+    ("abc", "abc"),
+    ("ab*", "a"),        # * makes the b optional
+    ("ab?", "a"),
+    ("ab{0,2}", "a"),
+    ("ab+", "ab"),       # + requires at least one b
+    ("a|b", ""),         # alternation bypasses any prefix
+    ("abc|z", ""),
+    ("ab(c|d)e", ""),    # nested alternation: conservative collapse
+    (".*foo", ""),
+    (r"ab\.c", "ab"),    # escape stops the literal run (conservative)
+    ("", ""),
+])
+def test_literal_prefix_extraction(pattern, prefix):
+    got, _ = regex_literal_prefix(pattern)
+    assert got == prefix, pattern
+
+
+def _build(idx_cls, values):
+    idx = idx_cls()
+    for pid, v in enumerate(values):
+        idx.add_partkey(pid, {"m": v, "dc": f"d{pid % 3}"}, 0, 10_000)
+    return idx
+
+
+VALUES = [
+    "http_requests_total", "http_errors_total", "http_500", "http_5xx",
+    "grpc_requests", "a", "ab", "abb", "abc", "z", "foo", "xfoo",
+    "e1", "e2", "e3", "ab.c", "abXc",
+]
+
+PATTERNS = [
+    "http_.*", "http_5.*", "http_.*_total", "ab*", "ab+", "ab?", "abc",
+    "a|b", "abc|z", "ab(c|d)", ".*foo", r"ab\.c", "e1|e2", "http_[0-9]+",
+    "h.*_5.*",
+]
+
+
+@pytest.mark.parametrize("pattern", PATTERNS)
+def test_regex_parity_with_python_index(pattern):
+    py = _build(PartKeyIndex, VALUES)
+    nat = _build(NativePartKeyIndex, VALUES)
+    f = [regex("m", pattern)]
+    want = py.part_ids_from_filters(f, 0, 20_000)
+    got = nat.part_ids_from_filters(f, 0, 20_000)
+    np.testing.assert_array_equal(got, want, err_msg=pattern)
+
+
+@pytest.mark.parametrize("pattern", PATTERNS)
+def test_regex_and_equality_parity(pattern):
+    py = _build(PartKeyIndex, VALUES)
+    nat = _build(NativePartKeyIndex, VALUES)
+    f = [regex("m", pattern), ColumnFilter("dc", "=", "d1")]
+    want = py.part_ids_from_filters(f, 0, 20_000)
+    got = nat.part_ids_from_filters(f, 0, 20_000)
+    np.testing.assert_array_equal(got, want, err_msg=pattern)
+
+
+@pytest.mark.parametrize("idx_cls", [PartKeyIndex, NativePartKeyIndex])
+def test_metachar_patterns_never_take_literal_shortcut(idx_cls):
+    """'ab+' and 'h.1' contain metacharacters: both backends must regex-
+    match them (the old _LITERAL_ALT classed '.'/'+' as literals and looked
+    the pattern up verbatim — wrong results in BOTH backends)."""
+    idx = _build(idx_cls, ["ab", "abb", "h01", "hx1", "h.1", "ab+"])
+    got = idx.part_ids_from_filters([regex("m", "ab+")], 0, 20_000)
+    assert got.tolist() == [0, 1], "ab+ must match ab and abb"
+    got = idx.part_ids_from_filters([regex("m", "h.1")], 0, 20_000)
+    assert got.tolist() == [2, 3, 4], "h.1 must match h01, hx1 AND h.1"
+    got = idx.part_ids_from_filters([regex("m", "ab|abb")], 0, 20_000)
+    assert got.tolist() == [0, 1]
+
+
+def test_time_overlap_applies_to_regex_union():
+    nat = NativePartKeyIndex()
+    nat.add_partkey(0, {"m": "http_a"}, 0, 100)
+    nat.add_partkey(1, {"m": "http_b"}, 200, 300)
+    got = nat.part_ids_from_filters([regex("m", "http_.*")], 150, 400)
+    np.testing.assert_array_equal(got, [1])
+
+
+def test_empty_matching_regex_stays_on_python_path():
+    """Patterns matching the empty string must also match series MISSING
+    the tag — the native union can't see those, so the python path must
+    answer (and it does, identically to the python backend)."""
+    py = _build(PartKeyIndex, VALUES)
+    nat = _build(NativePartKeyIndex, VALUES)
+    for idx in (py, nat):
+        idx.add_partkey(900, {"other": "x"}, 0, 10_000)  # no "m" tag
+    f = [regex("m", ".*")]
+    want = py.part_ids_from_filters(f, 0, 20_000)
+    got = nat.part_ids_from_filters(f, 0, 20_000)
+    np.testing.assert_array_equal(got, want)
+    assert 900 in got.tolist()
+
+
+def test_values_prefix_buffer_regrowth():
+    """The packed-values buffer must regrow when 64 KiB overflows."""
+    nat = NativePartKeyIndex()
+    long_vals = [f"metric_{'x' * 200}_{i:05d}" for i in range(600)]
+    for pid, v in enumerate(long_vals):
+        nat.add_partkey(pid, {"m": v}, 0, 10_000)
+    got = nat._values_with_prefix(b"m", b"metric_")
+    assert sorted(got) == sorted(long_vals)
+    ids = nat.part_ids_from_filters([regex("m", "metric_.*")], 0, 20_000)
+    assert len(ids) == 600
