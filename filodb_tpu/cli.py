@@ -124,6 +124,7 @@ def cmd_downsample_batch(args):
         _print({
             "worker": rep.worker_id, "shards_done": rep.shards_done,
             "shards_skipped": rep.shards_skipped,
+            "shards_failed": rep.shards_failed,
             "claims_broken": rep.claims_broken, "samples": rep.samples,
             "job_complete": job_complete(args.store, args.dataset,
                                          shard_nums, args.job_label),

@@ -44,6 +44,7 @@ class WorkerReport:
     worker_id: str
     shards_done: list = field(default_factory=list)
     shards_skipped: list = field(default_factory=list)
+    shards_failed: list = field(default_factory=list)
     claims_broken: list = field(default_factory=list)
     samples: int = 0
 
@@ -79,13 +80,19 @@ def _try_claim(job: str, shard: int, worker_id: str, stale_s: float,
         return False  # claim vanished: owner just finished or released
     if age <= stale_s:
         return False
-    # stale heartbeat: break the claim by atomic replace — exactly one of
-    # several concurrent breakers wins the subsequent O_EXCL retry because
-    # the unlink+create race leaves at most one creator succeeding
+    # stale heartbeat: STEAL the claim with an atomic rename of the stale
+    # file — rename of one source path succeeds for exactly ONE of several
+    # concurrent breakers (the losers get FileNotFoundError), so two
+    # breakers can never both claim the shard (unlink+recreate could)
+    stolen = path + f".stolen-{worker_id}-{os.getpid()}"
     try:
-        os.unlink(path)
+        os.rename(path, stolen)
     except OSError:
-        return False
+        return False  # another breaker won (or the owner just finished)
+    try:
+        os.unlink(stolen)
+    except OSError:
+        pass
     try:
         fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
         os.write(fd, payload)
@@ -159,8 +166,20 @@ def _flush_shard_output(store_root: str, dataset: str, shard: int,
         src = os.path.join(staging_root, ds, f"shard-{shard}")
         dst = os.path.join(store_root, ds, f"shard-{shard}")
         os.makedirs(os.path.dirname(dst), exist_ok=True)
-        shutil.rmtree(dst, ignore_errors=True)  # leftovers of a dead worker
-        os.rename(src, dst)
+        # a stalled-but-alive previous owner can commit concurrently with a
+        # redo (its heartbeat went stale, its claim was stolen, but its
+        # process survived): rmtree+rename can then race another committer
+        # and rename hits a re-created non-empty dst — retry a few times;
+        # both candidate outputs are equivalent (same input chunks)
+        for attempt in range(4):
+            shutil.rmtree(dst, ignore_errors=True)
+            try:
+                os.rename(src, dst)
+                break
+            except OSError:
+                if attempt == 3:
+                    raise
+                time.sleep(0.05 * (attempt + 1))
     shutil.rmtree(staging_root, ignore_errors=True)
     return n
 
@@ -219,6 +238,11 @@ def run_worker(store_root: str, dataset: str, shard_nums, periods_ms,
                            "t": time.time()}, f)
             report.shards_done.append(shard)
             report.samples += n
+        except Exception:
+            # one shard's failure (e.g. losing a concurrent-commit race to
+            # a stalled-but-alive previous owner) must not abort the whole
+            # worker: no done marker is left, so the shard gets redone
+            report.shards_failed.append(shard)
         finally:
             stop_hb.set()
             hb.join(timeout=heartbeat_s + 1)

@@ -161,17 +161,61 @@ class TimeSeriesShard:
 
     # -- ingest ------------------------------------------------------------
 
+    def _invalidate_stage_range(self, min_ts, max_ts, new_series: bool) -> None:
+        """Drop only the staging-cache entries the new samples can affect.
+
+        A dashboard's historical panels must not pay a full re-stage for
+        every live scrape that lands BEYOND their range: an entry staged
+        for [start, end] stays valid unless (a) the ingest's EFFECT
+        interval overlaps it, or (b) a NEW series appeared (it might match
+        the entry's filters). The effect interval of an append to an
+        existing series starts at the series' PREVIOUS newest sample, not
+        at the new sample: extending a gap series' index span can pull it
+        into a cached range it previously missed entirely, and the cached
+        block's row set would no longer match a fresh partition lookup.
+        Eviction/ODP paths still clear wholesale (they change resident
+        data in place). Caller holds the shard lock."""
+        if new_series or min_ts is None:
+            self.stage_cache.clear()
+            return
+        stale = [
+            k for k in self.stage_cache
+            if k[1] <= max_ts and k[2] >= min_ts  # k = (filters, start, end, ...)
+        ]
+        for k in stale:
+            del self.stage_cache[k]
+
+    def _prev_end_of(self, partkey) -> int | None:
+        """Newest sample ts of an existing series (None for a new one)."""
+        pid = self._by_partkey.get(partkey)
+        if pid is None:
+            return None
+        try:
+            return int(self.partitions[pid].latest_ts())
+        except (KeyError, ValueError):
+            return None
+
     def ingest(self, batch: RecordBatch, offset: int = -1) -> int:
         """Ingest a columnar record batch (reference ingest:939). Returns rows
         ingested. Records are grouped by series then appended in bulk."""
         n = 0
         with self._lock:
+            np0 = len(self.partitions)
+            min_ts = max_ts = None
             for sb in batch.group_by_series():
+                prev_end = self._prev_end_of(sb.partkey)
                 n += self._ingest_series(sb)
+                if len(sb.timestamps):
+                    lo, hi = int(sb.timestamps.min()), int(sb.timestamps.max())
+                    if prev_end is not None:
+                        lo = min(lo, prev_end)
+                    min_ts = lo if min_ts is None else min(min_ts, lo)
+                    max_ts = hi if max_ts is None else max(max_ts, hi)
             if offset >= 0:
                 self._ingested_offset = max(self._ingested_offset, offset)
             self.version += 1
-            self.stage_cache.clear()
+            self._invalidate_stage_range(min_ts, max_ts,
+                                         len(self.partitions) != np0)
         self.stats.rows_ingested += n
         # periodic headroom check on the ingest path (reference
         # ensureFreeSpace runs inside the ingest loop). The full O(partitions)
@@ -188,8 +232,20 @@ class TimeSeriesShard:
     def ingest_series(self, sb: SeriesBatch) -> int:
         with self._lock:
             self.version += 1
-            self.stage_cache.clear()
-            return self._ingest_series(sb)
+            np0 = len(self.partitions)
+            prev_end = self._prev_end_of(sb.partkey)
+            n = self._ingest_series(sb)
+            if len(sb.timestamps):
+                lo = int(sb.timestamps.min())
+                if prev_end is not None:
+                    lo = min(lo, prev_end)
+                self._invalidate_stage_range(
+                    lo, int(sb.timestamps.max()),
+                    len(self.partitions) != np0,
+                )
+            else:
+                self.stage_cache.clear()
+            return n
 
     def _ingest_series(self, sb: SeriesBatch) -> int:
         pk = sb.partkey

@@ -222,13 +222,20 @@ class SelectRawPartitionsExec(ExecPlan):
                 else "raw"
             )
             # staging cache: repeated queries over the same selection reuse
-            # the HBM-resident decoded block until new data arrives (the
-            # north-star "decoded chunk windows staged to HBM")
+            # the HBM-resident decoded block until new data LANDS IN RANGE
+            # (the north-star "decoded chunk windows staged to HBM"; the
+            # shard invalidates overlapping entries selectively on ingest —
+            # shard._invalidate_stage_range — so live scrapes beyond a
+            # historical panel's range never force a re-stage). NOTE: key
+            # layout (filters, start_ms, end_ms, ...) is load-bearing for
+            # that overlap check.
             cache_key = (
                 self.filters, self.start_ms, self.end_ms, col_name, schema_name,
-                shard.version, stage_mode,
+                stage_mode,
             )
-            hit = shard.stage_cache.get(cache_key)
+            with shard._lock:
+                hit = shard.stage_cache.get(cache_key)
+                version_at_stage = shard.version
             if hit is not None:
                 block = hit[0]
             else:
@@ -244,13 +251,21 @@ class SelectRawPartitionsExec(ExecPlan):
                 ctx.stats.bytes_staged += nbytes
                 block.to_device()
                 # byte-budgeted eviction, oldest entry first (the staging
-                # analog of BlockManager reclaim under memory pressure)
-                budget = getattr(shard.config, "stage_cache_bytes", 2 << 30)
-                used = sum(b for _, b in shard.stage_cache.values())
-                while shard.stage_cache and used + nbytes > budget:
-                    oldest = next(iter(shard.stage_cache))
-                    used -= shard.stage_cache.pop(oldest)[1]
-                shard.stage_cache[cache_key] = (block, nbytes)
+                # analog of BlockManager reclaim under memory pressure).
+                # All cache mutations run under the shard lock (the shard's
+                # selective invalidation iterates the dict under it), and a
+                # block staged concurrently with ANY ingest is used for this
+                # query but never cached — an in-range sample that landed
+                # mid-stage already ran its invalidation, which could not
+                # see this not-yet-inserted entry.
+                with shard._lock:
+                    if shard.version == version_at_stage:
+                        budget = getattr(shard.config, "stage_cache_bytes", 2 << 30)
+                        used = sum(b for _, b in shard.stage_cache.values())
+                        while shard.stage_cache and used + nbytes > budget:
+                            oldest = next(iter(shard.stage_cache))
+                            used -= shard.stage_cache.pop(oldest)[1]
+                        shard.stage_cache[cache_key] = (block, nbytes)
             ctx.stats.series_scanned += len(ids)
             ctx.stats.samples_scanned += int(np.asarray(block.lens).sum())
             if ctx.stats.samples_scanned > ctx.max_samples:

@@ -1,0 +1,132 @@
+"""Selective staging-cache invalidation (memstore/shard.py
+_invalidate_stage_range): live scrapes landing BEYOND a cached query range
+must not evict it (the dashboard-historical-panel-under-ingest cost), while
+anything that can change the cached block's content must."""
+
+import numpy as np
+import pytest
+
+import filodb_tpu.ops.staging as ST
+from filodb_tpu.coordinator.planner import QueryEngine
+from filodb_tpu.core.records import SeriesBatch
+from filodb_tpu.core.schemas import Dataset, GAUGE, METRIC_TAG
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.testkit import machine_metrics
+
+BASE = 1_600_000_000_000
+
+
+@pytest.fixture
+def setup():
+    ms = TimeSeriesMemStore()
+    ms.setup(Dataset("ds"), [0])
+    ms.ingest("ds", 0, machine_metrics(n_series=6, n_samples=200, start_ms=BASE))
+    engine = QueryEngine(ms, "ds")
+    return ms, engine, ms.shard("ds", 0)
+
+
+def _stage_calls(monkeypatch):
+    calls = []
+    orig = ST.stage_from_shard
+
+    def spy(*a, **k):
+        calls.append(1)
+        return orig(*a, **k)
+
+    monkeypatch.setattr(ST, "stage_from_shard", spy)
+    return calls
+
+
+def _append(ms, tags, ts, vals):
+    ms.shard("ds", 0).ingest_series(
+        SeriesBatch(GAUGE, dict(tags), np.asarray(ts, np.int64),
+                    {"value": np.asarray(vals, np.float64)})
+    )
+
+
+def _existing_tags(shard):
+    pid = int(shard.lookup_partitions([], 0, 2**62)[0])
+    return dict(shard.partition(pid).tags)
+
+
+def _new_series_tags(tags):
+    return dict(tags, instance="brand-new-host")
+
+
+def test_append_beyond_range_keeps_cache(setup, monkeypatch):
+    ms, engine, shard = setup
+    s, e = (BASE + 400_000) / 1000, (BASE + 1_100_000) / 1000
+    q = "sum(heap_usage0)"
+    want = engine.query_range(q, s, e, 60).grids[0].values_np().copy()
+    calls = _stage_calls(monkeypatch)
+    tags = _existing_tags(shard)
+    # new samples strictly beyond the staged range (raw end = e)
+    _append(ms, tags, [BASE + 5_000_000], [1.0])
+    got = engine.query_range(q, s, e, 60).grids[0].values_np()
+    assert calls == [], "historical range must stay cached"
+    np.testing.assert_array_equal(got, want)
+
+
+def test_append_into_range_invalidates(setup, monkeypatch):
+    """A live-edge panel (range end past the newest sample) must re-stage
+    when a fresh scrape lands inside its range."""
+    ms, engine, shard = setup
+    s, e = (BASE + 400_000) / 1000, (BASE + 2_500_000) / 1000
+    q = "sum(heap_usage0)"
+    before = engine.query_range(q, s, e, 60).grids[0].values_np().copy()
+    calls = _stage_calls(monkeypatch)
+    tags = _existing_tags(shard)
+    # newer than the series head (not out-of-order) AND inside [s, e]
+    _append(ms, tags, [BASE + 2_200_000], [1000.0])
+    got = engine.query_range(q, s, e, 60).grids[0].values_np()
+    assert calls, "in-range sample must re-stage"
+    assert not np.array_equal(got, before), "new in-range data must show up"
+
+
+def test_new_series_invalidates_even_beyond_range(setup, monkeypatch):
+    ms, engine, shard = setup
+    s, e = (BASE + 400_000) / 1000, (BASE + 1_100_000) / 1000
+    q = "sum(heap_usage0)"
+    engine.query_range(q, s, e, 60)
+    calls = _stage_calls(monkeypatch)
+    # a NEW series could match any cached filter set: conservative clear
+    _append(ms, _new_series_tags(_existing_tags(shard)),
+            [BASE + 5_000_000], [1.0])
+    engine.query_range(q, s, e, 60)
+    assert calls, "new series must invalidate"
+
+
+def test_gap_series_span_extension_invalidates(setup, monkeypatch):
+    """Reviewer-found hazard: a sample BEYOND the cached range can extend a
+    gap series' index span so it newly overlaps the range — the cached
+    block's row set would then disagree with a fresh partition lookup. The
+    effect interval must start at the series' PREVIOUS newest sample."""
+    ms, engine, shard = setup
+    tags = _existing_tags(shard)
+    # gap series: one old sample long before the queried range
+    gap = dict(tags, instance="gap-host")
+    _append(ms, gap, [BASE + 100_000], [1.0])
+    s, e = (BASE + 2_600_000) / 1000, (BASE + 3_200_000) / 1000
+    q = "count(last_over_time(heap_usage0[40m]))"
+    r1 = engine.query_range(q, s, e, 60).grids[0].values_np().copy()
+    calls = _stage_calls(monkeypatch)
+    # new sample BEYOND the cached range extends gap-host's span across it
+    _append(ms, gap, [BASE + 5_000_000], [2.0])
+    r2 = engine.query_range(q, s, e, 60).grids[0].values_np()
+    assert calls, "span-crossing append must re-stage"
+    # and the fresh result must be consistent (same or more series counted,
+    # never a row/label mismatch crash)
+    assert r2.shape == r1.shape
+
+
+def test_results_track_in_range_ingest_for_existing_series(setup, monkeypatch):
+    ms, engine, shard = setup
+    tags = _existing_tags(shard)
+    s, e = (BASE + 400_000) / 1000, (BASE + 2_500_000) / 1000
+    q = f'sum(heap_usage0{{instance="{tags["instance"]}"}})'
+    before = engine.query_range(q, s, e, 60).grids[0].values_np().copy()
+    # append within the (wide) cached range for the EXISTING series
+    _append(ms, tags, [BASE + 2_200_000, BASE + 2_300_000], [500.0, 500.0])
+    after = engine.query_range(q, s, e, 60).grids[0].values_np()
+    assert not np.array_equal(after, before), \
+        "in-range append to an existing series must be visible immediately"
