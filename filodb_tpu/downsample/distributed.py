@@ -241,7 +241,16 @@ def run_worker(store_root: str, dataset: str, shard_nums, periods_ms,
         except Exception:
             # one shard's failure (e.g. losing a concurrent-commit race to
             # a stalled-but-alive previous owner) must not abort the whole
-            # worker: no done marker is left, so the shard gets redone
+            # worker: no done marker is left, so the shard gets redone.
+            # The cause is logged — a deterministic failure (corrupt chunk)
+            # must be distinguishable from the benign race
+            import logging
+            import traceback
+
+            logging.getLogger(__name__).error(
+                "downsample worker %s: shard %s failed\n%s",
+                worker_id, shard, traceback.format_exc(),
+            )
             report.shards_failed.append(shard)
         finally:
             stop_hb.set()

@@ -397,6 +397,12 @@ class TimeSeriesShard:
                 self.evicted_keys.discard(part.partkey)
                 self.evictable.remove(pid)
                 self.stats.partitions_evicted += 1
+            if dropped or dead:
+                # resident data changed in place: cached staged blocks may
+                # hold evicted samples/partitions (the staging cache has no
+                # version in its key — invalidation is the contract)
+                self.version += 1
+                self.stage_cache.clear()
         return dropped
 
     def add_exemplar(self, partkey: bytes, ts_ms: int, value: float, labels) -> bool:

@@ -216,6 +216,35 @@ class TestTwoServerPartials:
             ok = ~np.isnan(w)
             np.testing.assert_allclose(g[ok], w[ok], rtol=1e-4, err_msg=q)
 
+    def test_histogram_sum_rate_matches_single_host(self, cluster):
+        """Native-histogram sum across peers: the peer ships per-group
+        bucket-cube partials (__comp__=hist riding the hist field), not raw
+        bucket series."""
+        a, _, oracle = cluster
+        from filodb_tpu.testkit import histogram_batch
+
+        for srv in (a.memstore, cluster[1].memstore):
+            srv.ingest_routed(
+                "prometheus",
+                histogram_batch(n_series=12, n_samples=60, start_ms=START),
+                spread=3,
+            )
+        ms_o = oracle.memstore
+        ms_o.ingest_routed(
+            "prometheus",
+            histogram_batch(n_series=12, n_samples=60, start_ms=START),
+            spread=3,
+        )
+        s, e = START / 1000 + 400, START / 1000 + 580
+        q = "histogram_quantile(0.9, sum(rate(http_request_latency[5m])))"
+        want = self._grids_map(oracle.query_range(q, s, e, 60))
+        got = self._grids_map(a.engine.query_range(q, s, e, 60))
+        assert want.keys() == got.keys()
+        for k in want:
+            w, g = want[k], got[k]
+            ok = ~np.isnan(w)
+            np.testing.assert_allclose(g[ok], w[ok], rtol=1e-4)
+
     def test_quantile_matches_single_host_within_sketch_error(self, cluster):
         a, _, oracle = cluster
         s, e = START / 1000 + 400, START / 1000 + 1100
