@@ -100,6 +100,21 @@ class EvictablePartIdQueueSet:
         return part_id in self._q
 
 
+@dataclass
+class StageEntry:
+    """One staging-cache entry: an HBM-resident staged block plus a dirty
+    flag set by in-range ingests since it was built. Dirty entries get
+    incrementally repaired — or restaged when repair preconditions fail —
+    at next use (query/exec/plans.py); ``repairing`` marks an in-flight
+    repair so concurrent same-key queries restage instead of serving the
+    pre-repair block."""
+
+    block: object
+    nbytes: int
+    dirty: bool = False
+    repairing: bool = False
+
+
 class TimeSeriesShard:
     def __init__(self, dataset: str, shard_num: int, config: StoreConfig | None = None):
         self.dataset = dataset
@@ -115,6 +130,7 @@ class TimeSeriesShard:
         self.cardinality = CardinalityTracker()
         self._lock = threading.RLock()
         self._ingested_offset = -1  # stream offset watermark (Kafka analog)
+        # entries are StageEntry objects (block + bytes + dirty interval)
         # data version for query-side staging caches: bumped on every ingest
         # so cached HBM-resident blocks invalidate (reference analog: block
         # memory reclaim + chunk seal versioning)
@@ -162,28 +178,32 @@ class TimeSeriesShard:
     # -- ingest ------------------------------------------------------------
 
     def _invalidate_stage_range(self, min_ts, max_ts, new_series: bool) -> None:
-        """Drop only the staging-cache entries the new samples can affect.
+        """Dirty-mark (not drop) the staging-cache entries the new samples
+        can affect.
 
         A dashboard's historical panels must not pay a full re-stage for
         every live scrape that lands BEYOND their range: an entry staged
         for [start, end] stays valid unless (a) the ingest's EFFECT
         interval overlaps it, or (b) a NEW series appeared (it might match
-        the entry's filters). The effect interval of an append to an
-        existing series starts at the series' PREVIOUS newest sample, not
-        at the new sample: extending a gap series' index span can pull it
-        into a cached range it previously missed entirely, and the cached
-        block's row set would no longer match a fresh partition lookup.
-        Eviction/ODP paths still clear wholesale (they change resident
-        data in place). Caller holds the shard lock."""
+        the entry's filters — conservative full clear). The effect interval
+        of an append to an existing series starts at the series' PREVIOUS
+        newest sample, not at the new sample: extending a gap series' span
+        can pull it into a cached range it previously missed entirely, and
+        the cached block's row set would no longer match a fresh lookup.
+
+        Overlapping entries are marked DIRTY with the accumulated effect
+        interval instead of deleted: the next query attempts an INCREMENTAL
+        append repair (ops/staging.append_to_block — live-edge panels pay
+        only the tail, reference's equivalent is serving straight from
+        write buffers) and falls back to a full re-stage when repair
+        preconditions fail. Eviction/ODP paths still clear wholesale (they
+        change resident data in place). Caller holds the shard lock."""
         if new_series or min_ts is None:
             self.stage_cache.clear()
             return
-        stale = [
-            k for k in self.stage_cache
-            if k[1] <= max_ts and k[2] >= min_ts  # k = (filters, start, end, ...)
-        ]
-        for k in stale:
-            del self.stage_cache[k]
+        for k, entry in self.stage_cache.items():
+            if k[1] <= max_ts and k[2] >= min_ts:  # k = (filters, start, end, ...)
+                entry.dirty = True
 
     def _prev_end_of(self, partkey) -> int | None:
         """Newest sample ts of an existing series (None for a new one)."""

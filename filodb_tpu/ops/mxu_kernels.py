@@ -107,19 +107,73 @@ class WindowMatrices:
         self.t_last2 = np.where(has2, ts[np.clip(hi - 2, 0, len(ts) - 1)], pad)
         self.out_t = out_t.astype(np.float64)
         self.window_ms = window_ms
-        # centered seconds for regression functions
-        tc = (ts1.astype(np.float64)[:, None] - out_t[None, :]) * 1e-3
-        self.Wt = (W * tc).astype(np.float32)
-        self.st = self.Wt.sum(0)
-        self.stt = (W * tc * tc).sum(0).astype(np.float64)
-        # pair-membership for changes/resets: pairs (t-1, t) with both in window
-        P = ((tidx > lo[None, :]) & (tidx < hi[None, :])).astype(np.float32)
+        self._ts1 = ts1
+        self._lo, self._hi, self._T, self._J = lo, hi, T, J
+        # gather-form of the one-hot selections for backends where a gather
+        # beats a matmul (CPU; the TPU branch keeps the MXU one-hots):
+        # row 0 = first-sample, 1 = last, 2 = second-to-last positions.
+        # Out-of-range windows clip to valid positions; every use is gated
+        # by has/count masks, matching the one-hot's all-zero columns.
+        self.idx = np.stack([
+            np.clip(lo, 0, T - 1),
+            np.clip(hi - 1, 0, T - 1),
+            np.clip(hi - 2, 0, T - 1),
+        ]).astype(np.int32)
+        # device-resident copies (transferred once, reused every query)
+        import jax
+
+        put = jax.device_put
+        self.dW, self.dF, self.dL, self.dL2 = map(put, (W, F, L, L2))
+        self.d_count = put(cnt)
+        self.d_tf = put(np.nan_to_num(self.t_first, nan=0.0).astype(np.float32))
+        self.d_tl = put(np.nan_to_num(self.t_last, nan=0.0).astype(np.float32))
+        self.d_tl2 = put(np.nan_to_num(self.t_last2, nan=0.0).astype(np.float32))
+        self.d_out_t = put(self.out_t.astype(np.float32))
+        self.d_idx = put(self.idx)
+        # the heavyweight structures below (min/max edge one-hots ~ [T, 32J],
+        # pair membership, regression moments) build LAZILY on first use:
+        # sum/rate dashboards never pay for them, and live-edge append
+        # repairs rebuild window matrices on every grid extension
+        self._pairs_built = False
+        self._minmax_built = False
+        self._regression_built = False
+
+    def ensure_pairs(self):
+        """P: pair-membership for changes/resets (lazy)."""
+        if self._pairs_built:
+            return
+        import jax
+
+        tidx = np.arange(self._T)[:, None]
+        P = ((tidx > self._lo[None, :]) & (tidx < self._hi[None, :])).astype(np.float32)
         self.P = P
-        # min/max hierarchy: per window, full _TILE-wide tiles are reduced
-        # from precomputed tile mins; the <=2*_TILE edge samples are fetched
-        # by a selection one-hot MATMUL (gathers are pathologically slow on
-        # this backend; a one-hot matmul is an MXU-speed gather)
-        Lt = _TILE  # (distinct name: L above is the last-sample one-hot)
+        self.dP = jax.device_put(P)
+        self._pairs_built = True
+
+    def ensure_regression(self):
+        """Centered time moments for deriv/predict_linear (lazy)."""
+        if self._regression_built:
+            return
+        import jax
+
+        tc = (self._ts1.astype(np.float64)[:, None] - self.out_t[None, :]) * 1e-3
+        self.Wt = (self.W * tc).astype(np.float32)
+        self.st = self.Wt.sum(0)
+        self.stt = (self.W * tc * tc).sum(0).astype(np.float64)
+        self.dWt = jax.device_put(self.Wt)
+        self.d_st = jax.device_put(self.st)
+        self.d_stt = jax.device_put(self.stt.astype(np.float32))
+        self._regression_built = True
+
+    def ensure_minmax(self):
+        """min/max tile hierarchy + edge one-hots (lazy — the edge matrix is
+        [T, 2*_TILE*J], by far the biggest structure here)."""
+        if self._minmax_built:
+            return
+        import jax
+
+        lo, hi, T, J = self._lo, self._hi, self._T, self._J
+        Lt = _TILE  # (distinct name: self.L is the last-sample one-hot)
         n_tiles = T // Lt
         t_lo = -(-lo // Lt)  # ceil
         t_hi = hi // Lt
@@ -145,35 +199,13 @@ class WindowMatrices:
                 edge_idx[j, slot] = pos
         self.edge_onehot = E
         self.edge_valid = edge_valid
-        # gather-form of the one-hot selections for backends where a gather
-        # beats a matmul (CPU; the TPU branch keeps the MXU one-hots):
-        # row 0 = first-sample, 1 = last, 2 = second-to-last positions.
-        # Out-of-range windows clip to valid positions; every use is gated
-        # by has/count masks, matching the one-hot's all-zero columns.
-        self.idx = np.stack([
-            np.clip(lo, 0, T - 1),
-            np.clip(hi - 1, 0, T - 1),
-            np.clip(hi - 2, 0, T - 1),
-        ]).astype(np.int32)
         self.edge_idx = edge_idx
-        # device-resident copies (transferred once, reused every query)
-        import jax
-
         put = jax.device_put
-        self.dW, self.dF, self.dL, self.dL2, self.dP = map(put, (W, F, L, L2, P))
-        self.dWt = put(self.Wt)
-        self.d_count = put(cnt)
-        self.d_tf = put(np.nan_to_num(self.t_first, nan=0.0).astype(np.float32))
-        self.d_tl = put(np.nan_to_num(self.t_last, nan=0.0).astype(np.float32))
-        self.d_tl2 = put(np.nan_to_num(self.t_last2, nan=0.0).astype(np.float32))
-        self.d_out_t = put(self.out_t.astype(np.float32))
-        self.d_st = put(self.st)
-        self.d_stt = put(self.stt.astype(np.float32))
         self.d_tile_mask = put(self.tile_mask)
         self.d_edge_onehot = put(self.edge_onehot)
         self.d_edge_valid = put(self.edge_valid)
-        self.d_idx = put(self.idx)
-        self.d_edge_idx = put(self.edge_idx)
+        self.d_edge_idx = put(edge_idx)
+        self._minmax_built = True
 
 
 def window_matrices(block: StagedBlock, start_off: int, step_ms: int,
@@ -373,6 +405,7 @@ def run_mxu_range_function(func, block: StagedBlock, params, is_counter=False,
         # so resets()/changes() must not read them (kernels.py has the same
         # rule). Counter blocks arrive diff-encoded (staging mode "diff");
         # gauges compare raw values.
+        wm.ensure_pairs()
         vals = jnp.asarray(block.raw if block.raw is not None else block.vals)
         if is_counter and not is_delta:
             flag = (vals != 0) if func == "changes" else (vals < 0)
@@ -381,6 +414,7 @@ def run_mxu_range_function(func, block: StagedBlock, params, is_counter=False,
             flag = (vals != prev) if func == "changes" else (vals < prev)
         return mxu_pair_count(flag.astype(jnp.float32), wm.dP, wm.d_count > 0)
     if func in ("min_over_time", "max_over_time"):
+        wm.ensure_minmax()
         return mxu_minmax(
             jnp.asarray(block.vals), wm.d_tile_mask, wm.d_edge_onehot,
             wm.d_edge_valid, wm.d_count,
@@ -388,6 +422,7 @@ def run_mxu_range_function(func, block: StagedBlock, params, is_counter=False,
             edge_idx=wm.d_edge_idx, fetch=fetch_strategy(),
         )
     if func in ("deriv", "predict_linear"):
+        wm.ensure_regression()
         lead = np.float32(args[0]) if args else np.float32(0.0)
         return mxu_regression(
             block.vals, wm.dW, wm.dWt, wm.d_st, wm.d_stt,

@@ -360,11 +360,23 @@ class StagedBlock:
     def shape(self):
         return self.ts.shape
 
-    def to_device(self) -> "StagedBlock":
+    def to_device(self, keep_host: bool = False) -> "StagedBlock":
         """Pin the block's arrays in HBM (the north-star 'decoded chunk
-        windows staged to HBM'); returns self for chaining."""
+        windows staged to HBM'); returns self for chaining. ``keep_host``
+        retains mutable host mirrors so cached blocks can be incrementally
+        APPENDED to when live samples arrive (append_to_block) instead of
+        fully restaged."""
         import jax
 
+        if keep_host:
+            # explicit copies: jax.device_put on the CPU backend can alias
+            # numpy memory, and the mirrors get mutated by append repairs
+            # while older device arrays may still be in flight
+            self.h_ts = np.array(self.ts, copy=True)
+            self.h_vals = np.array(self.vals, copy=True)
+            self.h_lens = np.array(self.lens, copy=True)
+            self.h_raw = (np.array(self.raw, copy=True)
+                          if self.raw is not None else None)
         self.ts = jax.device_put(self.ts)
         self.vals = jax.device_put(self.vals)
         self.lens = jax.device_put(self.lens)
@@ -397,10 +409,14 @@ def stage_series(
     counter_corrected: bool = False,
     diff_encode: bool = False,
     dtype=np.float32,
+    time_headroom: int = 0,
 ) -> StagedBlock:
     """Build a StagedBlock from per-series (ts_ms int64, values f64) pairs.
 
-    Drops NaN samples (staleness). Pads S and T to bucketed shapes.
+    Drops NaN samples (staleness). Pads S and T to bucketed shapes;
+    ``time_headroom`` extra columns let live-edge append repairs
+    (append_to_block) absorb many scrapes before the padded width forces a
+    full re-stage.
     With ``counter_corrected``, values are reset-corrected in f64 first and
     raw offsets are staged alongside (see module docstring).
     With ``diff_encode``, slot i carries the f64-exact adjacent difference
@@ -418,12 +434,17 @@ def stage_series(
         cleaned.append((ts, vals))
         maxlen = max(maxlen, len(ts))
     S = pad_series(max(n, 1))
-    T = pad_time(maxlen)
+    T = pad_time(maxlen + max(time_headroom, 0))
     out_ts = np.full((S, T), TS_PAD, dtype=np.int32)
     out_vals = np.zeros((S, T), dtype=dtype)
     out_raw = np.zeros((S, T), dtype=dtype) if counter_corrected else None
     lens = np.zeros(S, dtype=np.int32)
     baseline = np.zeros(S, dtype=dtype)
+    # f64 continuation state per series (last raw value, last corrected
+    # value) so cached counter blocks can be incrementally appended to with
+    # EXACT correction continuation (append_to_block)
+    cont_raw = np.zeros(S, dtype=np.float64)
+    cont_corr = np.zeros(S, dtype=np.float64)
     for i, (ts, vals) in enumerate(cleaned):
         m = len(ts)
         lens[i] = m
@@ -433,7 +454,10 @@ def stage_series(
         if counter_corrected:
             b = np.float64(vals[0])
             baseline[i] = b
-            out_vals[i, :m] = (counter_correct(vals) - b).astype(dtype)
+            corrected = counter_correct(vals)
+            cont_raw[i] = vals[-1]
+            cont_corr[i] = corrected[-1]
+            out_vals[i, :m] = (corrected - b).astype(dtype)
             # raw rides along unshifted: it only feeds the zero-crossing
             # extrapolation cap, which engages only for raw values near zero —
             # exactly where plain f32 is exact (large raws disable the cap)
@@ -479,11 +503,126 @@ def stage_series(
         mgrid = _build_masked_grid(
             cleaned[:n], base_ms, out_vals, out_raw, lens, T, S
         )
-    return StagedBlock(
+    block = StagedBlock(
         out_ts, out_vals, lens, base_ms, baseline, n, part_refs or [],
         raw=out_raw, regular_ts=regular, nominal_ts=nominal, ts_dev=ts_dev,
         maxdev_ms=maxdev, mgrid=mgrid,
     )
+    if counter_corrected:
+        block.cont = (cont_raw, cont_corr)
+    return block
+
+
+def append_to_block(shard, block: StagedBlock, part_ids, column: str,
+                    end_ms: int, mode: str) -> "StagedBlock | None":
+    """Incrementally append samples that arrived AFTER ``block`` was staged
+    (the live-edge dashboard path: every scrape lands just past the staged
+    head, and a full re-stage per scrape is the single biggest query cost
+    under ingest — the reference serves this straight from write buffers).
+
+    Mutates the HOST mirrors in place (old device arrays are immutable jax
+    buffers, so in-flight readers are unaffected) and returns a NEW
+    StagedBlock carrying the refreshed device arrays and extended shared
+    grid — the caller swaps it into the cache entry atomically, so a
+    concurrent query sees either the whole old block or the whole new one,
+    never a torn mix. Returns None whenever a precondition fails and the
+    caller restages from scratch:
+
+    - mode must be raw/shifted/corrected (diff continuation needs state the
+      block doesn't carry) and the block scalar, host-mirrored, regular-grid
+      (the overwhelmingly common live case; jitter/masked/irregular blocks
+      restage);
+    - the selection must be unchanged (same part refs, same order);
+    - every series must gain the SAME new timestamps (the appended grid
+      stays shared) and the padded T must still fit.
+    """
+    if mode not in ("raw", "shifted", "corrected"):
+        return None
+    if mode == "corrected" and getattr(block, "cont", None) is None:
+        return None
+    if getattr(block, "h_ts", None) is None or block.regular_ts is None:
+        return None
+    if block.n_series == 0 or block.h_vals.ndim != 2:
+        return None
+    refs = [(shard.shard_num, int(p)) for p in part_ids]
+    if refs != list(block.part_refs):
+        return None
+    n = block.n_series
+    lens = block.h_lens
+    m = int(lens[0])
+    if m == 0 or not (lens[:n] == m).all():
+        return None
+    base = block.base_ms
+    last_ts = int(np.asarray(block.regular_ts)[m - 1]) + base
+    new_ts = None
+    per_vals = []
+    for pid in part_ids:
+        ts, vals = shard.partition(int(pid)).samples_in_range(
+            last_ts + 1, end_ms, column
+        )
+        if getattr(vals, "ndim", 1) != 1:
+            return None
+        keep = ~np.isnan(vals)
+        if not keep.all():
+            ts, vals = ts[keep], vals[keep]
+        if new_ts is None:
+            new_ts = ts
+        elif len(ts) != len(new_ts) or (ts != new_ts).any():
+            return None  # appended grid would not stay shared
+        per_vals.append(vals)
+    k = 0 if new_ts is None else len(new_ts)
+    if k == 0:
+        return block  # nothing new in this block's range: still clean
+    T = block.h_ts.shape[1]
+    if m + k > T:
+        return None  # padded width exhausted: restage with a bigger T
+    off = (new_ts - base).astype(np.int64)
+    if off.max() >= 2**31 - 1 or off.min() <= int(np.asarray(block.regular_ts)[m - 1]):
+        return None
+    off32 = off.astype(np.int32)
+    # vectorized across series: the appended grid is shared, so the whole
+    # repair is a handful of [n, k] array ops, not n small python loops
+    V = np.stack(per_vals).astype(np.float64)  # [n, k]
+    block.h_ts[:n, m : m + k] = off32[None, :]
+    if mode == "raw":
+        block.h_vals[:n, m : m + k] = V.astype(block.h_vals.dtype)
+    elif mode == "shifted":
+        b = np.asarray(block.baseline)[:n].astype(np.float64)
+        block.h_vals[:n, m : m + k] = (V - b[:, None]).astype(block.h_vals.dtype)
+    else:  # corrected: exact f64 continuation from the stored state
+        cont_raw, cont_corr = block.cont
+        prev = np.concatenate([cont_raw[:n, None], V[:, :-1]], axis=1)
+        drops = np.where(V < prev, prev, 0.0)
+        corr = cont_corr[:n, None] + np.cumsum(V - prev + drops, axis=1)
+        b = np.asarray(block.baseline)[:n].astype(np.float64)
+        block.h_vals[:n, m : m + k] = (corr - b[:, None]).astype(block.h_vals.dtype)
+        block.h_raw[:n, m : m + k] = V.astype(block.h_raw.dtype)
+        cont_raw[:n] = V[:, -1]
+        cont_corr[:n] = corr[:, -1]
+    lens[:n] = m + k
+    reg = np.asarray(block.regular_ts).copy()
+    reg[m : m + k] = off32
+    import jax
+
+    # fresh block object: in-flight readers keep the old (immutable device
+    # arrays + old grid) view; window-matrix caches start empty against the
+    # extended grid. device_put gets COPIES — on the CPU backend it can
+    # alias numpy memory, and the next repair mutates these same mirrors
+    nb = StagedBlock(
+        jax.device_put(block.h_ts.copy()), jax.device_put(block.h_vals.copy()),
+        jax.device_put(block.h_lens.copy()), base, block.baseline, n,
+        list(block.part_refs),
+        raw=(jax.device_put(block.h_raw.copy())
+             if block.h_raw is not None else None),
+        regular_ts=reg,
+    )
+    nb.h_ts = block.h_ts
+    nb.h_vals = block.h_vals
+    nb.h_lens = block.h_lens
+    nb.h_raw = block.h_raw
+    if getattr(block, "cont", None) is not None:
+        nb.cont = block.cont
+    return nb
 
 
 def harmonize_nominal(blocks) -> bool:
@@ -694,13 +833,22 @@ def stage_from_shard(
             subtract_baseline=mode in ("corrected", "shifted"), dtype=dtype
         )
 
+    newest = max((int(ts[-1]) for ts, _ in series if len(ts)), default=None)
+
     def _stage(sr):
+        # modest time headroom on small-to-medium LIVE-EDGE blocks (range
+        # reaches past the newest sample): append repairs then absorb many
+        # scrapes before the padded width forces a full re-stage. Purely
+        # historical ranges never repair, so they never pay the wider T.
+        live_edge = newest is not None and end_ms >= newest
+        headroom = 256 if (live_edge and len(sr) <= 8192) else 0
         return stage_series(
             sr, start_ms, refs,
             counter_corrected=mode == "corrected",
             subtract_baseline=mode == "shifted",
             diff_encode=mode == "diff",
             dtype=dtype,
+            time_headroom=headroom,
         )
 
     block = _stage(series)

@@ -236,8 +236,38 @@ class SelectRawPartitionsExec(ExecPlan):
             with shard._lock:
                 hit = shard.stage_cache.get(cache_key)
                 version_at_stage = shard.version
+                claimed = False
+                if hit is not None and hit.repairing:
+                    # another thread is mid-repair: serving its pre-repair
+                    # block would miss acknowledged samples — restage fresh
+                    hit = None
+                elif hit is not None and hit.dirty:
+                    hit.dirty = False
+                    hit.repairing = True
+                    claimed = True
+            if hit is not None and claimed:
+                # in-range ingest landed since this block was staged: try
+                # the incremental append repair (live-edge panels pay the
+                # tail, not a full re-stage); on failure fall through to a
+                # fresh stage. The repair returns a NEW block (old one stays
+                # consistent for in-flight readers) swapped in atomically.
+                repaired = None
+                try:
+                    repaired = ST.append_to_block(
+                        shard, hit.block, ids, col_name, self.end_ms, stage_mode
+                    )
+                finally:
+                    with shard._lock:
+                        hit.repairing = False
+                        if repaired is not None:
+                            hit.block = repaired
+                        elif shard.stage_cache.get(cache_key) is hit:
+                            # failed (or raised): never leave a stale entry
+                            del shard.stage_cache[cache_key]
+                if repaired is None:
+                    hit = None
             if hit is not None:
-                block = hit[0]
+                block = hit.block
             else:
                 block = ST.stage_from_shard(
                     shard, ids, col_name, self.start_ms, self.end_ms,
@@ -249,7 +279,7 @@ class SelectRawPartitionsExec(ExecPlan):
                     + (np.asarray(block.raw).nbytes if block.raw is not None else 0)
                 )
                 ctx.stats.bytes_staged += nbytes
-                block.to_device()
+                block.to_device(keep_host=True)  # mirrors enable append repair
                 # byte-budgeted eviction, oldest entry first (the staging
                 # analog of BlockManager reclaim under memory pressure).
                 # All cache mutations run under the shard lock (the shard's
@@ -260,12 +290,16 @@ class SelectRawPartitionsExec(ExecPlan):
                 # see this not-yet-inserted entry.
                 with shard._lock:
                     if shard.version == version_at_stage:
+                        from ...memstore.shard import StageEntry
+
                         budget = getattr(shard.config, "stage_cache_bytes", 2 << 30)
-                        used = sum(b for _, b in shard.stage_cache.values())
+                        used = sum(
+                            e.nbytes for e in shard.stage_cache.values()
+                        )
                         while shard.stage_cache and used + nbytes > budget:
                             oldest = next(iter(shard.stage_cache))
-                            used -= shard.stage_cache.pop(oldest)[1]
-                        shard.stage_cache[cache_key] = (block, nbytes)
+                            used -= shard.stage_cache.pop(oldest).nbytes
+                        shard.stage_cache[cache_key] = StageEntry(block, nbytes)
             ctx.stats.series_scanned += len(ids)
             ctx.stats.samples_scanned += int(np.asarray(block.lens).sum())
             if ctx.stats.samples_scanned > ctx.max_samples:

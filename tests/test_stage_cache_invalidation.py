@@ -96,6 +96,145 @@ def test_new_series_invalidates_even_beyond_range(setup, monkeypatch):
     assert calls, "new series must invalidate"
 
 
+def _counter_setup():
+    from filodb_tpu.core.schemas import PROM_COUNTER
+
+    ms = TimeSeriesMemStore()
+    ms.setup(Dataset("ds"), [0])
+    rng = np.random.default_rng(7)
+    n = 200
+    ts = BASE + (1 + np.arange(n, dtype=np.int64)) * 10_000
+    for i in range(6):
+        vals = np.cumsum(rng.uniform(0, 10, n)) + 1e9
+        k = 120 + i
+        vals[k:] -= vals[k] - rng.uniform(0, 5)  # one reset per series
+        tags = {"_metric_": "rq_total", "_ws_": "w", "_ns_": "n",
+                "inst": f"h{i}"}
+        ms.shard("ds", 0).ingest_series(
+            SeriesBatch(PROM_COUNTER, tags, ts, {"count": vals})
+        )
+    return ms, QueryEngine(ms, "ds"), ms.shard("ds", 0), ts
+
+
+@pytest.mark.parametrize("q,with_reset", [
+    ("sum(rate(rq_total[5m]))", False),
+    ("sum(rate(rq_total[5m]))", True),
+    ("sum(increase(rq_total[5m]))", False),
+])
+def test_live_edge_append_repair_matches_fresh_engine(monkeypatch, q, with_reset):
+    """Repeated live-edge queries with samples appended between them must
+    take the incremental append-repair path (no full re-stage) and stay
+    equal to a fresh engine over identical data — counters included (exact
+    f64 correction continuation, resets in the appended region too)."""
+    from filodb_tpu.core.schemas import PROM_COUNTER
+
+    ms, engine, shard, ts0 = _counter_setup()
+    s = (BASE + 400_000) / 1000
+    n0 = len(ts0)
+    rng = np.random.default_rng(9)
+    restages = []
+    orig = ST.stage_from_shard
+
+    def spy(*a, **k):
+        restages.append(1)
+        return orig(*a, **k)
+
+    appended = {i: ([], []) for i in range(6)}
+    for step in range(4):
+        # live-edge range: covers everything ingested so far + the future
+        e = (BASE + (n0 + 40) * 10_000) / 1000
+        engine.query_range(q, s, e, 60)
+        if step == 0:
+            monkeypatch.setattr(ST, "stage_from_shard", spy)
+        # append 2 fresh scrapes per series (same shared grid)
+        new_ts = BASE + (n0 + 1 + 2 * step + np.arange(2, dtype=np.int64)) * 10_000
+        for i in range(6):
+            base_v = 1e6 * (step + 1)
+            v = np.array([base_v, 1.0 if (with_reset and i == 0 and step == 2)
+                          else base_v + rng.uniform(1, 5)])
+            tags = {"_metric_": "rq_total", "_ws_": "w", "_ns_": "n",
+                    "inst": f"h{i}"}
+            ms.shard("ds", 0).ingest_series(
+                SeriesBatch(PROM_COUNTER, tags, new_ts, {"count": v})
+            )
+            appended[i][0].extend(new_ts.tolist())
+            appended[i][1].extend(v.tolist())
+    e = (BASE + (n0 + 40) * 10_000) / 1000
+    got = engine.query_range(q, s, e, 60).grids[0].values_np()
+    assert restages == [], "live-edge appends must repair, never re-stage"
+
+    # oracle: a FRESH memstore with the identical final data
+    ms2 = TimeSeriesMemStore()
+    ms2.setup(Dataset("ds"), [0])
+    rng2 = np.random.default_rng(7)
+    n = 200
+    ts = BASE + (1 + np.arange(n, dtype=np.int64)) * 10_000
+    for i in range(6):
+        vals = np.cumsum(rng2.uniform(0, 10, n)) + 1e9
+        k = 120 + i
+        vals[k:] -= vals[k] - rng2.uniform(0, 5)
+        full_ts = np.concatenate([ts, np.array(appended[i][0], np.int64)])
+        full_v = np.concatenate([vals, np.array(appended[i][1])])
+        tags = {"_metric_": "rq_total", "_ws_": "w", "_ns_": "n",
+                "inst": f"h{i}"}
+        ms2.shard("ds", 0).ingest_series(
+            SeriesBatch(PROM_COUNTER, tags, full_ts, {"count": full_v})
+        )
+    want = QueryEngine(ms2, "ds").query_range(q, s, e, 60).grids[0].values_np()
+    np.testing.assert_array_equal(np.isnan(got), np.isnan(want))
+    ok = ~np.isnan(want)
+    np.testing.assert_allclose(got[ok], want[ok], rtol=1e-4)
+
+
+def test_append_repair_gauge_exact(monkeypatch):
+    """Gauge (raw-mode) repair must be bit-exact vs a fresh stage."""
+    ms = TimeSeriesMemStore()
+    ms.setup(Dataset("ds"), [0])
+    ms.ingest("ds", 0, machine_metrics(n_series=4, n_samples=100, start_ms=BASE))
+    engine = QueryEngine(ms, "ds")
+    s, e = (BASE + 400_000) / 1000, (BASE + 1_500_000) / 1000
+    q = "sum(sum_over_time(heap_usage0[5m]))"
+    engine.query_range(q, s, e, 60)
+    restages = []
+    orig = ST.stage_from_shard
+    monkeypatch.setattr(
+        ST, "stage_from_shard",
+        lambda *a, **k: (restages.append(1), orig(*a, **k))[1],
+    )
+    ms.ingest("ds", 0, machine_metrics(n_series=4, n_samples=2,
+                                       start_ms=BASE + 1_010_000))
+    got = engine.query_range(q, s, e, 60).grids[0].values_np()
+    assert restages == []
+    ms2 = TimeSeriesMemStore()
+    ms2.setup(Dataset("ds"), [0])
+    ms2.ingest("ds", 0, machine_metrics(n_series=4, n_samples=100, start_ms=BASE))
+    ms2.ingest("ds", 0, machine_metrics(n_series=4, n_samples=2,
+                                        start_ms=BASE + 1_010_000))
+    want = QueryEngine(ms2, "ds").query_range(q, s, e, 60).grids[0].values_np()
+    np.testing.assert_array_equal(got, want)
+
+
+def test_append_repair_falls_back_when_grid_diverges(setup, monkeypatch):
+    """Series appending DIFFERENT timestamps break the shared grid: repair
+    must decline and a full re-stage must produce correct results."""
+    ms, engine, shard = setup
+    tags = _existing_tags(shard)
+    s, e = (BASE + 400_000) / 1000, (BASE + 2_600_000) / 1000
+    q = "count(heap_usage0)"
+    engine.query_range(q, s, e, 60)
+    restages = []
+    orig = ST.stage_from_shard
+    monkeypatch.setattr(
+        ST, "stage_from_shard",
+        lambda *a, **k: (restages.append(1), orig(*a, **k))[1],
+    )
+    # only ONE series gets a new sample: per-series counts now differ
+    _append(ms, tags, [BASE + 2_150_000], [1.0])
+    got = engine.query_range(q, s, e, 60)
+    assert restages, "divergent append must fall back to a full re-stage"
+    assert got.grids[0].n_series >= 1
+
+
 def test_gap_series_span_extension_invalidates(setup, monkeypatch):
     """Reviewer-found hazard: a sample BEYOND the cached range can extend a
     gap series' index span so it newly overlaps the range — the cached
