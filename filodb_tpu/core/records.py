@@ -39,22 +39,53 @@ class RecordBatch:
     def group_by_series(self) -> "list[SeriesBatch]":
         """Group records by partition key, preserving time order within series.
 
-        Hot path: producers typically repeat the same tags object for every
-        sample of a series, so partkeys memoize by object identity before
-        falling back to canonical hashing."""
-        groups: dict[bytes, list[int]] = {}
+        Hot path: producers typically repeat the same tags OBJECT for every
+        sample of a series AND emit each series' samples contiguously, so
+        grouping walks runs of identical objects (one identity check per
+        row) instead of paying per-row dict ops, and partkeys memoize by
+        object identity before falling back to canonical hashing. Batches
+        with fresh dicts per row or interleaved series degrade gracefully
+        to per-row runs.
+
+        Contract: a single-run (contiguous) series returns slice VIEWS of
+        the batch columns — callers must not mutate either side after
+        grouping (every in-repo consumer copies on ingest)."""
+        groups: dict[bytes, list] = {}
         keys: dict[bytes, Mapping[str, str]] = {}
         memo: dict[int, bytes] = {}
-        for i, t in enumerate(self.tags):
+        tags = self.tags
+        n = len(tags)
+        i = 0
+        while i < n:
+            t = tags[i]
+            j = i + 1
+            while j < n and tags[j] is t:
+                j += 1
             pk = memo.get(id(t))
             if pk is None:
                 pk = canonical_partkey(t)
                 memo[id(t)] = pk
-            groups.setdefault(pk, []).append(i)
-            keys.setdefault(pk, t)
+            runs = groups.get(pk)
+            if runs is None:
+                groups[pk] = [(i, j)]
+                keys[pk] = t
+            else:
+                runs.append((i, j))
+            i = j
         out = []
-        for pk, idxs in groups.items():
-            ix = np.asarray(idxs)
+        for pk, runs in groups.items():
+            if len(runs) == 1:
+                lo, hi = runs[0]
+                ix = slice(lo, hi)
+            elif all(hi - lo == 1 for lo, hi in runs):
+                # fresh-dict-per-row producers (CSV/TCP/JSONL gateways):
+                # every row is its own run — index directly, no per-row
+                # arange allocations
+                ix = np.asarray([lo for lo, _ in runs])
+            else:
+                ix = np.concatenate(
+                    [np.arange(lo, hi) for lo, hi in runs]
+                )
             out.append(
                 SeriesBatch(
                     schema=self.schema,

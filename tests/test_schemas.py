@@ -116,3 +116,51 @@ def test_base2_exp_bucket_bounds():
     # growth factor 2^(2^-scale) between consecutive finite bounds
     ratios = b[2:-1] / b[1:-2]
     np.testing.assert_allclose(ratios, 2 ** (2**-2.0))
+
+
+class TestGroupBySeries:
+    """Run-length grouping edge cases (core/records.py group_by_series):
+    the fast path walks runs of identical tag OBJECTS; interleaved series
+    and per-row fresh dicts must still group correctly by content."""
+
+    def _batch(self, tags_list, ts, vals):
+        import numpy as np
+
+        from filodb_tpu.core.records import RecordBatch
+        from filodb_tpu.core.schemas import GAUGE
+
+        return RecordBatch(
+            GAUGE, np.asarray(ts, np.int64),
+            {"value": np.asarray(vals, np.float64)}, tags_list,
+        )
+
+    def test_interleaved_series_group_by_content(self):
+        import numpy as np
+
+        a = {"_metric_": "m", "host": "a"}
+        b = {"_metric_": "m", "host": "b"}
+        batch = self._batch([a, b, a, b, a], [1, 1, 2, 2, 3], [10, 20, 11, 21, 12])
+        got = {g.tags["host"]: g for g in batch.group_by_series()}
+        assert sorted(got) == ["a", "b"]
+        np.testing.assert_array_equal(got["a"].timestamps, [1, 2, 3])
+        np.testing.assert_array_equal(got["a"].values["value"], [10, 11, 12])
+        np.testing.assert_array_equal(got["b"].values["value"], [20, 21])
+
+    def test_fresh_dicts_per_row_group_by_content(self):
+        import numpy as np
+
+        rows = [{"_metric_": "m", "host": "a"} for _ in range(3)]
+        rows += [{"_metric_": "m", "host": "b"} for _ in range(2)]
+        batch = self._batch(rows, [1, 2, 3, 1, 2], [1, 2, 3, 4, 5])
+        got = {g.tags["host"]: g for g in batch.group_by_series()}
+        np.testing.assert_array_equal(got["a"].values["value"], [1, 2, 3])
+        np.testing.assert_array_equal(got["b"].values["value"], [4, 5])
+
+    def test_contiguous_single_series_is_view_equivalent(self):
+        import numpy as np
+
+        t = {"_metric_": "m", "host": "a"}
+        batch = self._batch([t, t, t], [1, 2, 3], [7, 8, 9])
+        (g,) = batch.group_by_series()
+        np.testing.assert_array_equal(g.timestamps, [1, 2, 3])
+        np.testing.assert_array_equal(g.values["value"], [7, 8, 9])
