@@ -142,34 +142,11 @@ class JitterWindowMatrices:
             has_khi, e - R[np.clip(khi, 0, m - 1)], -(2 * md) - 1
         ).astype(np.float32)
 
-        # min/max tile hierarchy over the certain range [clo, chi)
-        Lt = _TILE
-        n_tiles = T // Lt
-        t_lo = -(-clo // Lt)
-        t_hi = chi // Lt
-        full = np.arange(n_tiles)[None, :]
-        self.tile_mask = (
-            (full >= t_lo[:, None]) & (full < t_hi[:, None]) & (t_lo < t_hi)[:, None]
-        )
-        E = np.zeros((T, J * 2 * Lt), dtype=np.float32)
-        edge_valid = np.zeros((J, 2 * Lt), dtype=bool)
-        edge_idx = np.zeros((J, 2 * Lt), dtype=np.int32)
-        for j in range(J):
-            if chi[j] <= clo[j]:
-                continue
-            if t_lo[j] >= t_hi[j]:
-                left = np.arange(clo[j], chi[j])
-                right = np.empty(0, dtype=np.int64)
-            else:
-                left = np.arange(clo[j], t_lo[j] * Lt)
-                right = np.arange(t_hi[j] * Lt, chi[j])
-            for slot, pos in enumerate(np.concatenate([left, right])[: 2 * Lt]):
-                E[pos, j * 2 * Lt + slot] = 1.0
-                edge_valid[j, slot] = True
-                edge_idx[j, slot] = pos
-        self.edge_onehot = E
-        self.edge_valid = edge_valid
-        self.edge_idx = edge_idx
+        # min/max tile hierarchy + edge one-hots build LAZILY (the edge
+        # matrix is [T, 2*_TILE*J] — by far the biggest structure here, and
+        # only min/max_over_time reads it)
+        self._clo, self._chi, self._T, self._J = clo, chi, T, J
+        self._minmax_built = False
 
         put = jax.device_put
         self.d_W0 = put(self.W0)
@@ -186,11 +163,26 @@ class JitterWindowMatrices:
         self.d_Khi_rel = put(self.Khi_rel)
         self.d_blo_rel = put(self.blo_rel)
         self.d_ehi_rel = put(self.ehi_rel)
+        self.d_idx = put(self.idx)
+
+    def ensure_minmax(self):
+        """min/max tile hierarchy over the certain range [clo, chi) plus
+        the <=2*_TILE edge-sample selections (lazy; shared builder with the
+        regular-grid matrices)."""
+        if self._minmax_built:
+            return
+        from .mxu_kernels import build_minmax_structures
+
+        (self.tile_mask, self.edge_onehot, self.edge_valid,
+         self.edge_idx) = build_minmax_structures(
+            self._clo, self._chi, self._T, self._J
+        )
+        put = jax.device_put
         self.d_tile_mask = put(self.tile_mask)
         self.d_edge_onehot = put(self.edge_onehot)
         self.d_edge_valid = put(self.edge_valid)
-        self.d_idx = put(self.idx)
         self.d_edge_idx = put(self.edge_idx)
+        self._minmax_built = True
 
 
 def _cached_window_matrices(block, cache_attr: str, nominal_ts, n_valid: int,
@@ -730,6 +722,7 @@ def run_masked_jitter_range_function(func, block: StagedBlock, params,
         return None
     fetch = fetch_strategy()
     if func in ("min_over_time", "max_over_time"):
+        wm.ensure_minmax()
         return jitter_masked_minmax(
             g.vals, g.dev, g.valid, g.cc, wm.d_SEL, wm.d_idx,
             wm.d_tile_mask, wm.d_edge_onehot, wm.d_edge_valid, wm.d_edge_idx,
@@ -768,6 +761,7 @@ def run_jitter_range_function(func, block: StagedBlock, params,
     dev = block.ts_dev
     fetch = fetch_strategy()
     if func in ("min_over_time", "max_over_time"):
+        wm.ensure_minmax()
         return jitter_minmax(
             jnp.asarray(block.vals), dev, wm.d_SEL, wm.d_idx, wm.d_tile_mask,
             wm.d_edge_onehot, wm.d_edge_valid, wm.d_edge_idx, wm.d_count0,

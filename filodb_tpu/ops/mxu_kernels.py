@@ -42,6 +42,39 @@ MXU_FUNCS = {
 _TILE = 16  # tile width for the min/max hierarchy
 
 
+def build_minmax_structures(lo, hi, T: int, J: int):
+    """The ONE builder for the min/max window structure shared by the
+    regular-grid and jittered-grid matrices: per-window full-_TILE tile
+    masks plus the <=2*_TILE edge-sample one-hots/indices over the certain
+    range [lo, hi) per window. Returns (tile_mask [J, T/_TILE],
+    edge_onehot [T, J*2*_TILE], edge_valid [J, 2*_TILE], edge_idx i32)."""
+    Lt = _TILE
+    n_tiles = T // Lt
+    t_lo = -(-lo // Lt)  # ceil
+    t_hi = hi // Lt
+    full = np.arange(n_tiles)[None, :]
+    tile_mask = (
+        (full >= t_lo[:, None]) & (full < t_hi[:, None]) & (t_lo < t_hi)[:, None]
+    )
+    E = np.zeros((T, J * 2 * Lt), dtype=np.float32)
+    edge_valid = np.zeros((J, 2 * Lt), dtype=bool)
+    edge_idx = np.zeros((J, 2 * Lt), dtype=np.int32)
+    for j in range(J):
+        if hi[j] <= lo[j]:
+            continue
+        if t_lo[j] >= t_hi[j]:  # window inside <2 tiles: all samples are edges
+            left = np.arange(lo[j], hi[j])
+            right = np.empty(0, dtype=np.int64)
+        else:
+            left = np.arange(lo[j], t_lo[j] * Lt)
+            right = np.arange(t_hi[j] * Lt, hi[j])
+        for slot, pos in enumerate(np.concatenate([left, right])[: 2 * Lt]):
+            E[pos, j * 2 * Lt + slot] = 1.0
+            edge_valid[j, slot] = True
+            edge_idx[j, slot] = pos
+    return tile_mask, E, edge_valid, edge_idx
+
+
 def fetch_strategy(override: str | None = None) -> str:
     """Resolve the one-hot-selection fetch strategy for the MXU kernels.
 
@@ -172,39 +205,15 @@ class WindowMatrices:
             return
         import jax
 
-        lo, hi, T, J = self._lo, self._hi, self._T, self._J
-        Lt = _TILE  # (distinct name: self.L is the last-sample one-hot)
-        n_tiles = T // Lt
-        t_lo = -(-lo // Lt)  # ceil
-        t_hi = hi // Lt
-        full = np.arange(n_tiles)[None, :]
-        self.tile_mask = (
-            (full >= t_lo[:, None]) & (full < t_hi[:, None]) & (t_lo < t_hi)[:, None]
-        )  # [J, n_tiles]
-        E = np.zeros((T, J * 2 * Lt), dtype=np.float32)
-        edge_valid = np.zeros((J, 2 * Lt), dtype=bool)
-        edge_idx = np.zeros((J, 2 * Lt), dtype=np.int32)
-        for j in range(J):
-            if hi[j] <= lo[j]:
-                continue
-            if t_lo[j] >= t_hi[j]:  # window inside <2 tiles: all samples are edges
-                left = np.arange(lo[j], hi[j])
-                right = np.empty(0, dtype=np.int64)
-            else:
-                left = np.arange(lo[j], t_lo[j] * Lt)
-                right = np.arange(t_hi[j] * Lt, hi[j])
-            for slot, pos in enumerate(np.concatenate([left, right])[: 2 * Lt]):
-                E[pos, j * 2 * Lt + slot] = 1.0
-                edge_valid[j, slot] = True
-                edge_idx[j, slot] = pos
-        self.edge_onehot = E
-        self.edge_valid = edge_valid
-        self.edge_idx = edge_idx
+        (self.tile_mask, self.edge_onehot, self.edge_valid,
+         self.edge_idx) = build_minmax_structures(
+            self._lo, self._hi, self._T, self._J
+        )
         put = jax.device_put
         self.d_tile_mask = put(self.tile_mask)
         self.d_edge_onehot = put(self.edge_onehot)
         self.d_edge_valid = put(self.edge_valid)
-        self.d_edge_idx = put(edge_idx)
+        self.d_edge_idx = put(self.edge_idx)
         self._minmax_built = True
 
 
