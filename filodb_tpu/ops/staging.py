@@ -377,6 +377,8 @@ class StagedBlock:
             self.h_lens = np.array(self.lens, copy=True)
             self.h_raw = (np.array(self.raw, copy=True)
                           if self.raw is not None else None)
+            self.h_dev = (np.array(self.ts_dev, copy=True)
+                          if self.ts_dev is not None else None)
         self.ts = jax.device_put(self.ts)
         self.vals = jax.device_put(self.vals)
         self.lens = jax.device_put(self.lens)
@@ -388,6 +390,18 @@ class StagedBlock:
         if self.mgrid is not None:
             self.mgrid.to_device()
         return self
+
+
+def nominal_midrange(real: np.ndarray):
+    """Shared nominal-grid estimator for near-regular data: per-column
+    midrange (minimax-optimal for the max deviation) over [n, m] actual
+    timestamps. Returns (nominal int64 [m], deviations int64 [n, m],
+    maxdev int). The ONE definition used by staging detection and the
+    live-edge append repair — the 2*maxdev < min-interval safety bound must
+    be checked against the same estimator everywhere."""
+    nom = (real.min(axis=0) + real.max(axis=0)) // 2
+    dev = real - nom[None, :]
+    return nom, dev, int(np.abs(dev).max())
 
 
 def counter_correct(vals: np.ndarray) -> np.ndarray:
@@ -442,9 +456,12 @@ def stage_series(
     baseline = np.zeros(S, dtype=dtype)
     # f64 continuation state per series (last raw value, last corrected
     # value) so cached counter blocks can be incrementally appended to with
-    # EXACT correction continuation (append_to_block)
+    # EXACT correction continuation (append_to_block); base64 keeps the
+    # UNROUNDED per-series baseline — the f32 baseline array rounds to
+    # +-64 at 1e9 magnitudes, which would shift every appended value
     cont_raw = np.zeros(S, dtype=np.float64)
     cont_corr = np.zeros(S, dtype=np.float64)
+    base64 = np.zeros(S, dtype=np.float64)
     for i, (ts, vals) in enumerate(cleaned):
         m = len(ts)
         lens[i] = m
@@ -454,6 +471,7 @@ def stage_series(
         if counter_corrected:
             b = np.float64(vals[0])
             baseline[i] = b
+            base64[i] = b
             corrected = counter_correct(vals)
             cont_raw[i] = vals[-1]
             cont_corr[i] = corrected[-1]
@@ -468,6 +486,7 @@ def stage_series(
         elif subtract_baseline:
             b = np.float64(vals[0])
             baseline[i] = b
+            base64[i] = b
             out_vals[i, :m] = (vals.astype(np.float64) - b).astype(dtype)
         else:
             out_vals[i, :m] = vals.astype(dtype)
@@ -487,9 +506,7 @@ def stage_series(
             # (see mxu_jitter.py)
             m = int(lens[0])
             real = out_ts[:n, :m].astype(np.int64)
-            nom = (real.min(axis=0) + real.max(axis=0)) // 2
-            dev = real - nom[None, :]
-            md = int(np.abs(dev).max())
+            nom, dev, md = nominal_midrange(real)
             min_int = int(np.diff(nom).min()) if m >= 2 else 0
             if min_int > 0 and 2 * md < min_int:
                 nominal = np.full(T, TS_PAD, dtype=np.int32)
@@ -508,6 +525,8 @@ def stage_series(
         raw=out_raw, regular_ts=regular, nominal_ts=nominal, ts_dev=ts_dev,
         maxdev_ms=maxdev, mgrid=mgrid,
     )
+    if counter_corrected or subtract_baseline:
+        block.base64 = base64
     if counter_corrected:
         block.cont = (cont_raw, cont_corr)
     return block
@@ -529,18 +548,27 @@ def append_to_block(shard, block: StagedBlock, part_ids, column: str,
     caller restages from scratch:
 
     - mode must be raw/shifted/corrected (diff continuation needs state the
-      block doesn't carry) and the block scalar, host-mirrored, regular-grid
-      (the overwhelmingly common live case; jitter/masked/irregular blocks
-      restage);
+      block doesn't carry) and the block scalar, host-mirrored, on a
+      REGULAR or NEAR-REGULAR (jittered) shared grid — the common live
+      cases; masked/irregular blocks restage;
     - the selection must be unchanged (same part refs, same order);
-    - every series must gain the SAME new timestamps (the appended grid
-      stays shared) and the padded T must still fit.
+    - every series must gain the SAME COUNT of new samples — identical
+      timestamps on a regular grid, or near-nominal ones (the jitter bound
+      re-checked over the extended grid) on a jittered grid — and the
+      padded T must still fit.
     """
     if mode not in ("raw", "shifted", "corrected"):
         return None
     if mode == "corrected" and getattr(block, "cont", None) is None:
         return None
-    if getattr(block, "h_ts", None) is None or block.regular_ts is None:
+    if mode in ("corrected", "shifted") and getattr(block, "base64", None) is None:
+        return None  # exact f64 baselines required (f32 rounds +-64 at 1e9)
+    jittered = block.regular_ts is None and block.nominal_ts is not None
+    if getattr(block, "h_ts", None) is None:
+        return None
+    if block.regular_ts is None and not jittered:
+        return None
+    if jittered and getattr(block, "h_dev", None) is None:
         return None
     if block.n_series == 0 or block.h_vals.ndim != 2:
         return None
@@ -553,12 +581,23 @@ def append_to_block(shard, block: StagedBlock, part_ids, column: str,
     if m == 0 or not (lens[:n] == m).all():
         return None
     base = block.base_ms
-    last_ts = int(np.asarray(block.regular_ts)[m - 1]) + base
+    grid = np.asarray(block.nominal_ts if jittered else block.regular_ts)
+    last_nom = int(grid[m - 1]) + base
+    # jittered: each series' head sits at last_nom + its own deviation, so
+    # the read starts PER SERIES — an in-order sample landing in another
+    # series' (head, last_nom+maxdev] gap must not be silently skipped
+    # (it shows up as a non-uniform batch and forces the restage fallback)
+    if jittered:
+        dev_last = block.h_dev[:n, m - 1].astype(np.int64)
+        read_from = [last_nom + int(d) + 1 for d in dev_last]
+    else:
+        read_from = [last_nom + 1] * n
     new_ts = None
     per_vals = []
-    for pid in part_ids:
+    per_ts = []
+    for idx_i, pid in enumerate(part_ids):
         ts, vals = shard.partition(int(pid)).samples_in_range(
-            last_ts + 1, end_ms, column
+            read_from[idx_i], end_ms, column
         )
         if getattr(vals, "ndim", 1) != 1:
             return None
@@ -567,41 +606,63 @@ def append_to_block(shard, block: StagedBlock, part_ids, column: str,
             ts, vals = ts[keep], vals[keep]
         if new_ts is None:
             new_ts = ts
-        elif len(ts) != len(new_ts) or (ts != new_ts).any():
-            return None  # appended grid would not stay shared
+        elif len(ts) != len(new_ts):
+            return None  # appended counts diverge
+        elif not jittered and (ts != new_ts).any():
+            return None  # regular grid would not stay shared
         per_vals.append(vals)
+        per_ts.append(ts)
     k = 0 if new_ts is None else len(new_ts)
     if k == 0:
         return block  # nothing new in this block's range: still clean
     T = block.h_ts.shape[1]
     if m + k > T:
         return None  # padded width exhausted: restage with a bigger T
-    off = (new_ts - base).astype(np.int64)
-    if off.max() >= 2**31 - 1 or off.min() <= int(np.asarray(block.regular_ts)[m - 1]):
-        return None
+    if jittered:
+        TS = np.stack(per_ts).astype(np.int64)  # [n, k]
+        if (np.diff(TS, axis=1) <= 0).any():
+            return None
+        nom_new, dev_new, md_new = nominal_midrange(TS)
+        md = max(md_new, int(block.maxdev_ms))
+        ext = np.concatenate([grid[:m].astype(np.int64) + base, nom_new])
+        d = np.diff(ext)
+        if (d <= 0).any() or 2 * md >= int(d.min()):
+            return None  # jitter bound fails on the extended grid
+        off = (nom_new - base)
+        OFF = (TS - base).astype(np.int64)
+        if OFF.max() >= 2**31 - 1:
+            return None
+    else:
+        off = (new_ts - base).astype(np.int64)
+        if off.max() >= 2**31 - 1 or off.min() <= int(grid[m - 1]):
+            return None
     off32 = off.astype(np.int32)
-    # vectorized across series: the appended grid is shared, so the whole
-    # repair is a handful of [n, k] array ops, not n small python loops
+    # vectorized across series: uniform appended counts make the whole
+    # repair a handful of [n, k] array ops, not n small python loops
     V = np.stack(per_vals).astype(np.float64)  # [n, k]
-    block.h_ts[:n, m : m + k] = off32[None, :]
+    if jittered:
+        block.h_ts[:n, m : m + k] = (OFF).astype(np.int32)
+        block.h_dev[:n, m : m + k] = dev_new.astype(np.float32)
+    else:
+        block.h_ts[:n, m : m + k] = off32[None, :]
     if mode == "raw":
         block.h_vals[:n, m : m + k] = V.astype(block.h_vals.dtype)
     elif mode == "shifted":
-        b = np.asarray(block.baseline)[:n].astype(np.float64)
+        b = block.base64[:n]
         block.h_vals[:n, m : m + k] = (V - b[:, None]).astype(block.h_vals.dtype)
     else:  # corrected: exact f64 continuation from the stored state
         cont_raw, cont_corr = block.cont
         prev = np.concatenate([cont_raw[:n, None], V[:, :-1]], axis=1)
         drops = np.where(V < prev, prev, 0.0)
         corr = cont_corr[:n, None] + np.cumsum(V - prev + drops, axis=1)
-        b = np.asarray(block.baseline)[:n].astype(np.float64)
+        b = block.base64[:n]
         block.h_vals[:n, m : m + k] = (corr - b[:, None]).astype(block.h_vals.dtype)
         block.h_raw[:n, m : m + k] = V.astype(block.h_raw.dtype)
         cont_raw[:n] = V[:, -1]
         cont_corr[:n] = corr[:, -1]
     lens[:n] = m + k
-    reg = np.asarray(block.regular_ts).copy()
-    reg[m : m + k] = off32
+    ext_grid = grid.copy()
+    ext_grid[m : m + k] = off32
     import jax
 
     # fresh block object: in-flight readers keep the old (immutable device
@@ -614,14 +675,20 @@ def append_to_block(shard, block: StagedBlock, part_ids, column: str,
         list(block.part_refs),
         raw=(jax.device_put(block.h_raw.copy())
              if block.h_raw is not None else None),
-        regular_ts=reg,
+        regular_ts=None if jittered else ext_grid,
+        nominal_ts=ext_grid if jittered else None,
+        ts_dev=(jax.device_put(block.h_dev.copy()) if jittered else None),
+        maxdev_ms=(md if jittered else 0),
     )
     nb.h_ts = block.h_ts
     nb.h_vals = block.h_vals
     nb.h_lens = block.h_lens
     nb.h_raw = block.h_raw
+    nb.h_dev = getattr(block, "h_dev", None)
     if getattr(block, "cont", None) is not None:
         nb.cont = block.cont
+    if getattr(block, "base64", None) is not None:
+        nb.base64 = block.base64
     return nb
 
 
@@ -776,6 +843,12 @@ def _slot_align(shard, part_ids, column, series, start_ms: int, end_ms: int):
     k_need_hi = int(np.floor((end_ms + md - anchor) / interval + 1e-9))
     while anchor + k_need_hi * interval > end_ms + md:
         k_need_hi -= 1
+    # clamp the needed range to slots where data EXISTS at all: a live-edge
+    # query's end (beyond every series' newest sample) must not make the
+    # repair demand future slots of nobody (and symmetrically at the low
+    # edge before retention)
+    k_need_lo = max(k_need_lo, min(k[0] for k, _, _ in per))
+    k_need_hi = min(k_need_hi, max(k[-1] for k, _, _ in per))
     k_lo = max(k[0] for k, _, _ in per)
     k_hi = min(k[-1] for k, _, _ in per)
     if k_lo > k_need_lo or k_hi < k_need_hi or k_need_hi < k_need_lo:

@@ -214,6 +214,105 @@ def test_append_repair_gauge_exact(monkeypatch):
     np.testing.assert_array_equal(got, want)
 
 
+def test_live_edge_jittered_append_repair_matches_fresh_engine(monkeypatch):
+    """Jittered (near-regular) live scrapes — the realistic production
+    shape — must ALSO take the append-repair path: nominal grid extended
+    by per-column midranges, deviations re-checked against the jitter
+    bound, results equal to a fresh engine."""
+    from filodb_tpu.core.schemas import PROM_COUNTER
+
+    rng = np.random.default_rng(21)
+    n0, nseries = 120, 5
+    nominal = BASE + (1 + np.arange(n0, dtype=np.int64)) * 10_000
+    data = {}
+    ms = TimeSeriesMemStore()
+    ms.setup(Dataset("ds"), [0])
+    for i in range(nseries):
+        ts = nominal + np.rint(rng.uniform(-0.05, 0.05, n0) * 10_000).astype(np.int64)
+        v = np.cumsum(rng.uniform(0, 10, n0)) + 1e9
+        data[i] = (list(ts), list(v))
+        tags = {"_metric_": "rq_total", "_ws_": "w", "_ns_": "n", "inst": f"h{i}"}
+        ms.shard("ds", 0).ingest_series(
+            SeriesBatch(PROM_COUNTER, tags, ts, {"count": v}))
+    engine = QueryEngine(ms, "ds")
+    s = (BASE + 400_000) / 1000
+    e = (BASE + (n0 + 30) * 10_000) / 1000
+    q = "sum(rate(rq_total[5m]))"
+    head = n0
+    restages = []
+    for step in range(4):
+        engine.query_range(q, s, e, 60)
+        if step == 0:
+            calls = _stage_calls(monkeypatch)
+        new_nom = BASE + (1 + head + np.arange(2, dtype=np.int64)) * 10_000
+        for i in range(nseries):
+            nts = new_nom + np.rint(
+                rng.uniform(-0.05, 0.05, 2) * 10_000).astype(np.int64)
+            nv = np.cumsum(rng.uniform(0, 10, 2)) + data[i][1][-1]
+            data[i][0].extend(nts.tolist())
+            data[i][1].extend(nv.tolist())
+            tags = {"_metric_": "rq_total", "_ws_": "w", "_ns_": "n",
+                    "inst": f"h{i}"}
+            ms.shard("ds", 0).ingest_series(
+                SeriesBatch(PROM_COUNTER, tags, nts, {"count": nv}))
+        head += 2
+    got = engine.query_range(q, s, e, 60).grids[0].values_np()
+    assert calls == [], "jittered live-edge appends must repair, not restage"
+    ms2 = TimeSeriesMemStore()
+    ms2.setup(Dataset("ds"), [0])
+    for i in range(nseries):
+        tags = {"_metric_": "rq_total", "_ws_": "w", "_ns_": "n", "inst": f"h{i}"}
+        ms2.shard("ds", 0).ingest_series(SeriesBatch(
+            PROM_COUNTER, tags, np.asarray(data[i][0], np.int64),
+            {"count": np.asarray(data[i][1])}))
+    want = QueryEngine(ms2, "ds").query_range(q, s, e, 60).grids[0].values_np()
+    np.testing.assert_array_equal(np.isnan(got), np.isnan(want))
+    ok = ~np.isnan(want)
+    np.testing.assert_allclose(got[ok], want[ok], rtol=1e-3, atol=1e-3)
+
+
+def test_jittered_gap_sample_is_never_silently_dropped(monkeypatch):
+    """Reviewer-found hazard: on a jittered grid a series with negative
+    head deviation can accept an in-order sample BELOW last_nom + maxdev;
+    the repair must not skip it (per-series read starts make it a
+    non-uniform batch -> restage fallback includes it)."""
+    from filodb_tpu.core.schemas import GAUGE as G
+
+    rng = np.random.default_rng(31)
+    n0, nseries = 80, 4
+    nominal = BASE + (1 + np.arange(n0, dtype=np.int64)) * 10_000
+    ms = TimeSeriesMemStore()
+    ms.setup(Dataset("ds"), [0])
+    all_tags = []
+    for i in range(nseries):
+        dev = np.rint(rng.uniform(-0.04, 0.04, n0) * 10_000).astype(np.int64)
+        if i == 0:
+            dev[-1] = -350  # series 0's head trails the last nominal slot
+        ts = nominal + dev
+        tags = {"_metric_": "g", "_ws_": "w", "_ns_": "n", "inst": f"h{i}"}
+        all_tags.append(tags)
+        ms.shard("ds", 0).ingest_series(SeriesBatch(
+            G, tags, ts, {"value": 50 + rng.standard_normal(n0)}))
+    engine = QueryEngine(ms, "ds")
+    s = (BASE + 400_000) / 1000
+    e = (BASE + (n0 + 20) * 10_000) / 1000
+    q = "sum(count_over_time(g[5m]))"
+    before = engine.query_range(q, s, e, 60).grids[0].values_np().copy()
+    blk = next(iter(ms.shard("ds", 0).stage_cache.values())).block
+    assert blk.nominal_ts is not None, "setup must stage a jittered block"
+    md = blk.maxdev_ms
+    # in-order for series 0 (after its head at last_nom-350) but BELOW
+    # last_nom + maxdev — the skipped-gap shape
+    gap_ts = nominal[-1] - 100
+    assert nominal[-1] - 350 < gap_ts <= nominal[-1] + md
+    ms.shard("ds", 0).ingest_series(SeriesBatch(
+        G, all_tags[0], np.array([gap_ts], np.int64), {"value": np.array([99.0])}))
+    after = engine.query_range(q, s, e, 60).grids[0].values_np()
+    # every 5m window covering gap_ts must count one more sample
+    assert np.nansum(after) > np.nansum(before), \
+        "the gap sample must be visible in cached query results"
+
+
 def test_append_repair_falls_back_when_grid_diverges(setup, monkeypatch):
     """Series appending DIFFERENT timestamps break the shared grid: repair
     must decline and a full re-stage must produce correct results."""
