@@ -130,7 +130,7 @@ class TimeSeriesShard:
         self.cardinality = CardinalityTracker()
         self._lock = threading.RLock()
         self._ingested_offset = -1  # stream offset watermark (Kafka analog)
-        # entries are StageEntry objects (block + bytes + dirty interval)
+        # entries are StageEntry objects (block + bytes + dirty/repairing)
         # data version for query-side staging caches: bumped on every ingest
         # so cached HBM-resident blocks invalidate (reference analog: block
         # memory reclaim + chunk seal versioning)
