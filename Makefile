@@ -2,7 +2,7 @@
 
 NATIVE_DIR := filodb_tpu/native
 
-.PHONY: all native test bench microbench serve clean tpu-watch tpu-watch-bg
+.PHONY: all native test test-chaos bench microbench serve clean tpu-watch tpu-watch-bg
 
 all: native
 
@@ -22,6 +22,11 @@ $(NATIVE_DIR)/libfilodbrender.so: $(NATIVE_DIR)/promrender.cpp
 
 test: native
 	python -m pytest tests/ -q
+
+# deterministic fault-injection suite (doc/robustness.md): retries,
+# circuit breakers, partial results, shard-reassignment convergence
+test-chaos: native
+	python -m pytest tests/ -q -m chaos
 
 bench: native
 	python bench.py

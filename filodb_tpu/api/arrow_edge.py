@@ -131,13 +131,26 @@ if HAVE_FLIGHT:
                 res = self.engine.query_range(
                     req["query"], float(req["start"]), float(req["end"]), float(req["step"])
                 )
+            # partial-results protocol: warnings/partial ride the stream's
+            # schema metadata so Flight clients can tell survivors-only
+            # data from a complete result
+            extra = {}
+            if res.warnings:
+                extra = {b"partial": b"1",
+                         b"warnings": json.dumps(res.warnings).encode()}
             batches = [grid_to_record_batch(g) for g in res.grids]
             if not batches:
                 schema = pa.schema(
                     [pa.field("labels", pa.utf8()), pa.field("values", pa.list_(pa.float32(), 1))],
-                    metadata={b"start_ms": b"0", b"step_ms": b"1", b"num_steps": b"0"},
+                    metadata={b"start_ms": b"0", b"step_ms": b"1", b"num_steps": b"0",
+                              **extra},
                 )
                 return _flight.RecordBatchStream(pa.Table.from_batches([], schema=schema))
+            if extra:
+                batches = [
+                    b.replace_schema_metadata({**(b.schema.metadata or {}), **extra})
+                    for b in batches
+                ]
             table = pa.Table.from_batches(batches, schema=batches[0].schema)
             return _flight.RecordBatchStream(table)
 
@@ -160,11 +173,17 @@ if HAVE_FLIGHT:
         def _collect(cls, endpoint, ticket) -> QueryResult:
             reader = cls.get(endpoint).do_get(ticket)
             grids = []
+            md = {}
             for chunk in reader:
                 rb = chunk.data
+                md = rb.schema.metadata or md
                 if rb.num_rows:
                     grids.append(record_batch_to_grid(rb))
-            return QueryResult(grids=grids)
+            res = QueryResult(grids=grids)
+            if md.get(b"warnings"):
+                res.warnings = json.loads(md[b"warnings"])
+                res.partial = True
+            return res
 
         @classmethod
         def query_range(cls, endpoint, query, start_s, end_s, step_s) -> QueryResult:

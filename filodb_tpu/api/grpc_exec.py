@@ -76,6 +76,15 @@ class _RemoteExecServicer:
             return self.local_engine
         return self.engine
 
+    @staticmethod
+    def _allow_partial(context) -> bool | None:
+        """Tri-state like the HTTP edge: absent metadata means the engine's
+        configured default, not False."""
+        for k, v in context.invocation_metadata():
+            if k == ALLOW_PARTIAL_MD_KEY:
+                return v == "1"
+        return None
+
     def _stream(self, run):
         """Run ``run()`` -> QueryResult and stream frames; errors go in-band
         as the final frame (clients re-raise typed)."""
@@ -109,13 +118,16 @@ class _RemoteExecServicer:
         self._authorize(context)
         eng = self._engine_for(request.params)
         p = request.params
+        allow_partial = self._allow_partial(context)
 
         def run():
             if request.instant:
-                return eng.query_instant(request.promql, p.end_ms / 1000.0)
+                return eng.query_instant(request.promql, p.end_ms / 1000.0,
+                                         allow_partial_results=allow_partial)
             return eng.query_range(
                 request.promql, p.start_ms / 1000.0, p.end_ms / 1000.0,
                 (p.step_ms or 1000) / 1000.0,
+                allow_partial_results=allow_partial,
             )
 
         yield from self._stream(run)
@@ -124,11 +136,13 @@ class _RemoteExecServicer:
         self._authorize(context)
         eng = self._engine_for(request.params)
         p = request.params
+        allow_partial = self._allow_partial(context)
 
         def run():
             plan = proto_to_plan(request.plan)
             return eng.execute_plan(plan, deadline_s=p.deadline_s,
-                                    max_series=p.max_series)
+                                    max_series=p.max_series,
+                                    allow_partial_results=allow_partial)
 
         yield from self._stream(run)
 
@@ -194,59 +208,118 @@ def _channel(endpoint: str) -> grpc.Channel:
         return ch
 
 
-def _metadata(auth_token: str | None):
-    return (("authorization", f"Bearer {auth_token}"),) if auth_token else None
+# the flag rides call metadata (no proto change): peers answering a
+# partial-tolerant origin degrade gracefully instead of failing the RPC
+ALLOW_PARTIAL_MD_KEY = "x-filodb-allow-partial"
+
+# transient codes; DEADLINE_EXCEEDED is excluded — the budget is already
+# burnt. Retry ownership: plan-scatter children (GrpcPlanRemoteExec) pass
+# retries=0 and mark the error retryable so the dispatch layer
+# (query/faults.py) owns the retry loop — breaker-aware, jittered, budgeted
+# by the query deadline, tunable via config query.retry.*. Direct client
+# helpers (exec_promql / remote_metadata) keep one transport-level retry
+# instead; either way exactly ONE layer retries.
+_RETRYABLE_CODES = (grpc.StatusCode.UNAVAILABLE, grpc.StatusCode.RESOURCE_EXHAUSTED)
+
+# codes that are NOT peer-health evidence and must not open the endpoint's
+# breaker: auth/arg/config problems are real answers from a live peer, and
+# DEADLINE_EXCEEDED reflects the ORIGIN's (possibly nearly-spent) budget —
+# a healthy peer given a 50ms window says nothing about the peer
+_NOT_PEER_HEALTH_CODES = (
+    grpc.StatusCode.UNAUTHENTICATED,
+    grpc.StatusCode.PERMISSION_DENIED,
+    grpc.StatusCode.INVALID_ARGUMENT,
+    grpc.StatusCode.UNIMPLEMENTED,
+    grpc.StatusCode.FAILED_PRECONDITION,
+    grpc.StatusCode.NOT_FOUND,
+    grpc.StatusCode.OUT_OF_RANGE,
+    grpc.StatusCode.DEADLINE_EXCEEDED,
+)
+
+
+def _metadata(auth_token: str | None, allow_partial: bool | None = None):
+    """``allow_partial`` is tri-state: None omits the key (peer uses its own
+    default); True/False send "1"/"0" so an origin's explicit choice —
+    including strict mode — overrides the peer's configured default."""
+    md = []
+    if auth_token:
+        md.append(("authorization", f"Bearer {auth_token}"))
+    if allow_partial is not None:
+        md.append((ALLOW_PARTIAL_MD_KEY, "1" if allow_partial else "0"))
+    return tuple(md) or None
 
 
 def _call_stream(endpoint: str, method: str, request, serializer, auth_token,
-                 timeout_s: float | None, retries: int = 1):
+                 timeout_s: float | None, retries: int = 1,
+                 allow_partial: bool | None = None):
     """unary_stream call with bounded UNAVAILABLE retries (mirrors the HTTP
-    transport's retry discipline in planners.fetch_json)."""
+    transport's retry discipline in planners.fetch_json). ``timeout_s`` is a
+    TOTAL budget: retries and their per-attempt RPC deadlines all fit inside
+    it, so a hung peer cannot stall past the caller's query deadline."""
+    import time as _t
+
     ch = _channel(endpoint)
     call = ch.unary_stream(
         method,
         request_serializer=serializer,
         response_deserializer=pb.StreamFrame.FromString,
     )
+    deadline = None if timeout_s is None else _t.monotonic() + timeout_s
+    md = _metadata(auth_token, allow_partial)
     attempt = 0
     while True:
+        per_attempt = (
+            None if deadline is None else max(deadline - _t.monotonic(), 0.001)
+        )
         try:
-            return frames_to_result(
-                call(request, timeout=timeout_s, metadata=_metadata(auth_token))
-            )
+            return frames_to_result(call(request, timeout=per_attempt, metadata=md))
         except grpc.RpcError as e:
             code = e.code() if hasattr(e, "code") else None
-            if code == grpc.StatusCode.UNAVAILABLE and attempt < retries:
+            backoff = 0.2 * (attempt + 1)
+            if (
+                code in _RETRYABLE_CODES
+                and attempt < retries
+                and (deadline is None or _t.monotonic() + backoff < deadline)
+            ):
                 attempt += 1
-                import time as _t
-
-                _t.sleep(0.2 * attempt)
+                _t.sleep(backoff)
                 continue
-            raise RemoteExecError(str(code), e.details() if hasattr(e, "details") else str(e)) from e
+            err = RemoteExecError(
+                str(code), e.details() if hasattr(e, "details") else str(e)
+            )
+            # only when NO transport retry happened: the dispatch layer may
+            # retry a transient code it knows was tried exactly once
+            err.retryable = retries == 0 and code in _RETRYABLE_CODES
+            err.endpoint_failure = code not in _NOT_PEER_HEALTH_CODES
+            raise err from e
 
 
 def exec_promql(endpoint: str, promql: str, start_ms: int, end_ms: int, step_ms: int,
                 auth_token: str | None = None, local_only: bool = False,
-                instant: bool = False, timeout_s: float | None = None):
+                instant: bool = False, timeout_s: float | None = None,
+                allow_partial: bool | None = None):
     req = pb.ExecRequest(
         promql=promql, instant=instant,
         params=pb.QueryParams(start_ms=start_ms, end_ms=end_ms, step_ms=step_ms,
                               local_only=local_only),
     )
     return _call_stream(endpoint, _EXEC, req, pb.ExecRequest.SerializeToString,
-                        auth_token, timeout_s)
+                        auth_token, timeout_s, allow_partial=allow_partial)
 
 
 def exec_plan_remote(endpoint: str, logical_plan, auth_token: str | None = None,
                      local_only: bool = False, deadline_s: float = 0.0,
-                     max_series: int = 0, timeout_s: float | None = None):
+                     max_series: int = 0, timeout_s: float | None = None,
+                     allow_partial: bool | None = None, transport_retries: int = 1):
     req = pb.ExecutePlanRequest(
         plan=plan_to_proto(logical_plan),
         params=pb.QueryParams(local_only=local_only, deadline_s=deadline_s,
                               max_series=max_series),
     )
     return _call_stream(endpoint, _EXECUTE_PLAN, req,
-                        pb.ExecutePlanRequest.SerializeToString, auth_token, timeout_s)
+                        pb.ExecutePlanRequest.SerializeToString, auth_token,
+                        timeout_s, retries=transport_retries,
+                        allow_partial=allow_partial)
 
 
 from ..query.exec.plans import ExecPlan  # noqa: E402  (no cycle: query/ never imports api/)
@@ -278,10 +351,20 @@ class GrpcPlanRemoteExec(ExecPlan):
         return f"endpoint={self.endpoint} plan={type(self.logical_plan).__name__}"
 
     def do_execute(self, ctx):
+        # budget with the REMAINING deadline, not the full deadline_s: by
+        # the time this child dispatches (or re-dispatches on retry), part
+        # of the query budget is already spent, and both the per-RPC timeout
+        # and the peer's own deadline must fit in what's left
+        remaining = ctx.remaining_deadline_s()
         return exec_plan_remote(
             self.endpoint, self.logical_plan, auth_token=self.auth_token,
-            local_only=self.local_only, deadline_s=ctx.deadline_s,
-            max_series=ctx.max_series, timeout_s=self.timeout_s or ctx.deadline_s,
+            local_only=self.local_only, deadline_s=remaining,
+            max_series=ctx.max_series,
+            timeout_s=min(self.timeout_s, remaining) if self.timeout_s else remaining,
+            allow_partial=getattr(ctx, "allow_partial_results", False),
+            # the dispatch layer (faults.call_with_retries) owns this
+            # child's retries: transient errors come back marked retryable
+            transport_retries=0,
         )
 
 

@@ -35,6 +35,7 @@ from ..coordinator.planner import QueryEngine
 from ..core.filters import ColumnFilter
 from ..query.exec.transformers import QueryError
 from ..query.promql import PromQLError, Parser as PromParser
+from ..query.proto_plan import RemoteExecError
 from . import promjson as J
 
 
@@ -159,6 +160,13 @@ class PromApiHandler(BaseHTTPRequestHandler):
         v = params.get(name)
         return v[0] if v else default
 
+    def _allow_partial(self, params) -> bool | None:
+        """Tri-state: None = engine default, else the request's choice."""
+        v = self._q(params, "allow_partial_results")
+        if v is None:
+            return None
+        return v.lower() in ("1", "true", "yes")
+
     # -- routing ----------------------------------------------------------
 
     def do_GET(self):
@@ -254,12 +262,17 @@ class PromApiHandler(BaseHTTPRequestHandler):
             if path == "/api/v1/status/flags" or path == "/api/v1/status/config":
                 return self._send(200, J.success({}))
             self._send(404, J.error("not_found", f"unknown path {path}"))
-        except (PromQLError, QueryError, ValueError) as e:
+        except (PromQLError, QueryError, ValueError, RemoteExecError) as e:
+            from ..coordinator.planners import RemoteFetchError
             from ..coordinator.scheduler import QueryRejected
             from ..query.exec.transformers import QueryDeadlineExceeded
+            from ..query.faults import CircuitOpenError
 
-            if isinstance(e, QueryRejected):
-                # overload: bounded scheduler is saturated (Prometheus: 503)
+            if isinstance(e, (QueryRejected, CircuitOpenError, RemoteFetchError,
+                              RemoteExecError)):
+                # overload / open breaker / peer transport outage (either
+                # transport): availability conditions, not bad queries
+                # (Prometheus: 503)
                 self._send(503, J.error("unavailable", str(e)))
             elif isinstance(e, QueryDeadlineExceeded):
                 self._send(503, J.error("timeout", str(e)))
@@ -284,7 +297,10 @@ class PromApiHandler(BaseHTTPRequestHandler):
             )
         if end < start:
             return self._send(400, J.error("bad_data", "end timestamp before start"))
-        res = self._engine_for_request().query_range(query, start, end, step)
+        res = self._engine_for_request().query_range(
+            query, start, end, step, allow_partial_results=self._allow_partial(p)
+        )
+        warnings = res.warnings or None
         if res.result_type == "scalar":
             # range query over a scalar: render as matrix of the scalar
             sc = res.scalar
@@ -304,7 +320,7 @@ class PromApiHandler(BaseHTTPRequestHandler):
                 if sc is not None
                 else [],
             }
-            return self._send(200, J.success(data))
+            return self._send(200, J.success(data, warnings=warnings, partial=res.partial))
         stats = {
             "seriesScanned": res.stats.series_scanned,
             "samplesScanned": res.stats.samples_scanned,
@@ -318,10 +334,10 @@ class PromApiHandler(BaseHTTPRequestHandler):
         if res.raw is not None:
             n_samples += sum(len(t) for _, t, _ in res.raw)
         if n_samples >= self.STREAM_MIN_SAMPLES:
-            return self._send_chunked(200, J.stream_matrix(res, stats))
+            return self._send_chunked(200, J.stream_matrix(res, stats, warnings=warnings))
         data = J.render_matrix(res)
         data["stats"] = stats
-        return self._send(200, J.success(data))
+        return self._send(200, J.success(data, warnings=warnings, partial=res.partial))
 
     def _query(self):
         p = self._params()
@@ -329,12 +345,18 @@ class PromApiHandler(BaseHTTPRequestHandler):
         if not query:
             return self._send(400, J.error("bad_data", "missing query"))
         t = _parse_time(self._q(p, "time"), default=time.time())
-        res = self._engine_for_request().query_instant(query, t)
+        res = self._engine_for_request().query_instant(
+            query, t, allow_partial_results=self._allow_partial(p)
+        )
+        warnings = res.warnings or None
         if res.result_type == "scalar":
-            return self._send(200, J.success(J.render_scalar(res, t)))
+            return self._send(200, J.success(J.render_scalar(res, t), warnings=warnings,
+                                             partial=res.partial))
         if res.raw is not None:
-            return self._send(200, J.success(J.render_matrix(res)))
-        return self._send(200, J.success(J.render_vector(res, t)))
+            return self._send(200, J.success(J.render_matrix(res), warnings=warnings,
+                                             partial=res.partial))
+        return self._send(200, J.success(J.render_vector(res, t), warnings=warnings,
+                                         partial=res.partial))
 
     def _labels(self):
         p = self._params()

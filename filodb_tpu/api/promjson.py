@@ -108,7 +108,7 @@ def _values_fragment(ts_s: np.ndarray, vals: np.ndarray) -> bytes:
 
 
 def stream_matrix(res: QueryResult, stats: dict | None = None,
-                  chunk_target: int = 1 << 18):
+                  chunk_target: int = 1 << 18, warnings: list | None = None):
     """Generator of JSON byte chunks for a matrix result envelope.
 
     The serving-edge answer to reference executeStreaming
@@ -174,12 +174,23 @@ def stream_matrix(res: QueryResult, stats: dict | None = None,
     buf += b"]"
     if stats is not None:
         buf += b',"stats":' + json.dumps(stats).encode()
-    buf += b"}}"
+    buf += b"}"  # close data
+    if warnings:
+        buf += b',"partial":true,"warnings":' + json.dumps(warnings).encode()
+    buf += b"}"
     yield bytes(buf)
 
 
-def success(data: Any) -> dict:
-    return {"status": "success", "data": data}
+def success(data: Any, warnings: list | None = None, partial: bool = False) -> dict:
+    """Success envelope; partial results carry top-level ``warnings`` (the
+    Prometheus envelope's warnings slot, structured) + ``"partial": true``."""
+    out = {"status": "success", "data": data}
+    if warnings:
+        out["partial"] = True
+        out["warnings"] = warnings
+    elif partial:
+        out["partial"] = True
+    return out
 
 
 def error(err_type: str, message: str) -> dict:

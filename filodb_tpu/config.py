@@ -32,6 +32,21 @@ DEFAULTS: dict = {
         # 0 = run queries inline on the API edge threads (tests/embedding)
         "parallelism": 8,
         "max_queued": 64,
+        # fault tolerance (query/faults.py): default partial-results stance
+        # (per-request allow_partial_results overrides), remote-child retry
+        # budget, and per-endpoint circuit-breaker thresholds
+        "allow_partial_results": False,
+        "retry": {
+            "max_attempts": 3,
+            "base_backoff_s": 0.1,
+            "max_backoff_s": 2.0,
+        },
+        "breaker": {
+            "window": 16,
+            "failure_rate": 0.5,
+            "min_calls": 4,
+            "cooldown_s": 15.0,
+        },
     },
     # API
     "http_port": 9090,

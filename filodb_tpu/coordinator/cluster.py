@@ -108,13 +108,20 @@ class ShardManager:
     DOWN instead of bounced again)."""
 
     def __init__(self, num_shards: int, shards_per_node: int,
-                 reassignment_damper_s: float = 7200.0):
+                 reassignment_damper_s: float = 7200.0,
+                 clock: Callable[[], float] = time.time):
         self.mapper = ShardMapper(num_shards)
         self.strategy = ShardAssignmentStrategy()
         self.shards_per_node = shards_per_node
         self.damper_s = reassignment_damper_s
+        self._clock = clock  # injectable for deterministic chaos tests
         self.nodes: list[str] = []
         self._last_reassign: dict[int, float] = {}
+
+    def damper_active(self, shard: int) -> bool:
+        """True while a recent reassignment suppresses another bounce."""
+        last = self._last_reassign.get(shard)
+        return last is not None and self._clock() - last < self.damper_s
 
     # -- membership -------------------------------------------------------
 
@@ -134,13 +141,18 @@ class ShardManager:
         return self._reassign(shards)
 
     def _reassign(self, shards: Sequence[int]) -> list[int]:
+        from ..metrics import record_shard_reassignment
+
         moved = []
-        now = time.time()
+        now = self._clock()
         for s in shards:
-            last = self._last_reassign.get(s, 0)
-            if now - last < self.damper_s:
+            # a shard never reassigned before is infinitely old — the damper
+            # only suppresses REPEAT bounces (clocks may start near zero)
+            last = self._last_reassign.get(s)
+            if last is not None and now - last < self.damper_s:
                 # bounced too recently -> stop flapping (reference damper)
                 self.mapper.update(s, ShardStatus.DOWN, None)
+                record_shard_reassignment(s, damped=True)
                 continue
             per_node = self.strategy.assign(self.mapper, self.nodes, self.shards_per_node)
             for node, got in per_node.items():
@@ -148,6 +160,7 @@ class ShardManager:
                     self.mapper.update(s, ShardStatus.ASSIGNED, node)
                     self._last_reassign[s] = now
                     moved.append(s)
+                    record_shard_reassignment(s, damped=False)
                     break
         return moved
 

@@ -285,6 +285,15 @@ class PlannerParams:
     # fan-out: one kernel launch serves every copy). In-flight sharing only,
     # never a cache — see coordinator.scheduler.SingleFlight.
     coalesce_identical: bool = True
+    # fault tolerance (query/faults.py): default for per-query
+    # allow_partial_results (merge nodes tolerate lost shards/peers,
+    # tagging results with structured warnings); retry_policy / breakers
+    # override the module defaults (None = DEFAULT_RETRY_POLICY /
+    # GLOBAL_BREAKERS); dispatcher wraps child execution (fault injection)
+    allow_partial_results: bool = False
+    retry_policy: object | None = None
+    breakers: object | None = None
+    dispatcher: object | None = None
 
 
 class SingleClusterPlanner:
@@ -888,13 +897,40 @@ class QueryEngine:
         self.planner = SingleClusterPlanner(memstore, dataset, params=params)
         self._single_flight = SingleFlight()
 
-    def context(self) -> QueryContext:
+    def context(self, allow_partial_results: bool | None = None) -> QueryContext:
+        params = self.planner.params
         ctx = QueryContext(self.memstore, self.dataset)
-        ctx.max_series = self.planner.params.max_series
-        ctx.deadline_s = self.planner.params.deadline_s
+        ctx.max_series = params.max_series
+        ctx.deadline_s = params.deadline_s
+        ctx.allow_partial_results = (
+            params.allow_partial_results if allow_partial_results is None
+            else bool(allow_partial_results)
+        )
+        ctx.retry_policy = params.retry_policy
+        ctx.breakers = params.breakers
+        ctx.dispatcher = params.dispatcher
         return ctx
 
-    def query_range(self, promql: str, start_s: float, end_s: float, step_s: float):
+    def _finish(self, res, ctx):
+        """Attach per-query stats + partial-result warnings collected on the
+        context during scatter-gather (query/faults.py)."""
+        res.stats = ctx.stats  # per-query scan/latency stats ride in responses
+        if ctx.warnings:
+            from ..metrics import record_partial_result
+
+            # order-preserving dedup: a remote child's warnings can be seen
+            # both in its own result and hoisted onto the context
+            deduped: list = []
+            for w in ctx.warnings:
+                if w not in deduped:
+                    deduped.append(w)
+            res.warnings = deduped
+            res.partial = True
+            record_partial_result(self.dataset)
+        return res
+
+    def query_range(self, promql: str, start_s: float, end_s: float, step_s: float,
+                    allow_partial_results: bool | None = None):
         """PromQL range query. Concurrent identical queries coalesce into
         ONE plan+stage+kernel execution (reference: the shared
         QueryScheduler pool, QueryScheduler.scala:29-73, plus single-flight
@@ -907,14 +943,24 @@ class QueryEngine:
         from ..metrics import REGISTRY
 
         t0 = _time.perf_counter()
+        # resolve the tri-state BEFORE keying: "absent" and "explicitly the
+        # engine default" are the same query and must coalesce together
+        allow_partial = (
+            self.planner.params.allow_partial_results
+            if allow_partial_results is None else bool(allow_partial_results)
+        )
         if self.planner.params.coalesce_identical:
             res = self._single_flight.run(
-                (self.dataset, promql, float(start_s), float(end_s), float(step_s)),
-                lambda: self._query_range_uncoalesced(promql, start_s, end_s, step_s),
+                (self.dataset, promql, float(start_s), float(end_s), float(step_s),
+                 allow_partial),
+                lambda: self._query_range_uncoalesced(
+                    promql, start_s, end_s, step_s, allow_partial
+                ),
                 timeout_s=self.planner.params.deadline_s,
             )
         else:
-            res = self._query_range_uncoalesced(promql, start_s, end_s, step_s)
+            res = self._query_range_uncoalesced(promql, start_s, end_s, step_s,
+                                                allow_partial)
         REGISTRY.counter("filodb_queries", dataset=self.dataset).inc()
         REGISTRY.histogram("filodb_query_latency_seconds", dataset=self.dataset).observe(
             _time.perf_counter() - t0
@@ -922,7 +968,8 @@ class QueryEngine:
         return res
 
     def _query_range_uncoalesced(self, promql: str, start_s: float,
-                                 end_s: float, step_s: float):
+                                 end_s: float, step_s: float,
+                                 allow_partial_results: bool | None = None):
         plan = query_range_to_logical_plan(promql, start_s, end_s, step_s,
                                            self.planner.params.lookback_ms)
         if self.planner.params.agg_rules is not None:
@@ -930,9 +977,9 @@ class QueryEngine:
 
             plan = optimize_with_preagg(plan, self.planner.params.agg_rules)
         exec_plan = self.planner.materialize(plan)
-        ctx = self.context()
+        ctx = self.context(allow_partial_results)
         res = self._run(exec_plan, ctx)
-        res.stats = ctx.stats  # per-query scan/latency stats ride in responses
+        self._finish(res, ctx)
         if res.result_type == "matrix" or res.grids:
             res.result_type = "matrix"
         return res
@@ -945,7 +992,8 @@ class QueryEngine:
             return exec_plan.execute(ctx)
         return sched.run(lambda: exec_plan.execute(ctx), deadline_s=ctx.deadline_s)
 
-    def execute_plan(self, plan, deadline_s: float = 0.0, max_series: int = 0):
+    def execute_plan(self, plan, deadline_s: float = 0.0, max_series: int = 0,
+                     allow_partial_results: bool | None = None):
         """Execute an already-built LogicalPlan — THE entry for plan-level
         remote transports (gRPC ExecutePlan, Flight plan tickets), so every
         transport shares the same pre-agg rewrite, limits, and scheduler
@@ -955,14 +1003,13 @@ class QueryEngine:
 
             plan = optimize_with_preagg(plan, self.planner.params.agg_rules)
         exec_plan = self.planner.materialize(plan)
-        ctx = self.context()
+        ctx = self.context(allow_partial_results)
         if deadline_s:
             ctx.deadline_s = min(ctx.deadline_s, deadline_s)
         if max_series:
             ctx.max_series = min(ctx.max_series, max_series)
         res = self._run(exec_plan, ctx)
-        res.stats = ctx.stats
-        return res
+        return self._finish(res, ctx)
 
     def label_values(self, filters, label: str, start_ms: int, end_ms: int, limit=None):
         """Metadata through the planner so multi-host peers scatter too."""
@@ -986,12 +1033,13 @@ class QueryEngine:
         plan = L.TsCardinalities(tuple(prefix), depth if depth is not None else len(tuple(prefix)) + 1)
         return self.planner.materialize(plan).execute(self.context()).metadata
 
-    def query_instant(self, promql: str, time_s: float):
+    def query_instant(self, promql: str, time_s: float,
+                      allow_partial_results: bool | None = None):
         plan = query_to_logical_plan(promql, time_s, self.planner.params.lookback_ms)
         exec_plan = self.planner.materialize(plan)
-        ctx = self.context()
+        ctx = self.context(allow_partial_results)
         res = self._run(exec_plan, ctx)
-        res.stats = ctx.stats
+        self._finish(res, ctx)
         if res.result_type == "matrix":
             res.result_type = "vector"
         return res

@@ -47,14 +47,29 @@ _RETRIES = 3
 _BACKOFF_S = (0.2, 0.8)
 
 
+class RemoteFetchError(QueryError):
+    """Transport-level remote HTTP failure (exhausted retries / 5xx). Counts
+    against the endpoint's circuit breaker (query/faults.py) but is NOT
+    re-retried at the dispatch layer — fetch_json already retried."""
+
+    endpoint_failure = True
+
+
 def fetch_json(url: str, auth_token: str | None = None, local_only: bool = False,
-               timeout: float = 60, data: dict | None = None) -> dict | list:
+               timeout: float = 60, data: dict | None = None,
+               want_envelope: bool = False) -> dict | list:
     """THE remote-HTTP fetch used by every cross-host path (query scatter,
     federation, metadata, membership): gzip transport, bearer auth,
     X-FiloDB-Local pinning, bounded retries with backoff on transient
     failures (5xx / connection errors / timeouts; 4xx fails fast). ``data``
     switches to a JSON POST. Returns the parsed ``data`` payload of a
-    successful Prometheus-shaped response."""
+    successful Prometheus-shaped response (``want_envelope=True`` returns
+    the whole envelope — the partial-results scatter reads top-level
+    ``warnings``/``partial``).
+
+    ``timeout`` is a TOTAL budget: per-attempt socket timeouts shrink to the
+    remaining budget and retries/backoffs never run past it, so a hung peer
+    cannot stall a deadline-budgeted caller for retries x timeout."""
     import gzip
     import time as _time
     import urllib.error
@@ -69,18 +84,22 @@ def fetch_json(url: str, auth_token: str | None = None, local_only: bool = False
     if data is not None:
         body = json.dumps(data).encode()
         headers["Content-Type"] = "application/json"
+    deadline = _time.monotonic() + timeout
     last_err: Exception | None = None
     for attempt in range(_RETRIES):
+        per_attempt = deadline - _time.monotonic()
+        if per_attempt <= 0:
+            break
         try:
             req = urllib.request.Request(url, data=body, headers=headers)
-            with urllib.request.urlopen(req, timeout=timeout) as r:
+            with urllib.request.urlopen(req, timeout=per_attempt) as r:
                 raw = r.read()
                 if r.headers.get("Content-Encoding") == "gzip":
                     raw = gzip.decompress(raw)
                 payload = json.loads(raw)
             if payload.get("status") != "success":
                 raise QueryError(f"remote request failed: {payload}")
-            return payload["data"]
+            return payload if want_envelope else payload["data"]
         except urllib.error.HTTPError as e:
             if e.code < 500:
                 raise QueryError(f"remote request failed: HTTP {e.code} {e.reason}") from e
@@ -88,8 +107,11 @@ def fetch_json(url: str, auth_token: str | None = None, local_only: bool = False
         except (urllib.error.URLError, TimeoutError, ConnectionError) as e:
             last_err = e
         if attempt < _RETRIES - 1:
-            _time.sleep(_BACKOFF_S[min(attempt, len(_BACKOFF_S) - 1)])
-    raise QueryError(f"remote request failed after {_RETRIES} attempts: {last_err}")
+            backoff = _BACKOFF_S[min(attempt, len(_BACKOFF_S) - 1)]
+            if _time.monotonic() + backoff >= deadline:
+                break  # budget exhausted: surface the last error now
+            _time.sleep(backoff)
+    raise RemoteFetchError(f"remote request failed after retries: {last_err}")
 
 
 class PromQlRemoteExec(ExecPlan):
@@ -122,7 +144,16 @@ class PromQlRemoteExec(ExecPlan):
             f"{self.endpoint}/api/v1/query_range?query={q}"
             f"&start={self.start_ms / 1000}&end={self.end_ms / 1000}&step={self.step_ms / 1000}"
         )
-        data = fetch_json(url, auth_token=self.auth_token, local_only=self.local_only)
+        # forward the origin's RESOLVED stance explicitly (true or false) so
+        # it overrides the peer's own configured default either way; a
+        # partial peer's top-level warnings fold into this child's result
+        allow_partial = getattr(ctx, "allow_partial_results", False)
+        url += f"&allow_partial_results={'true' if allow_partial else 'false'}"
+        envelope = fetch_json(
+            url, auth_token=self.auth_token, local_only=self.local_only,
+            timeout=max(ctx.remaining_deadline_s(), 0.1), want_envelope=True,
+        )
+        data = envelope["data"]
         result = data["result"]
         num_steps = int((self.end_ms - self.start_ms) // self.step_ms) + 1
         times = self.start_ms + np.arange(num_steps, dtype=np.int64) * self.step_ms
@@ -143,7 +174,11 @@ class PromQlRemoteExec(ExecPlan):
             labels.append(lbls)
             rows.append(row)
         vals = np.stack(rows) if rows else np.zeros((0, num_steps), np.float32)
-        return QueryResult(grids=[Grid(labels, self.start_ms, self.step_ms, num_steps, vals)])
+        out = QueryResult(grids=[Grid(labels, self.start_ms, self.step_ms, num_steps, vals)])
+        if envelope.get("warnings"):
+            out.warnings = list(envelope["warnings"])
+            out.partial = True
+        return out
 
 
 # ---------------------------------------------------------------------------

@@ -112,6 +112,43 @@ class Registry:
 
 REGISTRY = Registry()
 
+# -- fault-tolerance instrumentation ----------------------------------------
+# (query/faults.py circuit breakers + remote retries; reference Kamon
+# counters around PromQlRemoteExec / ShardHealthStats)
+
+_BREAKER_STATE_VALUE = {"closed": 0.0, "half_open": 0.5, "open": 1.0}
+
+
+def record_breaker_transition(endpoint: str, from_state: str, to_state: str) -> None:
+    """Count a circuit-breaker state transition and expose the current
+    state as a gauge (0 closed, 0.5 half-open, 1 open)."""
+    REGISTRY.counter(
+        "filodb_breaker_transitions", endpoint=endpoint,
+        frm=from_state, to=to_state,
+    ).inc()
+    REGISTRY.gauge("filodb_breaker_state", endpoint=endpoint).set(
+        _BREAKER_STATE_VALUE.get(to_state, -1.0)
+    )
+
+
+def record_remote_retry(endpoint: str) -> None:
+    REGISTRY.counter("filodb_remote_retries", endpoint=endpoint).inc()
+
+
+def record_partial_result(dataset: str) -> None:
+    """A query answered with merged partials (some children lost)."""
+    REGISTRY.counter("filodb_partial_results", dataset=dataset).inc()
+
+
+def record_shard_reassignment(shard: int, damped: bool) -> None:
+    """ShardManager ingestion-error handling: reassigned vs damper-DOWN,
+    per shard so one flapping shard is distinguishable from many."""
+    REGISTRY.counter(
+        "filodb_shard_reassignments", shard=str(shard),
+        outcome="down" if damped else "moved",
+    ).inc()
+
+
 # -- tracing ----------------------------------------------------------------
 
 _trace_local = threading.local()

@@ -38,6 +38,15 @@ class QueryContext:
     max_result_bytes: int = 1 << 30
     deadline_s: float = 60.0
     stats: QueryStats = field(default_factory=QueryStats)
+    # fault tolerance (query/faults.py): tolerate lost children in merge
+    # nodes, collecting structured warnings instead of aborting
+    allow_partial_results: bool = False
+    warnings: list = field(default_factory=list)
+    # dispatch hooks: dispatcher wraps child execution (fault injection),
+    # retry_policy/breakers override the defaults for remote children
+    dispatcher: Any = None
+    retry_policy: Any = None
+    breakers: Any = None
     _start_time: float = field(default_factory=time.monotonic)
 
     def check_deadline(self) -> None:
@@ -50,6 +59,11 @@ class QueryContext:
             raise QueryDeadlineExceeded(
                 f"query exceeded deadline: {elapsed:.1f}s > {self.deadline_s:.1f}s"
             )
+
+    def remaining_deadline_s(self) -> float:
+        """Unspent deadline budget — what retries and per-RPC timeouts must
+        fit inside (never the full deadline_s)."""
+        return max(0.0, self.deadline_s - (time.monotonic() - self._start_time))
 
 
 class ExecPlan:
@@ -67,6 +81,14 @@ class ExecPlan:
         ctx.check_deadline()
         with span(type(self).__name__):
             res = self.do_execute(ctx)
+            if res.warnings:
+                # remote children return their own partial-result warnings
+                # in-band; hoist them onto the context so they survive
+                # transformer folding (which rebuilds QueryResults) and
+                # reach the query's final result. Remote children run on
+                # pool threads, so this must be a single atomic extend —
+                # dedup happens once at the engine edge.
+                ctx.warnings.extend(res.warnings)
             for tr in self.transformers:
                 with span(type(tr).__name__):
                     res = apply_transformer(tr, res, ctx)
@@ -205,6 +227,9 @@ class SelectRawPartitionsExec(ExecPlan):
         res = QueryResult()
         res.raw_grids = []
         for schema_name, ids in by_schema.items():
+            # long local scans must respect the query deadline between
+            # schema groups, not just at plan entry
+            ctx.check_deadline()
             parts = [shard.partition(p) for p in ids]
             schema = parts[0].schema
             col_name = self.column or column_override or schema.value_column
@@ -447,6 +472,11 @@ def _histogram_suffix_rewrite(filters):
 
 
 class NonLeafExecPlan(ExecPlan):
+    # merge nodes whose semantics tolerate losing a child under
+    # ctx.allow_partial_results (shard/peer partials are mergeable);
+    # structural nodes (joins, scalar ops, stitches) keep all-or-nothing
+    supports_partial = False
+
     def __init__(self, child_plans: Sequence[ExecPlan]):
         super().__init__()
         self.child_plans = list(child_plans)
@@ -454,33 +484,107 @@ class NonLeafExecPlan(ExecPlan):
     def children(self):
         return self.child_plans
 
+    @staticmethod
+    def _annotate_child_error(child: ExecPlan, e: Exception) -> Exception:
+        """Wrap the first child failure with the child's identity so a
+        scatter-gather error names its shard/endpoint; the exception TYPE is
+        preserved (deadline/rejection still map to their status codes)."""
+        note = f"{type(child).__name__}({child.args_str()})"
+        msg = str(e.args[0]) if e.args else str(e)
+        if note not in msg:
+            e.args = (f"{msg} [child {note}]",) + tuple(e.args[1:])
+        return e
+
     def execute_children(self, ctx: QueryContext) -> list[QueryResult]:
         """Children execute in order, EXCEPT network-bound children (remote
         execs mark ``is_remote``) which dispatch concurrently on IO threads —
         the reference runs children as concurrent monix Tasks; here local
-        children share the device serially while peer round-trips overlap."""
-        remotes = [
-            (i, c) for i, c in enumerate(self.child_plans)
-            if getattr(c, "is_remote", False)
-        ]
-        if len(remotes) < 1 or len(self.child_plans) < 2:
-            return [c.execute(ctx) for c in self.child_plans]
-        from concurrent.futures import ThreadPoolExecutor
+        children share the device serially while peer round-trips overlap.
 
+        All execution flows through faults.dispatch_child (fault-injection
+        hook + per-endpoint breaker/retries for remote children). The first
+        failure cancels remaining in-flight futures and re-raises annotated
+        with the child's args_str(); under ctx.allow_partial_results, merge
+        nodes (supports_partial) instead record a structured warning per
+        lost child and return the survivors."""
+        from ..faults import child_warning, dispatch_child
+        from .transformers import QueryDeadlineExceeded
+
+        children = self.child_plans
+        allow_partial = (
+            self.supports_partial and getattr(ctx, "allow_partial_results", False)
+        )
+        remote_idx = [
+            i for i, c in enumerate(children) if getattr(c, "is_remote", False)
+        ]
         results: dict[int, QueryResult] = {}
-        with ThreadPoolExecutor(max_workers=min(8, len(remotes)),
-                                thread_name_prefix="filodb-remote") as pool:
-            futs = {i: pool.submit(c.execute, ctx) for i, c in remotes}
-            for i, c in enumerate(self.child_plans):
-                if i not in futs:
-                    results[i] = c.execute(ctx)
-            for i, f in futs.items():
-                results[i] = f.result()
-        return [results[i] for i in range(len(self.child_plans))]
+        failures: list[tuple[int, Exception]] = []
+        pool = futs = None
+        if remote_idx and len(children) >= 2:
+            from concurrent.futures import ThreadPoolExecutor
+
+            pool = ThreadPoolExecutor(max_workers=min(8, len(remote_idx)),
+                                      thread_name_prefix="filodb-remote")
+            futs = {i: pool.submit(dispatch_child, children[i], ctx)
+                    for i in remote_idx}
+        try:
+            for i, c in enumerate(children):
+                if futs is not None and i in futs:
+                    continue
+                try:
+                    results[i] = dispatch_child(c, ctx)
+                except QueryDeadlineExceeded as e:
+                    # OUR spent budget is a query-level condition, never a
+                    # "lost child": a timeout must not degrade into a 200
+                    # partial success. But a child's deadline error while
+                    # origin budget remains (a peer with a stricter local
+                    # deadline) is just a slow child — partial-eligible.
+                    if not allow_partial or ctx.remaining_deadline_s() <= 0:
+                        raise self._annotate_child_error(c, e)
+                    failures.append((i, e))
+                except Exception as e:  # noqa: BLE001 — classified below
+                    failures.append((i, e))
+                    if not allow_partial:
+                        raise self._annotate_child_error(c, e)
+            if futs is not None:
+                from concurrent.futures import as_completed
+
+                fut_to_idx = {f: i for i, f in futs.items()}
+                # consume in COMPLETION order: a failed future surfaces
+                # immediately instead of blocking behind slower siblings
+                for f in as_completed(futs.values()):
+                    i = fut_to_idx[f]
+                    try:
+                        results[i] = f.result()
+                    except QueryDeadlineExceeded as e:
+                        if not allow_partial or ctx.remaining_deadline_s() <= 0:
+                            raise self._annotate_child_error(children[i], e)
+                        failures.append((i, e))
+                    except Exception as e:  # noqa: BLE001
+                        failures.append((i, e))
+                        if not allow_partial:
+                            raise self._annotate_child_error(children[i], e)
+        finally:
+            if pool is not None:
+                # on error: unstarted futures never run, and we do NOT block
+                # waiting for hung RPCs — in-flight calls finish on their own
+                # per-RPC deadlines (always <= the remaining query budget)
+                pool.shutdown(wait=False, cancel_futures=True)
+        if failures:
+            if len(failures) == len(children):
+                # nothing survived: a fully-failed merge is an error even
+                # under allow_partial_results
+                i, e = failures[0]
+                raise self._annotate_child_error(children[i], e)
+            for i, e in failures:
+                ctx.warnings.append(child_warning(children[i], e))
+        return [results[i] for i in sorted(results)]
 
 
 class DistConcatExec(NonLeafExecPlan):
     """Concatenate child results (reference DistConcatExec)."""
+
+    supports_partial = True  # shard-disjoint series: survivors are exact
 
     def do_execute(self, ctx: QueryContext) -> QueryResult:
         out = QueryResult()
@@ -745,6 +849,8 @@ def collect_partials(result: QueryResult, default_op: str):
 class ReduceAggregateExec(NonLeafExecPlan):
     """reference ReduceAggregateExec + RangeVectorAggregator.mapReduce."""
 
+    supports_partial = True  # __comp__ partials merge over any child subset
+
     def __init__(self, child_plans, op: str, by=None, without=None):
         super().__init__(child_plans)
         self.op = op
@@ -772,6 +878,8 @@ class PartialReduceExec(NonLeafExecPlan):
     executor of L.PartialAggregate — what a federation peer runs so only
     O(groups) mergeable components cross the wire (reference
     RowAggregator.scala:28,114; AggrOverRangeVectors.scala:224)."""
+
+    supports_partial = True
 
     def __init__(self, child_plans, op: str, by=None, without=None):
         super().__init__(child_plans)
@@ -845,6 +953,8 @@ class QuantileMergeExec(NonLeafExecPlan):
     quantile is approximate (~2.2% relative at SUB=32) exactly like the
     reference's t-digest exchange."""
 
+    supports_partial = True
+
     def __init__(self, child_plans, q: float, by=None, without=None):
         super().__init__(child_plans)
         self.q = q
@@ -883,6 +993,8 @@ class CountValuesMergeExec(NonLeafExecPlan):
     """Root merge for pushed-down count_values: children's partial count
     rows (CountValuesMapReduce) merge by identical label set with SUM —
     exact because shards own disjoint series."""
+
+    supports_partial = True
 
     def do_execute(self, ctx: QueryContext) -> QueryResult:
         grids = []

@@ -189,6 +189,11 @@ def result_to_frames(res: QueryResult, chunk_rows: int = CHUNK_ROWS):
         fr = pb.StreamFrame()
         fr.metadata.json = json.dumps(res.metadata)
         yield fr
+    if getattr(res, "warnings", None):
+        # partial-result warnings ride an error frame with the reserved
+        # non-fatal type (no proto schema change needed; the decoder folds
+        # it into QueryResult.warnings instead of raising)
+        yield error_frame(PARTIAL_WARNINGS, json.dumps(res.warnings))
     fin = pb.StreamFrame()
     st = fin.stats
     st.series_scanned = int(res.stats.series_scanned)
@@ -198,6 +203,10 @@ def result_to_frames(res: QueryResult, chunk_rows: int = CHUNK_ROWS):
     st.bytes_staged = int(res.stats.bytes_staged)
     st.result_type = res.result_type
     yield fin
+
+
+# error_type of the NON-FATAL warnings frame (partial results protocol)
+PARTIAL_WARNINGS = "PartialWarnings"
 
 
 def error_frame(error_type: str, message: str) -> "pb.StreamFrame":
@@ -212,6 +221,12 @@ class RemoteExecError(RuntimeError):
     deadline, query) re-raise as their local exception classes instead, so
     the origin's API edge maps them to the same status codes as local
     failures (503 backpressure / 503 timeout / 400 bad query)."""
+
+    # peer-health classification (query/faults.py): transport failures count
+    # against the endpoint's circuit breaker; the grpc client additionally
+    # marks UNAVAILABLE-class instances retryable
+    endpoint_failure = True
+    retryable = False
 
     def __init__(self, error_type: str, message: str):
         super().__init__(f"{error_type}: {message}")
@@ -231,7 +246,13 @@ def _raise_remote_error(error_type: str, message: str):
         from .exec.transformers import QueryError
 
         raise QueryError(f"remote {error_type}: {message}")
-    raise RemoteExecError(error_type, message)
+    err = RemoteExecError(error_type, message)
+    # an in-band error frame means the peer ANSWERED — its executor failed
+    # on this query, but the endpoint is reachable and healthy; it must not
+    # count against the circuit breaker (transport failures set the class
+    # default or an explicit override in the grpc client instead)
+    err.endpoint_failure = False
+    raise err
 
 
 def frames_to_result(frames) -> QueryResult:
@@ -270,7 +291,11 @@ def frames_to_result(frames) -> QueryResult:
             if st.result_type:
                 res.result_type = st.result_type
         elif which == "error":
-            _raise_remote_error(fr.error.error_type, fr.error.message)
+            if fr.error.error_type == PARTIAL_WARNINGS:
+                res.warnings.extend(json.loads(fr.error.message))
+                res.partial = True
+            else:
+                _raise_remote_error(fr.error.error_type, fr.error.message)
     for gi in sorted(headers):
         h = headers[gi]
         nb = int(h.hist_bins) or len(h.les)

@@ -105,6 +105,11 @@ class QueryResult:
     stats: QueryStats = field(default_factory=QueryStats)
     result_type: str = "matrix"  # matrix | vector | scalar | metadata
     metadata: list | None = None  # label values / names / series results
+    # partial-results protocol (query/faults.py): structured warnings for
+    # children lost under QueryContext.allow_partial_results; partial=True
+    # marks a result merged from a strict subset of its shards/peers
+    warnings: list[dict] = field(default_factory=list)
+    partial: bool = False
 
     def all_series(self):
         """Iterate (labels, ts_ms[], values[]) dropping NaN points."""

@@ -136,6 +136,28 @@ class FiloServer:
                 parallelism=int(qcfg["parallelism"]),
                 max_queued=int(qcfg.get("max_queued", 64)),
             )
+        # fault tolerance: shared breaker registry + retry budget for remote
+        # children (query/faults.py); both engines (scattering + local) share
+        # the registry so peer health is judged once per process
+        from .config import DEFAULTS
+        from .query.faults import BreakerRegistry, RetryPolicy
+
+        # layer user values over config.py DEFAULTS (the single source of
+        # truth): a user config providing a partial retry/breaker dict
+        # replaces the whole dict in load_config's one-level merge
+        rcfg = {**DEFAULTS["query"]["retry"], **(qcfg.get("retry") or {})}
+        bcfg = {**DEFAULTS["query"]["breaker"], **(qcfg.get("breaker") or {})}
+        self.breakers = BreakerRegistry(
+            window=int(bcfg["window"]),
+            failure_rate=float(bcfg["failure_rate"]),
+            min_calls=int(bcfg["min_calls"]),
+            cooldown_s=float(bcfg["cooldown_s"]),
+        )
+        self.retry_policy = RetryPolicy(
+            max_attempts=int(rcfg["max_attempts"]),
+            base_backoff_s=float(rcfg["base_backoff_s"]),
+            max_backoff_s=float(rcfg["max_backoff_s"]),
+        )
         common = dict(
             spread=self.spread,
             lookback_ms=int(qcfg["lookback_ms"]),
@@ -144,6 +166,9 @@ class FiloServer:
             agg_rules=self.agg_rules,
             scheduler=self.scheduler,
             num_shards=self.n_shards,
+            allow_partial_results=bool(qcfg.get("allow_partial_results", False)),
+            retry_policy=self.retry_policy,
+            breakers=self.breakers,
         )
         self.engine = QueryEngine(
             self.memstore, self.dataset,

@@ -1,8 +1,17 @@
 """Synthetic data generators shared by tests and benchmarks (reference
 core/src/test/scala/filodb.core/TestData.scala:27,239 MachineMetricsData —
-synthetic machine-metric streams used across every layer's specs)."""
+synthetic machine-metric streams used across every layer's specs), plus the
+deterministic fault-injection harness (:class:`FaultInjector`) the chaos
+tests drive the query/faults.py retry/breaker/partial-results machinery
+with."""
 
 from __future__ import annotations
+
+import random
+import threading
+import time
+from collections import Counter
+from dataclasses import dataclass
 
 import numpy as np
 
@@ -98,3 +107,103 @@ def histogram_batch(
         all_tags,
         bucket_les=scheme.bounds(),
     )
+
+
+# ---------------------------------------------------------------------------
+# deterministic fault injection (the chaos-test dispatcher)
+# ---------------------------------------------------------------------------
+
+
+class InjectedFault(RuntimeError):
+    """A fault raised by :class:`FaultInjector`. Classified like a remote
+    transport failure (query/faults.py): retried with backoff and counted
+    against the endpoint's circuit breaker."""
+
+    retryable = True
+    endpoint_failure = True
+
+
+@dataclass
+class FaultRule:
+    """One scheduled fault. ``target`` is substring-matched against the
+    child's descriptor — ``ClassName(args_str()) endpoint`` — so rules can
+    pin a shard (``"shard=2"``), an endpoint (``"grpc://peer:7777"``), or a
+    plan class (``"SelectRawPartitionsExec"``).
+
+    kinds:
+      - ``error``   raise :class:`InjectedFault` on every matching dispatch
+      - ``latency`` sleep ``latency_s`` then execute normally (stragglers)
+      - ``flap``    alternate phases of ``period`` failing dispatches and
+                    ``period`` healthy ones (breaker open/re-close drills)
+
+    ``count`` bounds how many matching dispatches the rule applies to
+    (None = forever); ``probability`` gates each application through the
+    injector's seeded RNG (1.0 = always, fully deterministic)."""
+
+    target: str
+    kind: str = "error"
+    count: int | None = None
+    probability: float = 1.0
+    latency_s: float = 0.0
+    period: int = 2
+
+
+class FaultInjector:
+    """Seeded dispatcher wrapper injecting failures, latency spikes, and
+    flapping per a schedule of :class:`FaultRule`s.
+
+    Installed as ``QueryContext.dispatcher`` (via
+    ``PlannerParams.dispatcher``), it sits BELOW the retry/breaker layer in
+    query/faults.py, so injected faults exercise exactly the production
+    fault-tolerance path. Same seed + same schedule + same query order =>
+    same outcomes."""
+
+    def __init__(self, rules, seed: int = 0, sleep=time.sleep):
+        self.rules = list(rules)
+        self.rng = random.Random(seed)
+        self.sleep = sleep
+        self.calls: Counter = Counter()      # per-target rule-match counts
+        self.injected: Counter = Counter()   # per-target injected faults
+        # schedule state is PER RULE, not per target: two rules sharing a
+        # target must not corrupt each other's count/flap phases. Guarded by
+        # a lock — concurrent remote children dispatch from pool threads,
+        # and per-rule counting must stay exact for the schedule to hold.
+        self._rule_calls = [0] * len(self.rules)
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def describe(child) -> str:
+        endpoint = getattr(child, "endpoint", "") or ""
+        return f"{type(child).__name__}({child.args_str()}) {endpoint}".strip()
+
+    def dispatch(self, child, ctx):
+        desc = self.describe(child)
+        latency = 0.0
+        fault: InjectedFault | None = None
+        with self._lock:
+            for ri, rule in enumerate(self.rules):
+                if rule.target not in desc:
+                    continue
+                n = self._rule_calls[ri]
+                self._rule_calls[ri] += 1
+                self.calls[rule.target] += 1
+                if rule.count is not None and n >= rule.count:
+                    continue
+                if rule.probability < 1.0 and self.rng.random() >= rule.probability:
+                    continue
+                if rule.kind == "latency":
+                    latency += rule.latency_s
+                    continue
+                if rule.kind == "flap" and (n % (2 * rule.period)) >= rule.period:
+                    continue  # healthy phase
+                self.injected[rule.target] += 1
+                fault = InjectedFault(
+                    f"injected {rule.kind} for {rule.target!r} (dispatch {n})"
+                )
+                break
+        # act OUTSIDE the lock: a latency spike must not serialize siblings
+        if latency:
+            self.sleep(latency)
+        if fault is not None:
+            raise fault
+        return child.execute(ctx)

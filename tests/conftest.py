@@ -10,6 +10,11 @@ config before any backend initializes.
 
 import os
 
+# keep grpc-core/absl INFO chatter (GOAWAY notices on server stop, etc.) off
+# stderr: it interleaves with pytest's progress lines and corrupts them
+os.environ.setdefault("GRPC_VERBOSITY", "ERROR")
+os.environ.setdefault("ABSL_MIN_LOG_LEVEL", "2")
+
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

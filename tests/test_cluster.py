@@ -70,6 +70,47 @@ class TestShardManager:
         assert mgr.ingestion_error(0) is False
         assert mgr.mapper.status_of(0) == ShardStatus.DOWN
 
+    def test_ingestion_error_moves_shard_to_another_node(self):
+        """First error: the shard leaves the failing node and lands on a
+        DIFFERENT node (reference doc/sharding.md auto-reassignment)."""
+        mgr = ShardManager(2, shards_per_node=2, reassignment_damper_s=3600)
+        mgr.node_joined("a")  # capacity 2: owns both shards
+        mgr.node_joined("b")
+        origin = mgr.mapper.node_of(0)
+        assert origin == "a"
+        assert mgr.ingestion_error(0) is True
+        assert mgr.mapper.status_of(0) == ShardStatus.ASSIGNED
+        assert mgr.mapper.node_of(0) == "b"
+        assert mgr.damper_active(0)
+
+    def test_damper_expiry_allows_reassignment_again(self):
+        """After the damper window passes, a DOWN shard recovers via the
+        normal reassignment path instead of staying dead forever."""
+        t = [1000.0]
+        mgr = ShardManager(2, shards_per_node=2, reassignment_damper_s=3600,
+                           clock=lambda: t[0])
+        mgr.node_joined("a")
+        mgr.node_joined("b")
+        assert mgr.ingestion_error(0) is True      # a -> b
+        t[0] += 10
+        assert mgr.ingestion_error(0) is False     # damper: DOWN, not bounced
+        assert mgr.mapper.status_of(0) == ShardStatus.DOWN
+        assert mgr.damper_active(0)
+        t[0] += 3600
+        assert not mgr.damper_active(0)
+        assert mgr.ingestion_error(0) is True      # recoverable again
+        assert mgr.mapper.status_of(0) == ShardStatus.ASSIGNED
+
+    def test_fresh_manager_never_dampers_first_reassignment(self):
+        """Regression: 'never reassigned' must read as infinitely old, even
+        under clocks that start near zero (the damper suppresses REPEAT
+        bounces only)."""
+        mgr = ShardManager(2, shards_per_node=2, reassignment_damper_s=3600,
+                           clock=lambda: 5.0)
+        mgr.node_joined("a")
+        mgr.node_joined("b")
+        assert mgr.ingestion_error(0) is True
+
     def test_lifecycle_to_active(self):
         mgr = ShardManager(1, shards_per_node=1)
         mgr.node_joined("a")
