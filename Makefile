@@ -2,7 +2,7 @@
 
 NATIVE_DIR := filodb_tpu/native
 
-.PHONY: all native test test-chaos bench microbench serve clean tpu-watch tpu-watch-bg
+.PHONY: all native test test-chaos test-observability bench microbench serve clean tpu-watch tpu-watch-bg
 
 all: native
 
@@ -27,6 +27,13 @@ test: native
 # circuit breakers, partial results, shard-reassignment convergence
 test-chaos: native
 	python -m pytest tests/ -q -m chaos
+
+# observability suite (doc/observability.md): trace propagation + stitching,
+# slow-query log, metrics exposition — plus the span-coverage lint asserting
+# every ExecPlan subclass executes under a span
+test-observability: native
+	python tools/check_spans.py
+	python -m pytest tests/ -q -m "observability or chaos" --continue-on-collection-errors
 
 bench: native
 	python bench.py

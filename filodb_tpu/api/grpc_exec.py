@@ -85,6 +85,19 @@ class _RemoteExecServicer:
                 return v == "1"
         return None
 
+    @staticmethod
+    def _trace_parent(context) -> tuple[str | None, str | None]:
+        """(trace_id, parent_span_id) from call metadata: the origin's span
+        identity, so this peer's span tree joins the origin's trace and its
+        slow-query entries share the origin's trace id."""
+        trace_id = parent = None
+        for k, v in context.invocation_metadata():
+            if k == TRACE_ID_MD_KEY:
+                trace_id = v
+            elif k == PARENT_SPAN_MD_KEY:
+                parent = v
+        return trace_id, parent
+
     def _stream(self, run):
         """Run ``run()`` -> QueryResult and stream frames; errors go in-band
         as the final frame (clients re-raise typed)."""
@@ -119,15 +132,19 @@ class _RemoteExecServicer:
         eng = self._engine_for(request.params)
         p = request.params
         allow_partial = self._allow_partial(context)
+        trace_id, parent_span = self._trace_parent(context)
 
         def run():
             if request.instant:
                 return eng.query_instant(request.promql, p.end_ms / 1000.0,
-                                         allow_partial_results=allow_partial)
+                                         allow_partial_results=allow_partial,
+                                         trace_id=trace_id,
+                                         parent_span_id=parent_span)
             return eng.query_range(
                 request.promql, p.start_ms / 1000.0, p.end_ms / 1000.0,
                 (p.step_ms or 1000) / 1000.0,
                 allow_partial_results=allow_partial,
+                trace_id=trace_id, parent_span_id=parent_span,
             )
 
         yield from self._stream(run)
@@ -137,12 +154,15 @@ class _RemoteExecServicer:
         eng = self._engine_for(request.params)
         p = request.params
         allow_partial = self._allow_partial(context)
+        trace_id, parent_span = self._trace_parent(context)
 
         def run():
             plan = proto_to_plan(request.plan)
             return eng.execute_plan(plan, deadline_s=p.deadline_s,
                                     max_series=p.max_series,
-                                    allow_partial_results=allow_partial)
+                                    allow_partial_results=allow_partial,
+                                    trace_id=trace_id,
+                                    parent_span_id=parent_span)
 
         yield from self._stream(run)
 
@@ -212,6 +232,12 @@ def _channel(endpoint: str) -> grpc.Channel:
 # partial-tolerant origin degrade gracefully instead of failing the RPC
 ALLOW_PARTIAL_MD_KEY = "x-filodb-allow-partial"
 
+# trace propagation rides call metadata too: the origin's trace id and the
+# dispatching span's id, so the peer's spans join the origin's trace (its
+# tree returns in-band as a TraceTree frame and gets stitched)
+TRACE_ID_MD_KEY = "x-filodb-trace-id"
+PARENT_SPAN_MD_KEY = "x-filodb-parent-span"
+
 # transient codes; DEADLINE_EXCEEDED is excluded — the budget is already
 # burnt. Retry ownership: plan-scatter children (GrpcPlanRemoteExec) pass
 # retries=0 and mark the error retryable so the dispatch layer
@@ -237,21 +263,27 @@ _NOT_PEER_HEALTH_CODES = (
 )
 
 
-def _metadata(auth_token: str | None, allow_partial: bool | None = None):
+def _metadata(auth_token: str | None, allow_partial: bool | None = None,
+              trace: tuple[str, str] | None = None):
     """``allow_partial`` is tri-state: None omits the key (peer uses its own
     default); True/False send "1"/"0" so an origin's explicit choice —
-    including strict mode — overrides the peer's configured default."""
+    including strict mode — overrides the peer's configured default.
+    ``trace`` is (trace_id, parent_span_id) of the dispatching span."""
     md = []
     if auth_token:
         md.append(("authorization", f"Bearer {auth_token}"))
     if allow_partial is not None:
         md.append((ALLOW_PARTIAL_MD_KEY, "1" if allow_partial else "0"))
+    if trace is not None:
+        md.append((TRACE_ID_MD_KEY, trace[0]))
+        md.append((PARENT_SPAN_MD_KEY, trace[1]))
     return tuple(md) or None
 
 
 def _call_stream(endpoint: str, method: str, request, serializer, auth_token,
                  timeout_s: float | None, retries: int = 1,
-                 allow_partial: bool | None = None):
+                 allow_partial: bool | None = None,
+                 trace: tuple[str, str] | None = None):
     """unary_stream call with bounded UNAVAILABLE retries (mirrors the HTTP
     transport's retry discipline in planners.fetch_json). ``timeout_s`` is a
     TOTAL budget: retries and their per-attempt RPC deadlines all fit inside
@@ -265,7 +297,7 @@ def _call_stream(endpoint: str, method: str, request, serializer, auth_token,
         response_deserializer=pb.StreamFrame.FromString,
     )
     deadline = None if timeout_s is None else _t.monotonic() + timeout_s
-    md = _metadata(auth_token, allow_partial)
+    md = _metadata(auth_token, allow_partial, trace)
     attempt = 0
     while True:
         per_attempt = (
@@ -310,7 +342,8 @@ def exec_promql(endpoint: str, promql: str, start_ms: int, end_ms: int, step_ms:
 def exec_plan_remote(endpoint: str, logical_plan, auth_token: str | None = None,
                      local_only: bool = False, deadline_s: float = 0.0,
                      max_series: int = 0, timeout_s: float | None = None,
-                     allow_partial: bool | None = None, transport_retries: int = 1):
+                     allow_partial: bool | None = None, transport_retries: int = 1,
+                     trace: tuple[str, str] | None = None):
     req = pb.ExecutePlanRequest(
         plan=plan_to_proto(logical_plan),
         params=pb.QueryParams(local_only=local_only, deadline_s=deadline_s,
@@ -319,7 +352,7 @@ def exec_plan_remote(endpoint: str, logical_plan, auth_token: str | None = None,
     return _call_stream(endpoint, _EXECUTE_PLAN, req,
                         pb.ExecutePlanRequest.SerializeToString, auth_token,
                         timeout_s, retries=transport_retries,
-                        allow_partial=allow_partial)
+                        allow_partial=allow_partial, trace=trace)
 
 
 from ..query.exec.plans import ExecPlan  # noqa: E402  (no cycle: query/ never imports api/)
@@ -351,11 +384,16 @@ class GrpcPlanRemoteExec(ExecPlan):
         return f"endpoint={self.endpoint} plan={type(self.logical_plan).__name__}"
 
     def do_execute(self, ctx):
+        from ..metrics import current_span
+
         # budget with the REMAINING deadline, not the full deadline_s: by
         # the time this child dispatches (or re-dispatches on retry), part
         # of the query budget is already spent, and both the per-RPC timeout
         # and the peer's own deadline must fit in what's left
         remaining = ctx.remaining_deadline_s()
+        # the active span here is this exec node's (ExecPlan.execute): its
+        # identity rides call metadata so the peer's spans join our trace
+        sp = current_span()
         return exec_plan_remote(
             self.endpoint, self.logical_plan, auth_token=self.auth_token,
             local_only=self.local_only, deadline_s=remaining,
@@ -365,6 +403,7 @@ class GrpcPlanRemoteExec(ExecPlan):
             # the dispatch layer (faults.call_with_retries) owns this
             # child's retries: transient errors come back marked retryable
             transport_retries=0,
+            trace=(sp.trace_id, sp.span_id) if sp is not None else None,
         )
 
 

@@ -94,6 +94,9 @@ class PromApiHandler(BaseHTTPRequestHandler):
     # share the token) can reach it, and keep the port off the public edge.
     local_engine: QueryEngine = None
     auth_token: str | None = None  # optional bearer auth (server factory)
+    # zero-arg profiler report hook; wired by the server ONLY when the
+    # profiler config block enables it (/debug/profile gate)
+    profiler_hook = None
     protocol_version = "HTTP/1.1"
     GZIP_MIN_BYTES = 1024
     STREAM_MIN_SAMPLES = 200_000  # above this, query_range streams chunked
@@ -166,6 +169,25 @@ class PromApiHandler(BaseHTTPRequestHandler):
         if v is None:
             return None
         return v.lower() in ("1", "true", "yes")
+
+    def _trace_requested(self, params) -> bool:
+        """``?trace=true`` / ``?explain=analyze``: return the annotated span
+        tree (per-node durations, QueryStats, retries/breaker/partial
+        annotations) alongside the result."""
+        v = self._q(params, "trace")
+        if v is not None and v.lower() in ("1", "true", "yes"):
+            return True
+        return (self._q(params, "explain") or "").lower() == "analyze"
+
+    def _trace_parent(self) -> tuple[str | None, str | None]:
+        """Upstream trace linkage headers (a scattering origin's span
+        identity) — this node's spans join that trace."""
+        from ..metrics import TraceContext
+
+        return (
+            self.headers.get(TraceContext.TRACE_ID_HEADER),
+            self.headers.get(TraceContext.PARENT_SPAN_HEADER),
+        )
 
     # -- routing ----------------------------------------------------------
 
@@ -242,6 +264,12 @@ class PromApiHandler(BaseHTTPRequestHandler):
                 }))
             if path == "/metrics":
                 return self._metrics()
+            if path == "/debug/slow_queries":
+                from ..metrics import SLOW_QUERY_LOG
+
+                return self._send(200, J.success(SLOW_QUERY_LOG.entries()))
+            if path == "/debug/profile":
+                return self._profile()
             if path == "/api/v1/cardinality":
                 return self._cardinality()
             if path == "/ingest":
@@ -297,9 +325,15 @@ class PromApiHandler(BaseHTTPRequestHandler):
             )
         if end < start:
             return self._send(400, J.error("bad_data", "end timestamp before start"))
+        trace_on = self._trace_requested(p)
+        trace_id, parent_span = self._trace_parent()
         res = self._engine_for_request().query_range(
-            query, start, end, step, allow_partial_results=self._allow_partial(p)
+            query, start, end, step, allow_partial_results=self._allow_partial(p),
+            trace_id=trace_id, parent_span_id=parent_span,
         )
+        from ..metrics import trace_to_dict
+
+        trace = trace_to_dict(res.trace) if trace_on else None
         warnings = res.warnings or None
         if res.result_type == "scalar":
             # range query over a scalar: render as matrix of the scalar
@@ -320,6 +354,8 @@ class PromApiHandler(BaseHTTPRequestHandler):
                 if sc is not None
                 else [],
             }
+            if trace is not None:
+                data["trace"] = trace
             return self._send(200, J.success(data, warnings=warnings, partial=res.partial))
         stats = {
             "seriesScanned": res.stats.series_scanned,
@@ -334,9 +370,13 @@ class PromApiHandler(BaseHTTPRequestHandler):
         if res.raw is not None:
             n_samples += sum(len(t) for _, t, _ in res.raw)
         if n_samples >= self.STREAM_MIN_SAMPLES:
-            return self._send_chunked(200, J.stream_matrix(res, stats, warnings=warnings))
+            return self._send_chunked(
+                200, J.stream_matrix(res, stats, warnings=warnings, trace=trace)
+            )
         data = J.render_matrix(res)
         data["stats"] = stats
+        if trace is not None:
+            data["trace"] = trace
         return self._send(200, J.success(data, warnings=warnings, partial=res.partial))
 
     def _query(self):
@@ -345,18 +385,24 @@ class PromApiHandler(BaseHTTPRequestHandler):
         if not query:
             return self._send(400, J.error("bad_data", "missing query"))
         t = _parse_time(self._q(p, "time"), default=time.time())
+        trace_on = self._trace_requested(p)
+        trace_id, parent_span = self._trace_parent()
         res = self._engine_for_request().query_instant(
-            query, t, allow_partial_results=self._allow_partial(p)
+            query, t, allow_partial_results=self._allow_partial(p),
+            trace_id=trace_id, parent_span_id=parent_span,
         )
         warnings = res.warnings or None
         if res.result_type == "scalar":
-            return self._send(200, J.success(J.render_scalar(res, t), warnings=warnings,
-                                             partial=res.partial))
-        if res.raw is not None:
-            return self._send(200, J.success(J.render_matrix(res), warnings=warnings,
-                                             partial=res.partial))
-        return self._send(200, J.success(J.render_vector(res, t), warnings=warnings,
-                                         partial=res.partial))
+            data = J.render_scalar(res, t)
+        elif res.raw is not None:
+            data = J.render_matrix(res)
+        else:
+            data = J.render_vector(res, t)
+        if trace_on and res.trace is not None:
+            from ..metrics import trace_to_dict
+
+            data["trace"] = trace_to_dict(res.trace)
+        return self._send(200, J.success(data, warnings=warnings, partial=res.partial))
 
     def _labels(self):
         p = self._params()
@@ -402,23 +448,27 @@ class PromApiHandler(BaseHTTPRequestHandler):
         return self._send(200, J.success(out))
 
     def _metrics(self):
-        """Prometheus exposition of internal metrics + per-shard stats
-        (reference TimeSeriesShardStats gauges + Kamon reporters)."""
+        """Prometheus exposition of internal metrics. Per-shard stats are a
+        scrape-time collector registered by make_server (reference
+        TimeSeriesShardStats gauges + Kamon reporters) — one exposition
+        path, with proper label escaping, for everything."""
         from ..metrics import REGISTRY
 
-        ds = self.engine.dataset
-        for sh in self.engine.memstore.shards(ds):
-            for name, v in (
-                ("filodb_shard_partitions", sh.num_partitions),
-                ("filodb_shard_rows_ingested", sh.stats.rows_ingested),
-                ("filodb_shard_rows_skipped", sh.stats.rows_skipped),
-                ("filodb_shard_partitions_evicted", sh.stats.partitions_evicted),
-                ("filodb_shard_chunks_flushed", sh.stats.chunks_flushed),
-            ):
-                REGISTRY.gauge(name, dataset=ds, shard=str(sh.shard_num)).set(float(v))
         body = REGISTRY.expose().encode()
         self.send_response(200)
         self.send_header("Content-Type", "text/plain; version=0.0.4")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _profile(self):
+        """Sampling-profiler report (config-gated: the server wires
+        profiler_hook only when filodb.profiler is enabled)."""
+        if self.profiler_hook is None:
+            return self._send(404, J.error("not_found", "profiler not enabled"))
+        body = str(self.profiler_hook()).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/plain")
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
@@ -536,6 +586,41 @@ class PromApiHandler(BaseHTTPRequestHandler):
         return self._send(200, J.success({"ingested": n}))
 
 
+def register_shard_stats_collector(engine: QueryEngine) -> None:
+    """Scrape-time per-shard gauges in the shared Registry (reference
+    TimeSeriesShardStats): refreshed on every /metrics render. Keyed per
+    ENGINE (not just dataset) so two embedded nodes sharing a dataset name
+    — the federation/bootstrap test topology — each keep refreshing their
+    own shard slice; gauges are disjoint by shard label. The closure holds
+    the memstore WEAKLY and self-unregisters once the store dies — the
+    process-global registry must not pin a shut-down server's shards
+    (staged chunks included) for the process lifetime."""
+    import weakref
+
+    from ..metrics import REGISTRY
+
+    ds = engine.dataset
+    key = f"shard_stats:{ds}:{id(engine.memstore)}"
+    memstore_ref = weakref.ref(engine.memstore)
+
+    def collect():
+        memstore = memstore_ref()
+        if memstore is None:
+            REGISTRY.unregister_collector(key)
+            return
+        for sh in memstore.shards(ds):
+            for name, v in (
+                ("filodb_shard_partitions", sh.num_partitions),
+                ("filodb_shard_rows_ingested", sh.stats.rows_ingested),
+                ("filodb_shard_rows_skipped", sh.stats.rows_skipped),
+                ("filodb_shard_partitions_evicted", sh.stats.partitions_evicted),
+                ("filodb_shard_chunks_flushed", sh.stats.chunks_flushed),
+            ):
+                REGISTRY.gauge(name, dataset=ds, shard=str(sh.shard_num)).set(float(v))
+
+    REGISTRY.register_collector(key, collect)
+
+
 def make_server(engine: QueryEngine, host: str = "127.0.0.1", port: int = 9090,
                 auth_token: str | None = None,
                 local_engine: QueryEngine | None = None,
@@ -543,6 +628,7 @@ def make_server(engine: QueryEngine, host: str = "127.0.0.1", port: int = 9090,
     # membership hooks (members_hook/join_hook) are wired as class attrs on
     # the returned server's RequestHandlerClass AFTER start — the registry
     # needs the bound port for its self URL (server.py seed bootstrap)
+    register_shard_stats_collector(engine)
     handler = type(
         "BoundHandler", (PromApiHandler,),
         {"engine": engine, "auth_token": auth_token, "local_engine": local_engine,

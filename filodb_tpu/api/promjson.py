@@ -108,7 +108,8 @@ def _values_fragment(ts_s: np.ndarray, vals: np.ndarray) -> bytes:
 
 
 def stream_matrix(res: QueryResult, stats: dict | None = None,
-                  chunk_target: int = 1 << 18, warnings: list | None = None):
+                  chunk_target: int = 1 << 18, warnings: list | None = None,
+                  trace: dict | None = None):
     """Generator of JSON byte chunks for a matrix result envelope.
 
     The serving-edge answer to reference executeStreaming
@@ -174,6 +175,8 @@ def stream_matrix(res: QueryResult, stats: dict | None = None,
     buf += b"]"
     if stats is not None:
         buf += b',"stats":' + json.dumps(stats).encode()
+    if trace is not None:
+        buf += b',"trace":' + json.dumps(trace).encode()
     buf += b"}"  # close data
     if warnings:
         buf += b',"partial":true,"warnings":' + json.dumps(warnings).encode()

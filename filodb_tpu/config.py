@@ -47,6 +47,12 @@ DEFAULTS: dict = {
             "min_calls": 4,
             "cooldown_s": 15.0,
         },
+        # observability (metrics.py): queries slower than the threshold
+        # record PromQL + rendered trace tree in the slow-query log
+        # (/debug/slow_queries, counted as filodb_slow_queries_total).
+        # null disables; log size is a ring buffer.
+        "slow_query_threshold_s": 10.0,
+        "slow_query_log_max": 64,
     },
     # API
     "http_port": 9090,

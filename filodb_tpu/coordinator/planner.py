@@ -294,6 +294,10 @@ class PlannerParams:
     retry_policy: object | None = None
     breakers: object | None = None
     dispatcher: object | None = None
+    # observability (metrics.py): queries slower than this record their
+    # rendered trace tree + PromQL in the global slow-query log
+    # (/debug/slow_queries). None disables.
+    slow_query_threshold_s: float | None = 10.0
 
 
 class SingleClusterPlanner:
@@ -911,10 +915,49 @@ class QueryEngine:
         ctx.dispatcher = params.dispatcher
         return ctx
 
+    def _start_trace(self, ctx, promql: str, trace_id: str | None = None,
+                     parent_span_id: str | None = None):
+        """Open the query's root span. ``trace_id``/``parent_span_id`` come
+        from an upstream origin (gRPC metadata / HTTP headers) so this
+        process's spans — and its slow-query entries — join that trace."""
+        import time as _time
+
+        from ..metrics import Span, new_trace_id
+
+        root = Span("query", _time.perf_counter_ns())
+        root.trace_id = trace_id or new_trace_id()
+        root.parent_id = parent_span_id
+        root.tags["promql"] = promql
+        root.tags["dataset"] = self.dataset
+        ctx.trace_root = root
+        return root
+
+    def _observe_slow(self, promql: str, elapsed_s: float, res) -> None:
+        """Record queries over the slow-query threshold with their rendered
+        trace (the observability substrate for "why was THIS query slow")."""
+        thr = self.planner.params.slow_query_threshold_s
+        if thr is None or elapsed_s < thr:
+            return
+        from ..metrics import SLOW_QUERY_LOG
+
+        SLOW_QUERY_LOG.record(
+            promql, elapsed_s, dataset=self.dataset, trace=res.trace,
+            stats=res.stats.as_dict() if res.stats is not None else None,
+        )
+
     def _finish(self, res, ctx):
         """Attach per-query stats + partial-result warnings collected on the
-        context during scatter-gather (query/faults.py)."""
+        context during scatter-gather (query/faults.py), and close + attach
+        the trace root span."""
         res.stats = ctx.stats  # per-query scan/latency stats ride in responses
+        root = getattr(ctx, "trace_root", None)
+        if root is not None:
+            import time as _time
+
+            if not root.end_ns:
+                root.end_ns = _time.perf_counter_ns()
+            root.stats = ctx.stats.as_dict()
+            res.trace = root
         if ctx.warnings:
             from ..metrics import record_partial_result
 
@@ -930,7 +973,9 @@ class QueryEngine:
         return res
 
     def query_range(self, promql: str, start_s: float, end_s: float, step_s: float,
-                    allow_partial_results: bool | None = None):
+                    allow_partial_results: bool | None = None,
+                    trace_id: str | None = None,
+                    parent_span_id: str | None = None):
         """PromQL range query. Concurrent identical queries coalesce into
         ONE plan+stage+kernel execution (reference: the shared
         QueryScheduler pool, QueryScheduler.scala:29-73, plus single-flight
@@ -949,18 +994,22 @@ class QueryEngine:
             self.planner.params.allow_partial_results
             if allow_partial_results is None else bool(allow_partial_results)
         )
+        # trace linkage is NOT part of the coalescing key: followers share
+        # the leader's execution and therefore the leader's trace tree
         if self.planner.params.coalesce_identical:
             res = self._single_flight.run(
                 (self.dataset, promql, float(start_s), float(end_s), float(step_s),
                  allow_partial),
                 lambda: self._query_range_uncoalesced(
-                    promql, start_s, end_s, step_s, allow_partial
+                    promql, start_s, end_s, step_s, allow_partial,
+                    trace_id=trace_id, parent_span_id=parent_span_id,
                 ),
                 timeout_s=self.planner.params.deadline_s,
             )
         else:
             res = self._query_range_uncoalesced(promql, start_s, end_s, step_s,
-                                                allow_partial)
+                                                allow_partial, trace_id=trace_id,
+                                                parent_span_id=parent_span_id)
         REGISTRY.counter("filodb_queries", dataset=self.dataset).inc()
         REGISTRY.histogram("filodb_query_latency_seconds", dataset=self.dataset).observe(
             _time.perf_counter() - t0
@@ -969,7 +1018,12 @@ class QueryEngine:
 
     def _query_range_uncoalesced(self, promql: str, start_s: float,
                                  end_s: float, step_s: float,
-                                 allow_partial_results: bool | None = None):
+                                 allow_partial_results: bool | None = None,
+                                 trace_id: str | None = None,
+                                 parent_span_id: str | None = None):
+        import time as _time
+
+        t0 = _time.perf_counter()
         plan = query_range_to_logical_plan(promql, start_s, end_s, step_s,
                                            self.planner.params.lookback_ms)
         if self.planner.params.agg_rules is not None:
@@ -978,10 +1032,12 @@ class QueryEngine:
             plan = optimize_with_preagg(plan, self.planner.params.agg_rules)
         exec_plan = self.planner.materialize(plan)
         ctx = self.context(allow_partial_results)
+        self._start_trace(ctx, promql, trace_id, parent_span_id)
         res = self._run(exec_plan, ctx)
         self._finish(res, ctx)
         if res.result_type == "matrix" or res.grids:
             res.result_type = "matrix"
+        self._observe_slow(promql, _time.perf_counter() - t0, res)
         return res
 
     def _run(self, exec_plan, ctx):
@@ -993,11 +1049,16 @@ class QueryEngine:
         return sched.run(lambda: exec_plan.execute(ctx), deadline_s=ctx.deadline_s)
 
     def execute_plan(self, plan, deadline_s: float = 0.0, max_series: int = 0,
-                     allow_partial_results: bool | None = None):
+                     allow_partial_results: bool | None = None,
+                     trace_id: str | None = None,
+                     parent_span_id: str | None = None):
         """Execute an already-built LogicalPlan — THE entry for plan-level
         remote transports (gRPC ExecutePlan, Flight plan tickets), so every
         transport shares the same pre-agg rewrite, limits, and scheduler
         path as PromQL queries."""
+        import time as _time
+
+        t0 = _time.perf_counter()
         if self.planner.params.agg_rules is not None:
             from .lpopt import optimize_with_preagg
 
@@ -1008,8 +1069,17 @@ class QueryEngine:
             ctx.deadline_s = min(ctx.deadline_s, deadline_s)
         if max_series:
             ctx.max_series = min(ctx.max_series, max_series)
+        try:
+            from ..query.unparse import to_promql
+
+            qname = to_promql(plan)
+        except Exception:  # noqa: BLE001 — metadata plans have no PromQL form
+            qname = type(plan).__name__
+        self._start_trace(ctx, qname, trace_id, parent_span_id)
         res = self._run(exec_plan, ctx)
-        return self._finish(res, ctx)
+        self._finish(res, ctx)
+        self._observe_slow(qname, _time.perf_counter() - t0, res)
+        return res
 
     def label_values(self, filters, label: str, start_ms: int, end_ms: int, limit=None):
         """Metadata through the planner so multi-host peers scatter too."""
@@ -1034,12 +1104,19 @@ class QueryEngine:
         return self.planner.materialize(plan).execute(self.context()).metadata
 
     def query_instant(self, promql: str, time_s: float,
-                      allow_partial_results: bool | None = None):
+                      allow_partial_results: bool | None = None,
+                      trace_id: str | None = None,
+                      parent_span_id: str | None = None):
+        import time as _time
+
+        t0 = _time.perf_counter()
         plan = query_to_logical_plan(promql, time_s, self.planner.params.lookback_ms)
         exec_plan = self.planner.materialize(plan)
         ctx = self.context(allow_partial_results)
+        self._start_trace(ctx, promql, trace_id, parent_span_id)
         res = self._run(exec_plan, ctx)
         self._finish(res, ctx)
         if res.result_type == "matrix":
             res.result_type = "vector"
+        self._observe_slow(promql, _time.perf_counter() - t0, res)
         return res

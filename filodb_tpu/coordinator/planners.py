@@ -57,7 +57,8 @@ class RemoteFetchError(QueryError):
 
 def fetch_json(url: str, auth_token: str | None = None, local_only: bool = False,
                timeout: float = 60, data: dict | None = None,
-               want_envelope: bool = False) -> dict | list:
+               want_envelope: bool = False,
+               extra_headers: dict | None = None) -> dict | list:
     """THE remote-HTTP fetch used by every cross-host path (query scatter,
     federation, metadata, membership): gzip transport, bearer auth,
     X-FiloDB-Local pinning, bounded retries with backoff on transient
@@ -80,6 +81,8 @@ def fetch_json(url: str, auth_token: str | None = None, local_only: bool = False
         headers["Authorization"] = f"Bearer {auth_token}"
     if local_only:
         headers["X-FiloDB-Local"] = "1"
+    if extra_headers:
+        headers.update(extra_headers)
     body = None
     if data is not None:
         body = json.dumps(data).encode()
@@ -149,9 +152,23 @@ class PromQlRemoteExec(ExecPlan):
         # partial peer's top-level warnings fold into this child's result
         allow_partial = getattr(ctx, "allow_partial_results", False)
         url += f"&allow_partial_results={'true' if allow_partial else 'false'}"
+        # trace propagation: request the peer's span tree and hand it our
+        # span identity so its spans join this query's trace; the tree comes
+        # back in the envelope and ExecPlan.execute stitches it in
+        from ..metrics import TraceContext, current_span
+
+        sp = current_span()
+        headers = None
+        if sp is not None:
+            url += "&trace=true"
+            headers = {
+                TraceContext.TRACE_ID_HEADER: sp.trace_id,
+                TraceContext.PARENT_SPAN_HEADER: sp.span_id,
+            }
         envelope = fetch_json(
             url, auth_token=self.auth_token, local_only=self.local_only,
             timeout=max(ctx.remaining_deadline_s(), 0.1), want_envelope=True,
+            extra_headers=headers,
         )
         data = envelope["data"]
         result = data["result"]
@@ -178,6 +195,20 @@ class PromQlRemoteExec(ExecPlan):
         if envelope.get("warnings"):
             out.warnings = list(envelope["warnings"])
             out.partial = True
+        if isinstance(data, dict) and data.get("trace") is not None:
+            out.trace = data["trace"]  # peer span tree; stitched by execute
+        st = data.get("stats") if isinstance(data, dict) else None
+        if st:
+            # the peer's QueryStats fold into the origin's query-wide stats
+            # (ExecPlan.execute merges a remote child's stats exactly once)
+            from ..query.rangevector import QueryStats
+
+            out.stats = QueryStats(
+                series_scanned=int(st.get("seriesScanned", 0)),
+                samples_scanned=int(st.get("samplesScanned", 0)),
+                cpu_ns=int(st.get("cpuNanos", 0)),
+                bytes_staged=int(st.get("bytesStaged", 0)),
+            )
         return out
 
 

@@ -4,9 +4,17 @@ Kamon spans threading ExecPlan.execute; standalone SimpleProfiler.java:19
 sampling profiler).
 
 - ``Registry``: counters / gauges / histograms with Prometheus text
-  exposition (served at /metrics by the HTTP API).
-- ``span``: lightweight tracing context manager; spans accumulate into the
-  per-query stats and an optional global trace log.
+  exposition (served at /metrics by the HTTP API), plus scrape-time
+  collectors for gauges that must be refreshed on demand.
+- ``span`` / ``Span`` / ``TraceContext``: real tracing. Spans carry
+  (trace_id, span_id, parent_id) plus tags and per-node QueryStats; the
+  context is explicitly capturable (``current_span``) and re-activatable
+  (``activate``) so a trace survives thread-pool hops, and serializable
+  (``Span.to_dict`` / ``from_dict``) so remote children return their span
+  trees in-band and the origin stitches them under the dispatching span.
+- ``SlowQueryLog``: ring buffer of queries exceeding a configured
+  threshold, each entry carrying the rendered trace tree (served at
+  /debug/slow_queries and counted in /metrics).
 - ``SamplingProfiler``: periodic stack sampler over all threads (the
   SimpleProfiler analog) with top-of-stack aggregation.
 """
@@ -19,7 +27,8 @@ import sys
 import threading
 import time
 import traceback
-from collections import Counter, defaultdict
+import uuid
+from collections import Counter, deque
 from dataclasses import dataclass, field
 
 
@@ -64,10 +73,30 @@ class Histogram:
             self.total += 1
 
 
+def escape_label_value(v) -> str:
+    """Prometheus text-format label escaping: backslash, double-quote and
+    newline must be escaped or the exposition line is unparseable."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
 class Registry:
     def __init__(self):
         self._metrics: dict[tuple[str, tuple], object] = {}
+        # scrape-time collectors: keyed callbacks run at the top of expose()
+        # to refresh gauges that mirror live state (per-shard stats etc.) —
+        # ONE exposition path instead of handlers hand-rolling text
+        self._collectors: dict[str, object] = {}
         self._lock = threading.Lock()
+
+    def register_collector(self, key: str, fn) -> None:
+        """Register (or replace) a zero-arg callback invoked at scrape time
+        before rendering. Keyed so re-created servers replace, not stack."""
+        with self._lock:
+            self._collectors[key] = fn
+
+    def unregister_collector(self, key: str) -> None:
+        with self._lock:
+            self._collectors.pop(key, None)
 
     def _get(self, cls, name: str, labels: dict | None):
         key = (name, tuple(sorted((labels or {}).items())))
@@ -89,15 +118,27 @@ class Registry:
 
     def expose(self) -> str:
         """Prometheus text exposition of everything registered."""
+        with self._lock:
+            collectors = list(self._collectors.values())
+        for fn in collectors:
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — a sick collector must not kill /metrics
+                pass
         lines = []
-        for (name, labels), m in sorted(self._metrics.items(), key=lambda kv: kv[0][0]):
-            lbl = "{" + ",".join(f'{k}="{v}"' for k, v in labels) + "}" if labels else ""
+        with self._lock:
+            items = sorted(self._metrics.items(), key=lambda kv: kv[0][0])
+        for (name, labels), m in items:
+            lbl = (
+                "{" + ",".join(f'{k}="{escape_label_value(v)}"' for k, v in labels) + "}"
+                if labels else ""
+            )
             if isinstance(m, Counter_):
                 lines.append(f"{name}_total{lbl} {m.value:g}")
             elif isinstance(m, Gauge):
                 lines.append(f"{name}{lbl} {m.value:g}")
             elif isinstance(m, Histogram):
-                base = [f'{k}="{v}"' for k, v in labels]
+                base = [f'{k}="{escape_label_value(v)}"' for k, v in labels]
                 cum = 0
                 for b, c in zip(m.BOUNDS, m.counts):
                     cum += c
@@ -154,44 +195,229 @@ def record_shard_reassignment(shard: int, damped: bool) -> None:
 _trace_local = threading.local()
 
 
+def new_trace_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def new_span_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+@dataclass(frozen=True)
+class TraceContext:
+    """The portable identity of an active span: what crosses thread pools
+    (by reference, via ``current_span``/``activate``) and process
+    boundaries (by value, via gRPC call metadata / HTTP headers)."""
+
+    trace_id: str
+    span_id: str
+    parent_id: str | None = None
+
+    # wire names, shared by the gRPC metadata keys and HTTP headers
+    TRACE_ID_HEADER = "X-FiloDB-Trace-Id"
+    PARENT_SPAN_HEADER = "X-FiloDB-Parent-Span"
+
+
 @dataclass
 class Span:
     name: str
     start_ns: int
     end_ns: int = 0
     children: list = field(default_factory=list)
+    trace_id: str = ""
+    span_id: str = field(default_factory=new_span_id)
+    parent_id: str | None = None
+    # free-form annotations (retries, breaker states, lost children, plan
+    # args); must stay JSON-serializable — they cross the wire in to_dict()
+    tags: dict = field(default_factory=dict)
+    # per-node QueryStats delta (series/samples scanned, bytes staged, ...)
+    stats: dict = field(default_factory=dict)
 
     @property
     def duration_ms(self) -> float:
         return (self.end_ns - self.start_ns) / 1e6
 
+    def context(self) -> TraceContext:
+        return TraceContext(self.trace_id, self.span_id, self.parent_id)
+
     def tree(self, depth=0) -> str:
-        out = [f"{'  ' * depth}{self.name}: {self.duration_ms:.2f}ms"]
+        line = f"{'  ' * depth}{self.name}: {self.duration_ms:.2f}ms"
+        if self.stats:
+            brief = " ".join(f"{k}={v}" for k, v in self.stats.items() if v)
+            if brief:
+                line += f" [{brief}]"
+        out = [line]
         for c in self.children:
             out.append(c.tree(depth + 1))
         return "\n".join(out)
 
+    def to_dict(self) -> dict:
+        """JSON form: the EXPLAIN ANALYZE / slow-query-log rendering and the
+        in-band cross-node trace payload (durations, never raw clocks — the
+        perf counters of two processes do not compare)."""
+        d = {
+            "name": self.name,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "duration_ms": round(self.duration_ms, 3),
+        }
+        if self.tags:
+            d["tags"] = self.tags
+        if self.stats:
+            d["stats"] = self.stats
+        d["children"] = [c.to_dict() for c in self.children]
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict, trace_id: str | None = None,
+                  parent_id: str | None = None) -> "Span":
+        """Rebuild a span tree from its wire form. ``trace_id``/``parent_id``
+        override the remote identifiers so a grafted subtree joins the LOCAL
+        trace (the stitch rewrites linkage; durations are preserved)."""
+        s = cls(str(d.get("name", "remote")), 0)
+        s.end_ns = int(float(d.get("duration_ms", 0.0)) * 1e6)
+        s.trace_id = trace_id if trace_id is not None else str(d.get("trace_id", ""))
+        s.span_id = str(d.get("span_id") or new_span_id())
+        s.parent_id = parent_id if parent_id is not None else d.get("parent_id")
+        s.tags = dict(d.get("tags") or {})
+        s.stats = dict(d.get("stats") or {})
+        s.children = [
+            cls.from_dict(c, trace_id=s.trace_id, parent_id=s.span_id)
+            for c in (d.get("children") or [])
+        ]
+        return s
+
+
+_UNSET = object()
+
 
 @contextlib.contextmanager
-def span(name: str):
-    """Nested timing spans (Kamon.runWithSpan analog). The root span of a
-    thread is retrievable via current_trace()."""
+def span(name: str, parent=_UNSET, **tags):
+    """Nested timing spans (Kamon.runWithSpan analog). The thread-local
+    current span is the default parent; an explicit ``parent=`` Span wires a
+    span into a trace across thread hops (a worker thread has no thread-local
+    context — the submitter captures ``current_span()`` and either passes it
+    here or re-activates it via ``activate``). The root span of a thread is
+    retrievable via current_trace()."""
+    cur = getattr(_trace_local, "current", None)
+    eff_parent = cur if cur is not None else (None if parent is _UNSET else parent)
     s = Span(name, time.perf_counter_ns())
-    parent = getattr(_trace_local, "current", None)
-    if parent is not None:
-        parent.children.append(s)
+    if tags:
+        s.tags.update(tags)
+    if eff_parent is not None:
+        s.trace_id = eff_parent.trace_id
+        s.parent_id = eff_parent.span_id
+        # list.append is atomic under the GIL: children may attach from
+        # concurrent pool threads re-activating the same parent
+        eff_parent.children.append(s)
     else:
+        s.trace_id = new_trace_id()
         _trace_local.root = s
     _trace_local.current = s
     try:
         yield s
     finally:
         s.end_ns = time.perf_counter_ns()
-        _trace_local.current = parent
+        _trace_local.current = cur
+
+
+@contextlib.contextmanager
+def activate(span_obj: Span | None):
+    """Re-activate a captured span as this thread's current trace context —
+    the cross-thread propagation primitive (``execute_children`` captures the
+    dispatching span and re-activates it inside pool workers so child spans
+    attach under the right parent instead of starting orphan traces)."""
+    if span_obj is None:
+        yield
+        return
+    prev = getattr(_trace_local, "current", None)
+    prev_root = getattr(_trace_local, "root", None)
+    _trace_local.current = span_obj
+    _trace_local.root = span_obj
+    try:
+        yield
+    finally:
+        _trace_local.current = prev
+        _trace_local.root = prev_root
+
+
+def current_span() -> Span | None:
+    """The innermost active span on this thread (the capture point for
+    cross-thread and cross-node propagation)."""
+    return getattr(_trace_local, "current", None)
 
 
 def current_trace() -> Span | None:
     return getattr(_trace_local, "root", None)
+
+
+def trace_to_dict(trace) -> dict | None:
+    """Normalize a QueryResult.trace (local Span or already-rendered dict
+    from a remote peer) to its JSON form."""
+    if trace is None:
+        return None
+    return trace.to_dict() if isinstance(trace, Span) else trace
+
+
+# -- slow-query log ---------------------------------------------------------
+
+
+class SlowQueryLog:
+    """Ring buffer of queries that exceeded the slow-query threshold, each
+    entry carrying the PromQL, duration, QueryStats and the rendered trace
+    tree (served at /debug/slow_queries; counted as
+    filodb_slow_queries_total in /metrics)."""
+
+    def __init__(self, max_entries: int = 64):
+        self._entries: deque = deque(maxlen=max_entries)
+        self._lock = threading.Lock()
+
+    def configure(self, max_entries: int) -> None:
+        with self._lock:
+            self._entries = deque(self._entries, maxlen=max(1, int(max_entries)))
+
+    def record(self, promql: str, duration_s: float, dataset: str = "",
+               trace=None, stats: dict | None = None) -> None:
+        entry = {
+            "time": time.time(),
+            "dataset": dataset,
+            "promql": promql,
+            "duration_s": round(float(duration_s), 6),
+            "stats": stats or {},
+            "trace": trace_to_dict(trace),
+        }
+        with self._lock:
+            self._entries.append(entry)
+        REGISTRY.counter("filodb_slow_queries", dataset=dataset).inc()
+
+    def entries(self) -> list[dict]:
+        """Newest first."""
+        with self._lock:
+            return list(reversed(self._entries))
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+
+SLOW_QUERY_LOG = SlowQueryLog()
+
+
+# -- kernel dispatch instrumentation ----------------------------------------
+
+
+def record_kernel_dispatch(kernel: str, seconds: float,
+                           compiled: bool | None = None) -> None:
+    """Latency histogram around an ops/ kernel entry point, plus JIT
+    compile-cache hit/miss accounting when the caller can observe its jit
+    cache (a grown cache across the call means this dispatch compiled)."""
+    REGISTRY.histogram("filodb_kernel_dispatch_seconds", kernel=kernel).observe(seconds)
+    if compiled is not None:
+        REGISTRY.counter(
+            "filodb_jit_cache", kernel=kernel,
+            outcome="miss" if compiled else "hit",
+        ).inc()
 
 
 # -- sampling profiler ------------------------------------------------------
@@ -209,6 +435,10 @@ class SamplingProfiler:
         self._thread: threading.Thread | None = None
 
     def start(self):
+        # idempotent: a second start() must not leak the first sampler
+        # thread (it would double-count every stack forever)
+        if self._thread is not None and self._thread.is_alive():
+            return
         self._stop.clear()
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()

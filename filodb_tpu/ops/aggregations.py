@@ -22,9 +22,27 @@ import numpy as np
 SIMPLE_AGG_OPS = ("sum", "count", "avg", "min", "max", "stddev", "stdvar", "group")
 
 
-@functools.partial(jax.jit, static_argnames=("op", "num_groups"))
 def segment_aggregate(op: str, values, group_ids, num_groups: int):
-    """values [S, J] (NaN = absent), group_ids [S] int32 -> [G, J]."""
+    """values [S, J] (NaN = absent), group_ids [S] int32 -> [G, J].
+
+    Instrumented entry point: per-op dispatch latency + JIT cache hit/miss
+    (metrics.record_kernel_dispatch) around the jitted kernel."""
+    import time as _time
+
+    from ..metrics import record_kernel_dispatch
+
+    t0 = _time.perf_counter()
+    before = _segment_aggregate_jit._cache_size()
+    out = _segment_aggregate_jit(op, values, group_ids, num_groups)
+    record_kernel_dispatch(
+        f"segment_{op}", _time.perf_counter() - t0,
+        compiled=_segment_aggregate_jit._cache_size() > before,
+    )
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("op", "num_groups"))
+def _segment_aggregate_jit(op: str, values, group_ids, num_groups: int):
     valid = ~jnp.isnan(values)
     v0 = jnp.where(valid, values, 0.0)
     count = jax.ops.segment_sum(valid.astype(values.dtype), group_ids, num_groups)

@@ -447,6 +447,30 @@ def _host_timestamp(block: StagedBlock, params: RangeParams) -> np.ndarray:
     return out
 
 
+def _jit_cache_size() -> int:
+    """Combined compile-cache size of the kernels run_range_function can
+    dispatch to — a growth across one dispatch means a compile happened
+    (the hit/miss signal for filodb_jit_cache; SURVEY §7 calls
+    recompilation the #1 risk, so hits/misses must be observable in
+    production).
+
+    Best-effort attribution under concurrency: a sibling thread's compile
+    during this dispatch is counted as this dispatch's miss, and two racing
+    first-dispatches of one shape may both count. Misses are therefore an
+    UPPER bound — but a miss can only register while some cache genuinely
+    grew, so the steady-state signal (misses must go to zero) is exact."""
+    total = range_kernel._cache_size() + sorted_window_kernel._cache_size()
+    try:
+        from .mxu_jitter import jitter_masked_kernel, jitter_range_kernel
+        from .mxu_kernels import mxu_minmax, mxu_range_kernel
+
+        total += mxu_range_kernel._cache_size() + mxu_minmax._cache_size()
+        total += jitter_range_kernel._cache_size() + jitter_masked_kernel._cache_size()
+    except Exception:  # noqa: BLE001 — accounting must never break dispatch
+        pass
+    return total
+
+
 def run_range_function(
     func: str,
     block: StagedBlock,
@@ -455,8 +479,32 @@ def run_range_function(
     is_delta: bool = False,
     args: tuple = (),
 ):
-    """Dispatch one range function over a staged block. Returns a device array
-    [S, J_padded]; caller slices [:n_series, :num_steps]."""
+    """Dispatch one range function over a staged block (instrumented entry
+    point: per-kernel dispatch latency + JIT cache hit/miss). Returns a
+    device array [S, J_padded]; caller slices [:n_series, :num_steps]."""
+    import time as _time
+
+    from ..metrics import record_kernel_dispatch
+
+    t0 = _time.perf_counter()
+    before = _jit_cache_size()
+    out = _dispatch_range_function(
+        func, block, params, is_counter=is_counter, is_delta=is_delta, args=args
+    )
+    record_kernel_dispatch(
+        func, _time.perf_counter() - t0, compiled=_jit_cache_size() > before
+    )
+    return out
+
+
+def _dispatch_range_function(
+    func: str,
+    block: StagedBlock,
+    params: RangeParams,
+    is_counter: bool = False,
+    is_delta: bool = False,
+    args: tuple = (),
+):
     from .mxu_kernels import MXU_FUNCS, run_mxu_range_function
 
     if func == "timestamp":

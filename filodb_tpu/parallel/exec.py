@@ -133,7 +133,7 @@ class MeshAggregateExec(ExecPlan):
                 self.raw_end_ms, is_counter=self.is_counter and not self.is_delta,
             )
             labels = [dict(shard.partition(int(p)).tags) for p in pids]
-            ctx.stats.series_scanned += len(pids)
+            ctx.stats.bump(series_scanned=len(pids))
             blocks.append(block)
             labels_per_shard.append(labels)
         all_labels = [l for ls in labels_per_shard for l in ls]
@@ -566,7 +566,7 @@ class TimeShardRangeExec(ExecPlan):
                     raise QueryError("time-sharded path supports scalar columns only")
                 series.append((t, v))
                 labels.append(dict(part.tags))
-            ctx.stats.series_scanned += len(pids)
+            ctx.stats.bump(series_scanned=len(pids))
         if not series:
             return QueryResult()
         block = ST.stage_series(

@@ -47,6 +47,10 @@ class QueryContext:
     dispatcher: Any = None
     retry_policy: Any = None
     breakers: Any = None
+    # tracing (metrics.py): the query's root Span. ExecPlan.execute falls
+    # back to it as parent when a thread has no active span — the scheduler
+    # pool hop between the engine and the root plan node
+    trace_root: Any = None
     _start_time: float = field(default_factory=time.monotonic)
 
     def check_deadline(self) -> None:
@@ -75,12 +79,38 @@ class ExecPlan:
         self.transformers = []
 
     def execute(self, ctx: QueryContext) -> QueryResult:
-        from ...metrics import span
+        from ...metrics import Span, current_span, span
 
         t0 = time.perf_counter_ns()
         ctx.check_deadline()
-        with span(type(self).__name__):
+        # parent: the thread's active span (nested execution, or a pool
+        # worker re-activated via metrics.activate), else the query's root
+        # span (the engine -> scheduler-pool hop)
+        parent = current_span() or ctx.trace_root
+        with span(type(self).__name__, parent=parent) as s:
+            args = self.args_str()
+            if args:
+                s.tags["plan"] = args
+            before = ctx.stats.snapshot()
+            peer_stats = None
             res = self.do_execute(ctx)
+            if res.stats is not ctx.stats and not res.stats.is_empty():
+                # a remote child returns the peer's QueryStats in-band:
+                # merge them into the query-wide stats exactly once, here,
+                # then alias so a parent re-returning this result object
+                # cannot double-merge
+                peer_stats = res.stats.as_dict()
+                ctx.stats.merge(res.stats)
+                res.stats = ctx.stats
+            rt = res.trace
+            if rt is not None and not isinstance(rt, Span):
+                # a remote child's span tree (rendered dict): stitch it
+                # under this node's span, rewriting linkage into the local
+                # trace — the cross-node half of trace propagation
+                s.children.append(
+                    Span.from_dict(rt, trace_id=s.trace_id, parent_id=s.span_id)
+                )
+                res.trace = None
             if res.warnings:
                 # remote children return their own partial-result warnings
                 # in-band; hoist them onto the context so they survive
@@ -90,9 +120,16 @@ class ExecPlan:
                 # dedup happens once at the engine edge.
                 ctx.warnings.extend(res.warnings)
             for tr in self.transformers:
-                with span(type(tr).__name__):
+                with span(type(tr).__name__) as ts:
+                    targs = tr_args(tr)
+                    if targs:
+                        ts.tags["plan"] = targs
                     res = apply_transformer(tr, res, ctx)
-        ctx.stats.cpu_ns += time.perf_counter_ns() - t0
+            # remote child: the peer's own stats are exact attribution; local
+            # nodes get the (inclusive, best-effort across concurrent
+            # siblings) delta of the query-wide stats
+            s.stats = peer_stats if peer_stats is not None else ctx.stats.delta_since(before)
+        ctx.stats.bump(cpu_ns=time.perf_counter_ns() - t0)
         return res
 
     def do_execute(self, ctx: QueryContext) -> QueryResult:
@@ -303,7 +340,7 @@ class SelectRawPartitionsExec(ExecPlan):
                     + np.asarray(block.vals).nbytes
                     + (np.asarray(block.raw).nbytes if block.raw is not None else 0)
                 )
-                ctx.stats.bytes_staged += nbytes
+                ctx.stats.bump(bytes_staged=nbytes)
                 block.to_device(keep_host=True)  # mirrors enable append repair
                 # byte-budgeted eviction, oldest entry first (the staging
                 # analog of BlockManager reclaim under memory pressure).
@@ -325,8 +362,10 @@ class SelectRawPartitionsExec(ExecPlan):
                             oldest = next(iter(shard.stage_cache))
                             used -= shard.stage_cache.pop(oldest).nbytes
                         shard.stage_cache[cache_key] = StageEntry(block, nbytes)
-            ctx.stats.series_scanned += len(ids)
-            ctx.stats.samples_scanned += int(np.asarray(block.lens).sum())
+            ctx.stats.bump(
+                series_scanned=len(ids),
+                samples_scanned=int(np.asarray(block.lens).sum()),
+            )
             if ctx.stats.samples_scanned > ctx.max_samples:
                 raise QueryError(
                     f"query would scan {ctx.stats.samples_scanned} samples > "
@@ -507,6 +546,7 @@ class NonLeafExecPlan(ExecPlan):
         with the child's args_str(); under ctx.allow_partial_results, merge
         nodes (supports_partial) instead record a structured warning per
         lost child and return the survivors."""
+        from ...metrics import activate, current_span
         from ..faults import child_warning, dispatch_child
         from .transformers import QueryDeadlineExceeded
 
@@ -520,12 +560,21 @@ class NonLeafExecPlan(ExecPlan):
         results: dict[int, QueryResult] = {}
         failures: list[tuple[int, Exception]] = []
         pool = futs = None
+        # capture the dispatching span: pool workers have no thread-local
+        # trace context, so each re-activates it before executing — child
+        # spans attach under this node instead of starting orphan traces
+        parent_span = current_span()
+
+        def dispatch_traced(child):
+            with activate(parent_span):
+                return dispatch_child(child, ctx)
+
         if remote_idx and len(children) >= 2:
             from concurrent.futures import ThreadPoolExecutor
 
             pool = ThreadPoolExecutor(max_workers=min(8, len(remote_idx)),
                                       thread_name_prefix="filodb-remote")
-            futs = {i: pool.submit(dispatch_child, children[i], ctx)
+            futs = {i: pool.submit(dispatch_traced, children[i])
                     for i in remote_idx}
         try:
             for i, c in enumerate(children):
@@ -577,7 +626,13 @@ class NonLeafExecPlan(ExecPlan):
                 i, e = failures[0]
                 raise self._annotate_child_error(children[i], e)
             for i, e in failures:
-                ctx.warnings.append(child_warning(children[i], e))
+                w = child_warning(children[i], e)
+                ctx.warnings.append(w)
+                if parent_span is not None:
+                    # partial-result drops annotate the merge node's span so
+                    # EXPLAIN ANALYZE / the slow-query log show which
+                    # children were lost and why
+                    parent_span.tags.setdefault("lost_children", []).append(w)
         return [results[i] for i in sorted(results)]
 
 

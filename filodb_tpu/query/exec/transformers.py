@@ -56,6 +56,8 @@ class PeriodicSamplesMapper:
         return int((self.end_ms - self.start_ms) // self.step_ms) + 1
 
     def apply_raw(self, raws: list[RawGrid]) -> list[Grid]:
+        from ...metrics import span
+
         out: list[Grid] = []
         nsteps = self.num_steps()
         for rg in raws:
@@ -69,7 +71,10 @@ class PeriodicSamplesMapper:
                     raise QueryError(
                         f"function {self.function} is not supported on native histograms"
                     )
-                vals = HK.run_hist_range_function(func, rg.block, params, is_delta=rg.is_delta)
+                with span(f"kernel:hist_{func}", schema=rg.schema_name):
+                    vals = HK.run_hist_range_function(
+                        func, rg.block, params, is_delta=rg.is_delta
+                    )
                 scalar_vals = vals[..., -1] * jnp.nan  # placeholder [S,J]
                 g = Grid(
                     labels=list(rg.labels),
@@ -81,14 +86,15 @@ class PeriodicSamplesMapper:
                     les=rg.les,
                 )
             else:
-                vals = K.run_range_function(
-                    func,
-                    rg.block,
-                    params,
-                    is_counter=rg.is_counter,
-                    is_delta=rg.is_delta,
-                    args=self.args,
-                )
+                with span(f"kernel:{func}", schema=rg.schema_name):
+                    vals = K.run_range_function(
+                        func,
+                        rg.block,
+                        params,
+                        is_counter=rg.is_counter,
+                        is_delta=rg.is_delta,
+                        args=self.args,
+                    )
                 g = Grid(
                     labels=list(rg.labels),
                     start_ms=self.start_ms,

@@ -275,18 +275,32 @@ def dispatch_child(child, ctx):
 
 
 def call_with_retries(fn, ctx, endpoint: str):
-    """Run ``fn`` with breaker consultation + budgeted backoff retries."""
-    from ..metrics import record_remote_retry
+    """Run ``fn`` with breaker consultation + budgeted backoff retries.
+
+    Retry and breaker events annotate the active span (the dispatching merge
+    node's — each ATTEMPT produces its own child span via the child's
+    execute, so per-endpoint counters live one level up where they
+    aggregate), making them visible in EXPLAIN ANALYZE output and the
+    slow-query log."""
+    from ..metrics import current_span, record_remote_retry
 
     policy: RetryPolicy = getattr(ctx, "retry_policy", None) or DEFAULT_RETRY_POLICY
     registry: BreakerRegistry = getattr(ctx, "breakers", None) or GLOBAL_BREAKERS
     breaker = registry.breaker_for(endpoint)
+    sp = current_span()
     rng = policy.rng()
     attempt = 0
     while True:
         ctx.check_deadline()
         if not breaker.allow():
+            if sp is not None:
+                opens = sp.tags.setdefault("breaker_open", [])
+                if endpoint not in opens:
+                    opens.append(endpoint)
             raise CircuitOpenError(f"circuit breaker open for endpoint {endpoint}")
+        state = breaker.state()
+        if state != _STATE_CLOSED and sp is not None:
+            sp.tags.setdefault("breaker_state", {})[endpoint] = state
         try:
             res = fn()
         except Exception as e:  # noqa: BLE001 — classified below
@@ -313,6 +327,9 @@ def call_with_retries(fn, ctx, endpoint: str):
                 # last transport error now instead of burning the budget
                 raise
             record_remote_retry(endpoint)
+            if sp is not None:
+                retries = sp.tags.setdefault("retries", {})
+                retries[endpoint] = retries.get(endpoint, 0) + 1
             policy.sleep(backoff)
             continue
         breaker.record_success()

@@ -194,6 +194,13 @@ def result_to_frames(res: QueryResult, chunk_rows: int = CHUNK_ROWS):
         # non-fatal type (no proto schema change needed; the decoder folds
         # it into QueryResult.warnings instead of raising)
         yield error_frame(PARTIAL_WARNINGS, json.dumps(res.warnings))
+    trace = getattr(res, "trace", None)
+    if trace is not None:
+        # the peer's span tree returns in-band like PartialWarnings; the
+        # origin stitches it under the dispatching exec node's span
+        from ..metrics import trace_to_dict
+
+        yield error_frame(TRACE_TREE, json.dumps(trace_to_dict(trace)))
     fin = pb.StreamFrame()
     st = fin.stats
     st.series_scanned = int(res.stats.series_scanned)
@@ -207,6 +214,10 @@ def result_to_frames(res: QueryResult, chunk_rows: int = CHUNK_ROWS):
 
 # error_type of the NON-FATAL warnings frame (partial results protocol)
 PARTIAL_WARNINGS = "PartialWarnings"
+
+# error_type of the NON-FATAL trace frame: the peer's span tree, rendered
+# (metrics.Span.to_dict), returned alongside results for cross-node stitching
+TRACE_TREE = "TraceTree"
 
 
 def error_frame(error_type: str, message: str) -> "pb.StreamFrame":
@@ -294,6 +305,8 @@ def frames_to_result(frames) -> QueryResult:
             if fr.error.error_type == PARTIAL_WARNINGS:
                 res.warnings.extend(json.loads(fr.error.message))
                 res.partial = True
+            elif fr.error.error_type == TRACE_TREE:
+                res.trace = json.loads(fr.error.message)
             else:
                 _raise_remote_error(fr.error.error_type, fr.error.message)
     for gi in sorted(headers):

@@ -10,6 +10,7 @@ pulls to host once at the edge.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field, replace
 from typing import Any, Sequence
 
@@ -76,6 +77,14 @@ class ScalarResult:
     values: np.ndarray  # [J]
 
 
+# serializes mutations of a QueryContext's stats: local children bump() on
+# the plan thread while remote children merge() from dispatch-pool threads,
+# and a bare '+=' read-modify-write can lose an update across that overlap.
+# One process-wide lock (never held across I/O) beats a per-instance Lock
+# field, which would break dataclass replace()/equality expectations.
+_STATS_LOCK = threading.Lock()
+
+
 @dataclass
 class QueryStats:
     """reference QuerySession.queryStats (ExecPlan.scala:430)."""
@@ -87,11 +96,44 @@ class QueryStats:
     bytes_staged: int = 0
 
     def merge(self, other: "QueryStats") -> None:
-        self.series_scanned += other.series_scanned
-        self.samples_scanned += other.samples_scanned
-        self.cpu_ns += other.cpu_ns
-        self.device_ns += other.device_ns
-        self.bytes_staged += other.bytes_staged
+        with _STATS_LOCK:
+            self.series_scanned += other.series_scanned
+            self.samples_scanned += other.samples_scanned
+            self.cpu_ns += other.cpu_ns
+            self.device_ns += other.device_ns
+            self.bytes_staged += other.bytes_staged
+
+    def bump(self, **deltas: int) -> None:
+        """Atomic increment of one or more counters (the '+=' replacement
+        for stats shared across scatter threads)."""
+        with _STATS_LOCK:
+            for k, v in deltas.items():
+                setattr(self, k, getattr(self, k) + v)
+
+    def is_empty(self) -> bool:
+        return not (self.series_scanned or self.samples_scanned or self.cpu_ns
+                    or self.device_ns or self.bytes_staged)
+
+    def as_dict(self) -> dict:
+        return {
+            "series_scanned": self.series_scanned,
+            "samples_scanned": self.samples_scanned,
+            "cpu_ns": self.cpu_ns,
+            "device_ns": self.device_ns,
+            "bytes_staged": self.bytes_staged,
+        }
+
+    def snapshot(self) -> tuple:
+        return (self.series_scanned, self.samples_scanned, self.cpu_ns,
+                self.device_ns, self.bytes_staged)
+
+    def delta_since(self, snap: tuple) -> dict:
+        """Per-plan-node stats attribution: what this node (and, inclusively,
+        its subtree) added to the query-wide stats since ``snap``."""
+        now = self.snapshot()
+        keys = ("series_scanned", "samples_scanned", "cpu_ns", "device_ns",
+                "bytes_staged")
+        return {k: now[i] - snap[i] for i, k in enumerate(keys) if now[i] != snap[i]}
 
 
 @dataclass
@@ -110,6 +152,10 @@ class QueryResult:
     # marks a result merged from a strict subset of its shards/peers
     warnings: list[dict] = field(default_factory=list)
     partial: bool = False
+    # tracing (metrics.py): the query's span tree. At the engine edge this
+    # is the root Span; on a result crossing a transport it is the peer's
+    # rendered dict, which ExecPlan.execute grafts into the local trace
+    trace: Any | None = None
 
     def all_series(self):
         """Iterate (labels, ts_ms[], values[]) dropping NaN points."""

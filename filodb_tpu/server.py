@@ -158,6 +158,12 @@ class FiloServer:
             base_backoff_s=float(rcfg["base_backoff_s"]),
             max_backoff_s=float(rcfg["max_backoff_s"]),
         )
+        # slow-query log: threshold rides PlannerParams, the ring size is
+        # process-global (the log is shared across engines)
+        slow_thr = qcfg.get("slow_query_threshold_s", DEFAULTS["query"]["slow_query_threshold_s"])
+        from .metrics import SLOW_QUERY_LOG
+
+        SLOW_QUERY_LOG.configure(int(qcfg.get("slow_query_log_max", 64) or 64))
         common = dict(
             spread=self.spread,
             lookback_ms=int(qcfg["lookback_ms"]),
@@ -169,6 +175,7 @@ class FiloServer:
             allow_partial_results=bool(qcfg.get("allow_partial_results", False)),
             retry_policy=self.retry_policy,
             breakers=self.breakers,
+            slow_query_threshold_s=float(slow_thr) if slow_thr is not None else None,
         )
         self.engine = QueryEngine(
             self.memstore, self.dataset,
@@ -245,6 +252,12 @@ class FiloServer:
             local_engine=self.local_engine,
             flush_hook=self.flush_now,
         )
+        if self.profiler is not None:
+            # /debug/profile is config-gated: wired only when the profiler
+            # block enables sampling
+            self._http.RequestHandlerClass.profiler_hook = staticmethod(
+                self.profiler.report
+            )
         if self.seeds:
             # seed bootstrap (reference akka-bootstrapper): discover peers
             # via /__members, expose our own membership, keep refreshing so

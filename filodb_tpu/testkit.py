@@ -3,7 +3,8 @@ core/src/test/scala/filodb.core/TestData.scala:27,239 MachineMetricsData —
 synthetic machine-metric streams used across every layer's specs), plus the
 deterministic fault-injection harness (:class:`FaultInjector`) the chaos
 tests drive the query/faults.py retry/breaker/partial-results machinery
-with."""
+with, and the in-process cluster harness (:func:`grpc_cluster`) for
+distributed parent -> remote-gRPC-child tests."""
 
 from __future__ import annotations
 
@@ -207,3 +208,50 @@ class FaultInjector:
         if fault is not None:
             raise fault
         return child.execute(ctx)
+
+
+# ---------------------------------------------------------------------------
+# in-process distributed cluster (parent -> remote gRPC child)
+# ---------------------------------------------------------------------------
+
+
+def grpc_cluster(batch=None, n_shards: int = 4, owned=(0, 1),
+                 dataset: str = "prometheus", spread: int = 2,
+                 deadline_s: float = 120.0, **params_kw):
+    """Two-node in-process cluster over the gRPC plan transport: a parent
+    engine owning ``owned`` shards that scatters every selector to a peer
+    engine owning the rest (the distributed scatter-gather path, without
+    FiloServer weight). ``batch`` (if given) is routed into BOTH memstores —
+    shard ownership splits it across the nodes exactly like production
+    ingest routing.
+
+    Returns ``(parent_engine, peer_engine, stop)``; call ``stop()`` to shut
+    the peer's gRPC server down. Extra kwargs land on both engines'
+    PlannerParams (e.g. slow_query_threshold_s, allow_partial_results)."""
+    from .api.grpc_exec import serve_grpc
+    from .coordinator.planner import PlannerParams, QueryEngine
+    from .core.schemas import Dataset
+    from .memstore.memstore import TimeSeriesMemStore
+
+    owned = list(owned)
+    peer_shards = [s for s in range(n_shards) if s not in set(owned)]
+    ms_parent = TimeSeriesMemStore()
+    ms_parent.setup(Dataset(dataset), owned, total_shards=n_shards)
+    ms_peer = TimeSeriesMemStore()
+    ms_peer.setup(Dataset(dataset), peer_shards, total_shards=n_shards)
+    if batch is not None:
+        ms_parent.ingest_routed(dataset, batch, spread=spread)
+        ms_peer.ingest_routed(dataset, batch, spread=spread)
+    common = dict(spread=spread, num_shards=n_shards, deadline_s=deadline_s,
+                  **params_kw)
+    peer_engine = QueryEngine(ms_peer, dataset, PlannerParams(**common))
+    server, port = serve_grpc(peer_engine, port=0)
+    parent_engine = QueryEngine(
+        ms_parent, dataset,
+        PlannerParams(peer_endpoints=(f"grpc://127.0.0.1:{port}",), **common),
+    )
+
+    def stop():
+        server.stop(grace=0)
+
+    return parent_engine, peer_engine, stop

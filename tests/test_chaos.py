@@ -532,6 +532,78 @@ class TestPartialOverHttp:
 
 
 # ---------------------------------------------------------------------------
+# trace annotations (metrics.py spans x fault machinery)
+# ---------------------------------------------------------------------------
+
+
+class TestTraceAnnotations:
+    def test_retries_annotate_dispatching_span(self):
+        from filodb_tpu.metrics import span
+
+        sleeps: list[float] = []
+        policy = RetryPolicy(max_attempts=4, base_backoff_s=0.01, seed=7,
+                             sleep=sleeps.append)
+        child = FlakyRemoteExec("grpc://p:1", fail_times=2)
+        ctx = make_ctx(retry_policy=policy, breakers=BreakerRegistry())
+        with span("gather") as s:
+            dispatch_child(child, ctx)
+        assert s.tags["retries"]["grpc://p:1"] == 2
+        # each ATTEMPT produced its own child span (3 = 2 failures + success)
+        assert [c.name for c in s.children] == ["FlakyRemoteExec"] * 3
+
+    def test_open_breaker_annotates_span(self):
+        from filodb_tpu.metrics import span
+
+        clock = FakeClock()
+        breakers = BreakerRegistry(clock=clock, window=8, failure_rate=0.5,
+                                   min_calls=4, cooldown_s=10.0)
+        policy = RetryPolicy(max_attempts=1, seed=0, sleep=lambda s: None)
+        ctx = make_ctx(retry_policy=policy, breakers=breakers)
+        child = FlakyRemoteExec("grpc://annot:1", always_fail=True)
+        for _ in range(4):
+            with pytest.raises(InjectedFault):
+                dispatch_child(child, ctx)
+        with span("gather") as s:
+            with pytest.raises(CircuitOpenError):
+                dispatch_child(child, ctx)
+        assert s.tags["breaker_open"] == ["grpc://annot:1"]
+        # half-open probing is annotated as breaker state encountered
+        clock.advance(10.0)
+        child.always_fail = False
+        with span("gather2") as s2:
+            dispatch_child(child, ctx)
+        assert s2.tags["breaker_state"]["grpc://annot:1"] == "half_open"
+
+    def test_partial_drops_annotate_merge_node_span(self):
+        """Chaos-injected partials appear as lost_children annotations on
+        the merge node's span in the query's trace tree."""
+        from filodb_tpu.metrics import trace_to_dict
+
+        ms0, _ = make_engine()
+        victim = next(sh.shard_num for sh in ms0.shards("prometheus")
+                      if sh.num_partitions)
+        inj = FaultInjector([FaultRule(target=f"shard={victim} ")], seed=1)
+        _, eng = make_engine(dispatcher=inj)
+        res = eng.query_range(Q, S, E, 60, allow_partial_results=True)
+        assert res.partial is True
+
+        def walk(d):
+            yield d
+            for c in d.get("children", ()):
+                yield from walk(c)
+
+        tree = trace_to_dict(res.trace)
+        annotated = [
+            sp for sp in walk(tree)
+            if "lost_children" in sp.get("tags", {})
+        ]
+        assert len(annotated) == 1
+        lost = annotated[0]["tags"]["lost_children"]
+        assert lost == res.warnings
+        assert lost[0]["shard"] == victim
+
+
+# ---------------------------------------------------------------------------
 # shard reassignment convergence
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Lint: every ExecPlan subclass must execute under a tracing span.
+
+The tracing contract (doc/observability.md) is that ``ExecPlan.execute`` is
+the ONE place spans wrap plan-node execution — subclasses implement
+``do_execute`` and inherit the instrumented template method. A subclass that
+overrides ``execute`` without opening a span silently drops its subtree out
+of every trace, EXPLAIN ANALYZE rendering, and the slow-query log.
+
+This check walks the package AST (no imports — runs without jax):
+
+- collects every class transitively subclassing ``ExecPlan``;
+- flags any that define ``execute`` unless that override visibly opens a
+  span (calls ``span(``) or delegates to ``super().execute``;
+- asserts the base ``ExecPlan.execute`` itself opens a span.
+
+Exit code 0 = clean, 1 = violations (printed one per line).
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+PKG = Path(__file__).resolve().parent.parent / "filodb_tpu"
+
+
+def base_names(cls: ast.ClassDef) -> list[str]:
+    out = []
+    for b in cls.bases:
+        if isinstance(b, ast.Name):
+            out.append(b.id)
+        elif isinstance(b, ast.Attribute):
+            out.append(b.attr)
+    return out
+
+
+def method(cls: ast.ClassDef, name: str) -> ast.FunctionDef | None:
+    for node in cls.body:
+        if isinstance(node, ast.FunctionDef) and node.name == name:
+            return node
+    return None
+
+
+def opens_span(fn: ast.FunctionDef) -> bool:
+    """True when the method body calls span(...) or super().execute(...)."""
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Name) and f.id == "span":
+            return True
+        if isinstance(f, ast.Attribute):
+            if f.attr == "span":
+                return True
+            if (
+                f.attr == "execute"
+                and isinstance(f.value, ast.Call)
+                and isinstance(f.value.func, ast.Name)
+                and f.value.func.id == "super"
+            ):
+                return True
+    return False
+
+
+def main() -> int:
+    classes: dict[str, ast.ClassDef] = {}
+    files: dict[str, Path] = {}
+    for path in sorted(PKG.rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        try:
+            tree = ast.parse(path.read_text(), filename=str(path))
+        except SyntaxError as e:
+            print(f"SYNTAX ERROR {path}: {e}")
+            return 1
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                classes[node.name] = node
+                files[node.name] = path
+
+    # transitive closure over class names (same-name collisions across
+    # modules are acceptable at this granularity — plan classes are unique)
+    plan_classes: set[str] = {"ExecPlan"}
+    changed = True
+    while changed:
+        changed = False
+        for name, cls in classes.items():
+            if name not in plan_classes and plan_classes & set(base_names(cls)):
+                plan_classes.add(name)
+                changed = True
+    plan_classes.discard("ExecPlan")
+
+    violations: list[str] = []
+    base = classes.get("ExecPlan")
+    if base is None:
+        violations.append("ExecPlan base class not found")
+    else:
+        base_exec = method(base, "execute")
+        if base_exec is None or not opens_span(base_exec):
+            violations.append(
+                f"{files['ExecPlan']}: ExecPlan.execute does not open a span"
+            )
+
+    for name in sorted(plan_classes):
+        fn = method(classes[name], "execute")
+        if fn is not None and not opens_span(fn):
+            violations.append(
+                f"{files[name]}:{fn.lineno}: {name}.execute overrides the "
+                "instrumented template without opening a span"
+            )
+
+    if violations:
+        print(f"span-coverage lint: {len(violations)} violation(s)")
+        for v in violations:
+            print(f"  {v}")
+        return 1
+    print(
+        f"span-coverage lint: OK — {len(plan_classes)} ExecPlan subclasses "
+        "all execute under a span"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
