@@ -2,7 +2,7 @@
 
 NATIVE_DIR := filodb_tpu/native
 
-.PHONY: all native test test-chaos test-observability bench microbench serve clean tpu-watch tpu-watch-bg
+.PHONY: all native test test-chaos test-observability bench bench-smoke microbench serve clean tpu-watch tpu-watch-bg
 
 all: native
 
@@ -20,6 +20,9 @@ $(NATIVE_DIR)/libfilodbprom.so: $(NATIVE_DIR)/promparse.cpp
 $(NATIVE_DIR)/libfilodbrender.so: $(NATIVE_DIR)/promrender.cpp
 	g++ -O3 -march=native -std=c++17 -shared -fPIC $< -o $@
 
+# default test run; pair with `make bench-smoke` before sending a perf-
+# sensitive change (the smoke gate catches losing the fused single-dispatch
+# path or a staging-cache regression that unit tests can't see)
 test: native
 	python -m pytest tests/ -q
 
@@ -37,6 +40,11 @@ test-observability: native
 
 bench: native
 	python bench.py
+
+# perf regression gate (doc/perf.md): 2k series, 3 runs, CPU backend;
+# fails if p50 regresses >25% vs benchmarks/bench_smoke_floor.json
+bench-smoke: native
+	python tools/bench_smoke.py
 
 microbench: native
 	python -m benchmarks.run

@@ -159,48 +159,100 @@ def cpu_baseline(ms, ts):
     return float(np.median(times) * 1e3), ref
 
 
+def _span_phase_ms(trace, out: dict) -> None:
+    """Accumulate per-phase durations from the query's span tree.
+
+    Phases (doc/perf.md): lookup/stage under ``fused:stage`` (index lookup +
+    superblock build, split out as fused:lookup when present), ``dispatch``
+    from the fused/kernel spans, ``merge`` from the partial-merge root when
+    the reference tree ran. ``transfer`` is measured by the caller around
+    the device->host fetch."""
+    if trace is None:
+        return
+
+    def kernel_ms(sp) -> float:
+        own = sp.duration_ms if sp.name.startswith("kernel:") else 0.0
+        return own + sum(kernel_ms(c) for c in sp.children)
+
+    name = trace.name
+    if name.startswith("fused:lookup"):
+        out["lookup"] = out.get("lookup", 0.0) + trace.duration_ms
+    elif name.startswith("fused:stage"):
+        out["stage"] = out.get("stage", 0.0) + trace.duration_ms
+    elif name.startswith("fused:dispatch") or name.startswith("kernel:"):
+        out["dispatch"] = out.get("dispatch", 0.0) + trace.duration_ms
+    elif name in ("ReduceAggregateExec", "AggregatePresentExec"):
+        child_ms = sum(c.duration_ms for c in trace.children)
+        out["merge"] = out.get("merge", 0.0) + max(
+            trace.duration_ms - child_ms, 0.0
+        )
+    elif name == "SelectRawPartitionsExec":
+        # the leaf span covers staging AND its folded transformers' kernel
+        # dispatches; attribute the kernel subtree to dispatch (handled by
+        # the kernel: branch when recursion reaches it), not to stage
+        out["stage"] = out.get("stage", 0.0) + max(
+            trace.duration_ms - kernel_ms(trace), 0.0
+        )
+    for c in trace.children:
+        _span_phase_ms(c, out)
+
+
 def tpu_query(ms):
-    import jax
-
     from filodb_tpu.coordinator.planner import PlannerParams, QueryEngine
-    from filodb_tpu.parallel.mesh import make_mesh
+    from filodb_tpu.ops.compile_cache import enable_compile_cache
 
-    # a device mesh (even a single chip) lets the planner compile the whole
-    # multi-shard sum(rate) into ONE kernel call (MeshAggregateExec MXU path)
-    engine = QueryEngine(
-        ms, "prometheus", PlannerParams(mesh=make_mesh(jax.devices()[:1]))
-    )
+    # persistent compile cache: the cold stage+compile warmup survives
+    # process restarts (FILODB_COMPILE_CACHE=0 disables; dir overridable)
+    if os.environ.get("FILODB_COMPILE_CACHE", "1") != "0":
+        enable_compile_cache(os.environ.get(
+            "FILODB_COMPILE_CACHE_DIR",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         ".jax-compile-cache"),
+        ))
+    # default engine: the planner fuses the multi-shard sum(rate) into ONE
+    # compiled range_fn->segment_aggregate dispatch over a device-resident
+    # superblock (FusedAggregateExec; doc/perf.md)
+    engine = QueryEngine(ms, "prometheus", PlannerParams())
     q = "sum(rate(http_requests_total[5m]))"
 
     def run():
         res = engine.query_range(q, START_S, END_S, STEP_S)
         # force full materialization to host (honest end-to-end latency)
+        t_f = time.perf_counter()
         out = [np.asarray(g.values_np()) for g in res.grids]
-        return res, out
+        return res, out, time.perf_counter() - t_f
 
     t0 = time.perf_counter()
-    res, out = run()  # compile + stage + cache warm
-    sys.stderr.write(f"warmup (stage+compile): {time.perf_counter()-t0:.1f}s\n")
+    res, out, _tf = run()  # compile + stage + cache warm
+    warmup_s = time.perf_counter() - t0
+    sys.stderr.write(f"warmup (stage+compile): {warmup_s:.1f}s\n")
     # deadline-aware: on a degraded tunnel each run can take seconds — trim
     # the run count (min 3) so the worker still reports a REAL accelerator
     # p50 inside its budget instead of being killed mid-loop
     deadline = float(os.environ.get("FILODB_BENCH_WORKER_DEADLINE", 0)) or None
     times = []
+    phases: dict = {}
     for i in range(TIMED_RUNS):
         t0 = time.perf_counter()
-        res, out = run()
+        res, out, transfer_s = run()
         times.append(time.perf_counter() - t0)
+        # steady-state attribution from the LAST warm run's trace
+        phases = {}
+        _span_phase_ms(res.trace, phases)
+        phases["transfer"] = transfer_s * 1e3
         if (deadline and len(times) >= 3
                 and time.time() + np.median(times) * 2 > deadline):
             sys.stderr.write(f"deadline near: stopping after {len(times)} runs\n")
             break
     vals = res.grids[0].values_np()[0]
-    return float(np.median(times) * 1e3), vals, res
+    phases = {k: round(v, 3) for k, v in sorted(phases.items())}
+    sys.stderr.write(f"phases_ms={json.dumps(phases)}\n")
+    return float(np.median(times) * 1e3), vals, res, warmup_s, phases
 
 
 def run_benchmark():
     ms, ts = build_memstore()
-    tpu_ms, tpu_vals, res = tpu_query(ms)
+    tpu_ms, tpu_vals, res, warmup_s, phases = tpu_query(ms)
     cpu_ms, cpu_vals = cpu_baseline(ms, ts)
     # cross-check: TPU result must match the CPU oracle
     n = min(len(tpu_vals), len(cpu_vals))
@@ -222,6 +274,8 @@ def run_benchmark():
                 "backend": backend,
                 "series": N_SERIES,
                 "match": bool(ok),
+                "warmup_s": round(warmup_s, 2),
+                "phases_ms": phases,
             }
         )
     )

@@ -22,6 +22,12 @@ DEFAULTS: dict = {
     # flush / persistence
     "flush_interval_s": 3600,
     "store_root": None,  # None = memory-only (NullColumnStore)
+    # persistent XLA compile cache (ops/compile_cache.py): compiled kernel
+    # programs survive process restarts, so a rolling deploy skips the
+    # multi-second cold compile. "auto" = <store_root>/jax-compile-cache
+    # (or ~/.cache/filodb-tpu/... when memory-only); a path uses it as-is;
+    # null disables.
+    "compile_cache_dir": "auto",
     # query limits (reference filodb.query circuit breaker / limits)
     "query": {
         "max_series": 1_000_000,
@@ -32,6 +38,11 @@ DEFAULTS: dict = {
         # 0 = run queries inline on the API edge threads (tests/embedding)
         "parallelism": 8,
         "max_queued": 64,
+        # single-dispatch cross-shard aggregates (doc/perf.md): plan
+        # sum|avg|min|max|count over range functions as ONE fused kernel
+        # dispatch over a device-resident superblock when all shards are
+        # local. false forces the reference scatter/partial-merge tree.
+        "fused_aggregate": True,
         # fault tolerance (query/faults.py): default partial-results stance
         # (per-request allow_partial_results overrides), remote-child retry
         # budget, and per-endpoint circuit-breaker thresholds

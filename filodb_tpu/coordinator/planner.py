@@ -285,6 +285,12 @@ class PlannerParams:
     # fan-out: one kernel launch serves every copy). In-flight sharing only,
     # never a cache — see coordinator.scheduler.SingleFlight.
     coalesce_identical: bool = True
+    # single-dispatch cross-shard aggregates (FusedAggregateExec): when every
+    # shard is local, `sum|avg|min|max|count by (...) (range_fn(...))`
+    # concatenates the per-shard staged blocks into one device-resident
+    # superblock and runs ONE compiled range_fn -> segment_aggregate program
+    # (doc/perf.md). False forces the reference scatter/partial-merge tree.
+    fused_aggregate: bool = True
     # fault tolerance (query/faults.py): default for per-query
     # allow_partial_results (merge nodes tolerate lost shards/peers,
     # tagging results with structured warnings); retry_policy / breakers
@@ -604,6 +610,61 @@ class SingleClusterPlanner:
         mesh_plan = self._try_mesh_aggregate(p)
         if mesh_plan is not None:
             return mesh_plan
+        fused = self._try_fused_aggregate(p)
+        if fused is not None:
+            return fused
+        return self._materialize_aggregate_tree(p)
+
+    def _try_fused_aggregate(self, p: L.Aggregate):
+        """Single-dispatch path: `op by (...) (range_fn(selector[w]))` with
+        every shard local plans to a FusedAggregateExec over one
+        device-resident superblock (O(1) kernel launches). The reference
+        scatter tree is built alongside as the runtime fallback (partial
+        results, histograms, mixed schemas)."""
+        from ..query.exec.plans import (
+            FUSED_AGG_OPS,
+            FUSED_FUNCS,
+            FusedAggregateExec,
+        )
+
+        params = self.params
+        if (
+            not params.fused_aggregate
+            or params.mesh is not None
+            or params.peer_endpoints
+        ):
+            return None
+        if p.op not in FUSED_AGG_OPS or p.params:
+            return None
+        inner = p.inner
+        if isinstance(inner, L.PeriodicSeriesWithWindowing):
+            if (
+                inner.function not in FUSED_FUNCS
+                or inner.function_args
+                or inner.at_ms is not None
+            ):
+                return None
+            func, window = inner.function, inner.window_ms
+        elif isinstance(inner, L.PeriodicSeries):
+            if inner.at_ms is not None:
+                return None
+            func, window = None, inner.lookback_ms
+        else:
+            return None
+        shards = self.shards_for(inner.raw.filters)
+        if not shards:
+            return None
+        return FusedAggregateExec(
+            shards, inner.raw.filters, inner.raw.start_ms, inner.raw.end_ms,
+            inner.raw.column, p.op, p.by, p.without, func,
+            inner.start_ms, inner.end_ms, inner.step_ms or 1, window,
+            inner.offset_ms,
+            # lazy: the O(shards) reference tree only materializes if a
+            # runtime condition actually falls back to it
+            fallback=lambda: self._materialize_aggregate_tree(p),
+        )
+
+    def _materialize_aggregate_tree(self, p: L.Aggregate) -> ExecPlan:
         inner = self._materialize(p.inner)
         simple = p.op in _PARTIAL_COMPONENTS
         if simple and isinstance(inner, DistConcatExec) and not inner.transformers:

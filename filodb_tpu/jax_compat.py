@@ -1,0 +1,27 @@
+"""Version shims for the pinned jax.
+
+``jax.shard_map`` became a top-level API (with ``check_vma``) only in newer
+jax; the image's jax still ships it as ``jax.experimental.shard_map`` with
+the older ``check_rep`` spelling. Every shard_map call site in the tree goes
+through this one wrapper so the mesh execution paths (parallel/, ops/sketch,
+downsample) run on either version.
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:
+    _shard_map = jax.shard_map
+    _CHECK_KW = "check_vma"
+except AttributeError:  # older jax: experimental namespace + check_rep
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KW = "check_rep"
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check: bool = False):
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        **{_CHECK_KW: check},
+    )

@@ -171,8 +171,12 @@ class TimeSeriesPartition:
         return n
 
     def latest_ts(self) -> int:
-        if self._buf is not None and self._buf_len:
-            return max(int(self._buf["timestamp"][self._buf_len - 1]), self._hwm)
+        # local snapshot: a concurrent seal nulls self._buf AFTER appending
+        # the chunk, and readers don't hold the shard lock (the "check then
+        # subscript" TOCTOU crashed queries racing ingest)
+        buf, n = self._buf, self._buf_len
+        if buf is not None and n:
+            return max(int(buf["timestamp"][n - 1]), self._hwm)
         if self.chunks:
             return max(self.chunks[-1].end_ts, self._hwm)
         return self._hwm
@@ -180,8 +184,9 @@ class TimeSeriesPartition:
     def earliest_ts(self) -> int:
         if self.chunks:
             return self.chunks[0].start_ts
-        if self._buf is not None and self._buf_len:
-            return int(self._buf["timestamp"][0])
+        buf, n = self._buf, self._buf_len
+        if buf is not None and n:
+            return int(buf["timestamp"][0])
         return 2**62
 
     def switch_buffers(self) -> Chunk | None:
@@ -217,19 +222,40 @@ class TimeSeriesPartition:
         write buffer. Returns (ts[int64], vals)."""
         ts_parts: list[np.ndarray] = []
         val_parts: list[np.ndarray] = []
-        for c in self.chunks_in_range(t0, t1):
+        # snapshot order matters: queries read without the shard lock while
+        # ingest can seal the buffer into a chunk mid-call (switch_buffers
+        # appends the chunk, THEN nulls self._buf, THEN zeroes _buf_len).
+        # Reading (len, buf, chunks) in that order — each exactly once; the
+        # old re-read of self._buf crashed with a NoneType subscript —
+        # covers every interleaving: a seal completing before the buf read
+        # leaves buf=None and the chunk list (read after) holds the sealed
+        # rows; a seal completing after it leaves the pre-seal buf ref
+        # valid, and the sealed_end clamp below drops any buffer rows a
+        # seen chunk already covers (per-series timestamps are monotone
+        # across seal points), so sealed rows are neither lost nor counted
+        # twice. A stale len against a freshly re-allocated buf fails the
+        # ts[-1] >= t0 gate (trailing zeros) and skips the buffer — the
+        # same slightly-stale-but-consistent view as querying a moment
+        # earlier.
+        n = self._buf_len
+        buf = self._buf
+        chunk_list = list(self.chunks)  # real copy: no mid-iteration appends
+        sealed_end = chunk_list[-1].end_ts if chunk_list else -(2**62)
+        for c in chunk_list:
+            if c.end_ts < t0 or c.start_ts > t1:
+                continue
             ts = c.column("timestamp")
             lo, hi = np.searchsorted(ts, [t0, t1 + 1])
             if hi > lo:
                 ts_parts.append(ts[lo:hi])
                 val_parts.append(c.column(col)[lo:hi])
-        if self._buf is not None and self._buf_len:
-            ts = self._buf["timestamp"][: self._buf_len]
+        if buf is not None and n:
+            ts = buf["timestamp"][:n]
             if ts[-1] >= t0 and ts[0] <= t1:
-                lo, hi = np.searchsorted(ts, [t0, t1 + 1])
+                lo, hi = np.searchsorted(ts, [max(t0, sealed_end + 1), t1 + 1])
                 if hi > lo:
                     ts_parts.append(ts[lo:hi].copy())
-                    val_parts.append(self._buf[col][lo:hi].copy())
+                    val_parts.append(buf[col][lo:hi].copy())
         if not ts_parts:
             ncol = self._hist_width(col)
             empty_v = np.empty((0, ncol)) if ncol else np.empty(0)
@@ -258,8 +284,9 @@ class TimeSeriesPartition:
         chunk arrays + encoded forms (reference: per-TSP write buffers +
         block-memory chunk bytes)."""
         n = 0
-        if self._buf is not None:
-            n += sum(a.nbytes for a in self._buf.values())
+        buf = self._buf
+        if buf is not None:
+            n += sum(a.nbytes for a in buf.values())
         for c in self.chunks:
             if c.arrays is not None:
                 n += sum(a.nbytes for a in c.arrays.values())

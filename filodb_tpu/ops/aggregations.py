@@ -14,10 +14,19 @@ no members at a step yields NaN.
 from __future__ import annotations
 
 import functools
+import threading
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+# serializes group_ids_memo misses (O(S) python regroup + device upload):
+# racing same-key queries must compute once, not clobber each other.
+# Deliberately ONE process-wide lock: misses happen once per (block,
+# grouping) lifetime, so cross-key contention is a cold-path-only cost not
+# worth per-key lock bookkeeping (ROADMAP notes consolidating the tree's
+# single-flight helpers).
+_GID_MEMO_LOCK = threading.Lock()
 
 SIMPLE_AGG_OPS = ("sum", "count", "avg", "min", "max", "stddev", "stdvar", "group")
 
@@ -71,6 +80,169 @@ def _segment_aggregate_jit(op: str, values, group_ids, num_groups: int):
         )
         return jnp.where(has, r, jnp.nan)
     raise ValueError(f"unknown aggregation {op}")
+
+
+# ---------------------------------------------------------------------------
+# fused range-function -> segment-aggregate (single-dispatch cross-shard path)
+# ---------------------------------------------------------------------------
+
+# range functions the fused MXU variant handles directly (the subset of
+# mxu_kernels.MXU_FUNCS that needs no extra lazily-built window structures)
+FUSED_MXU_FUNCS = {
+    "sum_over_time", "count_over_time", "avg_over_time", "last",
+    "last_over_time", "first_over_time", "present_over_time",
+    "stddev_over_time", "stdvar_over_time", "z_score",
+    "rate", "increase", "delta", "idelta", "irate",
+}
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "func", "op", "num_steps", "num_groups", "is_counter", "is_delta"
+))
+def _fused_general_jit(func, op, ts, vals, lens, baseline, raw, gids,
+                       start_off, step_ms, window, num_steps: int,
+                       num_groups: int, is_counter: bool, is_delta: bool):
+    """range_kernel -> segment aggregate as ONE compiled program: only the
+    [G, J] group partials ever exist as program outputs — no [S, J] grid
+    reaches the host, and no second dispatch happens. ``gids`` maps padded
+    rows to the trash group ``num_groups`` (padded rows yield NaN from value
+    functions but real values from count_over_time/present_over_time, so
+    they must never share a segment with real series)."""
+    from .kernels import range_kernel
+
+    sj = range_kernel(
+        func, ts, vals, lens, baseline, raw, start_off, step_ms, window,
+        num_steps, is_counter=is_counter, is_delta=is_delta,
+    )
+    return _segment_aggregate_jit(op, sj, gids, num_groups + 1)[:num_groups]
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "func", "op", "num_groups", "is_counter", "is_delta", "fetch"
+))
+def _fused_mxu_jit(func, op, vals, raw, baseline, W, F, L, L2, count,
+                   t_first, t_last, t_last2, out_t, window_ms, idx, gids,
+                   num_groups: int, is_counter: bool, is_delta: bool,
+                   fetch: str):
+    """Regular-grid fused variant: the MXU window-matmul kernel and the
+    segment reduce in one compiled program (see _fused_general_jit for the
+    trash-group contract on ``gids``)."""
+    from .mxu_kernels import mxu_range_kernel
+
+    sj = mxu_range_kernel(
+        func, vals, raw, baseline, W, F, L, L2, count, t_first, t_last,
+        t_last2, out_t, window_ms, idx=idx, is_counter=is_counter,
+        is_delta=is_delta, fetch=fetch,
+    )
+    return _segment_aggregate_jit(op, sj, gids, num_groups + 1)[:num_groups]
+
+
+def fused_range_aggregate(func: str, op: str, block, gids_padded,
+                          num_groups: int, params, is_counter: bool = False,
+                          is_delta: bool = False):
+    """One device dispatch for ``op by (...) (func(selector[w]))`` over a
+    staged (super)block: returns the [G, J_pad] group partials on device.
+
+    ``gids_padded`` is [S_padded] int32 with padded rows assigned the trash
+    group ``num_groups``. Regular shared grids ride the MXU window-matrix
+    kernel (matrices cached device-resident on the block); everything else
+    runs the general compare-and-reduce kernel. Instrumented like every
+    other kernel entry (per-dispatch latency + JIT hit/miss)."""
+    import time as _time
+
+    from ..metrics import record_kernel_dispatch
+    from .kernels import pad_steps
+
+    j_pad = pad_steps(params.num_steps)
+    raw = block.raw if block.raw is not None else block.vals
+    t0 = _time.perf_counter()
+    use_mxu = (
+        block.regular_ts is not None
+        and func in FUSED_MXU_FUNCS
+        and not (is_delta and func in ("irate", "idelta"))
+    )
+    if use_mxu:
+        from .mxu_kernels import fetch_strategy, window_matrices
+
+        wm = window_matrices(
+            block, int(params.start_ms - block.base_ms), params.step_ms,
+            j_pad, params.window_ms,
+        )
+        before = _fused_mxu_jit._cache_size()
+        out = _fused_mxu_jit(
+            func, op, block.vals, raw, block.baseline,
+            wm.dW, wm.dF, wm.dL, wm.dL2, wm.d_count, wm.d_tf, wm.d_tl,
+            wm.d_tl2, wm.d_out_t, np.float32(params.window_ms), wm.d_idx,
+            gids_padded, num_groups, is_counter, is_delta, fetch_strategy(),
+        )
+        compiled = _fused_mxu_jit._cache_size() > before
+    else:
+        before = _fused_general_jit._cache_size()
+        out = _fused_general_jit(
+            func, op, block.ts, block.vals, block.lens, block.baseline, raw,
+            gids_padded, np.int32(params.start_ms - block.base_ms),
+            np.int32(params.step_ms), np.int32(params.window_ms), j_pad,
+            num_groups, is_counter, is_delta,
+        )
+        compiled = _fused_general_jit._cache_size() > before
+    record_kernel_dispatch(
+        f"fused_{op}_{func}", _time.perf_counter() - t0, compiled=compiled
+    )
+    return out
+
+
+def group_ids_memo(block, series_labels, by, without,
+                   strip_metric: bool = False):
+    """``group_ids_for`` memoized on the (super)block object: repeated
+    dashboard queries over an unchanged block skip the O(S) python
+    regrouping, the label stripping that feeds it, AND the group-id device
+    upload. Sound because a staged block's series set is immutable for its
+    lifetime — the superblock cache hands out a NEW block whenever any
+    member shard's version moves. Keyed by (by, without, strip).
+
+    Returns ``(gids_padded_dev, num_groups, group_labels)`` where
+    gids_padded_dev is a device-resident [S_padded] int32 with padded rows
+    routed to the trash group ``num_groups`` (the fused_range_aggregate
+    contract)."""
+    key = (
+        tuple(by) if by else None,
+        tuple(without) if without else None,
+        bool(strip_metric),
+    )
+    cache = getattr(block, "_gid_cache", None)
+    hit = cache.get(key) if cache is not None else None
+    if hit is None:
+        # miss path under a lock: concurrent same-key queries must not each
+        # pay the O(S) regroup + device upload, nor clobber the cache dict
+        with _GID_MEMO_LOCK:
+            cache = getattr(block, "_gid_cache", None)
+            if cache is None:
+                cache = {}
+                block._gid_cache = cache
+            hit = cache.get(key)
+            if hit is None:
+                import jax
+
+                labels = series_labels
+                if strip_metric:
+                    from ..core.schemas import METRIC_TAG
+
+                    labels = [
+                        {k: v for k, v in l.items()
+                         if k not in (METRIC_TAG, "__name__")}
+                        for l in labels
+                    ]
+                gids, group_labels = group_ids_for(
+                    labels, list(by) if by else None,
+                    list(without) if without else None,
+                )
+                G = len(group_labels)
+                s_pad = np.asarray(block.lens).shape[0]
+                gids_padded = np.full(s_pad, G, dtype=np.int32)
+                gids_padded[: len(gids)] = gids
+                hit = (jax.device_put(gids_padded), G, group_labels)
+                cache[key] = hit
+    return hit
 
 
 @functools.partial(jax.jit, static_argnames=("k", "bottom"))

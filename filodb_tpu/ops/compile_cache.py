@@ -1,0 +1,82 @@
+"""Persistent JAX compilation cache (SURVEY §7: recompilation is the #1
+risk; BENCH_r05 measured a 24.6 s cold stage+compile warmup).
+
+XLA executables for the shape-bucketed kernel set are small and extremely
+reusable — padding discipline (staging.pad_series/pad_time, kernels
+.pad_steps) means a production process compiles a handful of programs and
+then never again. Persisting them to disk makes that true ACROSS process
+restarts too: a rolling deploy or crash-restart skips straight to warm
+dispatch latencies instead of re-paying multi-second XLA compiles.
+
+Config: top-level ``compile_cache_dir`` —
+
+- ``"auto"`` (default): ``<store_root>/jax-compile-cache`` when a data dir
+  is configured, else ``~/.cache/filodb-tpu/jax-compile-cache``;
+- an explicit path: used as-is;
+- ``null``/empty: disabled.
+
+Thresholds are forced to zero so even the fast-compiling CPU-backend
+programs persist (jax's defaults skip entries under 1s compile time, which
+would exclude most of our kernel set on small shapes).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+log = logging.getLogger("filodb_tpu.compile_cache")
+
+_enabled_dir: str | None = None
+
+
+def resolve_cache_dir(config: dict) -> str | None:
+    """Map the ``compile_cache_dir`` knob to a concrete path (or None)."""
+    raw = config.get("compile_cache_dir", "auto")
+    if not raw:
+        return None
+    if raw != "auto":
+        return str(raw)
+    store_root = config.get("store_root")
+    if store_root:
+        return os.path.join(str(store_root), "jax-compile-cache")
+    return os.path.join(
+        os.path.expanduser(os.environ.get("XDG_CACHE_HOME", "~/.cache")),
+        "filodb-tpu", "jax-compile-cache",
+    )
+
+
+def enable_compile_cache(cache_dir: str | None) -> str | None:
+    """Point jax's persistent compilation cache at ``cache_dir``.
+
+    Idempotent; returns the active dir or None when disabled/unsupported.
+    Must run before the first jit dispatch to benefit that process's cold
+    start (later calls still help subsequent compiles)."""
+    global _enabled_dir
+    if not cache_dir:
+        return None
+    if _enabled_dir == cache_dir:
+        return _enabled_dir
+    try:
+        import jax
+
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        for knob, v in (
+            ("jax_persistent_cache_min_compile_time_secs", 0.0),
+            ("jax_persistent_cache_min_entry_size_bytes", -1),
+        ):
+            try:
+                jax.config.update(knob, v)
+            except (AttributeError, ValueError):  # knob renamed/absent
+                pass
+        _enabled_dir = cache_dir
+        log.info("persistent jax compile cache at %s", cache_dir)
+    except Exception as e:  # noqa: BLE001 — cache is an optimization, never fatal
+        log.warning("persistent compile cache unavailable: %s", e)
+        return None
+    return _enabled_dir
+
+
+def enable_from_config(config: dict) -> str | None:
+    return enable_compile_cache(resolve_cache_dir(config))

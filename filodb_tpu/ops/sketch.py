@@ -20,6 +20,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..jax_compat import shard_map
+
 SUB = 32  # sub-bins per octave
 E_MIN = -24  # 2^-24 ~ 6e-8: smaller magnitudes collapse to the zero bin
 E_MAX = 40  # 2^40 ~ 1e12
@@ -116,10 +118,10 @@ def distributed_sketch_quantile(
 
     shard = P("shard")
     row = P("shard", None)
-    return jax.shard_map(
+    return shard_map(
         local,
         mesh=mesh,
         in_specs=(row, row, shard, shard, row, shard),
         out_specs=P(),
-        check_vma=False,
+        check=False,
     )(ts, vals, lens, baseline, raw, gids)

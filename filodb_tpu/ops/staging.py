@@ -26,6 +26,8 @@ Shape discipline (SURVEY.md §7 "ragged data vs static shapes" — the #1 risk):
 
 from __future__ import annotations
 
+import threading
+from collections import OrderedDict
 from dataclasses import dataclass
 
 import numpy as np
@@ -859,6 +861,134 @@ def _slot_align(shard, part_ids, column, series, start_ms: int, end_ms: int):
         o = k_need_lo - int(k[0])
         out.append((ts[o : o + width], v[o : o + width]))
     return out
+
+
+def concat_blocks(blocks, force_raw: bool = False) -> StagedBlock:
+    """Row-concatenate staged blocks into one padded superblock EXACTLY —
+    corrected values, raw sidecars, baselines and part refs carry over with
+    no restaging and no semantic drift. All blocks must share base_ms.
+
+    The shared regular grid survives only when every non-empty block
+    advertises the identical ``regular_ts`` (same padded length, same
+    offsets) — that keeps the MXU window-matrix path available for the
+    single-dispatch fused aggregate; otherwise the superblock runs the
+    general kernels. ``force_raw`` always materializes the raw sidecar
+    (filling from vals where a block has none) for consumers that index it
+    unconditionally (the mesh stacking path)."""
+    real = [b for b in blocks if b.n_series > 0]
+    if not real:  # keep an empty-but-shaped block (mesh rows can be empty)
+        real = list(blocks[:1])
+    assert real and len({b.base_ms for b in real}) == 1
+    T = max(b.ts.shape[1] for b in real)
+    S = sum(b.n_series for b in real)
+    Sp = pad_series(S)
+    ts = np.full((Sp, T), TS_PAD, np.int32)
+    vals = np.zeros((Sp, T), np.float32)
+    any_raw = force_raw or any(b.raw is not None for b in real)
+    raw = np.zeros((Sp, T), np.float32) if any_raw else None
+    lens = np.zeros(Sp, np.int32)
+    baseline = np.zeros(Sp, np.float32)
+    part_refs: list = []
+    o = 0
+    for b in real:
+        k, t = b.n_series, b.ts.shape[1]
+        ts[o : o + k, :t] = np.asarray(b.ts)[:k]
+        vals[o : o + k, :t] = np.asarray(b.vals)[:k]
+        if raw is not None:
+            src_raw = b.raw if b.raw is not None else b.vals
+            raw[o : o + k, :t] = np.asarray(src_raw)[:k]
+        lens[o : o + k] = np.asarray(b.lens)[:k]
+        baseline[o : o + k] = np.asarray(b.baseline)[:k]
+        part_refs.extend(b.part_refs)
+        o += k
+    reg = real[0].regular_ts
+    regular = None
+    if reg is not None and all(
+        b.regular_ts is not None
+        and len(b.regular_ts) == len(reg)
+        and not (np.asarray(b.regular_ts) != np.asarray(reg)).any()
+        for b in real[1:]
+    ):
+        regular = np.asarray(reg)
+        if len(regular) < T:  # narrower padded blocks keep the shared grid
+            ext = np.full(T, TS_PAD, np.int32)
+            ext[: len(regular)] = regular
+            regular = ext
+    return StagedBlock(ts, vals, lens, real[0].base_ms, baseline, S,
+                       part_refs, raw=raw, regular_ts=regular)
+
+
+class SuperblockCache:
+    """Shard-version-keyed cache of device-resident cross-shard superblocks
+    (the staging layer of the single-dispatch fused aggregate).
+
+    Entries are keyed by the query's staging identity (selector filters,
+    range, column, stage mode, shard set); each stores the vector of member
+    shard versions it was built from, so ANY ingest on ANY member shard
+    invalidates the entry at its next lookup — the rebuild then re-reads the
+    per-shard blocks, which repair incrementally through the shard staging
+    cache (append_to_block) instead of restaging from chunks. LRU on hit,
+    bounded by entry count and bytes."""
+
+    def __init__(self, max_entries: int = 8, max_bytes: int = 8 << 30):
+        self.max_entries = max_entries
+        self.max_bytes = max_bytes
+        self._d: OrderedDict = OrderedDict()
+        self._lock = threading.Lock()
+        self._build_locks: dict = {}
+
+    def build_lock(self, key) -> threading.Lock:
+        """Per-key single-flight for builders: concurrent identical cold
+        queries serialize on this lock so only one concatenates + uploads
+        the superblock; the rest hit its freshly-put entry. Locks for keys
+        no longer cached are pruned opportunistically (a racer holding a
+        pruned lock merely degrades to a duplicate build)."""
+        with self._lock:
+            lk = self._build_locks.get(key)
+            if lk is None:
+                if len(self._build_locks) > 4 * self.max_entries:
+                    self._build_locks = {
+                        k: v for k, v in self._build_locks.items()
+                        if k in self._d
+                    }
+                lk = self._build_locks.get(key)
+            if lk is None:
+                lk = threading.Lock()
+                self._build_locks[key] = lk
+            return lk
+
+    def get(self, key, versions: tuple):
+        with self._lock:
+            hit = self._d.get(key)
+            if hit is None:
+                return None
+            if hit[0] != versions:
+                # drop only entries STRICTLY OLDER than the observed shard
+                # state; a reader whose version read predates a concurrent
+                # ingest must not delete the fresher entry another query
+                # just rebuilt (put() replaces in place anyway)
+                if all(ev <= ov for ev, ov in zip(hit[0], versions)):
+                    del self._d[key]
+                return None
+            self._d.move_to_end(key)
+            return hit[1]
+
+    def put(self, key, versions: tuple, value, nbytes: int) -> None:
+        if nbytes > self.max_bytes:
+            return  # never pin more device memory than the whole budget
+        with self._lock:
+            self._d.pop(key, None)
+            used = sum(e[2] for e in self._d.values())
+            while self._d and (
+                len(self._d) >= self.max_entries
+                or used + nbytes > self.max_bytes
+            ):
+                used -= self._d.popitem(last=False)[1][2]
+            self._d[key] = (versions, value, nbytes)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._d)
 
 
 def stage_from_shard(

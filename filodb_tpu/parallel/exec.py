@@ -23,22 +23,53 @@ from . import mesh as M
 # device-resident WindowMatrices keyed by (grid bytes, query params); shared
 # across exec instances — repeated queries skip host precompute + uploads.
 # Guarded: the bounded QueryScheduler runs queries concurrently.
-_WM_CACHE: dict = {}
+from collections import OrderedDict
+
+
+class _WMEntry:
+    """Cache slot reserved BEFORE construction: the per-entry lock makes
+    exactly one thread build the device-resident matrices while concurrent
+    same-key misses wait for it — two racing builders would each upload the
+    full matrix set to HBM and the loser's copy would linger until GC."""
+
+    __slots__ = ("lock", "wm")
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.wm = None
+
+
+_WM_CACHE: "OrderedDict[object, _WMEntry]" = OrderedDict()
 _WM_LOCK = threading.Lock()
+_WM_CAPACITY = 16
 
 
 def _get_wm(wm_key, ctor):
     """Get-or-create a device-resident window-matrices object in the shared
-    bounded cache (one lock/eviction discipline for every mesh fast path)."""
+    bounded cache (one lock/eviction discipline for every mesh fast path).
+    LRU on hit; a hit on an entry still being built blocks on its lock until
+    the single builder finishes."""
     with _WM_LOCK:
-        wm = _WM_CACHE.get(wm_key)
-    if wm is None:
-        wm = ctor()
-        with _WM_LOCK:
-            while len(_WM_CACHE) >= 16:
-                _WM_CACHE.pop(next(iter(_WM_CACHE)), None)
-            _WM_CACHE[wm_key] = wm
-    return wm
+        entry = _WM_CACHE.get(wm_key)
+        if entry is not None:
+            _WM_CACHE.move_to_end(wm_key)
+        else:
+            entry = _WMEntry()
+            while len(_WM_CACHE) >= _WM_CAPACITY:
+                _WM_CACHE.popitem(last=False)
+            _WM_CACHE[wm_key] = entry
+    if entry.wm is None:
+        with entry.lock:
+            if entry.wm is None:
+                try:
+                    entry.wm = ctor()
+                except BaseException:
+                    # never leave a permanently-empty slot behind
+                    with _WM_LOCK:
+                        if _WM_CACHE.get(wm_key) is entry:
+                            del _WM_CACHE[wm_key]
+                    raise
+    return entry.wm
 
 
 def _harmonized_masked_grid(nb):
@@ -397,39 +428,11 @@ class MeshAggregateExec(ExecPlan):
 
 def _concat_staged(bs):
     """Row-concatenate staged blocks exactly (keeps corrected values, raw
-    sidecars, baselines — no restaging, no semantic drift)."""
-    from ..ops.staging import TS_PAD, StagedBlock, pad_series
-
-    assert len({b.base_ms for b in bs}) == 1
-    T = max(b.ts.shape[1] for b in bs)
-    S = sum(b.n_series for b in bs)
-    Sp = pad_series(max(S, 1))
-    ts = np.full((Sp, T), TS_PAD, np.int32)
-    vals = np.zeros((Sp, T), np.float32)
-    raw = np.zeros((Sp, T), np.float32)
-    lens = np.zeros(Sp, np.int32)
-    baseline = np.zeros(Sp, np.float32)
-    o = 0
-    for b in bs:
-        k, t = b.n_series, b.ts.shape[1]
-        ts[o : o + k, :t] = np.asarray(b.ts)[:k]
-        vals[o : o + k, :t] = np.asarray(b.vals)[:k]
-        src_raw = b.raw if b.raw is not None else b.vals
-        raw[o : o + k, :t] = np.asarray(src_raw)[:k]
-        lens[o : o + k] = np.asarray(b.lens)[:k]
-        baseline[o : o + k] = np.asarray(b.baseline)[:k]
-        o += k
-    reg = bs[0].regular_ts
-    regular = None
-    if reg is not None and all(
-        b.regular_ts is not None
-        and len(b.regular_ts) == len(reg)
-        and not (b.regular_ts != reg).any()
-        for b in bs[1:]
-    ):
-        regular = reg
-    return StagedBlock(ts, vals, lens, bs[0].base_ms, baseline, S, [],
-                       raw=raw, regular_ts=regular)
+    sidecars, baselines — no restaging, no semantic drift). Delegates to the
+    ONE concatenation (ops/staging.concat_blocks, shared with the fused
+    superblock path); the mesh stacking consumers index ``raw``
+    unconditionally, hence force_raw."""
+    return ST.concat_blocks(bs, force_raw=True)
 
 
 class Mesh2DAggregateExec(MeshAggregateExec):

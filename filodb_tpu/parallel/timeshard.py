@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..jax_compat import shard_map
 from ..ops import kernels as K
 from ..ops.staging import TS_PAD, StagedBlock
 
@@ -149,13 +150,13 @@ def timesharded_range(
         )
         return grid[None]
 
-    return jax.shard_map(
+    return shard_map(
         local,
         mesh=mesh,
         in_specs=(P(axis), P(axis), P(axis), P(axis),
                   P(axis), P(axis), P(axis), P()),
         out_specs=P(axis, None, None),
-        check_vma=False,
+        check=False,
     )(ts, vals, raw, lens, tail_ts, tail_vals, tail_raw, baseline)
 
 

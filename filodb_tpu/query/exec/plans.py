@@ -19,6 +19,7 @@ from ...ops import aggregations as AGG
 from ...ops import staging as ST
 from ..rangevector import Grid, QueryResult, QueryStats, RawGrid, ScalarResult
 from .transformers import (
+    _DROP_NAME_KEEP,
     AbsentFunctionMapper,
     PeriodicSamplesMapper,
     QueryError,
@@ -197,6 +198,18 @@ _DIFF_FNS = frozenset({"changes", "resets", "idelta"})
 #   quantile_over_time, ...) stages raw values
 
 
+def _stage_mode_for_function(func: str | None) -> str:
+    """Staging mode for a counter column given the range function that will
+    read it (default: raw selector read)."""
+    if func in _CORRECTED_FNS:
+        return "corrected"
+    if func in _SHIFTED_FNS:
+        return "shifted"
+    if func in _DIFF_FNS:
+        return "diff"
+    return "raw"
+
+
 def _counter_stage_mode(transformers) -> str:
     """Pick the staging mode for a counter column from the range function the
     leaf's PeriodicSamplesMapper will apply (default: raw selector read)."""
@@ -205,13 +218,84 @@ def _counter_stage_mode(transformers) -> str:
         if isinstance(tr, PeriodicSamplesMapper):
             func = tr.function
             break
-    if func in _CORRECTED_FNS:
-        return "corrected"
-    if func in _SHIFTED_FNS:
-        return "shifted"
-    if func in _DIFF_FNS:
-        return "diff"
-    return "raw"
+    return _stage_mode_for_function(func)
+
+
+def staged_block_for(ctx: "QueryContext", shard, ids, cache_key, col_name: str,
+                     start_ms: int, end_ms: int, stage_mode: str):
+    """Get a shard's HBM-resident staged block for a selection THROUGH the
+    shard's staging cache: serve a clean hit, incrementally repair a dirty
+    one (ST.append_to_block — live-edge panels pay only the tail), else
+    stage fresh and insert under the shard-version check. The ONE cached
+    staging path, shared by SelectRawPartitionsExec and the fused
+    single-dispatch aggregate's superblock builder so both have identical
+    repair/invalidation semantics.
+
+    Cache-key layout ``(filters, start_ms, end_ms, ...)`` is load-bearing:
+    the shard's selective invalidation (_invalidate_stage_range) reads
+    k[1]/k[2] as the staged range for its overlap check."""
+    with shard._lock:
+        hit = shard.stage_cache.get(cache_key)
+        version_at_stage = shard.version
+        claimed = False
+        if hit is not None and hit.repairing:
+            # another thread is mid-repair: serving its pre-repair block
+            # would miss acknowledged samples — restage fresh
+            hit = None
+        elif hit is not None and hit.dirty:
+            hit.dirty = False
+            hit.repairing = True
+            claimed = True
+    if hit is not None and claimed:
+        # in-range ingest landed since this block was staged: try the
+        # incremental append repair; on failure fall through to a fresh
+        # stage. The repair returns a NEW block (old one stays consistent
+        # for in-flight readers) swapped in atomically.
+        repaired = None
+        try:
+            repaired = ST.append_to_block(
+                shard, hit.block, ids, col_name, end_ms, stage_mode
+            )
+        finally:
+            with shard._lock:
+                hit.repairing = False
+                if repaired is not None:
+                    hit.block = repaired
+                elif shard.stage_cache.get(cache_key) is hit:
+                    # failed (or raised): never leave a stale entry
+                    del shard.stage_cache[cache_key]
+        if repaired is None:
+            hit = None
+    if hit is not None:
+        return hit.block
+    block = ST.stage_from_shard(
+        shard, ids, col_name, start_ms, end_ms, mode=stage_mode,
+    )
+    nbytes = int(
+        block.ts.nbytes
+        + np.asarray(block.vals).nbytes
+        + (np.asarray(block.raw).nbytes if block.raw is not None else 0)
+    )
+    ctx.stats.bump(bytes_staged=nbytes)
+    block.to_device(keep_host=True)  # mirrors enable append repair
+    # byte-budgeted eviction, oldest entry first (the staging analog of
+    # BlockManager reclaim under memory pressure). All cache mutations run
+    # under the shard lock (the shard's selective invalidation iterates the
+    # dict under it), and a block staged concurrently with ANY ingest is
+    # used for this query but never cached — an in-range sample that landed
+    # mid-stage already ran its invalidation, which could not see this
+    # not-yet-inserted entry.
+    with shard._lock:
+        if shard.version == version_at_stage:
+            from ...memstore.shard import StageEntry
+
+            budget = getattr(shard.config, "stage_cache_bytes", 2 << 30)
+            used = sum(e.nbytes for e in shard.stage_cache.values())
+            while shard.stage_cache and used + nbytes > budget:
+                oldest = next(iter(shard.stage_cache))
+                used -= shard.stage_cache.pop(oldest).nbytes
+            shard.stage_cache[cache_key] = StageEntry(block, nbytes)
+    return block
 
 
 class SelectRawPartitionsExec(ExecPlan):
@@ -288,80 +372,15 @@ class SelectRawPartitionsExec(ExecPlan):
             # (the north-star "decoded chunk windows staged to HBM"; the
             # shard invalidates overlapping entries selectively on ingest —
             # shard._invalidate_stage_range — so live scrapes beyond a
-            # historical panel's range never force a re-stage). NOTE: key
-            # layout (filters, start_ms, end_ms, ...) is load-bearing for
-            # that overlap check.
+            # historical panel's range never force a re-stage).
             cache_key = (
                 self.filters, self.start_ms, self.end_ms, col_name, schema_name,
                 stage_mode,
             )
-            with shard._lock:
-                hit = shard.stage_cache.get(cache_key)
-                version_at_stage = shard.version
-                claimed = False
-                if hit is not None and hit.repairing:
-                    # another thread is mid-repair: serving its pre-repair
-                    # block would miss acknowledged samples — restage fresh
-                    hit = None
-                elif hit is not None and hit.dirty:
-                    hit.dirty = False
-                    hit.repairing = True
-                    claimed = True
-            if hit is not None and claimed:
-                # in-range ingest landed since this block was staged: try
-                # the incremental append repair (live-edge panels pay the
-                # tail, not a full re-stage); on failure fall through to a
-                # fresh stage. The repair returns a NEW block (old one stays
-                # consistent for in-flight readers) swapped in atomically.
-                repaired = None
-                try:
-                    repaired = ST.append_to_block(
-                        shard, hit.block, ids, col_name, self.end_ms, stage_mode
-                    )
-                finally:
-                    with shard._lock:
-                        hit.repairing = False
-                        if repaired is not None:
-                            hit.block = repaired
-                        elif shard.stage_cache.get(cache_key) is hit:
-                            # failed (or raised): never leave a stale entry
-                            del shard.stage_cache[cache_key]
-                if repaired is None:
-                    hit = None
-            if hit is not None:
-                block = hit.block
-            else:
-                block = ST.stage_from_shard(
-                    shard, ids, col_name, self.start_ms, self.end_ms,
-                    mode=stage_mode,
-                )
-                nbytes = int(
-                    block.ts.nbytes
-                    + np.asarray(block.vals).nbytes
-                    + (np.asarray(block.raw).nbytes if block.raw is not None else 0)
-                )
-                ctx.stats.bump(bytes_staged=nbytes)
-                block.to_device(keep_host=True)  # mirrors enable append repair
-                # byte-budgeted eviction, oldest entry first (the staging
-                # analog of BlockManager reclaim under memory pressure).
-                # All cache mutations run under the shard lock (the shard's
-                # selective invalidation iterates the dict under it), and a
-                # block staged concurrently with ANY ingest is used for this
-                # query but never cached — an in-range sample that landed
-                # mid-stage already ran its invalidation, which could not
-                # see this not-yet-inserted entry.
-                with shard._lock:
-                    if shard.version == version_at_stage:
-                        from ...memstore.shard import StageEntry
-
-                        budget = getattr(shard.config, "stage_cache_bytes", 2 << 30)
-                        used = sum(
-                            e.nbytes for e in shard.stage_cache.values()
-                        )
-                        while shard.stage_cache and used + nbytes > budget:
-                            oldest = next(iter(shard.stage_cache))
-                            used -= shard.stage_cache.pop(oldest).nbytes
-                        shard.stage_cache[cache_key] = StageEntry(block, nbytes)
+            block = staged_block_for(
+                ctx, shard, ids, cache_key, col_name, self.start_ms,
+                self.end_ms, stage_mode,
+            )
             ctx.stats.bump(
                 series_scanned=len(ids),
                 samples_scanned=int(np.asarray(block.lens).sum()),
@@ -925,6 +944,287 @@ class ReduceAggregateExec(NonLeafExecPlan):
                 partials.append(p)
         key_to, meta = _merge_partials(self.op, partials)
         return _present(self.op, key_to, meta)
+
+
+# aggregation ops the fused single-dispatch path computes exactly as one
+# on-device segment reduce (ops/aggregations.fused_range_aggregate)
+FUSED_AGG_OPS = frozenset({"sum", "count", "avg", "min", "max"})
+
+# range functions the fused path supports: everything the shape-static range
+# kernels compute on device, minus host-path timestamp, per-window sorts,
+# absent_over_time (needs the presence reduce, not a value aggregate), and
+# arg-taking functions (the planner also rejects function_args)
+FUSED_FUNCS = frozenset({
+    "rate", "increase", "delta", "irate", "idelta",
+    "sum_over_time", "avg_over_time", "count_over_time", "min_over_time",
+    "max_over_time", "last", "last_over_time", "first_over_time",
+    "present_over_time", "stddev_over_time", "stdvar_over_time", "z_score",
+    "changes", "resets", "deriv",
+})
+
+
+class FusedAggregateExec(ExecPlan):
+    """Single-dispatch cross-shard aggregate (the tentpole of the
+    superblock path): ``op by (...) (func(selector[w]))`` over local shards
+    executes as ONE compiled program over ONE device-resident superblock —
+    O(1) kernel launches instead of O(shards) stage->kernel->partial-merge
+    round trips, and only the [G, J] group partials ever reach the host.
+
+    The superblock (ops/staging.concat_blocks) is cached on the memstore
+    keyed by the member shards' version vector (ops/staging.SuperblockCache);
+    per-shard blocks flow through the SAME cached staging path as
+    SelectRawPartitionsExec (staged_block_for), so dirty shards repair
+    incrementally via append_to_block before re-concatenation. Label
+    grouping memoizes on the superblock (ops/aggregations.group_ids_memo).
+
+    ``fallback`` is the reference tree
+    (ReduceAggregateExec -> N x SelectRawPartitionsExec); execution falls
+    back to it — annotating the span with the reason — for partial-results
+    mode, fault-injection dispatchers, histogram schemas, mixed schemas, or
+    anything else the fused kernel doesn't model. It is passed as a
+    zero-arg factory and materialized lazily on first use: the happy path
+    must not pay plan-time construction of O(shards) leaves it discards
+    (at 128 shards that is exactly the linear cost this node removes)."""
+
+    def __init__(self, shard_nums, filters, raw_start_ms: int, raw_end_ms: int,
+                 column, op: str, by, without, function,
+                 start_ms: int, end_ms: int, step_ms: int, window_ms: int,
+                 offset_ms: int, fallback):
+        super().__init__()
+        self.shard_nums = list(shard_nums)
+        self.filters = tuple(filters)
+        self.raw_start_ms = raw_start_ms
+        self.raw_end_ms = raw_end_ms
+        self.column = column
+        self.op = op
+        self.by = by
+        self.without = without
+        self.function = function  # None = plain selector (lookback last)
+        self.start_ms = start_ms
+        self.end_ms = end_ms
+        self.step_ms = step_ms
+        self.window_ms = window_ms
+        self.offset_ms = offset_ms
+        self._fallback_factory = fallback
+        self._fallback: ExecPlan | None = None
+
+    @property
+    def fallback(self) -> ExecPlan:
+        if self._fallback is None:
+            self._fallback = self._fallback_factory()
+        return self._fallback
+
+    def args_str(self) -> str:
+        fs = ",".join(f"{f.column}{f.op}{f.value}" for f in self.filters)
+        return (
+            f"op={self.op} fn={self.function} by={self.by} "
+            f"without={self.without} shards={self.shard_nums} filters=[{fs}]"
+        )
+
+    def _fall(self, ctx: QueryContext, reason: str) -> QueryResult:
+        from ...metrics import current_span
+
+        s = current_span()
+        if s is not None:
+            s.tags["fused_fallback"] = reason
+        return self.fallback.execute(ctx)
+
+    def num_steps(self) -> int:
+        return int((self.end_ms - self.start_ms) // self.step_ms) + 1
+
+    def _serve_hit(self, ctx: QueryContext, hit):
+        """Limit + stats enforcement for a cached superblock: limits are
+        PER REQUEST (execute_plan narrows them), so a cache hit must never
+        serve a query whose limits the build path would have rejected."""
+        if hit[5] > ctx.max_series:
+            raise QueryError(
+                f"query selects {hit[5]} series > limit {ctx.max_series}"
+            )
+        ctx.stats.bump(series_scanned=hit[0].n_series, samples_scanned=hit[4])
+        if ctx.stats.samples_scanned > ctx.max_samples:
+            raise QueryError(
+                f"query would scan {ctx.stats.samples_scanned} samples > "
+                f"limit {ctx.max_samples}"
+            )
+        return hit
+
+    def _superblock(self, ctx: QueryContext, stage_mode: str):
+        """(block, labels, is_counter, is_delta, samples, max_shard_series)
+        from the shard-version-keyed superblock cache, rebuilding through
+        the per-shard cached staging path on miss. Returns a fallback-reason
+        string instead when the selection needs the reference tree."""
+        cache = getattr(ctx.memstore, "_superblock_cache", None)
+        if cache is None:
+            cache = ST.SuperblockCache()
+            ctx.memstore._superblock_cache = cache
+        # resolved-mode keying: for non-counter columns every function
+        # stages "raw", so keying purely on the function-derived mode would
+        # cache byte-identical superblocks under distinct keys. The schema
+        # hint learned on first build collapses them; actual staging modes
+        # always re-derive from the live schema.
+        hints = getattr(ctx.memstore, "_fused_mode_hints", None)
+        if hints is None:
+            hints = {}
+            ctx.memstore._fused_mode_hints = hints
+        hint_key = (ctx.dataset, self.filters, self.column)
+        hint = hints.get(hint_key)
+        key_mode = stage_mode
+        if hint is not None and not (hint[0] and not hint[1]):
+            key_mode = "raw"  # known gauge / delta-temporality column
+        sb_key = (
+            ctx.dataset, tuple(self.shard_nums), self.filters,
+            self.raw_start_ms, self.raw_end_ms, self.column, key_mode,
+        )
+        versions = tuple(
+            ctx.memstore.shard(ctx.dataset, s).version for s in self.shard_nums
+        )
+        hit = cache.get(sb_key, versions)
+        if hit is not None:
+            return self._serve_hit(ctx, hit)
+        # single-flight per key: N identical cold queries must not each
+        # concatenate + upload the full superblock (the same duplicate-
+        # construction class as the _get_wm / window_matrices races)
+        with cache.build_lock(sb_key):
+            versions = tuple(
+                ctx.memstore.shard(ctx.dataset, s).version
+                for s in self.shard_nums
+            )
+            hit = cache.get(sb_key, versions)
+            if hit is not None:
+                return self._serve_hit(ctx, hit)
+            return self._build_superblock(
+                ctx, stage_mode, cache, sb_key, versions, hints, hint_key
+            )
+
+    def _build_superblock(self, ctx: QueryContext, stage_mode: str, cache,
+                          sb_key, versions, hints, hint_key):
+        blocks, labels = [], []
+        schema_name = None
+        is_counter = is_delta = False
+        total = max_shard_series = 0
+        for s in self.shard_nums:
+            ctx.check_deadline()
+            shard = ctx.memstore.shard(ctx.dataset, s)
+            pids = shard.lookup_partitions(
+                self.filters, self.raw_start_ms, self.raw_end_ms
+            )
+            if not len(pids):
+                rewritten, _c, _le = _histogram_suffix_rewrite(self.filters)
+                if rewritten is not None and len(shard.lookup_partitions(
+                        rewritten, self.raw_start_ms, self.raw_end_ms)):
+                    return "histogram_suffix"
+                continue
+            if len(pids) > ctx.max_series:
+                # same per-shard limit semantics as SelectRawPartitionsExec
+                raise QueryError(
+                    f"query selects {len(pids)} series > limit {ctx.max_series}"
+                )
+            total += len(pids)
+            max_shard_series = max(max_shard_series, len(pids))
+            if shard.odp_store is not None:
+                shard.odp_page_in(pids, self.raw_start_ms, self.raw_end_ms)
+            parts = [shard.partition(int(p)) for p in pids]
+            names = {p.schema.name for p in parts}
+            if len(names) > 1 or (schema_name is not None
+                                  and names != {schema_name}):
+                return "mixed_schemas"
+            schema_name = parts[0].schema.name
+            schema = parts[0].schema
+            col_name = self.column or schema.value_column
+            try:
+                col = schema.column(col_name)
+            except KeyError:
+                col_name = schema.value_column
+                col = schema.column(col_name)
+            if col.ctype == ColumnType.HISTOGRAM:
+                return "histogram"
+            is_counter = col.is_counter
+            is_delta = col.is_delta
+            mode = (
+                stage_mode if is_counter and not is_delta else "raw"
+            )
+            cache_key = (
+                self.filters, self.raw_start_ms, self.raw_end_ms, col_name,
+                schema_name, mode,
+            )
+            block = staged_block_for(
+                ctx, shard, pids, cache_key, col_name, self.raw_start_ms,
+                self.raw_end_ms, mode,
+            )
+            if np.asarray(block.vals).ndim != 2:
+                return "histogram"
+            blocks.append(block)
+            labels.extend(dict(p.tags) for p in parts)
+        if schema_name is not None:
+            if len(hints) >= 1024:
+                hints.clear()  # bounded: hints are one dict lookup to relearn
+            hints[hint_key] = (is_counter, is_delta)
+        if not blocks:
+            return None  # empty selection: empty result, not a fallback
+        samples = int(sum(int(np.asarray(b.lens).sum()) for b in blocks))
+        ctx.stats.bump(series_scanned=total, samples_scanned=samples)
+        if ctx.stats.samples_scanned > ctx.max_samples:
+            raise QueryError(
+                f"query would scan {ctx.stats.samples_scanned} samples > "
+                f"limit {ctx.max_samples}"
+            )
+        super_block = ST.concat_blocks(blocks).to_device()
+        nbytes = int(
+            np.asarray(super_block.ts).nbytes
+            + np.asarray(super_block.vals).nbytes
+            + (np.asarray(super_block.raw).nbytes
+               if super_block.raw is not None else 0)
+        )
+        value = (super_block, labels, is_counter, is_delta, samples,
+                 max_shard_series)
+        # versions re-read AFTER staging: an ingest that landed mid-build
+        # makes the entry unservable for the next query (version mismatch),
+        # so only cache when nothing moved
+        versions_now = tuple(
+            ctx.memstore.shard(ctx.dataset, s).version for s in self.shard_nums
+        )
+        if versions_now == versions:
+            cache.put(sb_key, versions, value, nbytes)
+        return value
+
+    def do_execute(self, ctx: QueryContext) -> QueryResult:
+        from ...metrics import span
+        from ...ops.kernels import RangeParams, pad_steps
+
+        if getattr(ctx, "allow_partial_results", False):
+            # the fused program is all-or-nothing; partial-results queries
+            # need the merge tree's lost-child tolerance
+            return self._fall(ctx, "partial_results")
+        if getattr(ctx, "dispatcher", None) is not None:
+            # a child-dispatch hook (fault injection / chaos harness) only
+            # fires on per-child dispatch — run the tree it can intercept
+            return self._fall(ctx, "dispatcher")
+        func = self.function or "last"
+        stage_mode = _stage_mode_for_function(self.function)
+        with span("fused:stage"):
+            got = self._superblock(ctx, stage_mode)
+        if isinstance(got, str):
+            return self._fall(ctx, got)
+        if got is None:
+            return QueryResult()
+        block, labels, is_counter, is_delta, _samples, _max_shard = got
+        strip = self.function is not None and self.function not in _DROP_NAME_KEEP
+        gids_dev, G, group_labels = AGG.group_ids_memo(
+            block, labels, self.by, self.without, strip_metric=strip
+        )
+        nsteps = self.num_steps()
+        params = RangeParams(
+            self.start_ms - self.offset_ms, self.step_ms, nsteps,
+            self.window_ms,
+        )
+        with span(f"fused:dispatch:{func}"):
+            out = AGG.fused_range_aggregate(
+                func, self.op, block, gids_dev, G, params,
+                is_counter=is_counter, is_delta=is_delta,
+            )
+        return QueryResult(
+            grids=[Grid(group_labels, self.start_ms, self.step_ms, nsteps, out)]
+        )
 
 
 class PartialReduceExec(NonLeafExecPlan):

@@ -41,6 +41,11 @@ class FiloServer:
 
         cfg = load_config(overrides=config or {})
         self.config = cfg
+        # before any jit dispatch: compiled kernels persist across restarts
+        # (config "compile_cache_dir"; doc/perf.md)
+        from .ops.compile_cache import enable_from_config
+
+        enable_from_config(cfg)
         self.dataset = cfg["dataset"]
         self.n_shards = int(cfg["shards"])
         self.spread = int(cfg["spread"])
@@ -173,6 +178,7 @@ class FiloServer:
             scheduler=self.scheduler,
             num_shards=self.n_shards,
             allow_partial_results=bool(qcfg.get("allow_partial_results", False)),
+            fused_aggregate=bool(qcfg.get("fused_aggregate", True)),
             retry_policy=self.retry_policy,
             breakers=self.breakers,
             slow_query_threshold_s=float(slow_thr) if slow_thr is not None else None,

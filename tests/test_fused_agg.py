@@ -1,0 +1,341 @@
+"""Fused single-dispatch cross-shard aggregation (doc/perf.md).
+
+Parity contract: FusedAggregateExec must agree with the reference
+``ReduceAggregateExec -> N x SelectRawPartitionsExec`` tree across
+counters, gauges, jittered grids and the partial-results fallback — NaN
+(absence) masks bit-identical, values within float32 accumulation-order
+tolerance (order-independent ops min/max/count compare exactly).
+
+Plus the O(1) dispatch guarantee: a warm ``sum(rate())`` over 8 shards
+issues exactly ONE kernel dispatch (asserted via the JIT dispatch
+counters), and the superblock/window-matrix caches behave (shard-version
+invalidation, single construction under race, LRU on hit).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from filodb_tpu.coordinator.planner import PlannerParams, QueryEngine
+from filodb_tpu.core.schemas import Dataset
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.testkit import counter_batch, machine_metrics
+
+pytestmark = pytest.mark.perf
+
+BASE = 1_600_000_000_000
+N_SHARDS = 8
+
+
+@pytest.fixture(scope="module")
+def store():
+    ms = TimeSeriesMemStore()
+    ms.setup(Dataset("ds"), list(range(N_SHARDS)))
+    ms.ingest_routed(
+        "ds", counter_batch(n_series=48, n_samples=300, start_ms=BASE), spread=3
+    )
+    ms.ingest_routed(
+        "ds",
+        counter_batch(n_series=24, n_samples=300, start_ms=BASE,
+                      metric="http_errors_total", resets=True, seed=11),
+        spread=3,
+    )
+    ms.ingest_routed(
+        "ds", machine_metrics(n_series=48, n_samples=300, start_ms=BASE), spread=3
+    )
+    return ms
+
+
+@pytest.fixture(scope="module")
+def engines(store):
+    fused = QueryEngine(store, "ds")
+    ref = QueryEngine(store, "ds", PlannerParams(fused_aggregate=False))
+    return fused, ref
+
+
+START = (BASE + 600_000) / 1000
+END = START + 1200
+STEP = 60
+
+
+def _rows(res):
+    out = {}
+    for g in res.grids:
+        for lbls, vals in zip(g.labels, g.values_np()):
+            out[tuple(sorted(lbls.items()))] = np.asarray(vals)
+    return out
+
+
+def assert_parity(fused, ref, q, start=START, end=END, step=STEP,
+                  exact=None, **kw):
+    """exact=None auto-detects: the count aggregate is bit-identical by
+    construction (it counts non-NaN series, and the NaN masks are asserted
+    equal); everything else allows float32 accumulation-order ulps between
+    the single fused program and the per-shard kernel + partial-merge
+    reference (min/max are order-independent as AGGREGATES, but their
+    per-series INPUTS may differ in ulp across kernel variants)."""
+    rf = fused.query_range(q, start, end, step, **kw)
+    rr = ref.query_range(q, start, end, step, **kw)
+    a, b = _rows(rf), _rows(rr)
+    assert a.keys() == b.keys(), (q, sorted(a), sorted(b))
+    if exact is None:
+        exact = q.startswith("count(") or q.startswith("count by")
+    for k in a:
+        na, nb = np.isnan(a[k]), np.isnan(b[k])
+        assert (na == nb).all(), (q, k, "NaN masks differ")
+        if exact:
+            assert (a[k][~na] == b[k][~nb]).all(), (q, k)
+        else:
+            np.testing.assert_allclose(
+                a[k][~na], b[k][~nb], rtol=2e-5, atol=1e-6, err_msg=f"{q} {k}"
+            )
+    return rf, rr
+
+
+def _plan_root(engine, q, start=START, end=END, step=STEP):
+    from filodb_tpu.query.promql import query_range_to_logical_plan
+
+    plan = query_range_to_logical_plan(q, start, end, step)
+    return engine.planner.materialize(plan)
+
+
+# -- parity ------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("q", [
+    "sum(rate(http_requests_total[5m]))",
+    "sum by (instance) (rate(http_requests_total[5m]))",
+    "avg(increase(http_requests_total[5m]))",
+    "max(irate(http_requests_total[5m]))",
+    "count by (job) (delta(http_requests_total[5m]))",
+    "sum(rate(http_errors_total[5m]))",  # counters WITH resets
+    "min(changes(http_requests_total[5m]))",
+])
+def test_fused_parity_counters(engines, q):
+    assert_parity(*engines, q)
+
+
+@pytest.mark.parametrize("q", [
+    "sum(avg_over_time(heap_usage0[3m]))",
+    "avg by (instance) (max_over_time(heap_usage0[2m]))",
+    "min(min_over_time(heap_usage0[3m]))",
+    "max(stddev_over_time(heap_usage0[3m]))",
+    "count(last_over_time(heap_usage0[3m]))",
+    "sum(heap_usage0)",       # plain selector (lookback last)
+    "sum by (job) (heap_usage0)",
+])
+def test_fused_parity_gauges(engines, q):
+    assert_parity(*engines, q)
+
+
+def test_fused_parity_offset(engines):
+    assert_parity(*engines, "sum(rate(http_requests_total[5m] offset 5m))")
+
+
+def test_fused_parity_jittered():
+    """Per-series scrape jitter: per-shard blocks stage near-regular, the
+    superblock runs the general fused kernel; the reference tree runs the
+    per-shard jittered MXU path — results must still agree."""
+    rng = np.random.default_rng(3)
+    ms = TimeSeriesMemStore()
+    ms.setup(Dataset("ds"), list(range(4)))
+    from filodb_tpu.core.records import SeriesBatch
+    from filodb_tpu.core.schemas import METRIC_TAG, PROM_COUNTER, shard_for
+
+    n, m = 24, 240
+    base_ts = BASE + np.arange(m, dtype=np.int64) * 10_000
+    for i in range(n):
+        tags = {METRIC_TAG: "jit_total", "_ws_": "w", "_ns_": "n",
+                "instance": f"h{i}"}
+        dev = rng.integers(-400, 400, size=m)
+        vals = np.cumsum(rng.uniform(0, 5, size=m)) + 1e6
+        ms.shard("ds", shard_for(tags, spread=2, num_shards=4)).ingest_series(
+            SeriesBatch(PROM_COUNTER, tags, base_ts + dev, {"count": vals})
+        )
+    fused = QueryEngine(ms, "ds")
+    ref = QueryEngine(ms, "ds", PlannerParams(fused_aggregate=False))
+    start = (BASE + 400_000) / 1000
+    assert_parity(fused, ref, "sum(rate(jit_total[5m]))", start, start + 900, 60)
+    assert_parity(fused, ref, "max(rate(jit_total[5m]))", start, start + 900, 60)
+
+
+def test_fused_partial_results_falls_back(engines):
+    """allow_partial_results needs the merge tree's lost-child tolerance:
+    the fused node must delegate to its reference fallback subtree (visible
+    in the trace) and still return identical results."""
+    fused, ref = engines
+    q = "sum(rate(http_requests_total[5m]))"
+    rf, _ = assert_parity(fused, ref, q, allow_partial_results=True)
+
+    def names(sp, acc):
+        acc.add(sp.name)
+        for c in sp.children:
+            names(c, acc)
+        return acc
+
+    seen = names(rf.trace, set())
+    assert "FusedAggregateExec" in seen
+    assert "ReduceAggregateExec" in seen  # the fallback subtree executed
+
+
+def test_fused_plan_selection(engines):
+    fused, ref = engines
+    q = "sum(rate(http_requests_total[5m]))"
+    assert type(_plan_root(fused, q)).__name__ == "FusedAggregateExec"
+    assert type(_plan_root(ref, q)).__name__ == "ReduceAggregateExec"
+    # non-fusable shapes keep the reference tree on the fused engine
+    for q in ("stddev(rate(http_requests_total[5m]))",
+              "topk(3, rate(http_requests_total[5m]))",
+              "sum(quantile_over_time(0.9, heap_usage0[3m]))"):
+        assert type(_plan_root(fused, q)).__name__ != "FusedAggregateExec", q
+
+
+def test_fused_sees_new_ingest(engines):
+    """The superblock cache is shard-version-keyed: ingest invalidates it
+    and the next query reflects the new samples."""
+    fused, ref = engines
+    ms = fused.memstore
+    q = "sum(count_over_time(heap_usage0[10m]))"
+    # range reaching past the staged head so appended samples land IN range
+    end = (BASE + 330 * 10_000) / 1000
+    before = _rows(fused.query_range(q, START, end, STEP))
+    ms.ingest_routed(
+        "ds",
+        machine_metrics(n_series=48, n_samples=330, start_ms=BASE, seed=42),
+        spread=3,
+    )
+    after = _rows(fused.query_range(q, START, end, STEP))
+    assert any(
+        np.nansum(after[k]) > np.nansum(before[k]) for k in before
+    ), "new in-range samples must show up after ingest"
+    assert_parity(fused, ref, q, START, end)
+
+
+def test_fused_cached_superblock_respects_limits(engines):
+    """Per-request limits (execute_plan narrows them) must be enforced on
+    the superblock-cache HIT path too, not only on the build path."""
+    from filodb_tpu.query.exec.transformers import QueryError
+    from filodb_tpu.query.promql import query_range_to_logical_plan
+
+    fused, _ = engines
+    q = "sum(rate(http_requests_total[5m]))"
+    fused.query_range(q, START, END, STEP)  # build + cache the superblock
+    plan = query_range_to_logical_plan(q, START, END, STEP)
+    with pytest.raises(QueryError, match="limit"):
+        fused.execute_plan(plan, max_series=1)
+
+
+# -- O(1) dispatch -----------------------------------------------------------
+
+
+def _dispatch_total() -> int:
+    from filodb_tpu.metrics import REGISTRY
+
+    total = 0
+    with REGISTRY._lock:
+        for (name, _lbls), m in REGISTRY._metrics.items():
+            if name == "filodb_kernel_dispatch_seconds":
+                total += m.total
+    return total
+
+
+def test_warm_sum_rate_is_single_dispatch(engines):
+    fused, _ = engines
+    q = "sum(rate(http_requests_total[5m]))"
+    for _ in range(2):  # stage + compile + fill every cache
+        fused.query_range(q, START, END, STEP)
+    before = _dispatch_total()
+    fused.query_range(q, START, END, STEP)
+    assert _dispatch_total() - before == 1, (
+        "warm fused sum(rate) must issue exactly ONE kernel dispatch"
+    )
+
+
+def test_reference_tree_dispatches_per_shard(engines):
+    """Sanity for the counter itself: the reference tree dispatches O(shards)
+    (range kernel + segment reduce per non-empty shard)."""
+    _, ref = engines
+    q = "sum(rate(http_requests_total[5m]))"
+    for _ in range(2):
+        ref.query_range(q, START, END, STEP)
+    before = _dispatch_total()
+    ref.query_range(q, START, END, STEP)
+    assert _dispatch_total() - before > 1
+
+
+# -- cache mechanics ---------------------------------------------------------
+
+
+def test_superblock_cache_version_keying():
+    from filodb_tpu.ops.staging import SuperblockCache
+
+    c = SuperblockCache(max_entries=2)
+    c.put("k", (1, 1), "v", 10)
+    assert c.get("k", (1, 1)) == "v"
+    assert c.get("k", (1, 2)) is None  # version moved: stale entry dropped
+    assert c.get("k", (1, 1)) is None
+
+
+def test_superblock_cache_lru_on_hit():
+    from filodb_tpu.ops.staging import SuperblockCache
+
+    c = SuperblockCache(max_entries=2)
+    c.put("a", (1,), "va", 1)
+    c.put("b", (1,), "vb", 1)
+    assert c.get("a", (1,)) == "va"  # refresh a
+    c.put("c", (1,), "vc", 1)       # evicts b (LRU), not a
+    assert c.get("a", (1,)) == "va"
+    assert c.get("b", (1,)) is None
+
+
+def test_get_wm_single_construction_under_race():
+    """Two concurrent misses on one key must build ONCE (the loser used to
+    build a duplicate device-resident matrix set and leak it)."""
+    from filodb_tpu.parallel import exec as PX
+
+    built = []
+    gate = threading.Barrier(4)
+
+    def ctor():
+        built.append(1)
+        import time
+
+        time.sleep(0.05)  # hold the build window open for the racers
+        return object()
+
+    results = []
+
+    def worker():
+        gate.wait()
+        results.append(PX._get_wm(("race-key",), ctor))
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(built) == 1
+    assert all(r is results[0] for r in results)
+    with PX._WM_LOCK:
+        PX._WM_CACHE.pop(("race-key",), None)
+
+
+def test_get_wm_lru_on_hit():
+    from filodb_tpu.parallel import exec as PX
+
+    with PX._WM_LOCK:
+        saved = dict(PX._WM_CACHE)
+        PX._WM_CACHE.clear()
+    try:
+        for i in range(PX._WM_CAPACITY):
+            PX._get_wm(("lru", i), lambda i=i: i)
+        PX._get_wm(("lru", 0), lambda: "rebuilt?")  # hit refreshes slot 0
+        PX._get_wm(("lru", "new"), lambda: "new")    # evicts ("lru", 1)
+        with PX._WM_LOCK:
+            assert ("lru", 0) in PX._WM_CACHE
+            assert ("lru", 1) not in PX._WM_CACHE
+    finally:
+        with PX._WM_LOCK:
+            PX._WM_CACHE.clear()
+            PX._WM_CACHE.update(saved)

@@ -28,6 +28,25 @@ def normalize(t):
 
 
 def test_golden_sum_rate(planner):
+    # default engine: single-dispatch fused aggregate (doc/perf.md)
+    got = tree(planner, "sum(rate(http_requests_total[5m]))")
+    want = (
+        "E~FusedAggregateExec(op=sum fn=rate by=None without=None "
+        "shards=[0, 1] filters=[_metric_=http_requests_total])"
+    )
+    assert normalize(got) == normalize(want)
+
+
+def test_golden_sum_rate_reference_tree():
+    # fused disabled: the reference scatter/partial-merge tree (also the
+    # shape FusedAggregateExec holds as its runtime fallback)
+    from filodb_tpu.coordinator.planner import PlannerParams
+
+    ms = TimeSeriesMemStore()
+    ms.setup(Dataset("prometheus"), [0, 1])
+    planner = SingleClusterPlanner(
+        ms, "prometheus", params=PlannerParams(fused_aggregate=False)
+    )
     got = tree(planner, "sum(rate(http_requests_total[5m]))")
     want = """\
 E~ReduceAggregateExec(op=sum by=None without=None)

@@ -166,7 +166,13 @@ class TestTracing:
         with span("query") as root:
             engine.query_range("sum(heap_usage0)", (BASE + 600_000) / 1000, (BASE + 900_000) / 1000, 60)
         names = [c.name for c in root.children]
-        assert "ReduceAggregateExec" in names
+        # default engine plans the aggregate as the fused single-dispatch
+        # node; its stage/dispatch phases are child spans
+        assert "FusedAggregateExec" in names
+        fused = root.children[names.index("FusedAggregateExec")]
+        child_names = {c.name for c in fused.children}
+        assert "fused:stage" in child_names
+        assert any(n.startswith("fused:dispatch") for n in child_names)
 
 
 class TestRegistryEscaping:
@@ -375,7 +381,7 @@ class TestSlowQueryLog:
         assert e["promql"] == "sum(heap_usage0)"
         assert e["duration_s"] > 0
         assert e["stats"]["series_scanned"] == 8
-        assert find_span(e["trace"], "ReduceAggregateExec") is not None
+        assert find_span(e["trace"], "FusedAggregateExec") is not None
 
     def test_fast_queries_not_recorded(self):
         SLOW_QUERY_LOG.clear()
@@ -429,15 +435,22 @@ class TestKernelInstrumentation:
         engine.query_range("sum(rate(http_requests_total[5m]))",
                            (BASE + 600_000) / 1000, (BASE + 900_000) / 1000, 60)
         text = REGISTRY.expose()
-        assert 'filodb_kernel_dispatch_seconds_bucket{kernel="rate"' in text
-        assert 'filodb_kernel_dispatch_seconds_count{kernel="segment_sum"}' in text
-        assert 'filodb_jit_cache_total{kernel="rate"' in text
+        # the fused path records ONE dispatch for the whole query
+        assert 'filodb_kernel_dispatch_seconds_bucket{kernel="fused_sum_rate"' in text
+        assert 'filodb_jit_cache_total{kernel="fused_sum_rate"' in text
         # a repeat of the same shape must record HITS, not new misses
-        before = REGISTRY.counter("filodb_jit_cache", kernel="rate", outcome="hit").value
+        before = REGISTRY.counter("filodb_jit_cache", kernel="fused_sum_rate", outcome="hit").value
         engine.query_range("sum(rate(http_requests_total[5m]))",
                            (BASE + 630_000) / 1000, (BASE + 930_000) / 1000, 60)
-        after = REGISTRY.counter("filodb_jit_cache", kernel="rate", outcome="hit").value
+        after = REGISTRY.counter("filodb_jit_cache", kernel="fused_sum_rate", outcome="hit").value
         assert after > before
+        # the reference tree still records per-kernel dispatches
+        ref = QueryEngine(ms, "prometheus", PlannerParams(fused_aggregate=False))
+        ref.query_range("sum(rate(http_requests_total[5m]))",
+                        (BASE + 600_000) / 1000, (BASE + 900_000) / 1000, 60)
+        text = REGISTRY.expose()
+        assert 'filodb_kernel_dispatch_seconds_bucket{kernel="rate"' in text
+        assert 'filodb_kernel_dispatch_seconds_count{kernel="segment_sum"}' in text
 
 
 class TestProfiler:

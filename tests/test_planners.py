@@ -193,8 +193,9 @@ class TestShardKeyRegex:
         exec_plan = skr.materialize(plan)
         tree = exec_plan.print_tree()
         assert "AggregatePresentExec" in tree
-        # two concrete _ns_ values -> two subtrees
-        assert tree.count("ReduceAggregateExec") == 2
+        # two concrete _ns_ values -> two subtrees (fused single-dispatch
+        # aggregates on the default engine)
+        assert tree.count("FusedAggregateExec") == 2
 
     def test_no_regex_passthrough(self):
         ms = make_ms()

@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""CPU bench smoke gate (make bench-smoke): a 2k-series, 3-run bench.py
+worker on the CPU backend must not regress p50 by more than 25% against the
+checked-in floor (benchmarks/bench_smoke_floor.json), and must keep
+match=True against the numpy oracle.
+
+This is the perf analog of the golden plan tests: small enough to run in CI
+(~10 s total), big enough that losing the fused single-dispatch path, the
+superblock cache, or the staging cache shows up as a multiple, not a blip.
+Update the floor deliberately — in the same PR as a justified perf change —
+never to paper over a regression.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+FLOOR_FILE = os.path.join(REPO, "benchmarks", "bench_smoke_floor.json")
+REGRESSION_TOLERANCE = 0.25  # fail beyond floor * (1 + this)
+
+
+def main() -> int:
+    with open(FLOOR_FILE) as f:
+        floor = json.load(f)
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        FILODB_BENCH_SERIES=str(floor["series"]),
+        FILODB_BENCH_RUNS=str(floor["runs"]),
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--worker", "--cpu"],
+        env=env, capture_output=True, text=True, cwd=REPO, timeout=600,
+    )
+    sys.stderr.write(proc.stderr[-2000:])
+    lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+    if proc.returncode != 0 or not lines:
+        print(f"bench-smoke: worker failed rc={proc.returncode}")
+        return 1
+    got = json.loads(lines[-1])
+    p50 = float(got["value"])
+    limit = float(floor["p50_ms_floor"]) * (1.0 + REGRESSION_TOLERANCE)
+    verdict = []
+    ok = True
+    if not got.get("match", False):
+        verdict.append("FAIL: result does not match the numpy oracle")
+        ok = False
+    if p50 <= 0:
+        verdict.append("FAIL: no measurement")
+        ok = False
+    elif p50 > limit:
+        verdict.append(
+            f"FAIL: p50 {p50:.2f}ms regresses >25% vs floor "
+            f"{floor['p50_ms_floor']}ms (limit {limit:.2f}ms)"
+        )
+        ok = False
+    else:
+        verdict.append(
+            f"OK: p50 {p50:.2f}ms within limit {limit:.2f}ms "
+            f"(floor {floor['p50_ms_floor']}ms, phases {got.get('phases_ms')})"
+        )
+    print("bench-smoke: " + "; ".join(verdict))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
