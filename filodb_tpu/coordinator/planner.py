@@ -515,6 +515,21 @@ class SingleClusterPlanner:
                 return ScalarVectorOpExec(vec, sexec, p.op, p.scalar_is_lhs, p.return_bool)
             raise QueryError(f"unsupported scalar operand {sc}")
         if isinstance(p, L.ApplyInstantFunction):
+            if (
+                p.function == "histogram_quantile"
+                and len(p.args) == 1
+                and isinstance(p.args[0], (int, float))
+                and isinstance(p.inner, L.Aggregate)
+                and p.inner.op == "sum"
+            ):
+                # the canonical SRE chain histogram_quantile(q, sum by (le)
+                # (rate(m_bucket[w]))): fuse the interpolation epilogue into
+                # the single-dispatch aggregate program (doc/perf.md)
+                fused = self._try_fused_aggregate(
+                    p.inner, hist_quantile=float(p.args[0])
+                )
+                if fused is not None:
+                    return fused
             inner = self._materialize(p.inner)
             inner.transformers.append(InstantVectorFunctionMapper(p.function, p.args))
             return inner
@@ -615,14 +630,19 @@ class SingleClusterPlanner:
             return fused
         return self._materialize_aggregate_tree(p)
 
-    def _try_fused_aggregate(self, p: L.Aggregate):
+    def _try_fused_aggregate(self, p: L.Aggregate,
+                             hist_quantile: float | None = None):
         """Single-dispatch path: `op by (...) (range_fn(selector[w]))` with
         every shard local plans to a FusedAggregateExec over one
-        device-resident superblock (O(1) kernel launches). The reference
+        device-resident superblock (O(1) kernel launches) — including 3-D
+        histogram superblocks, fused ``topk``/``bottomk``/``quantile``
+        epilogues, and (via ``hist_quantile``) the device-side
+        ``histogram_quantile`` interpolation epilogue. The reference
         scatter tree is built alongside as the runtime fallback (partial
-        results, histograms, mixed schemas)."""
+        results, mixed schemas, unsupported hist shapes)."""
         from ..query.exec.plans import (
             FUSED_AGG_OPS,
+            FUSED_EPI_OPS,
             FUSED_FUNCS,
             FusedAggregateExec,
         )
@@ -634,7 +654,17 @@ class SingleClusterPlanner:
             or params.peer_endpoints
         ):
             return None
-        if p.op not in FUSED_AGG_OPS or p.params:
+        if p.op in FUSED_AGG_OPS:
+            if p.params:
+                return None
+        elif p.op in FUSED_EPI_OPS:
+            if len(p.params) != 1 or not isinstance(p.params[0], (int, float)):
+                return None
+            if p.op in ("topk", "bottomk") and (p.by or p.without):
+                # the compact [k, J] device epilogue is global-only; grouped
+                # topk keeps the per-shard candidate pre-reduction tree
+                return None
+        else:
             return None
         inner = p.inner
         if isinstance(inner, L.PeriodicSeriesWithWindowing):
@@ -654,6 +684,20 @@ class SingleClusterPlanner:
         shards = self.shards_for(inner.raw.filters)
         if not shards:
             return None
+        if hist_quantile is not None:
+            # the fallback must reproduce the WHOLE fused subtree — the
+            # aggregate tree plus the histogram_quantile mapper on top
+            def fallback():
+                tree = self._materialize_aggregate_tree(p)
+                tree.transformers.append(
+                    InstantVectorFunctionMapper(
+                        "histogram_quantile", (hist_quantile,)
+                    )
+                )
+                return tree
+        else:
+            def fallback():
+                return self._materialize_aggregate_tree(p)
         return FusedAggregateExec(
             shards, inner.raw.filters, inner.raw.start_ms, inner.raw.end_ms,
             inner.raw.column, p.op, p.by, p.without, func,
@@ -661,7 +705,9 @@ class SingleClusterPlanner:
             inner.offset_ms,
             # lazy: the O(shards) reference tree only materializes if a
             # runtime condition actually falls back to it
-            fallback=lambda: self._materialize_aggregate_tree(p),
+            fallback=fallback,
+            params=p.params,
+            hist_quantile=hist_quantile,
         )
 
     def _materialize_aggregate_tree(self, p: L.Aggregate) -> ExecPlan:

@@ -55,3 +55,96 @@ def base2_exp_buckets(scale: int, start_index: int, num: int) -> BucketScheme:
 PROM_DEFAULT = custom_buckets(
     [0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10]
 )
+
+
+# -- bucket-scheme unification (heterogeneous schemes across shards) --------
+#
+# The reference resizes histograms onto a common scheme before HistSum
+# (Histogram.scala HistogramWithBuckets add/convert); here the same
+# unification runs host-side on [.., B]-shaped cumulative count arrays so
+# BOTH aggregation paths — the fused superblock concat and the reference
+# partial-merge — share one definition and stay numerically identical.
+
+_LE_TOL = 1e-10  # same bound-match tolerance as histogram_bucket selection
+
+
+def same_scheme(a, b) -> bool:
+    """True when two ``le`` bound vectors describe the same bucket scheme:
+    equal length, every bound within _LE_TOL (equal +Inf top buckets
+    match). The ONE equality rule for every fused/reference unification
+    site — keep them on this helper so the tolerance can't drift apart."""
+    a = np.asarray(a, dtype=np.float64)
+    b = np.asarray(b, dtype=np.float64)
+    if len(a) != len(b):
+        return False
+    with np.errstate(invalid="ignore"):
+        diff = np.abs(a - b)  # inf - inf -> nan: equal infinite tops match
+    return not (diff > _LE_TOL).any()
+
+
+def union_les(les_list) -> np.ndarray:
+    """Union bucket scheme of several ``le`` bound vectors: sorted unique
+    finite bounds (within _LE_TOL) plus the +Inf top bucket every scheme
+    carries."""
+    bounds: list[float] = []
+    for les in les_list:
+        for x in np.asarray(les, dtype=np.float64):
+            if np.isinf(x):
+                continue
+            if not any(abs(x - b) < _LE_TOL for b in bounds):
+                bounds.append(float(x))
+    return np.asarray(sorted(bounds) + [np.inf], dtype=np.float64)
+
+
+def bucket_mapping(src_les, dst_les) -> np.ndarray:
+    """For each dst bound, the index of the matching src bound, or the
+    largest src bound strictly below it (-1 when none). Cumulative counts
+    at a bound a scheme doesn't carry take the count of the nearest LOWER
+    bound it does (0 below the first): the exact lower-bound completion of
+    a cumulative distribution, and monotone by construction."""
+    src = np.asarray(src_les, dtype=np.float64)
+    out = np.empty(len(dst_les), dtype=np.int64)
+    for i, x in enumerate(np.asarray(dst_les, dtype=np.float64)):
+        hit = np.nonzero(
+            np.isclose(src, x, rtol=0.0, atol=_LE_TOL)
+            | (np.isinf(src) & np.isinf([x] * len(src)))
+        )[0]
+        if len(hit):
+            out[i] = hit[0]
+        else:
+            below = np.nonzero(src < x - _LE_TOL)[0]
+            out[i] = below[-1] if len(below) else -1
+    return out
+
+
+def unify_schemes(arrays, les_list):
+    """Remap several [..., B_i]-shaped cumulative-count arrays onto the
+    union of their bucket schemes (union_les + remap_buckets — the ONE
+    unification rule, shared by the fused superblock concat and both
+    reference partial-merge sites). Returns (arrays', union, changed);
+    arrays already on the union scheme pass through as the SAME objects,
+    and changed=False means every one did."""
+    les64 = [np.asarray(l, dtype=np.float64) for l in les_list]
+    union = union_les(les64)
+    out = [remap_buckets(a, l, union) for a, l in zip(arrays, les64)]
+    changed = any(o is not a for o, a in zip(out, arrays))
+    return out, union, changed
+
+
+def remap_buckets(arr: np.ndarray, src_les, dst_les) -> np.ndarray:
+    """Remap an [..., B_src] cumulative-count array onto ``dst_les``:
+    matching bounds copy through, missing bounds take the nearest lower
+    bound's count (0 when below the scheme's first bound). Exact identity
+    when the schemes already agree."""
+    src = np.asarray(src_les, dtype=np.float64)
+    dst = np.asarray(dst_les, dtype=np.float64)
+    if len(src) == len(dst) and np.allclose(
+        src[:-1], dst[:-1], rtol=0.0, atol=_LE_TOL
+    ):
+        return arr
+    m = bucket_mapping(src, dst)
+    a = np.asarray(arr)
+    out = np.zeros(a.shape[:-1] + (len(dst),), dtype=a.dtype)
+    have = m >= 0
+    out[..., have] = a[..., m[have]]
+    return out

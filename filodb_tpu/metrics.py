@@ -404,6 +404,15 @@ class SlowQueryLog:
 SLOW_QUERY_LOG = SlowQueryLog()
 
 
+def record_fused_fallback(reason: str) -> None:
+    """A FusedAggregateExec delegated to its reference scatter tree at
+    runtime. Exposed as ``filodb_fused_fallback_total{reason=...}`` so
+    operators see fused-path coverage at aggregate level (the reason was
+    previously only a span tag, visible per-query only); doc/perf.md
+    documents the reason taxonomy."""
+    REGISTRY.counter("filodb_fused_fallback", reason=reason).inc()
+
+
 # -- kernel dispatch instrumentation ----------------------------------------
 
 

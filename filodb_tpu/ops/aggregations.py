@@ -14,19 +14,10 @@ no members at a step yields NaN.
 from __future__ import annotations
 
 import functools
-import threading
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-
-# serializes group_ids_memo misses (O(S) python regroup + device upload):
-# racing same-key queries must compute once, not clobber each other.
-# Deliberately ONE process-wide lock: misses happen once per (block,
-# grouping) lifetime, so cross-key contention is a cold-path-only cost not
-# worth per-key lock bookkeeping (ROADMAP notes consolidating the tree's
-# single-flight helpers).
-_GID_MEMO_LOCK = threading.Lock()
 
 SIMPLE_AGG_OPS = ("sum", "count", "avg", "min", "max", "stddev", "stdvar", "group")
 
@@ -96,37 +87,75 @@ FUSED_MXU_FUNCS = {
 }
 
 
+def _apply_epilogue(sj, epilogue: tuple, gids, n_real, qv, num_groups: int):
+    """Device-side epilogue over the [S, J] range grid, INSIDE the same
+    compiled program as the range kernel. ``epilogue`` is a static tuple:
+
+      ("agg", op)          -> [G, J] segment aggregate
+      ("topk", k, bottom)  -> ([k, J] values, [k, J] i32 series indices):
+                              per-step top/bottom-k across series, the
+                              compact form of ``topk_mask`` — only O(k*J)
+                              crosses to the host, never [S, J]
+      ("quantile",)        -> [G, J] per-(group, step) quantile at ``qv``
+                              (``segment_quantile`` inside the jit boundary)
+
+    ``gids`` follows the trash-group contract (padded rows -> group
+    ``num_groups``); ``n_real`` additionally masks padded rows for the
+    non-segmented epilogues (count/present-style functions yield REAL
+    values on padded rows in the MXU kernel variant, which a top-k would
+    otherwise happily select)."""
+    kind = epilogue[0]
+    if kind == "agg":
+        return _segment_aggregate_jit(epilogue[1], sj, gids, num_groups + 1)[:num_groups]
+    S, J = sj.shape
+    rows = jax.lax.broadcasted_iota(jnp.int32, (S, J), 0)
+    sj = jnp.where(rows < n_real, sj, jnp.nan)
+    if kind == "topk":
+        _, k, bottom = epilogue
+        v = jnp.where(jnp.isnan(sj), jnp.inf if bottom else -jnp.inf, sj)
+        vt = v.T if not bottom else -v.T  # [J, S], larger = better
+        top_vals, top_idx = jax.lax.top_k(vt, min(k, S))  # [J, kk]
+        vals = jnp.where(
+            jnp.isfinite(top_vals),
+            top_vals if not bottom else -top_vals,
+            jnp.nan,
+        )
+        return vals.T, top_idx.T.astype(jnp.int32)  # [kk, J] each
+    if kind == "quantile":
+        return segment_quantile(sj, gids, num_groups + 1, qv)[:num_groups]
+    raise ValueError(f"unknown fused epilogue {epilogue}")
+
+
 @functools.partial(jax.jit, static_argnames=(
-    "func", "op", "num_steps", "num_groups", "is_counter", "is_delta"
+    "func", "epilogue", "num_steps", "num_groups", "is_counter", "is_delta"
 ))
-def _fused_general_jit(func, op, ts, vals, lens, baseline, raw, gids,
-                       start_off, step_ms, window, num_steps: int,
-                       num_groups: int, is_counter: bool, is_delta: bool):
-    """range_kernel -> segment aggregate as ONE compiled program: only the
-    [G, J] group partials ever exist as program outputs — no [S, J] grid
-    reaches the host, and no second dispatch happens. ``gids`` maps padded
-    rows to the trash group ``num_groups`` (padded rows yield NaN from value
-    functions but real values from count_over_time/present_over_time, so
-    they must never share a segment with real series)."""
+def _fused_general_jit(func, epilogue, ts, vals, lens, baseline, raw, gids,
+                       n_real, qv, start_off, step_ms, window,
+                       num_steps: int, num_groups: int, is_counter: bool,
+                       is_delta: bool):
+    """range_kernel -> epilogue as ONE compiled program: only the [G, J]
+    group partials (or [k, J] top-k rows) ever exist as program outputs —
+    no [S, J] grid reaches the host, and no second dispatch happens. See
+    _apply_epilogue for the trash-group / padded-row contract."""
     from .kernels import range_kernel
 
     sj = range_kernel(
         func, ts, vals, lens, baseline, raw, start_off, step_ms, window,
         num_steps, is_counter=is_counter, is_delta=is_delta,
     )
-    return _segment_aggregate_jit(op, sj, gids, num_groups + 1)[:num_groups]
+    return _apply_epilogue(sj, epilogue, gids, n_real, qv, num_groups)
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "func", "op", "num_groups", "is_counter", "is_delta", "fetch"
+    "func", "epilogue", "num_groups", "is_counter", "is_delta", "fetch"
 ))
-def _fused_mxu_jit(func, op, vals, raw, baseline, W, F, L, L2, count,
+def _fused_mxu_jit(func, epilogue, vals, raw, baseline, W, F, L, L2, count,
                    t_first, t_last, t_last2, out_t, window_ms, idx, gids,
-                   num_groups: int, is_counter: bool, is_delta: bool,
-                   fetch: str):
+                   n_real, qv, num_groups: int, is_counter: bool,
+                   is_delta: bool, fetch: str):
     """Regular-grid fused variant: the MXU window-matmul kernel and the
-    segment reduce in one compiled program (see _fused_general_jit for the
-    trash-group contract on ``gids``)."""
+    epilogue in one compiled program (see _apply_epilogue for the
+    trash-group / padded-row contract)."""
     from .mxu_kernels import mxu_range_kernel
 
     sj = mxu_range_kernel(
@@ -134,20 +163,15 @@ def _fused_mxu_jit(func, op, vals, raw, baseline, W, F, L, L2, count,
         t_last2, out_t, window_ms, idx=idx, is_counter=is_counter,
         is_delta=is_delta, fetch=fetch,
     )
-    return _segment_aggregate_jit(op, sj, gids, num_groups + 1)[:num_groups]
+    return _apply_epilogue(sj, epilogue, gids, n_real, qv, num_groups)
 
 
-def fused_range_aggregate(func: str, op: str, block, gids_padded,
-                          num_groups: int, params, is_counter: bool = False,
-                          is_delta: bool = False):
-    """One device dispatch for ``op by (...) (func(selector[w]))`` over a
-    staged (super)block: returns the [G, J_pad] group partials on device.
-
-    ``gids_padded`` is [S_padded] int32 with padded rows assigned the trash
-    group ``num_groups``. Regular shared grids ride the MXU window-matrix
-    kernel (matrices cached device-resident on the block); everything else
-    runs the general compare-and-reduce kernel. Instrumented like every
-    other kernel entry (per-dispatch latency + JIT hit/miss)."""
+def _fused_dispatch(func: str, epilogue: tuple, block, gids_padded,
+                    num_groups: int, params, qv, is_counter: bool,
+                    is_delta: bool, name: str):
+    """Shared MXU-vs-general selection + instrumentation for every fused
+    scalar entry point (one dispatch, one latency observation, one JIT
+    hit/miss account)."""
     import time as _time
 
     from ..metrics import record_kernel_dispatch
@@ -155,6 +179,7 @@ def fused_range_aggregate(func: str, op: str, block, gids_padded,
 
     j_pad = pad_steps(params.num_steps)
     raw = block.raw if block.raw is not None else block.vals
+    n_real = np.int32(block.n_series)
     t0 = _time.perf_counter()
     use_mxu = (
         block.regular_ts is not None
@@ -170,23 +195,149 @@ def fused_range_aggregate(func: str, op: str, block, gids_padded,
         )
         before = _fused_mxu_jit._cache_size()
         out = _fused_mxu_jit(
-            func, op, block.vals, raw, block.baseline,
+            func, epilogue, block.vals, raw, block.baseline,
             wm.dW, wm.dF, wm.dL, wm.dL2, wm.d_count, wm.d_tf, wm.d_tl,
             wm.d_tl2, wm.d_out_t, np.float32(params.window_ms), wm.d_idx,
-            gids_padded, num_groups, is_counter, is_delta, fetch_strategy(),
+            gids_padded, n_real, qv, num_groups, is_counter, is_delta,
+            fetch_strategy(),
         )
         compiled = _fused_mxu_jit._cache_size() > before
     else:
         before = _fused_general_jit._cache_size()
         out = _fused_general_jit(
-            func, op, block.ts, block.vals, block.lens, block.baseline, raw,
-            gids_padded, np.int32(params.start_ms - block.base_ms),
+            func, epilogue, block.ts, block.vals, block.lens, block.baseline,
+            raw, gids_padded, n_real, qv,
+            np.int32(params.start_ms - block.base_ms),
             np.int32(params.step_ms), np.int32(params.window_ms), j_pad,
             num_groups, is_counter, is_delta,
         )
         compiled = _fused_general_jit._cache_size() > before
+    record_kernel_dispatch(name, _time.perf_counter() - t0, compiled=compiled)
+    return out
+
+
+def fused_range_aggregate(func: str, op: str, block, gids_padded,
+                          num_groups: int, params, is_counter: bool = False,
+                          is_delta: bool = False):
+    """One device dispatch for ``op by (...) (func(selector[w]))`` over a
+    staged (super)block: returns the [G, J_pad] group partials on device.
+
+    ``gids_padded`` is [S_padded] int32 with padded rows assigned the trash
+    group ``num_groups``. Regular shared grids ride the MXU window-matrix
+    kernel (matrices cached device-resident on the block); everything else
+    runs the general compare-and-reduce kernel. Instrumented like every
+    other kernel entry (per-dispatch latency + JIT hit/miss)."""
+    return _fused_dispatch(
+        func, ("agg", op), block, gids_padded, num_groups, params,
+        np.float32(0.0), is_counter, is_delta, name=f"fused_{op}_{func}",
+    )
+
+
+def fused_topk(func: str, block, k: int, bottom: bool, params,
+               is_counter: bool = False, is_delta: bool = False):
+    """One device dispatch for global ``topk(k, func(selector[w]))``:
+    returns ([k, J_pad] values, [k, J_pad] i32 series indices) on device —
+    the compact per-step winner set, O(k*J) on the wire instead of the
+    [S, J] grid AggregatePresentExec gathers. Needs no label grouping at
+    all (global top-k), so the O(S) group pass is skipped too."""
+    import jax as _jax
+
+    from ..singleflight import memo_on
+
+    # trash-group vector unused by the topk epilogue but part of the shared
+    # jit signature; memoized device-resident zeros per block
+    s_pad = np.asarray(block.lens).shape[0]
+    gids = memo_on(
+        block, "_zero_gids", s_pad,
+        lambda: _jax.device_put(np.zeros(s_pad, dtype=np.int32)),
+    )
+    return _fused_dispatch(
+        func, ("topk", int(k), bool(bottom)), block, gids, 1, params,
+        np.float32(0.0), is_counter, is_delta,
+        name=f"fused_{'bottomk' if bottom else 'topk'}_{func}",
+    )
+
+
+def fused_quantile(func: str, block, gids_padded, num_groups: int, q: float,
+                   params, is_counter: bool = False, is_delta: bool = False):
+    """One device dispatch for ``quantile(q, func(selector[w])) by (...)``:
+    range kernel -> segment_quantile inside one compiled program; only the
+    [G, J_pad] quantile grid reaches the host. ``q`` rides as a dynamic
+    argument so dashboards sweeping quantiles share one executable."""
+    return _fused_dispatch(
+        func, ("quantile",), block, gids_padded, num_groups, params,
+        np.float32(q), is_counter, is_delta, name=f"fused_quantile_{func}",
+    )
+
+
+def fused_hist_range_aggregate(func: str, block, gids_padded,
+                               num_groups: int, params, les,
+                               q: float | None = None,
+                               is_delta: bool = False):
+    """One device dispatch for ``sum by (...) (hist_fn(selector[w]))`` over
+    a 3-D histogram (super)block — optionally with the device-side
+    ``histogram_quantile`` interpolation epilogue fused into the same
+    program (q != None). Returns [G, J_pad, B] group bucket partials, or
+    [G, J_pad] quantiles. ``les`` is the (unified) [B] bound vector.
+
+    Shared regular grids (the overwhelmingly common scraped-histogram case)
+    use the shared-window variant: [J] boundary vectors precomputed
+    host-side and memoized device-resident on the block, skipping the
+    O(S*J*T) per-series boundary compare entirely."""
+    import time as _time
+
+    from ..metrics import record_kernel_dispatch
+    from ..singleflight import memo_on
+    from .hist_kernels import _fused_hist_jit, _fused_hist_shared_jit
+    from .kernels import pad_steps
+
+    j_pad = pad_steps(params.num_steps)
+    qv = np.float32(q if q is not None else 0.0)
+    start_off = int(params.start_ms - block.base_ms)
+    t0 = _time.perf_counter()
+    if block.regular_ts is not None:
+        key = (start_off, int(params.step_ms), j_pad, int(params.window_ms))
+
+        def build_windows():
+            import jax
+
+            m = int(np.asarray(block.lens)[0])
+            tsv = np.asarray(block.regular_ts)[:m].astype(np.int64)
+            out_t = start_off + np.arange(j_pad, dtype=np.int64) * int(
+                params.step_ms
+            )
+            hi = np.searchsorted(tsv, out_t, side="right").astype(np.int32)
+            lo = np.searchsorted(
+                tsv, out_t - int(params.window_ms), side="right"
+            ).astype(np.int32)
+            t_first = tsv[np.minimum(lo, m - 1)].astype(np.int32)
+            t_last = tsv[np.minimum(hi - 1, m - 1)].astype(np.int32)
+            put = jax.device_put
+            return (put(lo), put(hi), put(t_first), put(t_last),
+                    put(out_t.astype(np.int32)))
+
+        lo, hi, t_first, t_last, out_t = memo_on(
+            block, "_hist_win_cache", key, build_windows
+        )
+        before = _fused_hist_shared_jit._cache_size()
+        out = _fused_hist_shared_jit(
+            func, block.vals, lo, hi, t_first, t_last, out_t,
+            np.int32(params.window_ms), gids_padded, les, qv,
+            num_groups, is_delta, q is not None,
+        )
+        compiled = _fused_hist_shared_jit._cache_size() > before
+    else:
+        before = _fused_hist_jit._cache_size()
+        out = _fused_hist_jit(
+            func, block.ts, block.vals, block.lens, gids_padded, les, qv,
+            np.int32(start_off), np.int32(params.step_ms),
+            np.int32(params.window_ms), j_pad, num_groups, is_delta,
+            q is not None,
+        )
+        compiled = _fused_hist_jit._cache_size() > before
     record_kernel_dispatch(
-        f"fused_{op}_{func}", _time.perf_counter() - t0, compiled=compiled
+        f"fused_hist_{'quantile_' if q is not None else ''}sum_{func}",
+        _time.perf_counter() - t0, compiled=compiled,
     )
     return out
 
@@ -203,46 +354,40 @@ def group_ids_memo(block, series_labels, by, without,
     Returns ``(gids_padded_dev, num_groups, group_labels)`` where
     gids_padded_dev is a device-resident [S_padded] int32 with padded rows
     routed to the trash group ``num_groups`` (the fused_range_aggregate
-    contract)."""
+    contract). Misses build through the shared keyed single-flight
+    (filodb_tpu/singleflight.memo_on): concurrent same-key queries must not
+    each pay the O(S) regroup + device upload, nor clobber the memo dict."""
+    from ..singleflight import memo_on
+
     key = (
         tuple(by) if by else None,
         tuple(without) if without else None,
         bool(strip_metric),
     )
-    cache = getattr(block, "_gid_cache", None)
-    hit = cache.get(key) if cache is not None else None
-    if hit is None:
-        # miss path under a lock: concurrent same-key queries must not each
-        # pay the O(S) regroup + device upload, nor clobber the cache dict
-        with _GID_MEMO_LOCK:
-            cache = getattr(block, "_gid_cache", None)
-            if cache is None:
-                cache = {}
-                block._gid_cache = cache
-            hit = cache.get(key)
-            if hit is None:
-                import jax
 
-                labels = series_labels
-                if strip_metric:
-                    from ..core.schemas import METRIC_TAG
+    def build():
+        import jax
 
-                    labels = [
-                        {k: v for k, v in l.items()
-                         if k not in (METRIC_TAG, "__name__")}
-                        for l in labels
-                    ]
-                gids, group_labels = group_ids_for(
-                    labels, list(by) if by else None,
-                    list(without) if without else None,
-                )
-                G = len(group_labels)
-                s_pad = np.asarray(block.lens).shape[0]
-                gids_padded = np.full(s_pad, G, dtype=np.int32)
-                gids_padded[: len(gids)] = gids
-                hit = (jax.device_put(gids_padded), G, group_labels)
-                cache[key] = hit
-    return hit
+        labels = series_labels
+        if strip_metric:
+            from ..core.schemas import METRIC_TAG
+
+            labels = [
+                {k: v for k, v in l.items()
+                 if k not in (METRIC_TAG, "__name__")}
+                for l in labels
+            ]
+        gids, group_labels = group_ids_for(
+            labels, list(by) if by else None,
+            list(without) if without else None,
+        )
+        G = len(group_labels)
+        s_pad = np.asarray(block.lens).shape[0]
+        gids_padded = np.full(s_pad, G, dtype=np.int32)
+        gids_padded[: len(gids)] = gids
+        return (jax.device_put(gids_padded), G, group_labels)
+
+    return memo_on(block, "_gid_cache", key, build)
 
 
 @functools.partial(jax.jit, static_argnames=("k", "bottom"))

@@ -147,6 +147,114 @@ def histogram_fraction(lower, upper, buckets, les):
     return jnp.where(total > 0, jnp.clip(frac, 0.0, 1.0), jnp.nan)
 
 
+# histogram range functions the fused single-dispatch path supports (the
+# hist_range_kernel dispatch set; "last" is the plain-selector read)
+FUSED_HIST_FUNCS = frozenset({
+    "rate", "increase", "delta", "sum_over_time", "last", "last_over_time",
+})
+
+
+def _hist_range_shared(func, vals, lo, hi, t_first, t_last, out_t, window,
+                       is_delta: bool):
+    """Shared-regular-grid form of hist_range_kernel: every series shares
+    ONE timestamp vector, so window boundaries are series-INDEPENDENT [J]
+    vectors precomputed host-side (np.searchsorted) — no O(S*J*T) compare.
+    Same math as hist_range_kernel over identical indices, so results are
+    bit-identical to the general path on shared grids. Padded series rows
+    get garbage values (count is series-independent); the fused epilogue's
+    trash-group contract discards them."""
+    f32 = vals.dtype
+    T = vals.shape[1]
+    cnt = (hi - lo).astype(f32)  # [J]
+    has = (cnt > 0)[None, :, None]
+
+    def gidx(idx):  # [S, J, B] gather at shared [J] sample indices
+        return jnp.take(vals, jnp.clip(idx, 0, T - 1), axis=1)
+
+    if func in ("last", "last_over_time"):
+        return jnp.where(has, gidx(hi - 1), jnp.nan)
+    if func == "sum_over_time" or (is_delta and func in ("rate", "increase")):
+        cs = jnp.cumsum(vals, axis=1)
+        cs = jnp.concatenate([jnp.zeros_like(cs[:, :1]), cs], axis=1)
+        s = (jnp.take(cs, jnp.clip(hi, 0, T), axis=1)
+             - jnp.take(cs, jnp.clip(lo, 0, T), axis=1))
+        if func == "rate":
+            s = s / (window.astype(f32) * 1e-3)
+        return jnp.where(has, s, jnp.nan)
+    if func in ("rate", "increase", "delta"):
+        v_first = gidx(lo)
+        v_last = gidx(hi - 1)
+        dlt = v_last - v_first  # [S, J, B]
+        tf = t_first.astype(f32) * 1e-3  # [J]
+        tl = t_last.astype(f32) * 1e-3
+        sampled = tl - tf
+        range_start = (out_t - window).astype(f32) * 1e-3
+        range_end = out_t.astype(f32) * 1e-3
+        dur_start = tf - range_start
+        dur_end = range_end - tl
+        avg_dur = sampled / jnp.maximum(cnt - 1.0, 1.0)
+        thresh = avg_dur * 1.1
+        dur_start = jnp.where(dur_start >= thresh, avg_dur / 2.0, dur_start)
+        dur_end = jnp.where(dur_end >= thresh, avg_dur / 2.0, dur_end)
+        factor = (sampled + dur_start + dur_end) / jnp.maximum(sampled, 1e-30)
+        res = dlt * factor[None, :, None]
+        if func == "rate":
+            res = res / (window.astype(f32) * 1e-3)
+        return jnp.where((cnt >= 2)[None, :, None], res, jnp.nan)
+    raise ValueError(f"unknown histogram range function {func}")
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "func", "num_groups", "is_delta", "quantile"
+))
+def _fused_hist_shared_jit(func, vals, lo, hi, t_first, t_last, out_t,
+                           window, gids, les, qv, num_groups: int,
+                           is_delta: bool, quantile: bool):
+    """Shared-grid twin of _fused_hist_jit (same program shape, cheaper
+    window machinery)."""
+    from .aggregations import _segment_aggregate_jit
+
+    sjb = _hist_range_shared(
+        func, vals, lo, hi, t_first, t_last, out_t, window, is_delta
+    )
+    S, J, B = sjb.shape
+    gjb = _segment_aggregate_jit(
+        "sum", sjb.reshape(S, J * B), gids, num_groups + 1
+    )[:num_groups].reshape(num_groups, J, B)
+    if quantile:
+        return histogram_quantile(qv, gjb, les)
+    return gjb
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "func", "num_steps", "num_groups", "is_delta", "quantile"
+))
+def _fused_hist_jit(func, ts, vals, lens, gids, les, qv, start_off, step_ms,
+                    window, num_steps: int, num_groups: int, is_delta: bool,
+                    quantile: bool):
+    """hist range_fn -> per-bucket segment-sum -> (optional) device-side
+    histogram_quantile interpolation as ONE compiled program: only the
+    [G, J, B] group partials — or just the [G, J] quantile grid — exist as
+    program outputs; no [S, J, B] grid ever reaches the host. ``gids``
+    follows the trash-group contract (padded rows -> group ``num_groups``);
+    per-bucket summation is the flattened [S, J*B] form of the same segment
+    reduce the reference partial-merge path runs, so the two paths agree
+    bit-for-bit on identical schemes."""
+    from .aggregations import _segment_aggregate_jit
+
+    sjb = hist_range_kernel(
+        func, ts, vals, lens, start_off, step_ms, window, num_steps,
+        is_delta=is_delta,
+    )
+    S, J, B = sjb.shape
+    gjb = _segment_aggregate_jit(
+        "sum", sjb.reshape(S, J * B), gids, num_groups + 1
+    )[:num_groups].reshape(num_groups, J, B)
+    if quantile:
+        return histogram_quantile(qv, gjb, les)
+    return gjb
+
+
 def run_hist_range_function(
     func: str, block: StagedBlock, params: RangeParams, is_delta: bool = False
 ):

@@ -189,20 +189,18 @@ def _cached_window_matrices(block, cache_attr: str, nominal_ts, n_valid: int,
                             maxdev_ms: int, start_off: int, step_ms: int,
                             num_steps: int, window_ms: int) -> JitterWindowMatrices:
     """One per-block memoization discipline for both the aligned-jitter and
-    masked grid sources (keyed on the query window parameters)."""
-    cache = getattr(block, cache_attr, None)
-    if cache is None:
-        cache = {}
-        setattr(block, cache_attr, cache)
+    masked grid sources (keyed on the query window parameters), via the
+    shared keyed single-flight so racing builders construct once."""
+    from ..singleflight import memo_on
+
     key = (int(start_off), int(step_ms), int(num_steps), int(window_ms))
-    wm = cache.get(key)
-    if wm is None:
-        wm = JitterWindowMatrices(
+    return memo_on(
+        block, cache_attr, key,
+        lambda: JitterWindowMatrices(
             np.asarray(nominal_ts), n_valid, maxdev_ms,
             start_off, step_ms, num_steps, window_ms,
-        )
-        cache[key] = wm
-    return wm
+        ),
+    )
 
 
 def jitter_window_matrices(block: StagedBlock, start_off: int, step_ms: int,

@@ -22,7 +22,6 @@ chunk layout; we exploit the shared scrape grid.
 from __future__ import annotations
 
 import functools
-import threading
 
 import jax
 import jax.numpy as jnp
@@ -218,33 +217,20 @@ class WindowMatrices:
         self._minmax_built = True
 
 
-# serializes WindowMatrices construction across query threads: two racing
-# same-key misses would each upload the full device-resident matrix set and
-# the loser's copy would linger until GC (same defect class as the
-# parallel/exec._WM_CACHE race). Builds are ms-scale and rare, so one
-# process-wide lock beats per-block lock bookkeeping.
-_WM_BUILD_LOCK = threading.Lock()
-
-
 def window_matrices(block: StagedBlock, start_off: int, step_ms: int,
                     num_steps: int, window_ms: int) -> WindowMatrices:
+    """Per-(block, query-params) WindowMatrices, memoized on the block via
+    the shared keyed single-flight (filodb_tpu/singleflight.memo_on): two
+    racing same-key misses would each upload the full device-resident
+    matrix set and the loser's copy would linger until GC."""
+    from ..singleflight import memo_on
+
     key = (int(start_off), int(step_ms), int(num_steps), int(window_ms))
-    cache = getattr(block, "_wm_cache", None)
-    wm = cache.get(key) if cache is not None else None
-    if wm is None:
-        with _WM_BUILD_LOCK:
-            # dict creation must ALSO happen under the lock: two racers
-            # attaching private dicts would each pass their own double-check
-            cache = getattr(block, "_wm_cache", None)
-            if cache is None:
-                cache = {}
-                setattr(block, "_wm_cache", cache)
-            wm = cache.get(key)
-            if wm is None:
-                wm = WindowMatrices(block.regular_ts, int(block.lens[0]),
-                                    start_off, step_ms, num_steps, window_ms)
-                cache[key] = wm
-    return wm
+    return memo_on(
+        block, "_wm_cache", key,
+        lambda: WindowMatrices(block.regular_ts, int(block.lens[0]),
+                               start_off, step_ms, num_steps, window_ms),
+    )
 
 
 @functools.partial(

@@ -788,7 +788,15 @@ def stage_histogram_series(
             out_vals[i, :m] = (vals.astype(np.float64) - b).astype(dtype)
         else:
             out_vals[i, :m] = vals.astype(dtype)
-    return StagedBlock(out_ts, out_vals, lens, base_ms, baseline, n, part_refs or [])
+    # shared-regular-grid detection, same rule as scalar staging: the fused
+    # hist kernels then use series-independent [J] window boundaries instead
+    # of the O(S*J*T) per-series compare (ops/hist_kernels shared variant)
+    regular = None
+    if n > 0 and (lens[:n] == lens[0]).all() and lens[0] > 0:
+        if not (out_ts[:n] != out_ts[0]).any():
+            regular = out_ts[0]
+    return StagedBlock(out_ts, out_vals, lens, base_ms, baseline, n,
+                       part_refs or [], regular_ts=regular)
 
 
 def _slot_align(shard, part_ids, column, series, start_ms: int, end_ms: int):
@@ -863,10 +871,36 @@ def _slot_align(shard, part_ids, column, series, start_ms: int, end_ms: int):
     return out
 
 
+def staged_nbytes(block: StagedBlock) -> int:
+    """True device-byte footprint of a staged block: every array a
+    ``to_device`` pins in HBM. Histogram blocks carry [S, T, B] vals and
+    [S, B] baselines — the B axis multiplies the footprint ~20-60x over a
+    scalar block of the same selection, and cache eviction budgets
+    (stage_cache_bytes, SuperblockCache.max_bytes) must see that. Reads
+    ``.nbytes`` directly so device arrays are never fetched to host."""
+    total = 0
+    for arr in (block.ts, block.vals, block.raw, block.baseline, block.lens,
+                block.ts_dev):
+        if arr is not None:
+            total += int(arr.nbytes)
+    if block.mgrid is not None:
+        for f in ("valid", "vals", "dev", "raw", "ffv", "ffd", "bfv", "bfd",
+                  "ff2v", "ff2d", "bfraw", "cc"):
+            arr = getattr(block.mgrid, f)
+            if arr is not None:
+                total += int(arr.nbytes)
+    return total
+
+
 def concat_blocks(blocks, force_raw: bool = False) -> StagedBlock:
     """Row-concatenate staged blocks into one padded superblock EXACTLY —
     corrected values, raw sidecars, baselines and part refs carry over with
     no restaging and no semantic drift. All blocks must share base_ms.
+
+    Histogram blocks ([S, T, B] vals, [S, B] baselines) concatenate the same
+    way into a ``[ΣS, T, B]`` superblock; all blocks must already share one
+    bucket scheme (callers unify heterogeneous ``le`` schemes first via
+    core.histograms.remap_buckets — see plans._build_superblock).
 
     The shared regular grid survives only when every non-empty block
     advertises the identical ``regular_ts`` (same padded length, same
@@ -874,7 +908,8 @@ def concat_blocks(blocks, force_raw: bool = False) -> StagedBlock:
     single-dispatch fused aggregate; otherwise the superblock runs the
     general kernels. ``force_raw`` always materializes the raw sidecar
     (filling from vals where a block has none) for consumers that index it
-    unconditionally (the mesh stacking path)."""
+    unconditionally (the mesh stacking path); histogram blocks never carry
+    one."""
     real = [b for b in blocks if b.n_series > 0]
     if not real:  # keep an empty-but-shaped block (mesh rows can be empty)
         real = list(blocks[:1])
@@ -882,12 +917,21 @@ def concat_blocks(blocks, force_raw: bool = False) -> StagedBlock:
     T = max(b.ts.shape[1] for b in real)
     S = sum(b.n_series for b in real)
     Sp = pad_series(S)
+    is_hist = any(np.asarray(b.vals).ndim == 3 for b in real)
+    if is_hist:
+        assert len({np.asarray(b.vals).shape[2] for b in real}) == 1, (
+            "histogram blocks must share one bucket scheme before concat"
+        )
+        B = np.asarray(real[0].vals).shape[2]
+        val_shape, base_shape = (Sp, T, B), (Sp, B)
+    else:
+        val_shape, base_shape = (Sp, T), (Sp,)
     ts = np.full((Sp, T), TS_PAD, np.int32)
-    vals = np.zeros((Sp, T), np.float32)
-    any_raw = force_raw or any(b.raw is not None for b in real)
+    vals = np.zeros(val_shape, np.float32)
+    any_raw = (force_raw or any(b.raw is not None for b in real)) and not is_hist
     raw = np.zeros((Sp, T), np.float32) if any_raw else None
     lens = np.zeros(Sp, np.int32)
-    baseline = np.zeros(Sp, np.float32)
+    baseline = np.zeros(base_shape, np.float32)
     part_refs: list = []
     o = 0
     for b in real:
@@ -931,31 +975,24 @@ class SuperblockCache:
     bounded by entry count and bytes."""
 
     def __init__(self, max_entries: int = 8, max_bytes: int = 8 << 30):
+        from ..singleflight import KeyedSingleFlight
+
         self.max_entries = max_entries
         self.max_bytes = max_bytes
         self._d: OrderedDict = OrderedDict()
         self._lock = threading.Lock()
-        self._build_locks: dict = {}
+        self._flight = KeyedSingleFlight(
+            max_keys=4 * max_entries, alive=lambda k: k in self._d
+        )
 
     def build_lock(self, key) -> threading.Lock:
-        """Per-key single-flight for builders: concurrent identical cold
-        queries serialize on this lock so only one concatenates + uploads
-        the superblock; the rest hit its freshly-put entry. Locks for keys
-        no longer cached are pruned opportunistically (a racer holding a
-        pruned lock merely degrades to a duplicate build)."""
-        with self._lock:
-            lk = self._build_locks.get(key)
-            if lk is None:
-                if len(self._build_locks) > 4 * self.max_entries:
-                    self._build_locks = {
-                        k: v for k, v in self._build_locks.items()
-                        if k in self._d
-                    }
-                lk = self._build_locks.get(key)
-            if lk is None:
-                lk = threading.Lock()
-                self._build_locks[key] = lk
-            return lk
+        """Per-key single-flight for builders (the shared
+        filodb_tpu/singleflight utility): concurrent identical cold queries
+        serialize on this lock so only one concatenates + uploads the
+        superblock; the rest hit its freshly-put entry. Locks for keys no
+        longer cached are pruned opportunistically (a racer holding a pruned
+        lock merely degrades to a duplicate build)."""
+        return self._flight.lock(key)
 
     def get(self, key, versions: tuple):
         with self._lock:

@@ -8,8 +8,6 @@ ReduceAggregateExec network gather this replaces).
 
 from __future__ import annotations
 
-import threading
-
 import numpy as np
 
 from ..ops import aggregations as AGG
@@ -22,54 +20,20 @@ from . import mesh as M
 
 # device-resident WindowMatrices keyed by (grid bytes, query params); shared
 # across exec instances — repeated queries skip host precompute + uploads.
-# Guarded: the bounded QueryScheduler runs queries concurrently.
-from collections import OrderedDict
+# Single-flight + LRU via the one shared utility (filodb_tpu/singleflight):
+# two racing same-key misses would each upload the full matrix set to HBM
+# and the loser's copy would linger until GC.
+from ..singleflight import SingleFlightLRU
 
-
-class _WMEntry:
-    """Cache slot reserved BEFORE construction: the per-entry lock makes
-    exactly one thread build the device-resident matrices while concurrent
-    same-key misses wait for it — two racing builders would each upload the
-    full matrix set to HBM and the loser's copy would linger until GC."""
-
-    __slots__ = ("lock", "wm")
-
-    def __init__(self):
-        self.lock = threading.Lock()
-        self.wm = None
-
-
-_WM_CACHE: "OrderedDict[object, _WMEntry]" = OrderedDict()
-_WM_LOCK = threading.Lock()
-_WM_CAPACITY = 16
+_WM_CACHE = SingleFlightLRU(capacity=16)
 
 
 def _get_wm(wm_key, ctor):
     """Get-or-create a device-resident window-matrices object in the shared
     bounded cache (one lock/eviction discipline for every mesh fast path).
-    LRU on hit; a hit on an entry still being built blocks on its lock until
-    the single builder finishes."""
-    with _WM_LOCK:
-        entry = _WM_CACHE.get(wm_key)
-        if entry is not None:
-            _WM_CACHE.move_to_end(wm_key)
-        else:
-            entry = _WMEntry()
-            while len(_WM_CACHE) >= _WM_CAPACITY:
-                _WM_CACHE.popitem(last=False)
-            _WM_CACHE[wm_key] = entry
-    if entry.wm is None:
-        with entry.lock:
-            if entry.wm is None:
-                try:
-                    entry.wm = ctor()
-                except BaseException:
-                    # never leave a permanently-empty slot behind
-                    with _WM_LOCK:
-                        if _WM_CACHE.get(wm_key) is entry:
-                            del _WM_CACHE[wm_key]
-                    raise
-    return entry.wm
+    LRU on hit; a concurrent same-key miss blocks on the key's flight lock
+    until the single builder finishes."""
+    return _WM_CACHE.get_or_build(wm_key, ctor)
 
 
 def _harmonized_masked_grid(nb):

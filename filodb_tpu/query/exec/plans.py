@@ -394,24 +394,10 @@ class SelectRawPartitionsExec(ExecPlan):
             labels = [dict(p.tags) for p in parts]
             if is_hist and hist_bucket_le is not None and les is not None:
                 # m_bucket{le=...}: slice one bucket into a scalar block
-                les64 = np.asarray(les, dtype=np.float64)
-                if np.isinf(hist_bucket_le):
-                    b_idx = len(les64) - 1
-                else:
-                    hits = np.nonzero(np.abs(les64 - hist_bucket_le) < 1e-10)[0]
-                    b_idx = int(hits[0]) if len(hits) else -1
-                if b_idx < 0:
+                sliced = _slice_bucket(block, les, hist_bucket_le)
+                if sliced is None:
                     continue  # no such bucket
-                vals3 = np.asarray(block.vals)
-                scalar_vals = np.ascontiguousarray(vals3[..., b_idx])
-                block = ST.StagedBlock(
-                    block.ts, scalar_vals, block.lens, block.base_ms,
-                    np.asarray(block.baseline)[..., b_idx]
-                    if np.asarray(block.baseline).ndim == 2 else block.baseline,
-                    block.n_series, block.part_refs, raw=scalar_vals,
-                    regular_ts=block.regular_ts,
-                )
-                le_str = "+Inf" if np.isinf(les64[b_idx]) else f"{les64[b_idx]:g}"
+                block, le_str = sliced
                 labels = [dict(l, le=le_str) for l in labels]
                 is_hist = False
                 is_counter = True
@@ -772,6 +758,18 @@ def _partial_aggregate(op: str, grids: list[Grid], by, without):
     if hists is not None:
         if op != "sum":
             raise QueryError(f"aggregation {op} not supported over native histograms (use sum)")
+        from ...core.histograms import unify_schemes
+
+        les_list = [g.les for g in grids if g.les is not None]
+        if len(les_list) == len(grids):
+            # heterogeneous bucket schemes in one gather: unify onto the
+            # union bounds (same rule as the fused superblock concat)
+            unified, union, changed = unify_schemes(hists, les_list)
+            if changed:
+                from dataclasses import replace as _replace
+
+                hists = unified
+                meta = _replace(meta, les=union)
         H = np.concatenate(hists, axis=0)  # [S, J, B]
         S, Jh, B = H.shape
         flat = np.asarray(
@@ -781,10 +779,42 @@ def _partial_aggregate(op: str, grids: list[Grid], by, without):
     return group_labels, comps, meta
 
 
+def _unify_hist_partials(partials):
+    """Pre-pass for _merge_partials: shard/peer partials carrying ``hist``
+    components on DIFFERENT bucket schemes remap onto the union bounds
+    (core.histograms.remap_buckets — the one unification rule, shared with
+    the fused superblock concat) so the component-wise merge below adds
+    aligned buckets."""
+    hist_idx = [
+        i for i, (_, comps, m) in enumerate(partials)
+        if "hist" in comps and m is not None and m.les is not None
+    ]
+    if len(hist_idx) <= 1:
+        return partials
+    from ...core.histograms import unify_schemes
+
+    unified, union, changed = unify_schemes(
+        [partials[i][1]["hist"] for i in hist_idx],
+        [partials[i][2].les for i in hist_idx],
+    )
+    if not changed:
+        return partials
+    from dataclasses import replace as _replace
+
+    out = list(partials)
+    for i, h in zip(hist_idx, unified):
+        gl, comps, m = partials[i]
+        comps = dict(comps)
+        comps["hist"] = h
+        out[i] = (gl, comps, _replace(m, les=union))
+    return out
+
+
 def _merge_partials(op: str, partials):
     """Reduce phase: merge shard partials by group label key."""
     key_to: dict[tuple, dict] = {}
     meta = None
+    partials = _unify_hist_partials(partials)
     for group_labels, comps, m in partials:
         if m is not None:
             meta = m
@@ -946,9 +976,112 @@ class ReduceAggregateExec(NonLeafExecPlan):
         return _present(self.op, key_to, meta)
 
 
+@dataclass
+class SuperblockEntry:
+    """One cached cross-shard superblock + everything do_execute needs to
+    dispatch on it (SuperblockCache value)."""
+
+    block: Any  # ST.StagedBlock, [ΣS, T] or [ΣS, T, B] device-resident
+    labels: list  # [ΣS] per-series label dicts
+    is_counter: bool
+    is_delta: bool
+    samples: int  # scanned sample count (stats/limit accounting; PRE-slice,
+    # like the reference path — a le= slice that drops a shard still scanned it)
+    max_shard_series: int  # per-shard limit re-enforcement on cache hits
+    series: int = 0  # scanned series count (pre-slice, see samples)
+    is_hist: bool = False
+    les: Any = None  # [B] unified bucket bounds (histogram blocks)
+    les_dev: Any = None  # device f32 copy for the fused quantile epilogue
+
+
+def _unify_hist_blocks(blocks, block_les):
+    """Put per-shard histogram blocks on ONE bucket scheme: the union of the
+    shards' ``le`` bounds, missing bounds completed from the nearest lower
+    bound (core.histograms.remap_buckets — the same rule the reference
+    partial-merge path applies, so fused and reference stay bit-identical).
+    Returns (blocks', union_les); blocks with the union scheme pass through
+    untouched."""
+    from ...core.histograms import remap_buckets, unify_schemes
+
+    vals_in = [np.asarray(b.vals) for b in blocks]
+    vals_out, union, changed = unify_schemes(vals_in, block_les)
+    if not changed:
+        return blocks, union
+    out = []
+    for b, v_in, v_out, l in zip(blocks, vals_in, vals_out, block_les):
+        if v_out is v_in:  # already on the union scheme
+            out.append(b)
+            continue
+        baseline = np.asarray(b.baseline)
+        if baseline.ndim == 2:
+            baseline = remap_buckets(baseline, l, union)
+        # remapping touches only the bucket axis: the shared regular time
+        # grid (the fused shared-window fast path) survives verbatim
+        out.append(ST.StagedBlock(
+            np.asarray(b.ts), v_out, np.asarray(b.lens), b.base_ms, baseline,
+            b.n_series, list(b.part_refs), regular_ts=b.regular_ts,
+        ))
+    return out, union
+
+
+def _uniform_scheme(parts, les) -> bool:
+    """True when every partition in a shard carries the SAME bucket scheme
+    (core.histograms.same_scheme). A shard mixing schemes (mid-rollout
+    bound change) cannot stage as one [S, T, B] block with a single ``le``
+    vector — the fused path must fall back rather than silently attribute
+    one scheme's counts to another's bounds."""
+    from ...core.histograms import same_scheme
+
+    if les is None:
+        return False
+    for p in parts[1:]:
+        other = p.bucket_les
+        if other is None:
+            return False
+        if other is not les and not same_scheme(other, les):
+            return False
+    return True
+
+
+def _slice_bucket(block, les, bucket_le: float):
+    """``m_bucket{le=...}``: slice one bucket of a staged [S, T, B] block
+    into a scalar counter block — the ONE definition of le-selection
+    semantics, shared by the fused builder and SelectRawPartitionsExec.
+    Returns (block, le_label) or None when the scheme has no such bound
+    (same tolerance as histogram_bucket)."""
+    from ...core.histograms import _LE_TOL
+
+    if les is None:
+        return None
+    les64 = np.asarray(les, dtype=np.float64)
+    if np.isinf(bucket_le):
+        b_idx = len(les64) - 1
+    else:
+        hits = np.nonzero(np.abs(les64 - bucket_le) < _LE_TOL)[0]
+        b_idx = int(hits[0]) if len(hits) else -1
+    if b_idx < 0:
+        return None
+    vals3 = np.asarray(block.vals)
+    scalar_vals = np.ascontiguousarray(vals3[..., b_idx])
+    baseline = np.asarray(block.baseline)
+    sliced = ST.StagedBlock(
+        block.ts, scalar_vals, block.lens, block.base_ms,
+        baseline[..., b_idx] if baseline.ndim == 2 else baseline,
+        block.n_series, block.part_refs, raw=scalar_vals,
+        regular_ts=block.regular_ts,
+    )
+    le_str = "+Inf" if np.isinf(les64[b_idx]) else f"{les64[b_idx]:g}"
+    return sliced, le_str
+
+
 # aggregation ops the fused single-dispatch path computes exactly as one
 # on-device segment reduce (ops/aggregations.fused_range_aggregate)
 FUSED_AGG_OPS = frozenset({"sum", "count", "avg", "min", "max"})
+
+# aggregation ops the fused path computes as a device-side EPILOGUE fused
+# into the same program (ops/aggregations.fused_topk / fused_quantile):
+# only [k, J] / [G, J] arrays ever reach the host
+FUSED_EPI_OPS = frozenset({"topk", "bottomk", "quantile"})
 
 # range functions the fused path supports: everything the shape-static range
 # kernels compute on device, minus host-path timestamp, per-window sorts,
@@ -977,19 +1110,32 @@ class FusedAggregateExec(ExecPlan):
     incrementally via append_to_block before re-concatenation. Label
     grouping memoizes on the superblock (ops/aggregations.group_ids_memo).
 
+    Histogram schemas run the 3-D variant: per-shard ``[S, T, B]`` bucket
+    blocks concatenate into one ``[ΣS, T, B]`` superblock (heterogeneous
+    ``le`` schemes unified onto the union bounds first,
+    core.histograms.remap_buckets) and one compiled hist range_fn ->
+    per-bucket segment-sum program returns [G, J, B] partials — or, with
+    ``hist_quantile`` set (the planner recognized
+    ``histogram_quantile(q, sum by (...) (rate(m_bucket[w])))``), just the
+    [G, J] interpolated quantile grid. ``topk``/``bottomk``/``quantile``
+    aggregates fuse their epilogue the same way (FUSED_EPI_OPS).
+
     ``fallback`` is the reference tree
     (ReduceAggregateExec -> N x SelectRawPartitionsExec); execution falls
-    back to it — annotating the span with the reason — for partial-results
-    mode, fault-injection dispatchers, histogram schemas, mixed schemas, or
-    anything else the fused kernel doesn't model. It is passed as a
-    zero-arg factory and materialized lazily on first use: the happy path
-    must not pay plan-time construction of O(shards) leaves it discards
-    (at 128 shards that is exactly the linear cost this node removes)."""
+    back to it — annotating the span with the reason and bumping
+    ``filodb_fused_fallback_total{reason=...}`` — for partial-results
+    mode, fault-injection dispatchers, mixed schemas, or anything else the
+    fused kernel doesn't model (doc/perf.md lists the reason taxonomy). It
+    is passed as a zero-arg factory and materialized lazily on first use:
+    the happy path must not pay plan-time construction of O(shards) leaves
+    it discards (at 128 shards that is exactly the linear cost this node
+    removes)."""
 
     def __init__(self, shard_nums, filters, raw_start_ms: int, raw_end_ms: int,
                  column, op: str, by, without, function,
                  start_ms: int, end_ms: int, step_ms: int, window_ms: int,
-                 offset_ms: int, fallback):
+                 offset_ms: int, fallback, params=(),
+                 hist_quantile: float | None = None):
         super().__init__()
         self.shard_nums = list(shard_nums)
         self.filters = tuple(filters)
@@ -1005,6 +1151,8 @@ class FusedAggregateExec(ExecPlan):
         self.step_ms = step_ms
         self.window_ms = window_ms
         self.offset_ms = offset_ms
+        self.params = tuple(params)  # k for topk/bottomk, q for quantile
+        self.hist_quantile = hist_quantile  # fused histogram_quantile(q, ..)
         self._fallback_factory = fallback
         self._fallback: ExecPlan | None = None
 
@@ -1016,31 +1164,65 @@ class FusedAggregateExec(ExecPlan):
 
     def args_str(self) -> str:
         fs = ",".join(f"{f.column}{f.op}{f.value}" for f in self.filters)
+        extra = f" params={self.params}" if self.params else ""
+        if self.hist_quantile is not None:
+            extra += f" hist_q={self.hist_quantile}"
         return (
             f"op={self.op} fn={self.function} by={self.by} "
             f"without={self.without} shards={self.shard_nums} filters=[{fs}]"
+            f"{extra}"
         )
 
     def _fall(self, ctx: QueryContext, reason: str) -> QueryResult:
-        from ...metrics import current_span
+        from ...metrics import current_span, record_fused_fallback
 
         s = current_span()
         if s is not None:
             s.tags["fused_fallback"] = reason
+        record_fused_fallback(reason)
         return self.fallback.execute(ctx)
 
     def num_steps(self) -> int:
         return int((self.end_ms - self.start_ms) // self.step_ms) + 1
 
-    def _serve_hit(self, ctx: QueryContext, hit):
+    def _unsupported_shape(self, is_hist: bool) -> str | None:
+        """Fallback reason when the fused kernels don't model this
+        op/function on the resolved schema, or None when fused dispatch can
+        proceed. Decided BEFORE the stats bump (the reference tree bumps
+        its own scan stats — deciding later would double-count against
+        per-request limits) and, on cold builds, before any staging (a
+        discarded [ΣS, T, B] build would evict cache entries for nothing)."""
+        from ...ops.hist_kernels import FUSED_HIST_FUNCS
+
+        if is_hist:
+            # hist kernel models only plain sum over the hist range funcs
+            if self.op != "sum" or self.params:
+                return "hist_op"
+            if (self.function or "last") not in FUSED_HIST_FUNCS:
+                return "hist_func"
+        elif self.hist_quantile is not None:
+            # planner recognized histogram_quantile over this aggregate but
+            # the selection resolved to a scalar schema: the reference tree
+            # raises the proper "needs native-histogram input" QueryError
+            return "hist_quantile_scalar"
+        return None
+
+    def _serve_hit(self, ctx: QueryContext, hit: "SuperblockEntry"):
         """Limit + stats enforcement for a cached superblock: limits are
         PER REQUEST (execute_plan narrows them), so a cache hit must never
-        serve a query whose limits the build path would have rejected."""
-        if hit[5] > ctx.max_series:
+        serve a query whose limits the build path would have rejected.
+        Returns a fallback-reason string instead when this query's op/func
+        can't dispatch on the cached block's schema."""
+        reason = self._unsupported_shape(hit.is_hist)
+        if reason is not None:
+            return reason
+        if hit.max_shard_series > ctx.max_series:
             raise QueryError(
-                f"query selects {hit[5]} series > limit {ctx.max_series}"
+                f"query selects {hit.max_shard_series} series > limit "
+                f"{ctx.max_series}"
             )
-        ctx.stats.bump(series_scanned=hit[0].n_series, samples_scanned=hit[4])
+        ctx.stats.bump(series_scanned=hit.series or hit.block.n_series,
+                       samples_scanned=hit.samples)
         if ctx.stats.samples_scanned > ctx.max_samples:
             raise QueryError(
                 f"query would scan {ctx.stats.samples_scanned} samples > "
@@ -1049,10 +1231,10 @@ class FusedAggregateExec(ExecPlan):
         return hit
 
     def _superblock(self, ctx: QueryContext, stage_mode: str):
-        """(block, labels, is_counter, is_delta, samples, max_shard_series)
-        from the shard-version-keyed superblock cache, rebuilding through
-        the per-shard cached staging path on miss. Returns a fallback-reason
-        string instead when the selection needs the reference tree."""
+        """SuperblockEntry from the shard-version-keyed superblock cache,
+        rebuilding through the per-shard cached staging path on miss.
+        Returns a fallback-reason string instead when the selection needs
+        the reference tree, or None for an empty selection."""
         cache = getattr(ctx.memstore, "_superblock_cache", None)
         if cache is None:
             cache = ST.SuperblockCache()
@@ -1098,27 +1280,37 @@ class FusedAggregateExec(ExecPlan):
 
     def _build_superblock(self, ctx: QueryContext, stage_mode: str, cache,
                           sb_key, versions, hints, hint_key):
-        blocks, labels = [], []
+        rewritten, col_override, bucket_le = _histogram_suffix_rewrite(
+            self.filters
+        )
+        blocks, labels, block_les = [], [], []
         schema_name = None
-        is_counter = is_delta = False
-        total = max_shard_series = 0
+        is_counter = is_delta = is_hist = sliced_hist = False
+        total = max_shard_series = dropped_samples = 0
         for s in self.shard_nums:
             ctx.check_deadline()
             shard = ctx.memstore.shard(ctx.dataset, s)
             pids = shard.lookup_partitions(
                 self.filters, self.raw_start_ms, self.raw_end_ms
             )
+            suffixed = False
+            if not len(pids) and rewritten is not None:
+                # classic-histogram suffix selector (m_sum / m_count /
+                # m_bucket): stage the base histogram schema's columns, same
+                # per-shard rewrite SelectRawPartitionsExec applies
+                pids = shard.lookup_partitions(
+                    rewritten, self.raw_start_ms, self.raw_end_ms
+                )
+                suffixed = len(pids) > 0
             if not len(pids):
-                rewritten, _c, _le = _histogram_suffix_rewrite(self.filters)
-                if rewritten is not None and len(shard.lookup_partitions(
-                        rewritten, self.raw_start_ms, self.raw_end_ms)):
-                    return "histogram_suffix"
                 continue
             if len(pids) > ctx.max_series:
                 # same per-shard limit semantics as SelectRawPartitionsExec
                 raise QueryError(
                     f"query selects {len(pids)} series > limit {ctx.max_series}"
                 )
+            # pre-slice accounting, matching the reference path (it bumps
+            # stats and enforces the per-shard limit before le= slicing)
             total += len(pids)
             max_shard_series = max(max_shard_series, len(pids))
             if shard.odp_store is not None:
@@ -1130,18 +1322,28 @@ class FusedAggregateExec(ExecPlan):
                 return "mixed_schemas"
             schema_name = parts[0].schema.name
             schema = parts[0].schema
-            col_name = self.column or schema.value_column
+            col_name = self.column or (suffixed and col_override) \
+                or schema.value_column
             try:
                 col = schema.column(col_name)
             except KeyError:
                 col_name = schema.value_column
                 col = schema.column(col_name)
-            if col.ctype == ColumnType.HISTOGRAM:
-                return "histogram"
+            hist_col = col.ctype == ColumnType.HISTOGRAM
+            # op/func support is decidable as soon as the schema resolves —
+            # bail before staging uploads a [S, T, B] block only to discard
+            # it (a le= slice lands scalar, so it follows the scalar rules)
+            reason = self._unsupported_shape(hist_col and bucket_le is None)
+            if reason is not None:
+                return reason
             is_counter = col.is_counter
             is_delta = col.is_delta
+            # histogram columns always stage raw (reference: correction only
+            # inside rate-family RangeFunctions; hist kernels window raw
+            # cumulative bucket counts directly)
             mode = (
-                stage_mode if is_counter and not is_delta else "raw"
+                stage_mode if is_counter and not is_delta and not hist_col
+                else "raw"
             )
             cache_key = (
                 self.filters, self.raw_start_ms, self.raw_end_ms, col_name,
@@ -1151,32 +1353,69 @@ class FusedAggregateExec(ExecPlan):
                 ctx, shard, pids, cache_key, col_name, self.raw_start_ms,
                 self.raw_end_ms, mode,
             )
-            if np.asarray(block.vals).ndim != 2:
-                return "histogram"
+            part_labels = [dict(p.tags) for p in parts]
+            les = parts[0].bucket_les if hist_col else None
+            if hist_col and not _uniform_scheme(parts, les):
+                # no scheme at all, or partitions WITHIN this shard disagree
+                # on bounds: one [S, T, B] block can't represent them (the
+                # union remap is per-shard) — keep the pre-fusion behavior
+                return "hist_scheme"
+            if hist_col and bucket_le is not None:
+                # m_bucket{le=...}: slice ONE bucket into a scalar block
+                # (same selection semantics as SelectRawPartitionsExec)
+                sliced = _slice_bucket(block, les, bucket_le)
+                if sliced is None:
+                    # no such bucket on this shard: it contributes no rows,
+                    # but its series/samples were scanned — count them, as
+                    # the reference path does (it bumps before slicing)
+                    dropped_samples += int(np.asarray(block.lens).sum())
+                    continue
+                block, le_str = sliced
+                part_labels = [dict(l, le=le_str) for l in part_labels]
+                les = None
+                hist_col = False
+                sliced_hist = True
+                is_counter, is_delta = True, False
+            if hist_col != is_hist and blocks:
+                return "mixed_schemas"  # scalar + histogram blocks can't mix
+            is_hist = hist_col
+            if np.asarray(block.vals).ndim != (3 if hist_col else 2):
+                return "mixed_schemas"
             blocks.append(block)
-            labels.extend(dict(p.tags) for p in parts)
+            block_les.append(les)
+            labels.extend(part_labels)
         if schema_name is not None:
             if len(hints) >= 1024:
                 hints.clear()  # bounded: hints are one dict lookup to relearn
-            hints[hint_key] = (is_counter, is_delta)
+            # histogram columns always stage raw — including a le= slice of
+            # one (sliced AFTER raw staging) — so key them like gauges: one
+            # superblock serves every range function over the selector
+            hints[hint_key] = (is_counter and not is_hist and not sliced_hist,
+                               is_delta)
         if not blocks:
             return None  # empty selection: empty result, not a fallback
-        samples = int(sum(int(np.asarray(b.lens).sum()) for b in blocks))
+        samples = dropped_samples + int(
+            sum(int(np.asarray(b.lens).sum()) for b in blocks)
+        )
         ctx.stats.bump(series_scanned=total, samples_scanned=samples)
         if ctx.stats.samples_scanned > ctx.max_samples:
             raise QueryError(
                 f"query would scan {ctx.stats.samples_scanned} samples > "
                 f"limit {ctx.max_samples}"
             )
+        les = None
+        if is_hist:
+            blocks, les = _unify_hist_blocks(blocks, block_les)
         super_block = ST.concat_blocks(blocks).to_device()
-        nbytes = int(
-            np.asarray(super_block.ts).nbytes
-            + np.asarray(super_block.vals).nbytes
-            + (np.asarray(super_block.raw).nbytes
-               if super_block.raw is not None else 0)
+        nbytes = ST.staged_nbytes(super_block)
+        import jax
+
+        value = SuperblockEntry(
+            super_block, labels, is_counter, is_delta, samples,
+            max_shard_series, series=total, is_hist=is_hist, les=les,
+            les_dev=(jax.device_put(np.asarray(les, dtype=np.float32))
+                     if les is not None else None),
         )
-        value = (super_block, labels, is_counter, is_delta, samples,
-                 max_shard_series)
         # versions re-read AFTER staging: an ingest that landed mid-build
         # makes the entry unservable for the next query (version mismatch),
         # so only cache when nothing moved
@@ -1189,7 +1428,7 @@ class FusedAggregateExec(ExecPlan):
 
     def do_execute(self, ctx: QueryContext) -> QueryResult:
         from ...metrics import span
-        from ...ops.kernels import RangeParams, pad_steps
+        from ...ops.kernels import RangeParams
 
         if getattr(ctx, "allow_partial_results", False):
             # the fused program is all-or-nothing; partial-results queries
@@ -1207,24 +1446,93 @@ class FusedAggregateExec(ExecPlan):
             return self._fall(ctx, got)
         if got is None:
             return QueryResult()
-        block, labels, is_counter, is_delta, _samples, _max_shard = got
-        strip = self.function is not None and self.function not in _DROP_NAME_KEEP
-        gids_dev, G, group_labels = AGG.group_ids_memo(
-            block, labels, self.by, self.without, strip_metric=strip
-        )
         nsteps = self.num_steps()
         params = RangeParams(
             self.start_ms - self.offset_ms, self.step_ms, nsteps,
             self.window_ms,
         )
+        strip = self.function is not None and self.function not in _DROP_NAME_KEEP
+        if got.is_hist:
+            # 3-D histogram superblock: per-bucket fused sum (+ optional
+            # device-side histogram_quantile interpolation epilogue).
+            # op/func support was already vetted (_unsupported_shape) before
+            # the superblock's stats bump, on both the hit and build paths.
+            gids_dev, G, group_labels = AGG.group_ids_memo(
+                got.block, got.labels, self.by, self.without,
+                strip_metric=strip,
+            )
+            with span(f"fused:dispatch:hist_{func}"):
+                out = AGG.fused_hist_range_aggregate(
+                    func, got.block, gids_dev, G, params, got.les_dev,
+                    q=self.hist_quantile, is_delta=got.is_delta,
+                )
+            if self.hist_quantile is not None:
+                # quantile fused on device: [G, J] is all that comes back
+                labels = [_strip_metric(l) for l in group_labels]
+                return QueryResult(grids=[
+                    Grid(labels, self.start_ms, self.step_ms, nsteps, out)
+                ])
+            placeholder = np.full((G, nsteps), np.nan, np.float32)
+            return QueryResult(grids=[
+                Grid(group_labels, self.start_ms, self.step_ms, nsteps,
+                     placeholder, hist=out, les=got.les)
+            ])
+        if self.op in ("topk", "bottomk"):
+            k = max(int(self.params[0]), 1)
+            with span(f"fused:dispatch:{self.op}:{func}"):
+                vals_dev, idx_dev = AGG.fused_topk(
+                    func, got.block, k, self.op == "bottomk", params,
+                    is_counter=got.is_counter, is_delta=got.is_delta,
+                )
+            return self._present_topk(
+                np.asarray(vals_dev)[:, :nsteps],
+                np.asarray(idx_dev)[:, :nsteps], got.labels, strip, nsteps,
+            )
+        gids_dev, G, group_labels = AGG.group_ids_memo(
+            got.block, got.labels, self.by, self.without, strip_metric=strip
+        )
+        if self.op == "quantile":
+            q = float(self.params[0])
+            with span(f"fused:dispatch:quantile:{func}"):
+                out = AGG.fused_quantile(
+                    func, got.block, gids_dev, G, q, params,
+                    is_counter=got.is_counter, is_delta=got.is_delta,
+                )
+            return QueryResult(grids=[
+                Grid(group_labels, self.start_ms, self.step_ms, nsteps, out)
+            ])
         with span(f"fused:dispatch:{func}"):
             out = AGG.fused_range_aggregate(
-                func, self.op, block, gids_dev, G, params,
-                is_counter=is_counter, is_delta=is_delta,
+                func, self.op, got.block, gids_dev, G, params,
+                is_counter=got.is_counter, is_delta=got.is_delta,
             )
         return QueryResult(
             grids=[Grid(group_labels, self.start_ms, self.step_ms, nsteps, out)]
         )
+
+    def _present_topk(self, vals, idx, labels, strip: bool,
+                      nsteps: int) -> QueryResult:
+        """Reconstruct Prometheus topk/bottomk rows from the compact [k, J]
+        winner set: each surviving series keeps its own labels with values
+        only at steps it won (NaN elsewhere) — exactly the ``topk_mask``
+        output restricted to rows that survive, built host-side in
+        O(k*J)."""
+        finite = np.isfinite(vals)
+        used = np.unique(idx[finite])
+        out_labels, rows = [], []
+        for s in used:
+            m = (idx == s) & finite
+            row = np.full(nsteps, np.nan, np.float32)
+            r_i, c_i = np.nonzero(m)
+            row[c_i] = vals[r_i, c_i]
+            lbls = labels[int(s)]
+            out_labels.append(_strip_metric(lbls) if strip else lbls)
+            rows.append(row)
+        v = (np.stack(rows) if rows
+             else np.zeros((0, nsteps), np.float32))
+        return QueryResult(grids=[
+            Grid(out_labels, self.start_ms, self.step_ms, nsteps, v)
+        ])
 
 
 class PartialReduceExec(NonLeafExecPlan):

@@ -184,9 +184,15 @@ def test_fused_plan_selection(engines):
     q = "sum(rate(http_requests_total[5m]))"
     assert type(_plan_root(fused, q)).__name__ == "FusedAggregateExec"
     assert type(_plan_root(ref, q)).__name__ == "ReduceAggregateExec"
+    # epilogue ops fuse too: global topk/bottomk and (grouped) quantile
+    for q in ("topk(3, rate(http_requests_total[5m]))",
+              "bottomk(2, heap_usage0)",
+              "quantile(0.9, rate(http_requests_total[5m]))"):
+        assert type(_plan_root(fused, q)).__name__ == "FusedAggregateExec", q
     # non-fusable shapes keep the reference tree on the fused engine
+    # (grouped topk keeps the per-shard candidate pre-reduction tree)
     for q in ("stddev(rate(http_requests_total[5m]))",
-              "topk(3, rate(http_requests_total[5m]))",
+              "topk by (job) (3, rate(http_requests_total[5m]))",
               "sum(quantile_over_time(0.9, heap_usage0[3m]))"):
         assert type(_plan_root(fused, q)).__name__ != "FusedAggregateExec", q
 
@@ -317,25 +323,61 @@ def test_get_wm_single_construction_under_race():
         t.join()
     assert len(built) == 1
     assert all(r is results[0] for r in results)
-    with PX._WM_LOCK:
-        PX._WM_CACHE.pop(("race-key",), None)
+    PX._WM_CACHE.pop(("race-key",))
 
 
 def test_get_wm_lru_on_hit():
     from filodb_tpu.parallel import exec as PX
 
-    with PX._WM_LOCK:
-        saved = dict(PX._WM_CACHE)
-        PX._WM_CACHE.clear()
+    saved = [(k, PX._WM_CACHE.pop(k)) for k in PX._WM_CACHE.keys()]
     try:
-        for i in range(PX._WM_CAPACITY):
+        for i in range(PX._WM_CACHE.capacity):
             PX._get_wm(("lru", i), lambda i=i: i)
         PX._get_wm(("lru", 0), lambda: "rebuilt?")  # hit refreshes slot 0
         PX._get_wm(("lru", "new"), lambda: "new")    # evicts ("lru", 1)
-        with PX._WM_LOCK:
-            assert ("lru", 0) in PX._WM_CACHE
-            assert ("lru", 1) not in PX._WM_CACHE
+        assert ("lru", 0) in PX._WM_CACHE
+        assert ("lru", 1) not in PX._WM_CACHE
     finally:
-        with PX._WM_LOCK:
-            PX._WM_CACHE.clear()
-            PX._WM_CACHE.update(saved)
+        PX._WM_CACHE.clear()
+        for k, v in saved:
+            PX._get_wm(k, lambda v=v: v)
+
+
+def test_memo_on_single_build_under_race():
+    """The shared memo_on helper (window matrices / group ids): concurrent
+    same-key misses on one object build once; different keys never clobber
+    each other's attached memo dict."""
+    from filodb_tpu.singleflight import memo_on
+
+    class Obj:
+        pass
+
+    o = Obj()
+    built = []
+    gate = threading.Barrier(6)
+
+    def worker(key):
+        gate.wait()
+        memo_on(o, "_memo", key, lambda: built.append(key) or key)
+
+    threads = [
+        threading.Thread(target=worker, args=(k,))
+        for k in ("a", "a", "a", "b", "b", "c")
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sorted(built) == ["a", "b", "c"]  # one build per key
+    assert o._memo == {"a": "a", "b": "b", "c": "c"}  # no dict clobbering
+
+
+def test_keyed_single_flight_prunes_lock_table():
+    from filodb_tpu.singleflight import KeyedSingleFlight
+
+    sf = KeyedSingleFlight(max_keys=8, alive=lambda k: k == "keep")
+    keep_lock = sf.lock("keep")
+    for i in range(20):
+        sf.lock(("k", i))
+    assert len(sf) <= 9  # pruned down around the cap
+    assert sf.lock("keep") is keep_lock  # alive keys survive pruning

@@ -1,0 +1,503 @@
+"""Fused histogram & epilogue pipeline (doc/perf.md).
+
+Parity contract: the single-dispatch histogram path (3-D superblock ->
+fused hist range_fn -> per-bucket segment-sum -> optional device-side
+histogram_quantile) and the fused topk/bottomk/quantile epilogues must
+agree with the reference scatter/partial-merge tree — identical NaN masks
+and label sets, values within float32 accumulation-order tolerance — across
+native-histogram selectors, classic-histogram suffix rewrites (_sum /
+_count / _bucket incl. le= and +Inf selection), and heterogeneous bucket
+schemes across shards.
+
+Plus the O(1) dispatch guarantee: the canonical SRE query
+``histogram_quantile(0.99, sum by (le) (rate(m_bucket[5m])))`` plans to the
+fused path (no fused_fallback span tag) and issues exactly ONE kernel
+dispatch warm; topk/quantile epilogues likewise, returning only [k, J] /
+[G, J] arrays to the host.
+"""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.coordinator.planner import PlannerParams, QueryEngine
+from filodb_tpu.core.histograms import custom_buckets
+from filodb_tpu.core.records import SeriesBatch
+from filodb_tpu.core.schemas import METRIC_TAG, PROM_HISTOGRAM, Dataset
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.testkit import counter_batch, histogram_batch
+
+pytestmark = pytest.mark.perf
+
+BASE = 1_600_000_000_000
+N_SHARDS = 4
+START = (BASE + 600_000) / 1000
+END = START + 900
+STEP = 60
+
+HQ_QUERY = (
+    'histogram_quantile(0.99, '
+    'sum by (le) (rate(http_request_latency_bucket[5m])))'
+)
+
+
+@pytest.fixture(scope="module")
+def store():
+    ms = TimeSeriesMemStore()
+    ms.setup(Dataset("ds"), list(range(N_SHARDS)))
+    ms.ingest_routed(
+        "ds",
+        histogram_batch(n_series=24, n_samples=240, start_ms=BASE,
+                        metric="http_request_latency"),
+        spread=2,
+    )
+    ms.ingest_routed(
+        "ds", counter_batch(n_series=24, n_samples=240, start_ms=BASE),
+        spread=2,
+    )
+    return ms
+
+
+@pytest.fixture(scope="module")
+def engines(store):
+    fused = QueryEngine(store, "ds")
+    ref = QueryEngine(store, "ds", PlannerParams(fused_aggregate=False))
+    return fused, ref
+
+
+def _rows(res):
+    out = {}
+    for g in res.grids:
+        for lbls, vals in zip(g.labels, g.values_np()):
+            out[tuple(sorted(lbls.items()))] = np.asarray(vals)
+    return out
+
+
+def _hist_rows(res):
+    out = {}
+    for g in res.grids:
+        h = g.hist_np()
+        if h is None:
+            continue
+        for lbls, cube in zip(g.labels, h):
+            out[tuple(sorted(lbls.items()))] = (np.asarray(cube),
+                                                np.asarray(g.les, np.float64))
+    return out
+
+
+def assert_parity(fused, ref, q, start=START, end=END, step=STEP, **kw):
+    rf = fused.query_range(q, start, end, step, **kw)
+    rr = ref.query_range(q, start, end, step, **kw)
+    a, b = _rows(rf), _rows(rr)
+    assert a.keys() == b.keys(), (q, sorted(a), sorted(b))
+    for k in a:
+        na, nb = np.isnan(a[k]), np.isnan(b[k])
+        assert (na == nb).all(), (q, k, "NaN masks differ")
+        np.testing.assert_allclose(
+            a[k][~na], b[k][~nb], rtol=2e-5, atol=1e-6, err_msg=f"{q} {k}"
+        )
+    ha, hb = _hist_rows(rf), _hist_rows(rr)
+    assert ha.keys() == hb.keys(), q
+    for k in ha:
+        ca, la = ha[k]
+        cb, lb = hb[k]
+        np.testing.assert_allclose(la, lb, err_msg=f"{q} {k} les")
+        na, nb = np.isnan(ca), np.isnan(cb)
+        assert (na == nb).all(), (q, k, "hist NaN masks differ")
+        np.testing.assert_allclose(
+            ca[~na], cb[~nb], rtol=2e-5, atol=1e-6, err_msg=f"{q} {k} hist"
+        )
+    return rf, rr
+
+
+def _plan_root(engine, q, start=START, end=END, step=STEP):
+    from filodb_tpu.query.promql import query_range_to_logical_plan
+
+    plan = query_range_to_logical_plan(q, start, end, step)
+    return engine.planner.materialize(plan)
+
+
+def _dispatch_total() -> int:
+    from filodb_tpu.metrics import REGISTRY
+
+    total = 0
+    with REGISTRY._lock:
+        for (name, _lbls), m in REGISTRY._metrics.items():
+            if name == "filodb_kernel_dispatch_seconds":
+                total += m.total
+    return total
+
+
+def _fallback_counts() -> dict:
+    from filodb_tpu.metrics import REGISTRY
+
+    out = {}
+    with REGISTRY._lock:
+        for (name, lbls), m in REGISTRY._metrics.items():
+            if name == "filodb_fused_fallback":
+                out[dict(lbls)["reason"]] = m.value
+    return out
+
+
+def _span_names_and_fallbacks(sp, acc):
+    acc.append((sp.name, sp.tags.get("fused_fallback")))
+    for c in sp.children:
+        _span_names_and_fallbacks(c, acc)
+    return acc
+
+
+# -- histogram parity --------------------------------------------------------
+
+
+@pytest.mark.parametrize("q", [
+    HQ_QUERY,
+    "histogram_quantile(0.9, sum(rate(http_request_latency[5m])))",
+    "histogram_quantile(0.5, sum(increase(http_request_latency[5m])))",
+    "histogram_quantile(0.99, sum(sum_over_time(http_request_latency[3m])))",
+    "histogram_quantile(0.9, sum(last_over_time(http_request_latency[3m])))",
+    "histogram_quantile(0.9, sum by (instance) (rate(http_request_latency[5m])))",
+])
+def test_fused_hist_quantile_parity(engines, q):
+    assert_parity(*engines, q)
+
+
+@pytest.mark.parametrize("q", [
+    "sum(rate(http_request_latency[5m]))",           # [G, J, B] hist grids
+    "sum(rate(http_request_latency_bucket[5m]))",    # suffix -> native hist
+    "sum(rate(http_request_latency_sum[5m]))",       # _sum column override
+    "sum(rate(http_request_latency_count[5m]))",     # _count column override
+    'sum(rate(http_request_latency_bucket{le="0.5"}[5m]))',   # one bucket
+    'sum(rate(http_request_latency_bucket{le="+Inf"}[5m]))',  # top bucket
+])
+def test_fused_hist_suffix_parity(engines, q):
+    assert_parity(*engines, q)
+
+
+def test_fused_hist_missing_bucket_is_empty_on_both(engines):
+    fused, ref = engines
+    q = 'sum(rate(http_request_latency_bucket{le="0.123"}[5m]))'
+    rf = fused.query_range(q, START, END, STEP)
+    rr = ref.query_range(q, START, END, STEP)
+    assert not _rows(rf) and not _rows(rr)
+
+
+def test_fused_hist_plan_and_no_fallback(engines):
+    fused, ref = engines
+    root = _plan_root(fused, HQ_QUERY)
+    assert type(root).__name__ == "FusedAggregateExec"
+    assert root.hist_quantile == pytest.approx(0.99)
+    assert type(_plan_root(ref, HQ_QUERY)).__name__ != "FusedAggregateExec"
+    rf = fused.query_range(HQ_QUERY, START, END, STEP)
+    spans = _span_names_and_fallbacks(rf.trace, [])
+    assert not any(fb for _, fb in spans), spans  # no fused_fallback tag
+
+
+def test_fused_hist_quantile_single_dispatch_warm(engines):
+    fused, _ = engines
+    for _ in range(2):  # stage + compile + fill every cache
+        fused.query_range(HQ_QUERY, START, END, STEP)
+    before = _dispatch_total()
+    fused.query_range(HQ_QUERY, START, END, STEP)
+    assert _dispatch_total() - before == 1, (
+        "warm fused histogram_quantile(sum by (le) (rate)) must issue "
+        "exactly ONE kernel dispatch"
+    )
+
+
+def test_fused_hist_unsupported_shapes_fall_back(engines):
+    """Non-sum hist aggregates and non-hist range functions delegate to the
+    reference tree (which raises the reference errors), tagging the span and
+    bumping filodb_fused_fallback_total{reason=...}."""
+    from filodb_tpu.query.exec.transformers import QueryError
+
+    fused, _ = engines
+    before = _fallback_counts()
+    with pytest.raises(QueryError):
+        fused.query_range(
+            "sum(avg_over_time(http_request_latency[3m]))", START, END, STEP)
+    with pytest.raises(QueryError):
+        fused.query_range(
+            "count(rate(http_request_latency[5m]))", START, END, STEP)
+    after = _fallback_counts()
+    assert after.get("hist_func", 0) == before.get("hist_func", 0) + 1
+    assert after.get("hist_op", 0) == before.get("hist_op", 0) + 1
+
+
+def test_hist_fallback_does_not_double_count_stats(store):
+    """hist_op/hist_func fallbacks are decided BEFORE the fused path bumps
+    scan stats (and, cold, before it stages a [S, T, B] superblock): only
+    the reference tree's own bumps land, so per-request max_samples limits
+    and EXPLAIN ANALYZE see the true scan count, not 2x."""
+    from filodb_tpu.query.exec.plans import QueryContext
+    from filodb_tpu.query.exec.transformers import QueryError
+
+    q = "count(rate(http_request_latency[5m]))"
+    scanned = []
+    for params in (None, PlannerParams(fused_aggregate=False)):
+        eng = QueryEngine(store, "ds", params)
+        ctx = QueryContext(store, "ds")
+        with pytest.raises(QueryError):
+            _plan_root(eng, q).execute(ctx)
+        scanned.append((ctx.stats.series_scanned, ctx.stats.samples_scanned))
+    assert scanned[0] == scanned[1]
+    assert scanned[0][1] > 0
+
+
+def test_fused_fallback_counter_partial_results(engines):
+    fused, ref = engines
+    before = _fallback_counts()
+    assert_parity(
+        fused, ref, "sum(rate(http_request_latency[5m]))",
+        allow_partial_results=True,
+    )
+    after = _fallback_counts()
+    assert after.get("partial_results", 0) >= before.get("partial_results", 0) + 1
+
+
+# -- heterogeneous bucket schemes across shards ------------------------------
+
+
+def _hetero_store():
+    """Scheme A on shards 0-1, scheme B (A plus two extra bounds) on shards
+    2-3 — the mid-rollout shape. Cumulative counts are consistent across
+    schemes, so the union remap is exact and both paths must agree."""
+    rng = np.random.default_rng(5)
+    ms = TimeSeriesMemStore()
+    ms.setup(Dataset("ds"), list(range(4)))
+    scheme_a = custom_buckets([0.1, 0.5, 1, 5])
+    scheme_b = custom_buckets([0.1, 0.25, 0.5, 1, 2.5, 5])
+    m = 200
+    ts = BASE + np.arange(m, dtype=np.int64) * 10_000
+    for i in range(16):
+        shard = i % 4
+        scheme = scheme_a if shard < 2 else scheme_b
+        b = scheme.num_buckets
+        tags = {METRIC_TAG: "lat_hetero", "_ws_": "w", "_ns_": "n",
+                "instance": f"h{i}"}
+        incr = rng.poisson(2.0, size=(m, b)).astype(np.float64)
+        incr[:, -1] = incr.sum(1)
+        hist = np.cumsum(np.cumsum(incr, axis=1), axis=0)
+        count = hist[:, -1]
+        total = np.cumsum(rng.uniform(0, 5, size=m))
+        ms.shard("ds", shard).ingest_series(SeriesBatch(
+            PROM_HISTOGRAM, tags, ts,
+            {"sum": total, "count": count, "h": hist},
+            bucket_les=scheme.bounds(),
+        ))
+    return ms
+
+
+def test_fused_hist_heterogeneous_schemes_parity():
+    ms = _hetero_store()
+    fused = QueryEngine(ms, "ds")
+    ref = QueryEngine(ms, "ds", PlannerParams(fused_aggregate=False))
+    start = (BASE + 400_000) / 1000
+    for q in (
+        "histogram_quantile(0.9, sum by (le) (rate(lat_hetero_bucket[5m])))",
+        "sum(rate(lat_hetero[5m]))",
+    ):
+        rf, rr = assert_parity(fused, ref, q, start, start + 600, 60)
+    # the merged scheme is the union of both shards' bounds
+    hist = [g for g in fused.query_range(
+        "sum(rate(lat_hetero[5m]))", start, start + 600, 60).grids
+        if g.les is not None]
+    assert len(hist) == 1
+    np.testing.assert_allclose(
+        np.asarray(hist[0].les, np.float64)[:-1],
+        [0.1, 0.25, 0.5, 1, 2.5, 5],
+    )
+    assert np.isinf(np.asarray(hist[0].les, np.float64)[-1])
+
+
+def test_bucket_slice_missing_scheme_parity_and_stats():
+    """lat_hetero_bucket{le="0.25"}: scheme-A shards lack the bound and are
+    dropped by the slice, scheme-B shards contribute. Values match the
+    reference, and scanned-stats/limit accounting stays PRE-slice on both
+    paths (the dropped shards were still scanned, exactly as the reference
+    bumps before slicing) — on the superblock cache hit too."""
+    from filodb_tpu.query.exec.plans import QueryContext
+
+    ms = _hetero_store()
+    fused = QueryEngine(ms, "ds")
+    ref = QueryEngine(ms, "ds", PlannerParams(fused_aggregate=False))
+    start = (BASE + 400_000) / 1000
+    q = 'sum(rate(lat_hetero_bucket{le="0.25"}[5m]))'
+    assert_parity(fused, ref, q, start, start + 600, 60)
+    scanned = []
+    for eng in (fused, fused, ref):  # 2nd fused run = superblock cache hit
+        ctx = QueryContext(ms, "ds")
+        res = _plan_root(eng, q, start, start + 600, 60).execute(ctx)
+        assert res.grids
+        scanned.append((ctx.stats.series_scanned, ctx.stats.samples_scanned))
+    assert scanned[0] == scanned[1] == scanned[2]
+    assert scanned[0][0] == 16  # all 16 series scanned, dropped shards incl.
+
+
+def test_intra_shard_scheme_mismatch_falls_back():
+    """Partitions WITHIN one shard disagreeing on bounds (same B, different
+    les) cannot stage as one [S, T, B] block — the fused path must fall
+    back (reason hist_scheme) instead of silently attributing one scheme's
+    counts to the other's bounds."""
+    rng = np.random.default_rng(7)
+    ms = TimeSeriesMemStore()
+    ms.setup(Dataset("ds"), [0])
+    m = 120
+    ts = BASE + np.arange(m, dtype=np.int64) * 10_000
+    for i, bounds in enumerate(([0.1, 1, 5], [0.2, 1, 5])):
+        scheme = custom_buckets(bounds)
+        b = scheme.num_buckets
+        incr = rng.poisson(2.0, size=(m, b)).astype(np.float64)
+        incr[:, -1] = incr.sum(1)
+        hist = np.cumsum(np.cumsum(incr, axis=1), axis=0)
+        ms.shard("ds", 0).ingest_series(SeriesBatch(
+            PROM_HISTOGRAM,
+            {METRIC_TAG: "lat_mixed", "_ws_": "w", "_ns_": "n",
+             "instance": f"h{i}"},
+            ts, {"sum": hist[:, -1] * 0.1, "count": hist[:, -1], "h": hist},
+            bucket_les=scheme.bounds(),
+        ))
+    eng = QueryEngine(ms, "ds")
+    before = _fallback_counts()
+    start = (BASE + 400_000) / 1000
+    eng.query_range("sum(rate(lat_mixed[5m]))", start, start + 300, 60)
+    after = _fallback_counts()
+    assert after.get("hist_scheme", 0) == before.get("hist_scheme", 0) + 1
+
+
+def test_remap_buckets_forward_fill():
+    """Missing bounds take the nearest lower bound's cumulative count (0
+    below the first) — monotone, and exact for nested schemes."""
+    from filodb_tpu.core.histograms import remap_buckets, union_les
+
+    src = np.array([0.5, 1.0, np.inf])
+    dst = union_les([src, np.array([0.25, 0.5, 1.0, 2.5, np.inf])])
+    np.testing.assert_allclose(dst[:-1], [0.25, 0.5, 1.0, 2.5])
+    arr = np.array([[3.0, 7.0, 10.0]])
+    out = remap_buckets(arr, src, dst)
+    # 0.25 < first bound -> 0; 2.5 takes C(1.0)=7; +Inf copies through
+    np.testing.assert_allclose(out, [[0.0, 3.0, 7.0, 7.0, 10.0]])
+
+
+# -- fused topk/bottomk/quantile epilogues -----------------------------------
+
+
+@pytest.mark.parametrize("q", [
+    "topk(3, rate(http_requests_total[5m]))",
+    "bottomk(2, rate(http_requests_total[5m]))",
+    "topk(5, http_requests_total)",
+    "quantile(0.9, rate(http_requests_total[5m]))",
+    "quantile by (job) (0.5, rate(http_requests_total[5m]))",
+    "quantile(0.25, http_requests_total)",
+])
+def test_fused_epilogue_parity(engines, q):
+    assert_parity(*engines, q)
+
+
+def test_fused_topk_single_dispatch_and_compact_transfer(engines):
+    """Warm fused topk = ONE instrumented kernel dispatch (range kernel +
+    epilogue in one compiled program), and only the [k, J] winner set comes
+    back: the device entry point returns [k, J_pad] arrays, never [S, J]."""
+    from filodb_tpu.ops import aggregations as AGG
+
+    fused, _ = engines
+    q = "topk(3, rate(http_requests_total[5m]))"
+    for _ in range(2):
+        fused.query_range(q, START, END, STEP)
+    before = _dispatch_total()
+    res = fused.query_range(q, START, END, STEP)
+    assert _dispatch_total() - before == 1
+    # at most k rows reach the result; per step at most k finite values
+    vals = np.vstack([g.values_np() for g in res.grids])
+    assert (np.isfinite(vals).sum(axis=0) <= 3).all()
+
+    # direct transfer-shape check on the device entry point
+    from filodb_tpu.ops.kernels import RangeParams
+    from filodb_tpu.ops.staging import stage_series
+
+    rng = np.random.default_rng(0)
+    m = 64
+    ts = BASE + np.arange(m, dtype=np.int64) * 10_000
+    series = [(ts, rng.uniform(1, 9, size=m)) for _ in range(10)]
+    block = stage_series(series, BASE).to_device()
+    params = RangeParams(BASE + 300_000, 60_000, 8, 300_000)
+    v, i = AGG.fused_topk("sum_over_time", block, 3, False, params)
+    assert v.shape[0] == 3 and i.shape[0] == 3  # [k, J_pad], not [S, J]
+
+
+def test_fused_quantile_single_dispatch_warm(engines):
+    fused, _ = engines
+    q = "quantile(0.9, rate(http_requests_total[5m]))"
+    for _ in range(2):
+        fused.query_range(q, START, END, STEP)
+    before = _dispatch_total()
+    fused.query_range(q, START, END, STEP)
+    assert _dispatch_total() - before == 1
+
+
+def test_fused_topk_sees_new_ingest(engines):
+    """Epilogue results flow through the same shard-version-keyed superblock
+    cache: ingest invalidates, and parity holds after."""
+    fused, ref = engines
+    q = "topk(4, sum_over_time(http_requests_total[10m]))"
+    end = (BASE + 260 * 10_000) / 1000
+    fused.query_range(q, START, end, STEP)
+    fused.memstore.ingest_routed(
+        "ds",
+        counter_batch(n_series=24, n_samples=260, start_ms=BASE, seed=99),
+        spread=2,
+    )
+    assert_parity(fused, ref, q, START, end)
+
+
+# -- superblock byte accounting (3-D blocks) ---------------------------------
+
+
+def test_hist_superblock_evicts_scalar_entries():
+    """The B axis multiplies a histogram superblock's footprint; eviction
+    must see TRUE device bytes (staged_nbytes incl. 3-D vals + [S, B]
+    baselines), so a big hist entry evicts scalar entries instead of
+    overshooting the byte budget."""
+    from filodb_tpu.ops import staging as ST
+
+    ms = TimeSeriesMemStore()
+    ms.setup(Dataset("ds"), list(range(2)))
+    ms.ingest_routed(
+        "ds",
+        histogram_batch(n_series=8, n_samples=200, start_ms=BASE,
+                        metric="http_request_latency"),
+        spread=1,
+    )
+    ms.ingest_routed(
+        "ds", counter_batch(n_series=8, n_samples=200, start_ms=BASE),
+        spread=1,
+    )
+    eng = QueryEngine(ms, "ds")
+    scalar_q = "sum(rate(http_requests_total[5m]))"
+    hist_q = "sum(rate(http_request_latency[5m]))"
+    # measure both entries' true accounting under an unbounded budget
+    eng.query_range(scalar_q, START, END, STEP)
+    eng.query_range(hist_q, START, END, STEP)
+    cache = ms._superblock_cache
+    with cache._lock:
+        sizes = {e[1].is_hist: e[2] for e in cache._d.values()}
+        blocks = {e[1].is_hist: e[1].block for e in cache._d.values()}
+    scalar_nbytes, hist_nbytes = sizes[False], sizes[True]
+    # the hist block is bigger despite having NO raw sidecar and a narrower
+    # padded T (no live-edge headroom): the B axis dominates
+    assert hist_nbytes > scalar_nbytes, (
+        "3-D bucket block bytes must reflect the B axis"
+    )
+    # and the accounting matches the blocks' true device footprint
+    assert hist_nbytes == ST.staged_nbytes(blocks[True])
+    assert scalar_nbytes == ST.staged_nbytes(blocks[False])
+    # budget fits the histogram entry but NOT histogram + scalar: caching
+    # the hist superblock must evict the scalar entry, not blow the budget
+    ms._superblock_cache = ST.SuperblockCache(
+        max_entries=8, max_bytes=hist_nbytes + scalar_nbytes // 2
+    )
+    eng.query_range(scalar_q, START, END, STEP)
+    assert len(ms._superblock_cache) == 1
+    eng.query_range(hist_q, START, END, STEP)
+    with ms._superblock_cache._lock:
+        entries = list(ms._superblock_cache._d.values())
+    assert len(entries) == 1, "hist superblock must evict the scalar entry"
+    assert entries[0][1].is_hist

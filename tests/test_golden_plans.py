@@ -77,9 +77,18 @@ def test_golden_binary_join(planner):
 
 
 def test_golden_topk(planner):
+    # global topk fuses its epilogue into the single-dispatch program
     got = normalize(tree(planner, "topk(3, rate(m[1m]))"))
+    assert got.startswith("E~FusedAggregateExec(op=topk fn=rate")
+    assert "params=(3.0,)" in got
+
+
+def test_golden_topk_grouped_reference_tree(planner):
+    # grouped topk keeps the per-shard candidate pre-reduction tree
+    got = normalize(tree(planner, "topk by (job) (3, rate(m[1m]))"))
     assert got.startswith("E~AggregatePresentExec(op=topk params=(3.0,)")
     assert "PeriodicSamplesMapper(fn=rate window=60000" in got
+    assert "TopkCandidateFilter" in got
 
 
 def test_golden_scalar_op(planner):

@@ -72,11 +72,22 @@ class TestEngineTopkParity:
         return QueryEngine(ms, "prometheus")
 
     def test_pushdown_filter_is_planned_per_shard(self, engine):
+        # global topk fuses (FusedAggregateExec) on the default engine; the
+        # per-shard candidate pre-reduction is the reference-tree shape, so
+        # plan with the fused path disabled (it is also what grouped topk
+        # and the fused node's own runtime fallback use)
+        from filodb_tpu.coordinator.planner import (
+            PlannerParams, SingleClusterPlanner,
+        )
         from filodb_tpu.query.promql import query_range_to_logical_plan
 
+        planner = SingleClusterPlanner(
+            engine.memstore, "prometheus",
+            params=PlannerParams(fused_aggregate=False),
+        )
         plan = query_range_to_logical_plan(
             "topk(3, heap_usage0)", (BASE + 400_000) / 1000, (BASE + 900_000) / 1000, 60)
-        tree = engine.planner.materialize(plan)
+        tree = planner.materialize(plan)
         assert "TopkCandidateFilter" in tree.print_tree()
 
     def test_topk_equals_full_matrix_oracle(self, engine):

@@ -1,14 +1,16 @@
 #!/usr/bin/env python
-"""CPU bench smoke gate (make bench-smoke): a 2k-series, 3-run bench.py
-worker on the CPU backend must not regress p50 by more than 25% against the
-checked-in floor (benchmarks/bench_smoke_floor.json), and must keep
-match=True against the numpy oracle.
+"""CPU bench smoke gate (make bench-smoke): small bench.py workers on the
+CPU backend must not regress p50 by more than 25% against the checked-in
+floors (benchmarks/bench_smoke_floor.json), and must keep match=True
+against the numpy oracles. One floor entry per workload — the north-star
+``sum(rate(...))`` and the fused histogram/epilogue pipeline's
+``histogram_quantile(0.99, sum by (le) (rate(..._bucket[5m])))``.
 
 This is the perf analog of the golden plan tests: small enough to run in CI
-(~10 s total), big enough that losing the fused single-dispatch path, the
-superblock cache, or the staging cache shows up as a multiple, not a blip.
-Update the floor deliberately — in the same PR as a justified perf change —
-never to paper over a regression.
+(~30 s total), big enough that losing the fused single-dispatch path, the
+shared-window hist kernel, the superblock cache, or the staging cache shows
+up as a multiple, not a blip. Update a floor deliberately — in the same PR
+as a justified perf change — never to paper over a regression.
 """
 
 from __future__ import annotations
@@ -24,14 +26,13 @@ FLOOR_FILE = os.path.join(REPO, "benchmarks", "bench_smoke_floor.json")
 REGRESSION_TOLERANCE = 0.25  # fail beyond floor * (1 + this)
 
 
-def main() -> int:
-    with open(FLOOR_FILE) as f:
-        floor = json.load(f)
+def run_entry(entry: dict) -> tuple[bool, str]:
     env = dict(
         os.environ,
         JAX_PLATFORMS="cpu",
-        FILODB_BENCH_SERIES=str(floor["series"]),
-        FILODB_BENCH_RUNS=str(floor["runs"]),
+        FILODB_BENCH_SERIES=str(entry["series"]),
+        FILODB_BENCH_RUNS=str(entry["runs"]),
+        **{k: str(v) for k, v in (entry.get("env") or {}).items()},
     )
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py"), "--worker", "--cpu"],
@@ -39,32 +40,43 @@ def main() -> int:
     )
     sys.stderr.write(proc.stderr[-2000:])
     lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+    name = entry["metric"]
     if proc.returncode != 0 or not lines:
-        print(f"bench-smoke: worker failed rc={proc.returncode}")
-        return 1
+        return False, f"{name}: worker failed rc={proc.returncode}"
     got = json.loads(lines[-1])
+    if got.get("metric") != name:
+        return False, (
+            f"{name}: FAIL worker emitted metric {got.get('metric')!r} — "
+            "floor entry and bench.py METRIC out of sync"
+        )
     p50 = float(got["value"])
-    limit = float(floor["p50_ms_floor"]) * (1.0 + REGRESSION_TOLERANCE)
-    verdict = []
-    ok = True
+    limit = float(entry["p50_ms_floor"]) * (1.0 + REGRESSION_TOLERANCE)
     if not got.get("match", False):
-        verdict.append("FAIL: result does not match the numpy oracle")
-        ok = False
+        return False, f"{name}: FAIL result does not match the numpy oracle"
     if p50 <= 0:
-        verdict.append("FAIL: no measurement")
-        ok = False
-    elif p50 > limit:
-        verdict.append(
-            f"FAIL: p50 {p50:.2f}ms regresses >25% vs floor "
-            f"{floor['p50_ms_floor']}ms (limit {limit:.2f}ms)"
+        return False, f"{name}: FAIL no measurement"
+    if p50 > limit:
+        return False, (
+            f"{name}: FAIL p50 {p50:.2f}ms regresses >25% vs floor "
+            f"{entry['p50_ms_floor']}ms (limit {limit:.2f}ms)"
         )
-        ok = False
-    else:
-        verdict.append(
-            f"OK: p50 {p50:.2f}ms within limit {limit:.2f}ms "
-            f"(floor {floor['p50_ms_floor']}ms, phases {got.get('phases_ms')})"
-        )
-    print("bench-smoke: " + "; ".join(verdict))
+    return True, (
+        f"{name}: OK p50 {p50:.2f}ms within limit {limit:.2f}ms "
+        f"(floor {entry['p50_ms_floor']}ms, phases {got.get('phases_ms')})"
+    )
+
+
+def main() -> int:
+    with open(FLOOR_FILE) as f:
+        floor = json.load(f)
+    entries = floor["entries"] if "entries" in floor else [floor]
+    ok = True
+    verdicts = []
+    for entry in entries:
+        good, verdict = run_entry(entry)
+        ok = ok and good
+        verdicts.append(verdict)
+    print("bench-smoke: " + "; ".join(verdicts))
     return 0 if ok else 1
 
 
