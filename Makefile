@@ -2,7 +2,7 @@
 
 NATIVE_DIR := filodb_tpu/native
 
-.PHONY: all native test test-chaos test-observability bench bench-smoke microbench serve clean tpu-watch tpu-watch-bg
+.PHONY: all native test test-chaos test-ingest-chaos test-observability bench bench-smoke microbench serve clean tpu-watch tpu-watch-bg
 
 all: native
 
@@ -30,6 +30,13 @@ test: native
 # circuit breakers, partial results, shard-reassignment convergence
 test-chaos: native
 	python -m pytest tests/ -q -m chaos
+
+# ingest-concurrency suite (doc/robustness.md "superblock consistency
+# model"): superblock extend/revalidate under live ingest, staging-cache
+# liveness vs the interval-aware insert guard, downsample claim/release
+# races and crash-mid-commit redo
+test-ingest-chaos: native
+	python -m pytest tests/ -q -m ingest_chaos
 
 # observability suite (doc/observability.md): trace propagation + stitching,
 # slow-query log, metrics exposition — plus the span-coverage lint asserting

@@ -80,41 +80,143 @@ def _try_claim(job: str, shard: int, worker_id: str, stale_s: float,
         return False  # claim vanished: owner just finished or released
     if age <= stale_s:
         return False
-    # stale heartbeat: STEAL the claim with an atomic rename of the stale
-    # file — rename of one source path succeeds for exactly ONE of several
-    # concurrent breakers (the losers get FileNotFoundError), so two
-    # breakers can never both claim the shard (unlink+recreate could)
-    stolen = path + f".stolen-{worker_id}-{os.getpid()}"
+    # stale heartbeat: STEAL the claim. Exactly one of N racing breakers
+    # may ever rename the claim file per stale epoch — an O_EXCL ``.break``
+    # marker elects it. Without this, a second breaker whose age check read
+    # the ORIGINAL stale mtime can rename the first breaker's freshly
+    # re-created claim (its rename source is no longer the file it judged
+    # stale), and any restore of that claim clobbers whatever a third
+    # worker O_EXCL-created while the path was transiently missing — the
+    # two-winner storms the chaos suite pins.
+    brk = path + ".break"
     try:
-        os.rename(path, stolen)
-    except OSError:
-        return False  # another breaker won (or the owner just finished)
-    try:
-        os.unlink(stolen)
-    except OSError:
-        pass
-    try:
-        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
-        os.write(fd, payload)
-        os.close(fd)
-        report.claims_broken.append(shard)
-        return True
+        bfd = os.open(brk, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        os.write(bfd, worker_id.encode())
+        os.close(bfd)
     except FileExistsError:
+        # another breaker is mid-steal; reap its marker only if IT died
+        try:
+            if time.time() - os.path.getmtime(brk) > stale_s:
+                os.unlink(brk)
+        except OSError:
+            pass
         return False
+    own_marker = True
+    try:
+        # sole breaker: re-verify from the claim itself — the owner's
+        # heartbeat may have revived it since the age check above
+        try:
+            if time.time() - os.path.getmtime(path) <= stale_s:
+                return False
+        except OSError:
+            return False  # vanished: owner just finished or released
+        # a breaker stalled here longer than stale_s loses its marker to
+        # the reap above; re-checking ownership narrows the resulting
+        # double-breaker window to the microseconds between this read and
+        # the rename (the residual, like the heartbeat TOCTOU, costs only
+        # duplicate redo work — commits are idempotent)
+        try:
+            with open(brk) as bf:
+                if bf.read().strip() != worker_id:
+                    own_marker = False
+                    return False
+        except OSError:
+            own_marker = False
+            return False
+        stolen = path + f".stolen-{worker_id}-{os.getpid()}"
+        try:
+            os.rename(path, stolen)
+        except OSError:
+            return False  # owner finished/released concurrently
+        try:
+            os.unlink(stolen)
+        except OSError:
+            pass
+        try:
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            os.write(fd, payload)
+            os.close(fd)
+            report.claims_broken.append(shard)
+            _record_claim("steal")
+            return True
+        except FileExistsError:
+            # a fresh claimant slipped into the rename->recreate gap via
+            # the top O_EXCL path: it is the single winner, we yield
+            return False
+    finally:
+        if own_marker:  # never remove a successor breaker's marker
+            try:
+                os.unlink(brk)
+            except OSError:
+                pass
+
+
+def _record_claim(event: str) -> None:
+    from ..metrics import record_downsample_claim
+
+    record_downsample_claim(event)
+
+
+# test hook: called between _release's ownership pre-read and its rename,
+# so the chaos suite can deterministically interleave a steal into the
+# exact TOCTOU window the tombstone discipline closes
+_release_race_hook = None
 
 
 def _release(job: str, shard: int, worker_id: str) -> None:
     """Release a claim ONLY if we still own it — a worker whose stale claim
     was broken must not delete the new owner's claim (which would re-open
-    the shard to a third worker mid-redo)."""
+    the shard to a third worker mid-redo).
+
+    Uses the same atomic-rename discipline as the steal path instead of
+    check-then-unlink: rename the claim to a worker-suffixed tombstone
+    (exactly one process can win the rename), verify ownership from the
+    RENAMED file, then unlink. If the tombstone turns out not to be ours —
+    our claim was stolen and re-created between the pre-read and the
+    rename, the old code's TOCTOU that deleted the NEW owner's claim — the
+    tombstone is renamed back into place and the new owner keeps the
+    shard (worst case: its redo duplicates work; commit stays atomic)."""
     path = _claim_path(job, shard)
     try:
         with open(path) as f:
             owner = json.load(f).get("worker")
-        if owner == worker_id:
-            os.unlink(path)
     except (OSError, ValueError):
-        pass
+        return  # claim vanished (or unreadable): nothing of ours to release
+    if owner != worker_id:
+        return
+    if _release_race_hook is not None:
+        _release_race_hook(shard)
+    tomb = path + f".release-{worker_id}-{os.getpid()}"
+    try:
+        os.rename(path, tomb)
+    except OSError:
+        return  # a concurrent stealer renamed it first: not ours anymore
+    try:
+        with open(tomb) as f:
+            owner = json.load(f).get("worker")
+    except (OSError, ValueError):
+        owner = None
+    if owner == worker_id:
+        _record_claim("release")
+        try:
+            os.unlink(tomb)
+        except OSError:
+            pass
+    else:
+        # the TOCTOU window closed on us: we yanked the new owner's claim —
+        # put it back exactly as it was. link (not rename) so a THIRD
+        # worker's claim O_EXCL-created while the path was transiently
+        # missing is never clobbered by the restore (EEXIST → the newer
+        # claim stands; the yanked owner redoes work, commit is idempotent)
+        _record_claim("tombstone_restored")
+        try:
+            os.link(tomb, path)
+        except OSError:
+            pass
+        try:
+            os.unlink(tomb)
+        except OSError:
+            pass
 
 
 def member_ordered_shards(shard_nums, members, self_url: str | None):
@@ -134,11 +236,73 @@ def member_ordered_shards(shard_nums, members, self_url: str | None):
     return mine + rest
 
 
+def _append_jsonl(dst_path: str, blob: str) -> None:
+    """Append newline-terminated jsonl records in ONE write, sharing the
+    store layer's torn-final-line guard (columnstore.torn_final_line)."""
+    from ..store.columnstore import torn_final_line
+
+    if not blob.endswith("\n"):
+        blob += "\n"
+    if torn_final_line(dst_path):
+        blob = "\n" + blob
+    with open(dst_path, "a") as f:
+        f.write(blob)
+
+
+def _commit_shard_dir(src: str, dst: str, label: str) -> None:
+    """MERGE one staged downsample shard dir into the LIVE shard dir —
+    never deleting it, so a batch commit can no longer wipe newer
+    ingest-time streaming-downsampled segments (the old rmtree+rename race,
+    ADVICE round 5).
+
+    Batch segments land under DETERMINISTIC ``chunks-batch-<label>-*``
+    names via atomic ``os.replace``: a redo after a claim steal (or a
+    stalled-but-alive previous owner committing late) overwrites its own
+    previous output in place — last writer wins, and both candidates are
+    equivalent (same input chunks) — while ``chunks-gN.seg`` files written
+    by the streaming downsampler are never touched. Where batch and
+    streaming output overlap in time, the read side reconciles
+    (store/flush._reconcile_chunks: later-end chunk wins per timestamp).
+    Manifest entries for the committed segments are appended in ONE write
+    (a concurrently appending streaming flush cannot tear a line);
+    partkeys append likewise (recovery dedups by partkey)."""
+    os.makedirs(dst, exist_ok=True)
+    seg_map = {}
+    for fn in sorted(os.listdir(src)):
+        if fn.startswith("chunks-") and fn.endswith(".seg"):
+            new = f"chunks-batch-{label}-{fn[len('chunks-'):]}"
+            os.replace(os.path.join(src, fn), os.path.join(dst, new))
+            seg_map[fn] = new
+    man = os.path.join(src, "manifest.jsonl")
+    if seg_map and os.path.exists(man):
+        out = []
+        with open(man) as f:
+            for line in f:
+                try:
+                    e = json.loads(line)
+                except ValueError:
+                    continue
+                if e.get("seg") in seg_map:
+                    e["seg"] = seg_map[e["seg"]]
+                    out.append(json.dumps(e))
+        if out:
+            _append_jsonl(os.path.join(dst, "manifest.jsonl"),
+                          "\n".join(out))
+    pk = os.path.join(src, "partkeys.jsonl")
+    if os.path.exists(pk):
+        with open(pk) as f:
+            data = f.read()
+        if data:
+            _append_jsonl(os.path.join(dst, "partkeys.jsonl"), data)
+
+
 def _flush_shard_output(store_root: str, dataset: str, shard: int,
                         periods_ms, value_cols, worker_id: str,
-                        downsample_resolution_names) -> int:
+                        downsample_resolution_names,
+                        label: str = "default") -> int:
     """Read one shard's raw chunks, reduce, and COMMIT the downsample
-    datasets for that shard via staging-dir + atomic rename."""
+    datasets for that shard by MERGING the staged output into the live
+    shard dirs (_commit_shard_dir)."""
     from ..memstore.memstore import TimeSeriesMemStore
     from ..store.columnstore import LocalColumnStore
     from ..store.flush import FlushCoordinator
@@ -161,25 +325,14 @@ def _flush_shard_output(store_root: str, dataset: str, shard: int,
         ms.shard(ds, shard).ingest_series(SeriesBatch(DS_GAUGE, tags, out_ts, reduced))
         n += len(out_ts)
     fc = FlushCoordinator(ms, staging)
-    for ds in by_ds:
+    crash_mid = os.environ.get("FILODB_DS_CRASH_MID_COMMIT")
+    for i, ds in enumerate(by_ds):
         fc.flush_shard(ds, shard)
         src = os.path.join(staging_root, ds, f"shard-{shard}")
         dst = os.path.join(store_root, ds, f"shard-{shard}")
-        os.makedirs(os.path.dirname(dst), exist_ok=True)
-        # a stalled-but-alive previous owner can commit concurrently with a
-        # redo (its heartbeat went stale, its claim was stolen, but its
-        # process survived): rmtree+rename can then race another committer
-        # and rename hits a re-created non-empty dst — retry a few times;
-        # both candidate outputs are equivalent (same input chunks)
-        for attempt in range(4):
-            shutil.rmtree(dst, ignore_errors=True)
-            try:
-                os.rename(src, dst)
-                break
-            except OSError:
-                if attempt == 3:
-                    raise
-                time.sleep(0.05 * (attempt + 1))
+        _commit_shard_dir(src, dst, label)
+        if crash_mid is not None and int(crash_mid) == shard:
+            os._exit(19)  # test hook: die between commit and done marker
     shutil.rmtree(staging_root, ignore_errors=True)
     return n
 
@@ -232,7 +385,8 @@ def run_worker(store_root: str, dataset: str, shard_nums, periods_ms,
         hb.start()
         try:
             n = _flush_shard_output(store_root, dataset, shard, periods_ms,
-                                    value_cols, worker_id, res_names)
+                                    value_cols, worker_id, res_names,
+                                    label=label)
             with open(_done_path(job, shard), "w") as f:
                 json.dump({"worker": worker_id, "samples": n,
                            "t": time.time()}, f)

@@ -262,6 +262,32 @@ class TimeSeriesPartition:
             return np.empty(0, dtype=np.int64), empty_v
         return np.concatenate(ts_parts), np.concatenate(val_parts)
 
+    def tail_samples(self, t0: int, t1: int, col: str) -> tuple[np.ndarray, np.ndarray]:
+        """Lean ``samples_in_range`` for the live-edge append window
+        (ops/staging._append_to_parts calls this once per partition per
+        repair, so per-call overhead is the whole cost at 100k series).
+        When every requested sample lives in the open write buffer it
+        returns VIEWS — no chunk scan, no copies, no concatenate. The
+        views are only stable until the next ingest into this partition:
+        callers must consume (stack/copy) them before releasing whatever
+        ordering guarantees they hold; appends land at rows >= the
+        snapshotted length so the returned slice itself is never
+        rewritten. Falls back to samples_in_range whenever any chunk
+        reaches into [t0, t1] or the seal race is in play."""
+        n = self._buf_len
+        buf = self._buf
+        chunks = self.chunks
+        sealed_end = chunks[-1].end_ts if chunks else -(2**62)
+        if buf is None or not n or sealed_end >= t0:
+            return self.samples_in_range(t0, t1, col)
+        ts = buf["timestamp"][:n]
+        if ts[-1] < t0 or ts[0] > t1:
+            ncol = self._hist_width(col)
+            empty_v = np.empty((0, ncol)) if ncol else np.empty(0)
+            return np.empty(0, dtype=np.int64), empty_v
+        lo, hi = np.searchsorted(ts, [t0, t1 + 1])
+        return ts[lo:hi], buf[col][lo:hi]
+
     def _hist_width(self, col: str) -> int | None:
         try:
             c = self.schema.column(col)

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Mapping, Sequence
 
@@ -107,12 +108,27 @@ class StageEntry:
     incrementally repaired — or restaged when repair preconditions fail —
     at next use (query/exec/plans.py); ``repairing`` marks an in-flight
     repair so concurrent same-key queries restage instead of serving the
-    pre-repair block."""
+    pre-repair block. ``dirty_lo``/``dirty_hi`` accumulate the union of
+    the ACCEPTED-sample intervals (absolute ms, inclusive) of the ingests
+    that dirtied the entry since it was last clean; the repair declines —
+    forcing a restage — when ``dirty_lo`` reaches below the staged heads
+    (ops/staging._append_to_parts), guarding the append-only repair's
+    monotone-ingest assumption. Reset when a repair claims the entry."""
 
     block: object
     nbytes: int
     dirty: bool = False
     repairing: bool = False
+    dirty_lo: int | None = None
+    dirty_hi: int | None = None
+
+
+# how many per-version ingest effect intervals a shard retains: the proof
+# window for insert-time overlap re-checks and superblock revalidation. At a
+# pathological 1000 version bumps/s this still covers ~1s of history — far
+# longer than a stage runs; a reader older than the window is treated
+# conservatively (as if everything changed).
+EFFECT_LOG_MAX = 1024
 
 
 class TimeSeriesShard:
@@ -130,6 +146,12 @@ class TimeSeriesShard:
         self.cardinality = CardinalityTracker()
         self._lock = threading.RLock()
         self._ingested_offset = -1  # stream offset watermark (Kafka analog)
+        # per-version ingest effect log: (version, lo_ms, hi_ms, full). One
+        # entry per version bump, so a consumer holding an older version can
+        # PROVE a staged range untouched (ingest_effects_since) instead of
+        # conservatively discarding its work — the interval-aware half of
+        # the staging-cache invalidation contract.
+        self._effects: deque = deque(maxlen=EFFECT_LOG_MAX)
         # entries are StageEntry objects (block + bytes + dirty/repairing)
         # data version for query-side staging caches: bumped on every ingest
         # so cached HBM-resident blocks invalidate (reference analog: block
@@ -177,7 +199,47 @@ class TimeSeriesShard:
 
     # -- ingest ------------------------------------------------------------
 
-    def _invalidate_stage_range(self, min_ts, max_ts, new_series: bool) -> None:
+    def _record_effect(self, lo, hi, full: bool) -> None:
+        """Append this version bump's effect to the bounded effect log.
+        ``full`` marks events that can change ANY cached block (new series,
+        eviction, ODP page-in, flush/recovery — resident data moved in
+        place). Caller holds the shard lock and has already bumped
+        ``version``; every bump must record exactly one effect so the log's
+        versions stay consecutive (ingest_effects_since relies on it to
+        detect truncation)."""
+        self._effects.append((self.version, lo, hi, full))
+
+    def ingest_effects_since(self, since_version: int, lo: int, hi: int):
+        """Classify what happened between ``since_version`` and the current
+        version w.r.t. the absolute-ms interval [lo, hi].
+
+        Returns None when the effect log PROVES every bump since left the
+        interval untouched (disjoint-range ingest only); else a reason
+        string: ``"overlap"`` (some ingest's effect interval intersects),
+        ``"full_clear"`` (new series / eviction / ODP / recovery — cached
+        row sets or resident data may have changed), or ``"log_truncated"``
+        (the bounded log no longer reaches back that far — conservatively
+        treated as changed)."""
+        with self._lock:
+            return self._ingest_effects_since_locked(since_version, lo, hi)
+
+    def _ingest_effects_since_locked(self, since_version: int, lo, hi):
+        if self.version == since_version:
+            return None
+        if not self._effects or self._effects[0][0] > since_version + 1:
+            return "log_truncated"
+        reason = None
+        for v, elo, ehi, full in self._effects:
+            if v <= since_version:
+                continue
+            if full:
+                return "full_clear"
+            if elo <= hi and ehi >= lo:
+                reason = "overlap"
+        return reason
+
+    def _invalidate_stage_range(self, min_ts, max_ts, new_series: bool,
+                                raw_lo=None) -> None:
         """Dirty-mark (not drop) the staging-cache entries the new samples
         can affect.
 
@@ -191,19 +253,35 @@ class TimeSeriesShard:
         can pull it into a cached range it previously missed entirely, and
         the cached block's row set would no longer match a fresh lookup.
 
-        Overlapping entries are marked DIRTY with the accumulated effect
-        interval instead of deleted: the next query attempts an INCREMENTAL
-        append repair (ops/staging.append_to_block — live-edge panels pay
-        only the tail, reference's equivalent is serving straight from
-        write buffers) and falls back to a full re-stage when repair
-        preconditions fail. Eviction/ODP paths still clear wholesale (they
-        change resident data in place). Caller holds the shard lock."""
+        Overlapping entries are marked DIRTY and accumulate the effect
+        interval (StageEntry.dirty_lo/hi) instead of being deleted: the
+        next query attempts an INCREMENTAL append repair
+        (ops/staging.append_to_block — live-edge panels pay only the tail,
+        reference's equivalent is serving straight from write buffers) and
+        falls back to a full re-stage when repair preconditions fail.
+        Eviction/ODP paths still clear wholesale (they change resident data
+        in place). Every call also records the effect in the shard's effect
+        log so later consumers (insert-time overlap re-check, superblock
+        revalidation) can prove disjointness. Caller holds the shard
+        lock."""
         if new_series or min_ts is None:
+            self._record_effect(0, 0, True)
             self.stage_cache.clear()
             return
+        self._record_effect(int(min_ts), int(max_ts), False)
+        # entries accumulate the ACCEPTED-sample interval (not the
+        # prev_end-widened one the effect log records): the widening exists
+        # for the index-span-pull hazard, which the repair's part-refs
+        # check covers; a widened lo would make every append to a lagging
+        # series read as below-head dirt and needlessly force restages
+        dlo = int(min_ts) if raw_lo is None else int(raw_lo)
         for k, entry in self.stage_cache.items():
             if k[1] <= max_ts and k[2] >= min_ts:  # k = (filters, start, end, ...)
                 entry.dirty = True
+                entry.dirty_lo = (dlo if entry.dirty_lo is None
+                                  else min(entry.dirty_lo, dlo))
+                entry.dirty_hi = (int(max_ts) if entry.dirty_hi is None
+                                  else max(entry.dirty_hi, int(max_ts)))
 
     def _prev_end_of(self, partkey) -> int | None:
         """Newest sample ts of an existing series (None for a new one)."""
@@ -221,21 +299,28 @@ class TimeSeriesShard:
         n = 0
         with self._lock:
             np0 = len(self.partitions)
-            min_ts = max_ts = None
+            min_ts = max_ts = raw_min = None
             for sb in batch.group_by_series():
                 prev_end = self._prev_end_of(sb.partkey)
                 n += self._ingest_series(sb)
                 if len(sb.timestamps):
-                    lo, hi = int(sb.timestamps.min()), int(sb.timestamps.max())
-                    if prev_end is not None:
-                        lo = min(lo, prev_end)
+                    raw, hi = int(sb.timestamps.min()), int(sb.timestamps.max())
+                    lo = raw if prev_end is None else min(raw, prev_end)
+                    # entry-dirt floor counts ACCEPTED rows only: rows at or
+                    # below prev_end are dropped by the partition's
+                    # out-of-order guard and change nothing, and counting
+                    # them would make one stale duplicate per scrape
+                    # permanently veto the append repair
+                    acc = raw if prev_end is None else max(raw, prev_end + 1)
+                    raw_min = acc if raw_min is None else min(raw_min, acc)
                     min_ts = lo if min_ts is None else min(min_ts, lo)
                     max_ts = hi if max_ts is None else max(max_ts, hi)
             if offset >= 0:
                 self._ingested_offset = max(self._ingested_offset, offset)
             self.version += 1
             self._invalidate_stage_range(min_ts, max_ts,
-                                         len(self.partitions) != np0)
+                                         len(self.partitions) != np0,
+                                         raw_lo=raw_min)
         self.stats.rows_ingested += n
         # periodic headroom check on the ingest path (reference
         # ensureFreeSpace runs inside the ingest loop). The full O(partitions)
@@ -256,14 +341,17 @@ class TimeSeriesShard:
             prev_end = self._prev_end_of(sb.partkey)
             n = self._ingest_series(sb)
             if len(sb.timestamps):
-                lo = int(sb.timestamps.min())
-                if prev_end is not None:
-                    lo = min(lo, prev_end)
+                raw = int(sb.timestamps.min())
+                lo = raw if prev_end is None else min(raw, prev_end)
+                # accepted-rows floor, as in ingest(): dropped out-of-order
+                # rows must not veto the append repair
+                acc = raw if prev_end is None else max(raw, prev_end + 1)
                 self._invalidate_stage_range(
                     lo, int(sb.timestamps.max()),
-                    len(self.partitions) != np0,
+                    len(self.partitions) != np0, raw_lo=acc,
                 )
             else:
+                self._record_effect(0, 0, True)
                 self.stage_cache.clear()
             return n
 
@@ -422,6 +510,7 @@ class TimeSeriesShard:
                 # hold evicted samples/partitions (the staging cache has no
                 # version in its key — invalidation is the contract)
                 self.version += 1
+                self._record_effect(0, 0, True)
                 self.stage_cache.clear()
         return dropped
 
@@ -488,6 +577,7 @@ class TimeSeriesShard:
             if freed:
                 self._resident_last = resident - freed
                 self.version += 1
+                self._record_effect(0, 0, True)
                 self.stage_cache.clear()
                 self.stats.headroom_evictions += 1
                 self.stats.bytes_reclaimed += freed
@@ -537,9 +627,19 @@ class TimeSeriesShard:
                 n += 1
             for part in need.values():
                 part.chunks.sort(key=lambda c: c.start_ts)
+                if n:
+                    # the merge-commit downsample layout stores overlapping
+                    # batch + streaming chunks side by side and relies on
+                    # read-side reconciliation (store/flush); a page-in
+                    # must apply it like recover_shard does, or overlapped
+                    # timestamps double-count
+                    from ..store.flush import _reconcile_chunks
+
+                    _reconcile_chunks(part)
                 self.evictable.offer(part.part_id)  # paged-in = re-evictable
             if n:
                 self.version += 1
+                self._record_effect(0, 0, True)
                 self.stage_cache.clear()
                 self.odp_stats_pages += n
         return n

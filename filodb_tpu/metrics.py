@@ -413,6 +413,41 @@ def record_fused_fallback(reason: str) -> None:
     REGISTRY.counter("filodb_fused_fallback", reason=reason).inc()
 
 
+def record_stage_insert_drop(reason: str) -> None:
+    """A freshly staged block was NOT inserted into the shard staging cache
+    because ingest effects since its stage provably-or-possibly touched its
+    range. Exposed as ``filodb_stage_cache_insert_dropped_total{reason}``
+    (reasons: overlap | full_clear | log_truncated); a sustained non-zero
+    rate under fine-grained ingest is the cache-starvation signal the
+    interval-aware insert re-check exists to eliminate for disjoint-range
+    ingest (doc/observability.md)."""
+    REGISTRY.counter("filodb_stage_cache_insert_dropped", reason=reason).inc()
+
+
+def record_superblock_event(outcome: str) -> None:
+    """Superblock cache maintenance outcome under ingest, exposed as
+    ``filodb_superblock_maintenance_total{outcome}``:
+
+    - ``revalidate`` — ingest since the entry was built was provably
+      disjoint from its range; the entry was re-stamped and served as-is
+    - ``extend`` — overlapping live-edge appends were absorbed by extending
+      the device superblock in place (the single-dispatch path survives)
+    - ``extend_abort`` — an extension raced a conflicting ingest and was
+      discarded
+    - ``restage`` — extension preconditions failed; full rebuild paid"""
+    REGISTRY.counter("filodb_superblock_maintenance", outcome=outcome).inc()
+
+
+def record_downsample_claim(event: str) -> None:
+    """Distributed-downsample claim lifecycle, exposed as
+    ``filodb_downsample_claims_total{event}``: ``steal`` (stale claim
+    broken), ``release`` (owner released its own claim), and
+    ``tombstone_restored`` (a release found its claim had been stolen and
+    re-created mid-release — the renamed tombstone was put back instead of
+    deleting the new owner's claim)."""
+    REGISTRY.counter("filodb_downsample_claims", event=event).inc()
+
+
 # -- kernel dispatch instrumentation ----------------------------------------
 
 

@@ -535,14 +535,69 @@ def stage_series(
 
 
 def append_to_block(shard, block: StagedBlock, part_ids, column: str,
-                    end_ms: int, mode: str) -> "StagedBlock | None":
+                    end_ms: int, mode: str,
+                    dirty_lo: int | None = None) -> "StagedBlock | None":
     """Incrementally append samples that arrived AFTER ``block`` was staged
     (the live-edge dashboard path: every scrape lands just past the staged
     head, and a full re-stage per scrape is the single biggest query cost
     under ingest — the reference serves this straight from write buffers).
+    ``dirty_lo`` is the entry's accumulated effect-interval floor
+    (StageEntry.dirty_lo): the repair is declined when the dirt provably
+    reaches below the staged heads. Thin shard-level wrapper around
+    :func:`_append_to_parts`; the cross-shard superblock variant is
+    :func:`extend_superblock`."""
+    refs = [(shard.shard_num, int(p)) for p in part_ids]
+    if refs != list(block.part_refs):
+        return None
+    parts = [shard.partition(int(p)) for p in part_ids]
+    return _append_to_parts(parts, block, column, end_ms, mode,
+                            dirty_lo=dirty_lo)
 
-    Mutates the HOST mirrors in place (old device arrays are immutable jax
-    buffers, so in-flight readers are unaffected) and returns a NEW
+
+def extend_superblock(memstore, dataset: str, block: StagedBlock,
+                      column: str, end_ms: int, mode: str,
+                      les=None) -> "StagedBlock | None":
+    """``append_to_block`` lifted to the cross-shard superblock (the
+    delta-summation move: maintain the device-resident aggregate input
+    incrementally on append instead of invalidate-and-restage). Resolves
+    every ``part_refs`` row back to its live partition across member shards
+    and appends through the same uniform-batch repair core, so the warm
+    single-dispatch query stays ONE dispatch under live ingest. The caller
+    (plans.FusedAggregateExec) is responsible for proving the ROW SET is
+    unchanged (fresh per-shard lookups + the shards' effect logs) before
+    calling. ``les`` must be the entry's bucket bounds for [ΣS, T, B]
+    histogram superblocks — extension declines when any member partition's
+    scheme no longer matches (appended raw rows would land on the wrong
+    bounds). Returns None when any precondition fails (caller restages)."""
+    parts = []
+    try:
+        for sn, pid in block.part_refs:
+            parts.append(memstore.shard(dataset, sn).partitions[int(pid)])
+    except KeyError:
+        return None
+    if les is not None:
+        from ..core.histograms import same_scheme
+
+        for p in parts:
+            if p.bucket_les is None or not same_scheme(p.bucket_les, les):
+                return None
+    return _append_to_parts(parts, block, column, end_ms, mode)
+
+
+def _append_to_parts(parts, block: StagedBlock, column: str,
+                     end_ms: int, mode: str,
+                     dirty_lo: int | None = None) -> "StagedBlock | None":
+    """Uniform-batch incremental append core shared by the per-shard repair
+    path (append_to_block) and the cross-shard superblock extension
+    (extend_superblock). ``parts`` are the live partitions in the block's
+    ``part_refs`` order — callers have already verified the selection is
+    unchanged.
+
+    Mutates the big [n, T] HOST mirrors in place but only at columns >= the
+    old head; the small per-series state (h_lens, cont) is copy-on-write,
+    so a reader holding the OLD block — an in-flight concat_blocks as much
+    as a device-array consumer — keeps a consistent head-m view. Returns a
+    NEW
     StagedBlock carrying the refreshed device arrays and extended shared
     grid — the caller swaps it into the cache entry atomically, so a
     concurrent query sees either the whole old block or the whole new one,
@@ -550,10 +605,11 @@ def append_to_block(shard, block: StagedBlock, part_ids, column: str,
     caller restages from scratch:
 
     - mode must be raw/shifted/corrected (diff continuation needs state the
-      block doesn't carry) and the block scalar, host-mirrored, on a
-      REGULAR or NEAR-REGULAR (jittered) shared grid — the common live
-      cases; masked/irregular blocks restage;
-    - the selection must be unchanged (same part refs, same order);
+      block doesn't carry) and the block host-mirrored, on a REGULAR or
+      NEAR-REGULAR (jittered) shared grid — the common live cases;
+      masked/irregular blocks restage. Scalar [S, T] blocks support all
+      three modes; histogram [S, T, B] blocks (raw cumulative bucket
+      counts) support raw on a regular grid;
     - every series must gain the SAME COUNT of new samples — identical
       timestamps on a regular grid, or near-nominal ones (the jitter bound
       re-checked over the extended grid) on a jittered grid — and the
@@ -572,10 +628,12 @@ def append_to_block(shard, block: StagedBlock, part_ids, column: str,
         return None
     if jittered and getattr(block, "h_dev", None) is None:
         return None
-    if block.n_series == 0 or block.h_vals.ndim != 2:
+    if block.n_series == 0:
         return None
-    refs = [(shard.shard_num, int(p)) for p in part_ids]
-    if refs != list(block.part_refs):
+    is_hist = block.h_vals.ndim == 3
+    if is_hist and (mode != "raw" or jittered):
+        return None
+    if not is_hist and block.h_vals.ndim != 2:
         return None
     n = block.n_series
     lens = block.h_lens
@@ -594,34 +652,79 @@ def append_to_block(shard, block: StagedBlock, part_ids, column: str,
         read_from = [last_nom + int(d) + 1 for d in dev_last]
     else:
         read_from = [last_nom + 1] * n
-    new_ts = None
-    per_vals = []
-    per_ts = []
-    for idx_i, pid in enumerate(part_ids):
-        ts, vals = shard.partition(int(pid)).samples_in_range(
-            read_from[idx_i], end_ms, column
-        )
-        if getattr(vals, "ndim", 1) != 1:
-            return None
-        keep = ~np.isnan(vals)
-        if not keep.all():
-            ts, vals = ts[keep], vals[keep]
-        if new_ts is None:
-            new_ts = ts
-        elif len(ts) != len(new_ts):
-            return None  # appended counts diverge
-        elif not jittered and (ts != new_ts).any():
-            return None  # regular grid would not stay shared
-        per_vals.append(vals)
-        per_ts.append(ts)
-    k = 0 if new_ts is None else len(new_ts)
+    # accumulated-dirt floor guard: the append-only repair can only be
+    # correct when every dirtying sample sits at or past the staged heads.
+    # Today that is guaranteed structurally (partitions drop out-of-order
+    # rows and uniform lens pin every member's store head to its staged
+    # head), so this cannot fire — it exists to turn a future relaxation
+    # of either invariant (e.g. accepting backfill) into a safe restage
+    # instead of a silently incomplete block.
+    if dirty_lo is not None and dirty_lo < min(read_from) - 1:
+        return None
+    # gather the per-series tails with NO per-series validation — at 100k
+    # series the python-level per-call overhead IS the cost of the repair,
+    # so uniformity/NaN/grid checks run vectorized over the stacked [n, k]
+    # batch below, with a per-series pass only when the batch is odd
+    # (diverging counts, staleness NaNs, histogram shape drift)
+    read = getattr(parts[0], "tail_samples", None)
+    if read is None:  # test doubles without the lean path
+        per = [p.samples_in_range(read_from[i], end_ms, column)
+               for i, p in enumerate(parts)]
+    else:
+        per = [p.tail_samples(read_from[i], end_ms, column)
+               for i, p in enumerate(parts)]
+    per_ts = [ts for ts, _ in per]
+    per_vals = [v for _, v in per]
+    V0 = TS0 = None
+    k = len(per_ts[0])
+    uniform = all(len(ts) == k for ts in per_ts)
+    if uniform and k > 0:
+        V0 = np.stack(per_vals)
+        if V0.ndim != (3 if is_hist else 2):
+            uniform = False
+            V0 = None
+        elif is_hist and V0.shape[2] != block.h_vals.shape[2]:
+            return None  # bucket scheme width changed: restage
+        elif not is_hist and np.isnan(V0).any():
+            uniform = False  # staleness markers: per-series filtering
+            V0 = None
+        else:
+            TS0 = np.stack(per_ts)
+            if not jittered and (TS0 != TS0[0]).any():
+                return None  # regular grid would not stay shared
+    if not uniform:
+        # odd batch: the original per-series discipline (filter staleness
+        # NaNs, then require uniform counts + a shared grid)
+        new_ts = None
+        per_vals = []
+        per_ts = []
+        for ts, vals in per:
+            if getattr(vals, "ndim", 1) != (2 if is_hist else 1):
+                return None
+            if is_hist:
+                if vals.shape[1] != block.h_vals.shape[2]:
+                    return None  # bucket scheme width changed: restage
+            else:
+                keep = ~np.isnan(vals)
+                if not keep.all():
+                    ts, vals = ts[keep], vals[keep]
+            if new_ts is None:
+                new_ts = ts
+            elif len(ts) != len(new_ts):
+                return None  # appended counts diverge
+            elif not jittered and (ts != new_ts).any():
+                return None  # regular grid would not stay shared
+            per_vals.append(vals)
+            per_ts.append(ts)
+        k = 0 if new_ts is None else len(new_ts)
     if k == 0:
         return block  # nothing new in this block's range: still clean
+    new_ts = per_ts[0]
     T = block.h_ts.shape[1]
     if m + k > T:
         return None  # padded width exhausted: restage with a bigger T
     if jittered:
-        TS = np.stack(per_ts).astype(np.int64)  # [n, k]
+        TS = (TS0 if TS0 is not None else np.stack(per_ts)).astype(np.int64)
         if (np.diff(TS, axis=1) <= 0).any():
             return None
         nom_new, dev_new, md_new = nominal_midrange(TS)
@@ -641,7 +744,8 @@ def append_to_block(shard, block: StagedBlock, part_ids, column: str,
     off32 = off.astype(np.int32)
     # vectorized across series: uniform appended counts make the whole
     # repair a handful of [n, k] array ops, not n small python loops
-    V = np.stack(per_vals).astype(np.float64)  # [n, k]
+    V = (V0 if V0 is not None else np.stack(per_vals)).astype(np.float64)
+    # [n, k] ([n, k, B] hist)
     if jittered:
         block.h_ts[:n, m : m + k] = (OFF).astype(np.int32)
         block.h_dev[:n, m : m + k] = dev_new.astype(np.float32)
@@ -652,7 +756,14 @@ def append_to_block(shard, block: StagedBlock, part_ids, column: str,
     elif mode == "shifted":
         b = block.base64[:n]
         block.h_vals[:n, m : m + k] = (V - b[:, None]).astype(block.h_vals.dtype)
-    else:  # corrected: exact f64 continuation from the stored state
+    new_cont = None
+    if mode == "corrected":
+        # corrected: exact f64 continuation from the stored state. The
+        # continuation arrays are COPY-ON-WRITE (like lens below): the old
+        # block object must stay frozen at head m, or a concurrent
+        # concat_blocks would snapshot cont at m+k against values at m and
+        # a later superblock extension would mis-correct the re-read tail
+        # as ~1e9 counter resets
         cont_raw, cont_corr = block.cont
         prev = np.concatenate([cont_raw[:n, None], V[:, :-1]], axis=1)
         drops = np.where(V < prev, prev, 0.0)
@@ -660,9 +771,16 @@ def append_to_block(shard, block: StagedBlock, part_ids, column: str,
         b = block.base64[:n]
         block.h_vals[:n, m : m + k] = (corr - b[:, None]).astype(block.h_vals.dtype)
         block.h_raw[:n, m : m + k] = V.astype(block.h_raw.dtype)
-        cont_raw[:n] = V[:, -1]
-        cont_corr[:n] = corr[:, -1]
-    lens[:n] = m + k
+        new_cont = (cont_raw.copy(), cont_corr.copy())
+        new_cont[0][:n] = V[:, -1]
+        new_cont[1][:n] = corr[:, -1]
+    # lens is copy-on-write: the big [n, T] mirrors may be shared with
+    # readers of the OLD block (concat_blocks mid-superblock-build) — the
+    # in-place column writes above land only at >= m, invisible under the
+    # old lens, so the old block stays a consistent head-m view as long as
+    # ITS lens never advances
+    new_lens = lens.copy()
+    new_lens[:n] = m + k
     ext_grid = grid.copy()
     ext_grid[m : m + k] = off32
     import jax
@@ -673,7 +791,7 @@ def append_to_block(shard, block: StagedBlock, part_ids, column: str,
     # alias numpy memory, and the next repair mutates these same mirrors
     nb = StagedBlock(
         jax.device_put(block.h_ts.copy()), jax.device_put(block.h_vals.copy()),
-        jax.device_put(block.h_lens.copy()), base, block.baseline, n,
+        jax.device_put(new_lens.copy()), base, block.baseline, n,
         list(block.part_refs),
         raw=(jax.device_put(block.h_raw.copy())
              if block.h_raw is not None else None),
@@ -684,13 +802,20 @@ def append_to_block(shard, block: StagedBlock, part_ids, column: str,
     )
     nb.h_ts = block.h_ts
     nb.h_vals = block.h_vals
-    nb.h_lens = block.h_lens
+    nb.h_lens = new_lens
     nb.h_raw = block.h_raw
     nb.h_dev = getattr(block, "h_dev", None)
-    if getattr(block, "cont", None) is not None:
+    if new_cont is not None:
+        nb.cont = new_cont
+    elif getattr(block, "cont", None) is not None:
         nb.cont = block.cont
     if getattr(block, "base64", None) is not None:
         nb.base64 = block.base64
+    if "_gid_cache" in block.__dict__:
+        # label grouping is a pure function of the (unchanged) series set:
+        # carrying the memo keeps an extended superblock's warm query free
+        # of the O(S) regroup AND the group-id device re-upload
+        nb._gid_cache = dict(block._gid_cache)
     return nb
 
 
@@ -958,8 +1083,31 @@ def concat_blocks(blocks, force_raw: bool = False) -> StagedBlock:
             ext = np.full(T, TS_PAD, np.int32)
             ext[: len(regular)] = regular
             regular = ext
-    return StagedBlock(ts, vals, lens, real[0].base_ms, baseline, S,
-                       part_refs, raw=raw, regular_ts=regular)
+    out = StagedBlock(ts, vals, lens, real[0].base_ms, baseline, S,
+                      part_refs, raw=raw, regular_ts=regular)
+    if not is_hist:
+        # f64 continuation state rides along (snapshot — the member blocks'
+        # own state keeps evolving under per-shard repairs) so the
+        # superblock can itself be incrementally extended on live-edge
+        # ingest (extend_superblock) with exact counter correction
+        if all(getattr(b, "base64", None) is not None for b in real):
+            base64 = np.zeros(Sp, np.float64)
+            o = 0
+            for b in real:
+                base64[o : o + b.n_series] = np.asarray(b.base64)[: b.n_series]
+                o += b.n_series
+            out.base64 = base64
+        if all(getattr(b, "cont", None) is not None for b in real):
+            cont_raw = np.zeros(Sp, np.float64)
+            cont_corr = np.zeros(Sp, np.float64)
+            o = 0
+            for b in real:
+                k = b.n_series
+                cont_raw[o : o + k] = np.asarray(b.cont[0])[:k]
+                cont_corr[o : o + k] = np.asarray(b.cont[1])[:k]
+                o += k
+            out.cont = (cont_raw, cont_corr)
+    return out
 
 
 class SuperblockCache:
@@ -997,18 +1145,45 @@ class SuperblockCache:
     def get(self, key, versions: tuple):
         with self._lock:
             hit = self._d.get(key)
-            if hit is None:
-                return None
-            if hit[0] != versions:
-                # drop only entries STRICTLY OLDER than the observed shard
-                # state; a reader whose version read predates a concurrent
-                # ingest must not delete the fresher entry another query
-                # just rebuilt (put() replaces in place anyway)
-                if all(ev <= ov for ev, ov in zip(hit[0], versions)):
-                    del self._d[key]
+            if hit is None or hit[0] != versions:
+                # version-stale entries are RETAINED (not dropped): the
+                # interval-aware refresh path (peek/revalidate + the
+                # superblock extension in plans.FusedAggregateExec) can
+                # prove them still valid or extend them in place, which is
+                # the whole point of surviving ingest that doesn't touch
+                # their range. LRU + the byte budget bound them; put()
+                # replaces in place on rebuild.
                 return None
             self._d.move_to_end(key)
             return hit[1]
+
+    def peek(self, key):
+        """The stored ``(versions, value, nbytes)`` triple regardless of
+        staleness (None when absent) — input to the interval-aware
+        revalidate/extend decision."""
+        with self._lock:
+            return self._d.get(key)
+
+    def revalidate(self, key, old_versions: tuple, new_versions: tuple) -> bool:
+        """CAS the stored version vector: the caller proved (via the member
+        shards' effect logs) that every bump between the two vectors was
+        disjoint from the entry's staged range. Fails — returns False —
+        when a racer replaced or dropped the entry in the meantime."""
+        with self._lock:
+            hit = self._d.get(key)
+            if hit is None or hit[0] != old_versions:
+                return False
+            self._d[key] = (new_versions, hit[1], hit[2])
+            self._d.move_to_end(key)
+            return True
+
+    def drop(self, key) -> None:
+        """Remove an entry outright — required when an in-place extension
+        mutated its host mirrors but could not be committed (the mirrors
+        are now ahead of the entry's device arrays, so it must never be
+        served or extended again)."""
+        with self._lock:
+            self._d.pop(key, None)
 
     def put(self, key, versions: tuple, value, nbytes: int) -> None:
         if nbytes > self.max_bytes:

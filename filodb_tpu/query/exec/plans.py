@@ -6,6 +6,7 @@ shape serves in-process, mesh-sharded, and (later) remote execution).
 
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Any, Optional, Sequence
@@ -243,7 +244,9 @@ def staged_block_for(ctx: "QueryContext", shard, ids, cache_key, col_name: str,
             # would miss acknowledged samples — restage fresh
             hit = None
         elif hit is not None and hit.dirty:
+            dirty_lo = hit.dirty_lo
             hit.dirty = False
+            hit.dirty_lo = hit.dirty_hi = None  # interval consumed by repair
             hit.repairing = True
             claimed = True
     if hit is not None and claimed:
@@ -254,7 +257,8 @@ def staged_block_for(ctx: "QueryContext", shard, ids, cache_key, col_name: str,
         repaired = None
         try:
             repaired = ST.append_to_block(
-                shard, hit.block, ids, col_name, end_ms, stage_mode
+                shard, hit.block, ids, col_name, end_ms, stage_mode,
+                dirty_lo=dirty_lo,
             )
         finally:
             with shard._lock:
@@ -281,12 +285,20 @@ def staged_block_for(ctx: "QueryContext", shard, ids, cache_key, col_name: str,
     # byte-budgeted eviction, oldest entry first (the staging analog of
     # BlockManager reclaim under memory pressure). All cache mutations run
     # under the shard lock (the shard's selective invalidation iterates the
-    # dict under it), and a block staged concurrently with ANY ingest is
-    # used for this query but never cached — an in-range sample that landed
-    # mid-stage already ran its invalidation, which could not see this
-    # not-yet-inserted entry.
+    # dict under it). The insert guard is INTERVAL-AWARE: an ingest that
+    # landed mid-stage ran its invalidation before this entry existed, so
+    # the entry may only be cached when the shard's effect log PROVES every
+    # version bump since version_at_stage was disjoint from the staged
+    # range (otherwise sustained fine-grained ingest — many small batches —
+    # would drop every insert and starve the cache forever, re-paying full
+    # stages despite the selective-invalidation machinery).
     with shard._lock:
-        if shard.version == version_at_stage:
+        drop_reason = None
+        if shard.version != version_at_stage:
+            drop_reason = shard._ingest_effects_since_locked(
+                version_at_stage, start_ms, end_ms
+            )
+        if drop_reason is None:
             from ...memstore.shard import StageEntry
 
             budget = getattr(shard.config, "stage_cache_bytes", 2 << 30)
@@ -295,6 +307,10 @@ def staged_block_for(ctx: "QueryContext", shard, ids, cache_key, col_name: str,
                 oldest = next(iter(shard.stage_cache))
                 used -= shard.stage_cache.pop(oldest).nbytes
             shard.stage_cache[cache_key] = StageEntry(block, nbytes)
+    if drop_reason is not None:
+        from ...metrics import record_stage_insert_drop
+
+        record_stage_insert_drop(drop_reason)
     return block
 
 
@@ -992,6 +1008,12 @@ class SuperblockEntry:
     is_hist: bool = False
     les: Any = None  # [B] unified bucket bounds (histogram blocks)
     les_dev: Any = None  # device f32 copy for the fused quantile epilogue
+    # incremental-extension inputs (ST.extend_superblock): the resolved
+    # value column and staging mode the member blocks were staged with.
+    # stage_mode None marks entries that can never extend (le=-sliced
+    # bucket superblocks) — they still revalidate on disjoint ingest.
+    col_name: str | None = None
+    stage_mode: str | None = None
 
 
 def _unify_hist_blocks(blocks, block_les):
@@ -1073,6 +1095,11 @@ def _slice_bucket(block, les, bucket_le: float):
     le_str = "+Inf" if np.isinf(les64[b_idx]) else f"{les64[b_idx]:g}"
     return sliced, le_str
 
+
+# incremental superblock extension under live ingest (escape hatch: set
+# FILODB_SUPERBLOCK_EXTEND=0 to restore invalidate-and-rebuild; also skips
+# the superblock's host mirrors, halving its host-memory footprint)
+_SUPERBLOCK_EXTEND = os.environ.get("FILODB_SUPERBLOCK_EXTEND", "1") != "0"
 
 # aggregation ops the fused single-dispatch path computes exactly as one
 # on-device segment reduce (ops/aggregations.fused_range_aggregate)
@@ -1274,9 +1301,168 @@ class FusedAggregateExec(ExecPlan):
             hit = cache.get(sb_key, versions)
             if hit is not None:
                 return self._serve_hit(ctx, hit)
+            refreshed = self._refresh_superblock(ctx, cache, sb_key, versions)
+            if refreshed is not None:
+                return refreshed
             return self._build_superblock(
                 ctx, stage_mode, cache, sb_key, versions, hints, hint_key
             )
+
+    def _refresh_superblock(self, ctx: QueryContext, cache, sb_key,
+                            versions: tuple):
+        """Interval-aware maintenance of a version-stale cached superblock
+        (runs under the per-key build lock). Three outcomes, cheapest
+        first:
+
+        - every member shard's effects since the entry was stamped were
+          provably DISJOINT from the staged range → re-stamp (revalidate)
+          and serve the entry untouched — disjoint-range ingest no longer
+          evicts superblocks;
+        - only overlapping interval effects (live-edge appends) and the
+          row set is provably unchanged → EXTEND the device superblock in
+          place (_extend_superblock) and serve it — the warm query stays
+          one dispatch under live ingest;
+        - anything else (new series, eviction, ODP, effect-log truncation,
+          extension precondition failure) → return None and let the caller
+          pay the full rebuild.
+
+        Returns what do_execute expects from _superblock (an entry, a
+        fallback-reason string from _serve_hit, or None for rebuild)."""
+        from ...metrics import record_superblock_event
+
+        stale = cache.peek(sb_key)
+        if stale is None:
+            return None
+        old_versions, entry, _ = stale
+        if len(old_versions) != len(versions):
+            return None
+        overlap = False
+        for s, ov in zip(self.shard_nums, old_versions):
+            shard = ctx.memstore.shard(ctx.dataset, s)
+            reason = shard.ingest_effects_since(
+                ov, self.raw_start_ms, self.raw_end_ms
+            )
+            if reason == "overlap":
+                overlap = True
+            elif reason is not None:
+                # full_clear / log_truncated: the entry can never be
+                # revalidated or extended, and put() is gated on a stable
+                # version vector that sustained ingest keeps moving — drop
+                # it now or it pins device + host-mirror bytes forever
+                # (eviction only runs inside put).
+                cache.drop(sb_key)
+                record_superblock_event("restage")
+                return None
+        if not overlap:
+            if cache.revalidate(sb_key, old_versions, versions):
+                record_superblock_event("revalidate")
+                return self._serve_hit(ctx, entry)
+            return None
+        if not _SUPERBLOCK_EXTEND or entry.stage_mode is None:
+            record_superblock_event("restage")
+            return None
+        return self._extend_superblock(ctx, cache, sb_key, entry, versions)
+
+    def _extend_superblock(self, ctx: QueryContext, cache, sb_key,
+                           entry: "SuperblockEntry", versions: tuple):
+        """Absorb overlapping live-edge appends into the cached superblock
+        via ST.extend_superblock (append_to_block lifted to the superblock
+        level), then commit with versions re-read AFTER the extension and
+        the effect log classifying whatever landed mid-extension:
+
+        - nothing in-range → commit at the post-extension vector;
+        - interval OVERLAPS only (live-edge appends racing the extension
+          reads) → commit at the PRE-extension vector. The extension is
+          still internally consistent — _append_to_parts rejects torn
+          cross-epoch reads via its uniform-count/timestamp checks BEFORE
+          mutating anything, and each series' content is a true prefix of
+          its store state re-extendable from its own head — it just may
+          not include the racing samples, so the entry stays version-stale
+          and the NEXT query extends again from the new head instead of
+          the whole cache paying a rebuild storm;
+        - full effects (new series, eviction, ODP, truncation) → DROP the
+          entry: resident data or the row set may have changed under the
+          reads, and the mutated host mirrors must never be served again."""
+        from ...metrics import record_superblock_event
+
+        # row-set proof: a fresh lookup per shard must return exactly the
+        # entry's part refs, in order. This is the superblock analog of
+        # append_to_block's part_refs check — it catches the gap-series
+        # hazard (an append BEYOND the range extending a series' index
+        # span across it) that version vectors alone cannot distinguish
+        # from a plain live-edge append.
+        rewritten, _co, bucket_le = _histogram_suffix_rewrite(self.filters)
+        if bucket_le is not None:
+            # le=-sliced bucket superblocks are built by slicing a staged
+            # [S, T, B] block — there is nothing to append onto
+            record_superblock_event("restage")
+            return None
+        refs = []
+        for s in self.shard_nums:
+            shard = ctx.memstore.shard(ctx.dataset, s)
+            pids = shard.lookup_partitions(
+                self.filters, self.raw_start_ms, self.raw_end_ms
+            )
+            if not len(pids) and rewritten is not None:
+                pids = shard.lookup_partitions(
+                    rewritten, self.raw_start_ms, self.raw_end_ms
+                )
+            refs.extend((s, int(p)) for p in pids)
+        if refs != list(entry.block.part_refs):
+            record_superblock_event("restage")
+            return None
+        try:
+            nb = ST.extend_superblock(
+                ctx.memstore, ctx.dataset, entry.block, entry.col_name,
+                self.raw_end_ms, entry.stage_mode,
+                les=entry.les if entry.is_hist else None,
+            )
+        except Exception:
+            cache.drop(sb_key)  # mirrors possibly torn mid-mutation
+            record_superblock_event("extend_abort")
+            return None
+        if nb is None:
+            record_superblock_event("restage")
+            return None
+        versions_now = tuple(
+            ctx.memstore.shard(ctx.dataset, s).version for s in self.shard_nums
+        )
+        commit_versions = versions_now
+        if versions_now != versions:
+            for s, ov in zip(self.shard_nums, versions):
+                reason = ctx.memstore.shard(ctx.dataset, s).ingest_effects_since(
+                    ov, self.raw_start_ms, self.raw_end_ms
+                )
+                if reason == "overlap":
+                    # live-edge appends raced the extension reads: the
+                    # extension is consistent (see docstring) but may not
+                    # include them — commit STALE at the pre-extension
+                    # vector so the next query extends again
+                    commit_versions = versions
+                elif reason is not None:
+                    cache.drop(sb_key)
+                    record_superblock_event("extend_abort")
+                    return None
+        if nb is entry.block:
+            # nothing new was readable in range (e.g. the overlapping
+            # effect's samples were all dropped as out-of-order, or they
+            # landed after the reads): the entry is untouched and valid
+            # as-is at the commit vector
+            stale = cache.peek(sb_key)
+            if stale is not None and stale[1] is entry:
+                cache.revalidate(sb_key, stale[0], commit_versions)
+            record_superblock_event("revalidate")
+            return self._serve_hit(ctx, entry)
+        samples = int(np.asarray(nb.h_lens).sum())
+        new_entry = SuperblockEntry(
+            nb, entry.labels, entry.is_counter, entry.is_delta, samples,
+            entry.max_shard_series, series=entry.series,
+            is_hist=entry.is_hist, les=entry.les, les_dev=entry.les_dev,
+            col_name=entry.col_name, stage_mode=entry.stage_mode,
+        )
+        cache.put(sb_key, commit_versions, new_entry, ST.staged_nbytes(nb))
+        record_superblock_event("extend")
+        return self._serve_hit(ctx, new_entry)
 
     def _build_superblock(self, ctx: QueryContext, stage_mode: str, cache,
                           sb_key, versions, hints, hint_key):
@@ -1406,15 +1592,26 @@ class FusedAggregateExec(ExecPlan):
         les = None
         if is_hist:
             blocks, les = _unify_hist_blocks(blocks, block_les)
-        super_block = ST.concat_blocks(blocks).to_device()
+        # host mirrors ride along so live-edge ingest can EXTEND the
+        # superblock in place (ST.extend_superblock) instead of paying
+        # concat + full re-upload per append — the delta-summation move
+        super_block = ST.concat_blocks(blocks).to_device(
+            keep_host=_SUPERBLOCK_EXTEND
+        )
         nbytes = ST.staged_nbytes(super_block)
         import jax
 
+        resolved_mode = (
+            stage_mode if is_counter and not is_delta and not is_hist
+            else "raw"
+        )
         value = SuperblockEntry(
             super_block, labels, is_counter, is_delta, samples,
             max_shard_series, series=total, is_hist=is_hist, les=les,
             les_dev=(jax.device_put(np.asarray(les, dtype=np.float32))
                      if les is not None else None),
+            col_name=col_name,
+            stage_mode=None if sliced_hist else resolved_mode,
         )
         # versions re-read AFTER staging: an ingest that landed mid-build
         # makes the entry unservable for the next query (version mismatch),

@@ -32,6 +32,18 @@ from ..memstore.partition import Chunk
 _FRAME = struct.Struct("<IHH")  # payload len, schema_id, n_columns
 
 
+def torn_final_line(path: str) -> bool:
+    """A crashed writer can leave a jsonl journal without a trailing
+    newline; the next append must write ``\\n`` first or its first record
+    merges into the half-written line and corrupts ONE entry. True when
+    that guard byte is needed."""
+    if not os.path.exists(path) or os.path.getsize(path) == 0:
+        return False
+    with open(path, "rb") as chk:
+        chk.seek(-1, os.SEEK_END)
+        return chk.read(1) != b"\n"
+
+
 class ColumnStore:
     """Write/read API (reference ChunkSink + ChunkSource raw reads)."""
 
@@ -195,13 +207,8 @@ class LocalColumnStore(ColumnStore):
             ):
                 self._backfill_manifest(dataset, shard, mpath)
         with self._lock, open(path, "ab") as f, open(mpath, "ab") as mf:
-            # a torn final line without newline would merge with our first
-            # append and corrupt ONE entry; start clean instead
-            if mf.tell() > 0:
-                with open(mpath, "rb") as chk:
-                    chk.seek(-1, os.SEEK_END)
-                    if chk.read(1) != b"\n":
-                        mf.write(b"\n")
+            if torn_final_line(mpath):
+                mf.write(b"\n")
             for c in chunks:
                 enc = c.ensure_encoded(schema)
                 header = {
@@ -414,7 +421,11 @@ class LocalColumnStore(ColumnStore):
         d = os.path.join(self.root, dataset, f"shard-{shard}")
         for seg, hits in sorted(by_seg.items()):
             hits.sort(key=lambda e: e["off"])
-            with open(os.path.join(d, seg), "rb") as f:
+            try:
+                f = open(os.path.join(d, seg), "rb")
+            except OSError:
+                continue  # entry outlived its segment (manifest is a journal)
+            with f:
                 for e in hits:
                     f.seek(e["off"])
                     raw = f.read(e["len"])

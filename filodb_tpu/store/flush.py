@@ -92,6 +92,40 @@ class FlushCoordinator:
         return total
 
 
+def _reconcile_chunks(part: TimeSeriesPartition) -> None:
+    """Collapse duplicate / overlapping chunks loaded from the store.
+
+    The batch downsampler commits by MERGE into the live shard dir
+    (downsample/distributed.py): its output coexists with ingest-time
+    streaming flushes of the same periods, and a redone shard (after a
+    claim steal) re-commits equivalent chunks. Read-side contract: per
+    timestamp, the sample from the chunk with the LATER end_ts wins (the
+    more complete computation — a partial period's value is superseded as
+    its raw inputs fill in), ties broken by row count; exact duplicates
+    collapse to one. Chunk sets with no time overlap — the normal raw
+    path — are untouched. Trimmed chunks keep decoded arrays only;
+    re-encoding happens at next flush as usual."""
+    chunks = part.chunks
+    if len(chunks) < 2 or not any(
+        chunks[i].start_ts <= chunks[i - 1].end_ts for i in range(1, len(chunks))
+    ):
+        return
+    claimed: set[int] = set()
+    kept = []
+    for c in sorted(chunks, key=lambda c: (c.end_ts, c.n), reverse=True):
+        ts = np.asarray(c.column("timestamp"))
+        mask = np.fromiter((int(t) not in claimed for t in ts), bool, len(ts))
+        if mask.all():
+            kept.append(c)
+        elif mask.any():
+            cols = list((c.arrays or c.encoded).keys())
+            arrays = {name: np.asarray(c.column(name))[mask] for name in cols}
+            tsm = arrays["timestamp"]
+            kept.append(Chunk(int(tsm[0]), int(tsm[-1]), int(mask.sum()), arrays))
+        claimed.update(int(t) for t in ts)
+    part.chunks = sorted(kept, key=lambda c: c.start_ts)
+
+
 def recover_shard(memstore, store: ColumnStore, dataset: str, shard_num: int) -> int:
     """Rebuild a shard from the column store. Returns the min checkpointed
     offset to replay the ingestion stream from (-1 if none)."""
@@ -144,8 +178,11 @@ def recover_shard(memstore, store: ColumnStore, dataset: str, shard_num: int) ->
         shard.evictable.offer(part.part_id)  # recovered chunks are reclaimable
     for part in shard.partitions.values():
         part.chunks.sort(key=lambda c: c.start_ts)
-    shard.version += 1
-    shard.stage_cache.clear()
+        _reconcile_chunks(part)
+    with shard._lock:
+        shard.version += 1
+        shard._record_effect(0, 0, True)
+        shard.stage_cache.clear()
     # 3. checkpoints -> replay offset (reference: replay from min(checkpoints))
     cps = store.read_checkpoints(dataset, shard_num)
     return min(cps.values()) if cps else -1

@@ -279,8 +279,17 @@ def test_superblock_cache_version_keying():
     c = SuperblockCache(max_entries=2)
     c.put("k", (1, 1), "v", 10)
     assert c.get("k", (1, 1)) == "v"
-    assert c.get("k", (1, 2)) is None  # version moved: stale entry dropped
-    assert c.get("k", (1, 1)) is None
+    # version moved: a stale entry never serves, but it is RETAINED so the
+    # interval-aware refresh path can revalidate or extend it in place
+    assert c.get("k", (1, 2)) is None
+    assert c.peek("k") == ((1, 1), "v", 10)
+    # revalidate = CAS on the stored version vector
+    assert not c.revalidate("k", (9, 9), (1, 2))
+    assert c.revalidate("k", (1, 1), (1, 2))
+    assert c.get("k", (1, 2)) == "v"
+    # drop removes outright (aborted in-place extension)
+    c.drop("k")
+    assert c.peek("k") is None
 
 
 def test_superblock_cache_lru_on_hit():

@@ -25,6 +25,7 @@ the driver's 100k-series target (BASELINE.md north star).
 
 from __future__ import annotations
 
+import fcntl
 import json
 import os
 import subprocess
@@ -35,6 +36,33 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BENCH = os.path.join(REPO, "bench.py")
 LOG = os.path.join(REPO, "TPU_WATCH_LOG.txt")
 OUT = os.path.join(REPO, "BENCH_TPU_ATTESTED.json")
+LOCKFILE = os.path.join(REPO, ".tpu_watch.lock")
+
+_lock_fh = None  # module-global: the flock lives as long as the process
+
+
+def acquire_singleton_lock() -> bool:
+    """Exactly ONE watchdog instance may append to TPU_WATCH_LOG.txt: two
+    interleaved probe streams double-count probes and misstate the cycle
+    (round-5 advisor finding). flock on a pidfile — held for the process
+    lifetime, vanishes with the process (no stale-pidfile handling
+    needed)."""
+    global _lock_fh
+    fh = open(LOCKFILE, "a+")
+    try:
+        fcntl.flock(fh, fcntl.LOCK_EX | fcntl.LOCK_NB)
+    except OSError:
+        fh.seek(0)
+        holder = fh.read().strip() or "unknown pid"
+        fh.close()
+        print(f"tpu-watch already running ({holder}); refusing to "
+              f"double-append to {os.path.basename(LOG)}", flush=True)
+        return False
+    fh.truncate(0)
+    fh.write(f"{os.getpid()}\n")
+    fh.flush()
+    _lock_fh = fh  # keep the fd (and with it the lock) alive
+    return True
 
 PROBE_EVERY_S = int(os.environ.get("TPU_WATCH_PROBE_EVERY_S", 120))
 PROBE_TIMEOUT_S = int(os.environ.get("TPU_WATCH_PROBE_TIMEOUT_S", 30))
@@ -150,12 +178,15 @@ def attest(parsed: dict, kind: str) -> None:
 
 
 def main() -> None:
+    if not acquire_singleton_lock():
+        sys.exit(1)
     deadline = time.time() + DEADLINE_S
     log(f"watchdog start: probe every {PROBE_EVERY_S}s, timeout {PROBE_TIMEOUT_S}s, "
         f"deadline in {DEADLINE_S/3600:.1f}h")
     have_quick = have_full = False
     n_probes = n_ok = 0
     while time.time() < deadline and not have_full:
+        cycle_t0 = time.monotonic()
         n_probes += 1
         if probe():
             n_ok += 1
@@ -172,7 +203,10 @@ def main() -> None:
                     attest(got, "full")
                     have_full = True
                     break
-        time.sleep(PROBE_EVERY_S)
+        # true-cycle pacing: sleep the REMAINDER of the probe period, so the
+        # logged cadence is PROBE_EVERY_S, not PROBE_EVERY_S + probe/bench
+        # duration (the round-5 advisor caught the log drifting to 150 s)
+        time.sleep(max(0.0, PROBE_EVERY_S - (time.monotonic() - cycle_t0)))
     log(f"watchdog done: {n_probes} probes, {n_ok} healthy, "
         f"quick={have_quick} full={have_full}")
 
