@@ -17,8 +17,10 @@ $(NATIVE_DIR)/libfilodbindex.so: $(NATIVE_DIR)/index.cpp
 $(NATIVE_DIR)/libfilodbprom.so: $(NATIVE_DIR)/promparse.cpp
 	g++ -O3 -march=native -std=c++17 -shared -fPIC $< -o $@
 
+# best-effort: the renderer needs float std::to_chars (gcc >= 11); runtime
+# falls back to the Python renderer (api/promjson.py) when the .so is absent
 $(NATIVE_DIR)/libfilodbrender.so: $(NATIVE_DIR)/promrender.cpp
-	g++ -O3 -march=native -std=c++17 -shared -fPIC $< -o $@
+	-g++ -O3 -march=native -std=c++17 -shared -fPIC $< -o $@
 
 # default test run; pair with `make bench-smoke` before sending a perf-
 # sensitive change (the smoke gate catches losing the fused single-dispatch
@@ -39,10 +41,13 @@ test-ingest-chaos: native
 	python -m pytest tests/ -q -m ingest_chaos
 
 # observability suite (doc/observability.md): trace propagation + stitching,
-# slow-query log, metrics exposition — plus the span-coverage lint asserting
-# every ExecPlan subclass executes under a span
+# slow-query log, resource ledger + self-scrape, metrics exposition — plus
+# the span-coverage lint (every ExecPlan subclass executes under a span) and
+# the metrics-doc lint (every filodb_* family emitted is documented, and
+# vice versa)
 test-observability: native
 	python tools/check_spans.py
+	python tools/check_metrics.py
 	python -m pytest tests/ -q -m "observability or chaos" --continue-on-collection-errors
 
 bench: native

@@ -86,6 +86,15 @@ class _RemoteExecServicer:
         return None
 
     @staticmethod
+    def _stats_ext(context) -> bool:
+        """Origin advertises StatsExt support via metadata; absent = older
+        origin that would fail on the unknown frame type, so don't send."""
+        for k, v in context.invocation_metadata():
+            if k == STATS_EXT_MD_KEY:
+                return v == "1"
+        return False
+
+    @staticmethod
     def _trace_parent(context) -> tuple[str | None, str | None]:
         """(trace_id, parent_span_id) from call metadata: the origin's span
         identity, so this peer's span tree joins the origin's trace and its
@@ -98,7 +107,7 @@ class _RemoteExecServicer:
                 parent = v
         return trace_id, parent
 
-    def _stream(self, run):
+    def _stream(self, run, stats_ext: bool = False):
         """Run ``run()`` -> QueryResult and stream frames; errors go in-band
         as the final frame (clients re-raise typed)."""
         from ..coordinator.scheduler import QueryRejected
@@ -123,7 +132,7 @@ class _RemoteExecServicer:
             log.exception("remote exec failed")
             yield error_frame("Internal", f"{type(e).__name__}: {e}")
             return
-        yield from result_to_frames(res)
+        yield from result_to_frames(res, stats_ext=stats_ext)
 
     # -- methods ----------------------------------------------------------
 
@@ -147,7 +156,7 @@ class _RemoteExecServicer:
                 trace_id=trace_id, parent_span_id=parent_span,
             )
 
-        yield from self._stream(run)
+        yield from self._stream(run, stats_ext=self._stats_ext(context))
 
     def ExecutePlan(self, request: "pb.ExecutePlanRequest", context):
         self._authorize(context)
@@ -164,7 +173,7 @@ class _RemoteExecServicer:
                                     trace_id=trace_id,
                                     parent_span_id=parent_span)
 
-        yield from self._stream(run)
+        yield from self._stream(run, stats_ext=self._stats_ext(context))
 
 
 def serve_grpc(engine, port: int = 0, auth_token: str | None = None,
@@ -238,6 +247,11 @@ ALLOW_PARTIAL_MD_KEY = "x-filodb-allow-partial"
 TRACE_ID_MD_KEY = "x-filodb-trace-id"
 PARENT_SPAN_MD_KEY = "x-filodb-parent-span"
 
+# origin capability flag: "1" = the caller's frames_to_result understands
+# the in-band StatsExt frame (kernel_ns + cache events); peers never send
+# the frame unsolicited so older origins keep working mid-rolling-deploy
+STATS_EXT_MD_KEY = "x-filodb-stats-ext"
+
 # transient codes; DEADLINE_EXCEEDED is excluded — the budget is already
 # burnt. Retry ownership: plan-scatter children (GrpcPlanRemoteExec) pass
 # retries=0 and mark the error retryable so the dispatch layer
@@ -277,6 +291,9 @@ def _metadata(auth_token: str | None, allow_partial: bool | None = None,
     if trace is not None:
         md.append((TRACE_ID_MD_KEY, trace[0]))
         md.append((PARENT_SPAN_MD_KEY, trace[1]))
+    # this client understands the StatsExt frame (proto_plan.STATS_EXT);
+    # peers only send it when the origin advertises so
+    md.append((STATS_EXT_MD_KEY, "1"))
     return tuple(md) or None
 
 

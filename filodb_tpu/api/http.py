@@ -93,6 +93,10 @@ class PromApiHandler(BaseHTTPRequestHandler):
     # multi-host deployments should set http_auth_token so only peers (who
     # share the token) can reach it, and keep the port off the public edge.
     local_engine: QueryEngine = None
+    # additional per-dataset engines reachable via ?dataset=<name> — the
+    # `_system` self-telemetry dataset rides this so the server's own
+    # metrics are queryable through the standard (fused) query API
+    dataset_engines: dict = {}
     auth_token: str | None = None  # optional bearer auth (server factory)
     # zero-arg profiler report hook; wired by the server ONLY when the
     # profiler config block enables it (/debug/profile gate)
@@ -101,9 +105,23 @@ class PromApiHandler(BaseHTTPRequestHandler):
     GZIP_MIN_BYTES = 1024
     STREAM_MIN_SAMPLES = 200_000  # above this, query_range streams chunked
 
-    def _engine_for_request(self) -> QueryEngine:
+    def _engine_for_request(self, params: dict | None = None) -> QueryEngine:
         if self.local_engine is not None and self.headers.get("X-FiloDB-Local"):
             return self.local_engine
+        if params is not None:
+            # handlers pass their parsed params so a POSTed form body's
+            # dataset= routes too (the body is consumable only once)
+            ds = (params.get("dataset") or [None])[0]
+        else:
+            qs = urllib.parse.parse_qs(urllib.parse.urlparse(self.path).query)
+            ds = (qs.get("dataset") or [None])[0]
+        if ds:
+            eng = (self.dataset_engines or {}).get(ds)
+            if eng is not None:
+                return eng
+            if ds != getattr(self.engine, "dataset", None):
+                # a typo must be a 400, never silently the default dataset
+                raise ValueError(f"unknown dataset {ds!r}")
         return self.engine
 
     # -- plumbing ---------------------------------------------------------
@@ -268,6 +286,10 @@ class PromApiHandler(BaseHTTPRequestHandler):
                 from ..metrics import SLOW_QUERY_LOG
 
                 return self._send(200, J.success(SLOW_QUERY_LOG.entries()))
+            if path == "/debug/resources":
+                return self._resources()
+            if path == "/debug/superblocks":
+                return self._superblocks()
             if path == "/debug/profile":
                 return self._profile()
             if path == "/api/v1/cardinality":
@@ -327,7 +349,7 @@ class PromApiHandler(BaseHTTPRequestHandler):
             return self._send(400, J.error("bad_data", "end timestamp before start"))
         trace_on = self._trace_requested(p)
         trace_id, parent_span = self._trace_parent()
-        res = self._engine_for_request().query_range(
+        res = self._engine_for_request(p).query_range(
             query, start, end, step, allow_partial_results=self._allow_partial(p),
             trace_id=trace_id, parent_span_id=parent_span,
         )
@@ -362,6 +384,12 @@ class PromApiHandler(BaseHTTPRequestHandler):
             "samplesScanned": res.stats.samples_scanned,
             "cpuNanos": res.stats.cpu_ns,
             "bytesStaged": res.stats.bytes_staged,
+            # resource attribution (doc/observability.md): device dispatch
+            # seconds and staging/superblock cache events for THIS query
+            "kernelSeconds": round(res.stats.kernel_ns / 1e9, 9),
+            "cacheHits": res.stats.cache_hits,
+            "cacheMisses": res.stats.cache_misses,
+            "cacheExtends": res.stats.cache_extends,
         }
         # large results stream chunked: memory stays bounded instead of
         # holding matrix + full JSON string (reference executeStreaming,
@@ -387,7 +415,7 @@ class PromApiHandler(BaseHTTPRequestHandler):
         t = _parse_time(self._q(p, "time"), default=time.time())
         trace_on = self._trace_requested(p)
         trace_id, parent_span = self._trace_parent()
-        res = self._engine_for_request().query_instant(
+        res = self._engine_for_request(p).query_instant(
             query, t, allow_partial_results=self._allow_partial(p),
             trace_id=trace_id, parent_span_id=parent_span,
         )
@@ -411,7 +439,7 @@ class PromApiHandler(BaseHTTPRequestHandler):
         limit = self._q(p, "limit")
         match = p.get("match[]", [])
         filters = _matchers_from(match[0]) if match else []
-        names = self._engine_for_request().label_names(
+        names = self._engine_for_request(p).label_names(
             filters, int(start * 1000), int(end * 1000)
         )
         names = ["__name__" if n == "_metric_" else n for n in names]
@@ -428,7 +456,7 @@ class PromApiHandler(BaseHTTPRequestHandler):
         match = p.get("match[]", [])
         limit = self._q(p, "limit")
         filters = _matchers_from(match[0]) if match else []
-        vals = self._engine_for_request().label_values(
+        vals = self._engine_for_request(p).label_values(
             filters, label, int(start * 1000), int(end * 1000),
             limit=int(limit) if limit else None,
         )
@@ -441,7 +469,7 @@ class PromApiHandler(BaseHTTPRequestHandler):
         out = []
         for expr in p.get("match[]", []):
             filters = _matchers_from(expr)
-            for tags in self._engine_for_request().series(
+            for tags in self._engine_for_request(p).series(
                 filters, int(start * 1000), int(end * 1000), limit=10000
             ):
                 out.append(J._labels_out(dict(tags)))
@@ -451,15 +479,55 @@ class PromApiHandler(BaseHTTPRequestHandler):
         """Prometheus exposition of internal metrics. Per-shard stats are a
         scrape-time collector registered by make_server (reference
         TimeSeriesShardStats gauges + Kamon reporters) — one exposition
-        path, with proper label escaping, for everything."""
+        path, with proper label escaping, for everything. Content-type
+        negotiation: an Accept header naming application/openmetrics-text
+        gets the OpenMetrics 1.0 rendering (HELP/TYPE metadata, trace-id
+        exemplars on latency buckets, # EOF terminator)."""
         from ..metrics import REGISTRY
 
-        body = REGISTRY.expose().encode()
+        openmetrics = "application/openmetrics-text" in (
+            self.headers.get("Accept") or ""
+        )
+        body = REGISTRY.expose(openmetrics=openmetrics).encode()
+        ctype = (
+            "application/openmetrics-text; version=1.0.0; charset=utf-8"
+            if openmetrics else "text/plain; version=0.0.4"
+        )
         self.send_response(200)
-        self.send_header("Content-Type", "text/plain; version=0.0.4")
+        self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
+
+    def _resources(self):
+        """Resource-ledger introspection: per-kind device bytes, the
+        ledger-vs-cold-walk drift check, and per-tenant query-resource
+        totals (doc/observability.md "Resource accounting")."""
+        from ..ledger import LEDGER
+        from ..metering import tenant_query_snapshot
+
+        verify = LEDGER.verify()
+        return self._send(200, J.success({
+            "device_bytes": LEDGER.balances(),
+            "kinds": verify["kinds"],
+            "accounts": verify["accounts"],
+            "tenants": tenant_query_snapshot(),
+        }))
+
+    def _superblocks(self):
+        """Superblock-cache introspection: one entry per cached superblock
+        (key, true device bytes, age, hits, last maintenance outcome from
+        the filodb_superblock_maintenance_total taxonomy)."""
+        cache = getattr(self.engine.memstore, "_superblock_cache", None)
+        entries = cache.snapshot() if cache is not None else []
+        return self._send(200, J.success({
+            "entries": entries,
+            "count": len(entries),
+            "bytes": sum(e["bytes"] for e in entries),
+            # THIS cache's ledger balance (the kind-wide filodb_device_bytes
+            # gauge sums every live cache in the process)
+            "ledger_bytes": cache.ledger.bytes if cache is not None else 0,
+        }))
 
     def _profile(self):
         """Sampling-profiler report (config-gated: the server wires
@@ -479,7 +547,7 @@ class PromApiHandler(BaseHTTPRequestHandler):
         p = self._params()
         prefix = [x for x in (self._q(p, "prefix", "") or "").split(",") if x]
         depth = int(self._q(p, "depth", str(len(prefix) + 1)))
-        out = self._engine_for_request().ts_cardinalities(prefix, depth)
+        out = self._engine_for_request(p).ts_cardinalities(prefix, depth)
         return self._send(200, J.success(out))
 
     def _query_exemplars(self):
@@ -624,7 +692,8 @@ def register_shard_stats_collector(engine: QueryEngine) -> None:
 def make_server(engine: QueryEngine, host: str = "127.0.0.1", port: int = 9090,
                 auth_token: str | None = None,
                 local_engine: QueryEngine | None = None,
-                flush_hook=None) -> ThreadingHTTPServer:
+                flush_hook=None,
+                dataset_engines: dict | None = None) -> ThreadingHTTPServer:
     # membership hooks (members_hook/join_hook) are wired as class attrs on
     # the returned server's RequestHandlerClass AFTER start — the registry
     # needs the bound port for its self URL (server.py seed bootstrap)
@@ -632,6 +701,7 @@ def make_server(engine: QueryEngine, host: str = "127.0.0.1", port: int = 9090,
     handler = type(
         "BoundHandler", (PromApiHandler,),
         {"engine": engine, "auth_token": auth_token, "local_engine": local_engine,
+         "dataset_engines": dict(dataset_engines or {}),
          "flush_hook": staticmethod(flush_hook) if flush_hook else None},
     )
     return ThreadingHTTPServer((host, port), handler)
@@ -640,9 +710,10 @@ def make_server(engine: QueryEngine, host: str = "127.0.0.1", port: int = 9090,
 def serve_background(engine: QueryEngine, host: str = "127.0.0.1", port: int = 0,
                      auth_token: str | None = None,
                      local_engine: QueryEngine | None = None,
-                     flush_hook=None):
+                     flush_hook=None, dataset_engines: dict | None = None):
     """Start the API server on a thread; returns (server, actual_port)."""
-    srv = make_server(engine, host, port, auth_token, local_engine, flush_hook)
+    srv = make_server(engine, host, port, auth_token, local_engine, flush_hook,
+                      dataset_engines)
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
     return srv, srv.server_address[1]

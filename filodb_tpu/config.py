@@ -102,6 +102,19 @@ DEFAULTS: dict = {
     "preagg_rules": [],
     # profiler (reference filodb.profiler)
     "profiler": {"enabled": False, "interval_ms": 10},
+    # self-telemetry (telemetry.py): when self_scrape_interval_s is set the
+    # server samples its own /metrics registry every interval and ingests
+    # the samples as real time series into the "_system" dataset, queryable
+    # through the standard query API via ?dataset=_system (so dashboards
+    # over the server's own kernel/cache/tenant metrics run through the
+    # fused query path). null disables. tpu_watch_log: path of the
+    # tools/tpu_watch.py log to surface as filodb_tpu_* gauges ("auto" =
+    # <repo>/TPU_WATCH_LOG.txt when present; null disables).
+    "telemetry": {
+        "self_scrape_interval_s": None,
+        "self_scrape_spread": 1,
+        "tpu_watch_log": "auto",
+    },
 }
 
 

@@ -1118,10 +1118,41 @@ class QueryEngine:
                                                 allow_partial, trace_id=trace_id,
                                                 parent_span_id=parent_span_id)
         REGISTRY.counter("filodb_queries", dataset=self.dataset).inc()
+        # trace-id exemplar: the OpenMetrics exposition attaches it to the
+        # latency bucket this query landed in, so a spiking bucket links
+        # straight to its trace / slow-query-log entry
+        tid = getattr(res.trace, "trace_id", None) if res.trace is not None \
+            else None
+        if tid is None and isinstance(res.trace, dict):
+            tid = res.trace.get("trace_id")
         REGISTRY.histogram("filodb_query_latency_seconds", dataset=self.dataset).observe(
-            _time.perf_counter() - t0
+            _time.perf_counter() - t0,
+            exemplar={"trace_id": tid} if tid else None,
         )
         return res
+
+    def _meter_tenant(self, plan, ctx, elapsed_s: float) -> None:
+        """Attribute the finished query's resources to the tenant resolved
+        from its selector filters (metering.py — the admission-control
+        accounting), and tag the trace root so ?trace=true shows it.
+
+        Child executions (a parent span rides the request: remote-exec from
+        another node, or a peer's scatter leg) only TAG — the origin meters
+        the whole query once, from its merged query-wide stats; metering
+        here too would double-count every remote child's resources."""
+        from ..metering import record_tenant_query, tenant_of_plan
+
+        ws, ns = tenant_of_plan(plan)
+        root = getattr(ctx, "trace_root", None)
+        if root is not None:
+            root.tags["ws"] = ws
+            root.tags["ns"] = ns
+            if root.parent_id is not None:
+                return
+        record_tenant_query(
+            ws, ns, elapsed_s, ctx.stats.kernel_ns / 1e9,
+            ctx.stats.bytes_staged,
+        )
 
     def _query_range_uncoalesced(self, promql: str, start_s: float,
                                  end_s: float, step_s: float,
@@ -1144,7 +1175,9 @@ class QueryEngine:
         self._finish(res, ctx)
         if res.result_type == "matrix" or res.grids:
             res.result_type = "matrix"
-        self._observe_slow(promql, _time.perf_counter() - t0, res)
+        elapsed_s = _time.perf_counter() - t0
+        self._meter_tenant(plan, ctx, elapsed_s)
+        self._observe_slow(promql, elapsed_s, res)
         return res
 
     def _run(self, exec_plan, ctx):
@@ -1185,7 +1218,9 @@ class QueryEngine:
         self._start_trace(ctx, qname, trace_id, parent_span_id)
         res = self._run(exec_plan, ctx)
         self._finish(res, ctx)
-        self._observe_slow(qname, _time.perf_counter() - t0, res)
+        elapsed_s = _time.perf_counter() - t0
+        self._meter_tenant(plan, ctx, elapsed_s)
+        self._observe_slow(qname, elapsed_s, res)
         return res
 
     def label_values(self, filters, label: str, start_ms: int, end_ms: int, limit=None):
@@ -1225,5 +1260,7 @@ class QueryEngine:
         self._finish(res, ctx)
         if res.result_type == "matrix":
             res.result_type = "vector"
-        self._observe_slow(promql, _time.perf_counter() - t0, res)
+        elapsed_s = _time.perf_counter() - t0
+        self._meter_tenant(plan, ctx, elapsed_s)
+        self._observe_slow(promql, elapsed_s, res)
         return res

@@ -208,6 +208,12 @@ class PromQlRemoteExec(ExecPlan):
                 samples_scanned=int(st.get("samplesScanned", 0)),
                 cpu_ns=int(st.get("cpuNanos", 0)),
                 bytes_staged=int(st.get("bytesStaged", 0)),
+                # resource attribution (doc/observability.md): remote kernel
+                # and cache work must fold into the origin's query totals
+                kernel_ns=int(round(float(st.get("kernelSeconds", 0.0)) * 1e9)),
+                cache_hits=int(st.get("cacheHits", 0)),
+                cache_misses=int(st.get("cacheMisses", 0)),
+                cache_extends=int(st.get("cacheExtends", 0)),
             )
         return out
 

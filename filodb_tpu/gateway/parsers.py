@@ -113,6 +113,24 @@ def _parse_sample_line(line: str):
     return name, tags, ts_ms, val, exemplar
 
 
+def _series_type(name: str, types: dict[str, str]) -> str:
+    """Resolve a sample's type from the TYPE table, understanding family
+    suffixes: a ``# TYPE m histogram|summary`` family exposes ``m_bucket``/
+    ``m_count``/``m_sum`` series, which are cumulative — counter semantics
+    (Prometheus treats them so for rate()); OpenMetrics counters declare the
+    family WITHOUT the ``_total`` their samples carry."""
+    t = types.get(name)
+    if t is not None:
+        return t
+    for suffix in ("_bucket", "_count", "_sum"):
+        if name.endswith(suffix):
+            if types.get(name[: -len(suffix)]) in ("histogram", "summary"):
+                return "counter"
+    if name.endswith("_total") and types.get(name[:-6]) == "counter":
+        return "counter"
+    return "untyped"
+
+
 def parse_prom_text(text: str, with_exemplars: bool = False):
     """Prometheus exposition format -> (metric, tags, ts_ms, value, type)
     tuples; with ``with_exemplars`` a sixth element carries the OpenMetrics
@@ -132,9 +150,9 @@ def parse_prom_text(text: str, with_exemplars: bool = False):
             continue
         name, tags, ts_ms, val, exemplar = _parse_sample_line(line)
         if with_exemplars:
-            yield name, tags, ts_ms, val, types.get(name, "untyped"), exemplar
+            yield name, tags, ts_ms, val, _series_type(name, types), exemplar
         else:
-            yield name, tags, ts_ms, val, types.get(name, "untyped")
+            yield name, tags, ts_ms, val, _series_type(name, types)
 
 
 def _native_influx_batch(text: str, default_ts_ms: int, ws: str, ns: str):

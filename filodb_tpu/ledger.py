@@ -1,0 +1,217 @@
+"""Device-resource ledger: ONE live accounting object every HBM consumer
+debits and credits (reference analog: MemFactory/BlockManager free-block
+accounting surfaced through FilodbMetrics gauges — resource attribution
+lives INSIDE the allocation boundary, never bolted on after the fact).
+
+Consumers register an *account* per cache (per-shard staging caches, the
+cross-shard ``SuperblockCache``, the persistent XLA compile cache) with:
+
+- a ``kind`` label — the ``filodb_device_bytes{kind=...}`` dimension;
+- a *walker*: a function recomputing the owner's TRUE footprint from the
+  cache itself (``staged_nbytes`` over live entries). The ledger's
+  ``verify()`` compares every live account's running balance against a cold
+  walk — the drift check the soak test pins to zero, and the leak detector
+  ``/debug/resources`` serves in production.
+
+Accounts hold their owner only through a weakref: a shut-down memstore's
+caches must not be pinned by process-global accounting (same discipline as
+``register_shard_stats_collector``). An account collected while still
+holding bytes is itself the signal a cache died without releasing — counted
+in ``filodb_device_leaked_bytes_total{kind}``.
+
+Nothing here touches device values: balances come from ``.nbytes`` metadata
+and the walkers read ``.nbytes`` only, so accounting adds zero host syncs —
+the warm fused query stays exactly ONE kernel dispatch with accounting on.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+
+from .metrics import REGISTRY
+
+
+class LedgerAccount:
+    """One consumer's balance within the ledger. ``alloc``/``free`` are the
+    debit/credit pair; ``sync()`` (self-syncing accounts only, e.g. the XLA
+    compile cache whose writes we don't control) re-reads the walker."""
+
+    __slots__ = ("kind", "name", "synced", "_owner_ref", "_walker", "_lock",
+                 "bytes", "allocs", "frees", "created")
+
+    def __init__(self, kind: str, name: str, owner_ref, walker, synced: bool):
+        self.kind = kind
+        self.name = name
+        self.synced = synced
+        self._owner_ref = owner_ref
+        self._walker = walker
+        self._lock = threading.Lock()
+        self.bytes = 0
+        self.allocs = 0
+        self.frees = 0
+        self.created = time.time()
+
+    def alloc(self, nbytes: int, count: int = 1) -> None:
+        if nbytes <= 0 and count <= 0:
+            return
+        with self._lock:
+            self.bytes += int(nbytes)
+            self.allocs += count
+        REGISTRY.counter("filodb_device_alloc", kind=self.kind).inc(count)
+        REGISTRY.counter("filodb_device_alloc_bytes", kind=self.kind).inc(int(nbytes))
+
+    def free(self, nbytes: int, reason: str = "drop", count: int = 1) -> None:
+        """Credit released bytes. ``reason``: ``evict`` (budget eviction),
+        ``invalidate`` (ingest invalidation / wholesale clear), ``replace``
+        (entry superseded by a rebuild/repair), ``drop`` (explicit
+        removal)."""
+        if nbytes <= 0 and count <= 0:
+            return
+        with self._lock:
+            self.bytes -= int(nbytes)
+            self.frees += count
+        REGISTRY.counter("filodb_device_free", kind=self.kind, reason=reason).inc(count)
+        REGISTRY.counter(
+            "filodb_device_free_bytes", kind=self.kind, reason=reason
+        ).inc(int(nbytes))
+
+    def walk(self) -> int | None:
+        """Cold recount of the owner's true footprint (None when the owner
+        is gone or has no walker)."""
+        if self._walker is None:
+            return None
+        owner = self._owner_ref() if self._owner_ref is not None else None
+        if self._owner_ref is not None and owner is None:
+            return None
+        try:
+            return int(self._walker(owner) if self._owner_ref is not None
+                       else self._walker())
+        except Exception:  # noqa: BLE001 — a sick walker must not kill /metrics
+            return None
+
+    def sync(self) -> None:
+        """Self-syncing accounts: balance = walker() (the compile cache —
+        jax writes it, we only observe)."""
+        got = self.walk()
+        if got is not None:
+            with self._lock:
+                self.bytes = got
+
+    def alive(self) -> bool:
+        return self._owner_ref is None or self._owner_ref() is not None
+
+
+class DeviceLedger:
+    """Process-global registry of LedgerAccounts; exposes the per-kind
+    ``filodb_device_bytes`` gauges as a scrape-time collector and serves
+    the drift check (``verify``) behind ``/debug/resources``."""
+
+    KINDS = ("staged_block", "superblock", "compile_cache")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._accounts: dict[int, LedgerAccount] = {}
+        self._next_id = 0
+        self._seen_kinds: set[str] = set(self.KINDS)
+        # dead-owner notices: weakref callbacks run mid-GC (possibly inside
+        # OTHER locks), so they only append to this list — list.append is
+        # atomic under the GIL — and real cleanup happens lazily in _reap()
+        self._dead: list[tuple[int, str, int]] = []
+
+    def register(self, owner, kind: str, walker=None, name: str = "",
+                 synced: bool = False) -> LedgerAccount:
+        """Create an account for ``owner`` (held weakly). ``walker(owner)``
+        recomputes the true byte footprint for the drift check. ``owner``
+        may be None for keyed module-level accounts (pass ``synced=True``
+        and a zero-arg walker)."""
+        with self._lock:
+            aid = self._next_id
+            self._next_id += 1
+        acct_holder: list[LedgerAccount] = []
+
+        def on_dead(_ref, _aid=aid):
+            acct = acct_holder[0] if acct_holder else None
+            # self-syncing accounts only OBSERVE external storage (e.g. the
+            # compile-cache dir); their owner dying releases nothing, so a
+            # replaced probe must not fire the leak alarm
+            leaked = acct.bytes if acct is not None and not acct.synced else 0
+            self._dead.append((_aid, kind, leaked))
+
+        ref = weakref.ref(owner, on_dead) if owner is not None else None
+        acct = LedgerAccount(kind, name, ref, walker, synced)
+        acct_holder.append(acct)
+        with self._lock:
+            self._accounts[aid] = acct
+        return acct
+
+    def _reap(self) -> None:
+        """Lazily process dead-owner notices: drop their accounts and count
+        any unreleased balance as leaked bytes."""
+        while self._dead:
+            try:
+                aid, kind, leaked = self._dead.pop()
+            except IndexError:  # racer drained it
+                return
+            with self._lock:
+                self._accounts.pop(aid, None)
+            if leaked > 0:
+                REGISTRY.counter("filodb_device_leaked_bytes", kind=kind).inc(leaked)
+
+    def _live_accounts(self) -> list[LedgerAccount]:
+        self._reap()
+        with self._lock:
+            accts = list(self._accounts.values())
+        return [a for a in accts if a.alive()]
+
+    def balances(self) -> dict[str, int]:
+        """Per-kind byte balance over live accounts (self-syncing accounts
+        refresh first)."""
+        out: dict[str, int] = {}
+        for a in self._live_accounts():
+            if a.synced:
+                a.sync()
+            out[a.kind] = out.get(a.kind, 0) + a.bytes
+        return out
+
+    def verify(self) -> dict:
+        """Drift check: ledger balance vs a cold walk of every live cache.
+        Returns ``{"kinds": {kind: {"ledger": b, "actual": b, "drift": d}},
+        "accounts": [...]}`` — drift must be zero for debit/credit kinds
+        (self-syncing kinds are zero by construction)."""
+        kinds: dict[str, dict] = {}
+        accounts = []
+        for a in self._live_accounts():
+            if a.synced:
+                a.sync()
+            actual = a.walk()
+            slot = kinds.setdefault(a.kind, {"ledger": 0, "actual": 0, "drift": 0})
+            slot["ledger"] += a.bytes
+            if actual is not None:
+                slot["actual"] += actual
+                slot["drift"] += a.bytes - actual
+            accounts.append({
+                "kind": a.kind,
+                "name": a.name,
+                "bytes": a.bytes,
+                "actual": actual,
+                "allocs": a.allocs,
+                "frees": a.frees,
+            })
+        return {"kinds": kinds, "accounts": accounts}
+
+    def publish(self) -> None:
+        """Scrape-time collector: refresh the per-kind gauges. Kinds seen
+        once keep publishing (possibly 0) so dashboards don't see series
+        vanish when a cache empties."""
+        balances = self.balances()
+        self._seen_kinds |= set(balances)
+        for kind in self._seen_kinds:
+            REGISTRY.gauge("filodb_device_bytes", kind=kind).set(
+                float(balances.get(kind, 0))
+            )
+
+
+LEDGER = DeviceLedger()
+REGISTRY.register_collector("device_ledger", LEDGER.publish)

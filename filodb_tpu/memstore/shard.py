@@ -131,6 +131,16 @@ class StageEntry:
 EFFECT_LOG_MAX = 1024
 
 
+def _stage_cache_walker(shard) -> int:
+    """Cold recount of the shard staging cache's true device footprint —
+    the ledger drift check's ground truth (must stay byte-identical to the
+    accounting at insert: both use ops/staging.staged_nbytes)."""
+    from ..ops.staging import staged_nbytes
+
+    with shard._lock:
+        return sum(staged_nbytes(e.block) for e in shard.stage_cache.values())
+
+
 class TimeSeriesShard:
     def __init__(self, dataset: str, shard_num: int, config: StoreConfig | None = None):
         self.dataset = dataset
@@ -158,6 +168,16 @@ class TimeSeriesShard:
         # memory reclaim + chunk seal versioning)
         self.version = 0
         self.stage_cache: dict = {}
+        # device-resource ledger account (filodb_tpu/ledger.py): every
+        # stage-cache insert/evict/clear debits/credits it, and the drift
+        # check recounts via the walker below (weakly bound — a dead shard
+        # must not be pinned by process-global accounting)
+        from ..ledger import LEDGER
+
+        self.ledger = LEDGER.register(
+            self, "staged_block", _stage_cache_walker,
+            name=f"{dataset}/shard-{shard_num}",
+        )
         # on-demand paging source: set to the ColumnStore to transparently
         # page evicted chunks back in at query time (reference
         # OnDemandPagingShard.scala:26 + DemandPagedChunkStore)
@@ -238,6 +258,15 @@ class TimeSeriesShard:
                 reason = "overlap"
         return reason
 
+    def _clear_stage_cache(self, reason: str = "invalidate") -> None:
+        """Wholesale staging-cache clear, crediting the device ledger for
+        every dropped entry (callers hold the shard lock). The ONE clear
+        path — a bare ``stage_cache.clear()`` would leak ledger balance."""
+        if self.stage_cache:
+            freed = sum(e.nbytes for e in self.stage_cache.values())
+            self.ledger.free(freed, reason=reason, count=len(self.stage_cache))
+            self.stage_cache.clear()
+
     def _invalidate_stage_range(self, min_ts, max_ts, new_series: bool,
                                 raw_lo=None) -> None:
         """Dirty-mark (not drop) the staging-cache entries the new samples
@@ -266,7 +295,7 @@ class TimeSeriesShard:
         lock."""
         if new_series or min_ts is None:
             self._record_effect(0, 0, True)
-            self.stage_cache.clear()
+            self._clear_stage_cache()
             return
         self._record_effect(int(min_ts), int(max_ts), False)
         # entries accumulate the ACCEPTED-sample interval (not the
@@ -352,7 +381,7 @@ class TimeSeriesShard:
                 )
             else:
                 self._record_effect(0, 0, True)
-                self.stage_cache.clear()
+                self._clear_stage_cache()
             return n
 
     def _ingest_series(self, sb: SeriesBatch) -> int:
@@ -511,7 +540,7 @@ class TimeSeriesShard:
                 # version in its key — invalidation is the contract)
                 self.version += 1
                 self._record_effect(0, 0, True)
-                self.stage_cache.clear()
+                self._clear_stage_cache()
         return dropped
 
     def add_exemplar(self, partkey: bytes, ts_ms: int, value: float, labels) -> bool:
@@ -578,7 +607,7 @@ class TimeSeriesShard:
                 self._resident_last = resident - freed
                 self.version += 1
                 self._record_effect(0, 0, True)
-                self.stage_cache.clear()
+                self._clear_stage_cache()
                 self.stats.headroom_evictions += 1
                 self.stats.bytes_reclaimed += freed
         return freed
@@ -640,7 +669,7 @@ class TimeSeriesShard:
             if n:
                 self.version += 1
                 self._record_effect(0, 0, True)
-                self.stage_cache.clear()
+                self._clear_stage_cache()
                 self.odp_stats_pages += n
         return n
 

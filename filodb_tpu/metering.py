@@ -8,10 +8,15 @@ at per-shard scale; the cap bounds memory like HLL's fixed size).
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass, field
 
 from .metrics import REGISTRY
+
+
+# the per-tenant series gauges publish() maintains (and ages out)
+_TENANT_SERIES_GAUGES = ("filodb_tenant_ts_total", "filodb_tenant_ts_active")
 
 
 class TenantIngestionMetering:
@@ -21,6 +26,10 @@ class TenantIngestionMetering:
     def __init__(self, memstore, dataset: str):
         self.memstore = memstore
         self.dataset = dataset
+        # tenants published last cycle: a tenant that vanishes (eviction,
+        # retention) must have its gauges REMOVED, not frozen at the last
+        # value forever (Registry.remove is the series-aging primitive)
+        self._published: set[tuple[str, str]] = set()
 
     def collect(self) -> dict[tuple[str, str], dict]:
         merged: dict[tuple[str, str], dict] = {}
@@ -34,10 +43,114 @@ class TenantIngestionMetering:
 
     def publish(self) -> int:
         stats = self.collect()
+        live = set(stats)
+        for ws, ns in self._published - live:
+            for name in _TENANT_SERIES_GAUGES:
+                REGISTRY.remove(name, ws=ws, ns=ns)
         for (ws, ns), rec in stats.items():
             REGISTRY.gauge("filodb_tenant_ts_total", ws=ws, ns=ns).set(rec["ts_count"])
             REGISTRY.gauge("filodb_tenant_ts_active", ws=ws, ns=ns).set(rec["active"])
+        self._published = live
         return len(stats)
+
+
+# -- per-query tenant attribution (the admission-control foundation) --------
+
+
+def tenant_of_filters(filters) -> tuple[str | None, str | None]:
+    """(ws, ns) from equality matchers on the shard-key tenant columns
+    (``_ws_``/``_ns_``); None components when the filters don't pin one."""
+    ws = ns = None
+    for f in filters or ():
+        if getattr(f, "op", None) != "=":
+            continue
+        if f.column == "_ws_":
+            ws = str(f.value)
+        elif f.column == "_ns_":
+            ns = str(f.value)
+    return ws, ns
+
+
+def tenant_of_plan(plan) -> tuple[str, str]:
+    """Resolve the query's tenant from its logical plan's raw-series leaves
+    (the ExecPlan boundary: every leaf carries the selector's filters).
+    Multi-tenant or tenant-less selections attribute to ``unknown`` — the
+    honest bucket; quotas act on pinned tenants."""
+    try:
+        from .query.logical import leaf_raw_series
+
+        leaves = leaf_raw_series(plan)
+    except Exception:  # noqa: BLE001 — metadata plans have no series leaves
+        leaves = []
+    ws = ns = None
+    for leaf in leaves:
+        lws, lns = tenant_of_filters(getattr(leaf, "filters", ()))
+        if lws is not None:
+            if ws is not None and ws != lws:
+                return "unknown", "unknown"  # cross-tenant query
+            ws = lws
+        if lns is not None:
+            if ns is not None and ns != lns:
+                return "unknown", "unknown"
+            ns = lns
+    return ws or "unknown", ns or "unknown"
+
+
+# tenant labels come from CLIENT-supplied query matchers: without a bound,
+# a scripted loop of made-up _ws_ values grows the registry (4 counter
+# series per pair) forever. Past the cap, new pairs pool into "overflow".
+MAX_TENANT_PAIRS = 256
+_tenant_pairs: set[tuple[str, str]] = set()
+_tenant_pairs_lock = threading.Lock()
+
+
+def record_tenant_query(ws: str, ns: str, query_seconds: float,
+                        kernel_seconds: float, bytes_staged: int) -> None:
+    """Accumulate one finished query into the per-tenant resource counters
+    — the accounting the ROADMAP's admission-control item builds quotas on:
+
+    - ``filodb_tenant_queries_total{ws,ns}``
+    - ``filodb_tenant_query_seconds_total{ws,ns}`` (wall clock)
+    - ``filodb_tenant_kernel_seconds_total{ws,ns}`` (device dispatch)
+    - ``filodb_tenant_bytes_staged_total{ws,ns}`` (HBM uploads)
+
+    Cardinality is bounded: at most :data:`MAX_TENANT_PAIRS` distinct
+    (ws, ns) label pairs; later pairs attribute to ``overflow``."""
+    with _tenant_pairs_lock:
+        if (ws, ns) not in _tenant_pairs:
+            if len(_tenant_pairs) >= MAX_TENANT_PAIRS:
+                ws = ns = "overflow"
+            _tenant_pairs.add((ws, ns))
+    REGISTRY.counter("filodb_tenant_queries", ws=ws, ns=ns).inc()
+    REGISTRY.counter("filodb_tenant_query_seconds", ws=ws, ns=ns).inc(
+        float(query_seconds)
+    )
+    REGISTRY.counter("filodb_tenant_kernel_seconds", ws=ws, ns=ns).inc(
+        float(kernel_seconds)
+    )
+    REGISTRY.counter("filodb_tenant_bytes_staged", ws=ws, ns=ns).inc(
+        int(bytes_staged)
+    )
+
+
+def tenant_query_snapshot() -> dict[str, dict]:
+    """Current per-tenant query-resource totals, keyed ``ws/ns`` (the
+    /debug/resources rendering)."""
+    names = {
+        "filodb_tenant_queries": "queries",
+        "filodb_tenant_query_seconds": "query_seconds",
+        "filodb_tenant_kernel_seconds": "kernel_seconds",
+        "filodb_tenant_bytes_staged": "bytes_staged",
+    }
+    out: dict[str, dict] = {}
+    with REGISTRY._lock:
+        items = [(k, m.value) for k, m in REGISTRY._metrics.items()
+                 if k[0] in names]
+    for (name, labels), value in items:
+        lbl = dict(labels)
+        key = f"{lbl.get('ws', '?')}/{lbl.get('ns', '?')}"
+        out.setdefault(key, {})[names[name]] = round(value, 6)
+    return out
 
 
 @dataclass

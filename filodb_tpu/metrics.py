@@ -63,20 +63,77 @@ class Histogram:
         self.counts = [0] * (len(self.BOUNDS) + 1)
         self.sum = 0.0
         self.total = 0
+        # last exemplar per bucket: (labels dict, value, unix_ts) — rendered
+        # on OpenMetrics bucket lines so a spiking latency bucket links
+        # straight to its trace (and through it the slow-query log)
+        self.exemplars: list = [None] * (len(self.BOUNDS) + 1)
         self._lock = threading.Lock()
 
-    def observe(self, v: float):
+    def observe(self, v: float, exemplar: dict | None = None):
         i = bisect.bisect_left(self.BOUNDS, v)
         with self._lock:
             self.counts[i] += 1
             self.sum += v
             self.total += 1
+            if exemplar:
+                self.exemplars[i] = (dict(exemplar), float(v), time.time())
 
 
 def escape_label_value(v) -> str:
     """Prometheus text-format label escaping: backslash, double-quote and
     newline must be escaped or the exposition line is unparseable."""
     return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def escape_help(v: str) -> str:
+    """# HELP line escaping (backslash and newline only, per the spec)."""
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+# help text per metric family (the *registered* name: counters WITHOUT the
+# _total suffix the exposition appends). tools/check_metrics.py lints that
+# every family emitted in code is documented in doc/observability.md —
+# this table feeds the # HELP lines of the same families.
+HELP_TEXTS: dict[str, str] = {
+    "filodb_queries": "Queries served, per dataset (coalesced followers included).",
+    "filodb_query_latency_seconds": "End-to-end query latency.",
+    "filodb_slow_queries": "Queries over the slow-query threshold (see /debug/slow_queries).",
+    "filodb_breaker_transitions": "Circuit-breaker state transitions per endpoint.",
+    "filodb_breaker_state": "Breaker state per endpoint: 0 closed, 0.5 half-open, 1 open.",
+    "filodb_remote_retries": "Remote-child dispatch retries per endpoint.",
+    "filodb_partial_results": "Queries answered with merged partials (children lost).",
+    "filodb_shard_reassignments": "Shard reassignment outcomes from ingestion errors.",
+    "filodb_fused_fallback": "Fused single-dispatch aggregates delegated to the reference tree, by reason.",
+    "filodb_stage_cache_insert_dropped": "Staged blocks not cached because ingest effects touched their range.",
+    "filodb_superblock_maintenance": "Version-stale superblock maintenance outcomes (revalidate|extend|extend_abort|restage).",
+    "filodb_downsample_claims": "Distributed-downsample claim lifecycle events.",
+    "filodb_kernel_dispatch_seconds": "ops/ kernel dispatch latency, per kernel.",
+    "filodb_jit_cache": "JIT compile-cache hits/misses per kernel.",
+    "filodb_shard_partitions": "Live partitions per shard.",
+    "filodb_shard_rows_ingested": "Rows ingested per shard.",
+    "filodb_shard_rows_skipped": "Rows skipped per shard.",
+    "filodb_shard_partitions_evicted": "Partitions evicted per shard.",
+    "filodb_shard_chunks_flushed": "Chunks flushed per shard.",
+    "filodb_tenant_ts_total": "Total series per tenant (ws/ns).",
+    "filodb_tenant_ts_active": "Actively ingesting series per tenant (ws/ns).",
+    "filodb_tenant_queries": "Queries attributed to the tenant resolved from query filters.",
+    "filodb_tenant_query_seconds": "Wall-clock query seconds per tenant.",
+    "filodb_tenant_kernel_seconds": "Device kernel-dispatch seconds per tenant.",
+    "filodb_tenant_bytes_staged": "Bytes staged to device per tenant.",
+    "filodb_device_bytes": "Live device bytes per ledger kind (staged_block|superblock|compile_cache).",
+    "filodb_device_alloc": "Ledger debits (entries pinned) per kind.",
+    "filodb_device_alloc_bytes": "Bytes debited to the device ledger per kind.",
+    "filodb_device_free": "Ledger credits per kind and reason (evict|invalidate|replace|drop).",
+    "filodb_device_free_bytes": "Bytes credited back to the device ledger per kind and reason.",
+    "filodb_device_leaked_bytes": "Bytes held by ledger accounts whose cache died without releasing.",
+    "filodb_self_scrapes": "Self-scrape cycles into the _system dataset.",
+    "filodb_self_scrape_samples": "Samples ingested into the _system dataset by the self-scraper.",
+    "filodb_tpu_probe_healthy": "Last tpu-watch probe outcome (1 healthy, 0 not).",
+    "filodb_tpu_probe_age_seconds": "Seconds since the last tpu-watch probe.",
+    "filodb_tpu_probes": "tpu-watch probes attempted (from the watch log).",
+    "filodb_tpu_probes_ok": "tpu-watch probes that found a healthy device.",
+    "filodb_tpu_bench_attested": "tpu-watch attested benchmark measurements.",
+}
 
 
 class Registry:
@@ -86,6 +143,7 @@ class Registry:
         # to refresh gauges that mirror live state (per-shard stats etc.) —
         # ONE exposition path instead of handlers hand-rolling text
         self._collectors: dict[str, object] = {}
+        self._help: dict[str, str] = {}
         self._lock = threading.Lock()
 
     def register_collector(self, key: str, fn) -> None:
@@ -116,8 +174,45 @@ class Registry:
     def histogram(self, name: str, **labels) -> Histogram:
         return self._get(Histogram, name, labels)
 
-    def expose(self) -> str:
-        """Prometheus text exposition of everything registered."""
+    def remove(self, name: str, **labels) -> bool:
+        """Drop one series (a vanished tenant's gauges must not be exposed
+        forever — TenantIngestionMetering ages them out on publish).
+        Returns True when the series existed."""
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            return self._metrics.pop(key, None) is not None
+
+    def remove_matching(self, name: str, predicate) -> int:
+        """Drop every series of ``name`` whose label dict satisfies
+        ``predicate``; returns the count removed."""
+        with self._lock:
+            gone = [
+                k for k in self._metrics
+                if k[0] == name and predicate(dict(k[1]))
+            ]
+            for k in gone:
+                del self._metrics[k]
+        return len(gone)
+
+    def describe(self, name: str, help_text: str) -> None:
+        """Register/override help text for a metric family (exposed as the
+        ``# HELP`` line; defaults come from :data:`HELP_TEXTS`)."""
+        with self._lock:
+            self._help[name] = str(help_text)
+
+    def _render_exemplar(self, ex) -> str:
+        labels, value, ts = ex
+        inner = ",".join(
+            f'{k}="{escape_label_value(v)}"' for k, v in labels.items()
+        )
+        return f" # {{{inner}}} {value:g} {ts:.3f}"
+
+    def expose(self, openmetrics: bool = False) -> str:
+        """Prometheus text exposition of everything registered, with
+        ``# HELP``/``# TYPE`` per family. ``openmetrics=True`` renders
+        OpenMetrics 1.0 instead: family names lose the ``_total`` suffix in
+        metadata lines, histogram bucket lines carry trace-id exemplars,
+        and the payload ends with ``# EOF``."""
         with self._lock:
             collectors = list(self._collectors.values())
         for fn in collectors:
@@ -128,26 +223,52 @@ class Registry:
         lines = []
         with self._lock:
             items = sorted(self._metrics.items(), key=lambda kv: kv[0][0])
+            help_map = dict(self._help)
+        seen_families: set[str] = set()
+
+        def header(name: str, mtype: str):
+            # text format 0.0.4 names counter families WITH the _total
+            # suffix samples carry; OpenMetrics strips it
+            family = (
+                name if (openmetrics or mtype != "counter") else f"{name}_total"
+            )
+            if family in seen_families:
+                return
+            seen_families.add(family)
+            help_text = help_map.get(name, HELP_TEXTS.get(name))
+            if help_text:
+                lines.append(f"# HELP {family} {escape_help(help_text)}")
+            lines.append(f"# TYPE {family} {mtype}")
+
         for (name, labels), m in items:
             lbl = (
                 "{" + ",".join(f'{k}="{escape_label_value(v)}"' for k, v in labels) + "}"
                 if labels else ""
             )
             if isinstance(m, Counter_):
+                header(name, "counter")
                 lines.append(f"{name}_total{lbl} {m.value:g}")
             elif isinstance(m, Gauge):
+                header(name, "gauge")
                 lines.append(f"{name}{lbl} {m.value:g}")
             elif isinstance(m, Histogram):
+                header(name, "histogram")
                 base = [f'{k}="{escape_label_value(v)}"' for k, v in labels]
                 cum = 0
-                for b, c in zip(m.BOUNDS, m.counts):
+                for i, (b, c) in enumerate(zip(m.BOUNDS, m.counts)):
                     cum += c
                     inner = ",".join(base + [f'le="{b:g}"'])
-                    lines.append(f"{name}_bucket{{{inner}}} {cum}")
+                    ex = m.exemplars[i] if openmetrics else None
+                    suffix = self._render_exemplar(ex) if ex else ""
+                    lines.append(f"{name}_bucket{{{inner}}} {cum}{suffix}")
                 inner = ",".join(base + ['le="+Inf"'])
-                lines.append(f"{name}_bucket{{{inner}}} {m.total}")
+                ex = m.exemplars[-1] if openmetrics else None
+                suffix = self._render_exemplar(ex) if ex else ""
+                lines.append(f"{name}_bucket{{{inner}}} {m.total}{suffix}")
                 lines.append(f"{name}_sum{lbl} {m.sum:g}")
                 lines.append(f"{name}_count{lbl} {m.total}")
+        if openmetrics:
+            lines.append("# EOF")
         return "\n".join(lines) + "\n"
 
 
@@ -450,13 +571,42 @@ def record_downsample_claim(event: str) -> None:
 
 # -- kernel dispatch instrumentation ----------------------------------------
 
+# the executing query's QueryStats, activated per thread by
+# ExecPlan.execute (and re-activated in pool workers through the same
+# path): kernel entry points attribute their dispatch seconds to the query
+# WITHOUT threading a context object through every ops/ signature
+_stats_local = threading.local()
+
+
+@contextlib.contextmanager
+def activate_stats(stats):
+    """Bind ``stats`` (a QueryStats) as this thread's attribution target for
+    record_kernel_dispatch. Nests/restores like ``activate``."""
+    prev = getattr(_stats_local, "stats", None)
+    _stats_local.stats = stats
+    try:
+        yield
+    finally:
+        _stats_local.stats = prev
+
+
+def current_stats():
+    return getattr(_stats_local, "stats", None)
+
 
 def record_kernel_dispatch(kernel: str, seconds: float,
                            compiled: bool | None = None) -> None:
     """Latency histogram around an ops/ kernel entry point, plus JIT
     compile-cache hit/miss accounting when the caller can observe its jit
-    cache (a grown cache across the call means this dispatch compiled)."""
+    cache (a grown cache across the call means this dispatch compiled).
+    Also attributes the dispatch seconds to the active query's QueryStats
+    (kernel_ns) — the per-query/per-tenant device accounting feed. Pure
+    host-side bookkeeping: no device sync is added around the (async)
+    dispatch."""
     REGISTRY.histogram("filodb_kernel_dispatch_seconds", kernel=kernel).observe(seconds)
+    st = current_stats()
+    if st is not None:
+        st.bump(kernel_ns=int(seconds * 1e9))
     if compiled is not None:
         REGISTRY.counter(
             "filodb_jit_cache", kernel=kernel,

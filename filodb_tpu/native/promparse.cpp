@@ -70,6 +70,35 @@ uint8_t type_code_of(std::string_view t) {
     return 0;
 }
 
+inline bool ends_with(std::string_view s, std::string_view suf) {
+    return s.size() >= suf.size()
+        && s.compare(s.size() - suf.size(), suf.size(), suf) == 0;
+}
+
+// Resolve a sample's type understanding family suffixes (mirror of the
+// Python parsers._series_type): a histogram/summary family's _bucket/
+// _count/_sum series are cumulative -> counter semantics, and OpenMetrics
+// counters declare the family WITHOUT the _total their samples carry.
+uint8_t series_type(std::string_view nm,
+                    const std::unordered_map<std::string_view, uint8_t>& types) {
+    auto it = types.find(nm);
+    if (it != types.end()) return it->second;
+    for (std::string_view suf : {std::string_view("_bucket"),
+                                 std::string_view("_count"),
+                                 std::string_view("_sum")}) {
+        if (ends_with(nm, suf)) {
+            auto fam = types.find(nm.substr(0, nm.size() - suf.size()));
+            if (fam != types.end() && (fam->second == 3 || fam->second == 4))
+                return 1;
+        }
+    }
+    if (ends_with(nm, std::string_view("_total"))) {
+        auto fam = types.find(nm.substr(0, nm.size() - 6));
+        if (fam != types.end() && fam->second == 1) return 1;
+    }
+    return 0;
+}
+
 }  // namespace
 
 extern "C" {
@@ -122,8 +151,7 @@ long fdb_parse_prom(const char* buf, long len, FdbPromRec* out, long max_out) {
         } else {
             while (p < e && name_char(buf[p])) p++;
             std::string_view nm(buf + b, (size_t)(p - b));
-            auto it = types.find(nm);
-            if (it != types.end()) tcode = it->second;
+            tcode = series_type(nm, types);
         }
         // exemplar suffix " # {" anywhere -> Python handles the whole line
         if (!defer) {

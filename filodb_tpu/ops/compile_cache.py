@@ -71,11 +71,63 @@ def enable_compile_cache(cache_dir: str | None) -> str | None:
             except (AttributeError, ValueError):  # knob renamed/absent
                 pass
         _enabled_dir = cache_dir
+        _register_ledger_account(cache_dir)
         log.info("persistent jax compile cache at %s", cache_dir)
     except Exception as e:  # noqa: BLE001 — cache is an optimization, never fatal
         log.warning("persistent compile cache unavailable: %s", e)
         return None
     return _enabled_dir
+
+
+class _CompileCacheProbe:
+    """Ledger-account owner for the persistent compile cache: jax writes the
+    entries, we only observe — the account is self-syncing from a disk walk
+    (and also refreshes the entry-count gauge at scrape time)."""
+
+    WALK_TTL_S = 15.0  # scrape-time collector: don't re-stat the dir per scrape
+
+    def __init__(self, cache_dir: str):
+        self.cache_dir = cache_dir
+        self._walked_at = 0.0
+        self._walked_bytes = 0
+
+    def walk_bytes(self) -> int:
+        import time
+
+        from ..metrics import REGISTRY
+
+        now = time.monotonic()
+        if now - self._walked_at < self.WALK_TTL_S:
+            return self._walked_bytes
+        total = entries = 0
+        for root, _dirs, files in os.walk(self.cache_dir):
+            for f in files:
+                try:
+                    total += os.path.getsize(os.path.join(root, f))
+                    entries += 1
+                except OSError:
+                    continue
+        REGISTRY.gauge("filodb_compile_cache_entries").set(float(entries))
+        self._walked_at = now
+        self._walked_bytes = total
+        return total
+
+
+_probe: _CompileCacheProbe | None = None
+
+
+def _register_ledger_account(cache_dir: str) -> None:
+    """One compile-cache account in the device ledger (kind
+    ``compile_cache``): re-registered (not stacked) when the dir changes."""
+    global _probe
+    from ..ledger import LEDGER
+
+    # dropping the old probe unregisters its account via the weakref
+    _probe = _CompileCacheProbe(cache_dir)
+    LEDGER.register(
+        _probe, "compile_cache", _CompileCacheProbe.walk_bytes,
+        name=cache_dir, synced=True,
+    )
 
 
 def enable_from_config(config: dict) -> str | None:

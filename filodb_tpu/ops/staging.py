@@ -27,6 +27,7 @@ Shape discipline (SURVEY.md §7 "ragged data vs static shapes" — the #1 risk):
 from __future__ import annotations
 
 import threading
+import time
 from collections import OrderedDict
 from dataclasses import dataclass
 
@@ -1110,6 +1111,19 @@ def concat_blocks(blocks, force_raw: bool = False) -> StagedBlock:
     return out
 
 
+def _superblock_cache_walker(cache) -> int:
+    """Cold recount of the superblock cache's true device footprint (drift
+    ground truth; must match the staged_nbytes accounting put() receives)."""
+    with cache._lock:
+        values = [v[1] for v in cache._d.values()]
+    total = 0
+    for v in values:
+        block = getattr(v, "block", None)
+        if block is not None:
+            total += staged_nbytes(block)
+    return total
+
+
 class SuperblockCache:
     """Shard-version-keyed cache of device-resident cross-shard superblocks
     (the staging layer of the single-dispatch fused aggregate).
@@ -1123,14 +1137,23 @@ class SuperblockCache:
     bounded by entry count and bytes."""
 
     def __init__(self, max_entries: int = 8, max_bytes: int = 8 << 30):
+        from ..ledger import LEDGER
         from ..singleflight import KeyedSingleFlight
 
         self.max_entries = max_entries
         self.max_bytes = max_bytes
         self._d: OrderedDict = OrderedDict()
+        # per-key introspection sidecar for /debug/superblocks: created
+        # time, hit count, last maintenance outcome (the PR-6 taxonomy)
+        self._meta: dict = {}
         self._lock = threading.Lock()
         self._flight = KeyedSingleFlight(
             max_keys=4 * max_entries, alive=lambda k: k in self._d
+        )
+        # device-ledger account (filodb_tpu/ledger.py): every put/evict/drop
+        # debits/credits; the walker recounts live entries for drift checks
+        self.ledger = LEDGER.register(
+            self, "superblock", _superblock_cache_walker, name="superblock-cache"
         )
 
     def build_lock(self, key) -> threading.Lock:
@@ -1155,6 +1178,9 @@ class SuperblockCache:
                 # replaces in place on rebuild.
                 return None
             self._d.move_to_end(key)
+            meta = self._meta.get(key)
+            if meta is not None:
+                meta["hits"] += 1
             return hit[1]
 
     def peek(self, key):
@@ -1183,20 +1209,74 @@ class SuperblockCache:
         are now ahead of the entry's device arrays, so it must never be
         served or extended again)."""
         with self._lock:
-            self._d.pop(key, None)
+            gone = self._d.pop(key, None)
+            self._meta.pop(key, None)
+            if gone is not None:
+                self.ledger.free(gone[2], reason="drop")
+
+    def note(self, key, outcome: str) -> None:
+        """Record the last maintenance outcome for an entry (the
+        ``filodb_superblock_maintenance_total`` taxonomy, surfaced per
+        entry at /debug/superblocks)."""
+        with self._lock:
+            meta = self._meta.get(key)
+            if meta is not None:
+                meta["last_outcome"] = outcome
 
     def put(self, key, versions: tuple, value, nbytes: int) -> None:
         if nbytes > self.max_bytes:
             return  # never pin more device memory than the whole budget
         with self._lock:
-            self._d.pop(key, None)
+            replaced = self._d.pop(key, None)
+            if replaced is not None:
+                self.ledger.free(replaced[2], reason="replace")
             used = sum(e[2] for e in self._d.values())
             while self._d and (
                 len(self._d) >= self.max_entries
                 or used + nbytes > self.max_bytes
             ):
-                used -= self._d.popitem(last=False)[1][2]
+                ek, ev = self._d.popitem(last=False)
+                self._meta.pop(ek, None)
+                used -= ev[2]
+                self.ledger.free(ev[2], reason="evict")
             self._d[key] = (versions, value, nbytes)
+            self.ledger.alloc(nbytes)
+            prev = self._meta.get(key)
+            self._meta[key] = {
+                "created": time.time(),
+                "hits": prev["hits"] if prev else 0,
+                "last_outcome": prev["last_outcome"] if prev else None,
+            }
+
+    def snapshot(self) -> list[dict]:
+        """Introspection view for /debug/superblocks: one dict per cached
+        entry (key rendered, true device bytes, age, hits, last maintenance
+        outcome, and the entry's scan accounting when it carries any)."""
+        now = time.time()
+        with self._lock:
+            items = [(k, v, dict(self._meta.get(k) or {}))
+                     for k, v in self._d.items()]
+        out = []
+        for key, (versions, value, nbytes), meta in items:
+            entry = {
+                "key": repr(key),
+                "bytes": int(nbytes),
+                "age_s": round(now - meta.get("created", now), 3),
+                "hits": int(meta.get("hits", 0)),
+                "last_outcome": meta.get("last_outcome"),
+                "versions": list(versions),
+            }
+            block = getattr(value, "block", None)
+            if block is not None:
+                entry["series"] = int(getattr(value, "series", 0)
+                                      or block.n_series)
+                # .shape is metadata on both jax and numpy arrays — never
+                # np.asarray here, that would pull the device block to host
+                entry["shape"] = list(block.vals.shape)
+                entry["is_hist"] = bool(getattr(value, "is_hist", False))
+                entry["stage_mode"] = getattr(value, "stage_mode", None)
+            out.append(entry)
+        return out
 
     def __len__(self) -> int:
         with self._lock:

@@ -81,7 +81,7 @@ class ExecPlan:
         self.transformers = []
 
     def execute(self, ctx: QueryContext) -> QueryResult:
-        from ...metrics import Span, current_span, span
+        from ...metrics import Span, activate_stats, current_span, span
 
         t0 = time.perf_counter_ns()
         ctx.check_deadline()
@@ -89,7 +89,11 @@ class ExecPlan:
         # worker re-activated via metrics.activate), else the query's root
         # span (the engine -> scheduler-pool hop)
         parent = current_span() or ctx.trace_root
-        with span(type(self).__name__, parent=parent) as s:
+        # bind the query's stats as this thread's kernel-attribution target:
+        # ops/ dispatch wrappers bump kernel_ns on it without any context
+        # threading (pool workers re-enter here per child, so they bind too)
+        with activate_stats(ctx.stats), \
+                span(type(self).__name__, parent=parent) as s:
             args = self.args_str()
             if args:
                 s.tags["plan"] = args
@@ -261,26 +265,44 @@ def staged_block_for(ctx: "QueryContext", shard, ids, cache_key, col_name: str,
                 dirty_lo=dirty_lo,
             )
         finally:
+            new_nbytes = (ST.staged_nbytes(repaired)
+                          if repaired is not None else 0)
             with shard._lock:
                 hit.repairing = False
                 if repaired is not None:
                     hit.block = repaired
+                    if new_nbytes != hit.nbytes:
+                        # the repaired block's device arrays may be wider:
+                        # keep entry bytes (and with them the ledger and
+                        # the eviction budget) true to what is pinned. Only
+                        # adjust the ledger while the entry is still CACHED
+                        # — a concurrent clear/eviction during the unlocked
+                        # repair already credited the old bytes, and this
+                        # block is then transient (never ledger-pinned)
+                        if shard.stage_cache.get(cache_key) is hit:
+                            shard.ledger.free(hit.nbytes, reason="replace")
+                            shard.ledger.alloc(new_nbytes)
+                        hit.nbytes = new_nbytes
                 elif shard.stage_cache.get(cache_key) is hit:
                     # failed (or raised): never leave a stale entry
                     del shard.stage_cache[cache_key]
+                    shard.ledger.free(hit.nbytes, reason="drop")
         if repaired is None:
             hit = None
+        else:
+            ctx.stats.bump(cache_extends=1)
     if hit is not None:
+        if not claimed:
+            ctx.stats.bump(cache_hits=1)
         return hit.block
     block = ST.stage_from_shard(
         shard, ids, col_name, start_ms, end_ms, mode=stage_mode,
     )
-    nbytes = int(
-        block.ts.nbytes
-        + np.asarray(block.vals).nbytes
-        + (np.asarray(block.raw).nbytes if block.raw is not None else 0)
-    )
-    ctx.stats.bump(bytes_staged=nbytes)
+    # true device footprint (ops/staging.staged_nbytes): the SAME number the
+    # cache entry, the byte-budget eviction, and the device ledger account
+    # — the drift check walks the cache with this exact function
+    nbytes = ST.staged_nbytes(block)
+    ctx.stats.bump(bytes_staged=nbytes, cache_misses=1)
     block.to_device(keep_host=True)  # mirrors enable append repair
     # byte-budgeted eviction, oldest entry first (the staging analog of
     # BlockManager reclaim under memory pressure). All cache mutations run
@@ -302,11 +324,20 @@ def staged_block_for(ctx: "QueryContext", shard, ids, cache_key, col_name: str,
             from ...memstore.shard import StageEntry
 
             budget = getattr(shard.config, "stage_cache_bytes", 2 << 30)
+            # a racing same-key stage (two queries sharing a leaf selector
+            # both missed) may have inserted already: credit its entry or
+            # the overwrite below would leak its ledger balance forever
+            raced = shard.stage_cache.pop(cache_key, None)
+            if raced is not None:
+                shard.ledger.free(raced.nbytes, reason="replace")
             used = sum(e.nbytes for e in shard.stage_cache.values())
             while shard.stage_cache and used + nbytes > budget:
                 oldest = next(iter(shard.stage_cache))
-                used -= shard.stage_cache.pop(oldest).nbytes
+                evicted = shard.stage_cache.pop(oldest)
+                used -= evicted.nbytes
+                shard.ledger.free(evicted.nbytes, reason="evict")
             shard.stage_cache[cache_key] = StageEntry(block, nbytes)
+            shard.ledger.alloc(nbytes)
     if drop_reason is not None:
         from ...metrics import record_stage_insert_drop
 
@@ -1289,6 +1320,7 @@ class FusedAggregateExec(ExecPlan):
         )
         hit = cache.get(sb_key, versions)
         if hit is not None:
+            ctx.stats.bump(cache_hits=1)
             return self._serve_hit(ctx, hit)
         # single-flight per key: N identical cold queries must not each
         # concatenate + upload the full superblock (the same duplicate-
@@ -1300,6 +1332,7 @@ class FusedAggregateExec(ExecPlan):
             )
             hit = cache.get(sb_key, versions)
             if hit is not None:
+                ctx.stats.bump(cache_hits=1)
                 return self._serve_hit(ctx, hit)
             refreshed = self._refresh_superblock(ctx, cache, sb_key, versions)
             if refreshed is not None:
@@ -1356,10 +1389,13 @@ class FusedAggregateExec(ExecPlan):
         if not overlap:
             if cache.revalidate(sb_key, old_versions, versions):
                 record_superblock_event("revalidate")
+                cache.note(sb_key, "revalidate")
+                ctx.stats.bump(cache_hits=1)
                 return self._serve_hit(ctx, entry)
             return None
         if not _SUPERBLOCK_EXTEND or entry.stage_mode is None:
             record_superblock_event("restage")
+            cache.note(sb_key, "restage")
             return None
         return self._extend_superblock(ctx, cache, sb_key, entry, versions)
 
@@ -1423,6 +1459,7 @@ class FusedAggregateExec(ExecPlan):
             return None
         if nb is None:
             record_superblock_event("restage")
+            cache.note(sb_key, "restage")
             return None
         versions_now = tuple(
             ctx.memstore.shard(ctx.dataset, s).version for s in self.shard_nums
@@ -1452,6 +1489,8 @@ class FusedAggregateExec(ExecPlan):
             if stale is not None and stale[1] is entry:
                 cache.revalidate(sb_key, stale[0], commit_versions)
             record_superblock_event("revalidate")
+            cache.note(sb_key, "revalidate")
+            ctx.stats.bump(cache_hits=1)
             return self._serve_hit(ctx, entry)
         samples = int(np.asarray(nb.h_lens).sum())
         new_entry = SuperblockEntry(
@@ -1462,6 +1501,8 @@ class FusedAggregateExec(ExecPlan):
         )
         cache.put(sb_key, commit_versions, new_entry, ST.staged_nbytes(nb))
         record_superblock_event("extend")
+        cache.note(sb_key, "extend")
+        ctx.stats.bump(cache_extends=1)
         return self._serve_hit(ctx, new_entry)
 
     def _build_superblock(self, ctx: QueryContext, stage_mode: str, cache,
@@ -1583,7 +1624,8 @@ class FusedAggregateExec(ExecPlan):
         samples = dropped_samples + int(
             sum(int(np.asarray(b.lens).sum()) for b in blocks)
         )
-        ctx.stats.bump(series_scanned=total, samples_scanned=samples)
+        ctx.stats.bump(series_scanned=total, samples_scanned=samples,
+                       cache_misses=1)
         if ctx.stats.samples_scanned > ctx.max_samples:
             raise QueryError(
                 f"query would scan {ctx.stats.samples_scanned} samples > "

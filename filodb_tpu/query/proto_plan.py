@@ -126,9 +126,12 @@ def plan_from_bytes(data: bytes):
 CHUNK_ROWS = 256
 
 
-def result_to_frames(res: QueryResult, chunk_rows: int = CHUNK_ROWS):
+def result_to_frames(res: QueryResult, chunk_rows: int = CHUNK_ROWS,
+                     stats_ext: bool = False):
     """Yield StreamFrames for a QueryResult (header/chunks per grid, then a
-    final stats frame)."""
+    final stats frame). ``stats_ext`` additionally emits the StatsExt frame
+    — origin-opt-in via call metadata, like TraceTree/PartialWarnings, so
+    an older origin that doesn't know the frame type never sees it."""
     for gi, g in enumerate(res.grids):
         vals = np.ascontiguousarray(g.values_np(), np.float32)
         hist = g.hist_np()
@@ -201,6 +204,18 @@ def result_to_frames(res: QueryResult, chunk_rows: int = CHUNK_ROWS):
         from ..metrics import trace_to_dict
 
         yield error_frame(TRACE_TREE, json.dumps(trace_to_dict(trace)))
+    # resource-attribution stats (kernel_ns, cache hit/miss/extend) ride an
+    # in-band non-fatal frame like PartialWarnings: the StatsFrame proto
+    # predates them and stays wire-stable for the 5 classic fields
+    if stats_ext:
+        ext = {
+            "kernel_ns": int(res.stats.kernel_ns),
+            "cache_hits": int(res.stats.cache_hits),
+            "cache_misses": int(res.stats.cache_misses),
+            "cache_extends": int(res.stats.cache_extends),
+        }
+        if any(ext.values()):
+            yield error_frame(STATS_EXT, json.dumps(ext))
     fin = pb.StreamFrame()
     st = fin.stats
     st.series_scanned = int(res.stats.series_scanned)
@@ -218,6 +233,10 @@ PARTIAL_WARNINGS = "PartialWarnings"
 # error_type of the NON-FATAL trace frame: the peer's span tree, rendered
 # (metrics.Span.to_dict), returned alongside results for cross-node stitching
 TRACE_TREE = "TraceTree"
+
+# error_type of the NON-FATAL extended-stats frame: QueryStats fields newer
+# than the StatsFrame proto (kernel_ns + cache event counts), JSON-encoded
+STATS_EXT = "StatsExt"
 
 
 def error_frame(error_type: str, message: str) -> "pb.StreamFrame":
@@ -272,6 +291,7 @@ def frames_to_result(frames) -> QueryResult:
     res = QueryResult()
     headers: dict[int, pb.GridHeader] = {}
     rows: dict[int, list] = {}
+    stats_ext: dict | None = None
     for fr in frames:
         which = fr.WhichOneof("frame")
         if which == "header":
@@ -307,8 +327,15 @@ def frames_to_result(frames) -> QueryResult:
                 res.partial = True
             elif fr.error.error_type == TRACE_TREE:
                 res.trace = json.loads(fr.error.message)
+            elif fr.error.error_type == STATS_EXT:
+                stats_ext = json.loads(fr.error.message)
             else:
                 _raise_remote_error(fr.error.error_type, fr.error.message)
+    if stats_ext:
+        # applied after the loop: the StatsFrame may arrive in either order
+        # and rebuilds res.stats from the 5 classic fields only
+        res.stats.bump(**{k: int(v) for k, v in stats_ext.items()
+                          if k in QueryStats._KEYS})
     for gi in sorted(headers):
         h = headers[gi]
         nb = int(h.hist_bins) or len(h.les)

@@ -94,14 +94,25 @@ class QueryStats:
     cpu_ns: int = 0
     device_ns: int = 0
     bytes_staged: int = 0
+    # resource attribution (doc/observability.md "Resource accounting"):
+    # kernel_ns sums the ops/ dispatch wall-times the query triggered
+    # (record_kernel_dispatch via the activated stats); cache_* count the
+    # staging/superblock cache events the query's staging path took —
+    # hits (served cached), misses (full stage/build), extends (in-place
+    # incremental repair/extension)
+    kernel_ns: int = 0
+    cache_hits: int = 0
+    cache_misses: int = 0
+    cache_extends: int = 0
+
+    _KEYS = ("series_scanned", "samples_scanned", "cpu_ns", "device_ns",
+             "bytes_staged", "kernel_ns", "cache_hits", "cache_misses",
+             "cache_extends")
 
     def merge(self, other: "QueryStats") -> None:
         with _STATS_LOCK:
-            self.series_scanned += other.series_scanned
-            self.samples_scanned += other.samples_scanned
-            self.cpu_ns += other.cpu_ns
-            self.device_ns += other.device_ns
-            self.bytes_staged += other.bytes_staged
+            for k in self._KEYS:
+                setattr(self, k, getattr(self, k) + getattr(other, k))
 
     def bump(self, **deltas: int) -> None:
         """Atomic increment of one or more counters (the '+=' replacement
@@ -111,29 +122,19 @@ class QueryStats:
                 setattr(self, k, getattr(self, k) + v)
 
     def is_empty(self) -> bool:
-        return not (self.series_scanned or self.samples_scanned or self.cpu_ns
-                    or self.device_ns or self.bytes_staged)
+        return not any(getattr(self, k) for k in self._KEYS)
 
     def as_dict(self) -> dict:
-        return {
-            "series_scanned": self.series_scanned,
-            "samples_scanned": self.samples_scanned,
-            "cpu_ns": self.cpu_ns,
-            "device_ns": self.device_ns,
-            "bytes_staged": self.bytes_staged,
-        }
+        return {k: getattr(self, k) for k in self._KEYS}
 
     def snapshot(self) -> tuple:
-        return (self.series_scanned, self.samples_scanned, self.cpu_ns,
-                self.device_ns, self.bytes_staged)
+        return tuple(getattr(self, k) for k in self._KEYS)
 
     def delta_since(self, snap: tuple) -> dict:
         """Per-plan-node stats attribution: what this node (and, inclusively,
         its subtree) added to the query-wide stats since ``snap``."""
         now = self.snapshot()
-        keys = ("series_scanned", "samples_scanned", "cpu_ns", "device_ns",
-                "bytes_staged")
-        return {k: now[i] - snap[i] for i, k in enumerate(keys) if now[i] != snap[i]}
+        return {k: now[i] - snap[i] for i, k in enumerate(self._KEYS) if now[i] != snap[i]}
 
 
 @dataclass

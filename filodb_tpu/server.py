@@ -217,6 +217,41 @@ class FiloServer:
             from .metrics import SamplingProfiler
 
             self.profiler = SamplingProfiler(cfg["profiler"]["interval_ms"] / 1000.0)
+        # self-telemetry (telemetry.py): config-gated REGISTRY -> _system
+        # dataset pipeline + an engine so the server's own metrics answer
+        # PromQL through the standard (fused) query path (?dataset=_system)
+        tcfg = cfg.get("telemetry") or {}
+        self.self_scraper = None
+        self.system_engine = None
+        scrape_interval = tcfg.get("self_scrape_interval_s")
+        if scrape_interval:
+            from .telemetry import SYSTEM_DATASET, SelfScraper
+
+            self.memstore.setup(Dataset(SYSTEM_DATASET), owned,
+                                total_shards=self.n_shards)
+            self.system_engine = QueryEngine(
+                self.memstore, SYSTEM_DATASET,
+                PlannerParams(**{**common, "scheduler": None}),
+            )
+            self.self_scraper = SelfScraper(
+                self.memstore, SYSTEM_DATASET,
+                interval_s=float(scrape_interval),
+                spread=int(tcfg.get("self_scrape_spread", 1)),
+            )
+        watch_log = tcfg.get("tpu_watch_log", "auto")
+        if watch_log:
+            import os as _os
+
+            if watch_log == "auto":
+                watch_log = _os.path.join(
+                    _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))),
+                    "TPU_WATCH_LOG.txt",
+                )
+                watch_log = watch_log if _os.path.exists(watch_log) else None
+            if watch_log:
+                from .telemetry import register_tpu_watch_collector
+
+                register_tpu_watch_collector(str(watch_log))
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._http = None
@@ -257,7 +292,13 @@ class FiloServer:
             auth_token=self.config.get("http_auth_token"),
             local_engine=self.local_engine,
             flush_hook=self.flush_now,
+            dataset_engines=(
+                {self.system_engine.dataset: self.system_engine}
+                if self.system_engine is not None else None
+            ),
         )
+        if self.self_scraper is not None:
+            self.self_scraper.start()
         if self.profiler is not None:
             # /debug/profile is config-gated: wired only when the profiler
             # block enables sampling
@@ -325,6 +366,8 @@ class FiloServer:
 
     def stop(self):
         self._stop.set()
+        if self.self_scraper is not None:
+            self.self_scraper.stop()
         if self.bootstrapper is not None:
             self.bootstrapper.stop()
         if self._http:

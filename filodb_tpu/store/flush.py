@@ -182,7 +182,7 @@ def recover_shard(memstore, store: ColumnStore, dataset: str, shard_num: int) ->
     with shard._lock:
         shard.version += 1
         shard._record_effect(0, 0, True)
-        shard.stage_cache.clear()
+        shard._clear_stage_cache()
     # 3. checkpoints -> replay offset (reference: replay from min(checkpoints))
     cps = store.read_checkpoints(dataset, shard_num)
     return min(cps.values()) if cps else -1

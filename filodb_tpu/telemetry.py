@@ -1,0 +1,163 @@
+"""Self-telemetry: the server scrapes ITSELF into a ``_system`` dataset.
+
+This is a Prometheus-compatible TSDB — its own metrics should be queryable
+through its own (fused) PromQL path, not only through an external
+Prometheus. The :class:`SelfScraper` samples the process ``REGISTRY`` every
+``telemetry.self_scrape_interval_s`` seconds, renders the standard text
+exposition, and feeds it through the PRODUCTION ingest parser
+(``gateway.parsers.prom_text_to_batches_and_exemplars`` — TYPE comments
+route counters and histogram families to the counter schema) into the
+memstore's ``_system`` dataset. ``rate(filodb_kernel_dispatch_seconds_count[5m])``
+and per-tenant byte dashboards then run through the standard query API
+(``?dataset=_system``) and the fused single-dispatch path like any other
+workload.
+
+Also here: the scrape-time collector that surfaces ``tools/tpu_watch.py``
+device-probe results as ``filodb_tpu_*`` gauges (the watchdog's log is the
+source of truth; parsing it at scrape time means the server needs no side
+channel to the watchdog process).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+import threading
+import time
+
+from .metrics import REGISTRY
+
+log = logging.getLogger("filodb_tpu.telemetry")
+
+SYSTEM_DATASET = "_system"
+
+
+class SelfScraper:
+    """Config-gated internal collector: REGISTRY -> text exposition ->
+    prom parser -> ``_system`` dataset, every ``interval_s`` seconds."""
+
+    def __init__(self, memstore, dataset: str = SYSTEM_DATASET,
+                 interval_s: float = 15.0, spread: int = 1,
+                 registry=REGISTRY, ws: str = "system", ns: str = "filodb"):
+        self.memstore = memstore
+        self.dataset = dataset
+        self.interval_s = float(interval_s)
+        self.spread = int(spread)
+        self.registry = registry
+        self.ws = ws
+        self.ns = ns
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def scrape_once(self, now_ms: int | None = None) -> int:
+        """One scrape cycle; returns samples ingested (synchronous — the
+        unit the tests drive directly)."""
+        from .gateway.parsers import prom_text_to_batches_and_exemplars
+
+        if now_ms is None:
+            now_ms = int(time.time() * 1000)
+        text = self.registry.expose()
+        batches, _exemplars = prom_text_to_batches_and_exemplars(
+            text, now_ms, ws=self.ws, ns=self.ns
+        )
+        n = 0
+        for batch in batches:
+            n += self.memstore.ingest_routed(self.dataset, batch, self.spread)
+        REGISTRY.counter("filodb_self_scrapes").inc()
+        REGISTRY.counter("filodb_self_scrape_samples").inc(n)
+        return n
+
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return  # idempotent, like SamplingProfiler.start
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="filodb-self-scrape"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=1)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.scrape_once()
+            except Exception:  # noqa: BLE001 — telemetry must never kill serving
+                log.exception("self-scrape failed")
+
+
+# -- tpu-watch probe gauges --------------------------------------------------
+
+_PROBE_RE = re.compile(
+    r"^(?P<ts>\S+) probe (?P<outcome>OK|FAIL|TIMEOUT)", re.M
+)
+_ATTEST_RE = re.compile(r"^\S+ ATTESTED ", re.M)
+_TS_FMT = "%Y-%m-%dT%H:%M:%S%z"
+
+
+def parse_tpu_watch_log(text: str) -> dict:
+    """Aggregate a TPU_WATCH_LOG.txt payload into probe stats: total/ok
+    counts, attested measurements, last outcome and its timestamp."""
+    probes = ok = 0
+    last_outcome = None
+    last_ts = None
+    for m in _PROBE_RE.finditer(text):
+        probes += 1
+        healthy = m.group("outcome") == "OK"
+        ok += healthy
+        last_outcome = healthy
+        try:
+            last_ts = time.mktime(
+                time.strptime(m.group("ts")[:19], "%Y-%m-%dT%H:%M:%S")
+            )
+        except ValueError:
+            last_ts = None
+    return {
+        "probes": probes,
+        "ok": ok,
+        "attested": len(_ATTEST_RE.findall(text)),
+        "last_healthy": last_outcome,
+        "last_ts": last_ts,
+    }
+
+
+def register_tpu_watch_collector(log_path: str,
+                                 registry=REGISTRY) -> None:
+    """Expose the tpu-watch watchdog's device-probe results as
+    ``filodb_tpu_*`` gauges, refreshed at scrape time from its log file
+    (keyed per path — re-registration replaces). Gauges:
+
+    - ``filodb_tpu_probe_healthy`` — last probe outcome (1/0; -1 = no
+      probes seen yet or log absent)
+    - ``filodb_tpu_probe_age_seconds`` — seconds since the last probe
+    - ``filodb_tpu_probes`` / ``filodb_tpu_probes_ok`` — cumulative counts
+      from the log
+    - ``filodb_tpu_bench_attested`` — attested benchmark measurements"""
+
+    def collect():
+        stats = None
+        try:
+            if os.path.exists(log_path):
+                with open(log_path) as f:
+                    stats = parse_tpu_watch_log(f.read())
+        except OSError:
+            stats = None
+        if not stats or not stats["probes"]:
+            registry.gauge("filodb_tpu_probe_healthy").set(-1.0)
+            return
+        registry.gauge("filodb_tpu_probe_healthy").set(
+            1.0 if stats["last_healthy"] else 0.0
+        )
+        if stats["last_ts"] is not None:
+            registry.gauge("filodb_tpu_probe_age_seconds").set(
+                max(0.0, time.time() - stats["last_ts"])
+            )
+        registry.gauge("filodb_tpu_probes").set(float(stats["probes"]))
+        registry.gauge("filodb_tpu_probes_ok").set(float(stats["ok"]))
+        registry.gauge("filodb_tpu_bench_attested").set(float(stats["attested"]))
+
+    registry.register_collector(f"tpu_watch:{log_path}", collect)

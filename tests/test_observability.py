@@ -185,8 +185,11 @@ class TestRegistryEscaping:
         assert 'reqs_total{path="say \\"hi\\"\\\\there\\nnow"} 1' in text
         assert 'g{v="a\\\\b"} 2' in text
         # no raw (unescaped) newline may survive inside a label value:
-        # every exposition line must end in a numeric sample value
+        # every exposition SAMPLE line must end in a numeric sample value
+        # (# HELP / # TYPE metadata lines are exempt)
         for line in text.strip().splitlines():
+            if line.startswith("#"):
+                continue
             float(line.rsplit(" ", 1)[1])
 
     def test_collectors_refresh_at_scrape_time(self):

@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""Lint: every ``filodb_*`` metric family emitted in code is documented in
+doc/observability.md, and every family the doc names exists in code.
+
+Companion to tools/check_spans.py (make test-observability): the doc's
+metrics reference is the operator contract — an undocumented metric is
+invisible to dashboards and runbooks, and a documented-but-deleted one is a
+broken alert waiting to fire never.
+
+Method: walk the package AST (no imports — runs without jax) collecting
+every string constant matching ``filodb_[a-z0-9_]+`` (registration calls,
+collector tuples, docstring references — all legitimate family mentions),
+then compare against the same regex over doc/observability.md. Both sides
+normalize to the family STEM — trailing ``_total``/``_bucket``/``_sum``/
+``_count`` exposition suffixes stripped — so counters registered as
+``filodb_queries`` match the documented ``filodb_queries_total`` and
+histogram families match any of their derived series names.
+
+Exit code 0 = clean, 1 = violations (printed one per line).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+PKG = ROOT / "filodb_tpu"
+DOC = ROOT / "doc" / "observability.md"
+
+# a family mention must not be preceded by a name character (excludes the
+# `_filodb_chunkmeta_all` magic selector) nor followed by `*` (glob-style
+# prose references like "filodb_tpu_*" aren't family names)
+NAME_RE = re.compile(r"(?<![A-Za-z0-9_])filodb_[a-z0-9_]+")
+FULL_RE = re.compile(r"^filodb_[a-z0-9]+(_[a-z0-9]+)*$")
+
+
+def find_names(text: str):
+    for m in NAME_RE.finditer(text):
+        end = m.end()
+        if end < len(text) and text[end] == "*":
+            continue  # glob-style prose reference, not a family name
+        yield m.group(0)
+SUFFIXES = ("_total", "_bucket", "_sum", "_count")
+
+# strings that match the metric-name shape but aren't metric families
+ALLOW = {
+    "filodb_tpu",  # the package itself (and the filodb_tpu_* glob's stem)
+}
+
+
+def stem(name: str) -> str:
+    for suf in SUFFIXES:
+        if name.endswith(suf) and len(name) > len(suf) + len("filodb_"):
+            return name[: -len(suf)]
+    return name
+
+
+def code_stems() -> tuple[set[str], dict[str, list[str]]]:
+    stems: set[str] = set()
+    where: dict[str, list[str]] = {}
+    for path in sorted(PKG.rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        try:
+            tree = ast.parse(path.read_text(), filename=str(path))
+        except SyntaxError as e:
+            print(f"SYNTAX ERROR {path}: {e}")
+            sys.exit(1)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                for m in find_names(node.value):
+                    m = m.rstrip("_")
+                    if not FULL_RE.match(m) or m in ALLOW:
+                        continue
+                    s = stem(m)
+                    stems.add(s)
+                    where.setdefault(s, []).append(
+                        f"{path.relative_to(ROOT)}:{node.lineno}"
+                    )
+    return stems, where
+
+
+def doc_stems() -> set[str]:
+    text = DOC.read_text()
+    out = set()
+    for m in find_names(text):
+        m = m.rstrip("_")
+        if FULL_RE.match(m) and m not in ALLOW:
+            out.add(stem(m))
+    return out
+
+
+def main() -> int:
+    code, where = code_stems()
+    doc = doc_stems()
+    violations: list[str] = []
+    for s in sorted(code - doc):
+        locs = ", ".join(where.get(s, [])[:2])
+        violations.append(
+            f"emitted but undocumented: {s}* ({locs}) — add it to "
+            f"doc/observability.md's metrics reference"
+        )
+    for s in sorted(doc - code):
+        violations.append(
+            f"documented but not emitted: {s}* — doc/observability.md names "
+            f"a family no code registers"
+        )
+    if violations:
+        print(f"metrics-doc lint: {len(violations)} violation(s)")
+        for v in violations:
+            print(f"  {v}")
+        return 1
+    print(f"metrics-doc lint: OK — {len(code)} metric families, code and "
+          f"doc agree")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -87,6 +87,24 @@ def log(msg: str) -> None:
         f.write(line + "\n")
 
 
+def register_probe_gauges() -> bool:
+    """Publish probe results as ``filodb_tpu_*`` gauges in the shared
+    Registry (filodb_tpu.telemetry parses the watch log at scrape time —
+    the same collector a FiloServer wires via config telemetry.tpu_watch_log,
+    so probe health rides /metrics and the _system self-scrape instead of
+    living only in TPU_WATCH_LOG.txt). Best-effort: the watchdog must keep
+    probing even when the package can't import (e.g. torn venv)."""
+    try:
+        sys.path.insert(0, REPO)
+        from filodb_tpu.telemetry import register_tpu_watch_collector
+
+        register_tpu_watch_collector(LOG)
+        return True
+    except Exception as e:  # noqa: BLE001 — observability must not stop probing
+        print(f"tpu-watch: probe gauges unavailable: {e}", flush=True)
+        return False
+
+
 def probe() -> bool:
     try:
         proc = subprocess.run(
@@ -180,6 +198,7 @@ def attest(parsed: dict, kind: str) -> None:
 def main() -> None:
     if not acquire_singleton_lock():
         sys.exit(1)
+    register_probe_gauges()
     deadline = time.time() + DEADLINE_S
     log(f"watchdog start: probe every {PROBE_EVERY_S}s, timeout {PROBE_TIMEOUT_S}s, "
         f"deadline in {DEADLINE_S/3600:.1f}h")
