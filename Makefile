@@ -2,7 +2,7 @@
 
 NATIVE_DIR := filodb_tpu/native
 
-.PHONY: all native test test-chaos test-ingest-chaos test-observability bench bench-smoke microbench serve clean tpu-watch tpu-watch-bg
+.PHONY: all native test test-chaos test-ingest-chaos test-multichip test-observability bench bench-smoke microbench serve clean tpu-watch tpu-watch-bg
 
 all: native
 
@@ -39,6 +39,16 @@ test-chaos: native
 # races and crash-mid-commit redo
 test-ingest-chaos: native
 	python -m pytest tests/ -q -m ingest_chaos
+
+# mesh-sharded fused suite (doc/perf.md "Mesh-sharded fused path"): sharded
+# vs single-device vs reference parity over the full operator set, the
+# warm-query-is-ONE-dispatch assertion on the forced 8-device CPU mesh, and
+# the sharded canonical query + histogram_quantile end-to-end through the
+# MULTICHIP dryrun entry
+test-multichip: native
+	env JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		python -m pytest tests/test_fused_mesh.py -q -m fused_mesh
+	env JAX_PLATFORMS=cpu python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
 # observability suite (doc/observability.md): trace propagation + stitching,
 # slow-query log, resource ledger + self-scrape, metrics exposition — plus

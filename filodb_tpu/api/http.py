@@ -517,13 +517,22 @@ class PromApiHandler(BaseHTTPRequestHandler):
     def _superblocks(self):
         """Superblock-cache introspection: one entry per cached superblock
         (key, true device bytes, age, hits, last maintenance outcome from
-        the filodb_superblock_maintenance_total taxonomy)."""
+        the filodb_superblock_maintenance_total taxonomy; mesh-sharded
+        entries additionally carry their sharding spec + per-device byte
+        split, rolled up in device_bytes)."""
         cache = getattr(self.engine.memstore, "_superblock_cache", None)
         entries = cache.snapshot() if cache is not None else []
+        device_bytes: dict = {}
+        for e in entries:
+            for dev, b in (e.get("device_bytes") or {}).items():
+                device_bytes[dev] = device_bytes.get(dev, 0) + int(b)
         return self._send(200, J.success({
             "entries": entries,
             "count": len(entries),
             "bytes": sum(e["bytes"] for e in entries),
+            # per-device roll-up over SHARDED entries (mesh path); also
+            # published as filodb_device_bytes{kind="superblock",device}
+            "device_bytes": device_bytes,
             # THIS cache's ledger balance (the kind-wide filodb_device_bytes
             # gauge sums every live cache in the process)
             "ledger_bytes": cache.ledger.bytes if cache is not None else 0,

@@ -118,6 +118,27 @@ DEFAULTS: dict = {
 }
 
 
+def force_virtual_devices(n: int) -> None:
+    """Ensure ``XLA_FLAGS`` requests at least ``n`` virtual host-platform
+    devices — must run BEFORE the first jax backend init. A smaller
+    pre-existing count (e.g. inherited from a test harness) is replaced; a
+    larger one is kept. The ONE definition of the flag-forcing defense
+    shared by the MULTICHIP dryrun (__graft_entry__) and bench.py's
+    fused_mesh workload."""
+    import re
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+    if m and int(m.group(1)) < n:
+        os.environ["XLA_FLAGS"] = flags.replace(
+            m.group(0), f"--xla_force_host_platform_device_count={n}"
+        )
+    elif not m:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+
+
 def apply_platform_env() -> None:
     """Honor ``FILODB_PLATFORM`` (e.g. "cpu", "tpu"): force the JAX platform
     BEFORE first backend init. Deployment images may preload an accelerator

@@ -648,11 +648,7 @@ class SingleClusterPlanner:
         )
 
         params = self.params
-        if (
-            not params.fused_aggregate
-            or params.mesh is not None
-            or params.peer_endpoints
-        ):
+        if not params.fused_aggregate or params.peer_endpoints:
             return None
         if p.op in FUSED_AGG_OPS:
             if p.params:
@@ -684,6 +680,21 @@ class SingleClusterPlanner:
         shards = self.shards_for(inner.raw.filters)
         if not shards:
             return None
+        mesh = None
+        if params.mesh is not None:
+            # a configured device mesh rides the SAME fused path: the
+            # superblock series axis partitions across it and the program
+            # runs under shard_map (ONE multi-chip dispatch). Simple
+            # aggregates reach here via the mesh engines' delegation
+            # (_try_mesh_aggregate wins for them); this branch covers the
+            # epilogue ops and fused histogram_quantile, which the legacy
+            # mesh kernels never modeled.
+            from ..parallel.mesh import series_mesh
+            from ..query.exec.plans import fused_mesh_supported
+
+            mesh = series_mesh(params.mesh)
+            if not fused_mesh_supported(mesh, p.op, func):
+                return None
         if hist_quantile is not None:
             # the fallback must reproduce the WHOLE fused subtree — the
             # aggregate tree plus the histogram_quantile mapper on top
@@ -708,6 +719,7 @@ class SingleClusterPlanner:
             fallback=fallback,
             params=p.params,
             hist_quantile=hist_quantile,
+            mesh=mesh,
         )
 
     def _materialize_aggregate_tree(self, p: L.Aggregate) -> ExecPlan:
@@ -964,6 +976,13 @@ class SingleClusterPlanner:
             start_ms=inner.start_ms, end_ms=inner.end_ms,
             step_ms=inner.step_ms, window_ms=inner.window_ms,
             is_counter=is_counter,
+            # sharded-fused delegation (parallel/exec.py): the mesh engines
+            # run the fused superblock kernels under shard_map when the
+            # op/function allows, falling back to their legacy per-shard
+            # stack (reason mesh_unsupported) otherwise. The delegate's own
+            # runtime fallback is the reference tree.
+            fused=self.params.fused_aggregate,
+            fused_fallback=lambda: self._materialize_aggregate_tree(p),
         )
         axes = set(getattr(mesh, "axis_names", ()))
         if axes == {"shard", "time"}:

@@ -38,15 +38,18 @@ class LedgerAccount:
     debit/credit pair; ``sync()`` (self-syncing accounts only, e.g. the XLA
     compile cache whose writes we don't control) re-reads the walker."""
 
-    __slots__ = ("kind", "name", "synced", "_owner_ref", "_walker", "_lock",
+    __slots__ = ("kind", "name", "synced", "_owner_ref", "_walker",
+                 "_device_walker", "_lock",
                  "bytes", "allocs", "frees", "created")
 
-    def __init__(self, kind: str, name: str, owner_ref, walker, synced: bool):
+    def __init__(self, kind: str, name: str, owner_ref, walker, synced: bool,
+                 device_walker=None):
         self.kind = kind
         self.name = name
         self.synced = synced
         self._owner_ref = owner_ref
         self._walker = walker
+        self._device_walker = device_walker
         self._lock = threading.Lock()
         self.bytes = 0
         self.allocs = 0
@@ -99,6 +102,20 @@ class LedgerAccount:
             with self._lock:
                 self.bytes = got
 
+    def walk_devices(self) -> dict | None:
+        """Per-device byte split of this account's balance (sharded caches
+        only; None when the owner is gone or the account has no device
+        walker). Metadata-only, like walk()."""
+        if self._device_walker is None:
+            return None
+        owner = self._owner_ref() if self._owner_ref is not None else None
+        if self._owner_ref is not None and owner is None:
+            return None
+        try:
+            return self._device_walker(owner)
+        except Exception:  # noqa: BLE001 — a sick walker must not kill /metrics
+            return None
+
     def alive(self) -> bool:
         return self._owner_ref is None or self._owner_ref() is not None
 
@@ -115,17 +132,20 @@ class DeviceLedger:
         self._accounts: dict[int, LedgerAccount] = {}
         self._next_id = 0
         self._seen_kinds: set[str] = set(self.KINDS)
+        self._seen_devices: set[tuple[str, str]] = set()
         # dead-owner notices: weakref callbacks run mid-GC (possibly inside
         # OTHER locks), so they only append to this list — list.append is
         # atomic under the GIL — and real cleanup happens lazily in _reap()
         self._dead: list[tuple[int, str, int]] = []
 
     def register(self, owner, kind: str, walker=None, name: str = "",
-                 synced: bool = False) -> LedgerAccount:
+                 synced: bool = False, device_walker=None) -> LedgerAccount:
         """Create an account for ``owner`` (held weakly). ``walker(owner)``
         recomputes the true byte footprint for the drift check. ``owner``
         may be None for keyed module-level accounts (pass ``synced=True``
-        and a zero-arg walker)."""
+        and a zero-arg walker). ``device_walker(owner)`` optionally returns
+        a per-device byte split (mesh-sharded caches) published as
+        ``filodb_device_bytes{kind,device}``."""
         with self._lock:
             aid = self._next_id
             self._next_id += 1
@@ -140,7 +160,8 @@ class DeviceLedger:
             self._dead.append((_aid, kind, leaked))
 
         ref = weakref.ref(owner, on_dead) if owner is not None else None
-        acct = LedgerAccount(kind, name, ref, walker, synced)
+        acct = LedgerAccount(kind, name, ref, walker, synced,
+                             device_walker=device_walker)
         acct_holder.append(acct)
         with self._lock:
             self._accounts[aid] = acct
@@ -201,15 +222,35 @@ class DeviceLedger:
             })
         return {"kinds": kinds, "accounts": accounts}
 
+    def device_balances(self) -> dict[tuple[str, str], int]:
+        """Per-(kind, device) byte balances over live accounts that expose a
+        device split (mesh-sharded caches)."""
+        out: dict[tuple[str, str], int] = {}
+        for a in self._live_accounts():
+            split = a.walk_devices()
+            if not split:
+                continue
+            for dev, b in split.items():
+                key = (a.kind, str(dev))
+                out[key] = out.get(key, 0) + int(b)
+        return out
+
     def publish(self) -> None:
-        """Scrape-time collector: refresh the per-kind gauges. Kinds seen
-        once keep publishing (possibly 0) so dashboards don't see series
-        vanish when a cache empties."""
+        """Scrape-time collector: refresh the per-kind gauges — plus the
+        per-device breakdown for kinds whose caches hold mesh-sharded
+        entries. Kinds/devices seen once keep publishing (possibly 0) so
+        dashboards don't see series vanish when a cache empties."""
         balances = self.balances()
         self._seen_kinds |= set(balances)
         for kind in self._seen_kinds:
             REGISTRY.gauge("filodb_device_bytes", kind=kind).set(
                 float(balances.get(kind, 0))
+            )
+        dev_balances = self.device_balances()
+        self._seen_devices |= set(dev_balances)
+        for kind, dev in self._seen_devices:
+            REGISTRY.gauge("filodb_device_bytes", kind=kind, device=dev).set(
+                float(dev_balances.get((kind, dev), 0))
             )
 
 

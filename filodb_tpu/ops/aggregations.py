@@ -73,6 +73,38 @@ def _segment_aggregate_jit(op: str, values, group_ids, num_groups: int):
     raise ValueError(f"unknown aggregation {op}")
 
 
+def _segment_psum_axis(op: str, grid, gids, num_groups: int, axis: str):
+    """Local segment-reduce + collective combine over a mesh axis: the
+    device-local half of ``segment_aggregate`` followed by psum/pmin/pmax,
+    so a series-sharded [S_local, J] grid reduces to the REPLICATED [G, J]
+    partials inside one program. Semantics mirror _segment_aggregate_jit
+    exactly (NaN = absence; a group with no members anywhere yields NaN).
+    The ONE definition shared by the sharded fused path and the parallel/
+    mesh engines (parallel.mesh._segment_psum delegates here)."""
+    valid = ~jnp.isnan(grid)
+    v0 = jnp.where(valid, grid, 0.0)
+    psum = jax.lax.psum
+    c = psum(
+        jax.ops.segment_sum(valid.astype(jnp.float32), gids, num_groups), axis
+    )
+    if op in ("sum", "avg", "count"):
+        s = psum(jax.ops.segment_sum(v0, gids, num_groups), axis)
+        if op == "sum":
+            return jnp.where(c > 0, s, jnp.nan)
+        if op == "count":
+            return jnp.where(c > 0, c, jnp.nan)
+        return jnp.where(c > 0, s / jnp.maximum(c, 1.0), jnp.nan)
+    if op in ("min", "max"):
+        big = jnp.inf if op == "min" else -jnp.inf
+        vm = jnp.where(valid, grid, big)
+        if op == "min":
+            r = jax.lax.pmin(jax.ops.segment_min(vm, gids, num_groups), axis)
+        else:
+            r = jax.lax.pmax(jax.ops.segment_max(vm, gids, num_groups), axis)
+        return jnp.where(c > 0, r, jnp.nan)
+    raise ValueError(f"unsupported sharded aggregation {op}")
+
+
 # ---------------------------------------------------------------------------
 # fused range-function -> segment-aggregate (single-dispatch cross-shard path)
 # ---------------------------------------------------------------------------
@@ -166,12 +198,149 @@ def _fused_mxu_jit(func, epilogue, vals, raw, baseline, W, F, L, L2, count,
     return _apply_epilogue(sj, epilogue, gids, n_real, qv, num_groups)
 
 
+def _sharded_epilogue(sj, epilogue: tuple, gids_l, n_real, qv,
+                      num_groups: int, axis: str):
+    """Device-local half of _apply_epilogue inside a shard_map body, with
+    the cross-device combine fused into the SAME program:
+
+      ("agg", op)          -> local segment reduce + psum/pmin/pmax -> [G, J]
+      ("topk", k, bottom)  -> local top-k winners (values + GLOBAL series
+                              indices), all_gather'd and re-reduced to the
+                              global [k, J] winner set — O(D*k*J) on the
+                              interconnect, never the [ΣS, J] grid
+      ("quantile",)        -> exact quantile needs the full value multiset
+                              per group: all_gather the [S_l, J] rows (the
+                              one epilogue that moves O(ΣS*J) over ICI,
+                              still inside the single program) and sort
+
+    Padded-row handling matches the single-device contract: trash-group
+    gids for segment reduces; GLOBAL row index vs ``n_real`` for the
+    non-segmented epilogues (a device's local rows map to global rows
+    ``axis_index * S_local + i``)."""
+    kind = epilogue[0]
+    if kind == "agg":
+        return _segment_psum_axis(
+            epilogue[1], sj, gids_l, num_groups + 1, axis
+        )[:num_groups]
+    S_l, J = sj.shape
+    d = jax.lax.axis_index(axis)
+    rows = jax.lax.broadcasted_iota(jnp.int32, (S_l, J), 0) + d * S_l
+    sj = jnp.where(rows < n_real, sj, jnp.nan)
+    if kind == "topk":
+        _, k, bottom = epilogue
+        v = jnp.where(jnp.isnan(sj), jnp.inf if bottom else -jnp.inf, sj)
+        vt = v.T if not bottom else -v.T  # [J, S_l], larger = better
+        kk = min(k, S_l)
+        lv, li = jax.lax.top_k(vt, kk)  # [J, kk] local winners
+        gi = li.astype(jnp.int32) + d * S_l  # global series indices
+        av = jax.lax.all_gather(lv, axis)  # [D, J, kk]
+        ai = jax.lax.all_gather(gi, axis)
+        D = av.shape[0]
+        av = jnp.transpose(av, (1, 0, 2)).reshape(J, D * kk)
+        ai = jnp.transpose(ai, (1, 0, 2)).reshape(J, D * kk)
+        k2 = min(k, D * kk)  # == single-device min(k, S_pad)
+        fv, fi = jax.lax.top_k(av, k2)  # [J, k2] global winners
+        gidx = jnp.take_along_axis(ai, fi, axis=1)
+        vals = jnp.where(
+            jnp.isfinite(fv), fv if not bottom else -fv, jnp.nan
+        )
+        return vals.T, gidx.T.astype(jnp.int32)  # [k2, J] each
+    if kind == "quantile":
+        full = jax.lax.all_gather(sj, axis).reshape(-1, J)  # [ΣS, J]
+        full_g = jax.lax.all_gather(gids_l, axis).reshape(-1)
+        return segment_quantile(full, full_g, num_groups + 1, qv)[:num_groups]
+    raise ValueError(f"unknown fused epilogue {epilogue}")
+
+
+def _sharded_out_specs(epilogue: tuple):
+    from jax.sharding import PartitionSpec as P
+
+    return (P(), P()) if epilogue[0] == "topk" else P()
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "mesh", "func", "epilogue", "num_steps", "num_groups", "is_counter",
+    "is_delta"
+))
+def _fused_sharded_general_jit(mesh, func, epilogue, ts, vals, lens, baseline,
+                               raw, gids, n_real, qv, start_off, step_ms,
+                               window, num_steps: int, num_groups: int,
+                               is_counter: bool, is_delta: bool):
+    """Series-sharded twin of _fused_general_jit: the row-wise range kernel
+    runs on each device's row band and the epilogue combines across the
+    mesh (psum / gathered winner state) INSIDE the same compiled program —
+    one dispatch spans every device, and only replicated [G, J] / [k, J]
+    outputs exist."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..jax_compat import shard_map
+    from .kernels import range_kernel
+
+    axis = mesh.axis_names[0]
+
+    def local(ts_l, vals_l, lens_l, base_l, raw_l, gids_l):
+        sj = range_kernel(
+            func, ts_l, vals_l, lens_l, base_l, raw_l, start_off, step_ms,
+            window, num_steps, is_counter=is_counter, is_delta=is_delta,
+        )
+        return _sharded_epilogue(sj, epilogue, gids_l, n_real, qv,
+                                 num_groups, axis)
+
+    row, vec = P(axis, None), P(axis)
+    return shard_map(
+        local, mesh=mesh,
+        in_specs=(row, row, vec, vec, row, vec),
+        out_specs=_sharded_out_specs(epilogue),
+        check=False,
+    )(ts, vals, lens, baseline, raw, gids)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "mesh", "func", "epilogue", "num_groups", "is_counter", "is_delta",
+    "fetch"
+))
+def _fused_sharded_mxu_jit(mesh, func, epilogue, vals, raw, baseline, W, F, L,
+                           L2, count, t_first, t_last, t_last2, out_t,
+                           window_ms, idx, gids, n_real, qv,
+                           num_groups: int, is_counter: bool, is_delta: bool,
+                           fetch: str):
+    """Series-sharded twin of _fused_mxu_jit: replicated [T, J] window
+    matrices ride the closure (committed replicated at build), the matmul
+    kernel runs per row band, and the epilogue combines over the mesh in
+    the same program."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..jax_compat import shard_map
+    from .mxu_kernels import mxu_range_kernel
+
+    axis = mesh.axis_names[0]
+
+    def local(vals_l, raw_l, base_l, gids_l):
+        sj = mxu_range_kernel(
+            func, vals_l, raw_l, base_l, W, F, L, L2, count, t_first, t_last,
+            t_last2, out_t, window_ms, idx=idx, is_counter=is_counter,
+            is_delta=is_delta, fetch=fetch,
+        )
+        return _sharded_epilogue(sj, epilogue, gids_l, n_real, qv,
+                                 num_groups, axis)
+
+    row, vec = P(axis, None), P(axis)
+    return shard_map(
+        local, mesh=mesh,
+        in_specs=(row, row, vec, vec),
+        out_specs=_sharded_out_specs(epilogue),
+        check=False,
+    )(vals, raw, baseline, gids)
+
+
 def _fused_dispatch(func: str, epilogue: tuple, block, gids_padded,
                     num_groups: int, params, qv, is_counter: bool,
-                    is_delta: bool, name: str):
+                    is_delta: bool, name: str, mesh=None):
     """Shared MXU-vs-general selection + instrumentation for every fused
     scalar entry point (one dispatch, one latency observation, one JIT
-    hit/miss account)."""
+    hit/miss account). With ``mesh`` (a 1-D device mesh matching the
+    block's series-sharded placement) the same program shape dispatches
+    ONCE across every device via shard_map."""
     import time as _time
 
     from ..metrics import record_kernel_dispatch
@@ -186,22 +355,47 @@ def _fused_dispatch(func: str, epilogue: tuple, block, gids_padded,
         and func in FUSED_MXU_FUNCS
         and not (is_delta and func in ("irate", "idelta"))
     )
+    if mesh is not None:
+        name = "mesh_" + name
     if use_mxu:
         from .mxu_kernels import fetch_strategy, window_matrices
 
+        # window_matrices reads block.placement: a sharded block's set is
+        # committed mesh-replicated at build, so no per-dispatch broadcast
         wm = window_matrices(
             block, int(params.start_ms - block.base_ms), params.step_ms,
             j_pad, params.window_ms,
         )
-        before = _fused_mxu_jit._cache_size()
-        out = _fused_mxu_jit(
-            func, epilogue, block.vals, raw, block.baseline,
-            wm.dW, wm.dF, wm.dL, wm.dL2, wm.d_count, wm.d_tf, wm.d_tl,
-            wm.d_tl2, wm.d_out_t, np.float32(params.window_ms), wm.d_idx,
-            gids_padded, n_real, qv, num_groups, is_counter, is_delta,
-            fetch_strategy(),
+        if mesh is not None:
+            before = _fused_sharded_mxu_jit._cache_size()
+            out = _fused_sharded_mxu_jit(
+                mesh, func, epilogue, block.vals, raw, block.baseline,
+                wm.dW, wm.dF, wm.dL, wm.dL2, wm.d_count, wm.d_tf, wm.d_tl,
+                wm.d_tl2, wm.d_out_t, np.float32(params.window_ms), wm.d_idx,
+                gids_padded, n_real, qv, num_groups, is_counter, is_delta,
+                fetch_strategy(),
+            )
+            compiled = _fused_sharded_mxu_jit._cache_size() > before
+        else:
+            before = _fused_mxu_jit._cache_size()
+            out = _fused_mxu_jit(
+                func, epilogue, block.vals, raw, block.baseline,
+                wm.dW, wm.dF, wm.dL, wm.dL2, wm.d_count, wm.d_tf, wm.d_tl,
+                wm.d_tl2, wm.d_out_t, np.float32(params.window_ms), wm.d_idx,
+                gids_padded, n_real, qv, num_groups, is_counter, is_delta,
+                fetch_strategy(),
+            )
+            compiled = _fused_mxu_jit._cache_size() > before
+    elif mesh is not None:
+        before = _fused_sharded_general_jit._cache_size()
+        out = _fused_sharded_general_jit(
+            mesh, func, epilogue, block.ts, block.vals, block.lens,
+            block.baseline, raw, gids_padded, n_real, qv,
+            np.int32(params.start_ms - block.base_ms),
+            np.int32(params.step_ms), np.int32(params.window_ms), j_pad,
+            num_groups, is_counter, is_delta,
         )
-        compiled = _fused_mxu_jit._cache_size() > before
+        compiled = _fused_sharded_general_jit._cache_size() > before
     else:
         before = _fused_general_jit._cache_size()
         out = _fused_general_jit(
@@ -218,62 +412,73 @@ def _fused_dispatch(func: str, epilogue: tuple, block, gids_padded,
 
 def fused_range_aggregate(func: str, op: str, block, gids_padded,
                           num_groups: int, params, is_counter: bool = False,
-                          is_delta: bool = False):
+                          is_delta: bool = False, mesh=None):
     """One device dispatch for ``op by (...) (func(selector[w]))`` over a
     staged (super)block: returns the [G, J_pad] group partials on device.
 
     ``gids_padded`` is [S_padded] int32 with padded rows assigned the trash
     group ``num_groups``. Regular shared grids ride the MXU window-matrix
     kernel (matrices cached device-resident on the block); everything else
-    runs the general compare-and-reduce kernel. Instrumented like every
-    other kernel entry (per-dispatch latency + JIT hit/miss)."""
+    runs the general compare-and-reduce kernel. With ``mesh`` (the block's
+    series-sharded placement) the body runs under shard_map with a
+    psum-combined [G, J] — ONE dispatch across the whole mesh. Instrumented
+    like every other kernel entry (per-dispatch latency + JIT hit/miss)."""
     return _fused_dispatch(
         func, ("agg", op), block, gids_padded, num_groups, params,
         np.float32(0.0), is_counter, is_delta, name=f"fused_{op}_{func}",
+        mesh=mesh,
     )
 
 
 def fused_topk(func: str, block, k: int, bottom: bool, params,
-               is_counter: bool = False, is_delta: bool = False):
+               is_counter: bool = False, is_delta: bool = False, mesh=None):
     """One device dispatch for global ``topk(k, func(selector[w]))``:
     returns ([k, J_pad] values, [k, J_pad] i32 series indices) on device —
     the compact per-step winner set, O(k*J) on the wire instead of the
     [S, J] grid AggregatePresentExec gathers. Needs no label grouping at
-    all (global top-k), so the O(S) group pass is skipped too."""
-    import jax as _jax
-
+    all (global top-k), so the O(S) group pass is skipped too. With
+    ``mesh`` the per-device winner state combines across devices inside
+    the same program (all_gather of [k, J] candidates + re-reduce)."""
     from ..singleflight import memo_on
+    from .staging import series_put
 
     # trash-group vector unused by the topk epilogue but part of the shared
-    # jit signature; memoized device-resident zeros per block
+    # jit signature; memoized device-resident zeros per block (co-placed
+    # with a sharded block's series axis)
     s_pad = np.asarray(block.lens).shape[0]
     gids = memo_on(
         block, "_zero_gids", s_pad,
-        lambda: _jax.device_put(np.zeros(s_pad, dtype=np.int32)),
+        lambda: series_put(getattr(block, "placement", None))(
+            np.zeros(s_pad, dtype=np.int32)
+        ),
     )
     return _fused_dispatch(
         func, ("topk", int(k), bool(bottom)), block, gids, 1, params,
         np.float32(0.0), is_counter, is_delta,
-        name=f"fused_{'bottomk' if bottom else 'topk'}_{func}",
+        name=f"fused_{'bottomk' if bottom else 'topk'}_{func}", mesh=mesh,
     )
 
 
 def fused_quantile(func: str, block, gids_padded, num_groups: int, q: float,
-                   params, is_counter: bool = False, is_delta: bool = False):
+                   params, is_counter: bool = False, is_delta: bool = False,
+                   mesh=None):
     """One device dispatch for ``quantile(q, func(selector[w])) by (...)``:
     range kernel -> segment_quantile inside one compiled program; only the
     [G, J_pad] quantile grid reaches the host. ``q`` rides as a dynamic
-    argument so dashboards sweeping quantiles share one executable."""
+    argument so dashboards sweeping quantiles share one executable. With
+    ``mesh`` the exact per-group multiset is all_gather'd across devices
+    inside the same program before the sort (see _sharded_epilogue)."""
     return _fused_dispatch(
         func, ("quantile",), block, gids_padded, num_groups, params,
         np.float32(q), is_counter, is_delta, name=f"fused_quantile_{func}",
+        mesh=mesh,
     )
 
 
 def fused_hist_range_aggregate(func: str, block, gids_padded,
                                num_groups: int, params, les,
                                q: float | None = None,
-                               is_delta: bool = False):
+                               is_delta: bool = False, mesh=None):
     """One device dispatch for ``sum by (...) (hist_fn(selector[w]))`` over
     a 3-D histogram (super)block — optionally with the device-side
     ``histogram_quantile`` interpolation epilogue fused into the same
@@ -283,24 +488,38 @@ def fused_hist_range_aggregate(func: str, block, gids_padded,
     Shared regular grids (the overwhelmingly common scraped-histogram case)
     use the shared-window variant: [J] boundary vectors precomputed
     host-side and memoized device-resident on the block, skipping the
-    O(S*J*T) per-series boundary compare entirely."""
+    O(S*J*T) per-series boundary compare entirely.
+
+    With ``mesh`` (the block's [ΣS, T, B] series-sharded placement) the
+    hist range_fn -> per-bucket segment-sum -> psum -> (quantile) body
+    runs under shard_map — one dispatch across the mesh, with the quantile
+    interpolation evaluated on the replicated [G, J, B] partials inside
+    the same program."""
     import time as _time
 
     from ..metrics import record_kernel_dispatch
     from ..singleflight import memo_on
-    from .hist_kernels import _fused_hist_jit, _fused_hist_shared_jit
+    from .hist_kernels import (
+        _fused_hist_jit,
+        _fused_hist_sharded_jit,
+        _fused_hist_shared_jit,
+        _fused_hist_shared_sharded_jit,
+    )
     from .kernels import pad_steps
+    from .staging import replicated_put
 
     j_pad = pad_steps(params.num_steps)
     qv = np.float32(q if q is not None else 0.0)
     start_off = int(params.start_ms - block.base_ms)
+    name = f"fused_hist_{'quantile_' if q is not None else ''}sum_{func}"
+    if mesh is not None:
+        name = "mesh_" + name
     t0 = _time.perf_counter()
     if block.regular_ts is not None:
-        key = (start_off, int(params.step_ms), j_pad, int(params.window_ms))
+        key = (start_off, int(params.step_ms), j_pad, int(params.window_ms),
+               mesh is not None)
 
         def build_windows():
-            import jax
-
             m = int(np.asarray(block.lens)[0])
             tsv = np.asarray(block.regular_ts)[:m].astype(np.int64)
             out_t = start_off + np.arange(j_pad, dtype=np.int64) * int(
@@ -312,20 +531,38 @@ def fused_hist_range_aggregate(func: str, block, gids_padded,
             ).astype(np.int32)
             t_first = tsv[np.minimum(lo, m - 1)].astype(np.int32)
             t_last = tsv[np.minimum(hi - 1, m - 1)].astype(np.int32)
-            put = jax.device_put
+            put = replicated_put(mesh)
             return (put(lo), put(hi), put(t_first), put(t_last),
                     put(out_t.astype(np.int32)))
 
         lo, hi, t_first, t_last, out_t = memo_on(
             block, "_hist_win_cache", key, build_windows
         )
-        before = _fused_hist_shared_jit._cache_size()
-        out = _fused_hist_shared_jit(
-            func, block.vals, lo, hi, t_first, t_last, out_t,
-            np.int32(params.window_ms), gids_padded, les, qv,
-            num_groups, is_delta, q is not None,
+        if mesh is not None:
+            before = _fused_hist_shared_sharded_jit._cache_size()
+            out = _fused_hist_shared_sharded_jit(
+                mesh, func, block.vals, lo, hi, t_first, t_last, out_t,
+                np.int32(params.window_ms), gids_padded, les, qv,
+                num_groups, is_delta, q is not None,
+            )
+            compiled = _fused_hist_shared_sharded_jit._cache_size() > before
+        else:
+            before = _fused_hist_shared_jit._cache_size()
+            out = _fused_hist_shared_jit(
+                func, block.vals, lo, hi, t_first, t_last, out_t,
+                np.int32(params.window_ms), gids_padded, les, qv,
+                num_groups, is_delta, q is not None,
+            )
+            compiled = _fused_hist_shared_jit._cache_size() > before
+    elif mesh is not None:
+        before = _fused_hist_sharded_jit._cache_size()
+        out = _fused_hist_sharded_jit(
+            mesh, func, block.ts, block.vals, block.lens, gids_padded, les,
+            qv, np.int32(start_off), np.int32(params.step_ms),
+            np.int32(params.window_ms), j_pad, num_groups, is_delta,
+            q is not None,
         )
-        compiled = _fused_hist_shared_jit._cache_size() > before
+        compiled = _fused_hist_sharded_jit._cache_size() > before
     else:
         before = _fused_hist_jit._cache_size()
         out = _fused_hist_jit(
@@ -335,10 +572,7 @@ def fused_hist_range_aggregate(func: str, block, gids_padded,
             q is not None,
         )
         compiled = _fused_hist_jit._cache_size() > before
-    record_kernel_dispatch(
-        f"fused_hist_{'quantile_' if q is not None else ''}sum_{func}",
-        _time.perf_counter() - t0, compiled=compiled,
-    )
+    record_kernel_dispatch(name, _time.perf_counter() - t0, compiled=compiled)
     return out
 
 
@@ -366,7 +600,7 @@ def group_ids_memo(block, series_labels, by, without,
     )
 
     def build():
-        import jax
+        from .staging import series_put
 
         labels = series_labels
         if strip_metric:
@@ -385,7 +619,10 @@ def group_ids_memo(block, series_labels, by, without,
         s_pad = np.asarray(block.lens).shape[0]
         gids_padded = np.full(s_pad, G, dtype=np.int32)
         gids_padded[: len(gids)] = gids
-        return (jax.device_put(gids_padded), G, group_labels)
+        # co-placed with the block: a series-sharded superblock's gids
+        # shard the same axis so the fused program needs no resharding
+        put = series_put(getattr(block, "placement", None))
+        return (put(gids_padded), G, group_labels)
 
     return memo_on(block, "_gid_cache", key, build)
 

@@ -255,6 +255,92 @@ def _fused_hist_jit(func, ts, vals, lens, gids, les, qv, start_off, step_ms,
     return gjb
 
 
+def _hist_sharded_combine(sjb, gids_l, les, qv, num_groups: int,
+                          quantile: bool, axis: str):
+    """Local per-bucket segment-sum + psum over the mesh axis, then the
+    (optional) histogram_quantile interpolation on the REPLICATED [G, J, B]
+    partials — all inside the shard_map body, so the whole hist pipeline
+    stays one multi-device program. NaN-absence semantics match
+    _segment_aggregate_jit's "sum" (a group with no members anywhere is
+    NaN), via psum'd validity counts."""
+    S, J, B = sjb.shape
+    flat = sjb.reshape(S, J * B)
+    valid = ~jnp.isnan(flat)
+    s = jax.ops.segment_sum(
+        jnp.where(valid, flat, 0.0), gids_l, num_groups + 1
+    )
+    c = jax.ops.segment_sum(valid.astype(flat.dtype), gids_l, num_groups + 1)
+    s = jax.lax.psum(s, axis)
+    c = jax.lax.psum(c, axis)
+    gjb = jnp.where(c > 0, s, jnp.nan)[:num_groups].reshape(
+        num_groups, J, B
+    )
+    if quantile:
+        return histogram_quantile(qv, gjb, les)
+    return gjb
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "mesh", "func", "num_groups", "is_delta", "quantile"
+))
+def _fused_hist_shared_sharded_jit(mesh, func, vals, lo, hi, t_first, t_last,
+                                   out_t, window, gids, les, qv,
+                                   num_groups: int, is_delta: bool,
+                                   quantile: bool):
+    """Series-sharded twin of _fused_hist_shared_jit: the shared-grid hist
+    range kernel runs on each device's [S_l, T, B] row band (the [J]
+    boundary vectors are replicated closures) and the per-bucket partials
+    psum across the mesh inside the same program."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..jax_compat import shard_map
+
+    axis = mesh.axis_names[0]
+
+    def local(vals_l, gids_l):
+        sjb = _hist_range_shared(
+            func, vals_l, lo, hi, t_first, t_last, out_t, window, is_delta
+        )
+        return _hist_sharded_combine(
+            sjb, gids_l, les, qv, num_groups, quantile, axis
+        )
+
+    return shard_map(
+        local, mesh=mesh, in_specs=(P(axis, None, None), P(axis)),
+        out_specs=P(), check=False,
+    )(vals, gids)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "mesh", "func", "num_steps", "num_groups", "is_delta", "quantile"
+))
+def _fused_hist_sharded_jit(mesh, func, ts, vals, lens, gids, les, qv,
+                            start_off, step_ms, window, num_steps: int,
+                            num_groups: int, is_delta: bool, quantile: bool):
+    """Series-sharded twin of _fused_hist_jit (general per-series window
+    boundaries)."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..jax_compat import shard_map
+
+    axis = mesh.axis_names[0]
+
+    def local(ts_l, vals_l, lens_l, gids_l):
+        sjb = hist_range_kernel(
+            func, ts_l, vals_l, lens_l, start_off, step_ms, window,
+            num_steps, is_delta=is_delta,
+        )
+        return _hist_sharded_combine(
+            sjb, gids_l, les, qv, num_groups, quantile, axis
+        )
+
+    return shard_map(
+        local, mesh=mesh,
+        in_specs=(P(axis, None), P(axis, None, None), P(axis), P(axis)),
+        out_specs=P(), check=False,
+    )(ts, vals, lens, gids)
+
+
 def run_hist_range_function(
     func: str, block: StagedBlock, params: RangeParams, is_delta: bool = False
 ):

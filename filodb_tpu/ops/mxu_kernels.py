@@ -111,10 +111,15 @@ def use_gather_fetch(fetch: str, idx) -> bool:
 
 
 class WindowMatrices:
-    """Host-precomputed per-(grid, window) matrices for one shared ts."""
+    """Host-precomputed per-(grid, window) matrices for one shared ts.
+
+    ``put`` overrides the device placement of every device-resident copy
+    (default: plain device_put). A series-sharded block passes a
+    mesh-REPLICATED put so the matrices upload once with the placement the
+    shard_map program wants — never a dead single-device copy."""
 
     def __init__(self, ts1: np.ndarray, n_valid: int, start_off: int, step_ms: int,
-                 num_steps: int, window_ms: int):
+                 num_steps: int, window_ms: int, put=None):
         ts = ts1[:n_valid].astype(np.int64)
         T = len(ts1)
         J = num_steps
@@ -155,7 +160,7 @@ class WindowMatrices:
         # device-resident copies (transferred once, reused every query)
         import jax
 
-        put = jax.device_put
+        put = self._put = put if put is not None else jax.device_put
         self.dW, self.dF, self.dL, self.dL2 = map(put, (W, F, L, L2))
         self.d_count = put(cnt)
         self.d_tf = put(np.nan_to_num(self.t_first, nan=0.0).astype(np.float32))
@@ -180,7 +185,7 @@ class WindowMatrices:
         tidx = np.arange(self._T)[:, None]
         P = ((tidx > self._lo[None, :]) & (tidx < self._hi[None, :])).astype(np.float32)
         self.P = P
-        self.dP = jax.device_put(P)
+        self.dP = self._put(P)
         self._pairs_built = True
 
     def ensure_regression(self):
@@ -193,9 +198,9 @@ class WindowMatrices:
         self.Wt = (self.W * tc).astype(np.float32)
         self.st = self.Wt.sum(0)
         self.stt = (self.W * tc * tc).sum(0).astype(np.float64)
-        self.dWt = jax.device_put(self.Wt)
-        self.d_st = jax.device_put(self.st)
-        self.d_stt = jax.device_put(self.stt.astype(np.float32))
+        self.dWt = self._put(self.Wt)
+        self.d_st = self._put(self.st)
+        self.d_stt = self._put(self.stt.astype(np.float32))
         self._regression_built = True
 
     def ensure_minmax(self):
@@ -209,7 +214,7 @@ class WindowMatrices:
          self.edge_idx) = build_minmax_structures(
             self._lo, self._hi, self._T, self._J
         )
-        put = jax.device_put
+        put = self._put
         self.d_tile_mask = put(self.tile_mask)
         self.d_edge_onehot = put(self.edge_onehot)
         self.d_edge_valid = put(self.edge_valid)
@@ -222,14 +227,20 @@ def window_matrices(block: StagedBlock, start_off: int, step_ms: int,
     """Per-(block, query-params) WindowMatrices, memoized on the block via
     the shared keyed single-flight (filodb_tpu/singleflight.memo_on): two
     racing same-key misses would each upload the full device-resident
-    matrix set and the loser's copy would linger until GC."""
+    matrix set and the loser's copy would linger until GC. A series-sharded
+    block (mesh superblock) uploads them REPLICATED across its mesh — the
+    placement the shard_map program consumes, committed once at build."""
     from ..singleflight import memo_on
+    from .staging import replicated_put
 
     key = (int(start_off), int(step_ms), int(num_steps), int(window_ms))
+    mesh = getattr(block, "placement", None)
     return memo_on(
         block, "_wm_cache", key,
         lambda: WindowMatrices(block.regular_ts, int(block.lens[0]),
-                               start_off, step_ms, num_steps, window_ms),
+                               start_off, step_ms, num_steps, window_ms,
+                               put=replicated_put(mesh) if mesh is not None
+                               else None),
     )
 
 

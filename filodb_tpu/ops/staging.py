@@ -50,6 +50,58 @@ def pad_time(t: int) -> int:
 
 TS_PAD = np.int32(2**31 - 1)  # padded slots sort after every real timestamp
 
+
+def series_put(mesh):
+    """``jax.device_put`` closure for a block placement: single-device when
+    ``mesh`` is None, else series-axis row sharding
+    (``PartitionSpec(axis)`` — trailing dims replicate implicitly, so ONE
+    spec covers [S], [S, T] and [S, T, B] arrays alike)."""
+    import jax
+
+    if mesh is None:
+        return jax.device_put
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    sharding = NamedSharding(mesh, PartitionSpec(mesh.axis_names[0]))
+    return lambda a: jax.device_put(a, sharding)
+
+
+def replicated_put(mesh):
+    """``jax.device_put`` closure committing an array REPLICATED across the
+    mesh (window matrices, group-id-free [J] vectors): placed once at build
+    so warm dispatches pay no per-call broadcast transfer."""
+    import jax
+
+    if mesh is None:
+        return jax.device_put
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    sharding = NamedSharding(mesh, PartitionSpec())
+    return lambda a: jax.device_put(a, sharding)
+
+
+def mesh_spec_str(mesh) -> str | None:
+    """Human-readable sharding descriptor for introspection endpoints
+    (/debug/superblocks) — the EXACT spec series_put applies: leading dim
+    sharded, trailing dims implicitly replicated whatever the rank."""
+    if mesh is None:
+        return None
+    axis = mesh.axis_names[0]
+    return f"PartitionSpec('{axis}') x {mesh.devices.size} devices"
+
+
+def mesh_device_bytes(mesh, nbytes: int) -> dict | None:
+    """Even per-device byte attribution of a series-sharded block (the row
+    arrays dominate and split evenly across the mesh)."""
+    if mesh is None:
+        return None
+    devs = list(mesh.devices.flat)
+    per = nbytes // len(devs)
+    out = {str(d): per for d in devs}
+    # remainder lands on the first device so the totals stay exact
+    out[str(devs[0])] += nbytes - per * len(devs)
+    return out
+
 # masked (missing-scrape) grid detection: tolerate up to this fraction of
 # holes before dropping to the general gather path
 MAX_HOLE_FRAC = 0.05
@@ -340,6 +392,11 @@ class StagedBlock:
     n_series: int  # real series count (<= S)
     part_refs: list  # (shard_num, part_id) per real series row
     raw: np.ndarray | None = None  # [S, T] f32 raw values (counters only)
+    # device mesh this block's series axis is partitioned over
+    # (NamedSharding, PartitionSpec(axis, None)); None = single-device.
+    # Set by to_device(mesh=...); consumers (group_ids_memo, the sharded
+    # fused kernels, append repairs) read it to co-place their arrays.
+    placement: "object | None" = None
     # regular-grid fast path: every real series shares ONE timestamp vector
     # and one length — window matrices become series-independent and the
     # range kernel becomes a batched matmul on the MXU (see kernels.py)
@@ -363,14 +420,23 @@ class StagedBlock:
     def shape(self):
         return self.ts.shape
 
-    def to_device(self, keep_host: bool = False) -> "StagedBlock":
+    def to_device(self, keep_host: bool = False,
+                  mesh=None) -> "StagedBlock":
         """Pin the block's arrays in HBM (the north-star 'decoded chunk
         windows staged to HBM'); returns self for chaining. ``keep_host``
         retains mutable host mirrors so cached blocks can be incrementally
         APPENDED to when live samples arrive (append_to_block) instead of
-        fully restaged."""
+        fully restaged.
+
+        ``mesh`` partitions the SERIES axis across a device mesh
+        (``NamedSharding``, ``PartitionSpec(axis)`` on the leading dim of
+        every [S, ...] array) so one shard_map program spans all devices —
+        the padded S must be mesh-divisible (concat_blocks
+        ``series_multiple``). The mesh is recorded as ``self.placement``."""
         import jax
 
+        if mesh is not None:
+            self.placement = mesh
         if keep_host:
             # explicit copies: jax.device_put on the CPU backend can alias
             # numpy memory, and the mirrors get mutated by append repairs
@@ -382,14 +448,15 @@ class StagedBlock:
                           if self.raw is not None else None)
             self.h_dev = (np.array(self.ts_dev, copy=True)
                           if self.ts_dev is not None else None)
-        self.ts = jax.device_put(self.ts)
-        self.vals = jax.device_put(self.vals)
-        self.lens = jax.device_put(self.lens)
-        self.baseline = jax.device_put(self.baseline)
+        put = series_put(self.placement)
+        self.ts = put(self.ts)
+        self.vals = put(self.vals)
+        self.lens = put(self.lens)
+        self.baseline = put(self.baseline)
         if self.raw is not None:
-            self.raw = jax.device_put(self.raw)
+            self.raw = put(self.raw)
         if self.ts_dev is not None:
-            self.ts_dev = jax.device_put(self.ts_dev)
+            self.ts_dev = put(self.ts_dev)
         if self.mgrid is not None:
             self.mgrid.to_device()
         return self
@@ -784,22 +851,24 @@ def _append_to_parts(parts, block: StagedBlock, column: str,
     new_lens[:n] = m + k
     ext_grid = grid.copy()
     ext_grid[m : m + k] = off32
-    import jax
-
     # fresh block object: in-flight readers keep the old (immutable device
     # arrays + old grid) view; window-matrix caches start empty against the
     # extended grid. device_put gets COPIES — on the CPU backend it can
-    # alias numpy memory, and the next repair mutates these same mirrors
+    # alias numpy memory, and the next repair mutates these same mirrors.
+    # A series-sharded block (mesh superblock) re-uploads with the SAME
+    # placement: extension never changes S, so the row bands still divide.
+    put = series_put(block.placement)
     nb = StagedBlock(
-        jax.device_put(block.h_ts.copy()), jax.device_put(block.h_vals.copy()),
-        jax.device_put(new_lens.copy()), base, block.baseline, n,
+        put(block.h_ts.copy()), put(block.h_vals.copy()),
+        put(new_lens.copy()), base, block.baseline, n,
         list(block.part_refs),
-        raw=(jax.device_put(block.h_raw.copy())
+        raw=(put(block.h_raw.copy())
              if block.h_raw is not None else None),
         regular_ts=None if jittered else ext_grid,
         nominal_ts=ext_grid if jittered else None,
-        ts_dev=(jax.device_put(block.h_dev.copy()) if jittered else None),
+        ts_dev=(put(block.h_dev.copy()) if jittered else None),
         maxdev_ms=(md if jittered else 0),
+        placement=block.placement,
     )
     nb.h_ts = block.h_ts
     nb.h_vals = block.h_vals
@@ -1018,7 +1087,8 @@ def staged_nbytes(block: StagedBlock) -> int:
     return total
 
 
-def concat_blocks(blocks, force_raw: bool = False) -> StagedBlock:
+def concat_blocks(blocks, force_raw: bool = False,
+                  series_multiple: int = 1) -> StagedBlock:
     """Row-concatenate staged blocks into one padded superblock EXACTLY —
     corrected values, raw sidecars, baselines and part refs carry over with
     no restaging and no semantic drift. All blocks must share base_ms.
@@ -1035,7 +1105,10 @@ def concat_blocks(blocks, force_raw: bool = False) -> StagedBlock:
     general kernels. ``force_raw`` always materializes the raw sidecar
     (filling from vals where a block has none) for consumers that index it
     unconditionally (the mesh stacking path); histogram blocks never carry
-    one."""
+    one. ``series_multiple`` rounds the padded series axis up to a multiple
+    (a device-mesh size): series-axis sharding needs equal per-device row
+    bands, and the trash-group/padded-row masking already makes the extra
+    rows inert."""
     real = [b for b in blocks if b.n_series > 0]
     if not real:  # keep an empty-but-shaped block (mesh rows can be empty)
         real = list(blocks[:1])
@@ -1043,6 +1116,8 @@ def concat_blocks(blocks, force_raw: bool = False) -> StagedBlock:
     T = max(b.ts.shape[1] for b in real)
     S = sum(b.n_series for b in real)
     Sp = pad_series(S)
+    if series_multiple > 1:
+        Sp = ((Sp + series_multiple - 1) // series_multiple) * series_multiple
     is_hist = any(np.asarray(b.vals).ndim == 3 for b in real)
     if is_hist:
         assert len({np.asarray(b.vals).shape[2] for b in real}) == 1, (
@@ -1124,6 +1199,21 @@ def _superblock_cache_walker(cache) -> int:
     return total
 
 
+def _superblock_device_walker(cache) -> dict:
+    """Per-device byte balances of SHARDED cached superblocks (metadata-only
+    split recorded at put time) — the filodb_device_bytes{kind,device}
+    breakdown; single-device entries carry no device dimension."""
+    with cache._lock:
+        metas = list(cache._meta.values())
+    out: dict[str, int] = {}
+    for m in metas:
+        db = m.get("device_bytes")
+        if db:
+            for dev, b in db.items():
+                out[dev] = out.get(dev, 0) + int(b)
+    return out
+
+
 class SuperblockCache:
     """Shard-version-keyed cache of device-resident cross-shard superblocks
     (the staging layer of the single-dispatch fused aggregate).
@@ -1151,9 +1241,13 @@ class SuperblockCache:
             max_keys=4 * max_entries, alive=lambda k: k in self._d
         )
         # device-ledger account (filodb_tpu/ledger.py): every put/evict/drop
-        # debits/credits; the walker recounts live entries for drift checks
+        # debits/credits; the walker recounts live entries for drift checks.
+        # The device walker splits sharded entries' balances per device for
+        # the filodb_device_bytes{kind,device} gauges.
         self.ledger = LEDGER.register(
-            self, "superblock", _superblock_cache_walker, name="superblock-cache"
+            self, "superblock", _superblock_cache_walker,
+            name="superblock-cache",
+            device_walker=_superblock_device_walker,
         )
 
     def build_lock(self, key) -> threading.Lock:
@@ -1242,10 +1336,17 @@ class SuperblockCache:
             self._d[key] = (versions, value, nbytes)
             self.ledger.alloc(nbytes)
             prev = self._meta.get(key)
+            # sharded entries record their placement at put time (metadata
+            # only — never touches device values): the sharding spec and
+            # even per-device byte split feed /debug/superblocks and the
+            # filodb_device_bytes{kind,device} gauges
+            mesh = getattr(getattr(value, "block", None), "placement", None)
             self._meta[key] = {
                 "created": time.time(),
                 "hits": prev["hits"] if prev else 0,
                 "last_outcome": prev["last_outcome"] if prev else None,
+                "sharding": mesh_spec_str(mesh),
+                "device_bytes": mesh_device_bytes(mesh, nbytes),
             }
 
     def snapshot(self) -> list[dict]:
@@ -1265,6 +1366,8 @@ class SuperblockCache:
                 "hits": int(meta.get("hits", 0)),
                 "last_outcome": meta.get("last_outcome"),
                 "versions": list(versions),
+                "sharding": meta.get("sharding"),
+                "device_bytes": meta.get("device_bytes"),
             }
             block = getattr(value, "block", None)
             if block is not None:

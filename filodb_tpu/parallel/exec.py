@@ -60,12 +60,20 @@ MESH_OPS = {"sum", "count", "avg", "min", "max"}
 
 
 class MeshAggregateExec(ExecPlan):
-    """Aggregate a windowed range function across shards on the mesh."""
+    """Aggregate a windowed range function across shards on the mesh.
+
+    The aggregate path DELEGATES to the mesh-sharded fused superblock
+    kernels (one pjit/shard_map dispatch over a series-partitioned
+    ``[ΣS, T]`` superblock — ops/staging + ops/aggregations) whenever the
+    op/function is in the fused set; the pre-fusion per-shard stack +
+    psum kernels below remain as the ``mesh_unsupported`` fallback for
+    everything else (and as the explicit ``fused=False`` escape hatch)."""
 
     def __init__(self, mesh, shard_nums, filters, raw_start_ms, raw_end_ms,
                  op: str, by, without, function: str,
                  start_ms: int, end_ms: int, step_ms: int, window_ms: int,
-                 is_counter=False, is_delta=False):
+                 is_counter=False, is_delta=False, fused: bool = True,
+                 fused_fallback=None):
         super().__init__()
         self.mesh = mesh
         self.shard_nums = list(shard_nums)
@@ -82,6 +90,14 @@ class MeshAggregateExec(ExecPlan):
         self.window_ms = window_ms
         self.is_counter = is_counter
         self.is_delta = is_delta
+        # sharded-fused delegation: the planner passes the reference-tree
+        # factory the delegate needs as ITS runtime fallback (partial
+        # results, mixed schemas, ...). fused_fallback None (direct
+        # construction) disables delegation outright.
+        self.fused = fused
+        self.fused_fallback = fused_fallback
+        self._fused_params: tuple = ()
+        self._fused_delegate = None
 
     def args_str(self):
         return (
@@ -207,7 +223,46 @@ class MeshAggregateExec(ExecPlan):
         cache[key] = result
         return result
 
+    def _sharded_fused(self):
+        """The mesh-sharded FusedAggregateExec this node delegates to, or
+        None when the fused program doesn't model this aggregate (the
+        planner's gate, re-checked here: fused_mesh_supported)."""
+        from ..query.exec.plans import FusedAggregateExec, fused_mesh_supported
+
+        if not self.fused or self.fused_fallback is None:
+            return None
+        smesh = M.series_mesh(self.mesh)
+        if not fused_mesh_supported(smesh, self.op, self.function):
+            return None
+        if self._fused_delegate is None:
+            self._fused_delegate = FusedAggregateExec(
+                self.shard_nums, self.filters, self.raw_start_ms,
+                self.raw_end_ms, None, self.op, self.by, self.without,
+                self.function, self.start_ms, self.end_ms, self.step_ms,
+                self.window_ms, 0, fallback=self.fused_fallback,
+                params=self._fused_params, mesh=smesh,
+            )
+        return self._fused_delegate
+
+    def _delegate(self, ctx: QueryContext):
+        """Run the sharded-fused delegate, or record the legacy-kernel
+        fallback (reason ``mesh_unsupported``) and return None."""
+        delegate = self._sharded_fused()
+        if delegate is not None:
+            return delegate.execute(ctx)
+        if self.fused and self.fused_fallback is not None:
+            from ..metrics import current_span, record_fused_fallback
+
+            s = current_span()
+            if s is not None:
+                s.tags["fused_fallback"] = "mesh_unsupported"
+            record_fused_fallback("mesh_unsupported")
+        return None
+
     def do_execute(self, ctx: QueryContext) -> QueryResult:
+        res = self._delegate(ctx)
+        if res is not None:
+            return res
         staged = self._stage_all(ctx)
         if staged is None:
             return QueryResult()
@@ -412,6 +467,11 @@ class Mesh2DAggregateExec(MeshAggregateExec):
     def do_execute(self, ctx: QueryContext) -> QueryResult:
         from . import mesh2d as M2
 
+        # sharded-fused delegation flattens the (shard x time) devices onto
+        # one series axis (series_mesh) — still exactly one dispatch
+        res = self._delegate(ctx)
+        if res is not None:
+            return res
         # per-shard staging (blocks + global gids) shared with the 1D path
         # (cached); mesh2d splits each block's time axis itself
         staged = self._staged_blocks(ctx)
@@ -456,6 +516,7 @@ class MeshQuantileExec(MeshAggregateExec):
     def __init__(self, q: float, *args, **kw):
         super().__init__(*args, op="quantile", **kw)
         self.q = q
+        self._fused_params = (q,)
 
     def args_str(self):
         return f"q={self.q} fn={self.function} shards={self.shard_nums} (sketch)"
@@ -463,6 +524,12 @@ class MeshQuantileExec(MeshAggregateExec):
     def do_execute(self, ctx: QueryContext) -> QueryResult:
         from ..ops import sketch as SK
 
+        # sharded-fused delegation: EXACT quantile (the all_gather'd
+        # multiset sort epilogue) in one dispatch — strictly better than
+        # the mergeable log-linear sketches, which remain the fallback
+        res = self._delegate(ctx)
+        if res is not None:
+            return res
         staged = self._stage_all(ctx)
         if staged is None:
             return QueryResult()

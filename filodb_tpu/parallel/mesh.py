@@ -35,30 +35,31 @@ def make_mesh(devices=None) -> Mesh:
     return Mesh(np.array(devices), axis_names=("shard",))
 
 
+def make_series_mesh(devices=None) -> Mesh:
+    """1-D mesh for the series-sharded fused superblock path
+    (PartitionSpec('series', None) placement in ops/staging)."""
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(np.array(devices), axis_names=("series",))
+
+
+def series_mesh(mesh) -> Mesh:
+    """Normalize any configured mesh to the 1-D form the sharded fused
+    kernels partition the superblock series axis over: 1-D meshes pass
+    through (whatever the axis is named), multi-axis meshes (shard x time)
+    flatten their devices onto a fresh ``series`` axis. Mesh equality is by
+    (devices, axis names), so repeated normalizations hit the same jit
+    cache entries."""
+    if len(mesh.axis_names) == 1:
+        return mesh
+    return make_series_mesh(list(mesh.devices.flat))
+
+
 def _segment_psum(op: str, grid, gids_l, num_groups: int):
     """Local segment-reduce + psum over the shard axis (shared by the
-    general and MXU local kernels)."""
-    valid = ~jnp.isnan(grid)
-    v0 = jnp.where(valid, grid, 0.0)
-    psum = jax.lax.psum
-    if op in ("sum", "avg", "count"):
-        s = psum(jax.ops.segment_sum(v0, gids_l, num_groups), "shard")
-        c = psum(jax.ops.segment_sum(valid.astype(jnp.float32), gids_l, num_groups), "shard")
-        if op == "sum":
-            return jnp.where(c > 0, s, jnp.nan)
-        if op == "count":
-            return jnp.where(c > 0, c, jnp.nan)
-        return jnp.where(c > 0, s / jnp.maximum(c, 1.0), jnp.nan)
-    if op in ("min", "max"):
-        big = jnp.inf if op == "min" else -jnp.inf
-        vm = jnp.where(valid, grid, big)
-        if op == "min":
-            r = jax.lax.pmin(jax.ops.segment_min(vm, gids_l, num_groups), "shard")
-        else:
-            r = jax.lax.pmax(jax.ops.segment_max(vm, gids_l, num_groups), "shard")
-        c = psum(jax.ops.segment_sum(valid.astype(jnp.float32), gids_l, num_groups), "shard")
-        return jnp.where(c > 0, r, jnp.nan)
-    raise ValueError(f"unsupported mesh aggregation {op}")
+    general and MXU local kernels). The ONE definition lives in
+    ops/aggregations._segment_psum_axis, shared with the sharded fused
+    superblock path."""
+    return AGG._segment_psum_axis(op, grid, gids_l, num_groups, "shard")
 
 
 @functools.partial(

@@ -1154,6 +1154,20 @@ FUSED_FUNCS = frozenset({
 })
 
 
+def fused_mesh_supported(mesh, op: str, function) -> bool:
+    """Whether the mesh-sharded fused program models this aggregate: a 1-D
+    device mesh, a fused op (simple aggregates psum their [G, J] partials;
+    topk/quantile epilogues combine winner/multiset state across devices),
+    and a fused range function. The ONE gate shared by the planner, the
+    parallel/ engines' delegation, and FusedAggregateExec's runtime check
+    (fallback reason ``mesh_unsupported``)."""
+    if mesh is None or len(getattr(mesh, "axis_names", ())) != 1:
+        return False
+    if op not in FUSED_AGG_OPS and op not in FUSED_EPI_OPS:
+        return False
+    return function is None or function in FUSED_FUNCS
+
+
 class FusedAggregateExec(ExecPlan):
     """Single-dispatch cross-shard aggregate (the tentpole of the
     superblock path): ``op by (...) (func(selector[w]))`` over local shards
@@ -1193,7 +1207,7 @@ class FusedAggregateExec(ExecPlan):
                  column, op: str, by, without, function,
                  start_ms: int, end_ms: int, step_ms: int, window_ms: int,
                  offset_ms: int, fallback, params=(),
-                 hist_quantile: float | None = None):
+                 hist_quantile: float | None = None, mesh=None):
         super().__init__()
         self.shard_nums = list(shard_nums)
         self.filters = tuple(filters)
@@ -1211,6 +1225,11 @@ class FusedAggregateExec(ExecPlan):
         self.offset_ms = offset_ms
         self.params = tuple(params)  # k for topk/bottomk, q for quantile
         self.hist_quantile = hist_quantile  # fused histogram_quantile(q, ..)
+        # 1-D device mesh (parallel.mesh.series_mesh): the superblock's
+        # series axis partitions across it and the fused program runs under
+        # shard_map — ONE dispatch spanning every device. None = the
+        # single-device fused path.
+        self.mesh = mesh
         self._fallback_factory = fallback
         self._fallback: ExecPlan | None = None
 
@@ -1225,6 +1244,8 @@ class FusedAggregateExec(ExecPlan):
         extra = f" params={self.params}" if self.params else ""
         if self.hist_quantile is not None:
             extra += f" hist_q={self.hist_quantile}"
+        if self.mesh is not None:
+            extra += f" mesh={self.mesh.devices.size}"
         return (
             f"op={self.op} fn={self.function} by={self.by} "
             f"without={self.without} shards={self.shard_nums} filters=[{fs}]"
@@ -1311,9 +1332,18 @@ class FusedAggregateExec(ExecPlan):
         key_mode = stage_mode
         if hint is not None and not (hint[0] and not hint[1]):
             key_mode = "raw"  # known gauge / delta-temporality column
+        # sharded and single-device superblocks are distinct cache entries:
+        # placement (and the mesh-divisible padding) differs even over the
+        # identical selection, and engines sharing one memstore may run both
+        mesh_desc = (
+            None if self.mesh is None
+            else (self.mesh.axis_names[0],
+                  tuple(d.id for d in self.mesh.devices.flat))
+        )
         sb_key = (
             ctx.dataset, tuple(self.shard_nums), self.filters,
             self.raw_start_ms, self.raw_end_ms, self.column, key_mode,
+            mesh_desc,
         )
         versions = tuple(
             ctx.memstore.shard(ctx.dataset, s).version for s in self.shard_nums
@@ -1636,12 +1666,16 @@ class FusedAggregateExec(ExecPlan):
             blocks, les = _unify_hist_blocks(blocks, block_les)
         # host mirrors ride along so live-edge ingest can EXTEND the
         # superblock in place (ST.extend_superblock) instead of paying
-        # concat + full re-upload per append — the delta-summation move
-        super_block = ST.concat_blocks(blocks).to_device(
-            keep_host=_SUPERBLOCK_EXTEND
-        )
+        # concat + full re-upload per append — the delta-summation move.
+        # With a mesh, the series axis pads to a mesh-divisible ΣS (the
+        # existing trash-group masking keeps the extra rows inert) and the
+        # arrays pin SHARDED (PartitionSpec(axis) row bands) so the fused
+        # program spans every device without a gather.
+        multiple = self.mesh.devices.size if self.mesh is not None else 1
+        super_block = ST.concat_blocks(
+            blocks, series_multiple=multiple
+        ).to_device(keep_host=_SUPERBLOCK_EXTEND, mesh=self.mesh)
         nbytes = ST.staged_nbytes(super_block)
-        import jax
 
         resolved_mode = (
             stage_mode if is_counter and not is_delta and not is_hist
@@ -1650,8 +1684,9 @@ class FusedAggregateExec(ExecPlan):
         value = SuperblockEntry(
             super_block, labels, is_counter, is_delta, samples,
             max_shard_series, series=total, is_hist=is_hist, les=les,
-            les_dev=(jax.device_put(np.asarray(les, dtype=np.float32))
-                     if les is not None else None),
+            les_dev=(ST.replicated_put(self.mesh)(
+                np.asarray(les, dtype=np.float32))
+                if les is not None else None),
             col_name=col_name,
             stage_mode=None if sliced_hist else resolved_mode,
         )
@@ -1677,6 +1712,13 @@ class FusedAggregateExec(ExecPlan):
             # a child-dispatch hook (fault injection / chaos harness) only
             # fires on per-child dispatch — run the tree it can intercept
             return self._fall(ctx, "dispatcher")
+        if self.mesh is not None and not fused_mesh_supported(
+            self.mesh, self.op, self.function
+        ):
+            # the sharded program models the fused op/function set over a
+            # 1-D series mesh; anything else keeps the caller's fallback
+            # (the mesh engines' legacy per-shard kernels, or the tree)
+            return self._fall(ctx, "mesh_unsupported")
         func = self.function or "last"
         stage_mode = _stage_mode_for_function(self.function)
         with span("fused:stage"):
@@ -1704,6 +1746,7 @@ class FusedAggregateExec(ExecPlan):
                 out = AGG.fused_hist_range_aggregate(
                     func, got.block, gids_dev, G, params, got.les_dev,
                     q=self.hist_quantile, is_delta=got.is_delta,
+                    mesh=self.mesh,
                 )
             if self.hist_quantile is not None:
                 # quantile fused on device: [G, J] is all that comes back
@@ -1722,6 +1765,7 @@ class FusedAggregateExec(ExecPlan):
                 vals_dev, idx_dev = AGG.fused_topk(
                     func, got.block, k, self.op == "bottomk", params,
                     is_counter=got.is_counter, is_delta=got.is_delta,
+                    mesh=self.mesh,
                 )
             return self._present_topk(
                 np.asarray(vals_dev)[:, :nsteps],
@@ -1736,6 +1780,7 @@ class FusedAggregateExec(ExecPlan):
                 out = AGG.fused_quantile(
                     func, got.block, gids_dev, G, q, params,
                     is_counter=got.is_counter, is_delta=got.is_delta,
+                    mesh=self.mesh,
                 )
             return QueryResult(grids=[
                 Grid(group_labels, self.start_ms, self.step_ms, nsteps, out)
@@ -1744,6 +1789,7 @@ class FusedAggregateExec(ExecPlan):
             out = AGG.fused_range_aggregate(
                 func, self.op, got.block, gids_dev, G, params,
                 is_counter=got.is_counter, is_delta=got.is_delta,
+                mesh=self.mesh,
             )
         return QueryResult(
             grids=[Grid(group_labels, self.start_ms, self.step_ms, nsteps, out)]

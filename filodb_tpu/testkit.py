@@ -21,6 +21,21 @@ from .core.records import RecordBatch
 from .core.schemas import GAUGE, METRIC_TAG, PROM_COUNTER, PROM_HISTOGRAM, Schema
 
 
+def kernel_dispatch_total() -> int:
+    """Total ``filodb_kernel_dispatch_seconds`` observations so far — the
+    ONE definition of the O(1)-dispatch assertion's counter, shared by the
+    fused/fused-mesh test suites, bench.py's fused_mesh workload, and the
+    MULTICHIP dryrun (a warm fused query must move this by exactly 1)."""
+    from .metrics import REGISTRY
+
+    total = 0
+    with REGISTRY._lock:
+        for (name, _lbls), m in REGISTRY._metrics.items():
+            if name == "filodb_kernel_dispatch_seconds":
+                total += m.total
+    return total
+
+
 def machine_metrics(
     n_series: int = 100,
     n_samples: int = 720,

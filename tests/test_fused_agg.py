@@ -236,14 +236,9 @@ def test_fused_cached_superblock_respects_limits(engines):
 
 
 def _dispatch_total() -> int:
-    from filodb_tpu.metrics import REGISTRY
+    from filodb_tpu.testkit import kernel_dispatch_total
 
-    total = 0
-    with REGISTRY._lock:
-        for (name, _lbls), m in REGISTRY._metrics.items():
-            if name == "filodb_kernel_dispatch_seconds":
-                total += m.total
-    return total
+    return kernel_dispatch_total()
 
 
 def test_warm_sum_rate_is_single_dispatch(engines):

@@ -117,14 +117,9 @@ def _plan_root(engine, q, start=START, end=END, step=STEP):
 
 
 def _dispatch_total() -> int:
-    from filodb_tpu.metrics import REGISTRY
+    from filodb_tpu.testkit import kernel_dispatch_total
 
-    total = 0
-    with REGISTRY._lock:
-        for (name, _lbls), m in REGISTRY._metrics.items():
-            if name == "filodb_kernel_dispatch_seconds":
-                total += m.total
-    return total
+    return kernel_dispatch_total()
 
 
 def _fallback_counts() -> dict:

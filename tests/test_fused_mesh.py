@@ -1,0 +1,375 @@
+"""Mesh-sharded fused superblocks (doc/perf.md "Mesh-sharded fused path").
+
+The canonical query over a mesh-configured engine must execute as ONE
+multi-device dispatch: the [ΣS, T] / [ΣS, T, B] superblock partitions its
+series axis across the mesh (PartitionSpec(axis) row bands) and the whole
+``range_fn -> segment_aggregate -> epilogue`` program runs under shard_map
+with psum-combined [G, J] partials (topk/quantile combine winner/multiset
+state across devices inside the same program).
+
+Parity contract: sharded fused == single-device fused == reference tree
+across the full operator set, for ΣS not divisible by the mesh size, and
+for the mesh-size-1 degenerate case. Runs on the conftest-forced 8-device
+virtual CPU mesh (make test-multichip).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from filodb_tpu.coordinator.planner import PlannerParams, QueryEngine
+from filodb_tpu.core.schemas import Dataset
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.parallel.mesh import make_mesh, make_series_mesh
+from filodb_tpu.testkit import counter_batch, histogram_batch, machine_metrics
+
+pytestmark = [pytest.mark.perf, pytest.mark.fused_mesh]
+
+BASE = 1_600_000_000_000
+N_SHARDS = 8
+START = (BASE + 600_000) / 1000
+END = START + 1200
+STEP = 60
+
+
+@pytest.fixture(scope="module")
+def store():
+    ms = TimeSeriesMemStore()
+    ms.setup(Dataset("ds"), list(range(N_SHARDS)))
+    ms.ingest_routed(
+        "ds", counter_batch(n_series=48, n_samples=300, start_ms=BASE),
+        spread=3,
+    )
+    ms.ingest_routed(
+        "ds", machine_metrics(n_series=48, n_samples=300, start_ms=BASE),
+        spread=3,
+    )
+    ms.ingest_routed(
+        "ds", histogram_batch(n_series=24, n_samples=300, start_ms=BASE),
+        spread=3,
+    )
+    return ms
+
+
+@pytest.fixture(scope="module")
+def engines(store):
+    single = QueryEngine(store, "ds")
+    sharded = QueryEngine(store, "ds", PlannerParams(mesh=make_mesh()))
+    ref = QueryEngine(store, "ds", PlannerParams(fused_aggregate=False))
+    return single, sharded, ref
+
+
+def _rows(res):
+    out = {}
+    for g in res.grids:
+        for i, lbls in enumerate(g.labels):
+            vals = g.values_np()[i]
+            h = g.hist_np()
+            out[tuple(sorted(lbls.items()))] = (
+                np.asarray(vals), None if h is None else np.asarray(h[i])
+            )
+    return out
+
+
+def assert_three_way(single, sharded, ref, q, exact=False):
+    """sharded == single-device fused == reference, NaN masks bit-identical,
+    values within float32 accumulation-order ulps (the same tolerance the
+    fused-vs-reference suite pins)."""
+    rows = [_rows(e.query_range(q, START, END, STEP))
+            for e in (single, sharded, ref)]
+    a, b, c = rows
+    assert a.keys() == b.keys() == c.keys(), (q, sorted(a), sorted(b))
+    for k in a:
+        for other in (b, c):
+            va, ha = a[k]
+            vb, hb = other[k]
+            na, nb = np.isnan(va), np.isnan(vb)
+            assert (na == nb).all(), (q, k, "NaN masks differ")
+            if exact:
+                assert (va[~na] == vb[~nb]).all(), (q, k)
+            else:
+                np.testing.assert_allclose(
+                    va[~na], vb[~nb], rtol=2e-5, atol=1e-6, err_msg=f"{q} {k}"
+                )
+            if ha is not None or hb is not None:
+                assert ha is not None and hb is not None, (q, k)
+                np.testing.assert_allclose(
+                    ha, hb, rtol=2e-5, atol=1e-6, equal_nan=True,
+                    err_msg=f"{q} {k} hist",
+                )
+
+
+# -- parity across the full operator set -------------------------------------
+
+
+@pytest.mark.parametrize("q", [
+    "sum(rate(http_requests_total[5m]))",
+    "sum by (instance) (rate(http_requests_total[5m]))",
+    "avg(increase(http_requests_total[5m]))",
+    "min(sum_over_time(heap_usage0[3m]))",
+    "max by (instance) (avg_over_time(heap_usage0[3m]))",
+    "count by (job) (delta(http_requests_total[5m]))",
+])
+def test_sharded_parity_simple_aggregates(engines, q):
+    assert_three_way(*engines, q)
+
+
+def test_sharded_parity_topk(engines):
+    assert_three_way(*engines, "topk(3, rate(http_requests_total[5m]))")
+    assert_three_way(*engines, "bottomk(2, rate(http_requests_total[5m]))")
+
+
+def test_sharded_parity_quantile(engines):
+    assert_three_way(*engines, "quantile(0.9, rate(http_requests_total[5m]))")
+
+
+def test_sharded_parity_hist_sum(engines):
+    assert_three_way(
+        *engines, "sum by (le) (rate(http_request_latency_bucket[5m]))"
+    )
+
+
+def test_sharded_parity_histogram_quantile(engines):
+    assert_three_way(
+        *engines,
+        "histogram_quantile(0.99, "
+        "sum by (le) (rate(http_request_latency_bucket[5m])))",
+    )
+
+
+def test_sharded_plans_delegate(engines):
+    """Plan shapes: simple aggregates keep the MeshAggregateExec root whose
+    aggregate path delegates to the sharded FusedAggregateExec; the
+    epilogue ops and fused histogram_quantile plan straight to a
+    mesh-aware FusedAggregateExec."""
+    from filodb_tpu.parallel.exec import MeshAggregateExec
+    from filodb_tpu.query.exec.plans import FusedAggregateExec
+    from filodb_tpu.query.promql import query_range_to_logical_plan
+
+    _, sharded, _ = engines
+    plan = query_range_to_logical_plan(
+        "sum(rate(http_requests_total[5m]))", START, END, STEP)
+    ep = sharded.planner.materialize(plan)
+    assert isinstance(ep, MeshAggregateExec)
+    delegate = ep._sharded_fused()
+    assert isinstance(delegate, FusedAggregateExec)
+    assert delegate.mesh is not None and delegate.mesh.devices.size == 8
+
+    for q in (
+        "topk(3, rate(http_requests_total[5m]))",
+        "histogram_quantile(0.99, "
+        "sum by (le) (rate(http_request_latency_bucket[5m])))",
+    ):
+        ep = sharded.planner.materialize(
+            query_range_to_logical_plan(q, START, END, STEP))
+        assert isinstance(ep, FusedAggregateExec), q
+        assert ep.mesh is not None, q
+
+
+# -- awkward shapes ----------------------------------------------------------
+
+
+def test_sigma_s_not_divisible_by_mesh(store):
+    """13 real series over an 8-device mesh: the padded ΣS rounds up to a
+    mesh-divisible size and the trash-group masking keeps the pad rows
+    inert — parity must hold exactly as for friendly shapes."""
+    ms = TimeSeriesMemStore()
+    ms.setup(Dataset("odd"), list(range(3)))
+    ms.ingest_routed(
+        "odd", counter_batch(n_series=13, n_samples=200, start_ms=BASE),
+        spread=1,
+    )
+    single = QueryEngine(ms, "odd")
+    sharded = QueryEngine(ms, "odd", PlannerParams(mesh=make_mesh()))
+    ref = QueryEngine(ms, "odd", PlannerParams(fused_aggregate=False))
+    assert_three_way(single, sharded, ref,
+                     "sum by (instance) (rate(http_requests_total[5m]))")
+    assert_three_way(single, sharded, ref,
+                     "topk(20, rate(http_requests_total[5m]))")
+    sharded_entries = [
+        e for e in ms._superblock_cache.snapshot() if e["sharding"]
+    ]
+    assert sharded_entries
+    for e in sharded_entries:
+        assert e["shape"][0] % 8 == 0, e  # mesh-divisible padded ΣS
+
+
+def test_mesh_size_one_degenerate(store):
+    """A 1-device mesh runs the same shard_map program shape — the
+    degenerate case must behave exactly like the single-device fused
+    path."""
+    single = QueryEngine(store, "ds")
+    one = QueryEngine(
+        store, "ds", PlannerParams(mesh=make_series_mesh(jax.devices()[:1]))
+    )
+    ref = QueryEngine(store, "ds", PlannerParams(fused_aggregate=False))
+    assert_three_way(single, one, ref,
+                     "sum by (instance) (rate(http_requests_total[5m]))")
+    assert_three_way(single, one, ref,
+                     "quantile(0.5, rate(http_requests_total[5m]))")
+
+
+# -- O(1) dispatch on the mesh -----------------------------------------------
+
+
+def _dispatch_total() -> int:
+    from filodb_tpu.testkit import kernel_dispatch_total
+
+    return kernel_dispatch_total()
+
+
+def test_warm_sharded_query_is_single_dispatch(engines):
+    _, sharded, _ = engines
+    q = "sum(rate(http_requests_total[5m]))"
+    sharded.query_range(q, START, END, STEP)  # stage + compile + cache warm
+    before = _dispatch_total()
+    sharded.query_range(q, START, END, STEP)
+    assert _dispatch_total() - before == 1, (
+        "warm sharded sum(rate) must issue exactly ONE dispatch across the "
+        "8-device mesh"
+    )
+
+
+def test_warm_sharded_hist_quantile_is_single_dispatch(engines):
+    _, sharded, _ = engines
+    q = ("histogram_quantile(0.99, "
+         "sum by (le) (rate(http_request_latency_bucket[5m])))")
+    sharded.query_range(q, START, END, STEP)
+    before = _dispatch_total()
+    sharded.query_range(q, START, END, STEP)
+    assert _dispatch_total() - before == 1, (
+        "warm sharded histogram_quantile must issue exactly ONE dispatch"
+    )
+
+
+# -- sharding-aware accounting & maintenance ---------------------------------
+
+
+def test_superblock_cache_reports_sharding(engines, store):
+    _, sharded, _ = engines
+    sharded.query_range("sum(rate(http_requests_total[5m]))", START, END, STEP)
+    entries = store._superblock_cache.snapshot()
+    shard_entries = [e for e in entries if e["sharding"]]
+    assert shard_entries, entries
+    e = shard_entries[0]
+    assert "x 8 devices" in e["sharding"]
+    assert e["device_bytes"] and len(e["device_bytes"]) == 8
+    assert sum(e["device_bytes"].values()) == e["bytes"]
+
+
+def test_ledger_per_device_balances(engines, store):
+    from filodb_tpu.ledger import LEDGER
+
+    _, sharded, _ = engines
+    sharded.query_range("sum(rate(http_requests_total[5m]))", START, END, STEP)
+    dev = {k: v for k, v in LEDGER.device_balances().items()
+           if k[0] == "superblock"}
+    assert len(dev) == 8, dev
+    assert all(v > 0 for v in dev.values())
+    # the process ledger spans every live cache (other suites' stores may
+    # still be alive): it must cover at least THIS store's sharded entries
+    total = sum(
+        e["bytes"] for e in store._superblock_cache.snapshot() if e["sharding"]
+    )
+    assert sum(dev.values()) >= total > 0
+    LEDGER.publish()
+    from filodb_tpu.metrics import REGISTRY
+
+    out = REGISTRY.expose()
+    assert 'filodb_device_bytes{device="' in out
+
+
+def test_sharded_superblock_extends_under_live_ingest():
+    """Live-edge appends must EXTEND the sharded superblock in place
+    (placement preserved) and keep the warm query a single dispatch."""
+    from filodb_tpu.core.records import RecordBatch
+    from filodb_tpu.core.schemas import PROM_COUNTER
+    from filodb_tpu.metrics import REGISTRY
+
+    def maintenance(outcome):
+        for line in REGISTRY.expose().splitlines():
+            if line.startswith(
+                f'filodb_superblock_maintenance_total{{outcome="{outcome}"}}'
+            ):
+                return int(float(line.rsplit(" ", 1)[1]))
+        return 0
+
+    T = 300
+    ms = TimeSeriesMemStore()
+    ms.setup(Dataset("live"), list(range(4)))
+    ms.ingest_routed(
+        "live", counter_batch(n_series=16, n_samples=T, start_ms=BASE),
+        spread=2,
+    )
+    eng = QueryEngine(ms, "live", PlannerParams(mesh=make_mesh()))
+    ref = QueryEngine(ms, "live", PlannerParams(fused_aggregate=False))
+    end = (BASE + (T + 60) * 10_000) / 1000  # live edge
+    q = "sum(rate(http_requests_total[5m]))"
+    eng.query_range(q, START, end, STEP)
+    eng.query_range(q, START, end, STEP)
+    tags = [dict(p.tags) for sh in ms.shards("live")
+            for p in sh.partitions.values()]
+    t_new = BASE + T * 10_000
+    ms.ingest_routed("live", RecordBatch(
+        PROM_COUNTER, np.full(len(tags), t_new, np.int64),
+        {"count": np.full(len(tags), 1e12)}, tags,
+    ), spread=2)
+    ext_before = maintenance("extend")
+    before = _dispatch_total()
+    r1 = eng.query_range(q, START, end, STEP)
+    assert _dispatch_total() - before == 1
+    assert maintenance("extend") == ext_before + 1
+    r2 = ref.query_range(q, START, end, STEP)
+    a = r1.grids[0].values_np()[0]
+    c = r2.grids[0].values_np()[0]
+    assert (np.isnan(a) == np.isnan(c)).all()
+    m = ~np.isnan(c)
+    np.testing.assert_allclose(a[m], c[m], rtol=2e-5, atol=1e-6)
+    snap = ms._superblock_cache.snapshot()
+    assert snap and snap[0]["sharding"] is not None  # placement survived
+
+
+# -- fallback taxonomy -------------------------------------------------------
+
+
+def test_unsupported_function_falls_back_to_legacy_mesh(engines):
+    """A mesh-accepted function outside the fused set keeps the legacy
+    per-shard mesh kernels, tagged mesh_unsupported."""
+    from filodb_tpu.metrics import REGISTRY
+
+    def fallback_count():
+        for line in REGISTRY.expose().splitlines():
+            if line.startswith(
+                'filodb_fused_fallback_total{reason="mesh_unsupported"}'
+            ):
+                return int(float(line.rsplit(" ", 1)[1]))
+        return 0
+
+    single, sharded, _ = engines
+    # absent_over_time is mesh-legal (MXU mesh set) but not in FUSED_FUNCS
+    q = "sum(absent_over_time(no_such_metric[5m]))"
+    before = fallback_count()
+    sharded.query_range(q, START, END, STEP)
+    assert fallback_count() == before + 1
+
+
+def test_fused_disabled_keeps_legacy_mesh_quietly(store):
+    """PlannerParams(fused_aggregate=False) + mesh = the pre-fusion mesh
+    engine, with NO mesh_unsupported noise (explicit opt-out)."""
+    from filodb_tpu.metrics import REGISTRY
+
+    def fallback_count():
+        for line in REGISTRY.expose().splitlines():
+            if line.startswith(
+                'filodb_fused_fallback_total{reason="mesh_unsupported"}'
+            ):
+                return int(float(line.rsplit(" ", 1)[1]))
+        return 0
+
+    eng = QueryEngine(store, "ds", PlannerParams(
+        mesh=make_mesh(), fused_aggregate=False))
+    before = fallback_count()
+    r = eng.query_range("sum(rate(http_requests_total[5m]))", START, END, STEP)
+    assert r.grids and np.isfinite(r.grids[0].values_np()).any()
+    assert fallback_count() == before

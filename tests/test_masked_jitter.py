@@ -204,8 +204,16 @@ def test_mesh_engine_masked_matches_host():
             SeriesBatch(PROM_COUNTER, tags, ts[keep], {"count": vals[keep]})
         )
     host = QueryEngine(ms, "prometheus")
+    # the mesh engine's default aggregate path now DELEGATES to the
+    # sharded fused superblock program (doc/perf.md "Mesh-sharded fused
+    # path"); the masked MXU kernel is the LEGACY engine's fast path, so
+    # pin it via the explicit fused opt-out and check BOTH paths match
+    # the host on missing-scrape data
     mesh = QueryEngine(ms, "prometheus",
-                       PlannerParams(mesh=make_mesh(jax.devices()[:1])))
+                       PlannerParams(mesh=make_mesh(jax.devices()[:1]),
+                                     fused_aggregate=False))
+    fused_mesh = QueryEngine(ms, "prometheus",
+                             PlannerParams(mesh=make_mesh(jax.devices()[:1])))
     start, end = (BASE + 400_000) / 1000, (BASE + 1_400_000) / 1000
 
     ran = {"masked": 0}
@@ -221,11 +229,13 @@ def test_mesh_engine_masked_matches_host():
     try:
         rh = host.query_range("sum(rate(rq_total[5m]))", start, end, 60)
         rm = mesh.query_range("sum(rate(rq_total[5m]))", start, end, 60)
+        rf = fused_mesh.query_range("sum(rate(rq_total[5m]))", start, end, 60)
     finally:
         PE.MeshAggregateExec._run_masked = orig
-    assert ran["masked"] == 1, "mesh must take the masked fast path"
+    assert ran["masked"] == 1, "legacy mesh must take the masked fast path"
     vh = np.asarray(rh.grids[0].values_np())
-    vm = np.asarray(rm.grids[0].values_np())
-    np.testing.assert_array_equal(np.isnan(vh), np.isnan(vm))
-    ok = ~np.isnan(vh)
-    np.testing.assert_allclose(vm[ok], vh[ok], rtol=2e-3)
+    for rv in (rm, rf):
+        vm = np.asarray(rv.grids[0].values_np())
+        np.testing.assert_array_equal(np.isnan(vh), np.isnan(vm))
+        ok = ~np.isnan(vh)
+        np.testing.assert_allclose(vm[ok], vh[ok], rtol=2e-3)
