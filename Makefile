@@ -2,7 +2,7 @@
 
 NATIVE_DIR := filodb_tpu/native
 
-.PHONY: all native test test-chaos test-ingest-chaos test-multichip test-observability bench bench-smoke microbench serve clean tpu-watch tpu-watch-bg
+.PHONY: all native test test-chaos test-ingest-chaos test-multichip test-observability test-scheduler bench bench-smoke microbench serve clean tpu-watch tpu-watch-bg
 
 all: native
 
@@ -49,6 +49,14 @@ test-multichip: native
 	env JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 		python -m pytest tests/test_fused_mesh.py -q -m fused_mesh
 	env JAX_PLATFORMS=cpu python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+# query dispatch scheduler suite (doc/operations.md "Cross-query batching &
+# admission control"): batched-vs-sequential bit parity across the epilogue
+# families, the ONE-dispatch-per-coalesced-group assertion, tenant quota
+# shedding + fairness, 429/Retry-After surfaces, batching-off golden
+# equivalence
+test-scheduler: native
+	python -m pytest tests/ -q -m scheduler
 
 # observability suite (doc/observability.md): trace propagation + stitching,
 # slow-query log, resource ledger + self-scrape, metrics exposition — plus

@@ -36,7 +36,13 @@ METRIC = {
     "hist_quantile": "hist_quantile_range_query_p50",
     "ingest_impact": "ingest_impact_on_query",
     "fused_mesh": "fused_mesh_sharded_query_p50",
+    "concurrent_qps": "concurrent_qps_16clients_20k",
 }.get(WORKLOAD, "sum_rate_100k_series_range_query_p50")
+# concurrent_qps: client thread count, per-mode measurement window, and the
+# batching window handed to the batched engine (the knob under test)
+QPS_CLIENTS = int(os.environ.get("FILODB_BENCH_CLIENTS", 16))
+QPS_DURATION_S = float(os.environ.get("FILODB_BENCH_QPS_DURATION_S", 6.0))
+QPS_BATCH_WINDOW_MS = float(os.environ.get("FILODB_BENCH_BATCH_WINDOW_MS", 200.0))
 # fused_mesh: virtual mesh width on the CPU backend (real accelerators use
 # every visible device)
 MESH_DEVICES = int(os.environ.get("FILODB_BENCH_MESH_DEVICES", 8))
@@ -96,6 +102,9 @@ def build_memstore():
                 "_ws_": "demo",
                 "_ns_": "App-2",
                 "instance": f"host-{b0 + i}",
+                # medium-cardinality dimension for grouped dashboard panels
+                # (the concurrent_qps workload's by-variants)
+                "zone": f"z{(b0 + i) % 8}",
             }
             shard = shard_for(tags, spread=3, num_shards=N_SHARDS)
             row_ts = ts + dev[i] if JITTER > 0 else ts
@@ -461,9 +470,11 @@ def run_benchmark_ingest_impact():
 
     # the ingest stream: deterministic, pre-derived tags, values monotone
     # above every series' build-time maximum (no artificial resets)
+    # tag sets must match build_memstore EXACTLY (zone included): a differing
+    # set would mint NEW series instead of appending to the existing ones
     tags_list = [
         {METRIC_TAG: "http_requests_total", "_ws_": "demo", "_ns_": "App-2",
-         "instance": f"host-{i}"}
+         "instance": f"host-{i}", "zone": f"z{i % 8}"}
         for i in range(N_SERIES)
     ]
     stop = threading.Event()
@@ -620,9 +631,165 @@ def run_benchmark_fused_mesh():
     }))
 
 
+def run_benchmark_concurrent_qps():
+    """N client threads hammering ONE hot superblock with VARIED dashboard
+    queries (windows 2-5m x group-by variants over the same selector — the
+    shape the engine-level identical-query single-flight can NOT collapse),
+    cross-query batching on vs off. This is the workload the ROADMAP's
+    ~222 qps / flat-beyond-16-clients number describes; the dispatch
+    scheduler (query/scheduler.py) exists to move it.
+
+    value = batched-mode throughput (qps, HIGHER is better — the smoke
+    floor gates it via qps_floor_min); vs_baseline = batched/unbatched
+    throughput ratio; phases_ms carries both modes' p50/p99 per-query
+    latency and raw qps. match = per-variant batched results agree with
+    the unbatched engine (allclose; the batched engine's plans stage an
+    aligned superblock range, so counter-correction f32 rounding may
+    differ in ulps from the unbatched engine's narrower block)."""
+    import threading
+
+    ms, _ts = build_memstore()
+    from filodb_tpu.coordinator.planner import PlannerParams, QueryEngine
+
+    _enable_compile_cache()
+    batched = QueryEngine(
+        ms, "prometheus",
+        PlannerParams(batch_window_ms=QPS_BATCH_WINDOW_MS,
+                      batch_max=max(QPS_CLIENTS, 2)),
+    )
+    unbatched = QueryEngine(ms, "prometheus", PlannerParams())
+    # the 16 panels of one dashboard: same selector, varied group-bys (all
+    # landing in one pow2 group-count bucket so they coalesce) x varied
+    # windows — distinct PromQL strings, so the engine-level identical-query
+    # single-flight cannot collapse them; only cross-query batching can
+    bys = [" by (zone)", " by (zone,_ns_)", " by (zone,_ws_)",
+           " by (zone,_ns_,_ws_)"]
+    wins = ["5m", "4m", "3m", "2m"]
+    variants = [
+        f"sum{bys[i % len(bys)]} "
+        f"(rate(http_requests_total[{wins[(i // len(bys)) % len(wins)]}]))"
+        for i in range(QPS_CLIENTS)
+    ]
+
+    def rows(res):
+        return {
+            tuple(sorted(l.items())): np.asarray(v)
+            for g in res.grids for l, v in zip(g.labels, g.values_np())
+        }
+
+    # warmup + parity: every variant once per engine (stage + compile the
+    # per-variant programs), then one full-width concurrent batched round
+    # so the pow2-padded batched executable is compiled before timing
+    ok = True
+    for q in variants:
+        ru = rows(unbatched.query_range(q, START_S, END_S, STEP_S))
+        rb = rows(batched.query_range(q, START_S, END_S, STEP_S))
+        if ru.keys() != rb.keys():
+            ok = False
+            continue
+        for k in ru:
+            na, nb = np.isnan(ru[k]), np.isnan(rb[k])
+            if not (na == nb).all() or not np.allclose(
+                ru[k][~na], rb[k][~nb], rtol=5e-3
+            ):
+                ok = False
+
+    def measure(engine):
+        lat: list[list[float]] = [[] for _ in range(QPS_CLIENTS)]
+        start_gate = threading.Barrier(QPS_CLIENTS + 1)
+        stop_at = [0.0]
+
+        def client(i):
+            q = variants[i]
+            start_gate.wait()
+            while time.perf_counter() < stop_at[0]:
+                t0 = time.perf_counter()
+                res = engine.query_range(q, START_S, END_S, STEP_S)
+                # force materialization: latency must include the device
+                # work, not just the async enqueue
+                for g in res.grids:
+                    np.asarray(g.values_np())
+                lat[i].append(time.perf_counter() - t0)
+
+        threads = [
+            threading.Thread(target=client, args=(i,))
+            for i in range(QPS_CLIENTS)
+        ]
+        for t in threads:
+            t.start()
+        stop_at[0] = time.perf_counter() + QPS_DURATION_S
+        t_begin = time.perf_counter()
+        start_gate.wait()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - t_begin
+        flat = [x for l in lat for x in l]
+        if not flat:
+            return 0.0, 0.0, 0.0
+        return (
+            len(flat) / elapsed,
+            float(np.percentile(flat, 50) * 1e3),
+            float(np.percentile(flat, 99) * 1e3),
+        )
+
+    # pre-compile the pow2 batch widths the run will see (group sizes
+    # fluctuate as clients desync; a mid-measurement XLA compile would
+    # poison p99 and qps) by running fixed-width concurrent rounds, then
+    # one full free-running round
+    def width_round(n, offset=0):
+        gate = threading.Barrier(n)
+
+        def one(i):
+            gate.wait()
+            batched.query_range(variants[offset + i], START_S, END_S, STEP_S)
+
+        ths = [threading.Thread(target=one, args=(i,)) for i in range(n)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+
+    for n in (2, 3, 4):
+        width_round(min(n, QPS_CLIENTS))
+    pre = measure(batched)
+    sys.stderr.write(f"batched warm round: {pre[0]:.0f} qps\n")
+    un_qps, un_p50, un_p99 = measure(unbatched)
+    b_qps, b_p50, b_p99 = measure(batched)
+    import jax
+
+    backend = jax.devices()[0].platform
+    speedup = b_qps / un_qps if un_qps > 0 else 0.0
+    sys.stderr.write(
+        f"clients={QPS_CLIENTS} unbatched={un_qps:.0f}qps "
+        f"(p50={un_p50:.1f}ms p99={un_p99:.1f}ms) batched={b_qps:.0f}qps "
+        f"(p50={b_p50:.1f}ms p99={b_p99:.1f}ms) speedup={speedup:.2f}x "
+        f"match={ok}\n"
+    )
+    print(json.dumps({
+        "metric": METRIC,
+        "value": round(b_qps, 1),
+        "unit": "qps",
+        "vs_baseline": round(speedup, 3),
+        "backend": backend,
+        "series": N_SERIES,
+        "clients": QPS_CLIENTS,
+        "match": bool(ok and b_qps > 0),
+        "phases_ms": {
+            "batched_qps": round(b_qps, 1),
+            "unbatched_qps": round(un_qps, 1),
+            "batched_p50": round(b_p50, 2),
+            "batched_p99": round(b_p99, 2),
+            "unbatched_p50": round(un_p50, 2),
+            "unbatched_p99": round(un_p99, 2),
+        },
+    }))
+
+
 def run_benchmark():
     if WORKLOAD == "ingest_impact":
         return run_benchmark_ingest_impact()
+    if WORKLOAD == "concurrent_qps":
+        return run_benchmark_concurrent_qps()
     if WORKLOAD == "fused_mesh":
         return run_benchmark_fused_mesh()
     if WORKLOAD == "hist_quantile":

@@ -107,15 +107,28 @@ class _RemoteExecServicer:
                 parent = v
         return trace_id, parent
 
-    def _stream(self, run, stats_ext: bool = False):
+    def _stream(self, run, context=None, stats_ext: bool = False):
         """Run ``run()`` -> QueryResult and stream frames; errors go in-band
         as the final frame (clients re-raise typed)."""
+        import json as _json
+
         from ..coordinator.scheduler import QueryRejected
         from ..query.exec.transformers import QueryDeadlineExceeded, QueryError
         from ..query.promql import PromQLError
+        from ..query.scheduler import AdmissionRejected
 
         try:
             res = run()
+        except AdmissionRejected as e:
+            # admission shed: typed in-band frame (clients re-raise the
+            # local AdmissionRejected) + the HTTP Retry-After's gRPC
+            # equivalent riding trailing call metadata
+            if context is not None:
+                context.set_trailing_metadata(
+                    ((RETRY_AFTER_MD_KEY, f"{e.retry_after_s:.3f}"),)
+                )
+            yield error_frame("AdmissionRejected", _json.dumps(e.warning()))
+            return
         except QueryRejected as e:
             yield error_frame("QueryRejected", str(e))
             return
@@ -156,7 +169,8 @@ class _RemoteExecServicer:
                 trace_id=trace_id, parent_span_id=parent_span,
             )
 
-        yield from self._stream(run, stats_ext=self._stats_ext(context))
+        yield from self._stream(run, context=context,
+                                stats_ext=self._stats_ext(context))
 
     def ExecutePlan(self, request: "pb.ExecutePlanRequest", context):
         self._authorize(context)
@@ -173,7 +187,8 @@ class _RemoteExecServicer:
                                     trace_id=trace_id,
                                     parent_span_id=parent_span)
 
-        yield from self._stream(run, stats_ext=self._stats_ext(context))
+        yield from self._stream(run, context=context,
+                                stats_ext=self._stats_ext(context))
 
 
 def serve_grpc(engine, port: int = 0, auth_token: str | None = None,
@@ -251,6 +266,11 @@ PARENT_SPAN_MD_KEY = "x-filodb-parent-span"
 # the in-band StatsExt frame (kernel_ns + cache events); peers never send
 # the frame unsolicited so older origins keep working mid-rolling-deploy
 STATS_EXT_MD_KEY = "x-filodb-stats-ext"
+
+# admission-control shed: the peer's Retry-After (seconds) rides trailing
+# call metadata — the gRPC equivalent of the HTTP 429 Retry-After header
+# (the typed rejection itself travels in-band as an AdmissionRejected frame)
+RETRY_AFTER_MD_KEY = "x-filodb-retry-after"
 
 # transient codes; DEADLINE_EXCEEDED is excluded — the budget is already
 # burnt. Retry ownership: plan-scatter children (GrpcPlanRemoteExec) pass

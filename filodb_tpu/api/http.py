@@ -129,10 +129,12 @@ class PromApiHandler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # quiet by default
         pass
 
-    def _send(self, code: int, payload: dict):
+    def _send(self, code: int, payload: dict, headers: dict | None = None):
         body = json.dumps(payload).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         # transparent gzip for big results (remote execs request it)
         if (
             len(body) >= self.GZIP_MIN_BYTES
@@ -288,6 +290,8 @@ class PromApiHandler(BaseHTTPRequestHandler):
                 return self._send(200, J.success(SLOW_QUERY_LOG.entries()))
             if path == "/debug/resources":
                 return self._resources()
+            if path == "/debug/scheduler":
+                return self._scheduler()
             if path == "/debug/superblocks":
                 return self._superblocks()
             if path == "/debug/profile":
@@ -313,13 +317,28 @@ class PromApiHandler(BaseHTTPRequestHandler):
                 return self._send(200, J.success({}))
             self._send(404, J.error("not_found", f"unknown path {path}"))
         except (PromQLError, QueryError, ValueError, RemoteExecError) as e:
+            import math
+
             from ..coordinator.planners import RemoteFetchError
             from ..coordinator.scheduler import QueryRejected
             from ..query.exec.transformers import QueryDeadlineExceeded
             from ..query.faults import CircuitOpenError
+            from ..query.scheduler import AdmissionRejected
 
-            if isinstance(e, (QueryRejected, CircuitOpenError, RemoteFetchError,
-                              RemoteExecError)):
+            if isinstance(e, AdmissionRejected):
+                # admission control shed: 429 + Retry-After (the overload
+                # contract, distinct from 503 pool saturation — the client
+                # should back off for a KNOWN interval, not fail over) plus
+                # the structured warning in the error envelope
+                payload = J.error("throttled", str(e))
+                payload["warnings"] = [e.warning()]
+                self._send(429, payload, headers={
+                    "Retry-After": str(max(
+                        1, math.ceil(e.retry_after_s)
+                    )),
+                })
+            elif isinstance(e, (QueryRejected, CircuitOpenError, RemoteFetchError,
+                                RemoteExecError)):
                 # overload / open breaker / peer transport outage (either
                 # transport): availability conditions, not bad queries
                 # (Prometheus: 503)
@@ -512,6 +531,20 @@ class PromApiHandler(BaseHTTPRequestHandler):
             "kinds": verify["kinds"],
             "accounts": verify["accounts"],
             "tenants": tenant_query_snapshot(),
+        }))
+
+    def _scheduler(self):
+        """Query-dispatch-scheduler introspection (doc/observability.md):
+        the micro-batcher's queue depth / open batch windows / cumulative
+        batching outcomes, and the admission controller's per-tenant token
+        balances, in-flight counts and shed totals — alongside
+        /debug/resources like the rest of the debug surface."""
+        params = self.engine.planner.params
+        sched = getattr(params, "dispatch_scheduler", None)
+        adm = getattr(params, "admission", None)
+        return self._send(200, J.success({
+            "batch": sched.snapshot() if sched is not None else None,
+            "admission": adm.snapshot() if adm is not None else None,
         }))
 
     def _superblocks(self):

@@ -64,6 +64,25 @@ DEFAULTS: dict = {
         # null disables; log size is a ring buffer.
         "slow_query_threshold_s": 10.0,
         "slow_query_log_max": 64,
+        # cross-query micro-batching (query/scheduler.py): concurrent
+        # fused queries sharing a hot superblock + grid/epilogue signature
+        # collect for this window and launch as ONE batched kernel (vmap
+        # over per-query window/offset/q/group-by). 0 disables. Every
+        # fused query pays up to the window in added latency, so this is
+        # the high-QPS-serving knob: enable (1-5 ms) when concurrent
+        # dashboard fan-out dominates, keep 0 for latency-critical
+        # single-user setups. batch_max closes a group early.
+        "batch_window_ms": 0.0,
+        "batch_max": 32,
+        # per-tenant admission control (doc/operations.md): maps "ws/ns"
+        # (or "*" = default for every tenant, including "unknown") to
+        # {"rate": queries/s, "burst": bucket, "max_concurrent": n}.
+        # Over-quota queries shed with HTTP 429 + Retry-After (gRPC: typed
+        # in-band error + retry-after metadata). Empty = no tenant quotas.
+        "tenant_quotas": {},
+        # global bound on admitted-and-unfinished queries (0 = unbounded);
+        # past it every tenant sheds with 429 until in-flight drains
+        "admission_max_queued": 0,
     },
     # API
     "http_port": 9090,

@@ -304,6 +304,22 @@ class PlannerParams:
     # rendered trace tree + PromQL in the global slow-query log
     # (/debug/slow_queries). None disables.
     slow_query_threshold_s: float | None = 10.0
+    # cross-query micro-batching (query/scheduler.DispatchScheduler):
+    # concurrent fused queries sharing a hot superblock + grid/epilogue
+    # signature collect for batch_window_ms and launch as ONE batched
+    # kernel (vmap over per-query params). 0 disables — every dispatch
+    # runs exactly like the pre-scheduler path. A shared scheduler object
+    # may be passed explicitly (server: one per process, shared by the
+    # scattering + local engines); else the engine builds one when the
+    # window is positive.
+    batch_window_ms: float = 0.0
+    batch_max: int = 32
+    dispatch_scheduler: object | None = None
+    # per-tenant admission control (query/scheduler.AdmissionController):
+    # consulted BEFORE execution with the tenant resolved from the plan's
+    # selector filters (metering.tenant_of_plan); over-quota queries raise
+    # AdmissionRejected (HTTP 429 + Retry-After). None = no admission.
+    admission: object | None = None
 
 
 class SingleClusterPlanner:
@@ -709,8 +725,11 @@ class SingleClusterPlanner:
         else:
             def fallback():
                 return self._materialize_aggregate_tree(p)
+        raw_start, raw_end = self._fused_raw_range(
+            inner.raw.start_ms, inner.raw.end_ms
+        )
         return FusedAggregateExec(
-            shards, inner.raw.filters, inner.raw.start_ms, inner.raw.end_ms,
+            shards, inner.raw.filters, raw_start, raw_end,
             inner.raw.column, p.op, p.by, p.without, func,
             inner.start_ms, inner.end_ms, inner.step_ms or 1, window,
             inner.offset_ms,
@@ -721,6 +740,28 @@ class SingleClusterPlanner:
             hist_quantile=hist_quantile,
             mesh=mesh,
         )
+
+    # superblock staging-range alignment under cross-query batching: the
+    # coalescing key is the superblock itself, but two dashboard panels
+    # differing only in window (rate[3m] vs rate[5m]), offset, or the
+    # live-edge "end=now" instant derive different raw selector ranges and
+    # would stage two byte-near-identical superblocks that can never share
+    # a batched launch. Aligning the staged range (start floored, end
+    # ceiled) makes them resolve to ONE cached superblock — staging a
+    # superset is always safe because result windows derive from the query
+    # params (out_t/window), never from block bounds; the wider selection
+    # can at most add series whose samples miss every window (NaN rows =
+    # absence, same as the reference tree over the same range).
+    FUSED_ALIGN_MS = 300_000
+
+    def _fused_raw_range(self, start_ms: int, end_ms: int) -> tuple[int, int]:
+        """Quantize a fused exec's staging range when (and only when)
+        cross-query batching is enabled — with batching off, plans are
+        byte-identical to the pre-scheduler planner."""
+        if self.params.batch_window_ms <= 0:
+            return start_ms, end_ms
+        a = self.FUSED_ALIGN_MS
+        return start_ms - start_ms % a, end_ms + (-end_ms) % a
 
     def _materialize_aggregate_tree(self, p: L.Aggregate) -> ExecPlan:
         inner = self._materialize(p.inner)
@@ -969,9 +1010,12 @@ class SingleClusterPlanner:
         # counter-ness resolved at execution from schemas; assume cumulative
         # counter when the function is the counter family
         is_counter = inner.function in ("rate", "increase", "irate")
+        raw_start, raw_end = self._fused_raw_range(
+            inner.raw.start_ms, inner.raw.end_ms
+        )
         common = dict(
             mesh=mesh, shard_nums=shards, filters=inner.raw.filters,
-            raw_start_ms=inner.raw.start_ms, raw_end_ms=inner.raw.end_ms,
+            raw_start_ms=raw_start, raw_end_ms=raw_end,
             by=p.by, without=p.without, function=inner.function,
             start_ms=inner.start_ms, end_ms=inner.end_ms,
             step_ms=inner.step_ms, window_ms=inner.window_ms,
@@ -1026,6 +1070,13 @@ class QueryEngine:
         self.dataset = dataset
         self.planner = SingleClusterPlanner(memstore, dataset, params=params)
         self._single_flight = SingleFlight()
+        p = self.planner.params
+        if p.dispatch_scheduler is None and p.batch_window_ms > 0:
+            from ..query.scheduler import DispatchScheduler
+
+            p.dispatch_scheduler = DispatchScheduler(
+                p.batch_window_ms, p.batch_max
+            )
 
     def context(self, allow_partial_results: bool | None = None) -> QueryContext:
         params = self.planner.params
@@ -1039,6 +1090,7 @@ class QueryEngine:
         ctx.retry_policy = params.retry_policy
         ctx.breakers = params.breakers
         ctx.dispatcher = params.dispatcher
+        ctx.dispatch_scheduler = params.dispatch_scheduler
         return ctx
 
     def _start_trace(self, ctx, promql: str, trace_id: str | None = None,
@@ -1161,7 +1213,7 @@ class QueryEngine:
         here too would double-count every remote child's resources."""
         from ..metering import record_tenant_query, tenant_of_plan
 
-        ws, ns = tenant_of_plan(plan)
+        ws, ns = getattr(ctx, "_tenant", None) or tenant_of_plan(plan)
         root = getattr(ctx, "trace_root", None)
         if root is not None:
             root.tags["ws"] = ws
@@ -1190,7 +1242,8 @@ class QueryEngine:
         exec_plan = self.planner.materialize(plan)
         ctx = self.context(allow_partial_results)
         self._start_trace(ctx, promql, trace_id, parent_span_id)
-        res = self._run(exec_plan, ctx)
+        with self._admit(plan, ctx):
+            res = self._run(exec_plan, ctx)
         self._finish(res, ctx)
         if res.result_type == "matrix" or res.grids:
             res.result_type = "matrix"
@@ -1198,6 +1251,28 @@ class QueryEngine:
         self._meter_tenant(plan, ctx, elapsed_s)
         self._observe_slow(promql, elapsed_s, res)
         return res
+
+    def _admit(self, plan, ctx):
+        """Admission-control gate (query/scheduler.AdmissionController):
+        resolve the tenant from the plan's selector filters and claim its
+        concurrency/rate slots for the duration of execution. Raises
+        AdmissionRejected (HTTP 429 + Retry-After) when the tenant is over
+        quota or the global queue-depth bound is hit; a no-op context when
+        no controller is configured. The resolved tenant is stashed on the
+        context so _meter_tenant doesn't walk the plan's leaves a second
+        time per query. Coalesced identical-query followers never reach
+        this point (they share the leader's execution AND its admission
+        slot — sharing an answer costs the tenant nothing)."""
+        params = self.planner.params
+        if params.admission is None:
+            import contextlib
+
+            return contextlib.nullcontext()
+        from ..metering import tenant_of_plan
+
+        ws, ns = tenant_of_plan(plan)
+        ctx._tenant = (ws, ns)
+        return params.admission.admit(ws, ns)
 
     def _run(self, exec_plan, ctx):
         """Execute on the shared bounded scheduler when configured, else
@@ -1235,7 +1310,8 @@ class QueryEngine:
         except Exception:  # noqa: BLE001 — metadata plans have no PromQL form
             qname = type(plan).__name__
         self._start_trace(ctx, qname, trace_id, parent_span_id)
-        res = self._run(exec_plan, ctx)
+        with self._admit(plan, ctx):
+            res = self._run(exec_plan, ctx)
         self._finish(res, ctx)
         elapsed_s = _time.perf_counter() - t0
         self._meter_tenant(plan, ctx, elapsed_s)
@@ -1275,7 +1351,8 @@ class QueryEngine:
         exec_plan = self.planner.materialize(plan)
         ctx = self.context(allow_partial_results)
         self._start_trace(ctx, promql, trace_id, parent_span_id)
-        res = self._run(exec_plan, ctx)
+        with self._admit(plan, ctx):
+            res = self._run(exec_plan, ctx)
         self._finish(res, ctx)
         if res.result_type == "matrix":
             res.result_type = "vector"

@@ -104,6 +104,22 @@ def fetch_json(url: str, auth_token: str | None = None, local_only: bool = False
                 raise QueryError(f"remote request failed: {payload}")
             return payload if want_envelope else payload["data"]
         except urllib.error.HTTPError as e:
+            if e.code == 429:
+                # the peer's admission control shed this scatter leg: honor
+                # its Retry-After instead of retrying into the shed window,
+                # and surface the typed rejection so partial-results merges
+                # degrade it exactly like a faulted child (its
+                # endpoint_failure classification feeds the peer's breaker)
+                from ..query.scheduler import AdmissionRejected
+
+                try:
+                    retry_after = float(e.headers.get("Retry-After") or 1.0)
+                except (TypeError, ValueError):
+                    retry_after = 1.0
+                raise AdmissionRejected(
+                    f"remote peer shed request: HTTP 429 {e.reason}",
+                    retry_after_s=retry_after, outcome="shed_remote",
+                ) from e
             if e.code < 500:
                 raise QueryError(f"remote request failed: HTTP {e.code} {e.reason}") from e
             last_err = e  # 5xx: transient, retry

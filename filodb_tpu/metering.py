@@ -104,6 +104,20 @@ _tenant_pairs: set[tuple[str, str]] = set()
 _tenant_pairs_lock = threading.Lock()
 
 
+def bounded_tenant_pair(ws: str, ns: str) -> tuple[str, str]:
+    """Apply the :data:`MAX_TENANT_PAIRS` overflow-bucket cap: the pair
+    itself when it is already known or the cap has room, else
+    ``("overflow", "overflow")``. The ONE cardinality gate shared by the
+    tenant resource counters here and the admission-control counters/state
+    (query/scheduler.py) — both are driven by client-supplied labels."""
+    with _tenant_pairs_lock:
+        if (ws, ns) not in _tenant_pairs:
+            if len(_tenant_pairs) >= MAX_TENANT_PAIRS:
+                return "overflow", "overflow"
+            _tenant_pairs.add((ws, ns))
+    return ws, ns
+
+
 def record_tenant_query(ws: str, ns: str, query_seconds: float,
                         kernel_seconds: float, bytes_staged: int) -> None:
     """Accumulate one finished query into the per-tenant resource counters
@@ -116,11 +130,7 @@ def record_tenant_query(ws: str, ns: str, query_seconds: float,
 
     Cardinality is bounded: at most :data:`MAX_TENANT_PAIRS` distinct
     (ws, ns) label pairs; later pairs attribute to ``overflow``."""
-    with _tenant_pairs_lock:
-        if (ws, ns) not in _tenant_pairs:
-            if len(_tenant_pairs) >= MAX_TENANT_PAIRS:
-                ws = ns = "overflow"
-            _tenant_pairs.add((ws, ns))
+    ws, ns = bounded_tenant_pair(ws, ns)
     REGISTRY.counter("filodb_tenant_queries", ws=ws, ns=ns).inc()
     REGISTRY.counter("filodb_tenant_query_seconds", ws=ws, ns=ns).inc(
         float(query_seconds)

@@ -117,6 +117,10 @@ HELP_TEXTS: dict[str, str] = {
     "filodb_tenant_ts_total": "Total series per tenant (ws/ns).",
     "filodb_tenant_ts_active": "Actively ingesting series per tenant (ws/ns).",
     "filodb_tenant_queries": "Queries attributed to the tenant resolved from query filters.",
+    "filodb_admission": "Admission-control outcomes per tenant (admitted|shed_rate|shed_concurrency|shed_queue).",
+    "filodb_batch_queries": "Fused dispatches submitted to the cross-query batching scheduler, per epilogue family.",
+    "filodb_batch_dispatches": "Batching-scheduler group executions per family and outcome (batched|solo|fallback).",
+    "filodb_batch_queue_depth": "Fused dispatches currently collecting in open batch windows.",
     "filodb_tenant_query_seconds": "Wall-clock query seconds per tenant.",
     "filodb_tenant_kernel_seconds": "Device kernel-dispatch seconds per tenant.",
     "filodb_tenant_bytes_staged": "Bytes staged to device per tenant.",

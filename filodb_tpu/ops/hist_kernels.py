@@ -341,6 +341,141 @@ def _fused_hist_sharded_jit(mesh, func, ts, vals, lens, gids, les, qv,
     )(ts, vals, lens, gids)
 
 
+# -- cross-query batched twins (query/scheduler.py; see the batched-dispatch
+# -- contract in ops/aggregations.py: lanes UNROLL with the exact
+# -- single-query math, range grids computed once per unique window,
+# -- num_groups = the group's shared pow2 bucket) ---------------------------
+
+
+def _hist_epilogue(sjb, gids, les, qv, num_groups: int, quantile: bool):
+    """One lane's per-bucket segment-sum (+ optional quantile
+    interpolation) — the identical computation _fused_hist_jit runs."""
+    from .aggregations import _segment_aggregate_jit
+
+    S, J, B = sjb.shape
+    gjb = _segment_aggregate_jit(
+        "sum", sjb.reshape(S, J * B), gids, num_groups + 1
+    )[:num_groups].reshape(num_groups, J, B)
+    if quantile:
+        return histogram_quantile(qv, gjb, les)
+    return gjb
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "func", "u_map", "num_groups", "is_delta", "quantile"
+))
+def _batched_hist_shared_jit(func, vals, lo_u, hi_u, tf_u, tl_u, out_t_u,
+                             w_u, gids_q, les, qv_q, u_map: tuple,
+                             num_groups: int, is_delta: bool,
+                             quantile: bool):
+    sjb_u = [
+        _hist_range_shared(
+            func, vals, lo_u[u], hi_u[u], tf_u[u], tl_u[u], out_t_u[u],
+            w_u[u], is_delta
+        )
+        for u in range(max(u_map) + 1)
+    ]
+    return jnp.stack([
+        _hist_epilogue(sjb_u[u_map[i]], gids_q[i], les, qv_q[i],
+                       num_groups, quantile)
+        for i in range(len(u_map))
+    ])
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "func", "u_map", "num_steps", "num_groups", "is_delta", "quantile"
+))
+def _batched_hist_jit(func, ts, vals, lens, gids_q, les, qv_q, so_u, sm_u,
+                      w_u, u_map: tuple, num_steps: int, num_groups: int,
+                      is_delta: bool, quantile: bool):
+    sjb_u = [
+        hist_range_kernel(
+            func, ts, vals, lens, so_u[u], sm_u[u], w_u[u], num_steps,
+            is_delta=is_delta,
+        )
+        for u in range(max(u_map) + 1)
+    ]
+    return jnp.stack([
+        _hist_epilogue(sjb_u[u_map[i]], gids_q[i], les, qv_q[i],
+                       num_groups, quantile)
+        for i in range(len(u_map))
+    ])
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "mesh", "func", "u_map", "num_groups", "is_delta", "quantile"
+))
+def _batched_hist_shared_sharded_jit(mesh, func, vals, lo_u, hi_u, tf_u,
+                                     tl_u, out_t_u, w_u, gids_q, les, qv_q,
+                                     u_map: tuple, num_groups: int,
+                                     is_delta: bool, quantile: bool):
+    from jax.sharding import PartitionSpec as P
+
+    from ..jax_compat import shard_map
+
+    axis = mesh.axis_names[0]
+
+    def local(vals_l, gids_ql):
+        sjb_u = [
+            _hist_range_shared(
+                func, vals_l, lo_u[u], hi_u[u], tf_u[u], tl_u[u],
+                out_t_u[u], w_u[u], is_delta
+            )
+            for u in range(max(u_map) + 1)
+        ]
+        return jnp.stack([
+            _hist_sharded_combine(
+                sjb_u[u_map[i]], gids_ql[i], les, qv_q[i], num_groups,
+                quantile, axis
+            )
+            for i in range(len(u_map))
+        ])
+
+    return shard_map(
+        local, mesh=mesh,
+        in_specs=(P(axis, None, None), P(None, axis)),
+        out_specs=P(), check=False,
+    )(vals, gids_q)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "mesh", "func", "u_map", "num_steps", "num_groups", "is_delta",
+    "quantile"
+))
+def _batched_hist_sharded_jit(mesh, func, ts, vals, lens, gids_q, les, qv_q,
+                              so_u, sm_u, w_u, u_map: tuple,
+                              num_steps: int, num_groups: int,
+                              is_delta: bool, quantile: bool):
+    from jax.sharding import PartitionSpec as P
+
+    from ..jax_compat import shard_map
+
+    axis = mesh.axis_names[0]
+
+    def local(ts_l, vals_l, lens_l, gids_ql):
+        sjb_u = [
+            hist_range_kernel(
+                func, ts_l, vals_l, lens_l, so_u[u], sm_u[u], w_u[u],
+                num_steps, is_delta=is_delta,
+            )
+            for u in range(max(u_map) + 1)
+        ]
+        return jnp.stack([
+            _hist_sharded_combine(
+                sjb_u[u_map[i]], gids_ql[i], les, qv_q[i], num_groups,
+                quantile, axis
+            )
+            for i in range(len(u_map))
+        ])
+
+    return shard_map(
+        local, mesh=mesh,
+        in_specs=(P(axis, None), P(axis, None, None), P(axis),
+                  P(None, axis)),
+        out_specs=P(), check=False,
+    )(ts, vals, lens, gids_q)
+
+
 def run_hist_range_function(
     func: str, block: StagedBlock, params: RangeParams, is_delta: bool = False
 ):

@@ -53,6 +53,11 @@ class QueryContext:
     # back to it as parent when a thread has no active span — the scheduler
     # pool hop between the engine and the root plan node
     trace_root: Any = None
+    # cross-query micro-batching (query/scheduler.DispatchScheduler):
+    # FusedAggregateExec routes its kernel launch through it so concurrent
+    # queries sharing a superblock coalesce into ONE batched dispatch.
+    # None (or a disabled scheduler) = the plain unbatched launch.
+    dispatch_scheduler: Any = None
     _start_time: float = field(default_factory=time.monotonic)
 
     def check_deadline(self) -> None:
@@ -1700,9 +1705,31 @@ class FusedAggregateExec(ExecPlan):
             cache.put(sb_key, versions, value, nbytes)
         return value
 
+    def _mesh_desc(self) -> tuple | None:
+        """Hashable mesh identity for the batching coalescing key (mirrors
+        the superblock cache key's mesh descriptor)."""
+        if self.mesh is None:
+            return None
+        return (self.mesh.axis_names[0],
+                tuple(d.id for d in self.mesh.devices.flat))
+
+    def _dispatch_fused(self, ctx: QueryContext, request) -> Any:
+        """Route one fused kernel launch through the query dispatch
+        scheduler (query/scheduler.py) when the context carries an enabled
+        one — concurrent queries sharing this superblock + grid/epilogue
+        signature coalesce into ONE batched launch — else run the plain
+        unbatched dispatch. Disabled batching is byte-identical to the
+        pre-scheduler path."""
+        sched = getattr(ctx, "dispatch_scheduler", None)
+        if sched is not None and getattr(sched, "enabled", False):
+            request.timeout_s = ctx.remaining_deadline_s()
+            return sched.dispatch(request)
+        return request.run_single()
+
     def do_execute(self, ctx: QueryContext) -> QueryResult:
         from ...metrics import span
-        from ...ops.kernels import RangeParams
+        from ...ops.kernels import RangeParams, pad_steps
+        from ..scheduler import FusedRequest
 
         if getattr(ctx, "allow_partial_results", False):
             # the fused program is all-or-nothing; partial-results queries
@@ -1743,11 +1770,20 @@ class FusedAggregateExec(ExecPlan):
                 strip_metric=strip,
             )
             with span(f"fused:dispatch:hist_{func}"):
-                out = AGG.fused_hist_range_aggregate(
-                    func, got.block, gids_dev, G, params, got.les_dev,
-                    q=self.hist_quantile, is_delta=got.is_delta,
-                    mesh=self.mesh,
-                )
+                out = self._dispatch_fused(ctx, FusedRequest(
+                    block=got.block, func=func, kind="hist", epilogue=(),
+                    gids_dev=gids_dev, G=G,
+                    qv=float(self.hist_quantile or 0.0), params=params,
+                    j_pad=pad_steps(nsteps), is_counter=False,
+                    is_delta=got.is_delta, mesh=self.mesh,
+                    mesh_desc=self._mesh_desc(), les_dev=got.les_dev,
+                    hist_q=self.hist_quantile is not None,
+                    run_single=lambda: AGG.fused_hist_range_aggregate(
+                        func, got.block, gids_dev, G, params, got.les_dev,
+                        q=self.hist_quantile, is_delta=got.is_delta,
+                        mesh=self.mesh,
+                    ),
+                ))
             if self.hist_quantile is not None:
                 # quantile fused on device: [G, J] is all that comes back
                 labels = [_strip_metric(l) for l in group_labels]
@@ -1762,11 +1798,19 @@ class FusedAggregateExec(ExecPlan):
         if self.op in ("topk", "bottomk"):
             k = max(int(self.params[0]), 1)
             with span(f"fused:dispatch:{self.op}:{func}"):
-                vals_dev, idx_dev = AGG.fused_topk(
-                    func, got.block, k, self.op == "bottomk", params,
+                vals_dev, idx_dev = self._dispatch_fused(ctx, FusedRequest(
+                    block=got.block, func=func, kind="topk",
+                    epilogue=("topk", k, self.op == "bottomk"),
+                    gids_dev=AGG.zero_gids(got.block), G=1, qv=0.0,
+                    params=params, j_pad=pad_steps(nsteps),
                     is_counter=got.is_counter, is_delta=got.is_delta,
-                    mesh=self.mesh,
-                )
+                    mesh=self.mesh, mesh_desc=self._mesh_desc(),
+                    run_single=lambda: AGG.fused_topk(
+                        func, got.block, k, self.op == "bottomk", params,
+                        is_counter=got.is_counter, is_delta=got.is_delta,
+                        mesh=self.mesh,
+                    ),
+                ))
             return self._present_topk(
                 np.asarray(vals_dev)[:, :nsteps],
                 np.asarray(idx_dev)[:, :nsteps], got.labels, strip, nsteps,
@@ -1777,20 +1821,34 @@ class FusedAggregateExec(ExecPlan):
         if self.op == "quantile":
             q = float(self.params[0])
             with span(f"fused:dispatch:quantile:{func}"):
-                out = AGG.fused_quantile(
-                    func, got.block, gids_dev, G, q, params,
+                out = self._dispatch_fused(ctx, FusedRequest(
+                    block=got.block, func=func, kind="quantile",
+                    epilogue=("quantile",), gids_dev=gids_dev, G=G, qv=q,
+                    params=params, j_pad=pad_steps(nsteps),
                     is_counter=got.is_counter, is_delta=got.is_delta,
-                    mesh=self.mesh,
-                )
+                    mesh=self.mesh, mesh_desc=self._mesh_desc(),
+                    run_single=lambda: AGG.fused_quantile(
+                        func, got.block, gids_dev, G, q, params,
+                        is_counter=got.is_counter, is_delta=got.is_delta,
+                        mesh=self.mesh,
+                    ),
+                ))
             return QueryResult(grids=[
                 Grid(group_labels, self.start_ms, self.step_ms, nsteps, out)
             ])
         with span(f"fused:dispatch:{func}"):
-            out = AGG.fused_range_aggregate(
-                func, self.op, got.block, gids_dev, G, params,
+            out = self._dispatch_fused(ctx, FusedRequest(
+                block=got.block, func=func, kind="agg",
+                epilogue=("agg", self.op), gids_dev=gids_dev, G=G, qv=0.0,
+                params=params, j_pad=pad_steps(nsteps),
                 is_counter=got.is_counter, is_delta=got.is_delta,
-                mesh=self.mesh,
-            )
+                mesh=self.mesh, mesh_desc=self._mesh_desc(),
+                run_single=lambda: AGG.fused_range_aggregate(
+                    func, self.op, got.block, gids_dev, G, params,
+                    is_counter=got.is_counter, is_delta=got.is_delta,
+                    mesh=self.mesh,
+                ),
+            ))
         return QueryResult(
             grids=[Grid(group_labels, self.start_ms, self.step_ms, nsteps, out)]
         )

@@ -264,6 +264,23 @@ class RemoteExecError(RuntimeError):
 
 
 def _raise_remote_error(error_type: str, message: str):
+    if error_type == "AdmissionRejected":
+        # the peer's admission control shed this request: re-raise the
+        # typed local rejection (429-at-the-origin semantics; its
+        # endpoint_failure classification lets sustained shedding open the
+        # peer's breaker) with the peer's structured warning payload
+        from .scheduler import AdmissionRejected
+
+        try:
+            w = json.loads(message)
+        except ValueError:
+            w = {}
+        raise AdmissionRejected(
+            f"remote peer shed request: {w.get('error', message)}",
+            retry_after_s=float(w.get("retry_after_s", 1.0) or 1.0),
+            ws=str(w.get("ws", "unknown")), ns=str(w.get("ns", "unknown")),
+            outcome="shed_remote",
+        )
     if error_type == "QueryRejected":
         from ..coordinator.scheduler import QueryRejected
 

@@ -1,0 +1,613 @@
+"""Query dispatch scheduler: cross-query micro-batching + per-tenant
+admission control — the layer between the planner and the fused engine
+(ROADMAP "cross-query batching + admission control for high-QPS serving";
+Storyboard's workload-aware sharing of precomputed aggregate work across
+queries, Tailwind's explicit dispatch/admission layer in front of the
+accelerator — PAPERS.md).
+
+Two cooperating pieces:
+
+- :class:`DispatchScheduler` — a micro-batching dispatcher for
+  ``FusedAggregateExec`` kernel launches. Concurrent fused queries hitting
+  the SAME device-resident superblock with the same grid/epilogue signature
+  (the coalescing key) collect for a short window (config
+  ``query.batch_window_ms``) and launch as ONE batched kernel — jax.vmap
+  over the per-query dynamics (window length, offset, q, group-by variant)
+  on the existing fused programs (ops/aggregations.fused_batched_scalar /
+  fused_batched_hist) — then the stacked ``[Q, G, J]`` partials fan back
+  out to each waiting query. Identical dispatch specs dedup onto one lane
+  (the single-flight discipline of filodb_tpu/singleflight.py applied at
+  the lane level: N identical specs share one future, never N lanes), and
+  identical FULL queries never reach this layer at all — the engine-level
+  SingleFlight (coordinator.scheduler, ``coalesce_identical``) already
+  shares one execution among them. The first arrival for a key leads: it
+  holds the window open (bounded by ``max_batch``), executes, and
+  distributes; a batch-path failure falls back to per-lane unbatched
+  execution so batching is strictly an optimization, never a correctness
+  risk.
+
+- :class:`AdmissionController` — per-tenant token-bucket rate +
+  concurrency quotas (config ``query.tenant_quotas``, tenants resolved via
+  :func:`filodb_tpu.metering.tenant_of_plan`) and a global queue-depth
+  bound, consulted by the QueryEngine BEFORE execution. Over-quota queries
+  shed with :class:`AdmissionRejected`, which the HTTP edge maps to
+  429 + ``Retry-After`` (plus a structured warning in the error envelope)
+  and the gRPC edge to an in-band typed error frame + retry-after call
+  metadata. A shed REMOTE child carries ``endpoint_failure=True`` so
+  sustained shedding opens the origin's circuit breaker for that peer
+  (query/faults.py), and under ``allow_partial_results`` merge nodes
+  degrade it exactly like a faulted child — structured warning, survivors
+  served.
+
+Tenant label cardinality is bounded by the same ``MAX_TENANT_PAIRS``
+overflow-bucket cap the metering counters use
+(:func:`filodb_tpu.metering.bounded_tenant_pair`).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FutureTimeout
+from dataclasses import dataclass
+from typing import Any, Callable
+
+from ..metrics import REGISTRY
+from .exec.transformers import QueryDeadlineExceeded, QueryError
+
+# ---------------------------------------------------------------------------
+# admission control
+# ---------------------------------------------------------------------------
+
+
+class AdmissionRejected(QueryError):
+    """Query shed by admission control (over-quota tenant or a saturated
+    global queue). HTTP: 429 + ``Retry-After: <retry_after_s>``; gRPC: the
+    ``AdmissionRejected`` in-band error frame + ``x-filodb-retry-after``
+    metadata.
+
+    Peer-health classification (query/faults.py): NOT retryable within the
+    same dispatch (retrying before ``retry_after_s`` would defeat the
+    shed), but it IS endpoint-failure evidence — a peer shedding our
+    scatter legs is overloaded, and sustained shedding should open its
+    breaker so the origin backs off for the cooldown instead of hammering
+    it."""
+
+    retryable = False
+    endpoint_failure = True
+
+    def __init__(self, message: str, retry_after_s: float = 1.0,
+                 ws: str = "unknown", ns: str = "unknown",
+                 outcome: str = "shed_rate"):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
+        self.ws = ws
+        self.ns = ns
+        self.outcome = outcome
+
+    def warning(self) -> dict:
+        """The structured warning shape riding error envelopes and partial
+        results (mirrors faults.child_warning)."""
+        return {
+            "reason": "admission_rejected",
+            "outcome": self.outcome,
+            "ws": self.ws,
+            "ns": self.ns,
+            "retry_after_s": round(self.retry_after_s, 3),
+            "error": str(self),
+        }
+
+
+class TokenBucket:
+    """Classic token bucket with an injectable clock (deterministic
+    tests). ``rate`` tokens/second refill up to ``burst``; ``try_take``
+    returns 0.0 on success or the seconds until the next token accrues."""
+
+    def __init__(self, rate: float, burst: float,
+                 clock: Callable[[], float] = time.monotonic):
+        self.rate = float(rate)
+        self.burst = max(float(burst), 1.0)
+        self._clock = clock
+        self._tokens = self.burst
+        self._last = clock()
+        self._lock = threading.Lock()
+
+    def _refill(self, now: float) -> None:
+        if now > self._last:
+            self._tokens = min(
+                self.burst, self._tokens + (now - self._last) * self.rate
+            )
+            self._last = now
+
+    def try_take(self) -> float:
+        with self._lock:
+            now = self._clock()
+            self._refill(now)
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return 0.0
+            if self.rate <= 0:
+                return float("inf")
+            return (1.0 - self._tokens) / self.rate
+
+    def balance(self) -> float:
+        with self._lock:
+            self._refill(self._clock())
+            return self._tokens
+
+
+@dataclass(frozen=True)
+class TenantQuota:
+    """Per-tenant admission quota. ``rate`` <= 0 disables the token
+    bucket; ``max_concurrent`` <= 0 disables the concurrency cap."""
+
+    rate: float = 0.0  # queries/second refill
+    burst: float = 0.0  # bucket capacity; <= 0 defaults to max(rate, 1)
+    max_concurrent: int = 0
+
+    @classmethod
+    def from_config(cls, cfg: dict) -> "TenantQuota":
+        return cls(
+            rate=float(cfg.get("rate", 0.0) or 0.0),
+            burst=float(cfg.get("burst", 0.0) or 0.0),
+            max_concurrent=int(cfg.get("max_concurrent", 0) or 0),
+        )
+
+
+class _TenantState:
+    __slots__ = ("bucket", "quota", "in_flight", "shed")
+
+    def __init__(self, quota: TenantQuota | None, clock):
+        self.quota = quota
+        self.bucket = None
+        if quota is not None and quota.rate > 0:
+            self.bucket = TokenBucket(
+                quota.rate, quota.burst if quota.burst > 0
+                else max(quota.rate, 1.0), clock,
+            )
+        self.in_flight = 0
+        self.shed = 0
+
+
+class AdmissionController:
+    """Per-tenant token-bucket rate/concurrency quotas + a global
+    queue-depth bound, in front of query execution.
+
+    ``quotas`` maps ``"ws/ns"`` keys (or ``"*"`` for the default applied to
+    every tenant without an explicit entry — including ``unknown``) to
+    quota dicts ``{"rate", "burst", "max_concurrent"}``. ``max_queued``
+    bounds admitted-and-unfinished queries process-wide (0 = unbounded).
+    Shedding outcomes are counted in
+    ``filodb_admission_total{outcome,ws,ns}`` with the metering module's
+    overflow-bucket cardinality cap; per-tenant token balances and shed
+    counts are inspectable at ``GET /debug/scheduler``."""
+
+    def __init__(self, quotas: dict | None = None, max_queued: int = 0,
+                 clock: Callable[[], float] = time.monotonic,
+                 retry_after_default_s: float = 1.0):
+        self._quotas = {
+            k: (q if isinstance(q, TenantQuota) else TenantQuota.from_config(q))
+            for k, q in (quotas or {}).items()
+        }
+        self.max_queued = int(max_queued)
+        self._clock = clock
+        self.retry_after_default_s = float(retry_after_default_s)
+        self._states: dict[str, _TenantState] = {}
+        self._in_flight = 0
+        self._shed_total = 0
+        self._lock = threading.Lock()
+
+    def _quota_for(self, key: str) -> TenantQuota | None:
+        return self._quotas.get(key) or self._quotas.get("*")
+
+    def _state(self, key: str) -> _TenantState:
+        st = self._states.get(key)
+        if st is None:
+            st = self._states[key] = _TenantState(
+                self._quota_for(key), self._clock
+            )
+        return st
+
+    def _count(self, outcome: str, ws: str, ns: str) -> None:
+        REGISTRY.counter(
+            "filodb_admission", outcome=outcome, ws=ws, ns=ns
+        ).inc()
+
+    def admit(self, ws: str, ns: str):
+        """Admit or shed one query for tenant (ws, ns). Returns a context
+        manager holding the tenant + global concurrency slots; raises
+        :class:`AdmissionRejected` with a computed ``Retry-After`` when the
+        query must shed."""
+        from ..metering import bounded_tenant_pair
+
+        ws, ns = bounded_tenant_pair(ws, ns)
+        key = f"{ws}/{ns}"
+        with self._lock:
+            st = self._state(key)
+            quota = st.quota
+            if (quota is not None and quota.max_concurrent > 0
+                    and st.in_flight >= quota.max_concurrent):
+                st.shed += 1
+                self._shed_total += 1
+                self._count("shed_concurrency", ws, ns)
+                raise AdmissionRejected(
+                    f"tenant {key} at max_concurrent="
+                    f"{quota.max_concurrent}",
+                    retry_after_s=self.retry_after_default_s,
+                    ws=ws, ns=ns, outcome="shed_concurrency",
+                )
+            if self.max_queued > 0 and self._in_flight >= self.max_queued:
+                st.shed += 1
+                self._shed_total += 1
+                self._count("shed_queue", ws, ns)
+                raise AdmissionRejected(
+                    f"query queue depth {self._in_flight} at bound "
+                    f"{self.max_queued}",
+                    retry_after_s=self.retry_after_default_s,
+                    ws=ws, ns=ns, outcome="shed_queue",
+                )
+            if st.bucket is not None:
+                wait_s = st.bucket.try_take()
+                if wait_s > 0:
+                    st.shed += 1
+                    self._shed_total += 1
+                    self._count("shed_rate", ws, ns)
+                    raise AdmissionRejected(
+                        f"tenant {key} over rate quota "
+                        f"({st.quota.rate:g}/s)",
+                        retry_after_s=min(
+                            wait_s, 60.0
+                        ) if wait_s != float("inf")
+                        else self.retry_after_default_s,
+                        ws=ws, ns=ns, outcome="shed_rate",
+                    )
+            st.in_flight += 1
+            self._in_flight += 1
+        self._count("admitted", ws, ns)
+        return _Admitted(self, key)
+
+    def _release(self, key: str) -> None:
+        with self._lock:
+            st = self._states.get(key)
+            if st is not None and st.in_flight > 0:
+                st.in_flight -= 1
+            self._in_flight = max(0, self._in_flight - 1)
+
+    def snapshot(self) -> dict:
+        """The /debug/scheduler rendering: global depth + per-tenant token
+        balances, in-flight counts and shed totals."""
+        with self._lock:
+            tenants = {
+                key: {
+                    "in_flight": st.in_flight,
+                    "shed": st.shed,
+                    "tokens": (round(st.bucket.balance(), 3)
+                               if st.bucket is not None else None),
+                    "rate": st.quota.rate if st.quota else None,
+                    "max_concurrent": (st.quota.max_concurrent
+                                       if st.quota else None),
+                }
+                for key, st in self._states.items()
+            }
+            return {
+                "in_flight": self._in_flight,
+                "max_queued": self.max_queued,
+                "shed_total": self._shed_total,
+                "tenants": tenants,
+            }
+
+
+class _Admitted:
+    """Held concurrency slot; releases on exit (success or failure)."""
+
+    __slots__ = ("_ctl", "_key")
+
+    def __init__(self, ctl: AdmissionController, key: str):
+        self._ctl = ctl
+        self._key = key
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self._ctl._release(self._key)
+        return False
+
+
+# ---------------------------------------------------------------------------
+# micro-batching dispatch
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class FusedRequest:
+    """One fused-kernel dispatch wish: everything the batched program needs
+    from this query, plus the unbatched fallback. Built by
+    ``FusedAggregateExec`` AFTER superblock resolution and group-id
+    memoization, so batching composes with (and never bypasses) limits,
+    stats accounting and cache maintenance — only the kernel launch itself
+    is shared."""
+
+    block: Any  # the (super)block object — group identity AND data source
+    func: str
+    kind: str  # "agg" | "topk" | "quantile" | "hist"
+    epilogue: tuple  # scalar static epilogue; () for hist
+    gids_dev: Any  # [S_pad] device group ids (trash group = G)
+    G: int  # this lane's real group count
+    qv: float  # quantile q / hist_quantile q; 0.0 otherwise
+    params: Any  # RangeParams (start/step/window are the vmapped dynamics)
+    j_pad: int
+    is_counter: bool
+    is_delta: bool
+    mesh: Any = None
+    mesh_desc: tuple | None = None
+    les_dev: Any = None  # hist bucket bounds (device)
+    hist_q: bool = False  # hist lane wants the quantile epilogue
+    run_single: Callable[[], Any] = None
+    timeout_s: float = 60.0
+
+    def family(self) -> str:
+        return self.kind
+
+    def g_bucket(self) -> int:
+        """Power-of-two bucket of this lane's group count. Part of the
+        coalescing key: the batched program's static group axis is the
+        group MAX, so one high-cardinality ``by (instance)`` lane would
+        poison every cheap ``sum()`` lane in its group with a [G_max, J]
+        output — bucketing keeps heavy and light group-bys in separate
+        batches (and gives the compiler a handful of stable group widths
+        instead of one per distinct G). The SAME rounding the batched
+        kernels apply to their lane/window axes — one definition, or keys
+        and kernel widths drift."""
+        from ..ops.aggregations import _pow2
+
+        return _pow2(self.G)
+
+    def group_key(self) -> tuple:
+        """Coalescing key: block identity + grid signature + epilogue
+        family statics. Lanes in one group share the block OBJECT (verified
+        again at execute time — ``id`` alone could alias across GC), the
+        grid triple, the kernel variant selectors and the epilogue's static
+        shape; per-query q and group-by variant ride the batch axis.
+
+        The grid triple (start/step/window) is deliberately IN the key:
+        the batched programs support mixed windows per launch (the u_map
+        machinery in ops/aggregations), but live group compositions
+        fluctuate with load, and every distinct lane->window pattern is a
+        distinct XLA executable — pinning one window per group collapses
+        the static composition space to a handful of pow2 widths, which is
+        what keeps steady-state serving out of the compiler. Queries with
+        near-miss windows still share everything that matters — the staged
+        superblock (range alignment, planner._fused_raw_range) and each
+        other's group-by epilogues within their window's group."""
+        p = self.params
+        return (
+            id(self.block), self.func, self.kind, self.epilogue, self.j_pad,
+            p.start_ms, p.step_ms, p.window_ms,
+            self.g_bucket(), self.is_counter, self.is_delta, self.hist_q,
+            self.mesh_desc,
+        )
+
+    def lane_key(self) -> tuple:
+        """Dedup key WITHIN a group: requests agreeing on every per-query
+        dynamic share one lane (and one kernel output slice) — the
+        single-flight discipline at lane granularity."""
+        p = self.params
+        return (p.start_ms, p.step_ms, p.num_steps, p.window_ms,
+                float(self.qv), id(self.gids_dev), self.G)
+
+    def take(self, stacked, i: int):
+        """Lane ``i``'s view of the stacked batch output, shaped exactly
+        like ``run_single``'s return."""
+        if self.kind == "topk":
+            return stacked[0][i], stacked[1][i]
+        return stacked[i][: self.G]
+
+
+def _run_batch(requests: list[FusedRequest]) -> list:
+    """ONE batched kernel launch for the whole group; returns per-request
+    outputs in run_single's shape."""
+    from ..ops import aggregations as AGG
+
+    r0 = requests[0]
+    for r in requests[1:]:
+        if r.block is not r0.block:
+            # id-reuse alias after GC, or a superblock swap mid-window:
+            # batching different blocks would serve wrong data — bail to
+            # the per-lane fallback
+            raise RuntimeError("batch group spans distinct blocks")
+    # canonical lane order: a recurring batch composition must build ONE
+    # stacked-input memo entry (ops/aggregations._batched_stacks) no matter
+    # which query happened to arrive first this round
+    order = sorted(range(len(requests)),
+                   key=lambda i: requests[i].lane_key())
+    # static group axis = the group's (shared) pow2 bucket, not the exact
+    # max: stable compile widths; lanes slice their own [:G_i]
+    g_max = max(r.g_bucket() for r in requests)
+    lanes = [(requests[i].gids_dev, requests[i].qv, requests[i].params)
+             for i in order]
+    if r0.kind == "hist":
+        out = AGG.fused_batched_hist(
+            r0.func, r0.block, lanes, g_max, r0.j_pad, r0.les_dev,
+            r0.hist_q, r0.is_delta, mesh=r0.mesh,
+        )
+    else:
+        out = AGG.fused_batched_scalar(
+            r0.func, r0.epilogue, r0.block, lanes, g_max, r0.j_pad,
+            r0.is_counter, r0.is_delta, mesh=r0.mesh,
+        )
+    results: list = [None] * len(requests)
+    for pos, i in enumerate(order):
+        results[i] = requests[i].take(out, pos)
+    return results
+
+
+class _Group:
+    # a group is "sealed" exactly when it is no longer in the scheduler's
+    # _open table (removed under the lock) — joins and seal can never race
+    __slots__ = ("lanes", "closed", "last_join")
+
+    def __init__(self):
+        self.lanes: dict[tuple, tuple[FusedRequest, Future]] = {}
+        self.closed = threading.Event()
+        self.last_join = time.monotonic()
+
+
+class DispatchScheduler:
+    """Micro-batching dispatcher (see module docstring).
+
+    ``window_ms`` is the collection window the group leader holds open
+    (0 = batching disabled: every dispatch runs unbatched, byte-identical
+    to the pre-scheduler behavior). ``max_batch`` closes a group early.
+    ``waiter`` is injectable for deterministic tests: it receives the
+    group's close event and the window seconds and returns when the window
+    ends (default: ``event.wait(window_s)``)."""
+
+    def __init__(self, window_ms: float = 0.0, max_batch: int = 32,
+                 waiter: Callable[[threading.Event, float], Any] | None = None):
+        self.window_s = max(float(window_ms), 0.0) / 1e3
+        self.max_batch = max(int(max_batch), 1)
+        self._waiter = waiter
+        self._open: dict[tuple, _Group] = {}
+        self._lock = threading.Lock()
+        self._queued = 0
+        # cumulative introspection counters (/debug/scheduler); the
+        # Prometheus families are the operator-facing copies
+        self.stats = {
+            "queries": 0, "batched": 0, "solo": 0, "fallback": 0,
+            "coalesced": 0, "dispatches": 0,
+        }
+
+    @property
+    def enabled(self) -> bool:
+        return self.window_s > 0
+
+    def dispatch(self, request: FusedRequest):
+        """Submit one fused dispatch; returns its kernel output (leader
+        executes for the whole group, followers share)."""
+        if not self.enabled:
+            return request.run_single()
+        fam = request.family()
+        key = request.group_key()
+        lane = request.lane_key()
+        with self._lock:
+            self.stats["queries"] += 1
+            group = self._open.get(key)
+            leader = group is None
+            if leader:
+                group = _Group()
+                self._open[key] = group
+            have = group.lanes.get(lane)
+            group.last_join = time.monotonic()
+            if have is None:
+                fut = Future()
+                group.lanes[lane] = (request, fut)
+                self._queued += 1
+            else:
+                fut = have[1]
+                self.stats["coalesced"] += 1
+            if len(group.lanes) >= self.max_batch:
+                group.closed.set()
+        REGISTRY.counter("filodb_batch_queries", family=fam).inc()
+        REGISTRY.gauge("filodb_batch_queue_depth").set(float(self._queued))
+        from ..metrics import current_span
+
+        sp = current_span()
+        if sp is not None:
+            sp.tags["batch_role"] = "leader" if leader else "follower"
+        if leader:
+            if self._waiter is not None:
+                self._waiter(group.closed, self.window_s)
+            else:
+                self._collect(group)
+            with self._lock:
+                if self._open.get(key) is group:
+                    del self._open[key]
+                lanes = list(group.lanes.values())
+                self._queued -= len(lanes)
+            REGISTRY.gauge("filodb_batch_queue_depth").set(
+                float(self._queued)
+            )
+            self._execute(fam, lanes)
+        try:
+            return fut.result(timeout=max(request.timeout_s, 0.001))
+        except FutureTimeout:
+            raise QueryDeadlineExceeded(
+                f"query exceeded deadline: {request.timeout_s:.1f}s waiting "
+                "on batched dispatch"
+            ) from None
+
+    def _collect(self, group: _Group) -> None:
+        """Leader-side collection: hold the window open until it elapses,
+        the group hits max_batch (closed event), or joins go QUIET — no new
+        lane for a quarter-window. The quiescence close is what keeps the
+        window from being a flat latency tax: after a shared batch
+        completes, its clients resubmit within milliseconds of each other,
+        so the next round's group fills almost at once and dispatches
+        immediately instead of idling out the rest of the window; a
+        sporadic lone query likewise waits only the gap, not the window."""
+        deadline = time.monotonic() + self.window_s
+        gap = self.window_s / 4
+        while True:
+            now = time.monotonic()
+            if group.closed.is_set() or now >= deadline:
+                return
+            idle = now - group.last_join
+            if idle >= gap:
+                return
+            group.closed.wait(min(deadline - now, gap - idle))
+
+    def _execute(self, fam: str, lanes: list) -> None:
+        """Leader-side group execution: one batched launch for Q>1 lanes,
+        the plain unbatched dispatch for a solo group, per-lane unbatched
+        fallback if the batched path fails."""
+        if len(lanes) == 1:
+            # solo group: the plain unbatched dispatch, errors propagated
+            # as-is (re-running a deterministic failure would double the
+            # device work exactly when the device is least healthy)
+            outcome = "solo"
+            req, fut = lanes[0]
+            try:
+                fut.set_result(req.run_single())
+            except Exception as e:  # noqa: BLE001 — delivered to the caller
+                fut.set_exception(e)
+        else:
+            outcome = "batched"
+            results = None
+            try:
+                results = _run_batch([req for req, _ in lanes])
+            except QueryError as e:
+                # typed query errors (limits) are real answers — propagate
+                for _, fut in lanes:
+                    fut.set_exception(e)
+                return
+            except Exception:  # noqa: BLE001 — batching must not lose queries
+                outcome = "fallback"
+            if results is None:
+                for req, fut in lanes:
+                    try:
+                        fut.set_result(req.run_single())
+                    except Exception as e:  # noqa: BLE001
+                        fut.set_exception(e)
+            else:
+                for (_, fut), res in zip(lanes, results):
+                    fut.set_result(res)
+        with self._lock:
+            self.stats[outcome] += 1
+            self.stats["dispatches"] += 1
+        REGISTRY.counter(
+            "filodb_batch_dispatches", family=fam, outcome=outcome
+        ).inc()
+
+    def snapshot(self) -> dict:
+        """The /debug/scheduler rendering: window config, live queue state
+        and cumulative batching outcomes."""
+        with self._lock:
+            return {
+                "window_ms": self.window_s * 1e3,
+                "max_batch": self.max_batch,
+                "open_groups": len(self._open),
+                "queued_lanes": self._queued,
+                **{k: v for k, v in self.stats.items()},
+            }

@@ -169,6 +169,27 @@ class FiloServer:
         from .metrics import SLOW_QUERY_LOG
 
         SLOW_QUERY_LOG.configure(int(qcfg.get("slow_query_log_max", 64) or 64))
+        # query dispatch scheduler (query/scheduler.py): ONE process-wide
+        # micro-batcher + admission controller shared by every engine
+        # (scattering, local and _system) so concurrent queries coalesce
+        # and tenant quotas act process-wide, whichever engine serves them
+        self.dispatch_scheduler = None
+        batch_window_ms = float(qcfg.get("batch_window_ms", 0) or 0)
+        if batch_window_ms > 0:
+            from .query.scheduler import DispatchScheduler
+
+            self.dispatch_scheduler = DispatchScheduler(
+                batch_window_ms, int(qcfg.get("batch_max", 32) or 32)
+            )
+        self.admission = None
+        quotas = qcfg.get("tenant_quotas") or {}
+        admission_max_queued = int(qcfg.get("admission_max_queued", 0) or 0)
+        if quotas or admission_max_queued:
+            from .query.scheduler import AdmissionController
+
+            self.admission = AdmissionController(
+                quotas, max_queued=admission_max_queued
+            )
         common = dict(
             spread=self.spread,
             lookback_ms=int(qcfg["lookback_ms"]),
@@ -182,6 +203,9 @@ class FiloServer:
             retry_policy=self.retry_policy,
             breakers=self.breakers,
             slow_query_threshold_s=float(slow_thr) if slow_thr is not None else None,
+            batch_window_ms=batch_window_ms,
+            dispatch_scheduler=self.dispatch_scheduler,
+            admission=self.admission,
         )
         self.engine = QueryEngine(
             self.memstore, self.dataset,

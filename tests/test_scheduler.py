@@ -1,0 +1,666 @@
+"""Query dispatch scheduler (query/scheduler.py; doc/operations.md).
+
+Cross-query micro-batching: concurrent fused queries sharing a hot
+superblock + grid/epilogue signature must launch as ONE batched kernel,
+with each lane's result BIT-EQUAL to its own unbatched execution — the
+batched programs unroll the exact single-query math (range grids computed
+once per unique window), so equality is structural and asserted exactly,
+never within tolerance. Batching disabled must be byte-identical to the
+pre-scheduler engine (plan shapes included).
+
+Admission control: per-tenant token-bucket rate/concurrency quotas and the
+global queue-depth bound shed with AdmissionRejected -> HTTP 429 +
+Retry-After + a structured warning; in-quota tenants complete while an
+over-quota one sheds (fairness soak), and a shed REMOTE child degrades
+exactly like a faulted one under allow_partial_results.
+
+All batching tests drive the window with a test-controlled waiter + the
+scheduler's queue-depth snapshot (no sleeps for correctness), and
+admission tests use a fake clock — deterministic by construction.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from filodb_tpu.coordinator.planner import PlannerParams, QueryEngine
+from filodb_tpu.core.schemas import Dataset
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.query.scheduler import (
+    AdmissionController,
+    AdmissionRejected,
+    DispatchScheduler,
+    TokenBucket,
+)
+from filodb_tpu.testkit import (
+    counter_batch,
+    histogram_batch,
+    kernel_dispatch_total,
+    machine_metrics,
+)
+
+pytestmark = pytest.mark.scheduler
+
+BASE = 1_600_000_000_000
+N_SHARDS = 8
+START = (BASE + 600_000) / 1000
+END = START + 900
+STEP = 60
+
+
+@pytest.fixture(scope="module")
+def store():
+    ms = TimeSeriesMemStore()
+    ms.setup(Dataset("ds"), list(range(N_SHARDS)))
+    ms.ingest_routed(
+        "ds", counter_batch(n_series=48, n_samples=240, start_ms=BASE),
+        spread=3,
+    )
+    ms.ingest_routed(
+        "ds", machine_metrics(n_series=48, n_samples=240, start_ms=BASE),
+        spread=3,
+    )
+    ms.ingest_routed(
+        "ds",
+        histogram_batch(n_series=24, n_samples=240, start_ms=BASE,
+                        metric="http_request_latency"),
+        spread=3,
+    )
+    return ms
+
+
+@pytest.fixture()
+def engines(store):
+    """(batched, sequential-twin, plain). The sequential twin shares the
+    batched engine's params (same range-aligned plans) but a DISABLED
+    scheduler, so batched-vs-sequential comparisons isolate exactly the
+    batching of the kernel launch; plain is the fully default engine."""
+    sched = DispatchScheduler(window_ms=100, max_batch=32)
+    batched = QueryEngine(store, "ds", PlannerParams(
+        batch_window_ms=100, dispatch_scheduler=sched))
+    seq = QueryEngine(store, "ds", PlannerParams(
+        batch_window_ms=100, dispatch_scheduler=DispatchScheduler(0)))
+    plain = QueryEngine(store, "ds", PlannerParams())
+    return batched, sched, seq, plain
+
+
+def _rows(res):
+    out = {}
+    for g in res.grids:
+        for lbls, vals in zip(g.labels, g.values_np()):
+            out[tuple(sorted(lbls.items()))] = np.asarray(vals)
+    return out
+
+
+def _run_coalesced(engine, sched, queries, expected_lanes=None):
+    """Run ``queries`` concurrently with the batch window held open until
+    every query has submitted (and every expected lane joined), then
+    release — deterministic group composition regardless of thread
+    scheduling."""
+    hold = threading.Event()
+    sched._waiter = lambda ev, s: hold.wait(30)
+    q0 = sched.stats["queries"]
+    results: dict = {}
+    errors: dict = {}
+
+    def worker(q):
+        try:
+            results[q] = engine.query_range(q, START, END, STEP)
+        except Exception as e:  # noqa: BLE001 — surfaced to the test
+            errors[q] = e
+
+    threads = [threading.Thread(target=worker, args=(q,)) for q in queries]
+    for t in threads:
+        t.start()
+    want = expected_lanes if expected_lanes is not None else len(queries)
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        snap = sched.snapshot()
+        if (snap["queries"] - q0 >= len(queries)
+                and snap["queued_lanes"] >= want):
+            break
+        time.sleep(0.002)
+    hold.set()
+    for t in threads:
+        t.join(60)
+    sched._waiter = None  # restore the production waiter
+    assert not errors, errors
+    return results
+
+
+def assert_bit_equal(res_a, res_b, ctx=""):
+    a, b = _rows(res_a), _rows(res_b)
+    assert a.keys() == b.keys(), (ctx, sorted(a)[:3], sorted(b)[:3])
+    for k in a:
+        assert np.array_equal(a[k], b[k], equal_nan=True), (ctx, k)
+
+
+# ---------------------------------------------------------------------------
+# batched-vs-sequential parity across the epilogue families
+# ---------------------------------------------------------------------------
+
+
+# Per family: group-by variants sharing one window (and one pow2
+# group-count bucket) genuinely COALESCE into one batched launch;
+# cross-window / cross-bucket entries ride along to cover the solo path of
+# the same scheduler round.
+FAMILY_QUERIES = {
+    "agg_sum": [
+        "sum(rate(http_requests_total[5m]))",
+        "sum by (_ws_) (rate(http_requests_total[5m]))",
+        "sum by (job) (rate(http_requests_total[5m]))",
+        "sum(rate(http_requests_total[4m]))",
+        "sum(rate(http_requests_total[5m] offset 1m))",
+    ],
+    "agg_grouped": [
+        "sum by (instance) (rate(http_requests_total[5m]))",
+        "sum by (instance,job) (rate(http_requests_total[5m]))",
+    ],
+    "agg_minmax": [
+        "max by (instance) (avg_over_time(heap_usage0[5m]))",
+        "max by (instance,job) (avg_over_time(heap_usage0[5m]))",
+        "min(avg_over_time(heap_usage0[5m]))",
+    ],
+    "agg_stddev": [
+        "stddev(rate(http_requests_total[5m]))",
+        "stddev by (_ns_) (rate(http_requests_total[5m]))",
+    ],
+    "topk": [
+        "topk(3, rate(http_requests_total[5m]))",
+        "topk(3, rate(http_requests_total[4m]))",
+        "bottomk(2, rate(http_requests_total[5m]))",
+    ],
+    "quantile": [
+        "quantile(0.9, rate(http_requests_total[5m]))",
+        "quantile(0.5, rate(http_requests_total[5m]))",
+        "quantile(0.99, rate(http_requests_total[5m]))",
+    ],
+    "hist": [
+        "sum by (le) (rate(http_request_latency_bucket[5m]))",
+        "sum by (le,_ws_) (rate(http_request_latency_bucket[5m]))",
+    ],
+    "hist_quantile": [
+        "histogram_quantile(0.99, sum by (le) "
+        "(rate(http_request_latency_bucket[5m])))",
+        "histogram_quantile(0.5, sum by (le) "
+        "(rate(http_request_latency_bucket[5m])))",
+        "histogram_quantile(0.9, sum by (le) "
+        "(rate(http_request_latency_bucket[4m])))",
+    ],
+}
+
+
+@pytest.mark.parametrize("family", sorted(FAMILY_QUERIES))
+def test_batched_parity_bit_equal(engines, family):
+    """Each lane of a coalesced batch must be BIT-equal to its own
+    sequential (unbatched) execution — across agg/topk/quantile/hist
+    epilogue families, mixed windows, offsets and group-by variants."""
+    batched, sched, seq, _plain = engines
+    queries = FAMILY_QUERIES[family]
+    expected = {q: seq.query_range(q, START, END, STEP) for q in queries}
+    got = _run_coalesced(batched, sched, queries)
+    for q in queries:
+        assert_bit_equal(got[q], expected[q], ctx=q)
+
+
+def test_batched_parity_vs_plain_engine(engines):
+    """The batched engine's answers also agree with the fully-default
+    engine (whose plans stage the narrower unaligned range): NaN masks
+    identical, values within f32 accumulation tolerance — the range
+    alignment never changes results beyond staging-baseline ulps."""
+    batched, sched, _seq, plain = engines
+    queries = FAMILY_QUERIES["agg_sum"]
+    got = _run_coalesced(batched, sched, queries)
+    for q in queries:
+        a, b = _rows(got[q]), _rows(plain.query_range(q, START, END, STEP))
+        assert a.keys() == b.keys(), q
+        for k in a:
+            na, nb = np.isnan(a[k]), np.isnan(b[k])
+            assert (na == nb).all(), (q, k)
+            np.testing.assert_allclose(
+                a[k][~na], b[k][~nb], rtol=2e-5, atol=1e-6, err_msg=str(q)
+            )
+
+
+def test_mesh_batched_parity(store):
+    """The sharded batched programs (shard_map twins) agree bit-for-bit
+    with sequential execution on a degenerate 1-device mesh — the same
+    program shape the multi-chip path compiles."""
+    import jax
+
+    from filodb_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(jax.devices()[:1])
+    sched = DispatchScheduler(window_ms=100)
+    batched = QueryEngine(store, "ds", PlannerParams(
+        mesh=mesh, batch_window_ms=100, dispatch_scheduler=sched))
+    seq = QueryEngine(store, "ds", PlannerParams(
+        mesh=mesh, batch_window_ms=100,
+        dispatch_scheduler=DispatchScheduler(0)))
+    queries = [
+        "sum(rate(http_requests_total[5m]))",
+        "sum by (instance) (rate(http_requests_total[4m]))",
+    ]
+    expected = {q: seq.query_range(q, START, END, STEP) for q in queries}
+    got = _run_coalesced(batched, sched, queries)
+    for q in queries:
+        assert_bit_equal(got[q], expected[q], ctx=q)
+
+
+# ---------------------------------------------------------------------------
+# ONE dispatch per coalesced group
+# ---------------------------------------------------------------------------
+
+
+def test_coalesced_group_is_one_dispatch(engines):
+    """A warm coalesced group of Q>1 queries sharing the superblock, grid
+    and epilogue family issues exactly ONE kernel dispatch (the PR 4/5
+    dispatch counter)."""
+    batched, sched, _seq, _plain = engines
+    queries = [
+        "sum(rate(http_requests_total[5m]))",
+        "sum by (_ws_) (rate(http_requests_total[5m]))",
+        "sum by (_ns_) (rate(http_requests_total[5m]))",
+    ]
+    # two full rounds: stage the superblock, memoize gids/window matrices,
+    # compile the width-4 batched executable
+    for _ in range(2):
+        _run_coalesced(batched, sched, queries)
+    before = kernel_dispatch_total()
+    _run_coalesced(batched, sched, queries)
+    assert kernel_dispatch_total() - before == 1, (
+        "a warm coalesced group must issue exactly ONE kernel dispatch"
+    )
+
+
+def test_identical_specs_dedup_onto_one_lane(engines):
+    """Identical dispatch specs from distinct queries share one lane (the
+    lane-level single-flight): the batch stays minimal and both callers get
+    the same answer."""
+    batched, sched, _seq, _plain = engines
+    # distinct PromQL strings (whitespace), identical dispatch spec after
+    # planning — the engine-level identical-query single-flight keys on the
+    # STRING, so both reach the batcher and must share one lane
+    queries = [
+        "sum by (_ws_) (rate(http_requests_total[5m]))",
+        "sum by (_ws_)  (rate(http_requests_total[5m]))",
+    ]
+    coalesced_before = sched.stats["coalesced"]
+    got = _run_coalesced(batched, sched, queries, expected_lanes=1)
+    assert sched.stats["coalesced"] > coalesced_before
+    assert_bit_equal(got[queries[0]], got[queries[1]])
+
+
+def test_batch_failure_falls_back_to_unbatched(engines, monkeypatch):
+    """A batched-path failure must not lose queries: the leader re-runs
+    every lane unbatched and the group is counted as a fallback."""
+    import filodb_tpu.query.scheduler as QS
+
+    batched, sched, seq, _plain = engines
+
+    def boom(requests):
+        raise RuntimeError("injected batch failure")
+
+    monkeypatch.setattr(QS, "_run_batch", boom)
+    queries = [
+        "sum(rate(http_requests_total[5m]))",
+        "sum by (_ns_) (rate(http_requests_total[5m]))",
+    ]
+    fallback_before = sched.stats["fallback"]
+    got = _run_coalesced(batched, sched, queries)
+    assert sched.stats["fallback"] == fallback_before + 1
+    for q in queries:
+        assert_bit_equal(got[q], seq.query_range(q, START, END, STEP), q)
+
+
+# ---------------------------------------------------------------------------
+# batching disabled == today's engine
+# ---------------------------------------------------------------------------
+
+
+def test_batching_disabled_is_todays_plans(store):
+    """window=0 (the default) must be byte-identical to the pre-scheduler
+    engine: same golden plan shapes, same staged ranges, bit-equal
+    results."""
+    from filodb_tpu.query.promql import query_range_to_logical_plan
+
+    off = QueryEngine(store, "ds", PlannerParams(batch_window_ms=0))
+    plain = QueryEngine(store, "ds", PlannerParams())
+    q = "sum by (instance) (rate(http_requests_total[5m]))"
+    plan = query_range_to_logical_plan(q, START, END, STEP)
+    ep_off = off.planner.materialize(plan)
+    ep_plain = plain.planner.materialize(plan)
+    assert ep_off.print_tree() == ep_plain.print_tree()
+    assert ep_off.raw_start_ms == ep_plain.raw_start_ms
+    assert ep_off.raw_end_ms == ep_plain.raw_end_ms
+    assert_bit_equal(
+        off.query_range(q, START, END, STEP),
+        plain.query_range(q, START, END, STEP),
+    )
+
+
+def test_batching_enabled_keeps_plan_shapes(store):
+    """Batching is a runtime dispatch concern: enabling it must not change
+    the PLAN tree (golden plan shapes unchanged) — only the staged range
+    aligns."""
+    from filodb_tpu.query.promql import query_range_to_logical_plan
+
+    on = QueryEngine(store, "ds", PlannerParams(batch_window_ms=5))
+    plain = QueryEngine(store, "ds", PlannerParams())
+    for q in (
+        "sum by (instance) (rate(http_requests_total[5m]))",
+        "topk(3, rate(http_requests_total[5m]))",
+        "histogram_quantile(0.99, sum by (le) "
+        "(rate(http_request_latency_bucket[5m])))",
+    ):
+        plan = query_range_to_logical_plan(q, START, END, STEP)
+        assert (on.planner.materialize(plan).print_tree()
+                == plain.planner.materialize(plan).print_tree()), q
+
+
+# ---------------------------------------------------------------------------
+# admission control
+# ---------------------------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+class TestTokenBucket:
+    def test_burst_then_rate(self):
+        clk = FakeClock()
+        b = TokenBucket(rate=2.0, burst=3.0, clock=clk)
+        assert [b.try_take() for _ in range(3)] == [0.0, 0.0, 0.0]
+        wait = b.try_take()
+        assert wait == pytest.approx(0.5)  # 1 token at 2/s
+        clk.t += 0.5
+        assert b.try_take() == 0.0
+        clk.t += 10.0  # refill caps at burst
+        assert b.balance() == pytest.approx(3.0)
+
+    def test_zero_rate_never_refills(self):
+        b = TokenBucket(rate=0.0, burst=1.0, clock=FakeClock())
+        assert b.try_take() == 0.0
+        assert b.try_take() == float("inf")
+
+
+class TestAdmission:
+    def test_rate_quota_sheds_with_retry_after(self):
+        clk = FakeClock()
+        ctl = AdmissionController(
+            {"demo/App-2": {"rate": 1.0, "burst": 2}}, clock=clk
+        )
+        with ctl.admit("demo", "App-2"):
+            pass
+        with ctl.admit("demo", "App-2"):
+            pass
+        with pytest.raises(AdmissionRejected) as ei:
+            ctl.admit("demo", "App-2")
+        assert ei.value.outcome == "shed_rate"
+        assert 0 < ei.value.retry_after_s <= 60
+        w = ei.value.warning()
+        assert w["reason"] == "admission_rejected"
+        assert w["ws"] == "demo"
+        clk.t += 1.5  # a token accrues
+        with ctl.admit("demo", "App-2"):
+            pass
+
+    def test_concurrency_quota_and_release(self):
+        ctl = AdmissionController(
+            {"*": {"max_concurrent": 1}}, clock=FakeClock()
+        )
+        slot = ctl.admit("t", "a")
+        with pytest.raises(AdmissionRejected) as ei:
+            ctl.admit("t", "a")
+        assert ei.value.outcome == "shed_concurrency"
+        # a DIFFERENT tenant has its own bucket under the "*" default
+        with ctl.admit("t", "b"):
+            pass
+        with slot:
+            pass  # release
+        with ctl.admit("t", "a"):
+            pass
+
+    def test_global_queue_depth_bound(self):
+        ctl = AdmissionController({}, max_queued=2, clock=FakeClock())
+        s1 = ctl.admit("x", "1")
+        s2 = ctl.admit("y", "2")
+        with pytest.raises(AdmissionRejected) as ei:
+            ctl.admit("z", "3")
+        assert ei.value.outcome == "shed_queue"
+        s1.__exit__(None, None, None)
+        with ctl.admit("z", "3"):
+            pass
+        s2.__exit__(None, None, None)
+
+    def test_snapshot_shows_balances_and_sheds(self):
+        clk = FakeClock()
+        ctl = AdmissionController(
+            {"demo/App-2": {"rate": 1.0, "burst": 1}}, clock=clk
+        )
+        with ctl.admit("demo", "App-2"):
+            pass
+        with pytest.raises(AdmissionRejected):
+            ctl.admit("demo", "App-2")
+        snap = ctl.snapshot()
+        t = snap["tenants"]["demo/App-2"]
+        assert t["shed"] == 1
+        assert t["tokens"] is not None
+        assert snap["shed_total"] == 1
+
+    def test_admission_counter_has_bounded_labels(self):
+        from filodb_tpu.metrics import REGISTRY
+
+        ctl = AdmissionController({}, max_queued=0, clock=FakeClock())
+        with ctl.admit("some-ws", "some-ns"):
+            pass
+        with REGISTRY._lock:
+            keys = [k for k in REGISTRY._metrics if k[0] == "filodb_admission"]
+        assert any(
+            dict(lbls).get("outcome") == "admitted"
+            and dict(lbls).get("ws") in ("some-ws", "overflow")
+            for _, lbls in keys
+        )
+
+
+def test_quota_shed_fairness_under_soak(store):
+    """Threaded soak: tenant A floods past its rate quota, tenant B stays
+    in quota. Every B query completes; A is shed (429 semantics) with a
+    positive Retry-After; no cross-tenant interference."""
+    ctl = AdmissionController({"demo/App-2": {"rate": 2.0, "burst": 2}})
+    engine = QueryEngine(store, "ds", PlannerParams(admission=ctl))
+    q_a = 'sum(rate(http_requests_total{_ws_="demo",_ns_="App-2"}[5m]))'
+    q_b = "sum(avg_over_time(heap_usage0[5m]))"  # tenant resolves unknown
+    engine.query_range(q_b, START, END, STEP)  # warm (unmetered tenant)
+    a_ok, a_shed, b_ok, b_err = [], [], [], []
+
+    def tenant_a():
+        for _ in range(6):
+            try:
+                engine.query_range(q_a, START, END, STEP)
+                a_ok.append(1)
+            except AdmissionRejected as e:
+                assert e.retry_after_s > 0
+                a_shed.append(e)
+
+    def tenant_b():
+        for _ in range(4):
+            try:
+                engine.query_range(q_b, START, END, STEP)
+                b_ok.append(1)
+            except Exception as e:  # noqa: BLE001
+                b_err.append(e)
+
+    threads = [threading.Thread(target=tenant_a) for _ in range(2)]
+    threads += [threading.Thread(target=tenant_b) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    assert not b_err, b_err
+    assert len(b_ok) == 8  # in-quota tenant: every query served
+    assert a_shed, "over-quota tenant must shed"
+    assert len(a_ok) >= 2  # burst admits some
+
+
+# ---------------------------------------------------------------------------
+# API surfaces: HTTP 429 + /debug/scheduler; remote shed degrades partial
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def shedding_server(store):
+    from filodb_tpu.api.http import serve_background
+
+    # near-zero refill: once the burst is drained the server sheds every
+    # further request (deterministic 429s for the test's duration)
+    ctl = AdmissionController(
+        {"*": {"rate": 0.001, "burst": 4}},
+    )
+    sched = DispatchScheduler(window_ms=1.0)
+    engine = QueryEngine(store, "ds", PlannerParams(
+        admission=ctl, batch_window_ms=1.0, dispatch_scheduler=sched,
+        coalesce_identical=False))
+    srv, port = serve_background(engine, port=0)
+    yield engine, ctl, port
+    srv.shutdown()
+
+
+def _http_get(port, path):
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30
+        ) as r:
+            return r.status, dict(r.headers), json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read())
+
+
+def test_http_429_retry_after_and_warning(shedding_server):
+    _engine, ctl, port = shedding_server
+    q = urllib.parse.quote("sum(rate(http_requests_total[5m]))")
+    path = f"/api/v1/query_range?query={q}&start={START}&end={END}&step=60"
+    # exhaust the burst so the next request sheds
+    with ctl._lock:
+        st = ctl._state("unknown/unknown")
+    if st.bucket is not None:
+        while st.bucket.try_take() == 0.0:
+            pass
+    code, headers, body = _http_get(port, path)
+    assert code == 429
+    assert int(headers["Retry-After"]) >= 1
+    assert body["status"] == "error"
+    assert body["errorType"] == "throttled"
+    w = body["warnings"][0]
+    assert w["reason"] == "admission_rejected"
+    assert w["retry_after_s"] > 0
+
+
+def test_debug_scheduler_endpoint(shedding_server):
+    _engine, _ctl, port = shedding_server
+    q = urllib.parse.quote("sum(rate(http_requests_total[5m]))")
+    _http_get(port, f"/api/v1/query_range?query={q}&start={START}&end={END}"
+                    "&step=60")
+    code, _h, body = _http_get(port, "/debug/scheduler")
+    assert code == 200
+    data = body["data"]
+    assert data["batch"]["window_ms"] == pytest.approx(1.0)
+    assert "queries" in data["batch"]
+    assert "tenants" in data["admission"]
+    assert "in_flight" in data["admission"]
+
+
+def test_fetch_json_maps_429_to_admission_rejected(shedding_server):
+    from filodb_tpu.coordinator.planners import fetch_json
+
+    _engine, ctl, port = shedding_server
+    with ctl._lock:
+        st = ctl._state("unknown/unknown")
+    while st.bucket.try_take() == 0.0:
+        pass
+    q = urllib.parse.quote("sum(rate(http_requests_total[5m]))")
+    with pytest.raises(AdmissionRejected) as ei:
+        fetch_json(
+            f"http://127.0.0.1:{port}/api/v1/query_range?query={q}"
+            f"&start={START}&end={END}&step=60"
+        )
+    assert ei.value.retry_after_s >= 1
+    assert ei.value.outcome == "shed_remote"
+    # peer-health classification: sustained shedding opens the breaker,
+    # but a shed is never blindly retried into the shed window
+    assert ei.value.endpoint_failure is True
+    assert ei.value.retryable is False
+
+
+def test_grpc_error_frame_roundtrip():
+    from filodb_tpu.query.proto_plan import _raise_remote_error
+
+    payload = json.dumps({
+        "error": "tenant demo/App-2 over rate quota",
+        "retry_after_s": 2.5, "ws": "demo", "ns": "App-2",
+    })
+    with pytest.raises(AdmissionRejected) as ei:
+        _raise_remote_error("AdmissionRejected", payload)
+    assert ei.value.retry_after_s == pytest.approx(2.5)
+    assert ei.value.ws == "demo"
+    assert ei.value.outcome == "shed_remote"
+
+
+def test_shed_remote_child_degrades_like_faulted(store):
+    """Under allow_partial_results a remote child shed by its peer's
+    admission control becomes a structured warning + survivors served —
+    exactly the PR 2 lost-child contract."""
+    from filodb_tpu.query.exec.plans import (
+        NonLeafExecPlan,
+        QueryContext,
+    )
+    from filodb_tpu.query.rangevector import QueryResult
+
+    class OkChild(NonLeafExecPlan):
+        def __init__(self):
+            super().__init__([])
+
+        def do_execute(self, ctx):
+            return QueryResult()
+
+    class ShedChild(OkChild):
+        is_remote = True
+        endpoint = "grpc://peer:1"
+
+        def do_execute(self, ctx):
+            raise AdmissionRejected(
+                "remote peer shed request", retry_after_s=2.0,
+                outcome="shed_remote",
+            )
+
+    class Merge(NonLeafExecPlan):
+        supports_partial = True
+
+        def do_execute(self, ctx):
+            results = self.execute_children(ctx)
+            return results[0]
+
+    ctx = QueryContext(store, "ds")
+    ctx.allow_partial_results = True
+    merge = Merge([OkChild(), ShedChild()])
+    merge.execute(ctx)
+    assert ctx.warnings, "shed child must record a structured warning"
+    assert any("shed" in w.get("error", "") for w in ctx.warnings)
+
+    # strict mode: the shed propagates as the typed rejection
+    ctx2 = QueryContext(store, "ds")
+    with pytest.raises(AdmissionRejected):
+        Merge([OkChild(), ShedChild()]).execute(ctx2)

@@ -49,19 +49,33 @@ def run_entry(entry: dict) -> tuple[bool, str]:
             f"{name}: FAIL worker emitted metric {got.get('metric')!r} — "
             "floor entry and bench.py METRIC out of sync"
         )
-    p50 = float(got["value"])
-    limit = float(entry["p50_ms_floor"]) * (1.0 + REGRESSION_TOLERANCE)
+    value = float(got["value"])
     if not got.get("match", False):
         return False, f"{name}: FAIL result does not match the numpy oracle"
-    if p50 <= 0:
+    if value <= 0:
         return False, f"{name}: FAIL no measurement"
-    if p50 > limit:
+    if "qps_floor_min" in entry:
+        # HIGHER is better (throughput workloads): fail when the measured
+        # value drops >25% below the checked-in floor
+        floor = float(entry["qps_floor_min"])
+        limit = floor * (1.0 - REGRESSION_TOLERANCE)
+        if value < limit:
+            return False, (
+                f"{name}: FAIL {value:.1f} qps regresses >25% vs floor "
+                f"{floor} qps (limit {limit:.1f} qps)"
+            )
+        return True, (
+            f"{name}: OK {value:.1f} qps above limit {limit:.1f} qps "
+            f"(floor {floor} qps, phases {got.get('phases_ms')})"
+        )
+    limit = float(entry["p50_ms_floor"]) * (1.0 + REGRESSION_TOLERANCE)
+    if value > limit:
         return False, (
-            f"{name}: FAIL p50 {p50:.2f}ms regresses >25% vs floor "
+            f"{name}: FAIL p50 {value:.2f}ms regresses >25% vs floor "
             f"{entry['p50_ms_floor']}ms (limit {limit:.2f}ms)"
         )
     return True, (
-        f"{name}: OK p50 {p50:.2f}ms within limit {limit:.2f}ms "
+        f"{name}: OK p50 {value:.2f}ms within limit {limit:.2f}ms "
         f"(floor {entry['p50_ms_floor']}ms, phases {got.get('phases_ms')})"
     )
 
