@@ -2,7 +2,7 @@
 
 NATIVE_DIR := filodb_tpu/native
 
-.PHONY: all native test test-chaos test-ingest-chaos test-multichip test-observability test-scheduler bench bench-smoke microbench serve clean tpu-watch tpu-watch-bg
+.PHONY: all native test test-chaos test-ingest-chaos test-jitter test-multichip test-observability test-scheduler bench bench-smoke microbench serve clean tpu-watch tpu-watch-bg
 
 all: native
 
@@ -49,6 +49,16 @@ test-multichip: native
 	env JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 		python -m pytest tests/test_fused_mesh.py -q -m fused_mesh
 	env JAX_PLATFORMS=cpu python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+# jitter-tolerant fused suite (doc/perf.md "Jitter-tolerant fused path"):
+# fused-vs-reference parity on jitter5pct / jitter+holes grids across the
+# epilogue families (hist_quantile included), warm single-dispatch
+# assertions for regular/jittered/holey grids + the mesh twins on the
+# forced 8-device CPU mesh, superblock grid-class isolation, and
+# extension-under-ingest on a jittered block
+test-jitter: native
+	env JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		python -m pytest tests/test_fused_jitter.py -q -m fused_jitter
 
 # query dispatch scheduler suite (doc/operations.md "Cross-query batching &
 # admission control"): batched-vs-sequential bit parity across the epilogue

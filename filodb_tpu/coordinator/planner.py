@@ -653,9 +653,16 @@ class SingleClusterPlanner:
         device-resident superblock (O(1) kernel launches) — including 3-D
         histogram superblocks, fused ``topk``/``bottomk``/``quantile``
         epilogues, and (via ``hist_quantile``) the device-side
-        ``histogram_quantile`` interpolation epilogue. The reference
-        scatter tree is built alongside as the runtime fallback (partial
-        results, mixed schemas, unsupported hist shapes)."""
+        ``histogram_quantile`` interpolation epilogue. Grid SHAPE is not a
+        plan-time concern: the dispatch classifies the staged superblock's
+        grid (regular | jitter | holes | irregular, staging.grid_class)
+        and selects the matching kernel variant — jittered and holey
+        scrape grids stay single-dispatch (doc/perf.md "Jitter-tolerant
+        fused path"), with the ``grid_jitter``/``grid_holes`` taxonomy
+        entries reserved for shapes the jitter variants truly can't model
+        (degraded to the general fused kernel, never to the tree). The
+        reference scatter tree is built alongside as the runtime fallback
+        (partial results, mixed schemas, unsupported hist shapes)."""
         from ..query.exec.plans import (
             FUSED_AGG_OPS,
             FUSED_EPI_OPS,

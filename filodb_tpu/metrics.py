@@ -120,6 +120,7 @@ HELP_TEXTS: dict[str, str] = {
     "filodb_admission": "Admission-control outcomes per tenant (admitted|shed_rate|shed_concurrency|shed_queue).",
     "filodb_batch_queries": "Fused dispatches submitted to the cross-query batching scheduler, per epilogue family.",
     "filodb_batch_dispatches": "Batching-scheduler group executions per family and outcome (batched|solo|fallback).",
+    "filodb_batch_merged_windows": "Compatible window-groups re-merged into one mixed-window batched launch, per family.",
     "filodb_batch_queue_depth": "Fused dispatches currently collecting in open batch windows.",
     "filodb_tenant_query_seconds": "Wall-clock query seconds per tenant.",
     "filodb_tenant_kernel_seconds": "Device kernel-dispatch seconds per tenant.",
@@ -529,12 +530,29 @@ class SlowQueryLog:
 SLOW_QUERY_LOG = SlowQueryLog()
 
 
+# the ONE fused-fallback reason taxonomy (doc/perf.md's fallback table
+# documents each entry; tools/check_metrics.py lints code and table against
+# each other). Tree-fallback reasons delegate to the reference scatter
+# tree; the grid_* entries are DEGRADED-KERNEL reasons — the dispatch
+# stays one fused program, it just lost its jitter-tolerant fast variant.
+FUSED_FALLBACK_REASONS = frozenset({
+    "partial_results", "dispatcher", "mixed_schemas", "hist_scheme",
+    "hist_op", "hist_func", "hist_quantile_scalar", "mesh_unsupported",
+    "grid_jitter", "grid_holes",
+})
+
+
 def record_fused_fallback(reason: str) -> None:
     """A FusedAggregateExec delegated to its reference scatter tree at
-    runtime. Exposed as ``filodb_fused_fallback_total{reason=...}`` so
-    operators see fused-path coverage at aggregate level (the reason was
-    previously only a span tag, visible per-query only); doc/perf.md
-    documents the reason taxonomy."""
+    runtime — or, for the ``grid_*`` reasons, degraded a jittered/holey
+    grid to the general fused kernel. Exposed as
+    ``filodb_fused_fallback_total{reason=...}`` so operators see
+    fused-path coverage at aggregate level (the reason was previously only
+    a span tag, visible per-query only); doc/perf.md documents the reason
+    taxonomy, and an unknown reason label is a bug caught here rather than
+    minted as an undashboarded series."""
+    if reason not in FUSED_FALLBACK_REASONS:
+        reason = "unknown"
     REGISTRY.counter("filodb_fused_fallback", reason=reason).inc()
 
 

@@ -118,6 +118,110 @@ FUSED_MXU_FUNCS = {
     "rate", "increase", "delta", "idelta", "irate",
 }
 
+# range functions the fused JITTER/MASKED variants handle (the mxu_jitter
+# set minus min/max_over_time, which need the lazily-built tile/edge
+# structures — those fall to the general kernel, counted grid_jitter/holes)
+FUSED_JITTER_FUNCS = FUSED_MXU_FUNCS
+
+
+def _grid_variant(block, func: str, is_delta: bool):
+    """Kernel-variant ladder for one fused dispatch, decided from the
+    (super)block's grid classification (staging.grid_class) and the
+    function: ``mxu`` (exact shared grid, window matmuls) > ``jitter``
+    (near-regular: certain-membership matmul + per-series boundary
+    corrections, ops/mxu_jitter) > ``masked`` (near-regular with missed
+    scrapes: validity-masked sidecar) > ``general``. The ONE selection
+    shared by the single-query dispatch (_fused_dispatch) and the
+    cross-query batcher (fused_batched_scalar) — a batched lane MUST
+    compute through the same variant its unbatched execution would, or
+    batched-vs-sequential parity breaks.
+
+    Returns ``(variant, degrade_reason)``: ``degrade_reason`` is a
+    fused-fallback taxonomy entry (``grid_jitter``/``grid_holes``) set only
+    when a jittered/holey grid is truly unsupported by its fast variant
+    (function outside FUSED_JITTER_FUNCS) and the dispatch degrades to the
+    multi-pass general kernel — still ONE fused dispatch, just slower."""
+    if not (is_delta and func in ("irate", "idelta")):
+        if block.regular_ts is not None:
+            if func in FUSED_MXU_FUNCS:
+                return "mxu", None
+        elif block.nominal_ts is not None:
+            if func in FUSED_JITTER_FUNCS:
+                return "jitter", None
+            return "general", "grid_jitter"
+        elif getattr(block, "mgrid", None) is not None:
+            if func in FUSED_JITTER_FUNCS:
+                return "masked", None
+            return "general", "grid_holes"
+    return "general", None
+
+
+def _pallas_variant(block, func: str, mesh) -> bool:
+    """Whether a general-path dispatch should promote to the fused Pallas
+    gather-scan backend: single-device, a truly IRREGULAR grid (regular /
+    near-regular / masked grids have cheaper structured variants), a
+    function the Pallas finisher models, and the shared FILODB_PALLAS
+    policy (pallas_kernels.pallas_enabled — the same predicate the legacy
+    range-function dispatch applies, so the two paths can't drift)."""
+    if mesh is not None:
+        return False
+    if (block.regular_ts is not None or block.nominal_ts is not None
+            or getattr(block, "mgrid", None) is not None):
+        return False
+    from .pallas_kernels import PALLAS_FUNCS, pallas_enabled
+
+    return func in PALLAS_FUNCS and pallas_enabled()
+
+
+def batch_variant_supported(block, func: str, kind: str, is_delta: bool,
+                            mesh) -> bool:
+    """Whether the batched program set models this dispatch's kernel
+    variant. The scheduler consults this BEFORE grouping
+    (FusedAggregateExec._dispatch_fused): a structurally-unbatchable
+    request runs unbatched immediately instead of paying the batch window
+    and a guaranteed-to-raise launch (which would also mint
+    ``outcome="fallback"`` dispatches operators are told to investigate).
+    The raises inside fused_batched_scalar/fused_batched_hist remain as
+    the defensive backstop for window-dependent cases (a merged window
+    failing the jitter safety bound)."""
+    if kind == "hist":
+        # jittered hist grids take the unbatched jitter variant
+        return block.regular_ts is not None or block.nominal_ts is None
+    variant, reason = _grid_variant(block, func, is_delta)
+    if variant in ("jitter", "masked") and mesh is not None:
+        return False
+    if variant == "general" and reason is None and _pallas_variant(
+        block, func, mesh
+    ):
+        return False
+    return True
+
+
+def _jwm_args(wm) -> tuple:
+    """The jitter window structure as ONE flat tuple in
+    jitter_range_kernel's positional order (a pytree jit argument — one
+    signature for the plain/sharded/batched fused jitter programs)."""
+    return (wm.d_W0, wm.d_SEL, wm.d_idx, wm.d_count0, wm.d_c0pos,
+            wm.d_c0ge2, wm.d_has_klo, wm.d_has_khi, wm.d_F0_rel,
+            wm.d_L0_rel, wm.d_L2_rel, wm.d_Klo_rel, wm.d_Khi_rel,
+            wm.d_blo_rel, wm.d_ehi_rel)
+
+
+def _mwm_args(wm) -> tuple:
+    """Masked-grid window structure tuple (jitter_masked_kernel order)."""
+    return (wm.d_W0, wm.d_SEL, wm.d_idx, wm.d_c0pos, wm.d_has_klo,
+            wm.d_has_khi, wm.d_F0_rel, wm.d_L0_rel, wm.d_Klo_rel,
+            wm.d_Khi_rel, wm.d_blo_rel, wm.d_ehi_rel)
+
+
+def _mgrid_args(g) -> tuple:
+    """A block's masked sidecar arrays as ONE flat tuple in
+    jitter_masked_kernel's positional order (vals..bfraw)."""
+    raw = g.raw if g.raw is not None else g.vals
+    bfraw = g.bfraw if g.bfraw is not None else g.bfv
+    return (g.vals, g.dev, raw, g.valid, g.cc, g.ffv, g.ffd, g.bfv, g.bfd,
+            g.ff2v, g.ff2d, bfraw)
+
 
 def _apply_epilogue(sj, epilogue: tuple, gids, n_real, qv, num_groups: int):
     """Device-side epilogue over the [S, J] range grid, INSIDE the same
@@ -195,6 +299,72 @@ def _fused_mxu_jit(func, epilogue, vals, raw, baseline, W, F, L, L2, count,
         t_last2, out_t, window_ms, idx=idx, is_counter=is_counter,
         is_delta=is_delta, fetch=fetch,
     )
+    return _apply_epilogue(sj, epilogue, gids, n_real, qv, num_groups)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "func", "epilogue", "num_groups", "is_counter", "is_delta", "fetch"
+))
+def _fused_jitter_jit(func, epilogue, vals, dev, raw, jwm, window_ms, gids,
+                      n_real, qv, num_groups: int, is_counter: bool,
+                      is_delta: bool, fetch: str):
+    """Near-regular-grid fused variant: the jitter kernel (certain-window
+    matmul + per-series boundary corrections, ops/mxu_jitter) and the
+    epilogue in ONE compiled program — a jittered scrape grid stays a
+    single warm dispatch instead of paying the multi-pass general path.
+    ``jwm`` is the flat window-structure tuple (_jwm_args)."""
+    from .mxu_jitter import jitter_range_kernel
+
+    sj = jitter_range_kernel(
+        func, vals, dev, raw, *jwm, window_ms,
+        is_counter=is_counter, is_delta=is_delta, fetch=fetch,
+    )
+    return _apply_epilogue(sj, epilogue, gids, n_real, qv, num_groups)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "func", "epilogue", "num_groups", "is_counter", "is_delta", "fetch"
+))
+def _fused_masked_jit(func, epilogue, mba, mwm, window_ms, maxdev, gids,
+                      n_real, qv, num_groups: int, is_counter: bool,
+                      is_delta: bool, fetch: str):
+    """Missing-scrape fused variant: the validity-masked jitter kernel over
+    the block's slot-aligned sidecar (staging.MaskedGrid) + epilogue, one
+    program. ``mba`` = _mgrid_args sidecar tuple, ``mwm`` = _mwm_args;
+    ``maxdev`` enables the kernel's lean gather plan."""
+    from .mxu_jitter import jitter_masked_kernel
+
+    sj = jitter_masked_kernel(
+        func, *mba, *mwm, window_ms,
+        is_counter=is_counter, is_delta=is_delta, fetch=fetch,
+        maxdev=maxdev,
+    )
+    return _apply_epilogue(sj, epilogue, gids, n_real, qv, num_groups)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "func", "epilogue", "j_pad", "num_groups", "is_counter", "is_delta",
+    "interpret"
+))
+def _fused_pallas_jit(func, epilogue, ts, vals, raw, lens, gids, n_real, qv,
+                      start_off, step_ms, window, j_pad: int,
+                      num_groups: int, is_counter: bool, is_delta: bool,
+                      interpret: bool):
+    """Truly-irregular-grid fused variant: the one-pass Pallas window-stats
+    kernel (ops/pallas_kernels.window_aggregates, VMEM-tiled gather-scan) +
+    its finisher + the epilogue behind the SAME jit boundary — interpret
+    mode on CPU (tier-1), compiled on TPU. The Pallas grid pads S/J up to
+    its tile sizes; slice back to the block's own padding before the
+    epilogue so the trash-group/gids contract is unchanged."""
+    from .pallas_kernels import finish, window_aggregates
+
+    agg = window_aggregates(
+        ts, vals, raw, lens, start_off, step_ms, window, j_pad,
+        interpret=interpret,
+    )
+    sj = finish(func, agg, start_off, step_ms, window,
+                is_counter=is_counter, is_delta=is_delta)
+    sj = sj[: vals.shape[0], :j_pad]
     return _apply_epilogue(sj, epilogue, gids, n_real, qv, num_groups)
 
 
@@ -333,80 +503,215 @@ def _fused_sharded_mxu_jit(mesh, func, epilogue, vals, raw, baseline, W, F, L,
     )(vals, raw, baseline, gids)
 
 
+@functools.partial(jax.jit, static_argnames=(
+    "mesh", "func", "epilogue", "num_groups", "is_counter", "is_delta",
+    "fetch"
+))
+def _fused_sharded_jitter_jit(mesh, func, epilogue, vals, dev, raw, jwm,
+                              window_ms, gids, n_real, qv, num_groups: int,
+                              is_counter: bool, is_delta: bool, fetch: str):
+    """Series-sharded twin of _fused_jitter_jit: the replicated window
+    structure rides the closure (committed mesh-replicated at build, like
+    the MXU matrices), the jitter kernel runs per row band, and the
+    epilogue combines over the mesh in the same program — mesh + jitter no
+    longer drops to the sharded general kernel (the PR 8 remainder)."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..jax_compat import shard_map
+    from .mxu_jitter import jitter_range_kernel
+
+    axis = mesh.axis_names[0]
+
+    def local(vals_l, dev_l, raw_l, gids_l):
+        sj = jitter_range_kernel(
+            func, vals_l, dev_l, raw_l, *jwm, window_ms,
+            is_counter=is_counter, is_delta=is_delta, fetch=fetch,
+        )
+        return _sharded_epilogue(sj, epilogue, gids_l, n_real, qv,
+                                 num_groups, axis)
+
+    row, vec = P(axis, None), P(axis)
+    return shard_map(
+        local, mesh=mesh,
+        in_specs=(row, row, row, vec),
+        out_specs=_sharded_out_specs(epilogue),
+        check=False,
+    )(vals, dev, raw, gids)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "mesh", "func", "epilogue", "num_groups", "is_counter", "is_delta",
+    "fetch"
+))
+def _fused_sharded_masked_jit(mesh, func, epilogue, mba, mwm, window_ms,
+                              maxdev, gids, n_real, qv, num_groups: int,
+                              is_counter: bool, is_delta: bool, fetch: str):
+    """Series-sharded twin of _fused_masked_jit: every [S, T'] sidecar
+    array is a row band (staging pins them with the block's placement),
+    the replicated masked window structure rides the closure."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..jax_compat import shard_map
+    from .mxu_jitter import jitter_masked_kernel
+
+    axis = mesh.axis_names[0]
+
+    def local(mba_l, gids_l):
+        sj = jitter_masked_kernel(
+            func, *mba_l, *mwm, window_ms,
+            is_counter=is_counter, is_delta=is_delta, fetch=fetch,
+            maxdev=maxdev,
+        )
+        return _sharded_epilogue(sj, epilogue, gids_l, n_real, qv,
+                                 num_groups, axis)
+
+    row, vec = P(axis, None), P(axis)
+    return shard_map(
+        local, mesh=mesh,
+        in_specs=(tuple(row for _ in mba), vec),
+        out_specs=_sharded_out_specs(epilogue),
+        check=False,
+    )(mba, gids)
+
+
 def _fused_dispatch(func: str, epilogue: tuple, block, gids_padded,
                     num_groups: int, params, qv, is_counter: bool,
                     is_delta: bool, name: str, mesh=None):
-    """Shared MXU-vs-general selection + instrumentation for every fused
-    scalar entry point (one dispatch, one latency observation, one JIT
-    hit/miss account). With ``mesh`` (a 1-D device mesh matching the
-    block's series-sharded placement) the same program shape dispatches
-    ONCE across every device via shard_map."""
+    """Shared kernel-variant selection (_grid_variant ladder: mxu > jitter >
+    masked > pallas > general) + instrumentation for every fused scalar
+    entry point (one dispatch, one latency observation, one JIT hit/miss
+    account). With ``mesh`` (a 1-D device mesh matching the block's
+    series-sharded placement) the same program shape dispatches ONCE across
+    every device via shard_map — every variant has a sharded twin except
+    pallas (irregular mesh grids run the sharded general kernel)."""
     import time as _time
 
-    from ..metrics import record_kernel_dispatch
+    from ..metrics import record_fused_fallback, record_kernel_dispatch
     from .kernels import pad_steps
 
     j_pad = pad_steps(params.num_steps)
     raw = block.raw if block.raw is not None else block.vals
     n_real = np.int32(block.n_series)
-    t0 = _time.perf_counter()
-    use_mxu = (
-        block.regular_ts is not None
-        and func in FUSED_MXU_FUNCS
-        and not (is_delta and func in ("irate", "idelta"))
-    )
-    if mesh is not None:
-        name = "mesh_" + name
-    if use_mxu:
-        from .mxu_kernels import fetch_strategy, window_matrices
+    start_off = int(params.start_ms - block.base_ms)
+    variant, reason = _grid_variant(block, func, is_delta)
+    # window structures build (memoized per block) BEFORE the timed span,
+    # for every variant alike — the dispatch-latency observation must
+    # compare kernel cost across grid classes, not host-side build
+    # placement
+    wm = None
+    if variant == "mxu":
+        from .mxu_kernels import window_matrices
 
         # window_matrices reads block.placement: a sharded block's set is
         # committed mesh-replicated at build, so no per-dispatch broadcast
         wm = window_matrices(
-            block, int(params.start_ms - block.base_ms), params.step_ms,
-            j_pad, params.window_ms,
+            block, start_off, params.step_ms, j_pad, params.window_ms
         )
+    elif variant == "jitter":
+        from .mxu_jitter import jitter_window_matrices
+
+        wm = jitter_window_matrices(
+            block, start_off, params.step_ms, j_pad, params.window_ms
+        )
+        if not wm.ok:  # window not wider than the deviation band
+            variant, reason = "general", "grid_jitter"
+    elif variant == "masked":
+        from .mxu_jitter import masked_window_matrices
+
+        wm = masked_window_matrices(
+            block, start_off, params.step_ms, j_pad, params.window_ms
+        )
+        if not wm.ok:
+            variant, reason = "general", "grid_holes"
+    if variant == "general" and reason is None and _pallas_variant(
+        block, func, mesh
+    ):
+        variant = "pallas"
+    if reason is not None:
+        # degraded-kernel taxonomy: the dispatch STAYS one fused program
+        # (the general kernel), it just lost the jitter-tolerant fast
+        # variant — reserved for truly unsupported shapes (doc/perf.md)
+        record_fused_fallback(reason)
+    t0 = _time.perf_counter()
+    if mesh is not None:
+        name = "mesh_" + name
+    if variant == "mxu":
+        from .mxu_kernels import fetch_strategy
+
         if mesh is not None:
-            before = _fused_sharded_mxu_jit._cache_size()
-            out = _fused_sharded_mxu_jit(
+            fn = _fused_sharded_mxu_jit
+            args = (
                 mesh, func, epilogue, block.vals, raw, block.baseline,
                 wm.dW, wm.dF, wm.dL, wm.dL2, wm.d_count, wm.d_tf, wm.d_tl,
                 wm.d_tl2, wm.d_out_t, np.float32(params.window_ms), wm.d_idx,
                 gids_padded, n_real, qv, num_groups, is_counter, is_delta,
                 fetch_strategy(),
             )
-            compiled = _fused_sharded_mxu_jit._cache_size() > before
         else:
-            before = _fused_mxu_jit._cache_size()
-            out = _fused_mxu_jit(
+            fn = _fused_mxu_jit
+            args = (
                 func, epilogue, block.vals, raw, block.baseline,
                 wm.dW, wm.dF, wm.dL, wm.dL2, wm.d_count, wm.d_tf, wm.d_tl,
                 wm.d_tl2, wm.d_out_t, np.float32(params.window_ms), wm.d_idx,
                 gids_padded, n_real, qv, num_groups, is_counter, is_delta,
                 fetch_strategy(),
             )
-            compiled = _fused_mxu_jit._cache_size() > before
+    elif variant == "jitter":
+        from .mxu_kernels import fetch_strategy
+
+        common = (
+            func, epilogue, block.vals, block.ts_dev, raw, _jwm_args(wm),
+            np.float32(params.window_ms), gids_padded, n_real, qv,
+            num_groups, is_counter, is_delta, fetch_strategy(),
+        )
+        if mesh is not None:
+            fn, args = _fused_sharded_jitter_jit, (mesh,) + common
+        else:
+            fn, args = _fused_jitter_jit, common
+    elif variant == "masked":
+        from .mxu_kernels import fetch_strategy
+
+        common = (
+            func, epilogue, _mgrid_args(block.mgrid), _mwm_args(wm),
+            np.float32(params.window_ms),
+            np.float32(block.mgrid.maxdev_ms), gids_padded, n_real, qv,
+            num_groups, is_counter, is_delta, fetch_strategy(),
+        )
+        if mesh is not None:
+            fn, args = _fused_sharded_masked_jit, (mesh,) + common
+        else:
+            fn, args = _fused_masked_jit, common
+    elif variant == "pallas":
+        fn = _fused_pallas_jit
+        args = (
+            func, epilogue, block.ts, block.vals, raw, block.lens,
+            gids_padded, n_real, qv, np.int32(start_off),
+            np.int32(params.step_ms), np.int32(params.window_ms), j_pad,
+            num_groups, is_counter, is_delta,
+            jax.devices()[0].platform in ("cpu",),
+        )
     elif mesh is not None:
-        before = _fused_sharded_general_jit._cache_size()
-        out = _fused_sharded_general_jit(
+        fn = _fused_sharded_general_jit
+        args = (
             mesh, func, epilogue, block.ts, block.vals, block.lens,
             block.baseline, raw, gids_padded, n_real, qv,
-            np.int32(params.start_ms - block.base_ms),
-            np.int32(params.step_ms), np.int32(params.window_ms), j_pad,
-            num_groups, is_counter, is_delta,
+            np.int32(start_off), np.int32(params.step_ms),
+            np.int32(params.window_ms), j_pad, num_groups, is_counter,
+            is_delta,
         )
-        compiled = _fused_sharded_general_jit._cache_size() > before
     else:
-        before = _fused_general_jit._cache_size()
-        out = _fused_general_jit(
+        fn = _fused_general_jit
+        args = (
             func, epilogue, block.ts, block.vals, block.lens, block.baseline,
-            raw, gids_padded, n_real, qv,
-            np.int32(params.start_ms - block.base_ms),
+            raw, gids_padded, n_real, qv, np.int32(start_off),
             np.int32(params.step_ms), np.int32(params.window_ms), j_pad,
             num_groups, is_counter, is_delta,
         )
-        compiled = _fused_general_jit._cache_size() > before
-    record_kernel_dispatch(name, _time.perf_counter() - t0, compiled=compiled)
+    before = fn._cache_size()
+    out = fn(*args)
+    record_kernel_dispatch(
+        name, _time.perf_counter() - t0, compiled=fn._cache_size() > before
+    )
     return out
 
 
@@ -481,6 +786,14 @@ def fused_quantile(func: str, block, gids_padded, num_groups: int, q: float,
     )
 
 
+def _hist_jwm_args(wm) -> tuple:
+    """Jitter window structure in hist_kernels._hist_range_jitter's order:
+    shared certain-range boundaries + the uncertain-slot selections."""
+    return (wm.d_clo, wm.d_chi, wm.d_idx, wm.d_count0, wm.d_c0pos,
+            wm.d_has_klo, wm.d_has_khi, wm.d_F0_rel, wm.d_L0_rel,
+            wm.d_Klo_rel, wm.d_Khi_rel, wm.d_blo_rel, wm.d_ehi_rel)
+
+
 def _hist_shared_windows(block, params, j_pad: int, mesh):
     """Host-precomputed [J] searchsorted window-boundary vectors for a
     shared-regular-grid histogram (super)block, memoized device-resident on
@@ -536,9 +849,11 @@ def fused_hist_range_aggregate(func: str, block, gids_padded,
     the same program."""
     import time as _time
 
-    from ..metrics import record_kernel_dispatch
+    from ..metrics import record_fused_fallback, record_kernel_dispatch
     from .hist_kernels import (
         _fused_hist_jit,
+        _fused_hist_jitter_jit,
+        _fused_hist_jitter_sharded_jit,
         _fused_hist_sharded_jit,
         _fused_hist_shared_jit,
         _fused_hist_shared_sharded_jit,
@@ -551,11 +866,28 @@ def fused_hist_range_aggregate(func: str, block, gids_padded,
     name = f"fused_hist_{'quantile_' if q is not None else ''}sum_{func}"
     if mesh is not None:
         name = "mesh_" + name
+    # near-regular (jittered scrape) grids ride the shared-boundary jitter
+    # variant; a grid failing the window safety bound degrades to the
+    # general per-series kernel (still one dispatch), counted grid_jitter
+    jwm = None
+    if block.regular_ts is None and block.nominal_ts is not None:
+        from .mxu_jitter import jitter_window_matrices
+
+        jwm = jitter_window_matrices(
+            block, start_off, params.step_ms, j_pad, params.window_ms
+        )
+        if not jwm.ok:
+            jwm = None
+            record_fused_fallback("grid_jitter")
+    # window-boundary structures build (memoized) before the timed span,
+    # like every other fused variant
+    shared_win = (
+        _hist_shared_windows(block, params, j_pad, mesh)
+        if block.regular_ts is not None else None
+    )
     t0 = _time.perf_counter()
     if block.regular_ts is not None:
-        lo, hi, t_first, t_last, out_t = _hist_shared_windows(
-            block, params, j_pad, mesh
-        )
+        lo, hi, t_first, t_last, out_t = shared_win
         if mesh is not None:
             before = _fused_hist_shared_sharded_jit._cache_size()
             out = _fused_hist_shared_sharded_jit(
@@ -572,6 +904,24 @@ def fused_hist_range_aggregate(func: str, block, gids_padded,
                 num_groups, is_delta, q is not None,
             )
             compiled = _fused_hist_shared_jit._cache_size() > before
+    elif jwm is not None:
+        hwa = _hist_jwm_args(jwm)
+        if mesh is not None:
+            before = _fused_hist_jitter_sharded_jit._cache_size()
+            out = _fused_hist_jitter_sharded_jit(
+                mesh, func, block.vals, block.ts_dev, hwa,
+                np.int32(params.window_ms), gids_padded, les, qv,
+                num_groups, is_delta, q is not None,
+            )
+            compiled = _fused_hist_jitter_sharded_jit._cache_size() > before
+        else:
+            before = _fused_hist_jitter_jit._cache_size()
+            out = _fused_hist_jitter_jit(
+                func, block.vals, block.ts_dev, hwa,
+                np.int32(params.window_ms), gids_padded, les, qv,
+                num_groups, is_delta, q is not None,
+            )
+            compiled = _fused_hist_jitter_jit._cache_size() > before
     elif mesh is not None:
         before = _fused_hist_sharded_jit._cache_size()
         out = _fused_hist_sharded_jit(
@@ -714,6 +1064,63 @@ def _batched_mxu_jit(func, epilogue, vals, raw, baseline, W_u, F_u, L_u,
 
 
 @functools.partial(jax.jit, static_argnames=(
+    "func", "epilogue", "u_map", "num_groups", "is_counter", "is_delta",
+    "fetch"
+))
+def _batched_jitter_jit(func, epilogue, vals, dev, raw, wm_u, window_ms_u,
+                        gids_q, n_real, qv_q, u_map: tuple,
+                        num_groups: int, is_counter: bool, is_delta: bool,
+                        fetch: str):
+    """Batched twin of _fused_jitter_jit: the jitter kernel evaluates once
+    per UNIQUE window from the stacked window-structure tuple ``wm_u``
+    (each field [U, ...]; sliced per unrolled window), per-lane epilogues
+    as in _batched_general_jit — lane math identical to the single-query
+    jitter program, so batched lanes stay bit-equal to unbatched."""
+    from .mxu_jitter import jitter_range_kernel
+
+    sj_u = [
+        jitter_range_kernel(
+            func, vals, dev, raw, *(a[u] for a in wm_u), window_ms_u[u],
+            is_counter=is_counter, is_delta=is_delta, fetch=fetch,
+        )
+        for u in range(max(u_map) + 1)
+    ]
+    outs = [
+        _apply_epilogue(sj_u[u_map[i]], epilogue, gids_q[i], n_real,
+                        qv_q[i], num_groups)
+        for i in range(len(u_map))
+    ]
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *outs)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "func", "epilogue", "u_map", "num_groups", "is_counter", "is_delta",
+    "fetch"
+))
+def _batched_masked_jit(func, epilogue, mba, wm_u, window_ms_u, maxdev,
+                        gids_q, n_real, qv_q, u_map: tuple, num_groups: int,
+                        is_counter: bool, is_delta: bool, fetch: str):
+    """Batched twin of _fused_masked_jit (masked sidecar shared across
+    windows, masked window structures stacked per unique window)."""
+    from .mxu_jitter import jitter_masked_kernel
+
+    sj_u = [
+        jitter_masked_kernel(
+            func, *mba, *(a[u] for a in wm_u), window_ms_u[u],
+            is_counter=is_counter, is_delta=is_delta, fetch=fetch,
+            maxdev=maxdev,
+        )
+        for u in range(max(u_map) + 1)
+    ]
+    outs = [
+        _apply_epilogue(sj_u[u_map[i]], epilogue, gids_q[i], n_real,
+                        qv_q[i], num_groups)
+        for i in range(len(u_map))
+    ]
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *outs)
+
+
+@functools.partial(jax.jit, static_argnames=(
     "mesh", "func", "epilogue", "u_map", "num_steps", "num_groups",
     "is_counter", "is_delta"
 ))
@@ -802,19 +1209,22 @@ def _batched_sharded_mxu_jit(mesh, func, epilogue, vals, raw, baseline, W_u,
 _BATCH_STACK_MEMO_MAX = 64
 
 
-def _batched_stacks(block, lanes, j_pad: int, use_mxu: bool, hist: bool,
+def _batched_stacks(block, lanes, j_pad: int, variant: str, hist: bool,
                     mesh):
     """Device-resident stacked batch inputs, memoized on the block per
     (sorted) batch composition: group-id stack [Q_pad, S], lane->unique
     window index vector, and the unique windows' parameter vectors (or MXU
-    window-matrix / hist boundary stacks). A recurring dashboard round —
-    the steady state the batcher exists for — pays ZERO host->device
-    copies after its first occurrence. qv is NOT part of the memo (built
-    per call): quantile sweeps must reuse the same stacks.
+    window-matrix / jitter-structure / hist boundary stacks). A recurring
+    dashboard round — the steady state the batcher exists for — pays ZERO
+    host->device copies after its first occurrence. qv is NOT part of the
+    memo (built per call): quantile sweeps must reuse the same stacks.
 
-    The memo key embeds id(gids_dev) per lane; those arrays are themselves
-    memoized on the block (group_ids_memo / zero_gids), so ids are stable
-    for the block's lifetime and the key can never alias across variants."""
+    The memo key embeds the kernel ``variant`` (mxu|jitter|masked|general —
+    the grid metadata half of the cache identity: a jittered block's
+    stacks can never serve a regular-grid program shape or vice versa) and
+    id(gids_dev) per lane; those arrays are themselves memoized on the
+    block (group_ids_memo / zero_gids), so ids are stable for the block's
+    lifetime and the key can never alias across variants."""
     from ..singleflight import memo_on
 
     sig = tuple(
@@ -822,7 +1232,7 @@ def _batched_stacks(block, lanes, j_pad: int, use_mxu: bool, hist: bool,
          int(l[2].window_ms), id(l[0]))
         for l in lanes
     )
-    key = (use_mxu, hist, j_pad, mesh is not None, sig)
+    key = (variant, hist, j_pad, mesh is not None, sig)
     cache = block.__dict__.get("_batch_stacks")
     if cache is not None and len(cache) > _BATCH_STACK_MEMO_MAX:
         cache.clear()  # bounded: stacks rebuild in one call
@@ -853,7 +1263,7 @@ def _batched_stacks(block, lanes, j_pad: int, use_mxu: bool, hist: bool,
                 w_u=jnp.asarray(np.asarray(
                     [w for _, _, w in ukeys], np.int32)),
             )
-        elif use_mxu:
+        elif variant == "mxu":
             from .mxu_kernels import window_matrices
 
             wms = [
@@ -872,6 +1282,34 @@ def _batched_stacks(block, lanes, j_pad: int, use_mxu: bool, hist: bool,
                 window_ms_u=jnp.asarray(np.asarray(
                     [w for _, _, w in ukeys], np.float32)),
                 idx_u=stk("d_idx"),
+            )
+        elif variant in ("jitter", "masked"):
+            from .mxu_jitter import (
+                jitter_window_matrices,
+                masked_window_matrices,
+            )
+
+            build_wm = (jitter_window_matrices if variant == "jitter"
+                        else masked_window_matrices)
+            wms = [
+                build_wm(block, so, sm, j_pad, w) for so, sm, w in ukeys
+            ]
+            if not all(w.ok for w in wms):
+                # a merged window not wider than the deviation band: the
+                # per-lane dispatch degrades to the general kernel, which
+                # the batched program shape here does not model — raise so
+                # the scheduler falls back to per-lane unbatched execution
+                raise RuntimeError(
+                    f"{variant} window bound fails for a batched window"
+                )
+            take = _jwm_args if variant == "jitter" else _mwm_args
+            st.update(
+                wm_u=tuple(
+                    jnp.stack([take(w)[k] for w in wms])
+                    for k in range(len(take(wms[0])))
+                ),
+                window_ms_u=jnp.asarray(np.asarray(
+                    [w for _, _, w in ukeys], np.float32)),
             )
         else:
             st.update(
@@ -896,22 +1334,36 @@ def fused_batched_scalar(func: str, epilogue: tuple, block, lanes,
     everything else (func, epilogue statics, kernel variant, j_pad) is
     uniform across the group by construction of the coalescing key
     (query/scheduler.py). Returns the stacked [Q_pad, ...] outputs; callers
-    take lane i's ``[:G_i]`` rows (or its [k, J] winner pair). MXU-vs-
-    general selection matches _fused_dispatch exactly so a batched lane
-    computes through the same kernel variant as its unbatched execution
-    would."""
+    take lane i's ``[:G_i]`` rows (or its [k, J] winner pair). Kernel
+    variant selection matches _fused_dispatch exactly (_grid_variant) so a
+    batched lane computes through the same kernel variant as its unbatched
+    execution would. Combinations the batched program set does not model —
+    mesh + jitter/masked, pallas-promoted irregular grids, a merged window
+    failing the jitter safety bound — RAISE, which the scheduler turns
+    into per-lane unbatched execution (batching is an optimization, never
+    a correctness risk)."""
     import time as _time
 
     from ..metrics import record_kernel_dispatch
 
     raw = block.raw if block.raw is not None else block.vals
     n_real = np.int32(block.n_series)
-    use_mxu = (
-        block.regular_ts is not None
-        and func in FUSED_MXU_FUNCS
-        and not (is_delta and func in ("irate", "idelta"))
-    )
-    st = _batched_stacks(block, lanes, j_pad, use_mxu, False, mesh)
+    variant, _reason = _grid_variant(block, func, is_delta)
+    if not batch_variant_supported(block, func, epilogue[0], is_delta, mesh):
+        # defensive backstop — the scheduler consults the same predicate
+        # before grouping, so this fires only for requests that bypassed it
+        raise RuntimeError(
+            f"batched programs do not model the {variant} variant here: "
+            "per-lane dispatch"
+        )
+    if _reason is not None:
+        # batched lanes degrade to the general kernel exactly like their
+        # unbatched executions would — keep the grid_* taxonomy counting
+        # (once per launch) so batched deployments don't undercount it
+        from ..metrics import record_fused_fallback
+
+        record_fused_fallback(_reason)
+    st = _batched_stacks(block, lanes, j_pad, variant, False, mesh)
     padded = _pad_lanes(lanes)
     u_idx, _ukeys = _unique_windows(padded, block.base_ms)
     u_map = tuple(u_idx)
@@ -919,7 +1371,7 @@ def fused_batched_scalar(func: str, epilogue: tuple, block, lanes,
     kind = epilogue[1] if epilogue[0] == "agg" else epilogue[0]
     name = f"batch_{'mesh_' if mesh is not None else ''}fused_{kind}_{func}"
     t0 = _time.perf_counter()
-    if use_mxu:
+    if variant == "mxu":
         from .mxu_kernels import fetch_strategy
 
         args = (
@@ -930,6 +1382,25 @@ def fused_batched_scalar(func: str, epilogue: tuple, block, lanes,
             num_groups, is_counter, is_delta, fetch_strategy(),
         )
         fn = _batched_sharded_mxu_jit if mesh is not None else _batched_mxu_jit
+    elif variant == "jitter":
+        from .mxu_kernels import fetch_strategy
+
+        args = (
+            func, epilogue, block.vals, block.ts_dev, raw, st["wm_u"],
+            st["window_ms_u"], st["gids_q"], n_real, qv_q, u_map,
+            num_groups, is_counter, is_delta, fetch_strategy(),
+        )
+        fn = _batched_jitter_jit
+    elif variant == "masked":
+        from .mxu_kernels import fetch_strategy
+
+        args = (
+            func, epilogue, _mgrid_args(block.mgrid), st["wm_u"],
+            st["window_ms_u"], np.float32(block.mgrid.maxdev_ms),
+            st["gids_q"], n_real, qv_q, u_map,
+            num_groups, is_counter, is_delta, fetch_strategy(),
+        )
+        fn = _batched_masked_jit
     else:
         args = (
             func, epilogue, block.ts, block.vals, block.lens, block.baseline,
@@ -970,7 +1441,13 @@ def fused_batched_hist(func: str, block, lanes, num_groups: int, j_pad: int,
     )
 
     shared = block.regular_ts is not None
-    st = _batched_stacks(block, lanes, j_pad, False, True, mesh)
+    if not batch_variant_supported(block, func, "hist", is_delta, mesh):
+        # unbatched hist dispatch takes the jitter shared-boundary variant
+        # on near-regular grids (fused_hist_range_aggregate); the batched
+        # program set does not model it — defensive backstop behind the
+        # scheduler's pre-grouping check (same predicate)
+        raise RuntimeError("jittered hist grid: per-lane dispatch")
+    st = _batched_stacks(block, lanes, j_pad, "general", True, mesh)
     padded = _pad_lanes(lanes)
     u_idx, _ukeys = _unique_windows(padded, block.base_ms)
     u_map = tuple(u_idx)

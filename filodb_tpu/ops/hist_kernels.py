@@ -204,6 +204,125 @@ def _hist_range_shared(func, vals, lo, hi, t_first, t_last, out_t, window,
     raise ValueError(f"unknown histogram range function {func}")
 
 
+def _hist_range_jitter(func, vals, dev, hwa, window, is_delta: bool):
+    """Near-regular (jittered) grid form of hist_range_kernel: the SHARED
+    certain-range boundary vectors [J] (clo/chi from the nominal grid,
+    mxu_jitter.JitterWindowMatrices) replace the O(S*J*T) per-series
+    boundary compare, and the <=1 uncertain slot per window boundary is
+    resolved per series from the staged deviations — a handful of [S, J, B]
+    gathers at shared slot indices. Window membership is EXACT (the same
+    certain/uncertain decomposition as the scalar jitter kernel;
+    PeriodicSamplesMapper.scala:256 contract), so results match the general
+    kernel on the same data. ``hwa`` is the flat structure tuple
+    (aggregations-side _hist_jwm_args order)."""
+    (clo, chi, idx, count0, c0pos, has_klo, has_khi, F0_rel, L0_rel,
+     Klo_rel, Khi_rel, blo_rel, ehi_rel) = hwa
+    f32 = vals.dtype
+    T = vals.shape[1]
+    nan = jnp.nan
+
+    def tk(x, i):  # x [S, T(, B)], shared [J] indices -> [S, J(, B)]
+        return jnp.take(x, jnp.clip(i, 0, T - 1), axis=1)
+
+    dKlo, dKhi = tk(dev, idx[3]), tk(dev, idx[4])
+    in_lo = has_klo[None, :] & (dKlo > blo_rel[None, :])
+    in_hi = has_khi[None, :] & (dKhi <= ehi_rel[None, :])
+    cnt = count0[None, :] + in_lo + in_hi  # [S, J]
+    has3 = (cnt > 0)[:, :, None]
+    il3, ih3 = in_lo[:, :, None], in_hi[:, :, None]
+    c0 = c0pos[None, :]
+    c03 = c0pos[None, :, None]
+
+    def w3(m1, a, m2, b_, c):
+        return jnp.where(m1, a, jnp.where(m2, b_, c))
+
+    if func in ("last", "last_over_time"):
+        vL0, vKlo, vKhi = tk(vals, idx[1]), tk(vals, idx[3]), tk(vals, idx[4])
+        return jnp.where(has3, w3(ih3, vKhi, c03, vL0, vKlo), nan)
+    if func == "sum_over_time" or (is_delta and func in ("rate", "increase")):
+        cs = jnp.cumsum(vals, axis=1)
+        cs = jnp.concatenate([jnp.zeros_like(cs[:, :1]), cs], axis=1)
+        s = (jnp.take(cs, jnp.clip(chi, 0, T), axis=1)
+             - jnp.take(cs, jnp.clip(clo, 0, T), axis=1))
+        vKlo, vKhi = tk(vals, idx[3]), tk(vals, idx[4])
+        s = s + jnp.where(il3, vKlo, 0.0) + jnp.where(ih3, vKhi, 0.0)
+        if func == "rate":
+            s = s / (window.astype(f32) * 1e-3)
+        return jnp.where(has3, s, nan)
+    if func in ("rate", "increase", "delta"):
+        vF0, vL0 = tk(vals, idx[0]), tk(vals, idx[1])
+        vKlo, vKhi = tk(vals, idx[3]), tk(vals, idx[4])
+        dF0, dL0 = tk(dev, idx[0]), tk(dev, idx[1])
+        v_first = w3(il3, vKlo, c03, vF0, vKhi)
+        v_last = w3(ih3, vKhi, c03, vL0, vKlo)
+        # boundary times RELATIVE to each window's start (f32 ms — same
+        # precision contract as the scalar jitter kernel)
+        tf_rel = w3(in_lo, Klo_rel[None, :] + dKlo, c0,
+                    F0_rel[None, :] + dF0, Khi_rel[None, :] + dKhi)
+        tl_rel = w3(in_hi, Khi_rel[None, :] + dKhi, c0,
+                    L0_rel[None, :] + dL0, Klo_rel[None, :] + dKlo)
+        dlt = v_last - v_first  # [S, J, B]
+        sampled = (tl_rel - tf_rel) * 1e-3
+        dur_start = tf_rel * 1e-3
+        dur_end = (window.astype(f32) - tl_rel) * 1e-3
+        avg_dur = sampled / jnp.maximum(cnt - 1.0, 1.0)
+        thresh = avg_dur * 1.1
+        ds = jnp.where(dur_start >= thresh, avg_dur / 2.0, dur_start)
+        de = jnp.where(dur_end >= thresh, avg_dur / 2.0, dur_end)
+        factor = (sampled + ds + de) / jnp.maximum(sampled, 1e-30)
+        res = dlt * factor[:, :, None]
+        if func == "rate":
+            res = res / (window.astype(f32) * 1e-3)
+        return jnp.where((cnt >= 2)[:, :, None], res, nan)
+    raise ValueError(f"unknown histogram range function {func}")
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "func", "num_groups", "is_delta", "quantile"
+))
+def _fused_hist_jitter_jit(func, vals, dev, hwa, window, gids, les, qv,
+                           num_groups: int, is_delta: bool, quantile: bool):
+    """Jitter-grid twin of _fused_hist_shared_jit: shared certain-range
+    boundaries + per-series one-slot corrections, epilogue in-program."""
+    from .aggregations import _segment_aggregate_jit
+
+    sjb = _hist_range_jitter(func, vals, dev, hwa, window, is_delta)
+    S, J, B = sjb.shape
+    gjb = _segment_aggregate_jit(
+        "sum", sjb.reshape(S, J * B), gids, num_groups + 1
+    )[:num_groups].reshape(num_groups, J, B)
+    if quantile:
+        return histogram_quantile(qv, gjb, les)
+    return gjb
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "mesh", "func", "num_groups", "is_delta", "quantile"
+))
+def _fused_hist_jitter_sharded_jit(mesh, func, vals, dev, hwa, window, gids,
+                                   les, qv, num_groups: int, is_delta: bool,
+                                   quantile: bool):
+    """Series-sharded twin of _fused_hist_jitter_jit (replicated window
+    structure rides the closure; [S, T, B] vals and [S, T] dev row bands)."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..jax_compat import shard_map
+
+    axis = mesh.axis_names[0]
+
+    def local(vals_l, dev_l, gids_l):
+        sjb = _hist_range_jitter(func, vals_l, dev_l, hwa, window, is_delta)
+        return _hist_sharded_combine(
+            sjb, gids_l, les, qv, num_groups, quantile, axis
+        )
+
+    return shard_map(
+        local, mesh=mesh,
+        in_specs=(P(axis, None, None), P(axis, None), P(axis)),
+        out_specs=P(), check=False,
+    )(vals, dev, gids)
+
+
 @functools.partial(jax.jit, static_argnames=(
     "func", "num_groups", "is_delta", "quantile"
 ))

@@ -548,25 +548,22 @@ def _dispatch_range_function(
             )
             if res is not None:
                 return res
-    import os as _os
+    from .pallas_kernels import (
+        PALLAS_FUNCS,
+        pallas_enabled,
+        run_pallas_range_function,
+    )
 
-    pallas_mode = _os.environ.get("FILODB_PALLAS", "auto")
-    if pallas_mode != "0":
-        from .pallas_kernels import PALLAS_FUNCS, run_pallas_range_function
+    if func in PALLAS_FUNCS and not args and pallas_enabled():
+        import jax as _jax
 
-        if func in PALLAS_FUNCS and not args:
-            import jax as _jax
-
-            on_tpu = _jax.devices()[0].platform not in ("cpu",)
-            # measured on TPU v5e (BENCH_LOCAL.json pallas_vs_general): the
-            # fused one-pass VMEM kernel beats the multi-pass general path by
-            # ~23% on irregular blocks, so "auto" selects it on real
-            # hardware; on CPU only when forced (interpret mode is for tests)
-            if on_tpu or pallas_mode == "1":
-                return run_pallas_range_function(
-                    func, block, params, is_counter=is_counter, is_delta=is_delta,
-                    interpret=not on_tpu,
-                )
+        # the ONE FILODB_PALLAS policy (pallas_kernels.pallas_enabled),
+        # shared with the fused dispatch ladder: the one-pass VMEM kernel
+        # on real hardware, interpret mode on CPU only when forced
+        return run_pallas_range_function(
+            func, block, params, is_counter=is_counter, is_delta=is_delta,
+            interpret=_jax.devices()[0].platform in ("cpu",),
+        )
     j_pad = pad_steps(params.num_steps)
     start_off = np.int32(params.start_ms - block.base_ms)
     if func in SORTED_FUNCS:

@@ -52,7 +52,8 @@ class JitterWindowMatrices:
     (nominal grid, output grid, window) triple."""
 
     def __init__(self, nominal_ts: np.ndarray, n_valid: int, maxdev_ms: int,
-                 start_off: int, step_ms: int, num_steps: int, window_ms: int):
+                 start_off: int, step_ms: int, num_steps: int, window_ms: int,
+                 put=None):
         R = nominal_ts[:n_valid].astype(np.int64)
         T = len(nominal_ts)
         J = num_steps
@@ -142,13 +143,21 @@ class JitterWindowMatrices:
             has_khi, e - R[np.clip(khi, 0, m - 1)], -(2 * md) - 1
         ).astype(np.float32)
 
+        # certain-range boundary indices in plain [J] form: the histogram
+        # jitter variant fetches [S, J, B] rows at these SHARED indices
+        # (jnp.take along T) instead of building [T, J] one-hots per bucket
+        self.clo = np.clip(clo, 0, T).astype(np.int32)
+        self.chi = np.clip(chi, 0, T).astype(np.int32)
+
         # min/max tile hierarchy + edge one-hots build LAZILY (the edge
         # matrix is [T, 2*_TILE*J] — by far the biggest structure here, and
         # only min/max_over_time reads it)
         self._clo, self._chi, self._T, self._J = clo, chi, T, J
         self._minmax_built = False
 
-        put = jax.device_put
+        put = self._put = put if put is not None else jax.device_put
+        self.d_clo = put(self.clo)
+        self.d_chi = put(self.chi)
         self.d_W0 = put(self.W0)
         self.d_SEL = put(self.SEL)
         self.d_count0 = put(self.count0)
@@ -177,7 +186,7 @@ class JitterWindowMatrices:
          self.edge_idx) = build_minmax_structures(
             self._clo, self._chi, self._T, self._J
         )
-        put = jax.device_put
+        put = self._put
         self.d_tile_mask = put(self.tile_mask)
         self.d_edge_onehot = put(self.edge_onehot)
         self.d_edge_valid = put(self.edge_valid)
@@ -190,15 +199,21 @@ def _cached_window_matrices(block, cache_attr: str, nominal_ts, n_valid: int,
                             num_steps: int, window_ms: int) -> JitterWindowMatrices:
     """One per-block memoization discipline for both the aligned-jitter and
     masked grid sources (keyed on the query window parameters), via the
-    shared keyed single-flight so racing builders construct once."""
+    shared keyed single-flight so racing builders construct once. A
+    series-sharded block (mesh superblock) uploads the matrices REPLICATED
+    across its mesh — the placement the shard_map fused program consumes,
+    committed once at build (same contract as mxu_kernels.window_matrices)."""
     from ..singleflight import memo_on
+    from .staging import replicated_put
 
+    mesh = getattr(block, "placement", None)
     key = (int(start_off), int(step_ms), int(num_steps), int(window_ms))
     return memo_on(
         block, cache_attr, key,
         lambda: JitterWindowMatrices(
             np.asarray(nominal_ts), n_valid, maxdev_ms,
             start_off, step_ms, num_steps, window_ms,
+            put=replicated_put(mesh) if mesh is not None else None,
         ),
     )
 
@@ -246,13 +261,23 @@ def jitter_range_kernel(
     S, T = vals.shape
     J = W0.shape[1]
     use_gather = use_gather_fetch(fetch, idx)
+    # gather mode: ONE five-row gather per source plane, memoized at trace
+    # time — XLA's CPU gather streams the source plane per op, so two
+    # gathers of different rows from one plane cost two plane passes while
+    # the full [5, J] index set costs barely more than either (5*S*J
+    # fetched vs the S*T plane read). Branches slice the rows they need;
+    # values are bit-identical to per-row gathers.
+    _planes: dict = {}
 
     def sel(x, rows):
         """Fetch the named selection rows of x as [S, len(rows), J]."""
         r = np.array(rows)
         if use_gather:
-            g = jnp.take(x, idx[r].reshape(-1), axis=1)
-            return g.reshape(S, len(rows), J)
+            full = _planes.get(id(x))
+            if full is None:
+                full = jnp.take(x, idx.reshape(-1), axis=1).reshape(S, 5, J)
+                _planes[id(x)] = full
+            return full[:, r, :]
         M = SEL.reshape(T, 5, J)[:, r, :].reshape(T, len(rows) * J)
         a = jax.lax.dot(x, M, precision=jax.lax.Precision.HIGHEST)
         return a.reshape(S, len(rows), J)
@@ -468,6 +493,7 @@ def jitter_masked_kernel(
     is_counter: bool = False,
     is_delta: bool = False,
     fetch: str = "auto",
+    maxdev=None,
 ):
     """Missing-scrape variant of jitter_range_kernel: per-slot validity masks
     replace the equal-count assumption. Per-series window counts come from
@@ -475,7 +501,18 @@ def jitter_masked_kernel(
     fetches — no extra matmul), and first/last selections read the
     host-precomputed forward/backward fills at SHARED slot indices — so a
     dropped scrape costs a few fetches, not a fall to the general path.
-    Same window-semantics contract: PeriodicSamplesMapper.scala:256."""
+    Same window-semantics contract: PeriodicSamplesMapper.scala:256.
+
+    With ``maxdev`` (the grid's maxdev_ms) the GATHER mode runs a LEAN
+    fetch plan exploiting the time-fill invariant (staging.masked_fills):
+    at a valid slot ffd == bfd == dev (|.| <= maxdev) while a hole pushes
+    ffd below -maxdev and bfd above it, so boundary membership, slot
+    validity and the boundary values all come from the fill planes — no
+    validity fetches at all, and the hot counter-rate path drops from 11
+    gather ops over 16 rows to 6 ops over 14 rows. Selected values are bit-identical to the classic plan
+    (fills COPY the staged values at valid slots), so gather-vs-matmul
+    parity is preserved; gathers on the CPU backend are the dominant cost
+    of this kernel, which is what the jitter+holes bench ratio gates."""
     from .mxu_kernels import use_gather_fetch
 
     f32 = jnp.float32
@@ -483,12 +520,23 @@ def jitter_masked_kernel(
     S, T = vals.shape
     J = W0.shape[1]
     use_gather = use_gather_fetch(fetch, idx)
+    lean = use_gather and maxdev is not None
+    # exact-row gather memo: gathers dominate this kernel's cost on CPU
+    # (roughly linear in fetched rows, with a per-op floor), so identical
+    # (plane, rows) fetches dedup at trace time and the LEAN plan below
+    # fetches each plane's row UNION once
+    _memo: dict = {}
 
     def sel(x, rows):
         r = np.array(rows)
         if use_gather:
-            g = jnp.take(x, idx[r].reshape(-1), axis=1)
-            return g.reshape(S, len(rows), J)
+            key = (id(x), tuple(rows))
+            got = _memo.get(key)
+            if got is None:
+                got = jnp.take(x, idx[r].reshape(-1), axis=1).reshape(
+                    S, len(rows), J)
+                _memo[key] = got
+            return got
         M = SEL.reshape(T, 5, J)[:, r, :].reshape(T, len(rows) * J)
         a = jax.lax.dot(x, M, precision=jax.lax.Precision.HIGHEST)
         return a.reshape(S, len(rows), J)
@@ -496,16 +544,31 @@ def jitter_masked_kernel(
     def mmW0(x):
         return jax.lax.dot(x, W0, precision=jax.lax.Precision.HIGHEST)
 
-    dKlo, dKhi = (a for a in sel(dev, (_KLO, _KHI)).swapaxes(0, 1))
-    vaKlo, vaKhi = (a for a in sel(valid, (_KLO, _KHI)).swapaxes(0, 1))
-    in_lo = has_klo[None, :] & (dKlo > blo_rel[None, :]) & (vaKlo > 0)
-    in_hi = has_khi[None, :] & (dKhi <= ehi_rel[None, :]) & (vaKhi > 0)
+    if lean:
+        # membership + validity from the time fills alone: ffd@klo is dev
+        # at a valid klo and <= -(interval - maxdev) < blo_rel at a hole
+        # (symmetrically bfd@khi vs ehi_rel), and |ffd@clo| <= maxdev is
+        # exactly valid[clo]. Fetch each plane's full row union here —
+        # the rate family reuses ffd@L0 / bfd@F0 for its window-edge
+        # times, and the sel memo makes the reuse free
+        Fd = sel(ffd, (_F0, _L0, _KLO))
+        ffdF0, ffdL0, dKlo = Fd[:, 0, :], Fd[:, 1, :], Fd[:, 2, :]
+        Bd = sel(bfd, (_F0, _KHI))
+        bfdF0, dKhi = Bd[:, 0, :], Bd[:, 1, :]
+        in_lo = has_klo[None, :] & (dKlo > blo_rel[None, :])
+        in_hi = has_khi[None, :] & (dKhi <= ehi_rel[None, :])
+        vaF0 = jnp.where(jnp.abs(ffdF0) <= maxdev, f32(1.0), f32(0.0))
+    else:
+        dKlo, dKhi = (a for a in sel(dev, (_KLO, _KHI)).swapaxes(0, 1))
+        vaKlo, vaKhi = (a for a in sel(valid, (_KLO, _KHI)).swapaxes(0, 1))
+        in_lo = has_klo[None, :] & (dKlo > blo_rel[None, :]) & (vaKlo > 0)
+        in_hi = has_khi[None, :] & (dKhi <= ehi_rel[None, :]) & (vaKhi > 0)
+        vaF0 = sel(valid, (_F0,))[:, 0, :]
     # per-series certain-range sample count from the validity prefix sum:
     # count over [clo, chi) = cc[chi-1] - cc[clo] + valid[clo]; the gather
     # form reads clipped garbage where the grid's certain range is empty, so
     # gate on the grid-level c0pos (the matmul's zero columns do the same)
     ccF0, ccL0 = (a for a in sel(cc, (_F0, _L0)).swapaxes(0, 1))
-    vaF0 = sel(valid, (_F0,))[:, 0, :]
     cnt0v = jnp.where(c0pos_g[None, :], ccL0 - ccF0 + vaF0, 0.0)
     cnt = cnt0v + in_lo + in_hi
     has = cnt > 0
@@ -563,9 +626,21 @@ def jitter_masked_kernel(
         ffvL0 = sel(ffv, (_L0,))[:, 0, :]
         return jnp.where(has, vlast(ffvL0, vKlo, vKhi), nan)
     if func in ("rate", "increase", "delta"):
-        vKlo, vKhi = (a for a in sel(vals, (_KLO, _KHI)).swapaxes(0, 1))
-        bfvF0, bfdF0 = (a[:, 0, :] for a in (sel(bfv, (_F0,)), sel(bfd, (_F0,))))
-        ffvL0, ffdL0 = (a[:, 0, :] for a in (sel(ffv, (_L0,)), sel(ffd, (_L0,))))
+        if lean:
+            # the backward fill at a VALID klo/khi IS the staged value
+            # there (fills copy), so ONE bfv fetch serves all three
+            # first/last selection sources; every selected site is valid
+            # by its gate, so values stay bit-identical to the classic
+            # plan. The window-edge times (bfd@F0, ffd@L0) were already
+            # fetched with the membership rows above.
+            Bv = sel(bfv, (_F0, _KLO, _KHI))
+            bfvF0, vKlo, vKhi = Bv[:, 0, :], Bv[:, 1, :], Bv[:, 2, :]
+        else:
+            vKlo, vKhi = (a for a in sel(vals, (_KLO, _KHI)).swapaxes(0, 1))
+            bfvF0 = sel(bfv, (_F0,))[:, 0, :]
+            bfdF0 = sel(bfd, (_F0,))[:, 0, :]
+            ffdL0 = sel(ffd, (_L0,))[:, 0, :]
+        ffvL0 = sel(ffv, (_L0,))[:, 0, :]
         v_first = w3(in_lo, vKlo, c0pos, bfvF0, vKhi)
         v_last = vlast(ffvL0, vKlo, vKhi)
         tf_rel = w3(in_lo, Klo_rel[None, :] + dKlo, c0pos,
@@ -579,8 +654,12 @@ def jitter_masked_kernel(
         avg_dur = sampled / jnp.maximum(cnt - 1.0, 1.0)
         thresh = avg_dur * 1.1
         if is_counter and func != "delta":
-            rKlo, rKhi = (a for a in sel(raw, (_KLO, _KHI)).swapaxes(0, 1))
-            bfrawF0 = sel(bfraw, (_F0,))[:, 0, :]
+            if lean:
+                Br = sel(bfraw, (_F0, _KLO, _KHI))
+                bfrawF0, rKlo, rKhi = Br[:, 0, :], Br[:, 1, :], Br[:, 2, :]
+            else:
+                rKlo, rKhi = (a for a in sel(raw, (_KLO, _KHI)).swapaxes(0, 1))
+                bfrawF0 = sel(bfraw, (_F0,))[:, 0, :]
             v_first_raw = w3(in_lo, rKlo, c0pos, bfrawF0, rKhi)
             dur_zero = jnp.where(
                 dlt > 0, sampled * (v_first_raw / jnp.maximum(dlt, 1e-30)), jnp.inf
@@ -739,6 +818,7 @@ def run_masked_jitter_range_function(func, block: StagedBlock, params,
         wm.d_blo_rel, wm.d_ehi_rel,
         np.float32(params.window_ms),
         is_counter=is_counter, is_delta=is_delta, fetch=fetch,
+        maxdev=np.float32(g.maxdev_ms),
     )
 
 

@@ -127,6 +127,22 @@ PALLAS_FUNCS = {
 }
 
 
+def pallas_enabled() -> bool:
+    """The ONE FILODB_PALLAS policy, shared by the legacy range-function
+    dispatch (kernels._dispatch_range_function) and the fused variant
+    ladder (aggregations._pallas_variant): "0" disables outright; "auto"
+    (default) selects the one-pass VMEM kernel on real accelerators only
+    (measured ~23% over the multi-pass general path on irregular blocks,
+    BENCH_LOCAL.json pallas_vs_general); "1" forces it everywhere —
+    interpret mode on CPU, which is for tests."""
+    import os
+
+    mode = os.environ.get("FILODB_PALLAS", "auto")
+    if mode == "0":
+        return False
+    return jax.devices()[0].platform not in ("cpu",) or mode == "1"
+
+
 @functools.partial(jax.jit, static_argnames=("func", "is_counter", "is_delta"))
 def finish(func: str, agg: dict, start_off, step_ms, window_ms,
            is_counter: bool = False, is_delta: bool = False):

@@ -147,14 +147,19 @@ class MaskedGrid:
     # two shared-index fetches instead of a [S,T]x[T,J] matmul
     cc: np.ndarray | None = None
 
-    def to_device(self):
+    def to_device(self, put=None):
+        """``put`` overrides the placement of every [S, T'] array (a
+        series-sharded superblock passes its row-band sharding so the
+        masked fused program spans the mesh without a gather)."""
         import jax
 
+        if put is None:
+            put = jax.device_put
         for f in ("valid", "vals", "dev", "raw", "ffv", "ffd", "bfv", "bfd",
                   "ff2v", "ff2d", "bfraw", "cc"):
             a = getattr(self, f)
             if a is not None:
-                setattr(self, f, jax.device_put(a))
+                setattr(self, f, put(a))
         return self
 
 
@@ -193,7 +198,16 @@ def _snap_slots(cleaned) -> tuple[float, float, list] | None:
 def masked_fills(valid, m_vals, m_dev, m_raw, R):
     """Host-precomputed forward/backward fills over slot-aligned masked
     arrays (the MaskedGrid fill semantics); R is the full-length int64
-    nominal offset vector. Returns (ffv, ffd, bfv, bfd, ff2v, ff2d, bfraw)."""
+    nominal offset vector. Returns (ffv, ffd, bfv, bfd, ff2v, ff2d, bfraw).
+
+    Slots with NO valid neighbor in the fill direction carry value 0 and a
+    SIGNED time sentinel (-3e38 forward, +3e38 backward) instead of 0: the
+    kernels never select such slots, and the sentinel keeps the fill-time
+    invariant the masked kernel's lean gather mode relies on — at a VALID
+    slot t, ffd[t] == bfd[t] == dev[t] (|.| <= maxdev), while at a hole
+    ffd is <= -(interval - maxdev) and bfd >= interval - maxdev — so
+    window-boundary membership and slot validity are decidable from the
+    time fills alone, without fetching the validity plane."""
     T = valid.shape[1]
     V = valid > 0
     tind = np.arange(T)
@@ -207,21 +221,21 @@ def masked_fills(valid, m_vals, m_dev, m_raw, R):
     ff2i = np.where(ffi >= 1, gather(ffi, ffi - 1), -1)
     Rf = R.astype(np.float64)
 
-    def fill(vsrc, idx):
+    def fill(vsrc, idx, t_sentinel):
         ok = (idx >= 0) & (idx < T)
         v = np.where(ok, gather(vsrc, idx), 0.0).astype(np.float32)
         dd = np.where(
             ok,
             (Rf[np.clip(idx, 0, T - 1)] - Rf[tind[None, :]])
             + gather(m_dev, idx),
-            0.0,
+            t_sentinel,
         ).astype(np.float32)
         return v, dd
 
-    ffv, ffd = fill(m_vals, ffi)
-    bfv, bfd = fill(m_vals, bfi)
-    ff2v, ff2d = fill(m_vals, ff2i)
-    bfraw = fill(m_raw, bfi)[0] if m_raw is not None else None
+    ffv, ffd = fill(m_vals, ffi, -3e38)
+    bfv, bfd = fill(m_vals, bfi, 3e38)
+    ff2v, ff2d = fill(m_vals, ff2i, -3e38)
+    bfraw = fill(m_raw, bfi, 3e38)[0] if m_raw is not None else None
     return ffv, ffd, bfv, bfd, ff2v, ff2d, bfraw
 
 
@@ -458,8 +472,58 @@ class StagedBlock:
         if self.ts_dev is not None:
             self.ts_dev = put(self.ts_dev)
         if self.mgrid is not None:
-            self.mgrid.to_device()
+            self.mgrid.to_device(put if self.placement is not None else None)
         return self
+
+
+def detect_shared_grid(out_ts: np.ndarray, lens: np.ndarray, n: int,
+                       T: int, S: int):
+    """Shared-grid classification over packed [S, T] timestamp rows — the
+    ONE rule used by per-shard staging (stage_series /
+    stage_histogram_series) AND superblock concatenation (concat_blocks), so
+    a cross-shard superblock keeps the same fast-path eligibility its member
+    blocks had. Returns ``(regular, nominal, ts_dev, maxdev)``:
+
+    - regular [T] when every real series shares one exact timestamp vector;
+    - else nominal [T] + ts_dev [S, T] + maxdev when every series has the
+      same sample count and each sample lies within half the minimum
+      nominal interval of the per-slot midrange grid (the mxu_jitter bound:
+      at most ONE uncertain slot per window boundary);
+    - (None, None, None, 0) otherwise (caller may still try the masked
+      missing-scrape grid)."""
+    if n <= 0 or not (lens[:n] == lens[0]).all() or lens[0] == 0:
+        return None, None, None, 0
+    if not (out_ts[:n] != out_ts[0]).any():
+        return out_ts[0], None, None, 0
+    if lens[0] < 2:
+        return None, None, None, 0
+    m = int(lens[0])
+    real = out_ts[:n, :m].astype(np.int64)
+    nom, dev, md = nominal_midrange(real)
+    min_int = int(np.diff(nom).min()) if m >= 2 else 0
+    if min_int > 0 and 2 * md < min_int:
+        nominal = np.full(T, TS_PAD, dtype=np.int32)
+        nominal[:m] = nom.astype(np.int32)
+        ts_dev = np.zeros((S, T), dtype=np.float32)
+        ts_dev[:n, :m] = dev.astype(np.float32)
+        return None, nominal, ts_dev, md
+    return None, None, None, 0
+
+
+def grid_class(block) -> str:
+    """Classification of a staged (super)block's time grid — the fused
+    kernel-variant ladder (ops/aggregations) and the /debug/superblocks
+    introspection both key on it: ``regular`` (exact shared grid, MXU
+    window matmuls) > ``jitter`` (near-regular, certain-matmul + boundary
+    corrections) > ``holes`` (near-regular with missed scrapes, masked
+    sidecar) > ``irregular`` (general / Pallas gather-scan)."""
+    if block.regular_ts is not None:
+        return "regular"
+    if block.nominal_ts is not None:
+        return "jitter"
+    if getattr(block, "mgrid", None) is not None:
+        return "holes"
+    return "irregular"
 
 
 def nominal_midrange(real: np.ndarray):
@@ -560,30 +624,10 @@ def stage_series(
             out_vals[i, :m] = (vals.astype(np.float64) - b).astype(dtype)
         else:
             out_vals[i, :m] = vals.astype(dtype)
-    regular = None
-    nominal = None
-    ts_dev = None
-    maxdev = 0
     mgrid = None
-    if n > 0 and (lens[:n] == lens[0]).all() and lens[0] > 0:
-        if not (out_ts[:n] != out_ts[0]).any():
-            regular = out_ts[0]
-        elif lens[0] >= 2:
-            # near-regular detection: shared nominal grid = per-slot midrange
-            # (minimax-optimal: minimizes the max deviation), deviations must
-            # stay under half the minimum nominal interval so at most ONE
-            # sample per window boundary has uncertain membership
-            # (see mxu_jitter.py)
-            m = int(lens[0])
-            real = out_ts[:n, :m].astype(np.int64)
-            nom, dev, md = nominal_midrange(real)
-            min_int = int(np.diff(nom).min()) if m >= 2 else 0
-            if min_int > 0 and 2 * md < min_int:
-                nominal = np.full(T, TS_PAD, dtype=np.int32)
-                nominal[:m] = nom.astype(np.int32)
-                ts_dev = np.zeros((S, T), dtype=np.float32)
-                ts_dev[:n, :m] = dev.astype(np.float32)
-                maxdev = md
+    regular, nominal, ts_dev, maxdev = detect_shared_grid(
+        out_ts, lens, n, T, S
+    )
     if n > 1 and regular is None and nominal is None:
         # unequal counts (or equal counts on misaligned slots): try the
         # missing-scrape masked grid before resigning to the general path
@@ -983,15 +1027,17 @@ def stage_histogram_series(
             out_vals[i, :m] = (vals.astype(np.float64) - b).astype(dtype)
         else:
             out_vals[i, :m] = vals.astype(dtype)
-    # shared-regular-grid detection, same rule as scalar staging: the fused
-    # hist kernels then use series-independent [J] window boundaries instead
-    # of the O(S*J*T) per-series compare (ops/hist_kernels shared variant)
-    regular = None
-    if n > 0 and (lens[:n] == lens[0]).all() and lens[0] > 0:
-        if not (out_ts[:n] != out_ts[0]).any():
-            regular = out_ts[0]
+    # shared-grid detection, same rule as scalar staging: regular grids get
+    # the series-independent [J] window boundaries (ops/hist_kernels shared
+    # variant), NEAR-regular (jittered scrape) grids get the certain-range
+    # boundaries + per-series one-slot corrections (jitter variant) instead
+    # of the O(S*J*T) per-series compare
+    regular, nominal, ts_dev, maxdev = detect_shared_grid(
+        out_ts, lens, n, T, S
+    )
     return StagedBlock(out_ts, out_vals, lens, base_ms, baseline, n,
-                       part_refs or [], regular_ts=regular)
+                       part_refs or [], regular_ts=regular,
+                       nominal_ts=nominal, ts_dev=ts_dev, maxdev_ms=maxdev)
 
 
 def _slot_align(shard, part_ids, column, series, start_ms: int, end_ms: int):
@@ -1159,8 +1205,41 @@ def concat_blocks(blocks, force_raw: bool = False,
             ext = np.full(T, TS_PAD, np.int32)
             ext[: len(regular)] = regular
             regular = ext
+    # grid classification does NOT stop at "not exactly regular": re-detect
+    # the near-regular (jittered scrape) and masked (missing-scrape) grids
+    # over the CONCATENATED rows, so a cross-shard superblock keeps the
+    # jitter-tolerant fused kernels available instead of silently dropping
+    # to the multi-pass general path (the jitter5pct 1.70x / jitter+holes
+    # 4.85x gap). Per-shard blocks estimated their nominal grids
+    # independently; the midrange over the full row set re-derives one
+    # common grid with the same 2*maxdev < min-interval safety bound, and
+    # the masked build snaps every row onto one slot grid with validity
+    # holes. Truly irregular data fails both checks and stays general.
+    nominal = ts_dev = None
+    maxdev = 0
+    mgrid = None
+    if regular is None and S > 0:
+        _reg2, nominal, ts_dev, maxdev = detect_shared_grid(
+            ts, lens, S, T, Sp
+        )
+        if _reg2 is not None:
+            # members' advertised grids differed (padded widths) but the
+            # real rows agree exactly ([T]-wide: row 0 of the concatenated
+            # timestamp array)
+            regular = _reg2
+        elif nominal is None and not is_hist and S > 1 and int(
+            lens[:S].min()
+        ) >= 2:
+            base = real[0].base_ms
+            cleaned = [
+                (ts[i, : lens[i]].astype(np.int64) + base, None)
+                for i in range(S)
+            ]
+            mgrid = _build_masked_grid(cleaned, base, vals, raw, lens, T, Sp)
     out = StagedBlock(ts, vals, lens, real[0].base_ms, baseline, S,
-                      part_refs, raw=raw, regular_ts=regular)
+                      part_refs, raw=raw, regular_ts=regular,
+                      nominal_ts=nominal, ts_dev=ts_dev, maxdev_ms=maxdev,
+                      mgrid=mgrid)
     if not is_hist:
         # f64 continuation state rides along (snapshot — the member blocks'
         # own state keeps evolving under per-shard repairs) so the
@@ -1378,6 +1457,7 @@ class SuperblockCache:
                 entry["shape"] = list(block.vals.shape)
                 entry["is_hist"] = bool(getattr(value, "is_hist", False))
                 entry["stage_mode"] = getattr(value, "stage_mode", None)
+                entry["grid"] = grid_class(block)
             out.append(entry)
         return out
 

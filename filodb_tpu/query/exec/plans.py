@@ -1127,6 +1127,10 @@ def _slice_bucket(block, les, bucket_le: float):
         baseline[..., b_idx] if baseline.ndim == 2 else baseline,
         block.n_series, block.part_refs, raw=scalar_vals,
         regular_ts=block.regular_ts,
+        # a jittered hist block's grid metadata survives the slice so the
+        # scalar jitter fused variant stays available for m_bucket{le=...}
+        nominal_ts=block.nominal_ts, ts_dev=block.ts_dev,
+        maxdev_ms=block.maxdev_ms,
     )
     le_str = "+Inf" if np.isinf(les64[b_idx]) else f"{les64[b_idx]:g}"
     return sliced, le_str
@@ -1719,9 +1723,16 @@ class FusedAggregateExec(ExecPlan):
         one — concurrent queries sharing this superblock + grid/epilogue
         signature coalesce into ONE batched launch — else run the plain
         unbatched dispatch. Disabled batching is byte-identical to the
-        pre-scheduler path."""
+        pre-scheduler path. Kernel variants the batched program set does
+        not model (AGG.batch_variant_supported: mesh + jitter/masked
+        grids, pallas-promoted irregular grids, jittered hist) skip the
+        scheduler outright — paying the batch window for a launch that is
+        guaranteed to fall back per-lane would be pure added latency."""
         sched = getattr(ctx, "dispatch_scheduler", None)
-        if sched is not None and getattr(sched, "enabled", False):
+        if (sched is not None and getattr(sched, "enabled", False)
+                and AGG.batch_variant_supported(
+                    request.block, request.func, request.kind,
+                    request.is_delta, request.mesh)):
             request.timeout_s = ctx.remaining_deadline_s()
             return sched.dispatch(request)
         return request.run_single()

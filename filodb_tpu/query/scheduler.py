@@ -20,7 +20,12 @@ Two cooperating pieces:
   the lane level: N identical specs share one future, never N lanes), and
   identical FULL queries never reach this layer at all — the engine-level
   SingleFlight (coordinator.scheduler, ``coalesce_identical``) already
-  shares one execution among them. The first arrival for a key leads: it
+  shares one execution among them. Lanes group per window triple for
+  executable stability, but a SEALING leader re-merges every still-open
+  group that is compatible on everything else (``FusedRequest.merge_key``)
+  into one mixed-window launch — the ops layer's u_map machinery routes
+  each lane to its own window, bit-parity per lane — counted in
+  ``filodb_batch_merged_windows_total{family}``. The first arrival for a key leads: it
   holds the window open (bounded by ``max_batch``), executes, and
   distributes; a batch-path failure falls back to per-lane unbatched
   execution so batching is strictly an optimization, never a correctness
@@ -380,11 +385,29 @@ class FusedRequest:
         what keeps steady-state serving out of the compiler. Queries with
         near-miss windows still share everything that matters — the staged
         superblock (range alignment, planner._fused_raw_range) and each
-        other's group-by epilogues within their window's group."""
+        other's group-by epilogues within their window's group — and the
+        SEALING leader re-merges compatible window-groups (merge_key) into
+        one mixed-window launch, so one batch still serves them all; the
+        pow2 lane/window padding keeps the merged composition space
+        bounded."""
         p = self.params
         return (
             id(self.block), self.func, self.kind, self.epilogue, self.j_pad,
             p.start_ms, p.step_ms, p.window_ms,
+            self.g_bucket(), self.is_counter, self.is_delta, self.hist_q,
+            self.mesh_desc,
+        )
+
+    def merge_key(self) -> tuple:
+        """Window-group compatibility key: group_key MINUS the grid triple.
+        Groups agreeing on everything but (start, step, window) run the
+        SAME batched program shape with the lane->unique-window map doing
+        the routing (ops/aggregations._unique_windows) — the sealing
+        leader absorbs them into one mixed-window launch, bit-parity per
+        lane (each lane's subgraph is the exact single-query computation
+        over its own window)."""
+        return (
+            id(self.block), self.func, self.kind, self.epilogue, self.j_pad,
             self.g_bucket(), self.is_counter, self.is_delta, self.hist_q,
             self.mesh_desc,
         )
@@ -445,13 +468,18 @@ def _run_batch(requests: list[FusedRequest]) -> list:
 
 class _Group:
     # a group is "sealed" exactly when it is no longer in the scheduler's
-    # _open table (removed under the lock) — joins and seal can never race
-    __slots__ = ("lanes", "closed", "last_join")
+    # _open table (removed under the lock) — joins and seal can never race.
+    # ``stolen`` marks a group absorbed into another leader's mixed-window
+    # batch (set under the same lock): its own leader must NOT execute —
+    # its lanes' futures are settled by the absorbing leader.
+    __slots__ = ("lanes", "closed", "last_join", "mkey", "stolen")
 
-    def __init__(self):
+    def __init__(self, mkey: tuple = ()):
         self.lanes: dict[tuple, tuple[FusedRequest, Future]] = {}
         self.closed = threading.Event()
         self.last_join = time.monotonic()
+        self.mkey = mkey
+        self.stolen = False
 
 
 class DispatchScheduler:
@@ -476,7 +504,7 @@ class DispatchScheduler:
         # Prometheus families are the operator-facing copies
         self.stats = {
             "queries": 0, "batched": 0, "solo": 0, "fallback": 0,
-            "coalesced": 0, "dispatches": 0,
+            "coalesced": 0, "dispatches": 0, "merged_windows": 0,
         }
 
     @property
@@ -496,7 +524,7 @@ class DispatchScheduler:
             group = self._open.get(key)
             leader = group is None
             if leader:
-                group = _Group()
+                group = _Group(mkey=request.merge_key())
                 self._open[key] = group
             have = group.lanes.get(lane)
             group.last_join = time.monotonic()
@@ -521,15 +549,48 @@ class DispatchScheduler:
                 self._waiter(group.closed, self.window_s)
             else:
                 self._collect(group)
+            merged = 0
             with self._lock:
-                if self._open.get(key) is group:
-                    del self._open[key]
-                lanes = list(group.lanes.values())
-                self._queued -= len(lanes)
-            REGISTRY.gauge("filodb_batch_queue_depth").set(
-                float(self._queued)
-            )
-            self._execute(fam, lanes)
+                if group.stolen:
+                    # a compatible window-group's leader absorbed this
+                    # group into its mixed-window batch while we waited —
+                    # it owns our lanes' futures now; just await ours
+                    lanes = None
+                else:
+                    if self._open.get(key) is group:
+                        del self._open[key]
+                    lanes = list(group.lanes.values())
+                    # stickier composition: absorb still-open groups that
+                    # agree on everything but the window triple
+                    # (merge_key) into THIS launch — the batched programs'
+                    # u_map machinery routes each lane to its own window,
+                    # bit-parity per lane. Those groups' waiting clients
+                    # get answered by this (earlier) dispatch. max_batch
+                    # bounds the MERGED launch too: it caps unrolled
+                    # program width and stacked-output HBM, and absorbing
+                    # past it would rebuild exactly the oversized
+                    # executables the bound exists to prevent.
+                    for k2 in [k for k, g in self._open.items()
+                               if g.mkey == group.mkey]:
+                        g2 = self._open[k2]
+                        if len(lanes) + len(g2.lanes) > self.max_batch:
+                            continue
+                        del self._open[k2]
+                        g2.stolen = True
+                        g2.closed.set()
+                        lanes.extend(g2.lanes.values())
+                        merged += 1
+                    self._queued -= len(lanes)
+                    self.stats["merged_windows"] += merged
+            if lanes is not None:
+                if merged:
+                    REGISTRY.counter(
+                        "filodb_batch_merged_windows", family=fam
+                    ).inc(merged)
+                REGISTRY.gauge("filodb_batch_queue_depth").set(
+                    float(self._queued)
+                )
+                self._execute(fam, lanes)
         try:
             return fut.result(timeout=max(request.timeout_s, 0.001))
         except FutureTimeout:

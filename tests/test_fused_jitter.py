@@ -1,0 +1,346 @@
+"""Jitter-tolerant fused kernels (doc/perf.md "Jitter-tolerant fused path").
+
+Real scrape traffic jitters and drops samples. The fused superblock engine
+must keep the single-dispatch guarantee for near-regular (jitter) and holey
+(masked) grids: superblock concatenation re-detects the grid class
+(staging.detect_shared_grid / _build_masked_grid), the dispatch ladder
+(ops/aggregations._grid_variant) selects the jitter/masked kernel variants,
+and the mesh twins run the same programs under shard_map. Parity contract:
+fused == reference tree across the epilogue families, NaN masks identical,
+values within float32 accumulation-order tolerance.
+
+Runs on the conftest-forced 8-device virtual CPU mesh (make test-jitter).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from filodb_tpu.coordinator.planner import PlannerParams, QueryEngine
+from filodb_tpu.core.histograms import PROM_DEFAULT
+from filodb_tpu.core.records import RecordBatch, SeriesBatch
+from filodb_tpu.core.schemas import (
+    Dataset,
+    METRIC_TAG,
+    PROM_COUNTER,
+    PROM_HISTOGRAM,
+    shard_for,
+)
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.parallel.mesh import make_mesh
+from filodb_tpu.testkit import kernel_dispatch_total
+
+pytestmark = [pytest.mark.perf, pytest.mark.fused_jitter]
+
+BASE = 1_600_000_000_000
+INTERVAL = 10_000
+N_SHARDS = 8
+N_SAMPLES = 240
+START = (BASE + 600_000) / 1000
+END = START + 900
+STEP = 60
+
+
+def _ingest_counters(ms, dataset, metric, n_series, jitter=0.05,
+                     hole_frac=0.0, seed=7, n_samples=N_SAMPLES,
+                     num_shards=N_SHARDS):
+    rng = np.random.default_rng(seed)
+    # half-interval phase shift: staging ranges are 5m-aligned and 10s
+    # divides 5m, so an unshifted grid puts a slot exactly ON the range
+    # boundary — jitter then clips that slot for SOME series and the
+    # superblock legitimately classifies as "holes". The shift keeps these
+    # fixtures deterministically in the intended grid class (jitter).
+    nominal = (BASE + INTERVAL // 2
+               + (1 + np.arange(n_samples, dtype=np.int64)) * INTERVAL)
+    for i in range(n_series):
+        tags = {METRIC_TAG: metric, "_ws_": "w", "_ns_": "n",
+                "instance": f"h{i}", "job": f"j{i % 4}"}
+        shard = shard_for(tags, spread=3, num_shards=num_shards)
+        dev = np.rint(
+            rng.uniform(-jitter, jitter, n_samples) * INTERVAL
+        ).astype(np.int64) if jitter > 0 else 0
+        ts = nominal + dev
+        vals = np.cumsum(rng.uniform(0, 10, n_samples)) + 1e9
+        keep = np.ones(n_samples, bool)
+        if hole_frac > 0:
+            # endpoints kept (deterministic grid anchor), different
+            # interior slots dropped per series
+            drop = rng.choice(np.arange(1, n_samples - 1),
+                              max(1, int(hole_frac * n_samples)),
+                              replace=False)
+            keep[drop] = False
+        ms.shard(dataset, shard).ingest_series(
+            SeriesBatch(PROM_COUNTER, tags, ts[keep], {"count": vals[keep]})
+        )
+
+
+def _ingest_jittered_hists(ms, dataset, metric, n_series, seed=11):
+    rng = np.random.default_rng(seed)
+    les = PROM_DEFAULT.bounds()
+    B = len(les)
+    nominal = (BASE + INTERVAL // 2
+               + (1 + np.arange(N_SAMPLES, dtype=np.int64)) * INTERVAL)
+    for i in range(n_series):
+        tags = {METRIC_TAG: metric, "_ws_": "w", "_ns_": "n",
+                "instance": f"h{i}"}
+        shard = shard_for(tags, spread=3, num_shards=N_SHARDS)
+        dev = np.rint(
+            rng.uniform(-0.05, 0.05, N_SAMPLES) * INTERVAL
+        ).astype(np.int64)
+        incr = rng.poisson(2.0, size=(N_SAMPLES, B)).astype(np.float64)
+        incr[:, -1] = incr.sum(1)
+        hist = np.cumsum(np.cumsum(incr, axis=1), axis=0)
+        ms.shard(dataset, shard).ingest_series(SeriesBatch(
+            PROM_HISTOGRAM, tags, nominal + dev,
+            {"sum": np.cumsum(rng.uniform(0, 5, N_SAMPLES)),
+             "count": hist[:, -1], "h": hist},
+            bucket_les=les,
+        ))
+
+
+@pytest.fixture(scope="module")
+def store():
+    ms = TimeSeriesMemStore()
+    ms.setup(Dataset("ds"), list(range(N_SHARDS)))
+    _ingest_counters(ms, "ds", "rq_reg", 48, jitter=0.0, seed=3)
+    _ingest_counters(ms, "ds", "rq_jit", 48, jitter=0.05, seed=5)
+    _ingest_counters(ms, "ds", "rq_holes", 48, jitter=0.05, hole_frac=0.01,
+                     seed=9)
+    _ingest_jittered_hists(ms, "ds", "lat_jit", 24)
+    return ms
+
+
+@pytest.fixture(scope="module")
+def engines(store):
+    fused = QueryEngine(store, "ds")
+    sharded = QueryEngine(store, "ds", PlannerParams(mesh=make_mesh()))
+    ref = QueryEngine(store, "ds", PlannerParams(fused_aggregate=False))
+    return fused, sharded, ref
+
+
+def _rows(res):
+    out = {}
+    for g in res.grids:
+        for i, lbls in enumerate(g.labels):
+            h = g.hist_np()
+            out[tuple(sorted(lbls.items()))] = (
+                np.asarray(g.values_np()[i]),
+                None if h is None else np.asarray(h[i]),
+            )
+    return out
+
+
+def assert_parity(engines_subset, q, rtol=2e-4, atol=1e-4):
+    rows = [_rows(e.query_range(q, START, END, STEP))
+            for e in engines_subset]
+    a = rows[0]
+    for b in rows[1:]:
+        assert a.keys() == b.keys(), (q, sorted(a)[:3], sorted(b)[:3])
+        for k in a:
+            va, ha = a[k]
+            vb, hb = b[k]
+            na, nb = np.isnan(va), np.isnan(vb)
+            assert (na == nb).all(), (q, k, "NaN masks differ")
+            np.testing.assert_allclose(
+                va[~na], vb[~nb], rtol=rtol, atol=atol, err_msg=f"{q} {k}"
+            )
+            if ha is not None or hb is not None:
+                assert ha is not None and hb is not None, (q, k)
+                np.testing.assert_allclose(
+                    ha, hb, rtol=rtol, atol=atol, equal_nan=True,
+                    err_msg=f"{q} {k} hist",
+                )
+
+
+# -- fused-vs-reference parity on jittered / holey grids ---------------------
+
+
+OPS = [
+    "sum by (job) (rate({m}[5m]))",
+    "avg(increase({m}[5m]))",
+    "min by (job) (rate({m}[5m]))",
+    "max(rate({m}[5m]))",
+    "count by (job) (sum_over_time({m}[3m]))",
+    "topk(3, rate({m}[5m]))",
+    "quantile(0.9, rate({m}[5m]))",
+]
+
+
+@pytest.mark.parametrize("metric", ["rq_jit", "rq_holes"])
+@pytest.mark.parametrize("q_tpl", OPS)
+def test_fused_parity_jitter_and_holes(engines, metric, q_tpl):
+    fused, sharded, ref = engines
+    assert_parity((fused, ref), q_tpl.format(m=metric))
+
+
+@pytest.mark.parametrize("q_tpl", [
+    "sum by (job) (rate({m}[5m]))",
+    "topk(3, rate({m}[5m]))",
+    "quantile(0.9, rate({m}[5m]))",
+])
+@pytest.mark.parametrize("metric", ["rq_jit", "rq_holes"])
+def test_mesh_parity_jitter_and_holes(engines, metric, q_tpl):
+    """mesh + jitter no longer drops to the sharded general kernel: the
+    shard_map jitter/masked twins must agree with the reference tree."""
+    fused, sharded, ref = engines
+    assert_parity((sharded, fused, ref), q_tpl.format(m=metric))
+
+
+def test_hist_quantile_parity_jittered(engines):
+    fused, sharded, ref = engines
+    q = ("histogram_quantile(0.99, "
+         "sum by (le) (rate(lat_jit_bucket[5m])))")
+    assert_parity((fused, ref), q)
+    assert_parity((sharded, ref), q)
+
+
+# -- warm single-dispatch guarantee ------------------------------------------
+
+
+@pytest.mark.parametrize("metric", ["rq_reg", "rq_jit", "rq_holes"])
+def test_warm_query_is_single_dispatch(engines, metric):
+    fused, _sharded, _ref = engines
+    q = f"sum(rate({metric}[5m]))"
+    fused.query_range(q, START, END, STEP)  # stage + compile + cache warm
+    before = kernel_dispatch_total()
+    fused.query_range(q, START, END, STEP)
+    assert kernel_dispatch_total() - before == 1, (
+        f"warm sum(rate) over a {metric} grid must stay ONE dispatch"
+    )
+
+
+@pytest.mark.parametrize("metric", ["rq_jit", "rq_holes"])
+def test_warm_mesh_query_is_single_dispatch(engines, metric):
+    """The sharded twin: one dispatch spanning the 8-device mesh even on
+    jittered/holey grids (the PR 8 remainder, closed)."""
+    _fused, sharded, _ref = engines
+    q = f"sum(rate({metric}[5m]))"
+    sharded.query_range(q, START, END, STEP)
+    before = kernel_dispatch_total()
+    sharded.query_range(q, START, END, STEP)
+    assert kernel_dispatch_total() - before == 1, (
+        f"warm mesh sum(rate) over a {metric} grid must stay ONE dispatch"
+    )
+
+
+def test_warm_jittered_hist_quantile_is_single_dispatch(engines):
+    fused, _sharded, _ref = engines
+    q = ("histogram_quantile(0.99, "
+         "sum by (le) (rate(lat_jit_bucket[5m])))")
+    fused.query_range(q, START, END, STEP)
+    before = kernel_dispatch_total()
+    fused.query_range(q, START, END, STEP)
+    assert kernel_dispatch_total() - before == 1
+
+
+# -- grid classification + degrade taxonomy ----------------------------------
+
+
+def _fallback_count(reason: str) -> int:
+    from filodb_tpu.metrics import REGISTRY
+
+    for line in REGISTRY.expose().splitlines():
+        if line.startswith(
+            f'filodb_fused_fallback_total{{reason="{reason}"}}'
+        ):
+            return int(float(line.rsplit(" ", 1)[1]))
+    return 0
+
+
+def test_supported_jitter_query_never_degrades(engines):
+    """rate over a jitter5pct grid rides the jitter variant: the
+    grid_jitter degrade reason must NOT fire."""
+    fused, _sharded, _ref = engines
+    before = _fallback_count("grid_jitter")
+    fused.query_range("sum(rate(rq_jit[5m]))", START, END, STEP)
+    assert _fallback_count("grid_jitter") == before
+
+
+def test_unsupported_func_on_jitter_grid_counts_grid_jitter(engines):
+    """A fused function outside the jitter set (changes) on a jittered
+    grid degrades to the general kernel — still fused, still correct —
+    and is counted under the grid_jitter taxonomy entry."""
+    fused, _sharded, ref = engines
+    q = "sum by (job) (changes(rq_jit[5m]))"
+    before = _fallback_count("grid_jitter")
+    assert_parity((fused, ref), q)
+    assert _fallback_count("grid_jitter") > before
+
+
+def test_superblock_cache_isolates_grid_classes(engines, store):
+    """Regular and jittered superblocks coexist as distinct cache entries
+    with their own grid classification; a jittered entry never serves a
+    regular-grid query (results stay stable across interleaved queries)."""
+    fused, _sharded, _ref = engines
+    q_reg = "sum(rate(rq_reg[5m]))"
+    q_jit = "sum(rate(rq_jit[5m]))"
+    first = _rows(fused.query_range(q_reg, START, END, STEP))
+    fused.query_range(q_jit, START, END, STEP)
+    grids = {e["grid"] for e in store._superblock_cache.snapshot()
+             if not e["is_hist"]}
+    assert {"regular", "jitter"} <= grids, grids
+    again = _rows(fused.query_range(q_reg, START, END, STEP))
+    assert first.keys() == again.keys()
+    for k in first:
+        np.testing.assert_array_equal(first[k][0], again[k][0])
+
+
+def test_holey_superblock_classified(engines, store):
+    fused, _sharded, _ref = engines
+    fused.query_range("sum(rate(rq_holes[5m]))", START, END, STEP)
+    grids = {e["grid"] for e in store._superblock_cache.snapshot()}
+    assert "holes" in grids, grids
+
+
+# -- extension under ingest on a jittered block ------------------------------
+
+
+def test_jittered_superblock_extends_under_live_ingest():
+    """Live-edge appends with jittered timestamps must EXTEND the cached
+    jittered superblock in place (append_to_parts' near-nominal batch
+    path) and keep the warm query one dispatch, parity-checked."""
+    from filodb_tpu.metrics import REGISTRY
+
+    def maintenance(outcome):
+        for line in REGISTRY.expose().splitlines():
+            if line.startswith(
+                f'filodb_superblock_maintenance_total{{outcome="{outcome}"}}'
+            ):
+                return int(float(line.rsplit(" ", 1)[1]))
+        return 0
+
+    T = N_SAMPLES
+    ms = TimeSeriesMemStore()
+    ms.setup(Dataset("live"), list(range(4)))
+    _ingest_counters(ms, "live", "rq_live", 16, jitter=0.05, seed=21,
+                     n_samples=T, num_shards=4)
+    eng = QueryEngine(ms, "live")
+    ref = QueryEngine(ms, "live", PlannerParams(fused_aggregate=False))
+    end = (BASE + (T + 60) * INTERVAL) / 1000  # live edge
+    q = "sum(rate(rq_live[5m]))"
+    eng.query_range(q, START, end, STEP)
+    eng.query_range(q, START, end, STEP)
+    rng = np.random.default_rng(33)
+    tags = [dict(p.tags) for sh in ms.shards("live")
+            for p in sh.partitions.values()]
+    # next nominal slot, per-series jitter within the staged bound
+    t_new = (BASE + INTERVAL // 2 + (T + 1) * INTERVAL
+             + np.rint(rng.uniform(-0.04, 0.04, len(tags)) * INTERVAL
+                       ).astype(np.int64))
+    ms.ingest_routed("live", RecordBatch(
+        PROM_COUNTER, t_new, {"count": np.full(len(tags), 1e12)}, tags,
+    ), spread=3)
+    ext_before = maintenance("extend")
+    before = kernel_dispatch_total()
+    r1 = eng.query_range(q, START, end, STEP)
+    assert kernel_dispatch_total() - before == 1
+    assert maintenance("extend") == ext_before + 1
+    r2 = ref.query_range(q, START, end, STEP)
+    a = r1.grids[0].values_np()[0]
+    c = r2.grids[0].values_np()[0]
+    assert (np.isnan(a) == np.isnan(c)).all()
+    m = ~np.isnan(c)
+    np.testing.assert_allclose(a[m], c[m], rtol=2e-4, atol=1e-4)
+    snap = ms._superblock_cache.snapshot()
+    assert snap and snap[0]["grid"] == "jitter"

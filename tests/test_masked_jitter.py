@@ -174,9 +174,14 @@ def test_harmonize_masked_common_grid():
         )
 
 
-def test_mesh_engine_masked_matches_host():
-    """Holey jittered counters through the MESH engine must use the masked
-    mesh kernel (not the slow general path) and match the host engine."""
+def test_mesh_engine_masked_is_fused_single_dispatch():
+    """Holey jittered counters through the MESH engine: the default
+    aggregate path DELEGATES to the sharded fused superblock program,
+    which now covers masked grids (doc/perf.md "Jitter-tolerant fused
+    path") — the warm query must be exactly ONE multi-device dispatch,
+    matching the host engine. The explicit fused opt-out
+    (fused_aggregate=False) still exercises the legacy masked MXU mesh
+    kernel, also parity-checked (it remains the pre-fusion escape hatch)."""
     import jax
 
     import filodb_tpu.parallel.exec as PE
@@ -185,6 +190,7 @@ def test_mesh_engine_masked_matches_host():
     from filodb_tpu.core.schemas import Dataset, METRIC_TAG, PROM_COUNTER, shard_for
     from filodb_tpu.memstore.memstore import TimeSeriesMemStore
     from filodb_tpu.parallel.mesh import make_mesh
+    from filodb_tpu.testkit import kernel_dispatch_total
 
     rng = np.random.default_rng(33)
     n = 150
@@ -204,17 +210,13 @@ def test_mesh_engine_masked_matches_host():
             SeriesBatch(PROM_COUNTER, tags, ts[keep], {"count": vals[keep]})
         )
     host = QueryEngine(ms, "prometheus")
-    # the mesh engine's default aggregate path now DELEGATES to the
-    # sharded fused superblock program (doc/perf.md "Mesh-sharded fused
-    # path"); the masked MXU kernel is the LEGACY engine's fast path, so
-    # pin it via the explicit fused opt-out and check BOTH paths match
-    # the host on missing-scrape data
-    mesh = QueryEngine(ms, "prometheus",
-                       PlannerParams(mesh=make_mesh(jax.devices()[:1]),
-                                     fused_aggregate=False))
+    legacy = QueryEngine(ms, "prometheus",
+                         PlannerParams(mesh=make_mesh(jax.devices()[:1]),
+                                       fused_aggregate=False))
     fused_mesh = QueryEngine(ms, "prometheus",
                              PlannerParams(mesh=make_mesh(jax.devices()[:1])))
     start, end = (BASE + 400_000) / 1000, (BASE + 1_400_000) / 1000
+    q = "sum(rate(rq_total[5m]))"
 
     ran = {"masked": 0}
     orig = PE.MeshAggregateExec._run_masked
@@ -227,12 +229,20 @@ def test_mesh_engine_masked_matches_host():
 
     PE.MeshAggregateExec._run_masked = spy
     try:
-        rh = host.query_range("sum(rate(rq_total[5m]))", start, end, 60)
-        rm = mesh.query_range("sum(rate(rq_total[5m]))", start, end, 60)
-        rf = fused_mesh.query_range("sum(rate(rq_total[5m]))", start, end, 60)
+        rh = host.query_range(q, start, end, 60)
+        rm = legacy.query_range(q, start, end, 60)
+        rf = fused_mesh.query_range(q, start, end, 60)
     finally:
         PE.MeshAggregateExec._run_masked = orig
-    assert ran["masked"] == 1, "legacy mesh must take the masked fast path"
+    assert ran["masked"] == 1, "legacy mesh opt-out keeps its masked path"
+    # the fused delegate covers masked grids: warm query = ONE dispatch
+    before = kernel_dispatch_total()
+    fused_mesh.query_range(q, start, end, 60)
+    assert kernel_dispatch_total() - before == 1, (
+        "warm mesh query over a masked grid must be ONE fused dispatch"
+    )
+    snap = ms._superblock_cache.snapshot()
+    assert any(e.get("grid") == "holes" for e in snap), snap
     vh = np.asarray(rh.grids[0].values_np())
     for rv in (rm, rf):
         vm = np.asarray(rv.grids[0].values_np())

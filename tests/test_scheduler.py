@@ -281,6 +281,35 @@ def test_coalesced_group_is_one_dispatch(engines):
     )
 
 
+def test_compatible_window_groups_merge_into_one_batch(engines):
+    """Lanes group per window triple, but the sealing leader re-merges
+    still-open groups that agree on everything else (merge_key) into ONE
+    mixed-window launch: each lane stays bit-equal to its own sequential
+    execution (the u_map machinery computes one range grid per unique
+    window), and the merge is counted in
+    filodb_batch_merged_windows_total."""
+    from filodb_tpu.metrics import REGISTRY
+
+    batched, sched, seq, _plain = engines
+    # same selector + group-by (one g_bucket), three windows whose
+    # 5m-aligned staging ranges coincide -> one superblock, three
+    # window-groups, all merge-compatible
+    queries = [
+        "sum(rate(http_requests_total[5m]))",
+        "sum(rate(http_requests_total[4m]))",
+        "sum(rate(http_requests_total[3m]))",
+    ]
+    expected = {q: seq.query_range(q, START, END, STEP) for q in queries}
+    merged_before = sched.stats["merged_windows"]
+    got = _run_coalesced(batched, sched, queries)
+    assert sched.stats["merged_windows"] > merged_before, (
+        "compatible window-groups must re-merge into one batch"
+    )
+    for q in queries:
+        assert_bit_equal(got[q], expected[q], ctx=q)
+    assert "filodb_batch_merged_windows_total" in REGISTRY.expose()
+
+
 def test_identical_specs_dedup_onto_one_lane(engines):
     """Identical dispatch specs from distinct queries share one lane (the
     lane-level single-flight): the batch stays minimal and both callers get

@@ -93,10 +93,78 @@ def doc_stems() -> set[str]:
     return out
 
 
+PERF_DOC = ROOT / "doc" / "perf.md"
+
+
+def fused_reason_violations() -> list[str]:
+    """Label-taxonomy lint for ``filodb_fused_fallback_total{reason}``:
+    the canonical set (metrics.FUSED_FALLBACK_REASONS) must match BOTH the
+    doc/perf.md fallback table's rows and every literal reason the code
+    records — a reason recorded but undocumented is an undashboarded
+    series, a documented-but-unrecorded one is a dead runbook row."""
+    out: list[str] = []
+    # canonical set, read from the AST (no imports — runs without jax)
+    canon: set[str] = set()
+    tree = ast.parse((PKG / "metrics.py").read_text())
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Assign) and node.targets
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "FUSED_FALLBACK_REASONS"):
+            for c in ast.walk(node.value):
+                if isinstance(c, ast.Constant) and isinstance(c.value, str):
+                    canon.add(c.value)
+    if not canon:
+        return ["fused-fallback lint: FUSED_FALLBACK_REASONS not found in "
+                "filodb_tpu/metrics.py"]
+    # literal reasons the code records: record_fused_fallback("x") and the
+    # FusedAggregateExec fallback helper self._fall(ctx, "x")
+    recorded: set[str] = set()
+    for path in sorted(PKG.rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        for node in ast.walk(ast.parse(path.read_text())):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = getattr(fn, "attr", None) or getattr(fn, "id", None)
+            if name == "record_fused_fallback" and node.args:
+                a = node.args[0]
+                if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                    recorded.add(a.value)
+            elif name == "_fall" and len(node.args) >= 2:
+                a = node.args[1]
+                if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                    recorded.add(a.value)
+    # documented rows: the doc/perf.md fallback table's `reason` column
+    # (the table under "Reason taxonomy:", up to the next heading — other
+    # two-column tables in the doc are not reason taxonomies)
+    text = PERF_DOC.read_text()
+    m = re.search(r"Reason taxonomy:(.*?)^#", text, re.S | re.M)
+    table = m.group(1) if m else ""
+    documented = set(re.findall(r"^\| `([a-z_]+)` \|", table, re.M))
+    for r in sorted(recorded - canon):
+        out.append(
+            f"fused-fallback reason {r!r} recorded in code but missing from "
+            f"metrics.FUSED_FALLBACK_REASONS (it would be minted as "
+            f"reason=\"unknown\")"
+        )
+    for r in sorted(canon - documented):
+        out.append(
+            f"fused-fallback reason {r!r} is canonical but undocumented — "
+            f"add a row to doc/perf.md's fallback table"
+        )
+    for r in sorted(documented - canon):
+        out.append(
+            f"doc/perf.md documents fused-fallback reason {r!r} that no "
+            f"code can record"
+        )
+    return out
+
+
 def main() -> int:
     code, where = code_stems()
     doc = doc_stems()
-    violations: list[str] = []
+    violations: list[str] = list(fused_reason_violations())
     for s in sorted(code - doc):
         locs = ", ".join(where.get(s, [])[:2])
         violations.append(
