@@ -2,7 +2,7 @@
 
 NATIVE_DIR := filodb_tpu/native
 
-.PHONY: all native test test-chaos test-ingest-chaos test-jitter test-multichip test-observability test-scheduler bench bench-smoke microbench serve clean tpu-watch tpu-watch-bg
+.PHONY: all native test test-chaos test-ingest-chaos test-jitter test-multichip test-observability test-scheduler test-standing bench bench-smoke microbench serve clean tpu-watch tpu-watch-bg
 
 all: native
 
@@ -67,6 +67,15 @@ test-jitter: native
 # equivalence
 test-scheduler: native
 	python -m pytest tests/ -q -m scheduler
+
+# standing-query engine suite (doc/operations.md "Standing queries &
+# recording rules"): delta-maintenance bit-equality vs full re-evaluation
+# across regular/jitter/holes grids and under concurrent in-place
+# extension, zero-dispatch retained refreshes, promotion/demotion
+# hysteresis over the scheduler's recurrence ring, one-materialization SSE
+# fan-out to N subscribers, and recording-rule write-back
+test-standing: native
+	python -m pytest tests/test_standing.py -q -m standing
 
 # observability suite (doc/observability.md): trace propagation + stitching,
 # slow-query log, resource ledger + self-scrape, metrics exposition — plus

@@ -28,7 +28,11 @@ N_SERIES = int(os.environ.get("FILODB_BENCH_SERIES", 100_000))
 # superblock extension exists to hold near 1.0), or "fused_mesh"
 # (single-device vs mesh-sharded fused p50 on a forced 8-device mesh:
 # the sharded superblock's one-dispatch path, doc/perf.md "Mesh-sharded
-# fused path"; value = sharded p50, vs_baseline = scaling ratio)
+# fused path"; value = sharded p50, vs_baseline = scaling ratio), or
+# "standing_refresh" (registered standing query's delta-maintained
+# live-edge refresh vs the pre-standing cold dashboard poll of the same
+# sliding grid, both under live ingest — doc/operations.md "Standing
+# queries & recording rules"; value = cold_p50 / standing_p50)
 WORKLOAD = os.environ.get("FILODB_BENCH_WORKLOAD", "sum_rate")
 # the ONE metric name per workload — emitted by both the success and error
 # JSON paths, and matched against benchmarks/bench_smoke_floor.json entries
@@ -38,6 +42,7 @@ METRIC = {
     "fused_mesh": "fused_mesh_sharded_query_p50",
     "concurrent_qps": "concurrent_qps_16clients_20k",
     "fused_jitter": "fused_jitter_holes_ratio",
+    "standing_refresh": "standing_refresh_speedup",
 }.get(WORKLOAD, "sum_rate_100k_series_range_query_p50")
 # concurrent_qps: client thread count, per-mode measurement window, and the
 # batching window handed to the batched engine (the knob under test)
@@ -955,7 +960,155 @@ def run_benchmark_concurrent_qps():
     }))
 
 
+def run_benchmark_standing_refresh():
+    """Standing-query live-edge refresh cost: the delta path vs a forced
+    full re-dispatch of the same grid, under a live ingest stream
+    (doc/operations.md "Standing queries & recording rules").
+
+    A registered standing query refreshes through the delta path
+    (aligned pinned staging range -> the ONE superblock entry extends in
+    place under the append; suffix-only re-dispatch + retained-partial
+    splice) while a 1-sample/series/100ms stream lands at the live edge
+    (the ingest_impact cadence). The baseline is what the same dashboard
+    panel pays TODAY without the standing engine: a plain query_range
+    poll of the same sliding grid, whose moving end resolves to a NEW
+    superblock cache key every refresh — full restage + full-grid
+    dispatch (cross-query batching off, the default). value =
+    cold_poll_p50 / standing_refresh_p50 (unit "x", HIGHER is better).
+    match = after the stream quiesces, the delta-maintained partials are
+    BIT-EQUAL to a forced full re-evaluation of the same grid AND the
+    delta path actually ran (falling back to full re-dispatch per refresh
+    collapses the ratio toward the warm-full line and flips match)."""
+    import threading
+
+    from filodb_tpu.core.records import RecordBatch
+    from filodb_tpu.core.schemas import METRIC_TAG, PROM_COUNTER
+    from filodb_tpu.coordinator.planner import PlannerParams, QueryEngine
+    from filodb_tpu.standing import StandingEngine
+
+    ms, _ts = build_memstore()
+    _enable_compile_cache()
+    engine = QueryEngine(ms, "prometheus", PlannerParams())
+    q = "sum by (zone) (rate(http_requests_total[5m]))"
+    step_ms = 15_000
+    span_ms = 5_400_000  # the "last 90m" dashboard panel (J = 361 steps)
+    batches = [0]
+    edge_clock = lambda: (  # noqa: E731 — tracks the ingest head
+        BASE + (N_SAMPLES + batches[0]) * INTERVAL_MS + 5_000
+    ) / 1e3
+    se = StandingEngine(engine, {"default_span_ms": span_ms},
+                        clock=edge_clock)
+    sq = se.register(q, step_ms)
+    twin = se.register(q, step_ms)
+    assert sq.mode == "delta", sq.mode_reason
+    t0 = time.perf_counter()
+    se.refresh(sq)  # compile + stage + superblock warm
+    se.refresh(twin, force_full=True)
+    warmup_s = time.perf_counter() - t0
+
+    tags_list = [
+        {METRIC_TAG: "http_requests_total", "_ws_": "demo", "_ns_": "App-2",
+         "instance": f"host-{i}", "zone": f"z{i % 8}"}
+        for i in range(N_SERIES)
+    ]
+    stop = threading.Event()
+
+    def ingester():
+        while not stop.is_set() and batches[0] < MAX_APPEND_BATCHES:
+            b = batches[0]
+            t = BASE + (N_SAMPLES + b) * INTERVAL_MS
+            vals = np.full(N_SERIES, 1e9 + 10.0 * (N_SAMPLES + b + 1))
+            ms.ingest_routed("prometheus", RecordBatch(
+                PROM_COUNTER, np.full(N_SERIES, t, np.int64),
+                {"count": vals}, tags_list,
+            ), spread=3)
+            batches[0] = b + 1
+            stop.wait(0.1)
+
+    # the cold-poll baseline warms its jit/compile state once; its
+    # superblock can never stay warm (that is the point being measured)
+    engine.query_range(q, (BASE + 600_000) / 1e3,
+                       (BASE + 600_000 + span_ms) / 1e3, step_ms / 1e3)
+    th = threading.Thread(target=ingester)
+    th.start()
+    delta_s, cold_s = [], []
+
+    def paced(measure, out, last_b):
+        """One measurement per fresh append, so every round absorbs real
+        live-edge work (never a free already-warm repeat)."""
+        for _ in range(TIMED_RUNS):
+            deadline = time.time() + 2.0
+            while batches[0] == last_b and time.time() < deadline:
+                time.sleep(0.005)
+            last_b = batches[0]
+            t0 = time.perf_counter()
+            measure()
+            out.append(time.perf_counter() - t0)
+        return last_b
+
+    try:
+        # phase A: the standing engine serving the panel alone (extension
+        # + suffix dispatch + render per append)
+        last_b = paced(lambda: se.refresh(sq), delta_s, batches[0])
+        # phase B: the same panel served the pre-standing way, alone under
+        # the same stream — each poll's moving end is a new superblock
+        # cache key, so every refresh restages + dispatches the full grid
+        paced(
+            lambda: engine.query_range(
+                q, edge_clock() - span_ms / 1e3, edge_clock(),
+                step_ms / 1e3,
+            ),
+            cold_s, last_b,
+        )
+    finally:
+        stop.set()
+        th.join()
+    # quiesced parity: the delta-maintained partials vs a forced full
+    # re-evaluation of the same grid over the same aligned superblock
+    se.refresh(sq)
+    t0 = time.perf_counter()
+    se.refresh(twin, force_full=True)
+    warmfull_ms = (time.perf_counter() - t0) * 1e3
+    biteq = (sq.grid_end_ms == twin.grid_end_ms
+             and sq.labels == twin.labels
+             and sq.retained.tobytes() == twin.retained.tobytes())
+    delta_p50 = float(np.median(delta_s) * 1e3)
+    cold_p50 = float(np.median(cold_s) * 1e3)
+    ratio = cold_p50 / delta_p50 if delta_p50 > 0 else 0.0
+    ok = bool(biteq) and sq.stats["delta"] > 0 and sq.stats["errors"] == 0
+    import jax
+
+    backend = jax.devices()[0].platform
+    sys.stderr.write(
+        f"standing_p50={delta_p50:.2f}ms cold_poll_p50={cold_p50:.2f}ms "
+        f"warmfull={warmfull_ms:.2f}ms speedup={ratio:.2f}x "
+        f"delta={sq.stats['delta']} retained={sq.stats['retained']} "
+        f"reset={sq.stats['reset']} biteq={biteq}\n"
+    )
+    print(json.dumps({
+        "metric": METRIC,
+        "value": round(ratio, 3),
+        "unit": "x",
+        "vs_baseline": round(ratio, 2),
+        "backend": backend,
+        "series": N_SERIES,
+        "match": ok,
+        "warmup_s": round(warmup_s, 2),
+        "phases_ms": {
+            "standing_p50": round(delta_p50, 3),
+            "cold_poll_p50": round(cold_p50, 3),
+            "warm_full_ms": round(warmfull_ms, 3),
+            "delta_refreshes": sq.stats["delta"],
+            "retained_refreshes": sq.stats["retained"],
+            "steps_computed": sq.stats["steps_computed"],
+            "steps_retained": sq.stats["steps_retained"],
+        },
+    }))
+
+
 def run_benchmark():
+    if WORKLOAD == "standing_refresh":
+        return run_benchmark_standing_refresh()
     if WORKLOAD == "ingest_impact":
         return run_benchmark_ingest_impact()
     if WORKLOAD == "concurrent_qps":

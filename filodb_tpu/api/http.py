@@ -97,6 +97,10 @@ class PromApiHandler(BaseHTTPRequestHandler):
     # `_system` self-telemetry dataset rides this so the server's own
     # metrics are queryable through the standard (fused) query API
     dataset_engines: dict = {}
+    # standing-query engine (filodb_tpu/standing/): registration +
+    # recording-rules APIs, SSE push subscriptions, /debug/standing.
+    # None = endpoints 404 (engine disabled or embedded without one).
+    standing = None
     auth_token: str | None = None  # optional bearer auth (server factory)
     # zero-arg profiler report hook; wired by the server ONLY when the
     # profiler config block enables it (/debug/profile gate)
@@ -310,9 +314,30 @@ class PromApiHandler(BaseHTTPRequestHandler):
                 return self._remote_read()
             if path == "/api/v1/query_exemplars":
                 return self._query_exemplars()
-            if path in ("/api/v1/rules", "/api/v1/alerts"):
-                kind = "rules" if path.endswith("rules") else "alerts"
-                return self._send(200, J.success({"groups" if kind == "rules" else "alerts": []}))
+            if path == "/api/v1/standing/register" and self.command == "POST":
+                return self._standing_register()
+            if path == "/api/v1/standing/unregister" and self.command == "POST":
+                return self._standing_unregister()
+            if path == "/api/v1/standing/subscribe":
+                return self._standing_subscribe()
+            if path == "/api/v1/standing":
+                if self.standing is None:
+                    return self._send(404, J.error("not_found", "standing engine disabled"))
+                return self._send(200, J.success(self.standing.registry.snapshot()))
+            if path == "/api/v1/rules/record" and self.command == "POST":
+                return self._rules_record()
+            if path == "/debug/standing":
+                if self.standing is None:
+                    return self._send(404, J.error("not_found", "standing engine disabled"))
+                return self._send(200, J.success(self.standing.snapshot()))
+            if path == "/api/v1/rules":
+                # the truthful answer: the standing engine's recording
+                # rules when one is attached, else the empty set
+                groups = (self.standing.rules_payload() if self.standing
+                          is not None else {"groups": []})
+                return self._send(200, J.success(groups))
+            if path == "/api/v1/alerts":
+                return self._send(200, J.success({"alerts": []}))
             if path == "/api/v1/status/flags" or path == "/api/v1/status/config":
                 return self._send(200, J.success({}))
             self._send(404, J.error("not_found", f"unknown path {path}"))
@@ -615,6 +640,133 @@ class PromApiHandler(BaseHTTPRequestHandler):
             )
         return self._send(200, J.success(out))
 
+    # -- standing queries / recording rules (filodb_tpu/standing/) ---------
+
+    def _json_body(self, params) -> dict:
+        """POSTed JSON body (handlers pass their parsed params — the body
+        is consumable only once and _params() stashes it)."""
+        body = self._q(params, "__body__") or ""
+        if not body:
+            return {}
+        try:
+            out = json.loads(body)
+        except ValueError as e:
+            raise ValueError(f"invalid JSON body: {e}") from None
+        if not isinstance(out, dict):
+            raise ValueError("JSON body must be an object")
+        return out
+
+    def _standing_register(self):
+        """Register a standing query: ``{"query", "step", "range"?}`` (step
+        and range in seconds or PromQL durations). Returns its id, mode
+        (delta|full) and grid shape."""
+        if self.standing is None:
+            return self._send(404, J.error("not_found", "standing engine disabled"))
+        p = self._params()
+        body = self._json_body(p)
+        query = body.get("query") or self._q(p, "query")
+        if not query:
+            return self._send(400, J.error("bad_data", "missing query"))
+        step_ms = int(_parse_step(str(body.get("step") or
+                                      self._q(p, "step") or 15)) * 1000)
+        rng = body.get("range") or self._q(p, "range")
+        span_ms = int(_parse_step(str(rng)) * 1000) if rng else None
+        sq = self.standing.register(query, step_ms, span_ms=span_ms)
+        return self._send(200, J.success(sq.snapshot()))
+
+    def _standing_unregister(self):
+        if self.standing is None:
+            return self._send(404, J.error("not_found", "standing engine disabled"))
+        p = self._params()
+        qid = self._json_body(p).get("id") or self._q(p, "id")
+        if not qid:
+            return self._send(400, J.error("bad_data", "missing id"))
+        sq = self.standing.unregister(str(qid))
+        if sq is None:
+            return self._send(404, J.error("not_found", f"no standing query {qid}"))
+        return self._send(200, J.success({"unregistered": qid}))
+
+    def _rules_record(self):
+        """Register a recording rule: ``{"name", "expr", "interval",
+        "range"?}`` — a standing query whose newest closed steps write back
+        into the memstore as the series ``name{group labels}``."""
+        if self.standing is None:
+            return self._send(404, J.error("not_found", "standing engine disabled"))
+        p = self._params()
+        body = self._json_body(p)
+        name = body.get("name") or self._q(p, "name")
+        expr = body.get("expr") or self._q(p, "expr")
+        if not name or not expr:
+            return self._send(400, J.error("bad_data", "missing name or expr"))
+        if not re.fullmatch(r"[a-zA-Z_:][a-zA-Z0-9_:]*", str(name)):
+            return self._send(400, J.error("bad_data", f"invalid rule name {name!r}"))
+        interval_s = _parse_step(str(body.get("interval") or
+                                     self._q(p, "interval") or 15))
+        step_ms = int(interval_s * 1000)
+        rng = body.get("range") or self._q(p, "range")
+        span_ms = int(_parse_step(str(rng)) * 1000) if rng else 4 * step_ms
+        sq = self.standing.register(
+            str(expr), step_ms, span_ms=span_ms, source="rule",
+            rule_name=str(name), eval_interval_s=float(interval_s),
+        )
+        return self._send(200, J.success(sq.snapshot()))
+
+    def _standing_subscribe(self):
+        """SSE push stream for one standing query: the initial frame is
+        the current materialization, then every refresh's payload — the
+        SAME rendered bytes every subscriber receives (one materialization,
+        N sockets). Subscriber counts are bounded per query
+        (``standing.max_subscribers`` → 429 + Retry-After past it)."""
+        from ..standing.hub import CLOSED, SubscriptionLimit
+
+        if self.standing is None:
+            return self._send(404, J.error("not_found", "standing engine disabled"))
+        p = self._params()
+        qid = self._q(p, "id")
+        sq = self.standing.get(str(qid)) if qid else None
+        if sq is None:
+            return self._send(404, J.error("not_found", f"no standing query {qid}"))
+        try:
+            sub = self.standing.hub.subscribe(sq.qid)
+        except SubscriptionLimit as e:
+            return self._send(429, J.error("throttled", str(e)),
+                              headers={"Retry-After": "5"})
+        if self.standing.get(sq.qid) is None:
+            # unregister raced between get() and subscribe(): hub.close
+            # already ran, so this fresh subscription would never receive
+            # a frame (and would resurrect a dead hub entry)
+            self.standing.hub.unsubscribe(sub)
+            return self._send(404, J.error("not_found",
+                                           f"no standing query {qid}"))
+        import queue as _queue
+
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Connection", "close")
+        self.close_connection = True
+        self.end_headers()
+        try:
+            first = sq.last_payload
+            if first:
+                self.wfile.write(b"data: " + first + b"\n\n")
+                self.wfile.flush()
+            while not sub.closed:
+                try:
+                    item = sub.get(timeout=15.0)
+                except _queue.Empty:
+                    self.wfile.write(b": keep-alive\n\n")
+                    self.wfile.flush()
+                    continue
+                if item is CLOSED:
+                    break
+                self.wfile.write(b"data: " + item + b"\n\n")
+                self.wfile.flush()
+        except (BrokenPipeError, ConnectionError, OSError):
+            pass  # client went away — the normal end of an SSE stream
+        finally:
+            self.standing.hub.unsubscribe(sub)
+
     def _ingest_prom(self):
         """Prometheus text exposition ingest (push-gateway style; counters
         route to the prom-counter schema via # TYPE comments)."""
@@ -735,7 +887,8 @@ def make_server(engine: QueryEngine, host: str = "127.0.0.1", port: int = 9090,
                 auth_token: str | None = None,
                 local_engine: QueryEngine | None = None,
                 flush_hook=None,
-                dataset_engines: dict | None = None) -> ThreadingHTTPServer:
+                dataset_engines: dict | None = None,
+                standing=None) -> ThreadingHTTPServer:
     # membership hooks (members_hook/join_hook) are wired as class attrs on
     # the returned server's RequestHandlerClass AFTER start — the registry
     # needs the bound port for its self URL (server.py seed bootstrap)
@@ -744,6 +897,7 @@ def make_server(engine: QueryEngine, host: str = "127.0.0.1", port: int = 9090,
         "BoundHandler", (PromApiHandler,),
         {"engine": engine, "auth_token": auth_token, "local_engine": local_engine,
          "dataset_engines": dict(dataset_engines or {}),
+         "standing": standing,
          "flush_hook": staticmethod(flush_hook) if flush_hook else None},
     )
     return ThreadingHTTPServer((host, port), handler)
@@ -752,10 +906,11 @@ def make_server(engine: QueryEngine, host: str = "127.0.0.1", port: int = 9090,
 def serve_background(engine: QueryEngine, host: str = "127.0.0.1", port: int = 0,
                      auth_token: str | None = None,
                      local_engine: QueryEngine | None = None,
-                     flush_hook=None, dataset_engines: dict | None = None):
+                     flush_hook=None, dataset_engines: dict | None = None,
+                     standing=None):
     """Start the API server on a thread; returns (server, actual_port)."""
     srv = make_server(engine, host, port, auth_token, local_engine, flush_hook,
-                      dataset_engines)
+                      dataset_engines, standing)
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
     return srv, srv.server_address[1]

@@ -113,6 +113,33 @@ DEFAULTS: dict = {
         "peers": [], "owned_shards": None,
         "seeds": [], "advertise_url": None, "refresh_interval_s": 30,
     },
+    # standing-query engine (filodb_tpu/standing/, doc/operations.md
+    # "Standing queries & recording rules"): hot recurring live-edge
+    # queries promote into registered standing queries whose [G, J]
+    # partials are DELTA-maintained on ingest append and served by push
+    # (SSE fan-out) — plus the recording-rules API. Promotion needs
+    # promote_min_count recurrences inside promote_window_s from a query
+    # whose grid end trails wall clock by at most promote_live_lag_ms;
+    # auto-promoted queries demote after demote_idle_s of no recurrence
+    # and no subscribers (hysteresis). max_subscribers bounds SSE fan-out
+    # per standing query; key_ring_max bounds the scheduler's retained
+    # per-key recurrence ring; align_ms quantizes staging ranges so every
+    # refresh rides ONE extendable superblock cache entry.
+    "standing": {
+        "enabled": True,
+        "promote_min_count": 8,
+        "promote_window_s": 120.0,
+        "promote_live_lag_ms": 120_000,
+        "demote_idle_s": 600.0,
+        "demote_retry_s": 3600.0,
+        "max_standing": 64,
+        "max_subscribers": 64,
+        "refresh_debounce_ms": 250,
+        "key_ring_max": 512,
+        "default_span_ms": 1_800_000,
+        "align_ms": 300_000,
+        "tick_s": 0.5,
+    },
     # downsampling (reference downsample resolutions)
     "downsample": {"enabled": False, "periods_m": [5, 60]},
     # cardinality quotas: list of {"prefix": ["ws","ns"], "quota": N}

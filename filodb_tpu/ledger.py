@@ -125,7 +125,8 @@ class DeviceLedger:
     ``filodb_device_bytes`` gauges as a scrape-time collector and serves
     the drift check (``verify``) behind ``/debug/resources``."""
 
-    KINDS = ("staged_block", "superblock", "compile_cache")
+    KINDS = ("staged_block", "superblock", "compile_cache",
+             "standing_state")
 
     def __init__(self):
         self._lock = threading.Lock()

@@ -162,6 +162,9 @@ class TimeSeriesShard:
         # conservatively discarding its work — the interval-aware half of
         # the staging-cache invalidation contract.
         self._effects: deque = deque(maxlen=EFFECT_LOG_MAX)
+        # append listeners (standing/maintainer.py): fired outside the
+        # shard lock after each ingest commits — wake signals, not truth
+        self._append_listeners: list[Callable] = []
         # entries are StageEntry objects (block + bytes + dirty/repairing)
         # data version for query-side staging caches: bumped on every ingest
         # so cached HBM-resident blocks invalidate (reference analog: block
@@ -243,20 +246,68 @@ class TimeSeriesShard:
         with self._lock:
             return self._ingest_effects_since_locked(since_version, lo, hi)
 
-    def _ingest_effects_since_locked(self, since_version: int, lo, hi):
+    def ingest_effects_interval_since(self, since_version: int, lo: int,
+                                      hi: int):
+        """Like :meth:`ingest_effects_since`, but additionally returns the
+        UNION interval of the overlapping effects:
+        ``(reason, eff_lo, eff_hi)`` with ``eff_lo``/``eff_hi`` None unless
+        reason is ``"overlap"``. The standing-query maintainer uses the
+        interval to bound which retained grid steps the appended samples
+        can have touched — a live-edge append dirties only the step
+        SUFFIX whose windows reach ``eff_lo``, so a delta refresh
+        recomputes O(touched steps) instead of the whole grid."""
+        with self._lock:
+            return self._ingest_effects_interval_locked(since_version, lo, hi)
+
+    def _ingest_effects_interval_locked(self, since_version: int, lo, hi):
+        """The ONE effect-log scan (classification + overlap interval)
+        behind both public forms — the staging-cache path and the
+        standing-delta path must never disagree on what counts as
+        covered."""
         if self.version == since_version:
-            return None
+            return None, None, None
         if not self._effects or self._effects[0][0] > since_version + 1:
-            return "log_truncated"
-        reason = None
+            return "log_truncated", None, None
+        eff_lo = eff_hi = None
         for v, elo, ehi, full in self._effects:
             if v <= since_version:
                 continue
             if full:
-                return "full_clear"
+                return "full_clear", None, None
             if elo <= hi and ehi >= lo:
-                reason = "overlap"
-        return reason
+                eff_lo = elo if eff_lo is None else min(eff_lo, elo)
+                eff_hi = ehi if eff_hi is None else max(eff_hi, ehi)
+        if eff_lo is None:
+            return None, None, None
+        return "overlap", int(eff_lo), int(eff_hi)
+
+    # -- append notification (standing/maintainer.py wake signal) ----------
+
+    def add_append_listener(self, cb: Callable) -> None:
+        """Register ``cb(dataset, shard_num, lo_ms, hi_ms, full)`` fired
+        AFTER each ingest commits (outside the shard lock — listeners must
+        never run under it; a listener that re-enters shard APIs would
+        deadlock otherwise). The standing-query maintainer uses this as a
+        WAKE signal only: correctness derives from the effect log
+        (ingest_effects_interval_since), so a lost or duplicated
+        notification is harmless."""
+        self._append_listeners.append(cb)
+
+    def remove_append_listener(self, cb: Callable) -> None:
+        try:
+            self._append_listeners.remove(cb)
+        except ValueError:
+            pass
+
+    def _notify_append(self, lo, hi, full: bool) -> None:
+        for cb in list(self._append_listeners):
+            try:
+                cb(self.dataset, self.shard_num, lo, hi, full)
+            except Exception:  # noqa: BLE001 — a sick listener must not break ingest
+                pass
+
+    def _ingest_effects_since_locked(self, since_version: int, lo, hi):
+        return self._ingest_effects_interval_locked(since_version, lo, hi)[0]
 
     def _clear_stage_cache(self, reason: str = "invalidate") -> None:
         """Wholesale staging-cache clear, crediting the device ledger for
@@ -347,9 +398,11 @@ class TimeSeriesShard:
             if offset >= 0:
                 self._ingested_offset = max(self._ingested_offset, offset)
             self.version += 1
-            self._invalidate_stage_range(min_ts, max_ts,
-                                         len(self.partitions) != np0,
+            new_series = len(self.partitions) != np0
+            self._invalidate_stage_range(min_ts, max_ts, new_series,
                                          raw_lo=raw_min)
+        if n and self._append_listeners:
+            self._notify_append(min_ts, max_ts, new_series or min_ts is None)
         self.stats.rows_ingested += n
         # periodic headroom check on the ingest path (reference
         # ensureFreeSpace runs inside the ingest loop). The full O(partitions)
@@ -364,6 +417,8 @@ class TimeSeriesShard:
         return n
 
     def ingest_series(self, sb: SeriesBatch) -> int:
+        lo = hi = None
+        full = True
         with self._lock:
             self.version += 1
             np0 = len(self.partitions)
@@ -372,17 +427,18 @@ class TimeSeriesShard:
             if len(sb.timestamps):
                 raw = int(sb.timestamps.min())
                 lo = raw if prev_end is None else min(raw, prev_end)
+                hi = int(sb.timestamps.max())
                 # accepted-rows floor, as in ingest(): dropped out-of-order
                 # rows must not veto the append repair
                 acc = raw if prev_end is None else max(raw, prev_end + 1)
-                self._invalidate_stage_range(
-                    lo, int(sb.timestamps.max()),
-                    len(self.partitions) != np0, raw_lo=acc,
-                )
+                full = len(self.partitions) != np0
+                self._invalidate_stage_range(lo, hi, full, raw_lo=acc)
             else:
                 self._record_effect(0, 0, True)
                 self._clear_stage_cache()
-            return n
+        if n and self._append_listeners:
+            self._notify_append(lo, hi, full)
+        return n
 
     def _ingest_series(self, sb: SeriesBatch) -> int:
         pk = sb.partkey

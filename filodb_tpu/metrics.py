@@ -133,6 +133,14 @@ HELP_TEXTS: dict[str, str] = {
     "filodb_device_leaked_bytes": "Bytes held by ledger accounts whose cache died without releasing.",
     "filodb_self_scrapes": "Self-scrape cycles into the _system dataset.",
     "filodb_self_scrape_samples": "Samples ingested into the _system dataset by the self-scraper.",
+    "filodb_standing_queries": "Registered standing queries by maintenance mode (delta|full).",
+    "filodb_standing_refreshes": "Standing-query refreshes by outcome (retained|delta|full|reset|error).",
+    "filodb_standing_refresh_seconds": "Standing-query refresh latency (classify + dispatch + render + fan-out).",
+    "filodb_standing_steps": "Standing-query grid steps per refresh disposition (computed|retained).",
+    "filodb_standing_subscribers": "Live push subscribers across all standing queries.",
+    "filodb_standing_pushes": "Per-subscriber payload deliveries (sent) and stall drops (dropped).",
+    "filodb_standing_promotions": "Standing-query lifecycle events (register|promote|demote).",
+    "filodb_standing_rule_samples": "Samples written back into the memstore by recording rules.",
     "filodb_tpu_probe_healthy": "Last tpu-watch probe outcome (1 healthy, 0 not).",
     "filodb_tpu_probe_age_seconds": "Seconds since the last tpu-watch probe.",
     "filodb_tpu_probes": "tpu-watch probes attempted (from the watch log).",
@@ -538,7 +546,7 @@ SLOW_QUERY_LOG = SlowQueryLog()
 FUSED_FALLBACK_REASONS = frozenset({
     "partial_results", "dispatcher", "mixed_schemas", "hist_scheme",
     "hist_op", "hist_func", "hist_quantile_scalar", "mesh_unsupported",
-    "grid_jitter", "grid_holes",
+    "grid_jitter", "grid_holes", "standing_nondecomposable",
 })
 
 

@@ -1477,6 +1477,85 @@ def fused_batched_hist(func: str, block, lanes, num_groups: int, j_pad: int,
     return out
 
 
+# ---------------------------------------------------------------------------
+# standing-query delta maintenance (filodb_tpu/standing/): retained [G, J]
+# partials + suffix-only re-dispatch + bitwise splice
+# ---------------------------------------------------------------------------
+#
+# A standing query's [G, J] output grid decomposes PER STEP: every fused
+# epilogue computes step j from the samples inside window j alone, so steps
+# are independent panes (the delta-summation move, PAPERS.md, with pane ==
+# output step and bitwise-exact combination). On a live-edge append the
+# appended columns can only touch the step SUFFIX whose windows reach the
+# append interval — the delta refresh re-dispatches ONLY those steps
+# through the SAME fused program ladder (same superblock object, same
+# kernel variant, same per-step math) and splices the retained prefix back
+# in. Two facts make the splice bit-exact rather than merely close, both
+# pinned by tests/test_standing.py across regular/jitter/holes grids:
+#
+# - a suffix-grid dispatch over the SAME staged superblock produces
+#   bit-identical per-step values to the full-grid dispatch (each step's
+#   window reduce runs over the identical [S, T] operand rows; the output
+#   grid start/count only select which independent reduces run);
+# - steps whose windows closed before an in-place extension are bit-stable
+#   across it (appended columns land masked-out of closed windows, and
+#   extension never rewrites resident columns — PR 6's consistency model).
+#
+# True sample-level partial combination (old_sum + appended_sum) was
+# rejected: float addition does not re-associate, so combined open-window
+# partials could never be bit-equal to a full re-evaluation — and bit
+# parity with the normal query path is the property the whole fused engine
+# asserts everywhere else (batched lanes, sharded twins).
+
+# epilogues whose [G, J] output splices per step: exactly the ("agg", op)
+# segment reduces. topk ([k, J] winner rows whose label reconstruction is
+# per-refresh), quantile and fused histogram_quantile keep full re-dispatch
+# (fallback taxonomy: standing_nondecomposable).
+STANDING_DELTA_OPS = frozenset(SIMPLE_AGG_OPS)
+
+
+def standing_delta_eligible(op: str, params=(),
+                            hist_quantile=None) -> bool:
+    """Whether a fused aggregate's epilogue supports standing delta
+    maintenance (per-step retained-partial splicing). Ineligible shapes
+    demote cleanly to full re-dispatch, counted
+    ``filodb_fused_fallback_total{reason="standing_nondecomposable"}``."""
+    return (op in STANDING_DELTA_OPS and not params
+            and hist_quantile is None)
+
+
+def shift_partials(retained: np.ndarray, shift: int,
+                   num_steps: int) -> np.ndarray:
+    """Slide retained [G, J] partials left by ``shift`` whole steps onto a
+    ``num_steps``-wide grid (the dashboard window advancing): steps falling
+    off the front drop, steps not yet computed arrive as NaN (absence) for
+    the delta dispatch to fill."""
+    G = retained.shape[0]
+    out = np.full((G, num_steps), np.nan, dtype=retained.dtype)
+    if shift < retained.shape[1]:
+        keep = retained[:, shift:]
+        n = min(keep.shape[1], num_steps)
+        out[:, :n] = keep[:, :n]
+    return out
+
+
+def splice_partials(retained: np.ndarray, fresh: np.ndarray,
+                    k0: int) -> np.ndarray:
+    """Combine a delta dispatch's [G, J-k0] suffix partials into the
+    retained [G, J] grid in place at step ``k0``. The ONE combination rule
+    of the standing delta path — callers must have verified the group axis
+    matches (same group_ids_memo labels); a mismatch means the block was
+    restaged with a different row set and the refresh must reset instead."""
+    if fresh.shape[0] != retained.shape[0]:
+        raise ValueError(
+            f"standing splice group mismatch: retained G={retained.shape[0]} "
+            f"vs fresh G={fresh.shape[0]}"
+        )
+    n = retained.shape[1] - k0
+    retained[:, k0:] = fresh[:, :n]
+    return retained
+
+
 def group_ids_memo(block, series_labels, by, without,
                    strip_metric: bool = False):
     """``group_ids_for`` memoized on the (super)block object: repeated

@@ -1729,6 +1729,12 @@ class FusedAggregateExec(ExecPlan):
         scheduler outright — paying the batch window for a launch that is
         guaranteed to fall back per-lane would be pure added latency."""
         sched = getattr(ctx, "dispatch_scheduler", None)
+        if sched is not None and hasattr(sched, "observe_key"):
+            # recurrence feed for standing-query promotion: every fused
+            # dispatch counts, batching enabled or not (the ring is the
+            # retained per-key state the batch groups used to drop at
+            # close) — see query/scheduler.KeyStatsRing
+            self._observe_key(ctx, sched)
         if (sched is not None and getattr(sched, "enabled", False)
                 and AGG.batch_variant_supported(
                     request.block, request.func, request.kind,
@@ -1736,6 +1742,47 @@ class FusedAggregateExec(ExecPlan):
             request.timeout_s = ctx.remaining_deadline_s()
             return sched.dispatch(request)
         return request.run_single()
+
+    def _observe_key(self, ctx: QueryContext, sched) -> None:
+        """Record this dispatch in the scheduler's per-key recurrence ring.
+        The key normalizes away the sliding live-edge times (a dashboard
+        re-issuing the same panel with a fresh ``end=now`` must count as
+        ONE recurring key): dataset + the root span's PromQL + grid shape.
+        The descriptor carries what the standing promoter needs to
+        re-register the query; ``end_lag_ms`` (wall clock minus the grid
+        end) distinguishes live-edge dashboards from historical scans."""
+        import time as _time
+
+        if getattr(ctx, "standing_refresh", False):
+            # the maintainer's own refresh dispatches must not feed the
+            # ring — a standing query would keep itself "hot" forever
+            return
+        root = getattr(ctx, "trace_root", None)
+        promql = root.tags.get("promql") if root is not None else None
+        if root is not None and root.parent_id is not None:
+            # a remote child's leg: the ORIGIN observes the query once
+            return
+        key = (
+            ctx.dataset, promql, self.step_ms, self.window_ms,
+            self.end_ms - self.start_ms,
+        ) if promql else (
+            ctx.dataset, self.op, self.function, self.filters,
+            tuple(self.by or ()), tuple(self.without or ()),
+            self.step_ms, self.window_ms, self.end_ms - self.start_ms,
+        )
+        now_ms = _time.time() * 1000.0
+        sched.observe_key(key, {
+            "promql": promql,
+            "dataset": ctx.dataset,
+            "op": self.op,
+            "function": self.function,
+            "params": self.params,
+            "hist_quantile": self.hist_quantile,
+            "step_ms": self.step_ms,
+            "window_ms": self.window_ms,
+            "span_ms": self.end_ms - self.start_ms,
+            "end_lag_ms": now_ms - float(self.end_ms),
+        })
 
     def do_execute(self, ctx: QueryContext) -> QueryResult:
         from ...metrics import span

@@ -53,6 +53,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future
 from concurrent.futures import TimeoutError as FutureTimeout
 from dataclasses import dataclass
@@ -321,6 +322,111 @@ class _Admitted:
 
 
 # ---------------------------------------------------------------------------
+# per-key recurrence ring (standing-query promotion feed)
+# ---------------------------------------------------------------------------
+
+
+class KeyStatsRing:
+    """Bounded per-key recurrence/age ring over fused-dispatch coalescing
+    keys. The scheduler's only per-key state used to be the OPEN batch
+    group, dropped the moment the group sealed — recurrence (the signal
+    that millions of users watch the SAME dashboard) was thrown away every
+    batch window. The ring RETAINS it: one LRU-bounded entry per
+    normalized key with a cumulative count, first/last-seen wall clocks, a
+    short deque of recent observation times (the promotion-burst window)
+    and the latest descriptor (promql, grid shape, live-edge lag) the
+    standing-query promoter needs to re-register the query
+    (standing/registry.py). Observed on EVERY fused dispatch — batching
+    enabled or not — so promotion works on latency-critical deployments
+    that keep ``batch_window_ms`` at 0. Exposed at ``/debug/standing``
+    alongside the promoted/demoted registry state."""
+
+    RECENT_MAX = 32  # per-entry burst window (>= any sane promote_min_count)
+
+    __slots__ = ("max_entries", "_entries", "_lock", "_clock")
+
+    def __init__(self, max_entries: int = 512,
+                 clock: Callable[[], float] = time.time):
+        self.max_entries = max(int(max_entries), 1)
+        self._entries: dict[Any, dict] = {}  # insertion-ordered (LRU)
+        self._lock = threading.Lock()
+        self._clock = clock
+
+    def observe(self, key, desc: dict | None = None) -> None:
+        """Count one recurrence of ``key``; ``desc`` (latest wins) carries
+        whatever the promoter needs to act on the key."""
+        now = self._clock()
+        with self._lock:
+            e = self._entries.pop(key, None)
+            if e is None:
+                e = {
+                    "count": 0,
+                    "first_s": now,
+                    "recent": deque(maxlen=self.RECENT_MAX),
+                    "desc": None,
+                }
+            e["count"] += 1
+            e["last_s"] = now
+            e["recent"].append(now)
+            if desc is not None:
+                e["desc"] = desc
+            self._entries[key] = e  # move-to-back = most recent
+            while len(self._entries) > self.max_entries:
+                self._entries.pop(next(iter(self._entries)))
+
+    @staticmethod
+    def _copy(e: dict) -> dict:
+        # ``recent`` rendered as an immutable tuple: observe() keeps
+        # appending to the live deque from query threads, and iterating a
+        # deque mid-mutation raises — callers only ever see copies
+        return {
+            "count": e["count"],
+            "first_s": e["first_s"],
+            "last_s": e["last_s"],
+            "recent": tuple(e["recent"]),
+            "desc": e.get("desc"),
+        }
+
+    def entries(self) -> list[tuple[Any, dict]]:
+        """(key, entry-copy) pairs, most-recently-seen last. Copies taken
+        under the ring's lock — safe to iterate while observe() keeps
+        mutating the live entries."""
+        with self._lock:
+            return [(k, self._copy(e)) for k, e in self._entries.items()]
+
+    def get(self, key) -> dict | None:
+        with self._lock:
+            e = self._entries.get(key)
+            return self._copy(e) if e is not None else None
+
+    def snapshot(self, limit: int = 64) -> list[dict]:
+        """The /debug/standing rendering: newest-first, descriptors
+        included, recent-burst deques rendered as their span."""
+        now = self._clock()
+        out = []
+        items = self.entries()
+        for key, e in reversed(items[-limit:] if limit else items):
+            recent = e["recent"]
+            out.append({
+                "key": repr(key),
+                "count": e["count"],
+                "age_s": round(now - e["first_s"], 3),
+                "idle_s": round(now - e["last_s"], 3),
+                "recent": len(recent),
+                "recent_span_s": (
+                    round(recent[-1] - recent[0], 3) if len(recent) > 1
+                    else 0.0
+                ),
+                "desc": e.get("desc"),
+            })
+        return out
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+# ---------------------------------------------------------------------------
 # micro-batching dispatch
 # ---------------------------------------------------------------------------
 
@@ -493,19 +599,31 @@ class DispatchScheduler:
     ends (default: ``event.wait(window_s)``)."""
 
     def __init__(self, window_ms: float = 0.0, max_batch: int = 32,
-                 waiter: Callable[[threading.Event, float], Any] | None = None):
+                 waiter: Callable[[threading.Event, float], Any] | None = None,
+                 key_ring_max: int = 512):
         self.window_s = max(float(window_ms), 0.0) / 1e3
         self.max_batch = max(int(max_batch), 1)
         self._waiter = waiter
         self._open: dict[tuple, _Group] = {}
         self._lock = threading.Lock()
         self._queued = 0
+        # per-key recurrence/age ring (standing-query promotion feed):
+        # retained across batch close, observed on every fused dispatch
+        # whether batching is enabled or not (window_ms 0 keeps the ring
+        # alive with batching off)
+        self.key_ring = KeyStatsRing(key_ring_max)
         # cumulative introspection counters (/debug/scheduler); the
         # Prometheus families are the operator-facing copies
         self.stats = {
             "queries": 0, "batched": 0, "solo": 0, "fallback": 0,
             "coalesced": 0, "dispatches": 0, "merged_windows": 0,
         }
+
+    def observe_key(self, key, desc: dict | None = None) -> None:
+        """Record one recurrence of a fused-dispatch key in the retained
+        ring (called by FusedAggregateExec for every fused dispatch — the
+        batching path and the plain unbatched path alike)."""
+        self.key_ring.observe(key, desc)
 
     @property
     def enabled(self) -> bool:
@@ -665,10 +783,12 @@ class DispatchScheduler:
         """The /debug/scheduler rendering: window config, live queue state
         and cumulative batching outcomes."""
         with self._lock:
-            return {
+            out = {
                 "window_ms": self.window_s * 1e3,
                 "max_batch": self.max_batch,
                 "open_groups": len(self._open),
                 "queued_lanes": self._queued,
                 **{k: v for k, v in self.stats.items()},
             }
+        out["standing_keys"] = len(self.key_ring)
+        return out

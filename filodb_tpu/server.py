@@ -175,11 +175,17 @@ class FiloServer:
         # and tenant quotas act process-wide, whichever engine serves them
         self.dispatch_scheduler = None
         batch_window_ms = float(qcfg.get("batch_window_ms", 0) or 0)
-        if batch_window_ms > 0:
+        scfg = {**DEFAULTS["standing"], **(cfg.get("standing") or {})}
+        self.standing_config = scfg
+        # standing-query promotion rides the scheduler's per-key recurrence
+        # ring, so an enabled standing engine needs the scheduler object
+        # even when batching is off (window 0 = ring only, no batching)
+        if batch_window_ms > 0 or scfg.get("enabled", True):
             from .query.scheduler import DispatchScheduler
 
             self.dispatch_scheduler = DispatchScheduler(
-                batch_window_ms, int(qcfg.get("batch_max", 32) or 32)
+                batch_window_ms, int(qcfg.get("batch_max", 32) or 32),
+                key_ring_max=int(scfg.get("key_ring_max", 512) or 512),
             )
         self.admission = None
         quotas = qcfg.get("tenant_quotas") or {}
@@ -236,6 +242,16 @@ class FiloServer:
                 "engine (partial results, no admission control) — set a "
                 "token so only peers can"
             )
+        # standing-query engine (filodb_tpu/standing/): promotion over the
+        # scheduler's recurrence ring, delta-maintained partials on ingest
+        # append, SSE push fan-out + the recording-rules API. One per
+        # process, bound to the scattering engine (standing queries over
+        # this node's primary dataset).
+        self.standing = None
+        if scfg.get("enabled", True):
+            from .standing import StandingEngine
+
+            self.standing = StandingEngine(self.engine, scfg)
         self.profiler = None
         if cfg["profiler"]["enabled"]:
             from .metrics import SamplingProfiler
@@ -320,7 +336,10 @@ class FiloServer:
                 {self.system_engine.dataset: self.system_engine}
                 if self.system_engine is not None else None
             ),
+            standing=self.standing,
         )
+        if self.standing is not None:
+            self.standing.start()
         if self.self_scraper is not None:
             self.self_scraper.start()
         if self.profiler is not None:
@@ -390,6 +409,8 @@ class FiloServer:
 
     def stop(self):
         self._stop.set()
+        if self.standing is not None:
+            self.standing.stop()
         if self.self_scraper is not None:
             self.self_scraper.stop()
         if self.bootstrapper is not None:

@@ -1,0 +1,20 @@
+"""Standing-query engine: delta-maintained dashboards with push fan-out
+and recording rules (doc/operations.md "Standing queries & recording
+rules"). See maintainer.py for the architecture overview."""
+
+from .hub import CLOSED, Subscription, SubscriptionHub, SubscriptionLimit
+from .maintainer import DEFAULTS as STANDING_DEFAULTS
+from .maintainer import StandingEngine
+from .registry import DEMOTE_REASONS, StandingQuery, StandingRegistry
+
+__all__ = [
+    "CLOSED",
+    "DEMOTE_REASONS",
+    "STANDING_DEFAULTS",
+    "StandingEngine",
+    "StandingQuery",
+    "StandingRegistry",
+    "Subscription",
+    "SubscriptionHub",
+    "SubscriptionLimit",
+]

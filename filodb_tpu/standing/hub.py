@@ -1,0 +1,146 @@
+"""Subscription hub: ONE materialization fanned out to N subscribers.
+
+The serving half of the standing-query engine (ROADMAP "serve results by
+push"): the maintainer renders each refresh payload EXACTLY ONCE (one JSON
+encode of the [G, J] partials) and :meth:`SubscriptionHub.publish` hands the
+same immutable bytes object to every subscriber queue — N dashboard clients
+cost one materialization plus N socket writes, never N query executions or
+N renders. Subscribers are bounded per standing query
+(``standing.max_subscribers``): past the limit, new subscriptions shed with
+:class:`SubscriptionLimit` (HTTP 429 at the SSE edge, the same overload
+contract admission control uses).
+
+Queues are bounded too (a stalled SSE client must not buffer unboundedly):
+when a subscriber's queue is full the OLDEST payload drops — dashboards
+want the freshest frame, not a backlog — counted in
+``filodb_standing_pushes_total{outcome="dropped"}``.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+from ..metrics import REGISTRY
+
+# sentinel delivered on close so blocked SSE writers wake and exit
+CLOSED = object()
+
+
+class SubscriptionLimit(Exception):
+    """Subscription shed: the standing query is at its subscriber bound."""
+
+
+class Subscription:
+    """One subscriber's bounded frame queue."""
+
+    __slots__ = ("qid", "_q", "closed")
+
+    def __init__(self, qid: str, depth: int = 8):
+        self.qid = qid
+        self._q: queue.Queue = queue.Queue(maxsize=max(int(depth), 1))
+        self.closed = False
+
+    def get(self, timeout: float | None = None):
+        """Next payload (bytes), or raises queue.Empty on timeout, or
+        returns :data:`CLOSED` when the hub shut the subscription down."""
+        return self._q.get(timeout=timeout)
+
+    def _offer(self, payload) -> bool:
+        """Enqueue newest-wins: a full queue drops its OLDEST frame first.
+        Returns False when a frame was dropped to make room."""
+        dropped = False
+        while True:
+            try:
+                self._q.put_nowait(payload)
+                return not dropped
+            except queue.Full:
+                try:
+                    self._q.get_nowait()
+                    dropped = True
+                except queue.Empty:
+                    pass
+
+
+class SubscriptionHub:
+    """Per-standing-query subscriber registry with publish-once fan-out."""
+
+    def __init__(self, max_subscribers: int = 64, queue_depth: int = 8):
+        self.max_subscribers = max(int(max_subscribers), 1)
+        self.queue_depth = max(int(queue_depth), 1)
+        self._subs: dict[str, list[Subscription]] = {}
+        self._lock = threading.Lock()
+
+    def subscribe(self, qid: str) -> Subscription:
+        with self._lock:
+            subs = self._subs.setdefault(qid, [])
+            if len(subs) >= self.max_subscribers:
+                raise SubscriptionLimit(
+                    f"standing query {qid} at max_subscribers="
+                    f"{self.max_subscribers}"
+                )
+            sub = Subscription(qid, self.queue_depth)
+            subs.append(sub)
+        REGISTRY.gauge("filodb_standing_subscribers").set(float(self.total()))
+        return sub
+
+    def unsubscribe(self, sub: Subscription) -> None:
+        with self._lock:
+            subs = self._subs.get(sub.qid)
+            if subs is not None:
+                try:
+                    subs.remove(sub)
+                except ValueError:
+                    pass
+                if not subs:
+                    self._subs.pop(sub.qid, None)
+        sub.closed = True
+        REGISTRY.gauge("filodb_standing_subscribers").set(float(self.total()))
+
+    def publish(self, qid: str, payload: bytes) -> int:
+        """Fan one rendered payload out to every subscriber of ``qid`` (the
+        SAME bytes object lands in every queue — zero per-subscriber
+        copies). Returns the number of subscribers reached."""
+        with self._lock:
+            subs = list(self._subs.get(qid, ()))
+        sent = dropped = 0
+        for sub in subs:
+            if sub._offer(payload):
+                sent += 1
+            else:
+                sent += 1
+                dropped += 1
+        if sent:
+            REGISTRY.counter(
+                "filodb_standing_pushes", outcome="sent"
+            ).inc(sent)
+        if dropped:
+            REGISTRY.counter(
+                "filodb_standing_pushes", outcome="dropped"
+            ).inc(dropped)
+        return sent
+
+    def close(self, qid: str) -> None:
+        """Shut every subscription of ``qid`` down (unregister/demote):
+        blocked SSE writers receive :data:`CLOSED` and exit."""
+        with self._lock:
+            subs = self._subs.pop(qid, [])
+        for sub in subs:
+            sub.closed = True
+            sub._offer(CLOSED)
+        if subs:
+            REGISTRY.gauge("filodb_standing_subscribers").set(
+                float(self.total())
+            )
+
+    def count(self, qid: str) -> int:
+        with self._lock:
+            return len(self._subs.get(qid, ()))
+
+    def total(self) -> int:
+        with self._lock:
+            return sum(len(s) for s in self._subs.values())
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {qid: len(subs) for qid, subs in self._subs.items()}

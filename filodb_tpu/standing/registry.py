@@ -1,0 +1,267 @@
+"""Standing-query registry: the set of PromQL expressions this process
+keeps continuously evaluated (ROADMAP "standing-query engine").
+
+A :class:`StandingQuery` is one registered expression plus its maintenance
+state — the retained ``[G, J]`` partials the delta path splices into, the
+shard version vector proving what the partials cover, and the grid/raw
+ranges pinning one superblock cache entry across refreshes. Entries arrive
+three ways:
+
+- ``manual`` — registered over the API (``POST /api/v1/standing/register``);
+- ``promoted`` — the promoter observed a hot recurring coalescing key in
+  the dispatch scheduler's :class:`~filodb_tpu.query.scheduler.KeyStatsRing`
+  and promoted it (Tailwind's explicit-dispatch framing: recurring work is
+  admitted as a standing obligation instead of re-arriving as ad-hoc load);
+- ``rule`` — a recording rule (``POST /api/v1/rules/record``): a standing
+  query whose newest closed steps write back into the memstore as a real
+  series under the rule's name.
+
+Demotion is remembered: a key demoted for a sticky reason (e.g.
+``standing_nondecomposable`` — topk/quantile/hist_quantile epilogues whose
+output cannot splice per step) lands in the ``demoted`` map so the promoter
+never flaps on it; idle-demoted keys age out and may re-promote once they
+get hot again (hysteresis — promotion needs a burst, demotion needs a long
+idle).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..metrics import REGISTRY
+
+# demotion reason taxonomy surfaced at /debug/standing. The
+# ``standing_nondecomposable`` entry is ALSO a fused-fallback taxonomy
+# member (metrics.FUSED_FALLBACK_REASONS — linted by tools/check_metrics.py
+# against doc/perf.md): every full re-dispatch a nondecomposable standing
+# query pays is counted there.
+DEMOTE_REASONS = frozenset({
+    "standing_nondecomposable",  # epilogue can't splice: sticky, never re-promotes
+    "idle",                      # recurrence stopped and no subscribers remain
+    "unregistered",              # explicit API unregister
+    "error",                     # refresh kept failing
+})
+
+
+def _new_qid() -> str:
+    return uuid.uuid4().hex[:12]
+
+
+@dataclass
+class StandingQuery:
+    """One registered standing query + its delta-maintenance state.
+    Mutable maintenance fields are guarded by ``lock`` (one refresh at a
+    time per query; the maintainer is the only writer)."""
+
+    qid: str
+    promql: str
+    dataset: str
+    step_ms: int
+    span_ms: int
+    source: str = "manual"  # manual | promoted | rule
+    key: object = None  # the KeyStatsRing key (promoted entries)
+    # delta eligibility, decided at registration by probing the planned
+    # exec (ops/aggregations.standing_delta_eligible): "delta" refreshes
+    # splice retained partials; "full" re-dispatches the whole grid each
+    # time, counted standing_nondecomposable when the epilogue is why
+    mode: str = "delta"
+    mode_reason: str | None = None
+    ws: str = "unknown"
+    ns: str = "unknown"
+    # recording rule: results write back as series `rule_name{group labels}`
+    rule_name: str | None = None
+    eval_interval_s: float | None = None
+    created_s: float = field(default_factory=time.time)
+    # set (under ``lock``) by StandingRegistry.remove: refreshes racing the
+    # unregister bail instead of re-growing state the ledger already
+    # credited back
+    removed: bool = False
+
+    # -- maintenance state (lock-guarded, maintainer-owned) ----------------
+    lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+    retained: np.ndarray | None = field(default=None, repr=False)  # [G, J]
+    labels: list | None = field(default=None, repr=False)  # [G] group labels
+    grid_start_ms: int = 0  # absolute out_t of retained[:, 0]
+    grid_end_ms: int = 0
+    raw_range: tuple | None = None  # aligned (lo, hi) pinning the superblock
+    versions: tuple | None = None  # shard version vector the partials cover
+    shard_nums: tuple = ()
+    window_ms: int = 0
+    offset_ms: int = 0
+    seq: int = 0  # refresh sequence number (rides every pushed payload)
+    last_refresh_s: float = 0.0
+    last_error: str | None = None
+    last_payload: bytes | None = field(default=None, repr=False)
+    last_rule_write_ms: int = 0
+    stats: dict = field(default_factory=lambda: {
+        "refreshes": 0, "delta": 0, "full": 0, "retained": 0, "reset": 0,
+        "errors": 0, "steps_computed": 0, "steps_retained": 0, "renders": 0,
+    })
+
+    def num_steps(self) -> int:
+        if self.grid_end_ms < self.grid_start_ms:
+            return 0
+        return int((self.grid_end_ms - self.grid_start_ms)
+                   // self.step_ms) + 1
+
+    def state_nbytes(self) -> int:
+        """Retained-partial footprint (the ledger's standing_state kind)."""
+        return int(self.retained.nbytes) if self.retained is not None else 0
+
+    def snapshot(self) -> dict:
+        return {
+            "id": self.qid,
+            "promql": self.promql,
+            "dataset": self.dataset,
+            "source": self.source,
+            "mode": self.mode,
+            "mode_reason": self.mode_reason,
+            "step_ms": self.step_ms,
+            "span_ms": self.span_ms,
+            "window_ms": self.window_ms,
+            "ws": self.ws,
+            "ns": self.ns,
+            "rule_name": self.rule_name,
+            "eval_interval_s": self.eval_interval_s,
+            "seq": self.seq,
+            "groups": (len(self.labels) if self.labels is not None else 0),
+            "steps": self.num_steps(),
+            "state_bytes": self.state_nbytes(),
+            "last_refresh_s": self.last_refresh_s,
+            "last_error": self.last_error,
+            "stats": dict(self.stats),
+        }
+
+
+def _standing_state_walker(registry) -> int:
+    """Cold recount of every registered query's retained-partial bytes —
+    the ledger drift check's ground truth for the standing_state kind."""
+    return sum(sq.state_nbytes() for sq in registry.list())
+
+
+class StandingRegistry:
+    """Process-local store of registered standing queries + the demotion
+    memory the promoter's hysteresis needs."""
+
+    def __init__(self, max_standing: int = 64):
+        self.max_standing = max(int(max_standing), 1)
+        self._queries: dict[str, StandingQuery] = {}
+        self._by_key: dict = {}  # ring key -> qid (promoted entries)
+        # demoted keys: key -> {"reason", "at_s"}; sticky reasons never
+        # re-promote, idle demotions age out (maintainer.DEMOTE_RETRY_S)
+        self.demoted: dict = {}
+        self._lock = threading.Lock()
+        # device-resource ledger account for retained partials — the
+        # standing engine's state is a first-class accounted consumer like
+        # every cache (filodb_device_bytes{kind="standing_state"})
+        from ..ledger import LEDGER
+
+        self.ledger = LEDGER.register(
+            self, "standing_state", _standing_state_walker, name="standing",
+        )
+
+    def add(self, sq: StandingQuery) -> StandingQuery:
+        with self._lock:
+            if len(self._queries) >= self.max_standing:
+                raise ValueError(
+                    f"standing registry at max_standing={self.max_standing}"
+                )
+            self._queries[sq.qid] = sq
+            if sq.key is not None:
+                self._by_key[sq.key] = sq.qid
+        self._publish_gauges()
+        return sq
+
+    def remove(self, qid: str) -> StandingQuery | None:
+        with self._lock:
+            sq = self._queries.pop(qid, None)
+            if sq is not None and sq.key is not None:
+                self._by_key.pop(sq.key, None)
+        if sq is not None:
+            # quiesce: an in-flight refresh holds sq.lock and will adjust
+            # the account when it commits — credit the state back only
+            # AFTER it finishes, and mark the query removed so later
+            # refreshes bail instead of re-growing freed state (else the
+            # ledger balance drifts from the walker forever)
+            with sq.lock:
+                sq.removed = True
+                nb = sq.state_nbytes()
+                sq.retained = None
+                sq.labels = None
+            if nb:
+                # count=0: standing state allocs/frees are byte
+                # adjustments, never entry counts (matches account_state)
+                self.ledger.free(nb, reason="drop", count=0)
+            self._publish_gauges()
+        return sq
+
+    def account_state(self, old_nbytes: int, new_nbytes: int) -> None:
+        """Debit/credit the ledger for a retained-partial resize (the
+        maintainer calls this around every refresh that changes state)."""
+        if new_nbytes > old_nbytes:
+            self.ledger.alloc(new_nbytes - old_nbytes, count=0)
+        elif old_nbytes > new_nbytes:
+            self.ledger.free(old_nbytes - new_nbytes, reason="replace",
+                             count=0)
+
+    def get(self, qid: str) -> StandingQuery | None:
+        with self._lock:
+            return self._queries.get(qid)
+
+    def by_key(self, key) -> StandingQuery | None:
+        with self._lock:
+            qid = self._by_key.get(key)
+            return self._queries.get(qid) if qid is not None else None
+
+    def list(self) -> list[StandingQuery]:
+        with self._lock:
+            return list(self._queries.values())
+
+    def rules(self) -> list[StandingQuery]:
+        return [sq for sq in self.list() if sq.rule_name]
+
+    def note_demoted(self, key, reason: str) -> None:
+        if key is None:
+            return
+        with self._lock:
+            self.demoted[key] = {"reason": reason, "at_s": time.time()}
+            # bounded: oldest demotion memories age out first
+            while len(self.demoted) > 256:
+                self.demoted.pop(next(iter(self.demoted)))
+
+    def demoted_reason(self, key) -> str | None:
+        with self._lock:
+            e = self.demoted.get(key)
+            return e["reason"] if e else None
+
+    def forget_demoted(self, key) -> None:
+        with self._lock:
+            self.demoted.pop(key, None)
+
+    def _publish_gauges(self) -> None:
+        with self._lock:
+            by_mode: dict[str, int] = {}
+            for sq in self._queries.values():
+                by_mode[sq.mode] = by_mode.get(sq.mode, 0) + 1
+        for mode in ("delta", "full"):
+            REGISTRY.gauge("filodb_standing_queries", mode=mode).set(
+                float(by_mode.get(mode, 0))
+            )
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            queries = [sq.snapshot() for sq in self._queries.values()]
+            demoted = [
+                {"key": repr(k), **v} for k, v in self.demoted.items()
+            ]
+        return {
+            "queries": queries,
+            "count": len(queries),
+            "max_standing": self.max_standing,
+            "demoted": demoted,
+        }

@@ -161,10 +161,62 @@ def fused_reason_violations() -> list[str]:
     return out
 
 
+def standing_violations() -> list[str]:
+    """Standing-engine taxonomy lint: (a) every ``filodb_standing_*``
+    family emitted in code carries a HELP text (metrics.HELP_TEXTS — the
+    families are new; shipping one without operator-facing help would be a
+    silent gap the doc lint alone can't see, since docstrings mentioning a
+    family satisfy it), and (b) the registry's canonical demotion-reason
+    set (standing/registry.DEMOTE_REASONS) includes the fused-fallback
+    member ``standing_nondecomposable`` — the two taxonomies must share
+    that entry or demotions and fallback counts drift apart."""
+    out: list[str] = []
+    helped: set[str] = set()
+    tree = ast.parse((PKG / "metrics.py").read_text())
+    for node in ast.walk(tree):
+        target = None
+        if isinstance(node, ast.Assign) and node.targets:
+            target = node.targets[0]
+        elif isinstance(node, ast.AnnAssign):  # HELP_TEXTS: dict[...] = {...}
+            target = node.target
+        if (target is not None and isinstance(target, ast.Name)
+                and target.id == "HELP_TEXTS" and node.value is not None
+                and isinstance(node.value, ast.Dict)):
+            for k in node.value.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    helped.add(k.value)
+    code, where = code_stems()
+    for s in sorted(code):
+        if s.startswith("filodb_standing") and s not in helped:
+            locs = ", ".join(where.get(s, [])[:2])
+            out.append(
+                f"standing family {s}* emitted ({locs}) without a HELP "
+                f"text in metrics.HELP_TEXTS"
+            )
+    reg = PKG / "standing" / "registry.py"
+    demote: set[str] = set()
+    if reg.exists():
+        for node in ast.walk(ast.parse(reg.read_text())):
+            if (isinstance(node, ast.Assign) and node.targets
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == "DEMOTE_REASONS"):
+                for c in ast.walk(node.value):
+                    if isinstance(c, ast.Constant) and isinstance(c.value, str):
+                        demote.add(c.value)
+        if "standing_nondecomposable" not in demote:
+            out.append(
+                "standing/registry.DEMOTE_REASONS must include "
+                "'standing_nondecomposable' (the shared fused-fallback "
+                "taxonomy entry)"
+            )
+    return out
+
+
 def main() -> int:
     code, where = code_stems()
     doc = doc_stems()
     violations: list[str] = list(fused_reason_violations())
+    violations.extend(standing_violations())
     for s in sorted(code - doc):
         locs = ", ".join(where.get(s, [])[:2])
         violations.append(
