@@ -78,10 +78,12 @@ test-standing: native
 	python -m pytest tests/test_standing.py -q -m standing
 
 # observability suite (doc/observability.md): trace propagation + stitching,
-# slow-query log, resource ledger + self-scrape, metrics exposition — plus
-# the span-coverage lint (every ExecPlan subclass executes under a span) and
-# the metrics-doc lint (every filodb_* family emitted is documented, and
-# vice versa)
+# slow-query log, query observatory (per-phase decomposition, query-log
+# ring, _system round trips, SLO burn-rate rules), resource ledger +
+# self-scrape, metrics exposition — plus the span-coverage + phase-coverage
+# lint (every ExecPlan subclass executes under a span; every phase literal
+# canonical and every fused path decomposed) and the metrics-doc lint
+# (every filodb_* family emitted is documented, and vice versa)
 test-observability: native
 	python tools/check_spans.py
 	python tools/check_metrics.py

@@ -101,6 +101,10 @@ class PromApiHandler(BaseHTTPRequestHandler):
     # recording-rules APIs, SSE push subscriptions, /debug/standing.
     # None = endpoints 404 (engine disabled or embedded without one).
     standing = None
+    # second StandingEngine bound to the _system engine: maintains the
+    # query observatory's SLO burn-rate recording rules (obs/slo.py);
+    # its rules merge into /api/v1/rules. None = no SLO maintainer.
+    standing_system = None
     auth_token: str | None = None  # optional bearer auth (server factory)
     # zero-arg profiler report hook; wired by the server ONLY when the
     # profiler config block enables it (/debug/profile gate)
@@ -133,8 +137,27 @@ class PromApiHandler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # quiet by default
         pass
 
+    @staticmethod
+    def _count_response(code: int) -> None:
+        """Per-status response accounting — the availability-SLO feed
+        (obs/slo.py): ``filodb_http_responses_total{code,class}``. Class
+        ``shed`` (429 admission sheds) is deliberate load management and
+        is excluded from BOTH sides of the availability ratio; ``5xx`` is
+        the error budget's numerator."""
+        from ..metrics import REGISTRY
+
+        klass = ("shed" if code == 429 else "5xx" if code >= 500
+                 else "4xx" if code >= 400 else "2xx")
+        REGISTRY.counter("filodb_http_responses", code=str(code),
+                         **{"class": klass}).inc()
+
     def _send(self, code: int, payload: dict, headers: dict | None = None):
+        """Returns the UNCOMPRESSED body byte count — the query
+        observatory records it as the result size, which must measure the
+        query, not the client's Accept-Encoding."""
         body = json.dumps(payload).encode()
+        raw_len = len(body)
+        self._count_response(code)
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         for k, v in (headers or {}).items():
@@ -151,18 +174,24 @@ class PromApiHandler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
+        return raw_len
 
     def _send_chunked(self, code: int, chunks):
         """Stream an iterable of byte chunks with chunked transfer encoding
-        (HTTP/1.1 keep-alive safe); memory stays bounded by one chunk."""
+        (HTTP/1.1 keep-alive safe); memory stays bounded by one chunk.
+        Returns total bytes streamed."""
+        self._count_response(code)
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Transfer-Encoding", "chunked")
         self.end_headers()
+        total = 0
         for chunk in chunks:
             if chunk:
                 self.wfile.write(f"{len(chunk):X}\r\n".encode() + chunk + b"\r\n")
+                total += len(chunk)
         self.wfile.write(b"0\r\n\r\n")
+        return total
 
     def _read_body(self) -> str:
         length = int(self.headers.get("Content-Length") or 0)
@@ -292,6 +321,10 @@ class PromApiHandler(BaseHTTPRequestHandler):
                 from ..metrics import SLOW_QUERY_LOG
 
                 return self._send(200, J.success(SLOW_QUERY_LOG.entries()))
+            if path == "/debug/querylog":
+                return self._querylog()
+            if path == "/api/v1/query_profile":
+                return self._query_profile()
             if path == "/debug/resources":
                 return self._resources()
             if path == "/debug/scheduler":
@@ -331,11 +364,14 @@ class PromApiHandler(BaseHTTPRequestHandler):
                     return self._send(404, J.error("not_found", "standing engine disabled"))
                 return self._send(200, J.success(self.standing.snapshot()))
             if path == "/api/v1/rules":
-                # the truthful answer: the standing engine's recording
-                # rules when one is attached, else the empty set
-                groups = (self.standing.rules_payload() if self.standing
-                          is not None else {"groups": []})
-                return self._send(200, J.success(groups))
+                # the truthful answer: recording rules from the standing
+                # engine AND the _system SLO maintainer when attached,
+                # else the empty set
+                groups: list = []
+                for eng in (self.standing, self.standing_system):
+                    if eng is not None:
+                        groups.extend(eng.rules_payload()["groups"])
+                return self._send(200, J.success({"groups": groups}))
             if path == "/api/v1/alerts":
                 return self._send(200, J.success({"alerts": []}))
             if path == "/api/v1/status/flags" or path == "/api/v1/status/config":
@@ -398,7 +434,22 @@ class PromApiHandler(BaseHTTPRequestHandler):
             trace_id=trace_id, parent_span_id=parent_span,
         )
         from ..metrics import trace_to_dict
+        from ..obs.querylog import QUERY_LOG
 
+        # the query-observatory record this execution published (None for
+        # remote-child legs); the edge folds in its serving phases below
+        record = getattr(res, "query_log", None)
+        # D2H transfer phase: pull every result grid to host HERE, timed,
+        # instead of implicitly inside the JSON encoder — the decomposition
+        # the result-plane ROADMAP item needs (is it the transfer or the
+        # encode that dominates?). Not an added sync: rendering forced the
+        # same conversion one call later.
+        t_tr = time.perf_counter()
+        for g in res.grids:
+            g.values = np.asarray(g.values)
+            if g.hist is not None:
+                g.hist = np.asarray(g.hist)
+        transfer_s = time.perf_counter() - t_tr
         trace = trace_to_dict(res.trace) if trace_on else None
         warnings = res.warnings or None
         if res.result_type == "scalar":
@@ -422,7 +473,14 @@ class PromApiHandler(BaseHTTPRequestHandler):
             }
             if trace is not None:
                 data["trace"] = trace
-            return self._send(200, J.success(data, warnings=warnings, partial=res.partial))
+            t_r = time.perf_counter()
+            nbytes = self._send(200, J.success(data, warnings=warnings,
+                                               partial=res.partial))
+            if record is not None:
+                QUERY_LOG.finish_serving(record, transfer_s,
+                                         time.perf_counter() - t_r,
+                                         body_bytes=nbytes, code=200)
+            return
         stats = {
             "seriesScanned": res.stats.series_scanned,
             "samplesScanned": res.stats.samples_scanned,
@@ -442,14 +500,27 @@ class PromApiHandler(BaseHTTPRequestHandler):
         if res.raw is not None:
             n_samples += sum(len(t) for _, t, _ in res.raw)
         if n_samples >= self.STREAM_MIN_SAMPLES:
-            return self._send_chunked(
+            t_r = time.perf_counter()
+            nbytes = self._send_chunked(
                 200, J.stream_matrix(res, stats, warnings=warnings, trace=trace)
             )
+            if record is not None:
+                QUERY_LOG.finish_serving(record, transfer_s,
+                                         time.perf_counter() - t_r,
+                                         body_bytes=nbytes, code=200)
+            return
+        t_r = time.perf_counter()
         data = J.render_matrix(res)
         data["stats"] = stats
         if trace is not None:
             data["trace"] = trace
-        return self._send(200, J.success(data, warnings=warnings, partial=res.partial))
+        nbytes = self._send(200, J.success(data, warnings=warnings,
+                                           partial=res.partial))
+        if record is not None:
+            QUERY_LOG.finish_serving(record, transfer_s,
+                                     time.perf_counter() - t_r,
+                                     body_bytes=nbytes, code=200)
+        return
 
     def _query(self):
         p = self._params()
@@ -463,7 +534,17 @@ class PromApiHandler(BaseHTTPRequestHandler):
             query, t, allow_partial_results=self._allow_partial(p),
             trace_id=trace_id, parent_span_id=parent_span,
         )
+        from ..obs.querylog import QUERY_LOG
+
+        record = getattr(res, "query_log", None)
+        t_tr = time.perf_counter()
+        for g in res.grids:
+            g.values = np.asarray(g.values)
+            if g.hist is not None:
+                g.hist = np.asarray(g.hist)
+        transfer_s = time.perf_counter() - t_tr
         warnings = res.warnings or None
+        t_r = time.perf_counter()
         if res.result_type == "scalar":
             data = J.render_scalar(res, t)
         elif res.raw is not None:
@@ -474,7 +555,13 @@ class PromApiHandler(BaseHTTPRequestHandler):
             from ..metrics import trace_to_dict
 
             data["trace"] = trace_to_dict(res.trace)
-        return self._send(200, J.success(data, warnings=warnings, partial=res.partial))
+        nbytes = self._send(200, J.success(data, warnings=warnings,
+                                           partial=res.partial))
+        if record is not None:
+            QUERY_LOG.finish_serving(record, transfer_s,
+                                     time.perf_counter() - t_r,
+                                     body_bytes=nbytes, code=200)
+        return
 
     def _labels(self):
         p = self._params()
@@ -616,6 +703,35 @@ class PromApiHandler(BaseHTTPRequestHandler):
         depth = int(self._q(p, "depth", str(len(prefix) + 1)))
         out = self._engine_for_request(p).ts_cardinalities(prefix, depth)
         return self._send(200, J.success(out))
+
+    def _querylog(self):
+        """Query-observatory ring (doc/observability.md "Query
+        observatory"): exemplar-level per-query cost records, newest
+        first; ``?limit=`` caps the page."""
+        from ..obs.querylog import QUERY_LOG
+
+        p = self._params()
+        limit = self._q(p, "limit")
+        return self._send(
+            200, J.success(QUERY_LOG.entries(int(limit) if limit else None))
+        )
+
+    def _query_profile(self):
+        """One query's full cost record by id (= its trace id) — the
+        target of slow-query-log ``profile`` links and OpenMetrics
+        exemplars."""
+        from ..obs.querylog import QUERY_LOG
+
+        p = self._params()
+        qid = self._q(p, "id")
+        if not qid:
+            return self._send(400, J.error("bad_data", "missing id"))
+        e = QUERY_LOG.get(str(qid))
+        if e is None:
+            return self._send(
+                404, J.error("not_found", f"no query-log record {qid!r}")
+            )
+        return self._send(200, J.success(e))
 
     def _query_exemplars(self):
         """Prometheus /api/v1/query_exemplars: exemplars of the series a
@@ -808,6 +924,7 @@ class PromApiHandler(BaseHTTPRequestHandler):
         n = 0
         for batch in parse_write_request(raw):
             n += self.engine.memstore.ingest_routed(self.engine.dataset, batch, spread=3)
+        self._count_response(204)
         self.send_response(204)
         self.send_header("Content-Length", "0")
         self.end_headers()
@@ -888,7 +1005,7 @@ def make_server(engine: QueryEngine, host: str = "127.0.0.1", port: int = 9090,
                 local_engine: QueryEngine | None = None,
                 flush_hook=None,
                 dataset_engines: dict | None = None,
-                standing=None) -> ThreadingHTTPServer:
+                standing=None, standing_system=None) -> ThreadingHTTPServer:
     # membership hooks (members_hook/join_hook) are wired as class attrs on
     # the returned server's RequestHandlerClass AFTER start — the registry
     # needs the bound port for its self URL (server.py seed bootstrap)
@@ -897,7 +1014,7 @@ def make_server(engine: QueryEngine, host: str = "127.0.0.1", port: int = 9090,
         "BoundHandler", (PromApiHandler,),
         {"engine": engine, "auth_token": auth_token, "local_engine": local_engine,
          "dataset_engines": dict(dataset_engines or {}),
-         "standing": standing,
+         "standing": standing, "standing_system": standing_system,
          "flush_hook": staticmethod(flush_hook) if flush_hook else None},
     )
     return ThreadingHTTPServer((host, port), handler)
@@ -907,10 +1024,10 @@ def serve_background(engine: QueryEngine, host: str = "127.0.0.1", port: int = 0
                      auth_token: str | None = None,
                      local_engine: QueryEngine | None = None,
                      flush_hook=None, dataset_engines: dict | None = None,
-                     standing=None):
+                     standing=None, standing_system=None):
     """Start the API server on a thread; returns (server, actual_port)."""
     srv = make_server(engine, host, port, auth_token, local_engine, flush_hook,
-                      dataset_engines, standing)
+                      dataset_engines, standing, standing_system)
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
     return srv, srv.server_address[1]

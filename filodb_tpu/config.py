@@ -64,6 +64,12 @@ DEFAULTS: dict = {
         # null disables; log size is a ring buffer.
         "slow_query_threshold_s": 10.0,
         "slow_query_log_max": 64,
+        # query observatory (obs/querylog.py, doc/observability.md "Query
+        # observatory"): every executed query leaves one exemplar-level
+        # cost record (phases, path, stats) in a bounded ring served at
+        # /debug/querylog and /api/v1/query_profile?id=. This sizes the
+        # ring; capture itself is always on (host-side metadata only).
+        "querylog_max": 512,
         # cross-query micro-batching (query/scheduler.py): concurrent
         # fused queries sharing a hot superblock + grid/epilogue signature
         # collect for this window and launch as ONE batched kernel (vmap
@@ -160,6 +166,22 @@ DEFAULTS: dict = {
         "self_scrape_interval_s": None,
         "self_scrape_spread": 1,
         "tpu_watch_log": "auto",
+    },
+    # SLO burn-rate recording rules over the query observatory (obs/slo.py,
+    # doc/observability.md "SLO burn-rate rules"): a second standing-query
+    # maintainer bound to the _system engine evaluates default availability
+    # (non-5xx share of non-shed responses vs the error budget) and latency
+    # (p99 vs objective) burn rates and writes them back into _system as
+    # real series. enabled null = auto: on exactly when the _system
+    # pipeline runs (telemetry.self_scrape_interval_s set) and the
+    # standing engine is enabled. latency_objectives_s maps "ws/ns" (or
+    # "*" = global) to a p99 objective in seconds.
+    "slo": {
+        "enabled": None,
+        "availability_objective": 0.999,
+        "latency_objectives_s": {"*": 2.0},
+        "windows": ["5m", "1h"],
+        "interval_s": 15.0,
     },
 }
 

@@ -1117,9 +1117,13 @@ class QueryEngine:
         ctx.trace_root = root
         return root
 
-    def _observe_slow(self, promql: str, elapsed_s: float, res) -> None:
+    def _observe_slow(self, promql: str, elapsed_s: float, res,
+                      query_id: str | None = None) -> None:
         """Record queries over the slow-query threshold with their rendered
-        trace (the observability substrate for "why was THIS query slow")."""
+        trace (the observability substrate for "why was THIS query slow").
+        ``query_id`` links the entry to the same execution's query-log
+        record (``/api/v1/query_profile?id=``) so the two debug surfaces
+        join instead of being disjoint rings."""
         thr = self.planner.params.slow_query_threshold_s
         if thr is None or elapsed_s < thr:
             return
@@ -1128,7 +1132,52 @@ class QueryEngine:
         SLOW_QUERY_LOG.record(
             promql, elapsed_s, dataset=self.dataset, trace=res.trace,
             stats=res.stats.as_dict() if res.stats is not None else None,
+            query_id=query_id,
         )
+
+    def _observe_querylog(self, promql: str, ctx, rec, elapsed_s: float,
+                          start_s: float, end_s: float, step_ms: int,
+                          res=None, error=None, tenant=None):
+        """Publish one exemplar-level cost record for this execution into
+        the query observatory (obs/querylog.py): phases, path, stats,
+        result size, status. Returns the record (None for remote-child
+        legs — the ORIGIN records the whole query exactly once, mirroring
+        tenant metering) and attaches it to the result so the serving edge
+        can fold in its transfer/render phases."""
+        root = getattr(ctx, "trace_root", None)
+        if rec is None or root is None or root.parent_id is not None:
+            return None
+        from ..obs.querylog import QUERY_LOG
+        from ..query.scheduler import AdmissionRejected
+
+        ws, ns = (tenant or getattr(ctx, "_tenant", None)
+                  or (root.tags.get("ws", "unknown"),
+                      root.tags.get("ns", "unknown")))
+        status, err = "ok", None
+        if error is not None:
+            status = ("shed" if isinstance(error, AdmissionRejected)
+                      else "error")
+            err = f"{type(error).__name__}: {error}"
+        result_series = result_samples = 0
+        if res is not None:
+            for g in res.grids:
+                result_series += g.n_series
+                result_samples += g.n_series * g.num_steps
+            if res.raw is not None:
+                result_series += len(res.raw)
+                result_samples += sum(len(t) for _, t, _ in res.raw)
+        record = QUERY_LOG.publish(
+            query_id=root.trace_id, dataset=self.dataset, promql=promql,
+            ws=ws, ns=ns, step_ms=int(step_ms),
+            span_ms=max(int((end_s - start_s) * 1000), 0),
+            start_s=start_s, end_s=end_s, phases=rec, elapsed_s=elapsed_s,
+            stats=ctx.stats, path_info=getattr(ctx, "obs", None),
+            result_series=result_series, result_samples=result_samples,
+            status=status, error=err,
+        )
+        if res is not None:
+            res.query_log = record
+        return record
 
     def _finish(self, res, ctx):
         """Attach per-query stats + partial-result warnings collected on the
@@ -1226,11 +1275,12 @@ class QueryEngine:
             root.tags["ws"] = ws
             root.tags["ns"] = ns
             if root.parent_id is not None:
-                return
+                return ws, ns
         record_tenant_query(
             ws, ns, elapsed_s, ctx.stats.kernel_ns / 1e9,
             ctx.stats.bytes_staged,
         )
+        return ws, ns
 
     def _query_range_uncoalesced(self, promql: str, start_s: float,
                                  end_s: float, step_s: float,
@@ -1239,24 +1289,49 @@ class QueryEngine:
                                  parent_span_id: str | None = None):
         import time as _time
 
-        t0 = _time.perf_counter()
-        plan = query_range_to_logical_plan(promql, start_s, end_s, step_s,
-                                           self.planner.params.lookback_ms)
-        if self.planner.params.agg_rules is not None:
-            from .lpopt import optimize_with_preagg
+        from ..obs.querylog import PhaseRecorder
 
-            plan = optimize_with_preagg(plan, self.planner.params.agg_rules)
-        exec_plan = self.planner.materialize(plan)
+        rec = PhaseRecorder()
+        t0 = _time.perf_counter()
+        with rec.phase("parse_plan"):
+            plan = query_range_to_logical_plan(
+                promql, start_s, end_s, step_s,
+                self.planner.params.lookback_ms,
+            )
+            if self.planner.params.agg_rules is not None:
+                from .lpopt import optimize_with_preagg
+
+                plan = optimize_with_preagg(plan,
+                                            self.planner.params.agg_rules)
+            exec_plan = self.planner.materialize(plan)
         ctx = self.context(allow_partial_results)
+        ctx.phases = rec
         self._start_trace(ctx, promql, trace_id, parent_span_id)
-        with self._admit(plan, ctx):
-            res = self._run(exec_plan, ctx)
+        step_ms = int(step_s * 1000)
+        try:
+            with rec.phase("admission"):
+                adm = self._admit(plan, ctx)
+            with adm:
+                res = self._run(exec_plan, ctx)
+        except Exception as e:
+            # shed / errored queries are cost records too (status =
+            # shed|error): the observatory must see what the tenant PAID
+            # for, not only what succeeded
+            self._observe_querylog(
+                promql, ctx, rec, _time.perf_counter() - t0, start_s,
+                end_s, step_ms, error=e,
+            )
+            raise
         self._finish(res, ctx)
         if res.result_type == "matrix" or res.grids:
             res.result_type = "matrix"
         elapsed_s = _time.perf_counter() - t0
-        self._meter_tenant(plan, ctx, elapsed_s)
-        self._observe_slow(promql, elapsed_s, res)
+        tenant = self._meter_tenant(plan, ctx, elapsed_s)
+        record = self._observe_querylog(promql, ctx, rec, elapsed_s,
+                                        start_s, end_s, step_ms, res=res,
+                                        tenant=tenant)
+        self._observe_slow(promql, elapsed_s, res,
+                           query_id=record["id"] if record else None)
         return res
 
     def _admit(self, plan, ctx):
@@ -1299,13 +1374,19 @@ class QueryEngine:
         path as PromQL queries."""
         import time as _time
 
-        t0 = _time.perf_counter()
-        if self.planner.params.agg_rules is not None:
-            from .lpopt import optimize_with_preagg
+        from ..obs.querylog import PhaseRecorder
 
-            plan = optimize_with_preagg(plan, self.planner.params.agg_rules)
-        exec_plan = self.planner.materialize(plan)
+        rec = PhaseRecorder()
+        t0 = _time.perf_counter()
+        with rec.phase("parse_plan"):
+            if self.planner.params.agg_rules is not None:
+                from .lpopt import optimize_with_preagg
+
+                plan = optimize_with_preagg(plan,
+                                            self.planner.params.agg_rules)
+            exec_plan = self.planner.materialize(plan)
         ctx = self.context(allow_partial_results)
+        ctx.phases = rec
         if deadline_s:
             ctx.deadline_s = min(ctx.deadline_s, deadline_s)
         if max_series:
@@ -1317,12 +1398,29 @@ class QueryEngine:
         except Exception:  # noqa: BLE001 — metadata plans have no PromQL form
             qname = type(plan).__name__
         self._start_trace(ctx, qname, trace_id, parent_span_id)
-        with self._admit(plan, ctx):
-            res = self._run(exec_plan, ctx)
+        times = _plan_times(plan)
+        g_start, g_end, g_step = (
+            (times[0] / 1000.0, times[1] / 1000.0, times[2])
+            if times else (0.0, 0.0, 0)
+        )
+        try:
+            with rec.phase("admission"):
+                adm = self._admit(plan, ctx)
+            with adm:
+                res = self._run(exec_plan, ctx)
+        except Exception as e:
+            self._observe_querylog(qname, ctx, rec,
+                                   _time.perf_counter() - t0, g_start,
+                                   g_end, g_step, error=e)
+            raise
         self._finish(res, ctx)
         elapsed_s = _time.perf_counter() - t0
-        self._meter_tenant(plan, ctx, elapsed_s)
-        self._observe_slow(qname, elapsed_s, res)
+        tenant = self._meter_tenant(plan, ctx, elapsed_s)
+        record = self._observe_querylog(qname, ctx, rec, elapsed_s,
+                                        g_start, g_end, g_step, res=res,
+                                        tenant=tenant)
+        self._observe_slow(qname, elapsed_s, res,
+                           query_id=record["id"] if record else None)
         return res
 
     def label_values(self, filters, label: str, start_ms: int, end_ms: int, limit=None):
@@ -1353,17 +1451,35 @@ class QueryEngine:
                       parent_span_id: str | None = None):
         import time as _time
 
+        from ..obs.querylog import PhaseRecorder
+
+        rec = PhaseRecorder()
         t0 = _time.perf_counter()
-        plan = query_to_logical_plan(promql, time_s, self.planner.params.lookback_ms)
-        exec_plan = self.planner.materialize(plan)
+        with rec.phase("parse_plan"):
+            plan = query_to_logical_plan(promql, time_s,
+                                         self.planner.params.lookback_ms)
+            exec_plan = self.planner.materialize(plan)
         ctx = self.context(allow_partial_results)
+        ctx.phases = rec
         self._start_trace(ctx, promql, trace_id, parent_span_id)
-        with self._admit(plan, ctx):
-            res = self._run(exec_plan, ctx)
+        try:
+            with rec.phase("admission"):
+                adm = self._admit(plan, ctx)
+            with adm:
+                res = self._run(exec_plan, ctx)
+        except Exception as e:
+            self._observe_querylog(promql, ctx, rec,
+                                   _time.perf_counter() - t0, time_s,
+                                   time_s, 0, error=e)
+            raise
         self._finish(res, ctx)
         if res.result_type == "matrix":
             res.result_type = "vector"
         elapsed_s = _time.perf_counter() - t0
-        self._meter_tenant(plan, ctx, elapsed_s)
-        self._observe_slow(promql, elapsed_s, res)
+        tenant = self._meter_tenant(plan, ctx, elapsed_s)
+        record = self._observe_querylog(promql, ctx, rec, elapsed_s,
+                                        time_s, time_s, 0, res=res,
+                                        tenant=tenant)
+        self._observe_slow(promql, elapsed_s, res,
+                           query_id=record["id"] if record else None)
         return res

@@ -127,6 +127,9 @@ def record_tenant_query(ws: str, ns: str, query_seconds: float,
     - ``filodb_tenant_query_seconds_total{ws,ns}`` (wall clock)
     - ``filodb_tenant_kernel_seconds_total{ws,ns}`` (device dispatch)
     - ``filodb_tenant_bytes_staged_total{ws,ns}`` (HBM uploads)
+    - ``filodb_tenant_query_latency_seconds{ws,ns}`` (histogram — the
+      per-tenant latency-SLO feed obs/slo.py's burn-rate rules quantile
+      over; counters can't answer "is tenant X's p99 over objective")
 
     Cardinality is bounded: at most :data:`MAX_TENANT_PAIRS` distinct
     (ws, ns) label pairs; later pairs attribute to ``overflow``."""
@@ -135,6 +138,9 @@ def record_tenant_query(ws: str, ns: str, query_seconds: float,
     REGISTRY.counter("filodb_tenant_query_seconds", ws=ws, ns=ns).inc(
         float(query_seconds)
     )
+    REGISTRY.histogram(
+        "filodb_tenant_query_latency_seconds", ws=ws, ns=ns
+    ).observe(float(query_seconds))
     REGISTRY.counter("filodb_tenant_kernel_seconds", ws=ws, ns=ns).inc(
         float(kernel_seconds)
     )

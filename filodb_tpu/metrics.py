@@ -146,6 +146,12 @@ HELP_TEXTS: dict[str, str] = {
     "filodb_tpu_probes": "tpu-watch probes attempted (from the watch log).",
     "filodb_tpu_probes_ok": "tpu-watch probes that found a healthy device.",
     "filodb_tpu_bench_attested": "tpu-watch attested benchmark measurements.",
+    "filodb_query_phase_seconds": "Per-phase query latency decomposition (parse_plan|admission|stage|dispatch|transfer|render|other).",
+    "filodb_query_path": "Queries by execution path (fused|fallback|tree) per dataset.",
+    "filodb_tenant_phase_seconds": "Per-phase query wall seconds attributed to the tenant (ws/ns).",
+    "filodb_tenant_query_latency_seconds": "End-to-end query latency per tenant (the latency-SLO feed).",
+    "filodb_http_responses": "HTTP API responses by status code and class (2xx|4xx|shed|5xx).",
+    "filodb_querylog_entries": "Query-log ring depth (exemplar-level cost records retained).",
 }
 
 
@@ -324,6 +330,52 @@ def record_shard_reassignment(shard: int, damped: bool) -> None:
     ).inc()
 
 
+# -- query-phase taxonomy ----------------------------------------------------
+
+# the ONE canonical per-query phase set (doc/observability.md "Query
+# observatory"). Mirrors FUSED_FALLBACK_REASONS: tools/check_spans.py lints
+# every phase literal in the package against this tuple, and
+# obs/querylog.PhaseRecorder rejects unknown names at runtime — a typo'd
+# phase must fail loudly, never mint an undashboarded series.
+#
+# - parse_plan  — PromQL parse + logical-plan build + materialize
+# - admission   — admission-control gate + batch-window queue wait
+# - stage       — superblock resolution (cache hit / extend / build+upload)
+# - dispatch    — the kernel launch itself (batched or solo)
+# - transfer    — device→host result pull at the serving edge
+# - render      — response encoding + write at the serving edge
+# - other       — engine residual (everything the named phases don't cover,
+#                 computed at query end so the phase sum equals wall time)
+QUERY_PHASES = (
+    "parse_plan", "admission", "stage", "dispatch", "transfer", "render",
+    "other",
+)
+
+
+# the executing query's PhaseRecorder (obs/querylog.py), activated per
+# thread by ExecPlan.execute exactly like the QueryStats attribution
+# target below: spans tagged with phase= and the fused dispatch path bump
+# it without threading a context object through every signature
+_phases_local = threading.local()
+
+
+@contextlib.contextmanager
+def activate_phases(rec):
+    """Bind ``rec`` (an obs.querylog.PhaseRecorder, or None for a no-op)
+    as this thread's phase-attribution target. Nests/restores like
+    ``activate_stats``."""
+    prev = getattr(_phases_local, "rec", None)
+    _phases_local.rec = rec
+    try:
+        yield
+    finally:
+        _phases_local.rec = prev
+
+
+def current_phases():
+    return getattr(_phases_local, "rec", None)
+
+
 # -- tracing ----------------------------------------------------------------
 
 _trace_local = threading.local()
@@ -427,13 +479,18 @@ _UNSET = object()
 
 
 @contextlib.contextmanager
-def span(name: str, parent=_UNSET, **tags):
+def span(name: str, parent=_UNSET, phase: str | None = None, **tags):
     """Nested timing spans (Kamon.runWithSpan analog). The thread-local
     current span is the default parent; an explicit ``parent=`` Span wires a
     span into a trace across thread hops (a worker thread has no thread-local
     context — the submitter captures ``current_span()`` and either passes it
     here or re-activates it via ``activate``). The root span of a thread is
-    retrievable via current_trace()."""
+    retrievable via current_trace().
+
+    ``phase=`` additionally attributes the span's wall time to the active
+    query's phase decomposition (QUERY_PHASES; the recorder bound via
+    ``activate_phases``) — the query-observatory capture point for phases
+    that already run under a span (e.g. ``fused:stage``)."""
     cur = getattr(_trace_local, "current", None)
     eff_parent = cur if cur is not None else (None if parent is _UNSET else parent)
     s = Span(name, time.perf_counter_ns())
@@ -454,6 +511,10 @@ def span(name: str, parent=_UNSET, **tags):
     finally:
         s.end_ns = time.perf_counter_ns()
         _trace_local.current = cur
+        if phase is not None:
+            rec = current_phases()
+            if rec is not None:
+                rec.add(phase, (s.end_ns - s.start_ns) / 1e9)
 
 
 @contextlib.contextmanager
@@ -512,7 +573,8 @@ class SlowQueryLog:
             self._entries = deque(self._entries, maxlen=max(1, int(max_entries)))
 
     def record(self, promql: str, duration_s: float, dataset: str = "",
-               trace=None, stats: dict | None = None) -> None:
+               trace=None, stats: dict | None = None,
+               query_id: str | None = None) -> None:
         entry = {
             "time": time.time(),
             "dataset": dataset,
@@ -521,6 +583,12 @@ class SlowQueryLog:
             "stats": stats or {},
             "trace": trace_to_dict(trace),
         }
+        if query_id:
+            # link to the query observatory: the same execution's
+            # exemplar-level cost record (obs/querylog.py) is one GET away
+            # instead of a disjoint debug surface
+            entry["query_id"] = query_id
+            entry["profile"] = f"/api/v1/query_profile?id={query_id}"
         with self._lock:
             self._entries.append(entry)
         REGISTRY.counter("filodb_slow_queries", dataset=dataset).inc()

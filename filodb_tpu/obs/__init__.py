@@ -1,0 +1,11 @@
+"""Query observatory (doc/observability.md "Query observatory"):
+exemplar-level per-query cost records + per-phase latency decomposition
+(`querylog.py`) and the default SLO burn-rate recording rules the standing
+engine maintains over the `_system` dataset (`slo.py`)."""
+
+from .querylog import (  # noqa: F401
+    QUERY_LOG,
+    PhaseRecorder,
+    QueryLogRing,
+    promql_fingerprint,
+)

@@ -1,0 +1,146 @@
+"""Default SLO burn-rate recording rules over the query observatory.
+
+The observatory's exemplar records and phase histograms (querylog.py) feed
+the ``_system`` dataset through the self-scraper; these rules make the
+standing-query engine (PR 11's recording-rules API) maintain the
+observatory's own rollups on top — burn rates land back in ``_system`` as
+real series, queryable/alertable like anything else, evaluated by the
+standing maintainer on its own clock from live traffic.
+
+Two SLO families (doc/observability.md "SLO burn-rate rules"):
+
+- **availability** — the non-5xx share of non-shed responses. Admission
+  sheds (429, class ``shed``) are deliberate load management, not broken
+  availability, so they leave both numerator and denominator. The burn
+  rate divides the observed error ratio by the error budget
+  (``1 - availability_objective``): 1.0 = burning exactly at budget; >1
+  sustained over the window means the SLO will be missed.
+
+      slo:availability:burnrate:<w> =
+          sum(rate(filodb_http_responses_total{class="5xx"}[w]))
+        / sum(rate(filodb_http_responses_total{class!="shed"}[w]))
+        / (1 - objective)
+
+  (Prometheus semantics: with zero 5xx responses the numerator selects no
+  series and the rule records nothing — absence IS the healthy state.)
+
+- **latency** — observed p99 against the objective, global (from
+  ``filodb_query_latency_seconds``) and per tenant with a configured
+  objective (from the per-tenant latency histogram
+  ``filodb_tenant_query_latency_seconds{ws,ns}``):
+
+      slo:latency:p99:<w>       = histogram_quantile(0.99, sum by (le)
+                                    (rate(..._bucket[w])))
+      slo:latency:burnrate:<w>  = the same, divided by the objective
+                                    (>1 = p99 over objective)
+
+Config block (config.py ``slo``): ``availability_objective``,
+``latency_objectives_s`` mapping ``"ws/ns"`` (or ``"*"`` = global) to a
+p99 objective in seconds, ``windows`` (PromQL durations), ``interval_s``
+(rule evaluation cadence). ``enabled: null`` auto-enables exactly when the
+``_system`` pipeline runs (telemetry.self_scrape_interval_s set).
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+
+log = logging.getLogger("filodb_tpu.obs.slo")
+
+DEFAULTS: dict = {
+    # null = auto: on exactly when the _system self-scrape pipeline runs
+    "enabled": None,
+    "availability_objective": 0.999,
+    # "ws/ns" (or "*" for the global objective) -> p99 seconds
+    "latency_objectives_s": {"*": 2.0},
+    # burn-rate windows (PromQL durations); the classic fast/slow pair
+    "windows": ["5m", "1h"],
+    "interval_s": 15.0,
+}
+
+_NAME_OK = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _name_part(s: str) -> str:
+    """Sanitize a free-form fragment (tenant key, window) into the rule
+    name charset [a-zA-Z0-9_:]."""
+    return _NAME_OK.sub("_", str(s))
+
+
+def default_slo_rules(cfg: dict | None = None) -> list[dict]:
+    """The default rule set as ``{"name", "expr", "interval_s"}`` dicts —
+    pure config→expressions (unit-testable without a server)."""
+    c = {**DEFAULTS, **(cfg or {})}
+    interval_s = float(c["interval_s"])
+    avail_obj = float(c["availability_objective"])
+    if not 0.0 < avail_obj < 1.0:
+        raise ValueError(
+            f"slo.availability_objective must be in (0, 1), got {avail_obj}"
+        )
+    budget = 1.0 - avail_obj
+    rules: list[dict] = []
+    for w in c["windows"]:
+        wl = _name_part(w)
+        rules.append({
+            "name": f"slo:availability:burnrate:{wl}",
+            "expr": (
+                f'sum(rate(filodb_http_responses_total{{class="5xx"}}[{w}]))'
+                f' / sum(rate(filodb_http_responses_total'
+                f'{{class!="shed"}}[{w}])) / {budget:g}'
+            ),
+            "interval_s": interval_s,
+        })
+        for tenant, obj in (c.get("latency_objectives_s") or {}).items():
+            obj = float(obj)
+            if obj <= 0:
+                raise ValueError(
+                    f"slo.latency_objectives_s[{tenant!r}] must be > 0"
+                )
+            if tenant == "*":
+                sel = "filodb_query_latency_seconds_bucket"
+                suffix = wl
+            else:
+                ws, _, ns = str(tenant).partition("/")
+                sel = (
+                    f"filodb_tenant_query_latency_seconds_bucket"
+                    f'{{ws="{ws}",ns="{ns or "unknown"}"}}'
+                )
+                suffix = f"{_name_part(tenant)}:{wl}"
+            p99 = (
+                f"histogram_quantile(0.99, sum by (le) "
+                f"(rate({sel}[{w}])))"
+            )
+            if tenant == "*":
+                # the raw p99 rollup only once (per window), for dashboards
+                rules.append({
+                    "name": f"slo:latency:p99:{wl}",
+                    "expr": p99,
+                    "interval_s": interval_s,
+                })
+            rules.append({
+                "name": f"slo:latency:burnrate:{suffix}",
+                "expr": f"{p99} / {obj:g}",
+                "interval_s": interval_s,
+            })
+    return rules
+
+
+def register_slo_rules(standing, cfg: dict | None = None) -> list:
+    """Register the default rules on a StandingEngine bound to the
+    ``_system`` engine (server.py wires this when both telemetry
+    self-scrape and the standing engine are enabled). Returns the
+    registered StandingQuery objects; an individual rule failing to plan
+    logs and is skipped — one bad expression must not take the rest of the
+    SLO plane down."""
+    out = []
+    for r in default_slo_rules(cfg):
+        step_ms = max(int(r["interval_s"] * 1000), 1)
+        try:
+            out.append(standing.register(
+                r["expr"], step_ms, span_ms=4 * step_ms, source="rule",
+                rule_name=r["name"], eval_interval_s=float(r["interval_s"]),
+            ))
+        except Exception:  # noqa: BLE001 — one sick rule must not kill the set
+            log.exception("SLO rule %s failed to register", r["name"])
+    return out

@@ -58,6 +58,12 @@ class QueryContext:
     # queries sharing a superblock coalesce into ONE batched dispatch.
     # None (or a disabled scheduler) = the plain unbatched launch.
     dispatch_scheduler: Any = None
+    # query observatory (obs/querylog.py): the per-query PhaseRecorder the
+    # engine attaches (ExecPlan.execute re-binds it per thread alongside
+    # stats) and the free-form path annotations (fused/fallback/batched/
+    # grid class) execution drops for the query's cost record
+    phases: Any = None
+    obs: dict = field(default_factory=dict)
     _start_time: float = field(default_factory=time.monotonic)
 
     def check_deadline(self) -> None:
@@ -86,7 +92,9 @@ class ExecPlan:
         self.transformers = []
 
     def execute(self, ctx: QueryContext) -> QueryResult:
-        from ...metrics import Span, activate_stats, current_span, span
+        from ...metrics import (
+            Span, activate_phases, activate_stats, current_span, span,
+        )
 
         t0 = time.perf_counter_ns()
         ctx.check_deadline()
@@ -96,8 +104,11 @@ class ExecPlan:
         parent = current_span() or ctx.trace_root
         # bind the query's stats as this thread's kernel-attribution target:
         # ops/ dispatch wrappers bump kernel_ns on it without any context
-        # threading (pool workers re-enter here per child, so they bind too)
+        # threading (pool workers re-enter here per child, so they bind
+        # too); the phase recorder binds identically so phase-tagged spans
+        # and the fused dispatch path decompose into the right query
         with activate_stats(ctx.stats), \
+                activate_phases(getattr(ctx, "phases", None)), \
                 span(type(self).__name__, parent=parent) as s:
             args = self.args_str()
             if args:
@@ -1267,6 +1278,12 @@ class FusedAggregateExec(ExecPlan):
         s = current_span()
         if s is not None:
             s.tags["fused_fallback"] = reason
+        # query-observatory path annotation: the cost record carries WHY
+        # this query left the fused path (obs/querylog.py)
+        obs = getattr(ctx, "obs", None)
+        if obs is not None:
+            obs["path"] = "fallback"
+            obs["fallback"] = reason
         record_fused_fallback(reason)
         return self.fallback.execute(ctx)
 
@@ -1290,9 +1307,16 @@ class FusedAggregateExec(ExecPlan):
                 return "hist_func"
         elif self.hist_quantile is not None:
             # planner recognized histogram_quantile over this aggregate but
-            # the selection resolved to a scalar schema: the reference tree
-            # raises the proper "needs native-histogram input" QueryError
-            return "hist_quantile_scalar"
+            # the selection resolved to a scalar schema. With ``le`` in the
+            # grouping these are CLASSIC bucket series (e.g. a self-scraped
+            # *_bucket family in _system): the fused agg computes the
+            # by-(le,...) partials as ONE dispatch and the classic
+            # interpolation folds them on host (transformers.
+            # classic_histogram_quantile — same kernel as the native path).
+            # Without ``le`` the shape is unanswerable; the reference tree
+            # raises the proper "needs native-histogram input" QueryError.
+            if "le" not in tuple(self.by or ()):
+                return "hist_quantile_scalar"
         return None
 
     def _serve_hit(self, ctx: QueryContext, hit: "SuperblockEntry"):
@@ -1728,6 +1752,10 @@ class FusedAggregateExec(ExecPlan):
         grids, pallas-promoted irregular grids, jittered hist) skip the
         scheduler outright — paying the batch window for a launch that is
         guaranteed to fall back per-lane would be pure added latency."""
+        import time as _time
+
+        from ...metrics import current_phases
+
         sched = getattr(ctx, "dispatch_scheduler", None)
         if sched is not None and hasattr(sched, "observe_key"):
             # recurrence feed for standing-query promotion: every fused
@@ -1735,13 +1763,41 @@ class FusedAggregateExec(ExecPlan):
             # retained per-key state the batch groups used to drop at
             # close) — see query/scheduler.KeyStatsRing
             self._observe_key(ctx, sched)
+        # phase decomposition (obs/querylog.py): time around the launch is
+        # split into "admission" (batch-window queue wait — the scheduler
+        # stamps the group's actual kernel seconds on the request) and
+        # "dispatch" (the launch itself). Pure host-side perf_counter
+        # bookkeeping: no device sync is added around the (async) dispatch.
+        rec = current_phases()
+        obs = getattr(ctx, "obs", None)
+        t0 = _time.perf_counter()
         if (sched is not None and getattr(sched, "enabled", False)
                 and AGG.batch_variant_supported(
                     request.block, request.func, request.kind,
                     request.is_delta, request.mesh)):
             request.timeout_s = ctx.remaining_deadline_s()
-            return sched.dispatch(request)
-        return request.run_single()
+            if obs is not None:
+                obs["batched"] = True
+            out = sched.dispatch(request)
+            if rec is not None:
+                total = _time.perf_counter() - t0
+                exec_s = request.exec_seconds
+                if exec_s is not None:
+                    exec_s = min(max(float(exec_s), 0.0), total)
+                    rec.add("admission", total - exec_s)
+                    rec.add("dispatch", exec_s)
+                else:
+                    # a coalesced duplicate lane: its own request object
+                    # never reached the executing leader — the shared wait
+                    # is indivisible, attribute it all to dispatch
+                    rec.add("dispatch", total)
+            return out
+        if obs is not None:
+            obs.setdefault("batched", False)
+        out = request.run_single()
+        if rec is not None:
+            rec.add("dispatch", _time.perf_counter() - t0)
+        return out
 
     def _observe_key(self, ctx: QueryContext, sched) -> None:
         """Record this dispatch in the scheduler's per-key recurrence ring.
@@ -1806,12 +1862,19 @@ class FusedAggregateExec(ExecPlan):
             return self._fall(ctx, "mesh_unsupported")
         func = self.function or "last"
         stage_mode = _stage_mode_for_function(self.function)
-        with span("fused:stage"):
+        with span("fused:stage", phase="stage"):
             got = self._superblock(ctx, stage_mode)
         if isinstance(got, str):
             return self._fall(ctx, got)
         if got is None:
             return QueryResult()
+        obs = getattr(ctx, "obs", None)
+        if obs is not None:
+            # query-observatory path annotations: the fused path served
+            # this query, over a superblock of this grid class (metadata
+            # reads only — .grid_class never touches device memory)
+            obs["path"] = "fused"
+            obs["grid_class"] = ST.grid_class(got.block)
         nsteps = self.num_steps()
         params = RangeParams(
             self.start_ms - self.offset_ms, self.step_ms, nsteps,
@@ -1907,6 +1970,22 @@ class FusedAggregateExec(ExecPlan):
                     mesh=self.mesh,
                 ),
             ))
+        if self.hist_quantile is not None:
+            # classic-bucket histogram_quantile (vetted in
+            # _unsupported_shape: "le" is in the grouping): the [G, J]
+            # by-(le,...) partials from the ONE fused dispatch above pivot
+            # into per-group cumulative grids and interpolate with the
+            # native path's kernel
+            from .transformers import classic_histogram_quantile
+
+            q_labels, q_vals = classic_histogram_quantile(
+                self.hist_quantile, group_labels,
+                np.asarray(out)[:, :nsteps],
+            )
+            return QueryResult(grids=[
+                Grid([_strip_metric(l) for l in q_labels], self.start_ms,
+                     self.step_ms, nsteps, q_vals)
+            ])
         return QueryResult(
             grids=[Grid(group_labels, self.start_ms, self.step_ms, nsteps, out)]
         )

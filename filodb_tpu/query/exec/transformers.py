@@ -150,6 +150,62 @@ _TIME_COMPONENT = {
 }
 
 
+def classic_histogram_quantile(q: float, labels, values):
+    """``histogram_quantile`` over CLASSIC bucket series (scalar rows
+    carrying ``le`` labels — e.g. a self-scraped ``*_bucket`` family in
+    ``_system``, or any Prometheus-style ingest): pivot each label-group's
+    le-sorted rows into a ``[1, J, B]`` cumulative grid and interpolate
+    with the SAME kernel the native-histogram path uses
+    (ops/hist_kernels.histogram_quantile — one rule, both schemas).
+    Returns ``(labels_without_le, [G', J] values)``; raises QueryError
+    when the rows carry no ``le`` at all (the historical error)."""
+    vals = np.asarray(values, dtype=np.float32)
+    J = vals.shape[1] if vals.ndim == 2 else 0
+    groups: dict = {}
+    order: list = []
+    for i, l in enumerate(labels):
+        le_s = l.get("le")
+        if le_s is None:
+            raise QueryError(
+                "histogram_quantile needs native-histogram input or "
+                "le-labeled classic bucket series"
+            )
+        le = (float("inf") if str(le_s) in ("+Inf", "Inf", "inf")
+              else float(le_s))
+        key = tuple(sorted(
+            (k, v) for k, v in l.items() if k != "le"
+        ))
+        if key not in groups:
+            groups[key] = []
+            order.append(key)
+        groups[key].append((le, i))
+    # groups sharing one bucket scheme stack into a single [G, J, B]
+    # kernel call (it already takes a leading group axis) — ONE dispatch
+    # per distinct scheme, not one per group, so a 50-tenant by-(le,ws)
+    # quantile costs the same launches as a 1-tenant one
+    by_scheme: dict = {}
+    for key in order:
+        members = sorted(groups[key], key=lambda m: m[0])
+        scheme = tuple(m[0] for m in members)
+        by_scheme.setdefault(scheme, []).append(
+            (key, [m[1] for m in members])
+        )
+    results: dict = {}
+    for scheme, entries in by_scheme.items():
+        les = np.array(scheme, dtype=np.float32)
+        # [G, J, B]: le-ordered cumulative bucket rows per group
+        h = np.stack([vals[idx].T for _key, idx in entries])
+        out = np.asarray(HK.histogram_quantile(
+            np.float32(q), jnp.asarray(h), jnp.asarray(les)
+        ))
+        for (key, _idx), row in zip(entries, out):
+            results[key] = row
+    out_labels = [dict(key) for key in order]
+    rows = [results[key] for key in order]
+    return out_labels, (np.stack(rows).astype(np.float32) if rows
+                        else np.zeros((0, J), np.float32))
+
+
 @dataclass
 class InstantVectorFunctionMapper:
     """reference InstantVectorFunctionMapper + InstantFunction.scala."""
@@ -166,9 +222,15 @@ class InstantVectorFunctionMapper:
     def _one(self, g: Grid) -> Grid:
         f = self.function
         if f == "histogram_quantile":
-            if g.hist is None:
-                raise QueryError("histogram_quantile needs native-histogram input")
             q = np.float32(self.args[0])
+            if g.hist is None:
+                # classic-bucket path: le-labeled scalar rows (the shape
+                # every self-scraped *_bucket family in _system has)
+                out_labels, vals = classic_histogram_quantile(
+                    q, g.labels, g.values_np()
+                )
+                return Grid([_strip_metric(l) for l in out_labels],
+                            g.start_ms, g.step_ms, g.num_steps, vals)
             vals = HK.histogram_quantile(q, g.hist, jnp.asarray(g.les, dtype=jnp.float32))
             return Grid([_strip_metric(l) for l in g.labels], g.start_ms, g.step_ms, g.num_steps, vals)
         if f == "histogram_fraction":

@@ -457,6 +457,14 @@ class FusedRequest:
     hist_q: bool = False  # hist lane wants the quantile epilogue
     run_single: Callable[[], Any] = None
     timeout_s: float = 60.0
+    # stamped by the executing leader (DispatchScheduler._execute) BEFORE
+    # the future resolves: the group's actual kernel-launch wall seconds.
+    # The waiting caller subtracts it from its total wait to split queue
+    # time from launch time in the query-phase decomposition
+    # (FusedAggregateExec._dispatch_fused). Batched lanes all carry the
+    # SHARED launch duration (the launch is indivisible); a coalesced
+    # duplicate lane's own request object stays None.
+    exec_seconds: float | None = None
 
     def family(self) -> str:
         return self.kind
@@ -747,13 +755,18 @@ class DispatchScheduler:
             # device work exactly when the device is least healthy)
             outcome = "solo"
             req, fut = lanes[0]
+            t0 = time.perf_counter()
             try:
-                fut.set_result(req.run_single())
+                out = req.run_single()
+                req.exec_seconds = time.perf_counter() - t0
+                fut.set_result(out)
             except Exception as e:  # noqa: BLE001 — delivered to the caller
+                req.exec_seconds = time.perf_counter() - t0
                 fut.set_exception(e)
         else:
             outcome = "batched"
             results = None
+            t0 = time.perf_counter()
             try:
                 results = _run_batch([req for req, _ in lanes])
             except QueryError as e:
@@ -765,11 +778,21 @@ class DispatchScheduler:
                 outcome = "fallback"
             if results is None:
                 for req, fut in lanes:
+                    t1 = time.perf_counter()
                     try:
-                        fut.set_result(req.run_single())
+                        out = req.run_single()
+                        req.exec_seconds = time.perf_counter() - t1
+                        fut.set_result(out)
                     except Exception as e:  # noqa: BLE001
+                        req.exec_seconds = time.perf_counter() - t1
                         fut.set_exception(e)
             else:
+                # exec_seconds stamped BEFORE the futures resolve so a
+                # woken waiter always reads its final value; every lane
+                # carries the shared (indivisible) launch duration
+                batch_s = time.perf_counter() - t0
+                for req, _ in lanes:
+                    req.exec_seconds = batch_s
                 for (_, fut), res in zip(lanes, results):
                     fut.set_result(res)
         with self._lock:

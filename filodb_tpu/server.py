@@ -169,6 +169,13 @@ class FiloServer:
         from .metrics import SLOW_QUERY_LOG
 
         SLOW_QUERY_LOG.configure(int(qcfg.get("slow_query_log_max", 64) or 64))
+        # query observatory (obs/querylog.py): size the per-query cost
+        # record ring and publish its depth at scrape time
+        from .obs.querylog import QUERY_LOG
+        from .telemetry import register_querylog_collector
+
+        QUERY_LOG.configure(int(qcfg.get("querylog_max", 512) or 512))
+        register_querylog_collector()
         # query dispatch scheduler (query/scheduler.py): ONE process-wide
         # micro-batcher + admission controller shared by every engine
         # (scattering, local and _system) so concurrent queries coalesce
@@ -278,6 +285,23 @@ class FiloServer:
                 interval_s=float(scrape_interval),
                 spread=int(tcfg.get("self_scrape_spread", 1)),
             )
+        # SLO burn-rate recording rules (obs/slo.py): a second standing
+        # maintainer bound to the _system engine keeps the observatory's
+        # own rollups — availability and latency burn rates — as real
+        # series. enabled null = auto (on exactly when _system exists and
+        # the standing engine is on).
+        from .obs.slo import DEFAULTS as SLO_DEFAULTS
+
+        slo_cfg = {**SLO_DEFAULTS, **(cfg.get("slo") or {})}
+        self.slo_config = slo_cfg
+        self.system_standing = None
+        slo_on = slo_cfg.get("enabled")
+        if slo_on is None:
+            slo_on = self.system_engine is not None and scfg.get("enabled", True)
+        if slo_on and self.system_engine is not None:
+            from .standing import StandingEngine
+
+            self.system_standing = StandingEngine(self.system_engine, scfg)
         watch_log = tcfg.get("tpu_watch_log", "auto")
         if watch_log:
             import os as _os
@@ -337,9 +361,18 @@ class FiloServer:
                 if self.system_engine is not None else None
             ),
             standing=self.standing,
+            standing_system=self.system_standing,
         )
         if self.standing is not None:
             self.standing.start()
+        if self.system_standing is not None:
+            # register + start the SLO maintainer AFTER the HTTP edge is
+            # up: rules evaluate from live-traffic metrics the edge emits
+            from .obs.slo import register_slo_rules
+
+            self.slo_rules = register_slo_rules(self.system_standing,
+                                                self.slo_config)
+            self.system_standing.start()
         if self.self_scraper is not None:
             self.self_scraper.start()
         if self.profiler is not None:
@@ -411,6 +444,8 @@ class FiloServer:
         self._stop.set()
         if self.standing is not None:
             self.standing.stop()
+        if self.system_standing is not None:
+            self.system_standing.stop()
         if self.self_scraper is not None:
             self.self_scraper.stop()
         if self.bootstrapper is not None:

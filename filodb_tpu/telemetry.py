@@ -12,10 +12,22 @@ and per-tenant byte dashboards then run through the standard query API
 (``?dataset=_system``) and the fused single-dispatch path like any other
 workload.
 
+The query observatory (obs/querylog.py) rides this pipeline into
+``_system``: the per-phase histograms
+(``filodb_query_phase_seconds{phase,dataset}``) and the per-tenant /
+per-path cumulative aggregates
+(``filodb_tenant_phase_seconds_total{phase,ws,ns}``,
+``filodb_query_path_total{path,dataset}``) are ordinary registry families,
+so every scrape ingests them as real series and
+``histogram_quantile(0.99, sum by (le)
+(rate(filodb_query_phase_seconds_bucket{phase="render"}[5m])))`` answers
+through the fused path — which is also what the SLO burn-rate recording
+rules (obs/slo.py) evaluate against.
+
 Also here: the scrape-time collector that surfaces ``tools/tpu_watch.py``
 device-probe results as ``filodb_tpu_*`` gauges (the watchdog's log is the
 source of truth; parsing it at scrape time means the server needs no side
-channel to the watchdog process).
+channel to the watchdog process), and the query-log ring-depth collector.
 """
 
 from __future__ import annotations
@@ -88,6 +100,23 @@ class SelfScraper:
                 self.scrape_once()
             except Exception:  # noqa: BLE001 — telemetry must never kill serving
                 log.exception("self-scrape failed")
+
+
+# -- query-observatory collector ---------------------------------------------
+
+
+def register_querylog_collector(registry=REGISTRY) -> None:
+    """Expose the query-log ring's depth as ``filodb_querylog_entries``,
+    refreshed at scrape time (keyed — re-registration replaces). The
+    per-phase/per-tenant/per-path aggregates need no collector: they are
+    plain counters/histograms bumped at record time (obs/querylog.py) and
+    every self-scrape carries them into ``_system``."""
+    from .obs.querylog import QUERY_LOG
+
+    def collect():
+        registry.gauge("filodb_querylog_entries").set(float(len(QUERY_LOG)))
+
+    registry.register_collector("querylog", collect)
 
 
 # -- tpu-watch probe gauges --------------------------------------------------

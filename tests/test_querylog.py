@@ -1,0 +1,488 @@
+"""Query observatory (doc/observability.md "Query observatory"):
+exemplar-level query-log ring, per-phase latency decomposition, the
+`_system` round trip for phase quantiles through the fused path, and the
+SLO burn-rate recording rules updating from live traffic.
+
+Contracts pinned here:
+
+- ring bounds + concurrency (record-vs-resize race, newest-first, by-id
+  index eviction);
+- the phase-sum invariant: the engine-phase sum equals engine wall time
+  by construction (``other`` is the computed residual);
+- the warm canonical query stays exactly ONE kernel dispatch with
+  query-log capture enabled, and the per-query record is metadata-only;
+- shed (429) and errored queries leave records too (status shed|error);
+- the slow-query ring links to the query log by query_id (one execution,
+  two views — never disjoint surfaces);
+- "p99 render phase" and "p99 latency by tenant" answer through the
+  fused path from ``_system`` (classic-bucket histogram_quantile);
+- the default SLO rules register and demonstrably update from live
+  traffic end-to-end (FiloServer, config-gated).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from filodb_tpu.api.http import serve_background
+from filodb_tpu.coordinator.planner import PlannerParams, QueryEngine
+from filodb_tpu.core.schemas import Dataset
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.memstore.shard import StoreConfig
+from filodb_tpu.metrics import QUERY_PHASES, REGISTRY
+from filodb_tpu.obs.querylog import (
+    QUERY_LOG,
+    PhaseRecorder,
+    QueryLogRing,
+    promql_fingerprint,
+)
+from filodb_tpu.testkit import counter_batch
+
+pytestmark = pytest.mark.observability
+
+BASE = 1_600_000_000_000
+N_SAMPLES = 240
+START_S = (BASE + 600_000) / 1000
+END_S = (BASE + 1_800_000) / 1000
+Q = "sum by (job) (rate(http_requests_total[5m]))"
+
+
+def _make_engine(n_shards=4, n_series=24, **params):
+    ms = TimeSeriesMemStore(StoreConfig())
+    ms.setup(Dataset("ds"), list(range(n_shards)))
+    ms.ingest_routed(
+        "ds", counter_batch(n_series=n_series, n_samples=N_SAMPLES,
+                            start_ms=BASE),
+        spread=3,
+    )
+    return ms, QueryEngine(ms, "ds", PlannerParams(**params))
+
+
+def _dispatch_total() -> int:
+    total = 0
+    with REGISTRY._lock:
+        for (name, _labels), m in REGISTRY._metrics.items():
+            if name == "filodb_kernel_dispatch_seconds":
+                total += m.total
+    return total
+
+
+def _get_json(url: str) -> dict:
+    with urllib.request.urlopen(url) as r:
+        return json.loads(r.read())
+
+
+# ---------------------------------------------------------------------------
+# PhaseRecorder
+
+
+class TestPhaseRecorder:
+    def test_unknown_phase_rejected(self):
+        rec = PhaseRecorder()
+        with pytest.raises(ValueError, match="unknown query phase"):
+            rec.add("reticulate", 0.1)
+
+    def test_accumulates_and_clamps(self):
+        rec = PhaseRecorder()
+        rec.add("stage", 0.1)
+        rec.add("stage", 0.2)
+        rec.add("render", -5.0)  # negative clock skew clamps to 0
+        snap = rec.snapshot()
+        assert snap["stage"] == pytest.approx(0.3)
+        assert snap["render"] == 0.0
+        assert rec.total() == pytest.approx(0.3)
+
+    def test_context_manager(self):
+        rec = PhaseRecorder()
+        with rec.phase("parse_plan"):
+            time.sleep(0.01)
+        assert rec.snapshot()["parse_plan"] >= 0.005
+
+    def test_canonical_set_matches_metrics(self):
+        # the recorder's set IS metrics.QUERY_PHASES (one taxonomy)
+        for p in QUERY_PHASES:
+            PhaseRecorder().add(p, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# ring bounds + concurrency
+
+
+class TestQueryLogRing:
+    @staticmethod
+    def _entry(i: int) -> dict:
+        return {"id": f"q{i}", "phases_ms": {}, "time": i}
+
+    def test_bounds_and_newest_first(self):
+        ring = QueryLogRing(max_entries=8)
+        for i in range(20):
+            ring.record(self._entry(i))
+        assert len(ring) == 8
+        ids = [e["id"] for e in ring.entries()]
+        assert ids == [f"q{i}" for i in range(19, 11, -1)]
+        # evicted ids leave the index too
+        assert ring.get("q0") is None
+        assert ring.get("q19")["id"] == "q19"
+
+    def test_limit_pages(self):
+        ring = QueryLogRing(max_entries=16)
+        for i in range(10):
+            ring.record(self._entry(i))
+        assert [e["id"] for e in ring.entries(limit=3)] == ["q9", "q8", "q7"]
+
+    def test_entries_are_copies(self):
+        ring = QueryLogRing()
+        ring.record({"id": "a", "phases_ms": {"stage": 1.0}})
+        got = ring.get("a")
+        got["phases_ms"]["stage"] = 999.0
+        got["id"] = "tampered"
+        assert ring.get("a")["phases_ms"]["stage"] == 1.0
+
+    def test_concurrent_record_vs_configure_resize(self):
+        ring = QueryLogRing(max_entries=8)
+        errors: list = []
+        stop = threading.Event()
+
+        def recorder(tid: int):
+            try:
+                i = 0
+                while not stop.is_set():
+                    ring.record({"id": f"t{tid}-{i}", "phases_ms": {}})
+                    i += 1
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        def resizer():
+            try:
+                while not stop.is_set():
+                    for n in (4, 64, 1, 16):
+                        ring.configure(n)
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=recorder, args=(t,))
+                   for t in range(3)] + [threading.Thread(target=resizer)]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)
+        stop.set()
+        for t in threads:
+            t.join(timeout=2)
+        assert not errors
+        ring.configure(16)
+        assert len(ring) <= 16
+        # the ring stays internally consistent: every listed id resolves
+        for e in ring.entries():
+            assert ring.get(e["id"]) is not None
+
+    def test_finish_serving_first_wins(self):
+        ring = QueryLogRing()
+        e = ring.record({"id": "x", "dataset": "ds", "phases_ms": {},
+                         "ws": "unknown", "ns": "unknown"})
+        ring.finish_serving(e, 0.1, 0.2, body_bytes=10, code=200)
+        ring.finish_serving(e, 9.0, 9.0, body_bytes=99, code=500)
+        got = ring.get("x")
+        assert got["phases_ms"]["render"] == pytest.approx(200.0)
+        assert got["result"]["bytes"] == 10
+        assert got["code"] == 200
+
+
+# ---------------------------------------------------------------------------
+# engine capture
+
+
+class TestEngineCapture:
+    def test_record_schema_and_phase_sum_invariant(self):
+        _ms, eng = _make_engine()
+        res = eng.query_range(Q, START_S, END_S, 60)
+        rec = res.query_log
+        assert rec["path"] == "fused"
+        assert rec["fallback_reason"] is None
+        assert rec["grid_class"] == "regular"
+        assert rec["status"] == "ok"
+        assert rec["stats"]["series_scanned"] == 24
+        assert rec["result"]["series"] >= 1
+        # engine-phase sum == wall time (``other`` is the residual);
+        # tolerance covers the 3-decimal per-phase rounding only
+        ph = rec["phases_ms"]
+        assert set(ph) <= set(QUERY_PHASES)
+        assert {"parse_plan", "admission", "stage", "dispatch"} <= set(ph)
+        assert sum(ph.values()) == pytest.approx(rec["duration_ms"],
+                                                 abs=0.05)
+        assert QUERY_LOG.get(rec["id"]) is not None
+
+    def test_fingerprint_normalizes_live_edge(self):
+        # same panel, sliding end, same span/step -> ONE fingerprint;
+        # different step -> different
+        a = promql_fingerprint("ds", Q, 60_000, 1_200_000)
+        b = promql_fingerprint("ds", Q, 60_000, 1_200_000)
+        c = promql_fingerprint("ds", Q, 15_000, 1_200_000)
+        assert a == b != c
+        _ms, eng = _make_engine()
+        r1 = eng.query_range(Q, START_S, START_S + 600, 60).query_log
+        r2 = eng.query_range(Q, START_S + 60, START_S + 660, 60).query_log
+        assert r1["fingerprint"] == r2["fingerprint"]
+        assert r1["id"] != r2["id"]
+
+    def test_warm_query_single_dispatch_with_capture(self):
+        """Acceptance: the warm canonical query is exactly ONE kernel
+        dispatch with query-log capture enabled, and the record is
+        metadata-only (cache hit, zero bytes staged)."""
+        _ms, eng = _make_engine()
+        eng.query_range(Q, START_S, END_S, 60)  # stage + compile
+        before = _dispatch_total()
+        res = eng.query_range(Q, START_S, END_S, 60)
+        assert _dispatch_total() - before == 1
+        rec = res.query_log
+        assert rec["path"] == "fused"
+        assert rec["stats"]["cache_hits"] == 1
+        assert rec["stats"]["bytes_staged"] == 0
+
+    def test_shed_query_records_status(self):
+        from filodb_tpu.query.scheduler import (
+            AdmissionController, AdmissionRejected,
+        )
+
+        _ms, eng = _make_engine()
+        eng.planner.params.admission = AdmissionController(
+            {"*": {"rate": 0.0001, "burst": 1}}
+        )
+        eng.query_range(Q, START_S, END_S, 60)  # takes the only token
+        with pytest.raises(AdmissionRejected):
+            eng.query_range(Q, START_S + 1, END_S, 60)
+        shed = [e for e in QUERY_LOG.entries(limit=4)
+                if e["status"] == "shed"]
+        assert shed and shed[0]["promql"] == Q
+        assert "AdmissionRejected" in shed[0]["error"]
+
+    def test_error_query_records_status(self):
+        from filodb_tpu.query.exec.transformers import QueryError
+
+        _ms, eng = _make_engine(max_series=2)
+        with pytest.raises(QueryError):
+            eng.query_range(Q, START_S, END_S, 60)
+        err = [e for e in QUERY_LOG.entries(limit=4)
+               if e["status"] == "error"]
+        assert err and "QueryError" in err[0]["error"]
+
+    def test_fallback_path_annotated(self):
+        _ms, eng = _make_engine()
+        res = eng.query_range(Q, START_S, END_S, 60,
+                              allow_partial_results=True)
+        rec = res.query_log
+        assert rec["path"] == "fallback"
+        assert rec["fallback_reason"] == "partial_results"
+
+
+# ---------------------------------------------------------------------------
+# HTTP edge: endpoints, serving phases, slow-query link
+
+
+class TestHttpEdge:
+    @pytest.fixture()
+    def server(self):
+        _ms, eng = _make_engine(slow_query_threshold_s=0.0)
+        srv, port = serve_background(eng, port=0)
+        yield eng, port
+        srv.shutdown()
+
+    def test_profile_round_trip_and_serving_phases(self, server):
+        _eng, port = server
+        base = f"http://127.0.0.1:{port}"
+        _get_json(f"{base}/api/v1/query_range?query="
+                  + urllib.parse.quote(Q)
+                  + f"&start={START_S}&end={END_S}&step=60")
+        entries = _get_json(f"{base}/debug/querylog?limit=1")["data"]
+        assert len(entries) == 1
+        rec = entries[0]
+        # the edge folded its serving phases in
+        assert "transfer" in rec["phases_ms"] and "render" in rec["phases_ms"]
+        assert rec["code"] == 200
+        assert rec["result"]["bytes"] > 0
+        prof = _get_json(f"{base}/api/v1/query_profile?id={rec['id']}")
+        assert prof["data"]["id"] == rec["id"]
+
+    def test_profile_unknown_id_404(self, server):
+        _eng, port = server
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get_json(f"http://127.0.0.1:{port}/api/v1/query_profile?id=nope")
+        assert ei.value.code == 404
+
+    def test_slow_query_links_to_profile(self, server):
+        """Satellite: /debug/slow_queries entries join the query log by
+        query_id + profile link instead of being a disjoint surface."""
+        _eng, port = server
+        base = f"http://127.0.0.1:{port}"
+        _get_json(f"{base}/api/v1/query_range?query="
+                  + urllib.parse.quote(Q)
+                  + f"&start={START_S}&end={END_S}&step=60")
+        slow = _get_json(f"{base}/debug/slow_queries")["data"]
+        assert slow, "threshold 0 must slow-log every query"
+        entry = slow[0]
+        assert entry["query_id"]
+        assert entry["profile"] == f"/api/v1/query_profile?id={entry['query_id']}"
+        prof = _get_json(base + entry["profile"])["data"]
+        assert prof["id"] == entry["query_id"]
+        assert prof["promql"] == entry["promql"]
+
+    def test_http_responses_counted(self, server):
+        _eng, port = server
+        base = f"http://127.0.0.1:{port}"
+        before = REGISTRY.counter("filodb_http_responses", code="200",
+                                  **{"class": "2xx"}).value
+        _get_json(f"{base}/api/v1/query?query="
+                  + urllib.parse.quote("vector(1)") + "&time=100")
+        after = REGISTRY.counter("filodb_http_responses", code="200",
+                                 **{"class": "2xx"}).value
+        assert after == before + 1
+
+
+# ---------------------------------------------------------------------------
+# the _system round trip: phase quantiles through the fused path
+
+
+class TestSystemRoundTrip:
+    def test_p99_phases_by_tenant_through_fused_path(self):
+        """`histogram_quantile(0.99, sum by (le) (rate(
+        filodb_query_phase_seconds_bucket{phase="render"}[5m])))` and the
+        per-tenant latency p99 both answer from _system THROUGH the fused
+        path (the classic-bucket quantile rides one by-(le) agg
+        dispatch)."""
+        from filodb_tpu.telemetry import SYSTEM_DATASET, SelfScraper
+
+        ms, eng = _make_engine(n_shards=2, n_series=8)
+        ms.setup(Dataset(SYSTEM_DATASET), list(range(2)))
+        sys_eng = QueryEngine(ms, SYSTEM_DATASET, PlannerParams())
+        scraper = SelfScraper(ms, interval_s=3600)
+        now = int(time.time() * 1000)
+        for k in range(6):
+            res = eng.query_range(Q, START_S + k, END_S, 60)
+            # the render/transfer phases an HTTP edge would observe
+            QUERY_LOG.finish_serving(res.query_log, 0.001, 0.002,
+                                     body_bytes=100, code=200)
+            scraper.scrape_once(now_ms=now + k * 15_000)
+        for promql in (
+            'histogram_quantile(0.99, sum by (le) (rate('
+            'filodb_query_phase_seconds_bucket{phase="render"}[5m])))',
+            'histogram_quantile(0.99, sum by (le) (rate('
+            'filodb_tenant_query_latency_seconds_bucket'
+            '{ws="unknown"}[5m])))',
+        ):
+            res = sys_eng.query_range(promql, (now + 30_000) / 1000,
+                                      (now + 75_000) / 1000, 15)
+            rec = res.query_log
+            assert rec["path"] == "fused", (promql, rec["fallback_reason"])
+            assert len(res.grids) == 1
+            vals = res.grids[0].values_np()
+            assert np.isfinite(vals).any(), promql
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate rules
+
+
+class TestSloRules:
+    def test_default_rules_shape(self):
+        from filodb_tpu.obs.slo import default_slo_rules
+
+        rules = default_slo_rules()
+        names = [r["name"] for r in rules]
+        # per window: availability burn + global p99 + global latency burn
+        assert len(rules) == 6
+        assert "slo:availability:burnrate:5m" in names
+        assert "slo:latency:p99:1h" in names
+        assert "slo:latency:burnrate:5m" in names
+        for r in rules:
+            assert r["interval_s"] == 15.0
+        # per-tenant objective mints a per-tenant burn rule
+        rules_t = default_slo_rules(
+            {"latency_objectives_s": {"*": 2.0, "demo/app": 0.5},
+             "windows": ["5m"]}
+        )
+        assert "slo:latency:burnrate:demo_app:5m" in [
+            r["name"] for r in rules_t
+        ]
+        assert any('ws="demo"' in r["expr"] and 'ns="app"' in r["expr"]
+                   for r in rules_t)
+
+    def test_objective_validation(self):
+        from filodb_tpu.obs.slo import default_slo_rules
+
+        with pytest.raises(ValueError):
+            default_slo_rules({"availability_objective": 1.0})
+        with pytest.raises(ValueError):
+            default_slo_rules({"latency_objectives_s": {"*": 0.0}})
+
+    def test_slo_rules_update_from_live_traffic_e2e(self, tmp_path):
+        """Acceptance e2e: FiloServer with telemetry + standing wires the
+        _system SLO maintainer; real HTTP traffic drives the latency
+        histograms, the self-scrape lands them in _system, and the
+        recording rules write burn-rate series back — queryable over the
+        same API and listed in /api/v1/rules."""
+        from filodb_tpu.server import FiloServer
+        from filodb_tpu.telemetry import SYSTEM_DATASET
+
+        srv = FiloServer({
+            "dataset": "ds",
+            "shards": 2,
+            "store_root": str(tmp_path / "store"),
+            "telemetry": {"self_scrape_interval_s": 3600},
+            "slo": {"interval_s": 15.0, "windows": ["5m"]},
+        })
+        port = srv.start(port=0)
+        try:
+            assert srv.system_standing is not None
+            assert len(srv.slo_rules) == 3
+            srv.memstore.ingest_routed(
+                "ds", counter_batch(n_series=6, n_samples=N_SAMPLES,
+                                    start_ms=BASE), spread=1,
+            )
+            base = f"http://127.0.0.1:{port}"
+            now = int(time.time() * 1000)
+            for k in range(6):
+                # live traffic: real API queries (the latency + phase
+                # histograms and http response counters this feeds are
+                # exactly what the rules quantile over)
+                _get_json(f"{base}/api/v1/query_range?query="
+                          + urllib.parse.quote(Q)
+                          + f"&start={START_S + k}&end={END_S}&step=60")
+                assert srv.self_scraper.scrape_once(
+                    now_ms=now + k * 15_000) > 0
+            # two deterministic evaluations (the maintainer thread also
+            # ticks on eval_interval_s in production)
+            for sq in srv.slo_rules:
+                srv.system_standing.refresh(sq, now_ms=now + 75_000)
+                assert sq.last_error is None, (sq.rule_name, sq.last_error)
+            for sq in srv.slo_rules:
+                srv.system_standing.refresh(sq, now_ms=now + 90_000)
+            rules = _get_json(f"{base}/api/v1/rules")["data"]["groups"]
+            listed = [r["name"] for g in rules for r in g["rules"]]
+            assert "slo:latency:p99:5m" in listed
+            assert "slo:availability:burnrate:5m" in listed
+            out = _get_json(
+                f"{base}/api/v1/query_range?dataset={SYSTEM_DATASET}"
+                "&query=" + urllib.parse.quote("slo:latency:p99:5m")
+                + f"&start={now / 1000}&end={(now + 95_000) / 1000}&step=15"
+            )["data"]
+            vals = [float(v) for series in out["result"]
+                    for _, v in series["values"] if v != "NaN"]
+            assert vals and max(vals) > 0, "p99 rule never recorded"
+            out2 = _get_json(
+                f"{base}/api/v1/query_range?dataset={SYSTEM_DATASET}"
+                "&query=" + urllib.parse.quote("slo:latency:burnrate:5m")
+                + f"&start={now / 1000}&end={(now + 95_000) / 1000}&step=15"
+            )["data"]
+            burn = [float(v) for series in out2["result"]
+                    for _, v in series["values"] if v != "NaN"]
+            # burn = p99 / objective(2.0): live traffic p99 is well under
+            # the objective, so 0 < burn < 1
+            assert burn and 0 < max(burn) < 1
+        finally:
+            srv.stop()

@@ -1,5 +1,6 @@
 #!/usr/bin/env python
-"""Lint: every ExecPlan subclass must execute under a tracing span.
+"""Lint: every ExecPlan subclass must execute under a tracing span, and
+the query-phase decomposition must stay canonical and complete.
 
 The tracing contract (doc/observability.md) is that ``ExecPlan.execute`` is
 the ONE place spans wrap plan-node execution — subclasses implement
@@ -13,6 +14,21 @@ This check walks the package AST (no imports — runs without jax):
 - flags any that define ``execute`` unless that override visibly opens a
   span (calls ``span(``) or delegates to ``super().execute``;
 - asserts the base ``ExecPlan.execute`` itself opens a span.
+
+Phase-coverage lint (the query observatory, doc/observability.md "Query
+observatory" — mirroring check_metrics.py's fused-fallback taxonomy lint):
+
+- every phase literal in the package (``span(..., phase="x")`` kwargs,
+  ``rec.phase("x")`` context-manager calls, ``rec.add("x", ...)``) must be
+  a member of the canonical ``metrics.QUERY_PHASES`` set — an unknown
+  phase name would mint an undashboarded histogram series;
+- every QueryEngine execution entry (``_query_range_uncoalesced``,
+  ``query_instant``, ``execute_plan``) must capture ``parse_plan`` and
+  ``admission`` exactly once;
+- every fused dispatch path (``span("fused:dispatch...")`` sites in
+  ``FusedAggregateExec.do_execute``) must route through
+  ``_dispatch_fused``, which must decompose into ``admission`` (queue
+  wait) + ``dispatch``; the stage phase must be captured exactly once.
 
 Exit code 0 = clean, 1 = violations (printed one per line).
 """
@@ -64,6 +80,136 @@ def opens_span(fn: ast.FunctionDef) -> bool:
     return False
 
 
+def _canonical_phases() -> set[str]:
+    """metrics.QUERY_PHASES, read from the AST (no imports)."""
+    out: set[str] = set()
+    tree = ast.parse((PKG / "metrics.py").read_text())
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Assign) and node.targets
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "QUERY_PHASES"):
+            for c in ast.walk(node.value):
+                if isinstance(c, ast.Constant) and isinstance(c.value, str):
+                    out.add(c.value)
+    return out
+
+
+def _phase_literals(tree: ast.AST):
+    """(phase-literal, lineno) pairs from one module: ``phase=`` kwargs on
+    span() calls, ``<x>.phase("...")`` context-manager calls, and
+    ``rec.add("...", ...)`` recorder bumps."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = getattr(f, "attr", None) or getattr(f, "id", None)
+        if name == "span":
+            for kw in node.keywords:
+                if (kw.arg == "phase" and isinstance(kw.value, ast.Constant)
+                        and isinstance(kw.value.value, str)):
+                    yield kw.value.value, node.lineno
+        elif name == "phase" and isinstance(f, ast.Attribute) and node.args:
+            a = node.args[0]
+            if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                yield a.value, node.lineno
+        elif (name == "add" and isinstance(f, ast.Attribute)
+              and isinstance(f.value, ast.Name) and f.value.id == "rec"
+              and node.args):
+            a = node.args[0]
+            if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                yield a.value, node.lineno
+
+
+def _count_in(fn: ast.AST, want: str, kinds=("phase", "add", "span")) -> int:
+    n = 0
+    for lit, _ in _phase_literals(fn):
+        if lit == want:
+            n += 1
+    return n
+
+
+def phase_violations(classes: dict[str, ast.ClassDef]) -> list[str]:
+    out: list[str] = []
+    canon = _canonical_phases()
+    if not canon:
+        return ["phase lint: QUERY_PHASES not found in filodb_tpu/metrics.py"]
+    # (a) canonical-set rejection over the whole package
+    for path in sorted(PKG.rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for lit, lineno in _phase_literals(tree):
+            if lit not in canon:
+                out.append(
+                    f"{path}:{lineno}: unknown query phase {lit!r} — not in "
+                    f"metrics.QUERY_PHASES {sorted(canon)}"
+                )
+    # (b) engine entry coverage: parse_plan + admission exactly once each
+    planner = ast.parse((PKG / "coordinator" / "planner.py").read_text())
+    entries = {"_query_range_uncoalesced", "query_instant", "execute_plan"}
+    seen_entries = set()
+    for node in ast.walk(planner):
+        if isinstance(node, ast.FunctionDef) and node.name in entries:
+            seen_entries.add(node.name)
+            for want in ("parse_plan", "admission"):
+                n = _count_in(node, want)
+                if n != 1:
+                    out.append(
+                        f"QueryEngine.{node.name} captures phase {want!r} "
+                        f"{n} times (must be exactly once)"
+                    )
+    for missing in sorted(entries - seen_entries):
+        out.append(f"QueryEngine.{missing} not found for phase lint")
+    # (c) fused path: one stage capture; every fused:dispatch span routes
+    # through _dispatch_fused; _dispatch_fused splits admission + dispatch
+    fused = classes.get("FusedAggregateExec")
+    if fused is None:
+        out.append("FusedAggregateExec not found for phase lint")
+        return out
+    do_exec = method(fused, "do_execute")
+    disp = method(fused, "_dispatch_fused")
+    if do_exec is None or disp is None:
+        out.append("FusedAggregateExec.do_execute/_dispatch_fused missing")
+        return out
+    n_stage = _count_in(do_exec, "stage")
+    if n_stage != 1:
+        out.append(
+            f"FusedAggregateExec.do_execute captures phase 'stage' "
+            f"{n_stage} times (must be exactly once)"
+        )
+    n_spans = n_routed = 0
+    for node in ast.walk(do_exec):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = getattr(f, "attr", None) or getattr(f, "id", None)
+        if name == "span" and node.args:
+            a = node.args[0]
+            text = None
+            if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                text = a.value
+            elif isinstance(a, ast.JoinedStr) and a.values and isinstance(
+                    a.values[0], ast.Constant):
+                text = str(a.values[0].value)
+            if text and text.startswith("fused:dispatch"):
+                n_spans += 1
+        elif name == "_dispatch_fused":
+            n_routed += 1
+    if n_spans != n_routed or n_routed == 0:
+        out.append(
+            f"FusedAggregateExec.do_execute has {n_spans} fused:dispatch "
+            f"spans but {n_routed} _dispatch_fused calls — every dispatch "
+            "path must route through the phase-decomposing helper"
+        )
+    for want in ("admission", "dispatch"):
+        if _count_in(disp, want) == 0:
+            out.append(
+                f"FusedAggregateExec._dispatch_fused never records phase "
+                f"{want!r} — the queue-wait/launch decomposition is gone"
+            )
+    return out
+
+
 def main() -> int:
     classes: dict[str, ast.ClassDef] = {}
     files: dict[str, Path] = {}
@@ -111,6 +257,8 @@ def main() -> int:
                 "instrumented template without opening a span"
             )
 
+    violations.extend(phase_violations(classes))
+
     if violations:
         print(f"span-coverage lint: {len(violations)} violation(s)")
         for v in violations:
@@ -118,7 +266,7 @@ def main() -> int:
         return 1
     print(
         f"span-coverage lint: OK — {len(plan_classes)} ExecPlan subclasses "
-        "all execute under a span"
+        "all execute under a span; query-phase coverage canonical"
     )
     return 0
 
