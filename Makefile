@@ -2,7 +2,7 @@
 
 NATIVE_DIR := filodb_tpu/native
 
-.PHONY: all native test test-chaos test-ingest-chaos test-jitter test-multichip test-observability test-scheduler test-standing bench bench-smoke microbench serve clean tpu-watch tpu-watch-bg
+.PHONY: all native test test-chaos test-index test-ingest-chaos test-jitter test-multichip test-observability test-scheduler test-standing bench bench-smoke microbench serve clean tpu-watch tpu-watch-bg
 
 all: native
 
@@ -76,6 +76,15 @@ test-scheduler: native
 # fan-out to N subscribers, and recording-rule write-back
 test-standing: native
 	python -m pytest tests/test_standing.py -q -m standing
+
+# vectorized part-key index suite (doc/perf.md "Vectorized part-key
+# index"): randomized property equivalence of the posting-bitmap index vs
+# the retained set-based oracle (eq/in/literal-alt/prefix/general-regex/
+# negative/empty-matcher x interval overlap x limit), incremental
+# add/update_end_time/remove parity, concurrent lookup-vs-ingest soak,
+# and zero ledger drift for the opt-in device postings tier
+test-index: native
+	python -m pytest tests/test_index_bitmap.py -q -m index
 
 # observability suite (doc/observability.md): trace propagation + stitching,
 # slow-query log, query observatory (per-phase decomposition, query-log

@@ -43,6 +43,8 @@ METRIC = {
     "concurrent_qps": "concurrent_qps_16clients_20k",
     "fused_jitter": "fused_jitter_holes_ratio",
     "standing_refresh": "standing_refresh_speedup",
+    "index_regex": "index_regex_lookups_1000k",
+    "query_hicard": "query_hicard_2000_of_8000_qps",
 }.get(WORKLOAD, "sum_rate_100k_series_range_query_p50")
 # concurrent_qps: client thread count, per-mode measurement window, and the
 # batching window handed to the batched engine (the knob under test)
@@ -1106,6 +1108,165 @@ def run_benchmark_standing_refresh():
     }))
 
 
+def run_benchmark_index_regex():
+    """General anchored-regex selector resolution at 1M part keys on the
+    vectorized posting-bitmap index (doc/perf.md "Vectorized part-key
+    index") — the workload the set-arithmetic index measured at ~6.8k
+    lookups/s (BENCH_LOCAL index_regex_lookups_1000k; ISSUE 14 bar: >=5x).
+
+    Probe shape matches benchmarks/run.py bench_index_1m: the 5-tag
+    schema, general anchored regexes with a literal prefix + tail class
+    over the 10k-value host dictionary, full-retention range, a 64-pattern
+    Grafana-storm pool (repeated selectors — the per-label match cache is
+    part of the path under test, invalidated by any ingest to the label).
+    match = every pool pattern's id set identical to the retained
+    set-based oracle, plus eq + literal-alt + negative spot probes."""
+    from filodb_tpu.core.filters import ColumnFilter, equals, regex
+    from filodb_tpu.memstore.index import PartKeyIndex, SetBasedPartKeyIndex
+
+    n = N_SERIES
+    t0 = time.perf_counter()
+    idx = PartKeyIndex()
+    oracle = SetBasedPartKeyIndex()
+    for i in range(n):
+        tags = {
+            "_metric_": f"metric_{i % 1000}", "host": f"h{i % 10_000}",
+            "dc": f"dc{i % 10}", "_ws_": "demo", "_ns_": f"ns{i % 20}",
+        }
+        idx.add_partkey(i, tags, 0)
+        oracle.add_partkey(i, tags, 0)
+    warmup_s = time.perf_counter() - t0
+    sys.stderr.write(f"index build 2x{n}: {warmup_s:.1f}s\n")
+
+    pool = [[regex("host", f"h1{i:02d}[0-9]?")] for i in range(64)]
+    probes = pool + [
+        [equals("_metric_", "metric_5")],
+        [regex("host", "h123.*")],
+        [regex("host", "h1|h2|h33")],
+        [equals("_ws_", "demo"), regex("host", "h77[0-9]?")],
+        [ColumnFilter("dc", "!=", "dc3"), equals("_ns_", "ns7")],
+    ]
+    ok = all(
+        idx.part_ids_from_filters(f, 0, 2**62).tolist()
+        == oracle.part_ids_from_filters(f, 0, 2**62).tolist()
+        for f in probes
+    )
+
+    for f in pool:  # warm: dictionary pass + match-cache fill
+        idx.part_ids_from_filters(f, 0, 2**62)
+    reps = 2000
+    t0 = time.perf_counter()
+    for k in range(reps):
+        idx.part_ids_from_filters(pool[k % len(pool)], 0, 2**62)
+    dt = time.perf_counter() - t0
+    rate = reps / dt
+
+    # secondary visibility: eq + cold-cache (first-touch) rates
+    f_eq = [equals("_metric_", "metric_5")]
+    idx.part_ids_from_filters(f_eq, 0, 2**62)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        idx.part_ids_from_filters(f_eq, 0, 2**62)
+    eq_rate = reps / (time.perf_counter() - t0)
+    cold = [[regex("host", f"h2{i:02d}[0-9]?")] for i in range(64)]
+    t0 = time.perf_counter()
+    for f in cold:
+        idx.part_ids_from_filters(f, 0, 2**62)
+    cold_rate = len(cold) / (time.perf_counter() - t0)
+
+    sys.stderr.write(
+        f"regex warm={rate:.0f}/s cold={cold_rate:.0f}/s eq={eq_rate:.0f}/s "
+        f"match={ok}\n"
+    )
+    print(json.dumps({
+        "metric": METRIC,
+        "value": round(rate, 1),
+        "unit": "lookups/s",
+        # vs the recorded set-arithmetic baseline (BENCH_LOCAL 6818.8/s)
+        "vs_baseline": round(rate / 6818.8, 2),
+        "backend": "host",
+        "series": n,
+        "match": bool(ok),
+        "warmup_s": round(warmup_s, 2),
+        "phases_ms": {
+            "eq_lookups_per_s": round(eq_rate, 1),
+            "cold_regex_per_s": round(cold_rate, 1),
+        },
+    }))
+
+
+def run_benchmark_query_hicard():
+    """End-to-end hicard query throughput with the bitmap index in the
+    selector path: 8000 series (4 tenants x 2000), 2000 queried —
+    benchmarks/run.py bench_query_hicard's shape (recorded ~98 qps on the
+    set-based index at PR 13; ISSUE 14 bar: >=2x). match = the bitmap-index
+    engine's matrix is IDENTICAL (bit-equal, NaNs aligned) to a second
+    engine over the same data with index_backend="set" — the new index in
+    the path must not change a single sample."""
+    from filodb_tpu.coordinator.planner import QueryEngine
+    from filodb_tpu.core.schemas import Dataset
+    from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+    from filodb_tpu.memstore.shard import StoreConfig
+    from filodb_tpu.testkit import counter_batch
+
+    _enable_compile_cache()
+
+    def build(backend: str):
+        ms = TimeSeriesMemStore(StoreConfig(index_backend=backend))
+        ms.setup(Dataset("prometheus"), range(8))
+        for ns in range(4):
+            ms.ingest_routed(
+                "prometheus",
+                counter_batch(n_series=2000, n_samples=120, start_ms=BASE,
+                              ns=f"App-{ns}"),
+                spread=3,
+            )
+        return QueryEngine(ms, "prometheus")
+
+    t0 = time.perf_counter()
+    engine = build("python")
+    engine_set = build("set")
+    warmup_s = time.perf_counter() - t0
+    start, end = (BASE + 400_000) / 1000, (BASE + 1_100_000) / 1000
+    q = 'sum(rate(http_requests_total{_ns_="App-1"}[5m]))'
+
+    def run(eng):
+        res = eng.query_range(q, start, end, 60)
+        return np.asarray(res.grids[0].values_np())
+
+    got = run(engine)
+    want = run(engine_set)
+    ok = got.shape == want.shape and bool(
+        np.array_equal(got, want, equal_nan=True)
+    )
+
+    times = []
+    for _ in range(max(TIMED_RUNS, 10)):
+        t0 = time.perf_counter()
+        run(engine)
+        times.append(time.perf_counter() - t0)
+    p50_ms = float(np.median(times) * 1e3)
+    qps = 1e3 / p50_ms
+    import jax
+
+    backend = jax.devices()[0].platform
+    sys.stderr.write(
+        f"hicard p50={p50_ms:.2f}ms qps={qps:.1f} match={ok}\n"
+    )
+    print(json.dumps({
+        "metric": METRIC,
+        "value": round(qps, 1),
+        "unit": "qps",
+        # vs the recorded pre-bitmap measurement (BENCH_LOCAL ~98 qps)
+        "vs_baseline": round(qps / 98.0, 2),
+        "backend": backend,
+        "series": 8000,
+        "match": bool(ok),
+        "warmup_s": round(warmup_s, 2),
+        "phases_ms": {"p50_ms": round(p50_ms, 3)},
+    }))
+
+
 def run_benchmark():
     if WORKLOAD == "standing_refresh":
         return run_benchmark_standing_refresh()
@@ -1117,6 +1278,10 @@ def run_benchmark():
         return run_benchmark_fused_mesh()
     if WORKLOAD == "fused_jitter":
         return run_benchmark_fused_jitter()
+    if WORKLOAD == "index_regex":
+        return run_benchmark_index_regex()
+    if WORKLOAD == "query_hicard":
+        return run_benchmark_query_hicard()
     if WORKLOAD == "hist_quantile":
         ms, ts = build_memstore_hist()
     else:

@@ -90,7 +90,10 @@ def bench_ingestion():
 
 
 def bench_index():
-    """reference PartKeyIndexBenchmark: lookups/sec."""
+    """reference PartKeyIndexBenchmark: lookups/sec. PartKeyIndex is the
+    vectorized posting-bitmap index since ISSUE 14 — these numbers measure
+    the new path (the pre-bitmap set-arithmetic numbers live on in
+    BENCH_LOCAL history and the retained SetBasedPartKeyIndex oracle)."""
     from filodb_tpu.core.filters import equals, regex
     from filodb_tpu.memstore.index import PartKeyIndex
 
@@ -150,6 +153,54 @@ def bench_index_1m():
     report(f"index_regex_lookups_{tag}", 50 / dt, "lookups/s")
     dt = _bench(lambda: [idx.label_values([], "_metric_", 0, 2**62) for _ in range(20)])
     report(f"index_label_values_{tag}", 20 / dt, "lookups/s")
+
+
+def bench_index_bitmap_1m():
+    """1M-partkey BITMAP index (the default backend, memstore/postings.py):
+    build rate + the probe set bench_index_1m runs on the native backend,
+    plus the warm Grafana-storm regex pool the match cache serves
+    (doc/perf.md 'Vectorized part-key index'). FILODB_BENCH_INDEX_SERIES
+    overrides the scale."""
+    import os
+
+    from filodb_tpu.core.filters import equals, regex
+    from filodb_tpu.memstore.index import PartKeyIndex
+
+    n = int(os.environ.get("FILODB_BENCH_INDEX_SERIES", 1_000_000))
+    idx = PartKeyIndex()
+    t0 = time.perf_counter()
+    for i in range(n):
+        idx.add_partkey(i, {
+            "_metric_": f"metric_{i % 1000}", "host": f"h{i % 10_000}",
+            "dc": f"dc{i % 10}", "_ws_": "demo", "_ns_": f"ns{i % 20}",
+        }, 0)
+    tag = f"{n // 1000}k"
+    report(f"index_bitmap_build_{tag}", n / (time.perf_counter() - t0), "keys/s")
+    f_eq = [equals("_metric_", "metric_5")]
+    dt = _bench(lambda: [idx.part_ids_from_filters(f_eq, 0, 2**62) for _ in range(50)])
+    report(f"index_bitmap_eq_lookups_{tag}", 50 / dt, "lookups/s")
+    f_pre = [regex("host", "h123.*")]
+    dt = _bench(lambda: [idx.part_ids_from_filters(f_pre, 0, 2**62) for _ in range(50)])
+    report(f"index_bitmap_prefix_regex_lookups_{tag}", 50 / dt, "lookups/s")
+    f_re = [regex("host", "h12[0-9]?")]
+    dt = _bench(lambda: [idx.part_ids_from_filters(f_re, 0, 2**62) for _ in range(50)])
+    report(f"index_bitmap_regex_lookups_{tag}", 50 / dt, "lookups/s")
+    # warm 64-pattern pool: the repeated-selector storm the per-label match
+    # cache exists for (each pattern still pays OR + extraction per call)
+    pool = [[regex("host", f"h1{i:02d}[0-9]?")] for i in range(64)]
+    for f in pool:
+        idx.part_ids_from_filters(f, 0, 2**62)
+    k = [0]
+
+    def storm():
+        for _ in range(50):
+            idx.part_ids_from_filters(pool[k[0] % 64], 0, 2**62)
+            k[0] += 1
+
+    dt = _bench(storm)
+    report(f"index_bitmap_regex_pool_lookups_{tag}", 50 / dt, "lookups/s")
+    dt = _bench(lambda: [idx.label_values([], "_metric_", 0, 2**62) for _ in range(20)])
+    report(f"index_bitmap_label_values_{tag}", 20 / dt, "lookups/s")
 
 
 def bench_gateway_parse():
@@ -344,7 +395,7 @@ def bench_jitter_query():
 
 ALL = [
     bench_encoding, bench_nan_sum, bench_ingestion, bench_index,
-    bench_index_1m, bench_gateway_parse, bench_planner,
+    bench_index_1m, bench_index_bitmap_1m, bench_gateway_parse, bench_planner,
     bench_query_in_memory, bench_query_hicard, bench_histogram_query,
     bench_jitter_query,
 ]

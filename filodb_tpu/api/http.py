@@ -331,6 +331,8 @@ class PromApiHandler(BaseHTTPRequestHandler):
                 return self._scheduler()
             if path == "/debug/superblocks":
                 return self._superblocks()
+            if path == "/debug/index":
+                return self._index_debug()
             if path == "/debug/profile":
                 return self._profile()
             if path == "/api/v1/cardinality":
@@ -683,6 +685,64 @@ class PromApiHandler(BaseHTTPRequestHandler):
             "ledger_bytes": cache.ledger.bytes if cache is not None else 0,
         }))
 
+    def _index_debug(self):
+        """Part-key index introspection (doc/perf.md "Vectorized part-key
+        index"): per-label cardinality + postings footprint per shard, the
+        rolled-up label dictionary, and the hot device-staged posting
+        bitmaps when the opt-in HBM tier is on."""
+        from ..memstore.cardinality import label_top_values
+
+        p = self._params()
+        drill_label = self._q(p, "label")
+        ds = self.engine.dataset
+        shards = []
+        labels_rollup: dict[str, dict] = {}
+        drill: dict[str, int] = {}
+        total_bytes = device_bytes = 0
+        for sh in self.engine.memstore.shards(ds):
+            st = sh.index_stats()
+            if drill_label:
+                for rec in label_top_values(sh.index, drill_label, k=50):
+                    drill[rec["value"]] = (
+                        drill.get(rec["value"], 0) + rec["series"]
+                    )
+            for k, rec in st.get("labels", {}).items():
+                slot = labels_rollup.setdefault(
+                    k, {"values": 0, "postings_bytes": 0}
+                )
+                slot["values"] += rec["values"]
+                slot["postings_bytes"] += rec["postings_bytes"]
+            total_bytes += st.get("postings_bytes", 0)
+            dev = st.get("device")
+            if dev:
+                device_bytes += dev.get("staged_bytes", 0)
+            shards.append({
+                "shard": sh.shard_num,
+                "part_keys": st.get("num_part_keys", 0),
+                "postings_bytes": st.get("postings_bytes", 0),
+                "dictionary_size": st.get("dictionary_size", 0),
+                "lookups": st.get("lookups", 0),
+                "device": dev,
+            })
+        return self._send(200, J.success({
+            "dataset": ds,
+            "shards": shards,
+            # per-label cardinality summed over shards (a label's true
+            # cross-shard value cardinality is <= this sum; exact dedup
+            # would require merging dictionaries)
+            "labels": dict(sorted(
+                labels_rollup.items(),
+                key=lambda kv: -kv[1]["postings_bytes"],
+            )),
+            "postings_bytes": total_bytes,
+            "device_staged_bytes": device_bytes,
+            # ?label= drill-down: top values of that label by series count
+            "label_values": (sorted(
+                ({"value": v, "series": n} for v, n in drill.items()),
+                key=lambda r: (-r["series"], r["value"]),
+            )[:50] if drill_label else None),
+        }))
+
     def _profile(self):
         """Sampling-profiler report (config-gated: the server wires
         profiler_hook only when filodb.profiler is enabled)."""
@@ -988,12 +1048,17 @@ def register_shard_stats_collector(engine: QueryEngine) -> None:
             REGISTRY.unregister_collector(key)
             return
         for sh in memstore.shards(ds):
+            ist = sh.index_stats()
+            dev = ist.get("device") or {}
             for name, v in (
                 ("filodb_shard_partitions", sh.num_partitions),
                 ("filodb_shard_rows_ingested", sh.stats.rows_ingested),
                 ("filodb_shard_rows_skipped", sh.stats.rows_skipped),
                 ("filodb_shard_partitions_evicted", sh.stats.partitions_evicted),
                 ("filodb_shard_chunks_flushed", sh.stats.chunks_flushed),
+                ("filodb_index_postings_bytes", ist.get("postings_bytes", 0)),
+                ("filodb_index_dictionary_size", ist.get("dictionary_size", 0)),
+                ("filodb_index_device_staged_bytes", dev.get("staged_bytes", 0)),
             ):
                 REGISTRY.gauge(name, dataset=ds, shard=str(sh.shard_num)).set(float(v))
 

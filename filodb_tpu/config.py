@@ -18,7 +18,15 @@ DEFAULTS: dict = {
     "retention_hours": 72,
     "groups_per_shard": 16,
     "max_partitions_per_shard": 1_000_000,
-    "index_backend": "python",  # or "native" (C++ posting lists)
+    # "python" = vectorized posting-bitmap index (default), "native" = C++
+    # posting lists, "set" = the retained set-arithmetic oracle
+    "index_backend": "python",
+    # opt-in HBM tier for hot posting bitmaps (doc/perf.md "Vectorized
+    # part-key index": all-equality selectors over staged bitmaps resolve
+    # as one tiny jit intersection; ledger kind index_postings)
+    "index_device_postings": False,
+    "index_device_min_hits": 16,
+    "index_device_max_bytes": 64 << 20,
     # flush / persistence
     "flush_interval_s": 3600,
     "store_root": None,  # None = memory-only (NullColumnStore)

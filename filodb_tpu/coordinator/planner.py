@@ -374,6 +374,8 @@ class SingleClusterPlanner:
             METRIC_TAG, PROM_METRIC_TAG, shard_group, shardkey_hash,
         )
 
+        from ..memstore.index import _LITERAL_ALT
+
         options = self._options()
         skc = tuple(options.shard_key_columns)
         eq: dict[str, set[str]] = {}
@@ -383,6 +385,17 @@ class SingleClusterPlanner:
                 eq.setdefault(col, set()).add(f.value)
             elif f.op == "in":
                 eq.setdefault(col, set()).update(f.value)
+            elif (f.op == "=~" and isinstance(f.value, str)
+                  and _LITERAL_ALT.match(f.value)):
+                # literal-alternation regex on a shard-key column (the
+                # Grafana variable-storm shape {_ns_=~"App-1|App-2"}) pins
+                # it to an explicit value set exactly like `in` — same
+                # dictionary-batched expansion the index applies. An empty
+                # alternation part would also match a MISSING tag, which
+                # routing can't pin, so it falls back to scan-all.
+                parts = f.value.split("|")
+                if all(parts):
+                    eq.setdefault(col, set()).update(parts)
         keysets = []
         for c in skc:
             vals = eq.get(c)

@@ -126,7 +126,7 @@ class DeviceLedger:
     the drift check (``verify``) behind ``/debug/resources``."""
 
     KINDS = ("staged_block", "superblock", "compile_cache",
-             "standing_state")
+             "standing_state", "index_postings")
 
     def __init__(self):
         self._lock = threading.Lock()

@@ -137,3 +137,14 @@ class CardinalityTracker:
             p = tuple(rec["p"])
             t._counts[p] = CardinalityRecord(p, rec["t"], rec["a"], rec["c"])
         return t
+
+
+def label_top_values(index, label: str, k: int = 20) -> list[dict]:
+    """Top-K values of one label by live-series count, straight off the
+    part-key index's posting containers (container length is O(1) — no
+    posting walk, no tag-map scan). Complements the shard-key trie above:
+    the trie answers ws/ns/metric quotas, this answers "which VALUE of this
+    label is exploding" for /debug/index?label= drill-downs."""
+    counts = index.value_counts(label)
+    top = sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))[: int(k)]
+    return [{"value": v, "series": n} for v, n in top]

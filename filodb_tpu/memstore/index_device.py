@@ -1,0 +1,241 @@
+"""Opt-in device tier for hot posting bitmaps (ISSUE 14 tentpole; Tailwind:
+treat the accelerator boundary as explicit dataflow and stage the hot
+working STRUCTURES, not just samples; Storyboard: let the observed workload
+choose what gets precomputed).
+
+For huge tenants, multi-matcher selector resolution is repeated AND over
+the same few posting bitmaps (``_ws_``/``_ns_``/``_metric_`` equality). The
+tier watches the index's observed equality-selector traffic
+(``PartKeyIndex.traffic``, fed by the lookup path — the same selector
+stream the PR 12 query-log fingerprints record per query) and stages the
+hottest (label, value) bitmaps to HBM as packed words. An all-equality
+lookup whose matchers are ALL staged then resolves as ONE tiny jit
+intersection program (ops/postings_kernels.py) instead of host set math.
+
+Accounting: every staged bitmap debits the process device ledger under the
+``index_postings`` kind; drops/invalidations credit it back, and the
+ledger's drift check recounts via :func:`_tier_walker` — the device tier
+can never hold untracked HBM.
+
+Consistency: a staged entry records the label's ``post_version`` at staging
+time. Any posting change under that label (ingest of a new series, removal)
+bumps the version; the entry is then DROPPED at next use and re-staged by
+the next ``maintain()`` pass. Stale device bitmaps are never consulted.
+
+Default OFF (``StoreConfig.index_device_postings``): with the tier disabled
+the index never touches a device and the warm fused query stays exactly ONE
+kernel dispatch. Enabling it trades one extra (tiny) dispatch per resolved
+selector for vectorized intersection off the host.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+
+def _tier_walker(tier: "DevicePostingsTier") -> int:
+    """Ledger drift-check ground truth: recount staged device bytes."""
+    with tier._lock:
+        return sum(e.nbytes for e in tier._staged.values())
+
+
+class _Entry:
+    __slots__ = ("dev", "nbytes", "post_version", "hits")
+
+    def __init__(self, dev, nbytes: int, post_version: int):
+        self.dev = dev
+        self.nbytes = int(nbytes)
+        self.post_version = post_version
+        self.hits = 0
+
+
+class DevicePostingsTier:
+    """Hot posting bitmaps staged to HBM for one shard's index."""
+
+    def __init__(self, index, min_hits: int = 16, max_bytes: int = 64 << 20,
+                 name: str = ""):
+        from ..ledger import LEDGER
+
+        self.index = index
+        self.min_hits = int(min_hits)
+        self.max_bytes = int(max_bytes)
+        self._staged: dict[tuple[str, str], _Entry] = {}
+        self._lock = threading.Lock()
+        self.stats = {"intersections": 0, "host_fallbacks": 0,
+                      "staged": 0, "dropped": 0}
+        self._maintaining = False
+        # steady-state guard for the opportunistic sweep: never more often
+        # than this — a warm lookup storm must not pay the sort+probe walk
+        # (or a thread spawn) every 256th call for a no-op
+        self.sweep_min_interval_s = 2.0
+        self._last_sweep = 0.0
+        self.ledger = LEDGER.register(
+            self, "index_postings", _tier_walker,
+            name=name or "index-device-tier",
+        )
+
+    # -- staging policy ----------------------------------------------------
+
+    def maintain(self, max_stage: int = 8) -> int:
+        """Stage up to ``max_stage`` of the hottest not-yet-staged posting
+        bitmaps (traffic >= min_hits), hottest first, within the byte
+        budget; drop version-stale entries. Returns entries staged. Called
+        opportunistically (every 256th lookup) and directly by tests/ops —
+        NOT on the lookup fast path itself."""
+        from ..ops.postings_kernels import host_words_to_device
+        from . import postings as P
+
+        idx = self.index
+        staged = 0
+        with idx._lock:
+            hot = sorted(
+                ((hits, key) for key, hits in idx.traffic.items()
+                 if hits >= self.min_hits),
+                reverse=True,
+            )
+            snapshots = []
+            for hits, (label, value) in hot:
+                if staged + len(snapshots) >= max_stage:
+                    break
+                with self._lock:
+                    cur = self._staged.get((label, value))
+                L = idx._labels.get(label)
+                c = L.containers.get(value) if L is not None else None
+                if c is None:
+                    continue
+                if cur is not None and cur.post_version == L.post_version:
+                    continue  # fresh copy already resident
+                view = c.view(idx._nbits)
+                words = (view[1] if view[0] == "d"
+                         else P.ids_to_dense(view[1], P.nwords(idx._nbits)))
+                snapshots.append(
+                    ((label, value), words.copy(), L.post_version)
+                )
+            nbits = idx._nbits
+        # device_put outside the index lock: staging must never stall
+        # concurrent lookups/ingest
+        for key, words, pv in snapshots:
+            nbytes = words.nbytes
+            with self._lock:
+                held = sum(e.nbytes for e in self._staged.values())
+                if held + nbytes > self.max_bytes:
+                    break
+            dev = host_words_to_device(words)
+            with self._lock:
+                # re-check the budget under the lock: concurrent sweeps
+                # (the in-flight flag is advisory; tests/ops call
+                # maintain() directly) must not compound past max_bytes
+                old = self._staged.get(key)
+                held = sum(e.nbytes for e in self._staged.values()) \
+                    - (old.nbytes if old is not None else 0)
+                if held + nbytes > self.max_bytes:
+                    break
+                if old is not None:
+                    self.ledger.free(old.nbytes, reason="replace")
+                self._staged[key] = _Entry(dev, nbytes, pv)
+                self.ledger.alloc(nbytes)
+                self.stats["staged"] += 1
+            staged += 1
+        return staged
+
+    def drop(self, key: tuple[str, str], reason: str = "drop") -> None:
+        with self._lock:
+            e = self._staged.pop(key, None)
+            if e is not None:
+                self.ledger.free(e.nbytes, reason=reason)
+                self.stats["dropped"] += 1
+
+    def clear(self) -> None:
+        with self._lock:
+            freed = sum(e.nbytes for e in self._staged.values())
+            if self._staged:
+                self.ledger.free(freed, reason="invalidate",
+                                 count=len(self._staged))
+            self._staged.clear()
+
+    # -- lookup path -------------------------------------------------------
+
+    def try_intersect(self, classed):
+        """Resolve an all-equality selector from staged bitmaps: returns the
+        AND'd host uint64 words, or None when any matcher is unstaged /
+        stale (host path takes over). Caller holds the index lock."""
+        idx = self.index
+        if idx.lookups % 256 == 0 and not self._maintaining:
+            # opportunistic re-staging sweep, amortized off the hot path
+            # and rate-limited: a warm steady-state lookup storm pays one
+            # monotonic-clock read here, with at most one sweep (sort +
+            # freshness probes, ~ms) per interval. (One in-flight sweep at
+            # a time; the flag is advisory — a duplicate sweep is wasted
+            # work, never wrong.)
+            import time
+
+            now = time.monotonic()
+            if now - self._last_sweep >= self.sweep_min_interval_s:
+                self._maintaining = True
+                self._last_sweep = now
+
+                def _sweep():
+                    try:
+                        self.maintain()
+                    finally:
+                        self._maintaining = False
+
+                threading.Thread(target=_sweep, daemon=True).start()
+        # only pure non-empty equality selectors: a {k=""} matcher also
+        # matches series MISSING the tag (host path adds `all &~ tagged`),
+        # which a staged posting bitmap alone cannot represent
+        if not classed or any(
+            c != "eq" or f.value == "" for f, c in classed
+        ):
+            return None
+        entries = []
+        for f, _c in classed:
+            L = idx._labels.get(f.column)
+            if L is None:
+                return None
+            with self._lock:
+                e = self._staged.get((f.column, f.value))
+            if e is None:
+                self.stats["host_fallbacks"] += 1
+                return None
+            if e.post_version != L.post_version:
+                # postings moved under the staged copy: drop, host resolves
+                self.drop((f.column, f.value), reason="invalidate")
+                self.stats["host_fallbacks"] += 1
+                return None
+            e.hits += 1
+            entries.append(e)
+        from ..ops.postings_kernels import intersect_on_device
+
+        if len(entries) == 1:
+            out = np.ascontiguousarray(
+                np.asarray(entries[0].dev)
+            ).view(np.uint64)
+        else:
+            # staged bitmaps may span different capacities (the universe
+            # grew between stagings) — versions being current guarantees
+            # equal length here, but guard anyway
+            if len({e.dev.shape[0] for e in entries}) != 1:
+                self.stats["host_fallbacks"] += 1
+                return None
+            out = intersect_on_device([e.dev for e in entries])
+        self.stats["intersections"] += 1
+        return out
+
+    # -- introspection -----------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            entries = [
+                {"label": k[0], "value": k[1], "bytes": e.nbytes,
+                 "hits": e.hits}
+                for k, e in sorted(self._staged.items())
+            ]
+        return {
+            "staged": entries,
+            "staged_bytes": sum(e["bytes"] for e in entries),
+            "ledger_bytes": self.ledger.bytes,
+            "stats": dict(self.stats),
+        }

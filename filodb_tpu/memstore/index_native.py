@@ -21,7 +21,7 @@ from typing import Iterable, Mapping, Sequence
 import numpy as np
 
 from ..core.filters import ColumnFilter
-from .index import _LITERAL_ALT, PartKeyIndex
+from .index import _LITERAL_ALT, PartKeyIndex, regex_literal_prefix
 
 _HERE = os.path.join(os.path.dirname(__file__), "..", "native")
 _SO = os.path.abspath(os.path.join(_HERE, "libfilodbindex.so"))
@@ -95,29 +95,9 @@ def _load():
         return _lib
 
 
-# first regex metacharacter ends the literal prefix (conservative: a
-# backslash escape also stops it)
-_META = re.compile(r"[.^$*+?()[\]{}|\\]")
-
-
-def regex_literal_prefix(pattern: str) -> tuple[str, str]:
-    """Split an anchored regex into (safe literal prefix, remainder) — the
-    range-aware regex trick (reference tantivy_utils): ``http_5.*`` scans
-    only the ``http_5``-prefixed slice of the value dictionary.
-
-    Safety: every full match MUST start with the returned prefix. A
-    quantifier right after the literal run makes its last char optional
-    (``ab*`` matches "a"), so it is dropped; an alternation anywhere can
-    bypass the prefix entirely (``abc|z``), so the prefix collapses to ""."""
-    if "|" in pattern:
-        return "", pattern
-    m = _META.search(pattern)
-    if m is None:
-        return pattern, ""
-    prefix, remainder = pattern[: m.start()], pattern[m.start():]
-    if remainder[:1] in ("*", "?", "{") and prefix:
-        prefix = prefix[:-1]
-    return prefix, remainder
+# regex_literal_prefix moved to memstore/index.py (the bitmap index's
+# dictionary-batched regex path uses the same prefix split); re-exported
+# here for backward compatibility.
 
 
 def native_index_available() -> bool:
@@ -218,7 +198,7 @@ class NativePartKeyIndex(PartKeyIndex):
         PartKeyTantivyIndex.scala:38)."""
         pattern = f.value
         key = f.column.encode()
-        cap = max(len(self._all), 1)
+        cap = max(len(self._tags), 1)
         out = np.empty(cap, dtype=np.int32)
         optr = out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
         if _LITERAL_ALT.match(pattern):
@@ -294,7 +274,7 @@ class NativePartKeyIndex(PartKeyIndex):
         vals = [f.value.encode() for f in eq_filters]
         KeyArr = ctypes.c_char_p * n
         LenArr = ctypes.c_long * n
-        cap = max(len(self._all), 1)
+        cap = max(len(self._tags), 1)
         out = np.empty(cap, dtype=np.int32)
         got = self._L.fdb_idx_query(
             self._h, n,

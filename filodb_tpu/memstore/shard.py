@@ -52,10 +52,18 @@ class StoreConfig:
     encode_on_seal: bool = False
     groups_per_shard: int = NUM_FLUSH_GROUPS
     max_partitions: int = 1_000_000
-    # "python" | "native": the C++ posting-list index (reference's tantivy
-    # analog; BENCH_LOCAL.json index_* metrics record both backends) is the
-    # fast path for equality queries; falls back when unbuilt
+    # "python" (vectorized posting-bitmap index, the default) | "native"
+    # (the C++ posting-list core, reference's tantivy analog; falls back
+    # when unbuilt) | "set" (the original set-arithmetic index, retained as
+    # the property-test oracle / escape hatch)
     index_backend: str = "python"
+    # opt-in HBM tier for hot posting bitmaps (memstore/index_device.py):
+    # all-equality selectors whose matchers are staged resolve as one tiny
+    # jit intersection program. Default OFF — with it off the index never
+    # touches a device and the warm fused query stays ONE kernel dispatch.
+    index_device_postings: bool = False
+    index_device_min_hits: int = 16
+    index_device_max_bytes: int = 64 << 20
     # staging-cache byte budget per shard (HBM/working-set guard; reference
     # analog: BlockManager reclaim under memory pressure)
     stage_cache_bytes: int = 2 << 30
@@ -210,15 +218,51 @@ class TimeSeriesShard:
         self._approx_new_bytes = 0
 
     def _make_index(self) -> PartKeyIndex:
+        idx = None
         if self.config.index_backend == "native":
             try:
                 from .index_native import NativePartKeyIndex, native_index_available
 
                 if native_index_available():
-                    return NativePartKeyIndex()
+                    idx = NativePartKeyIndex()
             except Exception:
                 pass
-        return PartKeyIndex()
+        elif self.config.index_backend == "set":
+            from .index import SetBasedPartKeyIndex
+
+            return SetBasedPartKeyIndex()
+        if idx is None:
+            idx = PartKeyIndex()
+        if self.config.index_device_postings:
+            if type(idx) is not PartKeyIndex:
+                # the native backend answers all-equality selectors in C++
+                # and never reaches the bitmap tier hook — attaching a tier
+                # there would be a silent no-op holding a ledger account
+                import logging
+
+                logging.getLogger("filodb_tpu.memstore").warning(
+                    "index_device_postings ignored: backend %r resolves "
+                    "equality selectors outside the bitmap path (use "
+                    "index_backend=\"python\")", self.config.index_backend,
+                )
+            else:
+                from .index_device import DevicePostingsTier
+
+                idx.device_tier = DevicePostingsTier(
+                    idx,
+                    min_hits=self.config.index_device_min_hits,
+                    max_bytes=self.config.index_device_max_bytes,
+                    name=f"{self.dataset}/shard-{self.shard_num}/index",
+                )
+        return idx
+
+    def index_stats(self) -> dict:
+        """Introspection for /debug/index + the filodb_index_* gauges (the
+        set-based escape-hatch backend reports a minimal shape)."""
+        if hasattr(self.index, "postings_stats"):
+            return self.index.postings_stats()
+        return {"num_part_keys": len(self.index), "labels": {},
+                "postings_bytes": 0, "dictionary_size": 0, "device": None}
 
     # -- ingest ------------------------------------------------------------
 

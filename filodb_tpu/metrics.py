@@ -79,6 +79,15 @@ class Histogram:
                 self.exemplars[i] = (dict(exemplar), float(v), time.time())
 
 
+class MicroHistogram(Histogram):
+    """Histogram with sub-millisecond bounds for host paths that complete in
+    microseconds (index lookups): the standard bounds start at 1ms and would
+    collapse the whole distribution into the first bucket."""
+
+    BOUNDS = (5e-6, 1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4,
+              1e-3, 5e-3, 2.5e-2, 0.1, 0.5)
+
+
 def escape_label_value(v) -> str:
     """Prometheus text-format label escaping: backslash, double-quote and
     newline must be escaped or the exposition line is unparseable."""
@@ -125,7 +134,7 @@ HELP_TEXTS: dict[str, str] = {
     "filodb_tenant_query_seconds": "Wall-clock query seconds per tenant.",
     "filodb_tenant_kernel_seconds": "Device kernel-dispatch seconds per tenant.",
     "filodb_tenant_bytes_staged": "Bytes staged to device per tenant.",
-    "filodb_device_bytes": "Live device bytes per ledger kind (staged_block|superblock|compile_cache).",
+    "filodb_device_bytes": "Live device bytes per ledger kind (staged_block|superblock|compile_cache|standing_state|index_postings).",
     "filodb_device_alloc": "Ledger debits (entries pinned) per kind.",
     "filodb_device_alloc_bytes": "Bytes debited to the device ledger per kind.",
     "filodb_device_free": "Ledger credits per kind and reason (evict|invalidate|replace|drop).",
@@ -152,6 +161,10 @@ HELP_TEXTS: dict[str, str] = {
     "filodb_tenant_query_latency_seconds": "End-to-end query latency per tenant (the latency-SLO feed).",
     "filodb_http_responses": "HTTP API responses by status code and class (2xx|4xx|shed|5xx).",
     "filodb_querylog_entries": "Query-log ring depth (exemplar-level cost records retained).",
+    "filodb_index_lookup_seconds": "Part-key index lookup latency by matcher cost class (eq|in|prefix|regex|neg).",
+    "filodb_index_postings_bytes": "Host posting-bitmap footprint of the part-key index, per shard.",
+    "filodb_index_device_staged_bytes": "Posting bitmaps staged to device (HBM) by the index's opt-in hot tier, per shard.",
+    "filodb_index_dictionary_size": "Distinct (label, value) dictionary entries in the part-key index, per shard.",
 }
 
 
@@ -192,6 +205,11 @@ class Registry:
 
     def histogram(self, name: str, **labels) -> Histogram:
         return self._get(Histogram, name, labels)
+
+    def micro_histogram(self, name: str, **labels) -> MicroHistogram:
+        """Histogram with µs-scale buckets (one family must use ONE bucket
+        layout consistently — pick this or :meth:`histogram`, never both)."""
+        return self._get(MicroHistogram, name, labels)
 
     def remove(self, name: str, **labels) -> bool:
         """Drop one series (a vanished tenant's gauges must not be exposed

@@ -88,6 +88,18 @@ def test_two_process_psum():
     for rc, out in outs:
         if rc != 0 and ("UNAVAILABLE" in out or "Failed to connect" in out or "barrier" in out.lower()):
             pytest.skip(f"sandbox blocks the coordination service: {out[-300:]}")
+        if rc != 0 and "Multiprocess computations aren't implemented" in out:
+            # capability probe, not an env failure: this jaxlib's CPU
+            # backend has no multiprocess collectives (cross-process psum
+            # needs a real TPU/GPU backend or a newer CPU collectives
+            # build) — the workers DID join the coordination service and
+            # build the global mesh before the psum dispatch refused
+            pytest.skip(
+                "jax CPU backend lacks multiprocess collectives "
+                "(XlaRuntimeError: 'Multiprocess computations aren't "
+                "implemented on the CPU backend') — needs TPU/GPU or a "
+                "CPU build with cross-process collectives"
+            )
         assert rc == 0, out[-2000:]
         assert "MULTIHOST_OK" in out
 

@@ -268,10 +268,13 @@ class TestInfluxNativeParity:
 
         rng = random.Random(1000 + seed)
         lines = []
+        # escaped-char candidates hoisted out of the f-string: a backslash
+        # inside an f-string expression is a SyntaxError before py3.12
+        tag_vals = ["v1", "x\\,y", "p\\=q"]
         for _ in range(rng.randint(30, 120)):
             meas = rng.choice(["cpu", "mem", "disk\\ io"])
             tags = "".join(
-                f",{rng.choice('abcd')}={rng.choice(['v1', 'x\\,y', 'p\\=q'])}"
+                f",{rng.choice('abcd')}={rng.choice(tag_vals)}"
                 for _ in range(rng.randint(0, 2))
             )
             fields = ",".join(

@@ -299,10 +299,17 @@ class TestHttpEdge:
         _get_json(f"{base}/api/v1/query_range?query="
                   + urllib.parse.quote(Q)
                   + f"&start={START_S}&end={END_S}&step=60")
-        entries = _get_json(f"{base}/debug/querylog?limit=1")["data"]
-        assert len(entries) == 1
-        rec = entries[0]
-        # the edge folded its serving phases in
+        # the edge folds its serving phases into the ring entry AFTER the
+        # response body goes out (render time is measured around the send),
+        # so a fast follow-up read can land in that window — retry briefly
+        rec = None
+        for _ in range(50):
+            entries = _get_json(f"{base}/debug/querylog?limit=1")["data"]
+            assert len(entries) == 1
+            rec = entries[0]
+            if "transfer" in rec["phases_ms"]:
+                break
+            time.sleep(0.02)
         assert "transfer" in rec["phases_ms"] and "render" in rec["phases_ms"]
         assert rec["code"] == 200
         assert rec["result"]["bytes"] > 0
