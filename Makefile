@@ -2,7 +2,7 @@
 
 NATIVE_DIR := filodb_tpu/native
 
-.PHONY: all native test test-chaos test-index test-ingest-chaos test-jitter test-multichip test-observability test-scheduler test-standing bench bench-smoke microbench serve clean tpu-watch tpu-watch-bg
+.PHONY: all native test test-chaos test-index test-ingest-chaos test-jitter test-multichip test-observability test-scheduler test-standing attest bench bench-smoke microbench serve clean tpu-watch tpu-watch-bg
 
 all: native
 
@@ -102,9 +102,20 @@ bench: native
 	python bench.py
 
 # perf regression gate (doc/perf.md): 2k series, 3 runs, CPU backend;
-# fails if p50 regresses >25% vs benchmarks/bench_smoke_floor.json
+# fails if p50 regresses >25% vs benchmarks/bench_smoke_floor.json —
+# plus the attestation machinery smoke (one tiny workload through the
+# bench -> kernel-snapshot -> verdict -> digest pipeline)
 bench-smoke: native
 	python tools/bench_smoke.py
+	python tools/attest.py --smoke
+
+# one-command hardware attestation (doc/operations.md "Attestation"):
+# bench-smoke floors + MULTICHIP dryrun + per-workload kernel-observatory
+# snapshots, bundled into one signed-off ATTEST_<backend>.json proving
+# what compiled, dispatched and fell back. Runs on the CPU backend today
+# and unchanged on hardware (workers label their backend honestly).
+attest: native
+	python tools/attest.py
 
 microbench: native
 	python -m benchmarks.run

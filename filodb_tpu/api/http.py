@@ -329,6 +329,8 @@ class PromApiHandler(BaseHTTPRequestHandler):
                 return self._resources()
             if path == "/debug/scheduler":
                 return self._scheduler()
+            if path == "/debug/kernels":
+                return self._kernels()
             if path == "/debug/superblocks":
                 return self._superblocks()
             if path == "/debug/index":
@@ -631,6 +633,21 @@ class PromApiHandler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
+
+    def _kernels(self):
+        """Kernel & compile observatory (doc/observability.md "Kernel &
+        compile observatory"): the per-executable table — compiles,
+        dispatches, device p50/p99, executable bytes, compile-cache
+        provenance — plus recompile-storm annotations (each naming the
+        unstable key dimension) and registered-wrapper cache sizes.
+        ``?limit=`` caps the executable table."""
+        from ..obs.kernels import KERNELS
+
+        p = self._params()
+        limit = self._q(p, "limit")
+        return self._send(
+            200, J.success(KERNELS.snapshot(int(limit) if limit else None))
+        )
 
     def _resources(self):
         """Resource-ledger introspection: per-kind device bytes, the

@@ -154,6 +154,22 @@ DEFAULTS: dict = {
         "align_ms": 300_000,
         "tick_s": 0.5,
     },
+    # kernel & compile observatory (obs/kernels.py, doc/observability.md
+    # "Kernel & compile observatory"): every jitted kernel dispatch is
+    # accounted per executable (compiles, dispatches, device p50/p99,
+    # compile-cache provenance) at /debug/kernels — capture is always on,
+    # these knobs size the table and the recompile-storm detector. A family
+    # compiling more than storm_threshold times inside storm_window_s
+    # counts filodb_xla_recompile_storms_total and annotates the unstable
+    # key dimension. device_timing adds a block_until_ready around each
+    # warm dispatch for exact device cost (bench/attest runs turn it on;
+    # serving keeps it off — the sync serializes the dispatch pipeline).
+    "kernel_obs": {
+        "max_executables": 1024,
+        "storm_threshold": 5,
+        "storm_window_s": 60.0,
+        "device_timing": False,
+    },
     # downsampling (reference downsample resolutions)
     "downsample": {"enabled": False, "periods_m": [5, 60]},
     # cardinality quotas: list of {"prefix": ["ws","ns"], "quota": N}

@@ -156,12 +156,20 @@ HELP_TEXTS: dict[str, str] = {
     "filodb_tpu_probes_ok": "tpu-watch probes that found a healthy device.",
     "filodb_tpu_bench_attested": "tpu-watch attested benchmark measurements.",
     "filodb_query_phase_seconds": "Per-phase query latency decomposition (parse_plan|admission|stage|dispatch|transfer|render|other).",
-    "filodb_query_path": "Queries by execution path (fused|fallback|tree) per dataset.",
+    "filodb_query_path": "Queries by execution path (fused|fallback|tree|standing:delta|standing:full) per dataset.",
     "filodb_tenant_phase_seconds": "Per-phase query wall seconds attributed to the tenant (ws/ns).",
     "filodb_tenant_query_latency_seconds": "End-to-end query latency per tenant (the latency-SLO feed).",
     "filodb_http_responses": "HTTP API responses by status code and class (2xx|4xx|shed|5xx).",
     "filodb_querylog_entries": "Query-log ring depth (exemplar-level cost records retained).",
     "filodb_index_lookup_seconds": "Part-key index lookup latency by matcher cost class (eq|in|prefix|regex|neg).",
+    "filodb_xla_compiles": "XLA compile events per kernel family (a dispatch that grew the jit cache).",
+    "filodb_xla_compile_seconds": "Wall seconds spent in dispatches that compiled (trace+compile inclusive), per kernel family.",
+    "filodb_xla_recompile_storms": "Recompile storms detected per kernel family (same family re-lowering past the threshold inside the window; /debug/kernels names the unstable dimension).",
+    "filodb_xla_executables": "Live executables in the kernel observatory's registry.",
+    "filodb_kernel_exec_dispatches": "Kernel dispatches accounted by the executable registry, per family.",
+    "filodb_kernel_exec_device_seconds": "Per-dispatch device cost of warm (non-compiling) dispatches, per kernel family (host dispatch wall; exact block_until_ready deltas with kernel_obs.device_timing).",
+    "filodb_compile_cache_hits": "Compile-cache hits by tier (in_process = warm jit cache, persistent = compile deserialized from the on-disk XLA cache).",
+    "filodb_compile_cache_misses": "Compile-cache misses by tier (in_process = a compile happened, persistent = a fresh trace wrote a new on-disk entry).",
     "filodb_index_postings_bytes": "Host posting-bitmap footprint of the part-key index, per shard.",
     "filodb_index_device_staged_bytes": "Posting bitmaps staged to device (HBM) by the index's opt-in hot tier, per shard.",
     "filodb_index_dictionary_size": "Distinct (label, value) dictionary entries in the part-key index, per shard.",
@@ -230,6 +238,19 @@ class Registry:
             for k in gone:
                 del self._metrics[k]
         return len(gone)
+
+    def counter_samples(self, *families: str) -> dict[str, float]:
+        """Rendered ``family{labels} -> value`` for the named counter
+        families — the public snapshot surface for consumers outside this
+        module (the bench kernel-snapshot dump, attestation) so they never
+        couple to the private storage layout."""
+        out: dict[str, float] = {}
+        with self._lock:
+            for (name, labels), m in self._metrics.items():
+                if name in families and isinstance(m, Counter_):
+                    lbl = ",".join(f"{k}={v}" for k, v in labels)
+                    out[f"{name}{{{lbl}}}"] = m.value
+        return out
 
     def describe(self, name: str, help_text: str) -> None:
         """Register/override help text for a metric family (exposed as the
@@ -711,14 +732,22 @@ def current_stats():
 
 
 def record_kernel_dispatch(kernel: str, seconds: float,
-                           compiled: bool | None = None) -> None:
+                           compiled: bool | None = None,
+                           key: dict | None = None, result=None) -> None:
     """Latency histogram around an ops/ kernel entry point, plus JIT
     compile-cache hit/miss accounting when the caller can observe its jit
     cache (a grown cache across the call means this dispatch compiled).
     Also attributes the dispatch seconds to the active query's QueryStats
     (kernel_ns) — the per-query/per-tenant device accounting feed. Pure
     host-side bookkeeping: no device sync is added around the (async)
-    dispatch."""
+    dispatch.
+
+    ``key`` (executable-key parts: variant/epilogue/shapes/mesh/batch —
+    obs.kernels.KEY_DIMS) and ``result`` (the dispatch's device output,
+    for the opt-in exact device timing) additionally feed the kernel &
+    compile observatory's per-executable registry; the family dimension is
+    ``kernel`` itself, so the registry and this histogram's ``kernel=``
+    label stay the same vocabulary."""
     REGISTRY.histogram("filodb_kernel_dispatch_seconds", kernel=kernel).observe(seconds)
     st = current_stats()
     if st is not None:
@@ -728,6 +757,12 @@ def record_kernel_dispatch(kernel: str, seconds: float,
             "filodb_jit_cache", kernel=kernel,
             outcome="miss" if compiled else "hit",
         ).inc()
+    # kernel & compile observatory (obs/kernels.py): per-executable
+    # compile/dispatch/device-cost attribution + recompile-storm detection
+    from .obs.kernels import KERNELS
+
+    KERNELS.observe_dispatch(kernel, seconds, compiled=compiled, parts=key,
+                             result=result)
 
 
 # -- sampling profiler ------------------------------------------------------

@@ -250,6 +250,12 @@ class QueryLogRing:
             "fallback_reason": info.get("fallback"),
             "grid_class": info.get("grid_class"),
             "batched": info.get("batched"),
+            # kernel-observatory join (obs/kernels.py): the executable that
+            # served the fused dispatch and whether that launch compiled —
+            # the cost model joins phase data to kernel identity through
+            # this key (/debug/kernels indexes by it)
+            "executable_key": info.get("executable_key"),
+            "compile_miss": info.get("compile_miss"),
             "status": status,
             "error": error,
             "duration_ms": round(float(elapsed_s) * 1e3, 3),

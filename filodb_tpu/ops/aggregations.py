@@ -34,9 +34,13 @@ def segment_aggregate(op: str, values, group_ids, num_groups: int):
     t0 = _time.perf_counter()
     before = _segment_aggregate_jit._cache_size()
     out = _segment_aggregate_jit(op, values, group_ids, num_groups)
+    s_, j_ = np.shape(values)
     record_kernel_dispatch(
         f"segment_{op}", _time.perf_counter() - t0,
         compiled=_segment_aggregate_jit._cache_size() > before,
+        key={"variant": "general", "epilogue": f"agg:{op}",
+             "shapes": f"S{s_}xJ{j_}xG{num_groups}"},
+        result=out,
     )
     return out
 
@@ -574,6 +578,28 @@ def _fused_sharded_masked_jit(mesh, func, epilogue, mba, mwm, window_ms,
     )(mba, gids)
 
 
+def _exec_key_parts(variant: str, epilogue, block, j_pad: int,
+                    num_groups: int, mesh=None, batch: str | None = None):
+    """Executable-key parts for the kernel observatory (obs/kernels.py
+    KEY_DIMS): the static signature that selects the XLA executable —
+    kernel variant, epilogue statics, PADDED device shapes, mesh width and
+    batched-lane composition. Metadata reads only (shape tuples), shared
+    by every fused dispatch site so the key vocabulary has ONE builder."""
+    shape = tuple(np.shape(block.vals))
+    dims = f"S{shape[0]}xT{shape[1] if len(shape) > 1 else 1}"
+    if len(shape) > 2:
+        dims += f"xB{shape[2]}"
+    ep = (":".join(str(x) for x in epilogue) if isinstance(epilogue, tuple)
+          else str(epilogue))
+    return {
+        "variant": variant,
+        "epilogue": ep or None,
+        "shapes": f"{dims}xJ{j_pad}xG{num_groups}",
+        "mesh": mesh.devices.size if mesh is not None else None,
+        "batch": batch,
+    }
+
+
 def _fused_dispatch(func: str, epilogue: tuple, block, gids_padded,
                     num_groups: int, params, qv, is_counter: bool,
                     is_delta: bool, name: str, mesh=None):
@@ -710,7 +736,10 @@ def _fused_dispatch(func: str, epilogue: tuple, block, gids_padded,
     before = fn._cache_size()
     out = fn(*args)
     record_kernel_dispatch(
-        name, _time.perf_counter() - t0, compiled=fn._cache_size() > before
+        name, _time.perf_counter() - t0, compiled=fn._cache_size() > before,
+        key=_exec_key_parts(variant, epilogue, block, j_pad, num_groups,
+                            mesh),
+        result=out,
     )
     return out
 
@@ -940,7 +969,16 @@ def fused_hist_range_aggregate(func: str, block, gids_padded,
             q is not None,
         )
         compiled = _fused_hist_jit._cache_size() > before
-    record_kernel_dispatch(name, _time.perf_counter() - t0, compiled=compiled)
+    hist_variant = ("hist_shared" if block.regular_ts is not None
+                    else "hist_jitter" if jwm is not None else "hist_general")
+    record_kernel_dispatch(
+        name, _time.perf_counter() - t0, compiled=compiled,
+        key=_exec_key_parts(
+            hist_variant, ("hist", "quantile" if q is not None else "sum"),
+            block, j_pad, num_groups, mesh,
+        ),
+        result=out,
+    )
     return out
 
 
@@ -1415,7 +1453,12 @@ def fused_batched_scalar(func: str, epilogue: tuple, block, lanes,
     before = fn._cache_size()
     out = fn(*args)
     record_kernel_dispatch(
-        name, _time.perf_counter() - t0, compiled=fn._cache_size() > before
+        name, _time.perf_counter() - t0, compiled=fn._cache_size() > before,
+        key=_exec_key_parts(
+            variant, epilogue, block, j_pad, num_groups, mesh,
+            batch=f"Q{len(padded)}xU{len(_ukeys)}",
+        ),
+        result=out,
     )
     return out
 
@@ -1472,7 +1515,13 @@ def fused_batched_hist(func: str, block, lanes, num_groups: int, j_pad: int,
     before = fn._cache_size()
     out = fn(*args)
     record_kernel_dispatch(
-        name, _time.perf_counter() - t0, compiled=fn._cache_size() > before
+        name, _time.perf_counter() - t0, compiled=fn._cache_size() > before,
+        key=_exec_key_parts(
+            "hist_shared" if shared else "hist_general",
+            ("hist", "quantile" if quantile else "sum"), block, j_pad,
+            num_groups, mesh, batch=f"Q{len(padded)}xU{len(_ukeys)}",
+        ),
+        result=out,
     )
     return out
 
@@ -1701,3 +1750,37 @@ def group_ids_for(series_labels: list[dict], by: list[str] | None, without: list
             group_labels.append(dict(k))
         gids[i] = uniq[k]
     return gids, group_labels
+
+
+# -- kernel observatory registration (obs/kernels.py) -----------------------
+# every jit wrapper in this module registers with the executable registry so
+# the observatory can report live in-process cache sizes per wrapper and
+# tools/check_metrics.py can lint that no jit entry point dispatches outside
+# the observatory (a new kernel added without registration fails the lint)
+def _register_kernel_observatory() -> None:
+    from ..obs.kernels import KERNELS
+
+    KERNELS.register_jits(
+        "ops.aggregations",
+        _segment_aggregate_jit=_segment_aggregate_jit,
+        _fused_general_jit=_fused_general_jit,
+        _fused_mxu_jit=_fused_mxu_jit,
+        _fused_jitter_jit=_fused_jitter_jit,
+        _fused_masked_jit=_fused_masked_jit,
+        _fused_pallas_jit=_fused_pallas_jit,
+        _fused_sharded_general_jit=_fused_sharded_general_jit,
+        _fused_sharded_mxu_jit=_fused_sharded_mxu_jit,
+        _fused_sharded_jitter_jit=_fused_sharded_jitter_jit,
+        _fused_sharded_masked_jit=_fused_sharded_masked_jit,
+        _batched_general_jit=_batched_general_jit,
+        _batched_mxu_jit=_batched_mxu_jit,
+        _batched_jitter_jit=_batched_jitter_jit,
+        _batched_masked_jit=_batched_masked_jit,
+        _batched_sharded_general_jit=_batched_sharded_general_jit,
+        _batched_sharded_mxu_jit=_batched_sharded_mxu_jit,
+        topk_mask=topk_mask,
+        segment_quantile=segment_quantile,
+    )
+
+
+_register_kernel_observatory()

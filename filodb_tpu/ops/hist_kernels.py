@@ -611,3 +611,29 @@ def run_hist_range_function(
         j_pad,
         is_delta=is_delta,
     )
+
+
+# kernel-observatory registration (obs/kernels.py; linted by
+# tools/check_metrics.py — every jit wrapper here must register)
+def _register_kernel_observatory() -> None:
+    from ..obs.kernels import KERNELS
+
+    KERNELS.register_jits(
+        "ops.hist_kernels",
+        hist_range_kernel=hist_range_kernel,
+        histogram_quantile=histogram_quantile,
+        histogram_fraction=histogram_fraction,
+        _fused_hist_jit=_fused_hist_jit,
+        _fused_hist_shared_jit=_fused_hist_shared_jit,
+        _fused_hist_jitter_jit=_fused_hist_jitter_jit,
+        _fused_hist_jitter_sharded_jit=_fused_hist_jitter_sharded_jit,
+        _fused_hist_shared_sharded_jit=_fused_hist_shared_sharded_jit,
+        _fused_hist_sharded_jit=_fused_hist_sharded_jit,
+        _batched_hist_jit=_batched_hist_jit,
+        _batched_hist_shared_jit=_batched_hist_shared_jit,
+        _batched_hist_shared_sharded_jit=_batched_hist_shared_sharded_jit,
+        _batched_hist_sharded_jit=_batched_hist_sharded_jit,
+    )
+
+
+_register_kernel_observatory()

@@ -488,11 +488,15 @@ def run_range_function(
 
     t0 = _time.perf_counter()
     before = _jit_cache_size()
-    out = _dispatch_range_function(
+    out, variant = _dispatch_range_function(
         func, block, params, is_counter=is_counter, is_delta=is_delta, args=args
     )
+    s_, t_ = np.shape(block.ts)
     record_kernel_dispatch(
-        func, _time.perf_counter() - t0, compiled=_jit_cache_size() > before
+        func, _time.perf_counter() - t0, compiled=_jit_cache_size() > before,
+        key={"variant": variant,
+             "shapes": f"S{s_}xT{t_}xJ{pad_steps(params.num_steps)}"},
+        result=out,
     )
     return out
 
@@ -505,10 +509,14 @@ def _dispatch_range_function(
     is_delta: bool = False,
     args: tuple = (),
 ):
+    """Returns ``(grid, variant)``: the variant is the ladder rung that
+    actually served the dispatch — the observatory's executable-key
+    ``variant`` dimension, reported by the rung that ran rather than
+    re-derived (the jitter/masked fast paths can decline at runtime)."""
     from .mxu_kernels import MXU_FUNCS, run_mxu_range_function
 
     if func == "timestamp":
-        return _host_timestamp(block, params)
+        return _host_timestamp(block, params), "host"
     if (
         block.regular_ts is not None
         and func in MXU_FUNCS
@@ -517,7 +525,7 @@ def _dispatch_range_function(
         # shared-scrape-grid fast path: window reduction as MXU matmuls
         return run_mxu_range_function(
             func, block, params, is_counter=is_counter, is_delta=is_delta, args=args
-        )
+        ), "mxu"
     if (
         block.nominal_ts is not None
         and not (is_delta and func in ("irate", "idelta"))
@@ -532,7 +540,7 @@ def _dispatch_range_function(
                 func, block, params, is_counter=is_counter, is_delta=is_delta
             )
             if res is not None:
-                return res
+                return res, "jitter"
     if (
         block.mgrid is not None
         and not (is_delta and func in ("irate", "idelta"))
@@ -547,7 +555,7 @@ def _dispatch_range_function(
                 func, block, params, is_counter=is_counter, is_delta=is_delta
             )
             if res is not None:
-                return res
+                return res, "masked"
     from .pallas_kernels import (
         PALLAS_FUNCS,
         pallas_enabled,
@@ -563,7 +571,7 @@ def _dispatch_range_function(
         return run_pallas_range_function(
             func, block, params, is_counter=is_counter, is_delta=is_delta,
             interpret=_jax.devices()[0].platform in ("cpu",),
-        )
+        ), "pallas"
     j_pad = pad_steps(params.num_steps)
     start_off = np.int32(params.start_ms - block.base_ms)
     if func in SORTED_FUNCS:
@@ -578,7 +586,7 @@ def _dispatch_range_function(
             j_pad,
             q=np.float32(args[0]) if args else np.float32(0.5),
             arg1=np.float32(args[1]) if len(args) > 1 else np.float32(0.0),
-        )
+        ), "sorted"
     a0 = np.float32(args[0]) if len(args) > 0 else np.float32(0.0)
     a1 = np.float32(args[1]) if len(args) > 1 else np.float32(0.0)
     return range_kernel(
@@ -596,4 +604,19 @@ def _dispatch_range_function(
         is_delta=is_delta,
         arg0=a0,
         arg1=a1,
+    ), "general"
+
+
+# kernel-observatory registration (obs/kernels.py; linted by
+# tools/check_metrics.py — every jit wrapper here must register)
+def _register_kernel_observatory() -> None:
+    from ..obs.kernels import KERNELS
+
+    KERNELS.register_jits(
+        "ops.kernels",
+        range_kernel=range_kernel,
+        sorted_window_kernel=sorted_window_kernel,
     )
+
+
+_register_kernel_observatory()

@@ -865,3 +865,20 @@ def run_jitter_range_function(func, block: StagedBlock, params,
         is_delta=is_delta,
         fetch=fetch,
     )
+
+
+# kernel-observatory registration (obs/kernels.py; linted by
+# tools/check_metrics.py — every jit wrapper here must register)
+def _register_kernel_observatory() -> None:
+    from ..obs.kernels import KERNELS
+
+    KERNELS.register_jits(
+        "ops.mxu_jitter",
+        jitter_range_kernel=jitter_range_kernel,
+        jitter_minmax=jitter_minmax,
+        jitter_masked_kernel=jitter_masked_kernel,
+        jitter_masked_minmax=jitter_masked_minmax,
+    )
+
+
+_register_kernel_observatory()

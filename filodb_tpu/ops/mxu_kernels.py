@@ -468,3 +468,20 @@ def run_mxu_range_function(func, block: StagedBlock, params, is_counter=False,
         is_delta=is_delta,
         fetch=fetch_strategy(),
     )
+
+
+# kernel-observatory registration (obs/kernels.py; linted by
+# tools/check_metrics.py — every jit wrapper here must register)
+def _register_kernel_observatory() -> None:
+    from ..obs.kernels import KERNELS
+
+    KERNELS.register_jits(
+        "ops.mxu_kernels",
+        mxu_range_kernel=mxu_range_kernel,
+        mxu_pair_count=mxu_pair_count,
+        mxu_minmax=mxu_minmax,
+        mxu_regression=mxu_regression,
+    )
+
+
+_register_kernel_observatory()

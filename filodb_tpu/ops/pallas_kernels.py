@@ -211,3 +211,18 @@ def run_pallas_range_function(func: str, block: StagedBlock, params,
     )
     return finish(func, agg, start_off, np.int32(params.step_ms), np.int32(params.window_ms),
                   is_counter=is_counter, is_delta=is_delta)
+
+
+# kernel-observatory registration (obs/kernels.py; linted by
+# tools/check_metrics.py — every jit wrapper here must register)
+def _register_kernel_observatory() -> None:
+    from ..obs.kernels import KERNELS
+
+    KERNELS.register_jits(
+        "ops.pallas_kernels",
+        window_aggregates=window_aggregates,
+        finish=finish,
+    )
+
+
+_register_kernel_observatory()

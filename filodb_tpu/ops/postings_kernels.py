@@ -28,23 +28,38 @@ def host_words_to_device(words: np.ndarray):
 
 def intersect_on_device(dev_words: list) -> np.ndarray:
     """AND the staged device bitmaps in ONE jit dispatch; returns the host
-    uint64 result words."""
+    uint64 result words. Instrumented like every other kernel entry point
+    (per-dispatch latency + JIT hit/miss + the executable registry)."""
+    import time as _time
+
     import jax.numpy as jnp
 
+    from ..metrics import record_kernel_dispatch
+
     stacked = jnp.stack(dev_words)
-    out = np.asarray(_intersect_jit(stacked))
+    fn = _intersect_jit()
+    t0 = _time.perf_counter()
+    before = fn._cache_size()
+    out_dev = fn(stacked)
+    m_, w_ = stacked.shape
+    record_kernel_dispatch(
+        "postings_intersect", _time.perf_counter() - t0,
+        compiled=fn._cache_size() > before,
+        key={"variant": "general", "shapes": f"M{m_}xW{w_}"},
+        result=out_dev,
+    )
+    out = np.asarray(out_dev)
     return np.ascontiguousarray(out).view(np.uint64)
 
 
 _jit_cache = {}
 
 
-def _intersect_jit(stacked):
+def _intersect_jit():
     import jax
 
-    key = "intersect_words"
-    fn = _jit_cache.get(key)
-    if fn is None:
+    intersect_words = _jit_cache.get("intersect_words")
+    if intersect_words is None:
         def _and_reduce(ws):
             out = ws[0]
             # static leading dim: unrolled at trace time, ONE fused kernel
@@ -52,5 +67,9 @@ def _intersect_jit(stacked):
                 out = out & ws[i]
             return out
 
-        fn = _jit_cache[key] = jax.jit(_and_reduce)
-    return fn(stacked)
+        intersect_words = _jit_cache["intersect_words"] = jax.jit(_and_reduce)
+        from ..obs.kernels import KERNELS
+
+        KERNELS.register_jits("ops.postings_kernels",
+                              intersect_words=intersect_words)
+    return intersect_words

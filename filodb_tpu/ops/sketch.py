@@ -125,3 +125,18 @@ def distributed_sketch_quantile(
         out_specs=P(),
         check=False,
     )(ts, vals, lens, baseline, raw, gids)
+
+
+# kernel-observatory registration (obs/kernels.py; linted by
+# tools/check_metrics.py — every jit wrapper here must register)
+def _register_kernel_observatory() -> None:
+    from ..obs.kernels import KERNELS
+
+    KERNELS.register_jits(
+        "ops.sketch",
+        build_sketch=build_sketch,
+        distributed_sketch_quantile=distributed_sketch_quantile,
+    )
+
+
+_register_kernel_observatory()

@@ -1791,12 +1791,28 @@ class FusedAggregateExec(ExecPlan):
                     # never reached the executing leader — the shared wait
                     # is indivisible, attribute it all to dispatch
                     rec.add("dispatch", total)
+            if obs is not None and request.executable_key is not None:
+                # kernel-observatory join key (obs/kernels.py): the leader
+                # stamped the executable that served this lane (a
+                # coalesced duplicate lane's own request stays None —
+                # mirroring exec_seconds)
+                obs["executable_key"] = request.executable_key
+                obs["compile_miss"] = request.compile_miss
             return out
         if obs is not None:
             obs.setdefault("batched", False)
         out = request.run_single()
         if rec is not None:
             rec.add("dispatch", _time.perf_counter() - t0)
+        if obs is not None:
+            # solo path: the launch ran on THIS thread — read the
+            # executable identity straight from the registry's capture
+            from ...obs.kernels import KERNELS
+
+            info = KERNELS.last_dispatch()
+            if info:
+                obs["executable_key"] = info.get("executable_key")
+                obs["compile_miss"] = info.get("compile_miss")
         return out
 
     def _observe_key(self, ctx: QueryContext, sched) -> None:

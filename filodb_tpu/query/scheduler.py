@@ -465,6 +465,13 @@ class FusedRequest:
     # SHARED launch duration (the launch is indivisible); a coalesced
     # duplicate lane's own request object stays None.
     exec_seconds: float | None = None
+    # stamped alongside exec_seconds from the leader thread's
+    # obs.kernels.last_dispatch(): the executable that actually served this
+    # lane (batched lanes share ONE executable by construction) and
+    # whether that launch compiled — the engine folds both into the
+    # query's cost record (executable_key / compile_miss)
+    executable_key: str | None = None
+    compile_miss: bool | None = None
 
     def family(self) -> str:
         return self.kind
@@ -745,6 +752,21 @@ class DispatchScheduler:
                 return
             group.closed.wait(min(deadline - now, gap - idle))
 
+    @staticmethod
+    def _stamp_executable(reqs) -> None:
+        """Copy the leader thread's last-dispatch identity (the executable
+        registry's thread-local capture) onto the lane request(s) BEFORE
+        their futures resolve — the waiting engines' threads never saw the
+        launch, so the key must ride the request like exec_seconds."""
+        from ..obs.kernels import KERNELS
+
+        info = KERNELS.last_dispatch()
+        if not info:
+            return
+        for req in reqs:
+            req.executable_key = info.get("executable_key")
+            req.compile_miss = info.get("compile_miss")
+
     def _execute(self, fam: str, lanes: list) -> None:
         """Leader-side group execution: one batched launch for Q>1 lanes,
         the plain unbatched dispatch for a solo group, per-lane unbatched
@@ -759,6 +781,7 @@ class DispatchScheduler:
             try:
                 out = req.run_single()
                 req.exec_seconds = time.perf_counter() - t0
+                self._stamp_executable((req,))
                 fut.set_result(out)
             except Exception as e:  # noqa: BLE001 — delivered to the caller
                 req.exec_seconds = time.perf_counter() - t0
@@ -782,6 +805,7 @@ class DispatchScheduler:
                     try:
                         out = req.run_single()
                         req.exec_seconds = time.perf_counter() - t1
+                        self._stamp_executable((req,))
                         fut.set_result(out)
                     except Exception as e:  # noqa: BLE001
                         req.exec_seconds = time.perf_counter() - t1
@@ -793,6 +817,7 @@ class DispatchScheduler:
                 batch_s = time.perf_counter() - t0
                 for req, _ in lanes:
                     req.exec_seconds = batch_s
+                self._stamp_executable([req for req, _ in lanes])
                 for (_, fut), res in zip(lanes, results):
                     fut.set_result(res)
         with self._lock:

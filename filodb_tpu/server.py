@@ -179,6 +179,19 @@ class FiloServer:
 
         QUERY_LOG.configure(int(qcfg.get("querylog_max", 512) or 512))
         register_querylog_collector()
+        # kernel & compile observatory (obs/kernels.py): size the
+        # per-executable registry + recompile-storm detector and publish
+        # the live executable count at scrape time (/debug/kernels)
+        from .obs.kernels import KERNELS, register_kernel_obs_collector
+
+        kcfg = {**DEFAULTS["kernel_obs"], **(cfg.get("kernel_obs") or {})}
+        KERNELS.configure(
+            max_entries=int(kcfg["max_executables"]),
+            storm_threshold=int(kcfg["storm_threshold"]),
+            storm_window_s=float(kcfg["storm_window_s"]),
+            device_timing=bool(kcfg["device_timing"]),
+        )
+        register_kernel_obs_collector()
         # query dispatch scheduler (query/scheduler.py): ONE process-wide
         # micro-batcher + admission controller shared by every engine
         # (scattering, local and _system) so concurrent queries coalesce

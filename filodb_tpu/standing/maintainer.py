@@ -210,8 +210,14 @@ class StandingEngine:
         """Run one (suffix or full) dispatch on the engine's context —
         admission is bypassed (standing work is the server's own standing
         obligation), attribution is not (caller meters the tenant)."""
+        from ..obs.querylog import PhaseRecorder
+
         ctx = self.engine.context()
         ctx.standing_refresh = True  # keep maintainer dispatches out of the ring
+        # phase capture for the refresh's querylog record: the maintainer
+        # calls the exec tree outside the HTTP/engine entry points, so it
+        # attaches the recorder itself (stage/dispatch decompose as usual)
+        ctx.phases = PhaseRecorder()
         return ctx, ex.execute(ctx)
 
     def refresh(self, sq: StandingQuery, now_ms: int | None = None,
@@ -238,6 +244,10 @@ class StandingEngine:
                 REGISTRY.counter("filodb_standing_refreshes",
                                  outcome="error").inc()
                 log.exception("standing refresh failed: %s", sq.promql)
+                self._observe_querylog(sq, "error", None,
+                                       time.perf_counter() - t0,
+                                       status="error",
+                                       error=f"{type(e).__name__}: {e}")
                 return None
             sq.last_error = None
         elapsed = time.perf_counter() - t0
@@ -250,9 +260,59 @@ class StandingEngine:
                 sq.ws, sq.ns, elapsed, ctx.stats.kernel_ns / 1e9,
                 ctx.stats.bytes_staged,
             )
+        # query-observatory record (obs/querylog.py): refreshes used to
+        # bypass the querylog entirely (the maintainer calls the exec tree
+        # outside the engine's HTTP entry points), leaving the busiest
+        # recurring work invisible to the observatory — every refresh now
+        # publishes a cost record under path standing:delta|standing:full
+        self._observe_querylog(sq, outcome, ctx, elapsed)
         if payload is not None:
             self.hub.publish(sq.qid, payload)
         return payload
+
+    def _observe_querylog(self, sq: StandingQuery, outcome: str, ctx,
+                          elapsed_s: float, status: str = "ok",
+                          error: str | None = None) -> None:
+        """One exemplar-level cost record per refresh. Path vocabulary:
+        ``standing:delta`` covers the delta-maintained dispositions
+        (suffix-only re-dispatch AND the zero-dispatch retained serve),
+        ``standing:full`` the full re-evaluations (nondecomposable/unfused
+        demotions and grid resets); an ERRORED refresh is labeled by the
+        query's registered maintenance mode — the plane that was being
+        attempted — so delta-path failures never masquerade as full
+        refreshes in path-filtered dashboards (status=error tells the
+        rest). The record carries the same executable_key/compile_miss
+        join the ad-hoc path gets — the fused suffix dispatch stamped
+        them on the context's obs annotations."""
+        from ..obs.querylog import QUERY_LOG, PhaseRecorder
+
+        phases = getattr(ctx, "phases", None) if ctx is not None else None
+        if phases is None:
+            phases = PhaseRecorder()
+        info = dict(getattr(ctx, "obs", None) or {}) if ctx is not None else {}
+        if status == "error":
+            delta = sq.mode == "delta"
+        else:
+            delta = outcome in ("delta", "retained")
+        info["path"] = "standing:delta" if delta else "standing:full"
+        retained = sq.retained
+        result_series = int(retained.shape[0]) if retained is not None else 0
+        result_samples = int(retained.size) if retained is not None else 0
+        # unique per refresh (sq.seq does not advance on retained serves):
+        # the ring's id index must never alias two refreshes' records
+        serial = int(sq.stats.get("refreshes", 0)) + int(
+            sq.stats.get("errors", 0)
+        )
+        QUERY_LOG.publish(
+            query_id=f"{sq.qid}:{serial}", dataset=sq.dataset,
+            promql=sq.promql, ws=sq.ws, ns=sq.ns, step_ms=sq.step_ms,
+            span_ms=sq.span_ms, start_s=sq.grid_start_ms / 1000.0,
+            end_s=sq.grid_end_ms / 1000.0, phases=phases,
+            elapsed_s=elapsed_s,
+            stats=ctx.stats if ctx is not None else None,
+            path_info=info, result_series=result_series,
+            result_samples=result_samples, status=status, error=error,
+        )
 
     def _refresh_locked(self, sq: StandingQuery, now_ms: int,
                         force_full: bool):

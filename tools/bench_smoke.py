@@ -26,34 +26,47 @@ FLOOR_FILE = os.path.join(REPO, "benchmarks", "bench_smoke_floor.json")
 REGRESSION_TOLERANCE = 0.25  # fail beyond floor * (1 + this)
 
 
-def run_entry(entry: dict) -> tuple[bool, str]:
+def run_entry(entry: dict, extra_env: dict | None = None,
+              cpu: bool = True) -> tuple[bool, str, dict | None]:
+    """Run one floor entry's bench worker. Returns ``(ok, verdict,
+    measurement)`` — the parsed worker JSON rides along so callers beyond
+    the smoke gate (tools/attest.py embeds floor verdicts + measurements
+    into the attestation artifact) don't re-run the workload.
+
+    ``cpu=False`` (the attestation harness after a healthy accelerator
+    probe) leaves the platform to jax's auto-detection so the worker runs
+    — and honestly labels — the real backend; the smoke gate itself always
+    pins cpu (its floors are CPU numbers)."""
     env = dict(
         os.environ,
-        JAX_PLATFORMS="cpu",
         FILODB_BENCH_SERIES=str(entry["series"]),
         FILODB_BENCH_RUNS=str(entry["runs"]),
         **{k: str(v) for k, v in (entry.get("env") or {}).items()},
+        **{k: str(v) for k, v in (extra_env or {}).items()},
     )
+    if cpu:
+        env["JAX_PLATFORMS"] = "cpu"
     proc = subprocess.run(
-        [sys.executable, os.path.join(REPO, "bench.py"), "--worker", "--cpu"],
+        [sys.executable, os.path.join(REPO, "bench.py"), "--worker"]
+        + (["--cpu"] if cpu else []),
         env=env, capture_output=True, text=True, cwd=REPO, timeout=600,
     )
     sys.stderr.write(proc.stderr[-2000:])
     lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
     name = entry["metric"]
     if proc.returncode != 0 or not lines:
-        return False, f"{name}: worker failed rc={proc.returncode}"
+        return False, f"{name}: worker failed rc={proc.returncode}", None
     got = json.loads(lines[-1])
     if got.get("metric") != name:
         return False, (
             f"{name}: FAIL worker emitted metric {got.get('metric')!r} — "
             "floor entry and bench.py METRIC out of sync"
-        )
+        ), got
     value = float(got["value"])
     if not got.get("match", False):
-        return False, f"{name}: FAIL result does not match the numpy oracle"
+        return False, f"{name}: FAIL result does not match the numpy oracle", got
     if value <= 0:
-        return False, f"{name}: FAIL no measurement"
+        return False, f"{name}: FAIL no measurement", got
     if "qps_floor_min" in entry:
         # HIGHER is better (throughput workloads): fail when the measured
         # value drops >25% below the checked-in floor
@@ -63,21 +76,21 @@ def run_entry(entry: dict) -> tuple[bool, str]:
             return False, (
                 f"{name}: FAIL {value:.1f} qps regresses >25% vs floor "
                 f"{floor} qps (limit {limit:.1f} qps)"
-            )
+            ), got
         return True, (
             f"{name}: OK {value:.1f} qps above limit {limit:.1f} qps "
             f"(floor {floor} qps, phases {got.get('phases_ms')})"
-        )
+        ), got
     limit = float(entry["p50_ms_floor"]) * (1.0 + REGRESSION_TOLERANCE)
     if value > limit:
         return False, (
             f"{name}: FAIL p50 {value:.2f}ms regresses >25% vs floor "
             f"{entry['p50_ms_floor']}ms (limit {limit:.2f}ms)"
-        )
+        ), got
     return True, (
         f"{name}: OK p50 {value:.2f}ms within limit {limit:.2f}ms "
         f"(floor {entry['p50_ms_floor']}ms, phases {got.get('phases_ms')})"
-    )
+    ), got
 
 
 def main() -> int:
@@ -87,7 +100,7 @@ def main() -> int:
     ok = True
     verdicts = []
     for entry in entries:
-        good, verdict = run_entry(entry)
+        good, verdict, _got = run_entry(entry)
         ok = ok and good
         verdicts.append(verdict)
     print("bench-smoke: " + "; ".join(verdicts))

@@ -212,11 +212,73 @@ def standing_violations() -> list[str]:
     return out
 
 
+OPS = PKG / "ops"
+
+
+def _is_jit_decorator(d: ast.expr) -> bool:
+    """True for ``@jax.jit``, ``@jax.jit(...)``, ``@pjit(...)`` and
+    ``@functools.partial(jax.jit, ...)`` decorator shapes."""
+    if isinstance(d, ast.Attribute) and d.attr in ("jit", "pjit"):
+        return True
+    if isinstance(d, ast.Name) and d.id == "pjit":
+        return True
+    if isinstance(d, ast.Call):
+        if _is_jit_decorator(d.func):
+            return True
+        return any(_is_jit_decorator(a) for a in d.args)
+    return False
+
+
+def jit_registration_violations() -> list[str]:
+    """Executable-registry coverage lint (obs/kernels.py): every jit
+    wrapper defined in ``ops/`` — decorated defs AND ``x = jax.jit(...)``
+    assignments — must be registered with the kernel observatory via a
+    ``KERNELS.register_jits(...)`` call in the same module (kwarg name ==
+    wrapper name). A kernel added without registration would dispatch
+    outside the observatory: its compiles and device costs would be
+    invisible to /debug/kernels, the recompile-storm detector and the
+    attestation artifact."""
+    out: list[str] = []
+    for path in sorted(OPS.glob("*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        jits: dict[str, int] = {}
+        registered: set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.FunctionDef) and any(
+                _is_jit_decorator(d) for d in node.decorator_list
+            ):
+                jits[node.name] = node.lineno
+            elif isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ) and _is_jit_decorator(node.value.func):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        jits[t.id] = node.lineno
+            elif (isinstance(node, ast.Call)
+                  and getattr(node.func, "attr", None) == "register_jits"):
+                for kw in node.keywords:
+                    if kw.arg:
+                        registered.add(kw.arg)
+                for a in node.args:
+                    if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                        registered.add(a.value)
+        for name, lineno in sorted(jits.items()):
+            if name not in registered:
+                out.append(
+                    f"jit wrapper {name!r} "
+                    f"({path.relative_to(ROOT)}:{lineno}) is not registered "
+                    f"with the executable registry — add it to the module's "
+                    f"KERNELS.register_jits(...) call (obs/kernels.py)"
+                )
+    return out
+
+
 def main() -> int:
     code, where = code_stems()
     doc = doc_stems()
     violations: list[str] = list(fused_reason_violations())
     violations.extend(standing_violations())
+    violations.extend(jit_registration_violations())
     for s in sorted(code - doc):
         locs = ", ".join(where.get(s, [])[:2])
         violations.append(
