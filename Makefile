@@ -2,7 +2,7 @@
 
 NATIVE_DIR := filodb_tpu/native
 
-.PHONY: all native test test-chaos test-index test-ingest-chaos test-jitter test-multichip test-observability test-scheduler test-standing attest bench bench-smoke microbench serve clean tpu-watch tpu-watch-bg
+.PHONY: all native test test-chaos test-index test-ingest-chaos test-jitter test-multichip test-observability test-rollup test-scheduler test-standing attest bench bench-smoke microbench serve clean tpu-watch tpu-watch-bg
 
 all: native
 
@@ -85,6 +85,15 @@ test-standing: native
 # and zero ledger drift for the opt-in device postings tier
 test-index: native
 	python -m pytest tests/test_index_bitmap.py -q -m index
+
+# sketch rollup tier suite (doc/perf.md "Sketch rollup tier"): planner
+# substitution (querylog path=rollup) + parity vs the raw path within the
+# documented error bounds, bit-identical plan-time AND runtime fallback,
+# chooser add/retire from querylog evidence, log-linear sketch property
+# tests vs the numpy quantile oracle, psum-merge parity on the 8-device
+# virtual mesh, and superblock pinning under eviction storms
+test-rollup: native
+	python -m pytest tests/test_rollup.py tests/test_sketch_property.py -q -m rollup
 
 # observability suite (doc/observability.md): trace propagation + stitching,
 # slow-query log, query observatory (per-phase decomposition, query-log

@@ -105,6 +105,9 @@ class PromApiHandler(BaseHTTPRequestHandler):
     # query observatory's SLO burn-rate recording rules (obs/slo.py);
     # its rules merge into /api/v1/rules. None = no SLO maintainer.
     standing_system = None
+    # RollupManager (downsample/rollup.py): the sketch-rollup summary
+    # tier's admin surface, /debug/rollups. None = endpoint 404s.
+    rollups = None
     auth_token: str | None = None  # optional bearer auth (server factory)
     # zero-arg profiler report hook; wired by the server ONLY when the
     # profiler config block enables it (/debug/profile gate)
@@ -367,6 +370,10 @@ class PromApiHandler(BaseHTTPRequestHandler):
                 if self.standing is None:
                     return self._send(404, J.error("not_found", "standing engine disabled"))
                 return self._send(200, J.success(self.standing.snapshot()))
+            if path == "/debug/rollups":
+                if self.rollups is None:
+                    return self._send(404, J.error("not_found", "rollup tier disabled"))
+                return self._send(200, J.success(self.rollups.snapshot()))
             if path == "/api/v1/rules":
                 # the truthful answer: recording rules from the standing
                 # engine AND the _system SLO maintainer when attached,
@@ -784,14 +791,21 @@ class PromApiHandler(BaseHTTPRequestHandler):
     def _querylog(self):
         """Query-observatory ring (doc/observability.md "Query
         observatory"): exemplar-level per-query cost records, newest
-        first; ``?limit=`` caps the page."""
+        first; ``?limit=`` caps the page, ``?fingerprint=`` keeps only one
+        normalized query shape (the filter applies BEFORE the limit, so a
+        page of a rare fingerprint is still a full page)."""
         from ..obs.querylog import QUERY_LOG
 
         p = self._params()
         limit = self._q(p, "limit")
-        return self._send(
-            200, J.success(QUERY_LOG.entries(int(limit) if limit else None))
-        )
+        fingerprint = self._q(p, "fingerprint")
+        entries = QUERY_LOG.entries(None)
+        if fingerprint:
+            entries = [e for e in entries
+                       if e.get("fingerprint") == fingerprint]
+        if limit:
+            entries = entries[: int(limit)]
+        return self._send(200, J.success(entries))
 
     def _query_profile(self):
         """One query's full cost record by id (= its trace id) — the
@@ -1087,7 +1101,8 @@ def make_server(engine: QueryEngine, host: str = "127.0.0.1", port: int = 9090,
                 local_engine: QueryEngine | None = None,
                 flush_hook=None,
                 dataset_engines: dict | None = None,
-                standing=None, standing_system=None) -> ThreadingHTTPServer:
+                standing=None, standing_system=None,
+                rollups=None) -> ThreadingHTTPServer:
     # membership hooks (members_hook/join_hook) are wired as class attrs on
     # the returned server's RequestHandlerClass AFTER start — the registry
     # needs the bound port for its self URL (server.py seed bootstrap)
@@ -1097,6 +1112,7 @@ def make_server(engine: QueryEngine, host: str = "127.0.0.1", port: int = 9090,
         {"engine": engine, "auth_token": auth_token, "local_engine": local_engine,
          "dataset_engines": dict(dataset_engines or {}),
          "standing": standing, "standing_system": standing_system,
+         "rollups": rollups,
          "flush_hook": staticmethod(flush_hook) if flush_hook else None},
     )
     return ThreadingHTTPServer((host, port), handler)
@@ -1106,10 +1122,10 @@ def serve_background(engine: QueryEngine, host: str = "127.0.0.1", port: int = 0
                      auth_token: str | None = None,
                      local_engine: QueryEngine | None = None,
                      flush_hook=None, dataset_engines: dict | None = None,
-                     standing=None, standing_system=None):
+                     standing=None, standing_system=None, rollups=None):
     """Start the API server on a thread; returns (server, actual_port)."""
     srv = make_server(engine, host, port, auth_token, local_engine, flush_hook,
-                      dataset_engines, standing, standing_system)
+                      dataset_engines, standing, standing_system, rollups)
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
     return srv, srv.server_address[1]

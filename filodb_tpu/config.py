@@ -172,6 +172,31 @@ DEFAULTS: dict = {
     },
     # downsampling (reference downsample resolutions)
     "downsample": {"enabled": False, "periods_m": [5, 60]},
+    # sketch rollup tier (downsample/rollup.py + downsample/chooser.py,
+    # doc/perf.md "Sketch rollup tier"): per-period mergeable summary
+    # blocks (log-linear sketch + min/max/sum/count moments) maintained
+    # over the ingest path; the planner substitutes them for long-range
+    # window queries whose step/window the resolution divides, so a
+    # 30-day quantile reads O(periods) instead of O(raw samples). The
+    # chooser trains the rollup set on the querylog: a fingerprint
+    # recurring >= min_count times with span >= min_span_ms earns a
+    # rollup at the coarsest ladder resolution serving its shape;
+    # chooser-owned entries idle > idle_s retire. grace_ms holds back
+    # the fold watermark so the live edge stays raw-served.
+    "rollup": {
+        "enabled": True,
+        "grace_ms": 120_000,
+        "max_entries": 64,
+        "tick_s": 5.0,
+        "chooser": {
+            "enabled": True,
+            "resolutions_ms": [300_000, 3_600_000],
+            "min_count": 3,
+            "min_span_ms": 86_400_000,
+            "idle_s": 3600.0,
+            "interval_s": 30.0,
+        },
+    },
     # cardinality quotas: list of {"prefix": ["ws","ns"], "quota": N}
     "quotas": [],
     # streaming preagg rules: [{"metric_regex", "include_tags"|"exclude_tags"}]

@@ -40,6 +40,7 @@ from ..query.exec.plans import (
     RawChunkExportExec,
     ReduceAggregateExec,
     SelectRawPartitionsExec,
+    StitchRvsExec,
 )
 from ..query.exec.transformers import (
     AbsentFunctionMapper,
@@ -320,6 +321,12 @@ class PlannerParams:
     # selector filters (metering.tenant_of_plan); over-quota queries raise
     # AdmissionRejected (HTTP 429 + Retry-After). None = no admission.
     admission: object | None = None
+    # sketch rollup tier (downsample/rollup.RollupManager): long-range
+    # queries whose step/window are multiples of a registered rollup's
+    # resolution substitute O(periods) summary blocks for the raw scan
+    # (doc/perf.md "Sketch rollup tier"). None = no substitution; every
+    # plan is byte-identical to the pre-rollup planner.
+    rollups: object | None = None
 
 
 class SingleClusterPlanner:
@@ -424,8 +431,80 @@ class SingleClusterPlanner:
     # -- entry -----------------------------------------------------------
 
     def materialize(self, plan: L.LogicalPlan) -> ExecPlan:
-        m = self._materialize
-        return m(plan)
+        slices = self._wide_range_slices(plan)
+        if slices is None:
+            return self._materialize(plan)
+        # over-wide range: the raw selector span exceeds the staged int32
+        # ms-offset representation (ops/staging.MAX_STAGE_SPAN_MS, ~24.8
+        # days) — offsets would wrap and every windowing path over the
+        # staged block (fused searchsorted precompute, tree kernels alike)
+        # silently empties or corrupts late windows. Rollup substitution
+        # still gets first refusal over the WHOLE range (summary blocks
+        # index by period number, no span limit); only the raw serving —
+        # including a rollup serve's runtime fallback — is time-sliced
+        # into per-slice staged bases and stitched.
+        from ..query.exec.plans import RollupServeExec
+
+        exec_plan = self._materialize(plan)
+        if isinstance(exec_plan, RollupServeExec):
+            exec_plan._fallback_factory = (
+                lambda: self._materialize_sliced(plan, slices)
+            )
+            return exec_plan
+        return self._materialize_sliced(plan, slices)
+
+    def _wide_range_slices(self, plan) -> list[tuple[int, int]] | None:
+        """(delta_start_ms, delta_end_ms) trims cutting an over-wide range
+        query into slices whose raw selector span each fits the staged
+        int32 offset representation — or None when the plan fits as-is (or
+        has no range grid to slice along, e.g. instant subqueries)."""
+        from ..ops import staging as ST
+
+        raws = L.leaf_raw_series(plan)
+        if not raws:
+            return None
+        raw_lo = min(r.start_ms for r in raws)
+        raw_hi = max(r.end_ms for r in raws)
+        span = raw_hi - raw_lo
+        if span <= ST.MAX_STAGE_SPAN_MS:
+            return None
+        # grid params live on the topmost periodic node (Aggregate and the
+        # function wrappers don't carry times themselves)
+        node = plan
+        while node is not None and not isinstance(
+            getattr(node, "start_ms", None), int
+        ):
+            node = getattr(node, "inner", None) or getattr(
+                node, "vectors", None
+            )
+        start = getattr(node, "start_ms", None)
+        end = getattr(node, "end_ms", None)
+        step = getattr(node, "step_ms", None) or 0
+        if not isinstance(start, int) or not isinstance(end, int) \
+                or step <= 0 or end <= start:
+            return None
+        # per-slice budget: the window/lookback/offset margins around the
+        # grid ride along with EVERY slice
+        margin = span - (end - start)
+        per = ST.MAX_STAGE_SPAN_MS - margin
+        if per < step:
+            return None  # window alone overflows; unsliceable
+        k = int(per // step) + 1  # steps per slice: (k-1)*step <= per
+        n = int((end - start) // step) + 1
+        if k >= n:
+            return None
+        out = []
+        for a in range(0, n, k):
+            b = min(a + k, n) - 1
+            out.append((a * step, (b - (n - 1)) * step))
+        return out
+
+    def _materialize_sliced(self, plan, slices) -> ExecPlan:
+        children = [
+            self._materialize(L.narrow_time(plan, ds, de))
+            for ds, de in slices
+        ]
+        return StitchRvsExec(children)
 
     def _fanout(self, make_leaf, transformers, filters=None, logical=None) -> ExecPlan:
         leaves = []
@@ -492,6 +571,9 @@ class SingleClusterPlanner:
             ts_plan = self._try_time_shard(p)
             if ts_plan is not None:
                 return ts_plan
+            rollup_plan = self._try_rollup_windowing(p)
+            if rollup_plan is not None:
+                return rollup_plan
             mapper = PeriodicSamplesMapper(
                 p.start_ms, p.end_ms, p.step_ms, p.function, p.window_ms,
                 offset_ms=p.offset_ms, at_ms=p.at_ms, args=p.function_args,
@@ -748,7 +830,7 @@ class SingleClusterPlanner:
         raw_start, raw_end = self._fused_raw_range(
             inner.raw.start_ms, inner.raw.end_ms
         )
-        return FusedAggregateExec(
+        fused = FusedAggregateExec(
             shards, inner.raw.filters, raw_start, raw_end,
             inner.raw.column, p.op, p.by, p.without, func,
             inner.start_ms, inner.end_ms, inner.step_ms or 1, window,
@@ -759,6 +841,101 @@ class SingleClusterPlanner:
             params=p.params,
             hist_quantile=hist_quantile,
             mesh=mesh,
+        )
+        rollup = self._try_rollup_aggregate(
+            p, inner, func, window, hist_quantile, fused, mesh
+        )
+        return rollup if rollup is not None else fused
+
+    def _try_rollup_aggregate(self, p: "L.Aggregate", inner, func,
+                              window_ms: int, hist_quantile, fused, mesh):
+        """Rollup substitution over the fused aggregate shape: when a
+        registered rollup's resolution divides this query's step AND
+        window and its closed coverage spans the grid, the [G, J] answer
+        comes from O(periods) summary blocks — moments for
+        sum/count/avg/min/max, merged sketches for the quantile epilogue,
+        per-``le`` counter rollups for classic histogram_quantile. The
+        already-built FusedAggregateExec IS the fallback, so plan-time
+        ineligibility (returning None) and runtime ineligibility
+        (``rollup_ineligible``) are both bit-identical to today's path."""
+        from ..query.exec.plans import RollupServeExec
+        from ..downsample.rollup import ROLLUP_AGG_OPS, ROLLUP_FUNCS
+
+        rollups = self.params.rollups
+        if rollups is None or func is None or inner.raw.column is not None:
+            return None
+        if func not in ROLLUP_FUNCS or inner.offset_ms:
+            return None
+        if hist_quantile is not None:
+            # classic bucket series only: the interpolation needs the
+            # per-``le`` rate partials in the grouping
+            if p.op != "sum" or "le" not in tuple(p.by or ()):
+                return None
+        elif p.op not in ROLLUP_AGG_OPS and p.op != "quantile":
+            return None
+        key = rollups.plan(
+            self.dataset, inner.raw.filters, func, inner.step_ms or 1,
+            window_ms, inner.start_ms, inner.end_ms, inner.offset_ms,
+        )
+        if key is None:
+            return None
+        return RollupServeExec(
+            rollups, key, inner.raw.filters, func, (),
+            inner.start_ms, inner.end_ms, inner.step_ms or 1, window_ms,
+            fallback=lambda: fused, op=p.op, by=p.by, without=p.without,
+            params=p.params, hist_quantile=hist_quantile, mesh=mesh,
+        )
+
+    def _try_rollup_windowing(self, p: "L.PeriodicSeriesWithWindowing"):
+        """Rollup substitution for a bare range function (no aggregate):
+        ``quantile_over_time`` reads the per-period sketch blocks, the
+        moment functions and counter rate/increase read the [S, P]
+        moments. Ineligible shapes return None and the caller builds the
+        raw mapper+fanout plan exactly as before (bit-identical)."""
+        from ..query.exec.plans import (
+            RollupServeExec,
+            SelectRawPartitionsExec,
+        )
+        from ..downsample.rollup import ROLLUP_FUNCS
+
+        rollups = self.params.rollups
+        if rollups is None or p.raw.column is not None:
+            return None
+        if (p.function not in ROLLUP_FUNCS or p.at_ms is not None
+                or p.offset_ms):
+            return None
+        if p.function_args and not (
+            p.function == "quantile_over_time"
+            and len(p.function_args) == 1
+            and isinstance(p.function_args[0], (int, float))
+        ):
+            return None
+        key = rollups.plan(
+            self.dataset, p.raw.filters, p.function, p.step_ms or 1,
+            p.window_ms, p.start_ms, p.end_ms, p.offset_ms,
+        )
+        if key is None:
+            return None
+
+        def fallback():
+            mapper = PeriodicSamplesMapper(
+                p.start_ms, p.end_ms, p.step_ms, p.function, p.window_ms,
+                offset_ms=p.offset_ms, at_ms=p.at_ms, args=p.function_args,
+            )
+            raw = p.raw
+            return self._fanout(
+                lambda s: SelectRawPartitionsExec(
+                    s, raw.filters, raw.start_ms, raw.end_ms, raw.column
+                ),
+                [mapper],
+                filters=raw.filters,
+                logical=p,
+            )
+
+        return RollupServeExec(
+            rollups, key, p.raw.filters, p.function, p.function_args,
+            p.start_ms, p.end_ms, p.step_ms or 1, p.window_ms,
+            fallback=fallback,
         )
 
     # superblock staging-range alignment under cross-query batching: the

@@ -1,0 +1,175 @@
+"""Workload-chosen rollup set (Storyboard's framing): the summary tier is
+trained on the OBSERVED workload, not a fixed 5m/1h ladder.
+
+The chooser is a standing job over the querylog ring (obs/querylog.py —
+exemplar-level records with PromQL fingerprints and per-phase costs). A
+fingerprint that keeps re-appearing with a long span (a dashboard panel
+refreshing a month-long quantile, say) earns a rollup: the chooser
+re-parses the recorded PromQL into its logical plan, extracts the
+selector + range-function shape, picks the COARSEST ladder resolution
+that divides both the query's step and window (maximum summary
+compression that still serves the shape exactly), and registers it with
+:class:`~filodb_tpu.downsample.rollup.RollupManager`. Chooser-owned
+entries whose selectors stop being queried are retired after an idle
+period, so the rollup set tracks dashboards as they change.
+
+Config-pinned entries (``origin != "chooser"``) are never retired here.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..metrics import record_rollup_chooser
+from ..obs.querylog import QUERY_LOG
+from ..query import logical as L
+from ..query.promql import query_range_to_logical_plan
+from .rollup import ROLLUP_FUNCS, RollupManager
+
+
+class RollupChooser:
+    """Decides WHICH selectors get rollups at WHAT resolutions from
+    querylog evidence. ``tick()`` is the synchronous decision pass (tests
+    call it directly); ``start()`` runs it on a standing thread."""
+
+    def __init__(self, manager: RollupManager,
+                 resolutions_ms=(300_000, 3_600_000),
+                 min_count: int = 3, min_span_ms: int = 86_400_000,
+                 idle_s: float = 3600.0, interval_s: float = 30.0,
+                 log_limit: int = 512):
+        self.manager = manager
+        self.resolutions_ms = tuple(sorted(int(r) for r in resolutions_ms))
+        self.min_count = int(min_count)
+        self.min_span_ms = int(min_span_ms)
+        self.idle_s = float(idle_s)
+        self.interval_s = float(interval_s)
+        self.log_limit = int(log_limit)
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self.decisions: list[dict] = []  # most recent pass, for /debug
+
+    # -- standing thread ---------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="rollup-chooser", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 — the chooser must not die
+                pass
+
+    # -- decision pass -----------------------------------------------------
+
+    def tick(self, now_s: float | None = None) -> list[dict]:
+        """One decision pass: add rollups for repeated long-range
+        fingerprints, retire idle chooser-owned entries. Returns the
+        decisions made (also kept on ``self.decisions``)."""
+        if now_s is None:
+            now_s = time.time()
+        decisions: list[dict] = []
+        by_fp: dict[str, list[dict]] = {}
+        for rec in QUERY_LOG.entries(limit=self.log_limit):
+            grid = rec.get("grid") or {}
+            span_ms = (grid.get("end_s", 0) - grid.get("start_s", 0)) * 1000
+            if span_ms < self.min_span_ms or rec.get("status") == "error":
+                continue
+            by_fp.setdefault(rec.get("fingerprint", ""), []).append(rec)
+        for fp, recs in by_fp.items():
+            if not fp or len(recs) < self.min_count:
+                continue
+            rec = recs[0]  # newest
+            shape = self._servable_shape(rec)
+            if shape is None:
+                continue
+            filters, step_ms, window_ms = shape
+            res = self._pick_resolution(step_ms, window_ms)
+            if res is None:
+                continue
+            dataset = rec.get("dataset", "")
+            if self.manager.has(dataset, filters, res):
+                continue
+            try:
+                self.manager.ensure(dataset, filters, res,
+                                    origin="chooser", build=True)
+            except ValueError:
+                continue  # entry limit — keep what we have
+            record_rollup_chooser("add")
+            decisions.append({
+                "action": "add", "fingerprint": fp, "dataset": dataset,
+                "resolution_ms": res, "count": len(recs),
+                "promql": rec.get("promql"),
+            })
+        # retire chooser-owned entries that went idle
+        for entry in self.manager.entries():
+            if entry.origin != "chooser":
+                continue
+            last = max(entry.last_hit_s, entry.created_s)
+            if now_s - last > self.idle_s:
+                if self.manager.retire(entry.dataset, entry.filters,
+                                       entry.resolution_ms, reason="idle"):
+                    record_rollup_chooser("retire")
+                    decisions.append({
+                        "action": "retire",
+                        "dataset": entry.dataset,
+                        "selector": [list(f) for f in entry.filters_key()],
+                        "resolution_ms": entry.resolution_ms,
+                        "idle_s": now_s - last,
+                    })
+        self.decisions = decisions
+        return decisions
+
+    def _pick_resolution(self, step_ms: int, window_ms: int) -> int | None:
+        """Coarsest ladder resolution that divides step AND window — the
+        same divisibility rule the planner's substitution check applies,
+        so a chosen rollup is guaranteed eligible for the training
+        fingerprint's shape."""
+        best = None
+        for res in self.resolutions_ms:
+            if (step_ms % res == 0 and window_ms % res == 0
+                    and window_ms >= res):
+                best = res
+        return best
+
+    def _servable_shape(self, rec: dict):
+        """Re-parse the recorded PromQL and extract (filters, step_ms,
+        window_ms) when the plan is a rollup-servable shape: a range
+        function in ROLLUP_FUNCS under any stack of aggregates / instant
+        functions (histogram_quantile over rate'd buckets included).
+        Returns None for everything else."""
+        grid = rec.get("grid") or {}
+        promql = rec.get("promql")
+        if not promql or not grid:
+            return None
+        try:
+            plan = query_range_to_logical_plan(
+                promql, grid["start_s"], grid["end_s"],
+                max(grid.get("step_ms", 0) // 1000, 1),
+            )
+        except Exception:  # noqa: BLE001 — unparsable record, skip
+            return None
+        node = plan
+        while isinstance(node, (L.Aggregate, L.ApplyInstantFunction)):
+            node = node.inner
+        if not isinstance(node, L.PeriodicSeriesWithWindowing):
+            return None
+        if node.function not in ROLLUP_FUNCS or node.offset_ms:
+            return None
+        if node.function_args and node.function != "quantile_over_time":
+            return None
+        return (node.raw.filters, int(node.step_ms), int(node.window_ms))

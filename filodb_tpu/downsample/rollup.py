@@ -1,0 +1,690 @@
+"""Workload-chosen sketch rollup tier — the maintenance side
+(doc/perf.md "Sketch rollup tier").
+
+A *rollup entry* is a selector-scoped summary block at one resolution:
+per series per period it holds min/max/sum/count moments, a
+reset-corrected last (counters — period-aligned ``rate``/``increase``
+read off as diffs), and a COMPACTED log-linear sketch (ops/sketch.py bin
+ids, stored as the populated ``[bin_lo, bin_hi]`` slice of the full bin
+axis — exact-equivalent to the full sketch because bins stay sorted by
+value). Long-range ``quantile_over_time``/``histogram_quantile``/
+``*_over_time`` queries whose step and window are multiples of the
+resolution read O(periods) summaries instead of O(raw samples)
+(coordinator/planner substitution -> query/exec RollupServeExec).
+
+Maintenance reuses the PR-6 race-free pattern: each entry records the
+member shards' version vectors and closes periods up to a graced
+watermark; on refresh, the shard effect log (``ingest_effects_since``)
+proves whether ingest since the stamped versions touched the CLOSED
+region — disjoint effects fold forward incrementally, overlapping or
+unclassifiable effects (out-of-order writes, eviction, truncation) force
+a full rebuild, so rollups stay live under production ingest without
+ever serving a torn period. Device copies stage lazily at first serve
+and are accounted in the device ledger under the ``rollup`` kind;
+``/debug/rollups`` serves :meth:`RollupManager.snapshot`.
+
+WHICH selectors get rollups at WHAT resolutions is workload-chosen:
+downsample/chooser.py trains on querylog fingerprints and drives
+:meth:`RollupManager.ensure` / :meth:`RollupManager.retire`.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..core.schemas import ColumnType
+from ..ledger import LEDGER
+from ..metrics import REGISTRY, record_rollup_event
+from ..ops import sketch as SK
+
+# range functions servable from moments (plus counter-only rate/increase;
+# quantile_over_time serves from the sketch block)
+ROLLUP_MOMENT_FUNCS = frozenset({
+    "min_over_time", "max_over_time", "sum_over_time", "count_over_time",
+    "avg_over_time",
+})
+ROLLUP_COUNTER_FUNCS = frozenset({"rate", "increase"})
+ROLLUP_SKETCH_FUNCS = frozenset({"quantile_over_time"})
+ROLLUP_FUNCS = ROLLUP_MOMENT_FUNCS | ROLLUP_COUNTER_FUNCS | ROLLUP_SKETCH_FUNCS
+
+# aggregate ops the rollup aggregate path computes (one masked segment
+# reduce over the per-series moment values; quantile goes through the
+# merge-sketches -> epilogue program)
+ROLLUP_AGG_OPS = frozenset({"sum", "count", "avg", "min", "max"})
+
+
+def filters_key(filters) -> tuple:
+    """Canonical selector identity: rollups are selector-scoped and matched
+    exactly (order-insensitive)."""
+    return tuple(sorted((f.column, f.op, str(f.value)) for f in filters))
+
+
+def _ffill(arr: np.ndarray, seed: np.ndarray, mask: np.ndarray) -> np.ndarray:
+    """Row-wise forward fill of ``arr`` [S, P] where ``mask`` is False,
+    seeded per row (the value 'before' column 0)."""
+    S, P = arr.shape
+    if P == 0:
+        return arr
+    a = np.concatenate([seed[:, None], arr], axis=1)
+    m = np.concatenate([np.ones((S, 1), bool), mask], axis=1)
+    idx = np.where(m, np.arange(P + 1)[None, :], 0)
+    np.maximum.accumulate(idx, axis=1, out=idx)
+    return a[np.arange(S)[:, None], idx][:, 1:]
+
+
+@dataclass
+class RollupEntry:
+    """One selector x resolution summary block (host mirrors + lazily
+    staged device copies)."""
+
+    dataset: str
+    filters: tuple
+    resolution_ms: int
+    origin: str = "config"
+    # period coverage: periods [p0, watermark_p) are closed and folded.
+    # Arrays are allocated only up to the DATA edge (local period count
+    # ``alloc_p``); closed periods past it are implicitly empty — identity
+    # values the serve path pads in — so a stale selector costs O(data),
+    # not O(wall-clock since p0)
+    p0: int | None = None
+    watermark_p: int | None = None
+    alloc_p: int = 0
+    # per-series identity, in row order
+    labels: list = field(default_factory=list)
+    part_refs: list = field(default_factory=list)  # [(shard_num, pid)]
+    col_name: str | None = None
+    is_counter: bool = False
+    # host moment arrays [S, P]
+    mn: np.ndarray | None = None
+    mx: np.ndarray | None = None
+    sm: np.ndarray | None = None
+    cnt: np.ndarray | None = None
+    clast: np.ndarray | None = None  # corrected last, forward-filled (f64)
+    # compacted sketch block [S, P, Bc] over full-bin ids [bin_lo, bin_hi]
+    sketch: np.ndarray | None = None
+    bin_lo: int | None = None
+    bin_hi: int | None = None
+    # per-series counter-correction carry across folds
+    carry_last_raw: np.ndarray | None = None
+    carry_base: np.ndarray | None = None
+    carry_clast: np.ndarray | None = None
+    # freshness: per-shard versions stamped BEFORE the last fold's reads
+    versions: dict = field(default_factory=dict)
+    # stats
+    created_s: float = field(default_factory=time.time)
+    last_hit_s: float = 0.0
+    last_refresh_s: float = 0.0
+    builds: int = 0
+    folds: int = 0
+    serves: int = 0
+    # device staging (protected by the manager lock)
+    _dev: dict | None = None
+    _dev_nbytes: int = 0
+
+    @property
+    def n_series(self) -> int:
+        return len(self.labels)
+
+    @property
+    def n_periods(self) -> int:
+        if self.p0 is None or self.watermark_p is None:
+            return 0
+        return self.watermark_p - self.p0
+
+    def host_nbytes(self) -> int:
+        total = 0
+        for a in (self.mn, self.mx, self.sm, self.cnt, self.clast,
+                  self.sketch):
+            if a is not None:
+                total += a.nbytes
+        return total
+
+    def describe(self) -> dict:
+        res = self.resolution_ms
+        return {
+            "dataset": self.dataset,
+            "selector": [list(f) for f in self.filters_key()],
+            "resolution_ms": res,
+            "origin": self.origin,
+            "series": self.n_series,
+            "periods": self.n_periods,
+            "alloc_periods": self.alloc_p,
+            "coverage_ms": (
+                [self.p0 * res, self.watermark_p * res]
+                if self.p0 is not None else None
+            ),
+            "is_counter": self.is_counter,
+            "column": self.col_name,
+            "sketch_bins": (
+                self.bin_hi - self.bin_lo + 1 if self.bin_lo is not None
+                else 0
+            ),
+            "host_bytes": self.host_nbytes(),
+            "device_bytes": self._dev_nbytes,
+            "builds": self.builds,
+            "folds": self.folds,
+            "serves": self.serves,
+            "last_hit_s": self.last_hit_s,
+            "last_refresh_s": self.last_refresh_s,
+        }
+
+    def filters_key(self) -> tuple:
+        return filters_key(self.filters)
+
+
+class RollupManager:
+    """Owns the rollup entry set for one memstore: maintenance (standing
+    thread or explicit :meth:`tick`), plan-time eligibility, and the
+    serve-time views RollupServeExec dispatches on."""
+
+    def __init__(self, memstore, grace_ms: int = 0, max_entries: int = 64,
+                 tick_s: float = 5.0):
+        self.memstore = memstore
+        self.grace_ms = int(grace_ms)
+        self.max_entries = int(max_entries)
+        self.tick_s = float(tick_s)
+        self._entries: dict[tuple, RollupEntry] = {}
+        self._lock = threading.RLock()
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self.ledger = LEDGER.register(
+            self, "rollup", _rollup_ledger_walker, name="rollup-blocks",
+        )
+        REGISTRY.register_collector(
+            f"rollup_manager_{id(self)}", self._publish_gauges,
+        )
+
+    # -- entry lifecycle ---------------------------------------------------
+
+    def _key(self, dataset: str, filters, resolution_ms: int) -> tuple:
+        return (dataset, filters_key(filters), int(resolution_ms))
+
+    def ensure(self, dataset: str, filters, resolution_ms: int,
+               origin: str = "config", build: bool = False) -> RollupEntry:
+        """Idempotently register a rollup for (selector, resolution).
+        ``build=True`` folds synchronously (tests, chooser warm-add)."""
+        key = self._key(dataset, filters, resolution_ms)
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                if len(self._entries) >= self.max_entries:
+                    raise ValueError(
+                        f"rollup entry limit {self.max_entries} reached"
+                    )
+                entry = RollupEntry(
+                    dataset=dataset, filters=tuple(filters),
+                    resolution_ms=int(resolution_ms), origin=origin,
+                )
+                self._entries[key] = entry
+                record_rollup_event("add")
+        if build:
+            self.refresh(entry)
+        return entry
+
+    def retire(self, dataset: str, filters, resolution_ms: int,
+               reason: str = "idle") -> bool:
+        key = self._key(dataset, filters, resolution_ms)
+        with self._lock:
+            entry = self._entries.pop(key, None)
+            if entry is None:
+                return False
+            self._drop_device_locked(entry)
+        record_rollup_event("retire")
+        return True
+
+    def has(self, dataset: str, filters, resolution_ms: int) -> bool:
+        with self._lock:
+            return self._key(dataset, filters, resolution_ms) in self._entries
+
+    def entries(self) -> list[RollupEntry]:
+        with self._lock:
+            return list(self._entries.values())
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            entries = [e.describe() for e in self._entries.values()]
+        out = {
+            "entries": entries,
+            "count": len(entries),
+            "max_entries": self.max_entries,
+            "grace_ms": self.grace_ms,
+        }
+        # the chooser (when attached by the server) contributes its latest
+        # decision pass so /debug/rollups tells WHY the set looks like this
+        chooser = getattr(self, "chooser", None)
+        if chooser is not None:
+            out["chooser_decisions"] = list(chooser.decisions)
+        return out
+
+    def _publish_gauges(self) -> None:
+        with self._lock:
+            per_ds: dict[str, int] = {}
+            for e in self._entries.values():
+                per_ds[e.dataset] = per_ds.get(e.dataset, 0) + 1
+        for ds, n in per_ds.items():
+            REGISTRY.gauge("filodb_rollup_entries", dataset=ds).set(float(n))
+
+    # -- maintenance -------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="rollup-maintainer", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.tick_s):
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 — maintenance must not die
+                record_rollup_event("error")
+
+    def tick(self, now_ms: int | None = None) -> int:
+        """One maintenance pass over every entry; returns entries
+        refreshed. Synchronous entry point for tests."""
+        n = 0
+        for entry in self.entries():
+            try:
+                if self.refresh(entry, now_ms=now_ms):
+                    n += 1
+            except Exception:  # noqa: BLE001 — one sick entry must not stall the rest
+                record_rollup_event("error")
+        return n
+
+    def refresh(self, entry: RollupEntry, now_ms: int | None = None) -> bool:
+        """Fold newly closed periods into ``entry``; full rebuild when the
+        effect log can't prove the closed region untouched."""
+        if now_ms is None:
+            now_ms = int(time.time() * 1000)
+        res = entry.resolution_ms
+        upto_p = (now_ms - self.grace_ms) // res
+        with self._lock:
+            needs_rebuild = False
+            if entry.watermark_p is not None:
+                closed_lo = (entry.p0 or 0) * res
+                closed_hi = entry.watermark_p * res
+                for s in self.memstore.shard_nums(entry.dataset):
+                    shard = self.memstore.shard(entry.dataset, s)
+                    old_v = entry.versions.get(s)
+                    if old_v is None:
+                        continue
+                    if shard.version == old_v:
+                        continue
+                    reason = shard.ingest_effects_since(
+                        old_v, closed_lo, closed_hi - 1
+                    )
+                    if reason is not None:
+                        # overlap / full_clear / log_truncated: closed
+                        # periods may be stale — rebuild from scratch
+                        needs_rebuild = True
+                        break
+            if needs_rebuild:
+                self._rebuild_locked(entry, upto_p, now_ms)
+                record_rollup_event("rebuild")
+                return True
+            if entry.watermark_p is not None and upto_p <= entry.watermark_p:
+                entry.last_refresh_s = time.time()
+                return False
+            self._fold_locked(entry, upto_p, now_ms)
+            if entry.builds == 1 and entry.folds == 1:
+                record_rollup_event("build")
+            else:
+                record_rollup_event("fold")
+            return True
+
+    def _rebuild_locked(self, entry: RollupEntry, upto_p: int,
+                        now_ms: int) -> None:
+        entry.p0 = None
+        entry.watermark_p = None
+        entry.alloc_p = 0
+        entry.labels = []
+        entry.part_refs = []
+        entry.mn = entry.mx = entry.sm = entry.cnt = None
+        entry.clast = entry.sketch = None
+        entry.bin_lo = entry.bin_hi = None
+        entry.carry_last_raw = entry.carry_base = entry.carry_clast = None
+        entry.versions = {}
+        self._drop_device_locked(entry)
+        self._fold_locked(entry, upto_p, now_ms)
+
+    def _fold_locked(self, entry: RollupEntry, upto_p: int,
+                     now_ms: int) -> None:
+        """Fold samples from closed periods [watermark_p, upto_p) into the
+        entry's arrays (first call establishes p0 from the data)."""
+        res = entry.resolution_ms
+        # versions stamped BEFORE the reads: a racing in-range append bumps
+        # them, so the next refresh sees an overlap against the (by then
+        # closed) region and rebuilds — never a torn period served
+        versions = {
+            s: self.memstore.shard(entry.dataset, s).version
+            for s in self.memstore.shard_nums(entry.dataset)
+        }
+        fold_from = (entry.watermark_p * res
+                     if entry.watermark_p is not None else 0)
+        fold_to = upto_p * res
+        if fold_to <= fold_from:
+            entry.versions = versions
+            entry.last_refresh_s = time.time()
+            return
+        # gather (row, ts, vals) per series; discover new series as we go
+        ref_row = {r: i for i, r in enumerate(entry.part_refs)}
+        gathered: list[tuple[int, np.ndarray, np.ndarray]] = []
+        for s in self.memstore.shard_nums(entry.dataset):
+            shard = self.memstore.shard(entry.dataset, s)
+            pids = shard.lookup_partitions(entry.filters, fold_from,
+                                           fold_to - 1)
+            for pid in pids:
+                part = shard.partition(int(pid))
+                schema = part.schema
+                col_name = entry.col_name or schema.value_column
+                try:
+                    col = schema.column(col_name)
+                except KeyError:
+                    continue
+                if col.ctype != ColumnType.DOUBLE:
+                    continue  # native-histogram columns: not rolled up
+                ts, vals = part.samples_in_range(fold_from, fold_to - 1,
+                                                 col_name)
+                keep = ~np.isnan(np.asarray(vals, dtype=np.float64))
+                ts = np.asarray(ts, dtype=np.int64)[keep]
+                vals = np.asarray(vals, dtype=np.float64)[keep]
+                ref = (s, int(pid))
+                row = ref_row.get(ref)
+                if row is None:
+                    row = len(entry.part_refs)
+                    ref_row[ref] = row
+                    entry.part_refs.append(ref)
+                    entry.labels.append(dict(part.tags))
+                    if entry.col_name is None:
+                        entry.col_name = col_name
+                        entry.is_counter = bool(col.is_counter
+                                                and not col.is_delta)
+                if len(ts):
+                    gathered.append((row, ts, vals))
+        if entry.p0 is None:
+            if not gathered:
+                entry.versions = versions
+                entry.last_refresh_s = time.time()
+                return
+            entry.p0 = int(min(ts[0] for _, ts, _ in gathered)) // res
+            entry.watermark_p = entry.p0
+        p_off = entry.p0
+        wm_old = entry.watermark_p - p_off  # fold range, local periods
+        wm_new = upto_p - p_off
+        old_alloc = entry.alloc_p
+        data_hi = old_alloc
+        kept: list[tuple[int, np.ndarray, np.ndarray, np.ndarray]] = []
+        for row, ts, vals in gathered:
+            periods = ts // res - p_off
+            inrange = (periods >= wm_old) & (periods < wm_new)
+            if not inrange.all():
+                ts, vals, periods = ts[inrange], vals[inrange], periods[inrange]
+            if not len(ts):
+                continue
+            kept.append((row, ts, vals, periods))
+            data_hi = max(data_hi, int(periods.max()) + 1)
+        S = len(entry.part_refs)
+        self._grow_arrays_locked(entry, S, data_hi)
+        entry.alloc_p = data_hi
+        mn, mx, sm, cnt = entry.mn, entry.mx, entry.sm, entry.cnt
+        for row, ts, vals, periods in kept:
+            np.minimum.at(mn[row], periods, vals)
+            np.maximum.at(mx[row], periods, vals)
+            np.add.at(sm[row], periods, vals)
+            np.add.at(cnt[row], periods, 1.0)
+            # sketch: full-bin ids, folded into the compacted slice
+            bins = SK.bin_of_np(vals)
+            self._fold_sketch_locked(entry, row, periods, bins)
+            if entry.is_counter:
+                self._fold_counter_locked(entry, row, ts, vals, periods)
+        # forward-fill corrected last across empty periods of the fold
+        if entry.is_counter and data_hi > old_alloc:
+            seg = entry.clast[:, old_alloc:data_hi]
+            mask = cnt[:, old_alloc:data_hi] > 0
+            seed = (entry.carry_clast.copy() if old_alloc == 0
+                    else entry.clast[:, old_alloc - 1])
+            entry.clast[:, old_alloc:data_hi] = _ffill(seg, seed, mask)
+            entry.carry_clast = entry.clast[:, data_hi - 1].copy()
+        entry.watermark_p = upto_p
+        entry.versions = versions
+        entry.builds += 1 if entry.folds == 0 else 0
+        entry.folds += 1
+        entry.last_refresh_s = time.time()
+        self._drop_device_locked(entry)
+
+    def _grow_arrays_locked(self, entry: RollupEntry, S: int,
+                            P: int) -> None:
+        """Resize host arrays to [S, P] (rows append, periods extend)."""
+        def grow2(a, fill, dtype=np.float64):
+            if a is None:
+                return np.full((S, P), fill, dtype)
+            s0, p0 = a.shape
+            if s0 == S and p0 == P:
+                return a
+            out = np.full((S, P), fill, dtype)
+            out[:s0, :p0] = a
+            return out
+
+        entry.mn = grow2(entry.mn, np.inf)
+        entry.mx = grow2(entry.mx, -np.inf)
+        entry.sm = grow2(entry.sm, 0.0)
+        entry.cnt = grow2(entry.cnt, 0.0)
+        entry.clast = grow2(entry.clast, 0.0)
+        Bc = (entry.bin_hi - entry.bin_lo + 1
+              if entry.bin_lo is not None else 0)
+        if entry.sketch is None:
+            entry.sketch = np.zeros((S, P, Bc), np.float32)
+        elif entry.sketch.shape[:2] != (S, P):
+            out = np.zeros((S, P, Bc), np.float32)
+            s0, p0, _ = entry.sketch.shape
+            out[:s0, :p0] = entry.sketch
+            entry.sketch = out
+
+        def grow1(a, fill):
+            if a is None:
+                return np.full(S, fill, np.float64)
+            if len(a) == S:
+                return a
+            out = np.full(S, fill, np.float64)
+            out[: len(a)] = a
+            return out
+
+        entry.carry_last_raw = grow1(entry.carry_last_raw, np.nan)
+        entry.carry_base = grow1(entry.carry_base, 0.0)
+        entry.carry_clast = grow1(entry.carry_clast, 0.0)
+
+    def _fold_sketch_locked(self, entry: RollupEntry, row: int,
+                            periods: np.ndarray, bins: np.ndarray) -> None:
+        ok = bins >= 0
+        if not ok.all():
+            periods, bins = periods[ok], bins[ok]
+        if not len(bins):
+            return
+        lo, hi = int(bins.min()), int(bins.max())
+        if entry.bin_lo is None:
+            entry.bin_lo, entry.bin_hi = lo, hi
+            S, P = entry.cnt.shape
+            entry.sketch = np.zeros((S, P, hi - lo + 1), np.float32)
+        elif lo < entry.bin_lo or hi > entry.bin_hi:
+            new_lo = min(lo, entry.bin_lo)
+            new_hi = max(hi, entry.bin_hi)
+            pad_l = entry.bin_lo - new_lo
+            pad_r = new_hi - entry.bin_hi
+            entry.sketch = np.pad(
+                entry.sketch, ((0, 0), (0, 0), (pad_l, pad_r))
+            )
+            entry.bin_lo, entry.bin_hi = new_lo, new_hi
+        np.add.at(entry.sketch[row], (periods, bins - entry.bin_lo), 1.0)
+
+    def _fold_counter_locked(self, entry: RollupEntry, row: int,
+                             ts: np.ndarray, vals: np.ndarray,
+                             periods: np.ndarray) -> None:
+        """Reset-corrected cumulative last per period (vectorized; carry
+        crosses folds so corrections stay consistent over time)."""
+        last_raw = entry.carry_last_raw[row]
+        prev = np.concatenate(
+            [[vals[0] if np.isnan(last_raw) else last_raw], vals[:-1]]
+        )
+        drops = vals < prev
+        base = entry.carry_base[row] + np.cumsum(np.where(drops, prev, 0.0))
+        corrected = base + vals
+        # last sample of each period present in this fold
+        uniq, last_idx = np.unique(periods[::-1], return_index=True)
+        last_idx = len(periods) - 1 - last_idx
+        entry.clast[row, uniq] = corrected[last_idx]
+        entry.carry_last_raw[row] = vals[-1]
+        entry.carry_base[row] = base[-1]
+
+    # -- device staging ----------------------------------------------------
+
+    def _drop_device_locked(self, entry: RollupEntry) -> None:
+        if entry._dev is not None:
+            self.ledger.free(entry._dev_nbytes, reason="invalidate")
+            entry._dev = None
+            entry._dev_nbytes = 0
+
+    def device_arrays(self, entry: RollupEntry) -> dict:
+        """Lazily staged device copies of the entry's arrays (f32 moments
+        with the counter baseline shifted out, compacted sketch counts).
+        Ledger-accounted under kind ``rollup``."""
+        import jax.numpy as jnp
+
+        with self._lock:
+            if entry._dev is not None:
+                return entry._dev
+            clast = entry.clast
+            baseline = (clast[:, 0].copy() if clast is not None
+                        and clast.shape[1] else None)
+            dev = {
+                "mn": jnp.asarray(entry.mn, jnp.float32),
+                "mx": jnp.asarray(entry.mx, jnp.float32),
+                "sm": jnp.asarray(entry.sm, jnp.float32),
+                "cnt": jnp.asarray(entry.cnt, jnp.float32),
+                # baseline-shifted corrected last: exact f32 diffs even on
+                # 1e15-magnitude counters (the staging "shifted" trick)
+                "clast": jnp.asarray(
+                    clast - baseline[:, None] if baseline is not None
+                    else entry.clast, jnp.float32,
+                ),
+                "sketch": jnp.asarray(entry.sketch, jnp.float32),
+                "centers": jnp.asarray(
+                    SK.bin_centers()[entry.bin_lo: entry.bin_hi + 1]
+                    if entry.bin_lo is not None else np.zeros(0),
+                    jnp.float32,
+                ),
+            }
+            nbytes = sum(int(v.nbytes) for v in dev.values())
+            entry._dev = dev
+            entry._dev_nbytes = nbytes
+            self.ledger.alloc(nbytes)
+            return dev
+
+    # -- plan-time eligibility + serve-time views --------------------------
+
+    def plan(self, dataset: str, filters, func: str | None, step_ms: int,
+             window_ms: int, start_ms: int, end_ms: int,
+             offset_ms: int = 0, need_counter: bool | None = None):
+        """Plan-time substitution check: the most coarse registered rollup
+        whose resolution divides step AND window, with the query's period
+        range inside the entry's closed coverage. Returns the entry key or
+        None (the planner keeps the raw plan — bit-identical fallback)."""
+        if offset_ms or step_ms <= 0 or window_ms <= 0:
+            return None
+        if func is not None and func not in ROLLUP_FUNCS:
+            return None
+        # clamp to the last evaluated grid step: coverage is only needed up
+        # to start + floor((end-start)/step)*step, and the serve-time slice
+        # then yields exactly num_steps windows
+        end_ms = start_ms + ((end_ms - start_ms) // step_ms) * step_ms
+        fkey = filters_key(filters)
+        best = None
+        with self._lock:
+            for key, entry in self._entries.items():
+                if key[0] != dataset or key[1] != fkey:
+                    continue
+                res = entry.resolution_ms
+                if (step_ms % res or window_ms % res or window_ms < res
+                        or start_ms % res):
+                    continue
+                if self._eligible_locked(entry, func, window_ms, start_ms,
+                                         end_ms) is None:
+                    continue
+                if best is None or res > best[2]:
+                    best = key
+        if best is not None:
+            with self._lock:
+                e = self._entries.get(best)
+                if e is not None:
+                    e.last_hit_s = time.time()
+        return best
+
+    def _eligible_locked(self, entry: RollupEntry, func: str | None,
+                         window_ms: int, start_ms: int, end_ms: int):
+        """Runtime-shared eligibility: coverage + func/schema fit. Returns
+        the (p_lo, p_hi) period range or None."""
+        if entry.p0 is None or entry.watermark_p is None:
+            return None
+        res = entry.resolution_ms
+        p_lo = (start_ms - window_ms) // res
+        p_hi = end_ms // res
+        if p_lo < entry.p0 or p_hi > entry.watermark_p:
+            return None
+        if func in ROLLUP_COUNTER_FUNCS:
+            if not entry.is_counter or p_lo - 1 < entry.p0:
+                return None
+        return (p_lo, p_hi)
+
+    def serve_view(self, key: tuple, func: str | None, window_ms: int,
+                   start_ms: int, end_ms: int, step_ms: int):
+        """Serve-time view for RollupServeExec: re-checks coverage against
+        the LIVE entry (it may have been rebuilt, retired, or its
+        watermark may no longer cover a moved live edge) and returns the
+        device arrays plus local period indexing, or None -> the exec
+        falls back to the raw path."""
+        end_ms = start_ms + ((end_ms - start_ms) // step_ms) * step_ms
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                return None
+            rng = self._eligible_locked(entry, func, window_ms, start_ms,
+                                        end_ms)
+            if rng is None:
+                return None
+            res = entry.resolution_ms
+            if step_ms % res or window_ms % res or start_ms % res:
+                return None
+            entry.serves += 1
+            entry.last_hit_s = time.time()
+        p_lo, p_hi = rng
+        # device arrays are NOT fetched here: the exec stages them under
+        # its "stage" phase span so upload cost lands in the decomposition
+        return {
+            "entry": entry,
+            "labels": list(entry.labels),
+            "resolution_ms": res,
+            "p_lo": p_lo,
+            "p_hi": p_hi,
+            "p0": entry.p0,
+            "alloc_p": entry.alloc_p,
+            "win_p": window_ms // res,
+            "step_p": step_ms // res,
+        }
+
+
+def _rollup_ledger_walker(manager: "RollupManager") -> int:
+    with manager._lock:
+        return sum(e._dev_nbytes for e in manager._entries.values())

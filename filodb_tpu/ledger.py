@@ -126,7 +126,7 @@ class DeviceLedger:
     the drift check (``verify``) behind ``/debug/resources``."""
 
     KINDS = ("staged_block", "superblock", "compile_cache",
-             "standing_state", "index_postings")
+             "standing_state", "index_postings", "rollup")
 
     def __init__(self):
         self._lock = threading.Lock()

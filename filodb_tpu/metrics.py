@@ -134,7 +134,7 @@ HELP_TEXTS: dict[str, str] = {
     "filodb_tenant_query_seconds": "Wall-clock query seconds per tenant.",
     "filodb_tenant_kernel_seconds": "Device kernel-dispatch seconds per tenant.",
     "filodb_tenant_bytes_staged": "Bytes staged to device per tenant.",
-    "filodb_device_bytes": "Live device bytes per ledger kind (staged_block|superblock|compile_cache|standing_state|index_postings).",
+    "filodb_device_bytes": "Live device bytes per ledger kind (staged_block|superblock|compile_cache|standing_state|index_postings|rollup).",
     "filodb_device_alloc": "Ledger debits (entries pinned) per kind.",
     "filodb_device_alloc_bytes": "Bytes debited to the device ledger per kind.",
     "filodb_device_free": "Ledger credits per kind and reason (evict|invalidate|replace|drop).",
@@ -173,6 +173,11 @@ HELP_TEXTS: dict[str, str] = {
     "filodb_index_postings_bytes": "Host posting-bitmap footprint of the part-key index, per shard.",
     "filodb_index_device_staged_bytes": "Posting bitmaps staged to device (HBM) by the index's opt-in hot tier, per shard.",
     "filodb_index_dictionary_size": "Distinct (label, value) dictionary entries in the part-key index, per shard.",
+    "filodb_rollup_entries": "Registered rollup entries (selector x resolution summary blocks) per dataset.",
+    "filodb_rollup_maintenance": "Rollup maintainer outcomes (add|build|fold|rebuild|retire|error).",
+    "filodb_rollup_serves": "Queries served from rollup blocks instead of raw samples, by kind (window|agg|hist_quantile).",
+    "filodb_rollup_chooser": "Workload-chooser decisions (add|retire) over querylog fingerprints.",
+    "filodb_superblock_pinned_bytes": "Superblock cache bytes pinned by standing queries (skipped by eviction).",
 }
 
 
@@ -654,6 +659,7 @@ FUSED_FALLBACK_REASONS = frozenset({
     "partial_results", "dispatcher", "mixed_schemas", "hist_scheme",
     "hist_op", "hist_func", "hist_quantile_scalar", "mesh_unsupported",
     "grid_jitter", "grid_holes", "standing_nondecomposable",
+    "rollup_ineligible", "stage_span",
 })
 
 
@@ -669,6 +675,36 @@ def record_fused_fallback(reason: str) -> None:
     if reason not in FUSED_FALLBACK_REASONS:
         reason = "unknown"
     REGISTRY.counter("filodb_fused_fallback", reason=reason).inc()
+
+
+ROLLUP_EVENTS = frozenset({"add", "build", "fold", "rebuild", "retire",
+                           "error"})
+
+
+def record_rollup_event(event: str) -> None:
+    """Rollup-maintainer lifecycle accounting, exposed as
+    ``filodb_rollup_maintenance_total{event=...}`` (doc/perf.md "Sketch
+    rollup tier"). Same closed-taxonomy discipline as
+    :func:`record_fused_fallback` — an unknown event collapses to
+    ``unknown`` instead of minting an undashboarded series."""
+    if event not in ROLLUP_EVENTS:
+        event = "unknown"
+    REGISTRY.counter("filodb_rollup_maintenance", event=event).inc()
+
+
+def record_rollup_serve(kind: str) -> None:
+    """A query was served from rollup blocks (querylog ``path=rollup``),
+    by serve kind: ``window`` (per-series range function), ``agg`` (fused
+    aggregate over moments or merged sketches), ``hist_quantile``
+    (classic-histogram bucket fold from counter rollups)."""
+    REGISTRY.counter("filodb_rollup_serves", kind=kind).inc()
+
+
+def record_rollup_chooser(action: str) -> None:
+    """Workload-chooser decision: ``add`` (a repeatedly-seen long-range
+    fingerprint earned a rollup) or ``retire`` (an idle rollup was
+    dropped)."""
+    REGISTRY.counter("filodb_rollup_chooser", action=action).inc()
 
 
 def record_stage_insert_drop(reason: str) -> None:

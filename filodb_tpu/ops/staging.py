@@ -50,6 +50,14 @@ def pad_time(t: int) -> int:
 
 TS_PAD = np.int32(2**31 - 1)  # padded slots sort after every real timestamp
 
+# Widest selector span a staged block can represent exactly: ts offsets are
+# int32 ms from base_ms (the selector start), so anything wider wraps
+# negative and searchsorted over the no-longer-sorted vector silently
+# empties late windows. Consumers that window over staged offsets
+# (the fused superblock paths) must refuse wider selections up front;
+# ~24.8 days — long-range reads beyond it are the rollup tier's job.
+MAX_STAGE_SPAN_MS = 2**31 - 2
+
 
 def series_put(mesh):
     """``jax.device_put`` closure for a block placement: single-device when
@@ -1315,6 +1323,13 @@ class SuperblockCache:
         # per-key introspection sidecar for /debug/superblocks: created
         # time, hit count, last maintenance outcome (the PR-6 taxonomy)
         self._meta: dict = {}
+        # pinned keys -> owner set (standing queries): pinned entries are
+        # SKIPPED by put()'s eviction loop, so an ad-hoc eviction storm
+        # cannot churn a standing query's entry out from under its delta
+        # refresh (which would silently degrade every refresh to
+        # rebuild+suffix). Pins are identity, not storage — a key may be
+        # pinned before its entry is built, and unpinning never drops data.
+        self._pins: dict = {}
         self._lock = threading.Lock()
         self._flight = KeyedSingleFlight(
             max_keys=4 * max_entries, alive=lambda k: k in self._d
@@ -1386,6 +1401,7 @@ class SuperblockCache:
             self._meta.pop(key, None)
             if gone is not None:
                 self.ledger.free(gone[2], reason="drop")
+            self._publish_pinned_locked()
 
     def note(self, key, outcome: str) -> None:
         """Record the last maintenance outcome for an entry (the
@@ -1395,6 +1411,47 @@ class SuperblockCache:
             meta = self._meta.get(key)
             if meta is not None:
                 meta["last_outcome"] = outcome
+
+    def pin(self, key, owner) -> None:
+        """Pin ``key`` against eviction on behalf of ``owner`` (a standing
+        query id). Pinning a not-yet-built key is allowed — the pin takes
+        effect when put() stores it."""
+        with self._lock:
+            self._pins.setdefault(key, set()).add(owner)
+            self._publish_pinned_locked()
+
+    def unpin(self, key, owner) -> None:
+        with self._lock:
+            owners = self._pins.get(key)
+            if owners is not None:
+                owners.discard(owner)
+                if not owners:
+                    self._pins.pop(key, None)
+            self._publish_pinned_locked()
+
+    def unpin_owner(self, owner) -> None:
+        """Release every pin held by ``owner`` (standing-query
+        unregister)."""
+        with self._lock:
+            for key in [k for k, o in self._pins.items() if owner in o]:
+                self._pins[key].discard(owner)
+                if not self._pins[key]:
+                    self._pins.pop(key, None)
+            self._publish_pinned_locked()
+
+    def pinned_bytes(self) -> int:
+        with self._lock:
+            return self._pinned_bytes_locked()
+
+    def _pinned_bytes_locked(self) -> int:
+        return sum(v[2] for k, v in self._d.items() if k in self._pins)
+
+    def _publish_pinned_locked(self) -> None:
+        from ..metrics import REGISTRY
+
+        REGISTRY.gauge("filodb_superblock_pinned_bytes").set(
+            float(self._pinned_bytes_locked())
+        )
 
     def put(self, key, versions: tuple, value, nbytes: int) -> None:
         if nbytes > self.max_bytes:
@@ -1408,12 +1465,20 @@ class SuperblockCache:
                 len(self._d) >= self.max_entries
                 or used + nbytes > self.max_bytes
             ):
-                ek, ev = self._d.popitem(last=False)
+                # evict in LRU order but never a pinned entry; when only
+                # pinned entries remain, tolerate running over budget (the
+                # standing set is deliberately small and bounded by its own
+                # registration cap)
+                ek = next((k for k in self._d if k not in self._pins), None)
+                if ek is None:
+                    break
+                ev = self._d.pop(ek)
                 self._meta.pop(ek, None)
                 used -= ev[2]
                 self.ledger.free(ev[2], reason="evict")
             self._d[key] = (versions, value, nbytes)
             self.ledger.alloc(nbytes)
+            self._publish_pinned_locked()
             prev = self._meta.get(key)
             # sharded entries record their placement at put time (metadata
             # only — never touches device values): the sharding spec and
@@ -1434,10 +1499,11 @@ class SuperblockCache:
         outcome, and the entry's scan accounting when it carries any)."""
         now = time.time()
         with self._lock:
-            items = [(k, v, dict(self._meta.get(k) or {}))
+            items = [(k, v, dict(self._meta.get(k) or {}),
+                      k in self._pins)
                      for k, v in self._d.items()]
         out = []
-        for key, (versions, value, nbytes), meta in items:
+        for key, (versions, value, nbytes), meta, pinned in items:
             entry = {
                 "key": repr(key),
                 "bytes": int(nbytes),
@@ -1447,6 +1513,7 @@ class SuperblockCache:
                 "versions": list(versions),
                 "sharding": meta.get("sharding"),
                 "device_bytes": meta.get("device_bytes"),
+                "pinned": bool(pinned),
             }
             block = getattr(value, "block", None)
             if block is not None:

@@ -1299,6 +1299,17 @@ class FusedAggregateExec(ExecPlan):
         discarded [ΣS, T, B] build would evict cache entries for nothing)."""
         from ...ops.hist_kernels import FUSED_HIST_FUNCS
 
+        if self.raw_end_ms - self.raw_start_ms > ST.MAX_STAGE_SPAN_MS:
+            # staged timestamps are int32 ms offsets from the selector start
+            # (ops/staging.py): a wider selection cannot be represented —
+            # offsets would wrap and searchsorted over the no-longer-sorted
+            # vector silently empties late windows. The reference tree
+            # windows over the same staged offsets, so falling back does
+            # NOT help; Planner.materialize time-slices such ranges before
+            # any exec is built, making this a defense-in-depth guard for
+            # plans assembled outside materialize. (Spans this wide are
+            # the rollup tier's job.)
+            return "stage_span"
         if is_hist:
             # hist kernel models only plain sum over the hist range funcs
             if self.op != "sum" or self.params:
@@ -1378,6 +1389,12 @@ class FusedAggregateExec(ExecPlan):
             self.raw_start_ms, self.raw_end_ms, self.column, key_mode,
             mesh_desc,
         )
+        # standing-query refresh contexts carry a pin sink: the maintainer
+        # pins the key it resolves to (by standing qid) so ad-hoc eviction
+        # storms can't churn the entry its delta refresh extends in place
+        pin_sink = getattr(ctx, "superblock_pin_sink", None)
+        if pin_sink is not None:
+            pin_sink(cache, sb_key)
         versions = tuple(
             ctx.memstore.shard(ctx.dataset, s).version for s in self.shard_nums
         )
@@ -2028,6 +2045,234 @@ class FusedAggregateExec(ExecPlan):
              else np.zeros((0, nsteps), np.float32))
         return QueryResult(grids=[
             Grid(out_labels, self.start_ms, self.step_ms, nsteps, v)
+        ])
+
+
+class RollupServeExec(ExecPlan):
+    """Serve a long-range query from rollup summary blocks instead of raw
+    samples (doc/perf.md "Sketch rollup tier"): the planner substituted
+    this node because the query's step and window are multiples of a
+    registered rollup's resolution, so every answer reads O(periods)
+    per-period summaries — min/max/sum/count moments, reset-corrected
+    counter lasts, and mergeable log-linear sketches — rather than
+    O(raw samples). Quantiles evaluate ON DEVICE from the sketch blocks
+    (merge-sketches -> rank-scan epilogue, psum-mergeable across a series
+    mesh via the same shard_map pattern as the fused histogram path);
+    ``histogram_quantile`` over classic bucket counters folds the [G, J]
+    per-``le`` rollup rates through the native interpolation kernel.
+
+    The serve is re-validated at RUNTIME against the live entry (the
+    maintainer may have rebuilt it, the chooser may have retired it, or
+    the watermark may no longer cover a moved live edge): any mismatch
+    delegates to ``fallback`` — the exact plan the planner would have
+    built without substitution — under the ``rollup_ineligible`` taxonomy
+    entry, so results never silently degrade. The querylog ``path`` field
+    records ``rollup`` on success."""
+
+    def __init__(self, rollups, rollup_key, filters, function,
+                 function_args, start_ms: int, end_ms: int, step_ms: int,
+                 window_ms: int, fallback, op=None, by=None, without=None,
+                 params=(), hist_quantile: float | None = None, mesh=None):
+        super().__init__()
+        self.rollups = rollups
+        self.rollup_key = rollup_key
+        self.filters = tuple(filters)
+        self.function = function
+        self.function_args = tuple(function_args or ())
+        self.start_ms = start_ms
+        self.end_ms = end_ms
+        self.step_ms = step_ms
+        self.window_ms = window_ms
+        self.op = op  # None = per-series range function (window kind)
+        self.by = by
+        self.without = without
+        self.params = tuple(params)
+        self.hist_quantile = hist_quantile
+        self.mesh = mesh
+        self._fallback_factory = fallback
+        self._fallback: ExecPlan | None = None
+
+    @property
+    def fallback(self) -> ExecPlan:
+        if self._fallback is None:
+            self._fallback = self._fallback_factory()
+        return self._fallback
+
+    def args_str(self) -> str:
+        fs = ",".join(f"{f.column}{f.op}{f.value}" for f in self.filters)
+        extra = f" op={self.op} by={self.by}" if self.op else ""
+        if self.hist_quantile is not None:
+            extra += f" hist_q={self.hist_quantile}"
+        return (
+            f"fn={self.function} window={self.window_ms} "
+            f"res={self.rollup_key[2]} filters=[{fs}]{extra}"
+        )
+
+    def num_steps(self) -> int:
+        return int((self.end_ms - self.start_ms) // self.step_ms) + 1
+
+    def _fall(self, ctx: QueryContext, reason: str) -> QueryResult:
+        from ...metrics import current_span, record_fused_fallback
+
+        s = current_span()
+        if s is not None:
+            s.tags["fused_fallback"] = reason
+        obs = getattr(ctx, "obs", None)
+        if obs is not None:
+            obs["path"] = "fallback"
+            obs["fallback"] = reason
+        record_fused_fallback(reason)
+        return self.fallback.execute(ctx)
+
+    def do_execute(self, ctx: QueryContext) -> QueryResult:
+        from ...metrics import record_rollup_serve, span
+        from ...ops import sketch as SKETCH
+
+        rollups = self.rollups
+        view = None
+        if rollups is not None:
+            view = rollups.serve_view(
+                self.rollup_key, self.function, self.window_ms,
+                self.start_ms, self.end_ms, self.step_ms,
+            )
+        if view is None:
+            # entry retired/rebuilt/behind the live edge since plan time:
+            # run the exact plan the planner would have built instead
+            return self._fall(ctx, "rollup_ineligible")
+        entry = view["entry"]
+        with span("rollup:stage", phase="stage"):
+            dev = rollups.device_arrays(entry)
+        S = entry.n_series
+        labels = view["labels"]
+        if S > ctx.max_series:
+            raise QueryError(
+                f"query selects {S} series > limit {ctx.max_series}"
+            )
+        p0, p_lo, p_hi = view["p0"], view["p_lo"], view["p_hi"]
+        win_p, step_p = view["win_p"], view["step_p"]
+        nsteps = self.num_steps()
+        window_s = self.window_ms / 1000.0
+        # the whole point: stats record O(periods) summary reads, never
+        # the raw sample count the fallback would have scanned
+        ctx.stats.bump(series_scanned=S,
+                       samples_scanned=S * max(p_hi - p_lo, 0))
+        obs = getattr(ctx, "obs", None)
+        if obs is not None:
+            obs["path"] = "rollup"
+            obs["rollup_resolution_ms"] = view["resolution_ms"]
+        if S == 0:
+            return QueryResult()
+        a = p_lo - 1 - p0  # moment-kernel slice start (one lead period)
+        n = p_hi - p_lo + 1
+        alloc_p = view["alloc_p"]
+        _IDENT = {"mn": np.inf, "mx": -np.inf, "sm": 0.0, "cnt": 0.0,
+                  "clast": 0.0}
+
+        def msl(name):
+            """[S, n] moment slice with index 0 = the lead period. Arrays
+            only cover the entry's data edge (alloc_p local periods);
+            closed-but-empty periods outside pad with the moment's IDENTITY
+            value (the windowed-count mask yields NaN for all-empty
+            windows), except ``clast`` which edge-pads so counter diffs
+            past the data edge read 0 increase, not a reset to baseline.
+            The left lead pad is never read by the window reduction
+            (counter shapes require a real lead at eligibility time)."""
+            arr = dev[name]
+            lo, hi = a, a + n
+            s = arr[:, max(lo, 0):min(hi, alloc_p)]
+            left, right = max(0, -lo), max(0, hi - alloc_p)
+            if not left and not right:
+                return s
+            parts = []
+            if left:
+                parts.append(jnp.full((arr.shape[0], left), _IDENT[name],
+                                      arr.dtype))
+            parts.append(s)
+            if right:
+                if name == "clast":
+                    parts.append(jnp.repeat(arr[:, -1:], right, axis=1))
+                else:
+                    parts.append(jnp.full((arr.shape[0], right),
+                                          _IDENT[name], arr.dtype))
+            return jnp.concatenate(parts, axis=1)
+
+        strip = (self.function is not None
+                 and self.function not in _DROP_NAME_KEEP)
+        if self.op is None:
+            # per-series range function
+            if self.function == "quantile_over_time":
+                q = float(self.function_args[0])
+                counts = dev["sketch"][:, p_lo - p0:min(p_hi - p0, alloc_p), :]
+                tail = (p_hi - p_lo) - counts.shape[1]
+                if tail > 0:  # implicitly-empty closed periods: zero counts
+                    counts = jnp.concatenate([
+                        counts,
+                        jnp.zeros((counts.shape[0], tail, counts.shape[2]),
+                                  counts.dtype),
+                    ], axis=1)
+                starts = jnp.arange(nsteps, dtype=jnp.int32) * step_p
+                with span("rollup:dispatch:sketch_quantile",
+                          phase="dispatch"):
+                    out = SKETCH.rollup_sketch_quantile(
+                        counts, dev["centers"], starts, q, win_p
+                    )
+            else:
+                with span(f"rollup:dispatch:{self.function}",
+                          phase="dispatch"):
+                    out = SKETCH.rollup_moment_range(
+                        self.function, msl("mn"), msl("mx"), msl("sm"),
+                        msl("cnt"), msl("clast"), win_p, step_p, window_s,
+                    )
+            record_rollup_serve("window")
+            out_labels = [_strip_metric(l) for l in labels] if strip else labels
+            return QueryResult(grids=[
+                Grid(out_labels, self.start_ms, self.step_ms, nsteps, out)
+            ])
+        gids_np, group_labels = AGG.group_ids_for(
+            labels,
+            list(self.by) if self.by else None,
+            list(self.without) if self.without else None,
+        )
+        G = max(len(group_labels), 1)
+        gids = jnp.asarray(gids_np)
+        if self.op == "quantile":
+            q = float(self.params[0])
+            mesh = self.mesh
+            if mesh is not None and (S == 0 or S % mesh.devices.size):
+                mesh = None  # series axis not mesh-divisible: solo dispatch
+            with span("rollup:dispatch:agg_sketch_quantile",
+                      phase="dispatch"):
+                out = SKETCH.rollup_agg_sketch_quantile(
+                    self.function, msl("mn"), msl("mx"), msl("sm"),
+                    msl("cnt"), msl("clast"), gids, q, G, win_p, step_p,
+                    window_s, mesh=mesh,
+                )
+            record_rollup_serve("agg")
+            return QueryResult(grids=[
+                Grid(group_labels, self.start_ms, self.step_ms, nsteps, out)
+            ])
+        with span(f"rollup:dispatch:{self.op}:{self.function}",
+                  phase="dispatch"):
+            out = SKETCH.rollup_moment_aggregate(
+                self.function, self.op, msl("mn"), msl("mx"), msl("sm"),
+                msl("cnt"), msl("clast"), gids, G, win_p, step_p, window_s,
+            )
+        if self.hist_quantile is not None:
+            # classic-bucket histogram_quantile: [G, J] per-``le`` rollup
+            # rates interpolate through the native path's kernel
+            from .transformers import classic_histogram_quantile
+
+            q_labels, q_vals = classic_histogram_quantile(
+                self.hist_quantile, group_labels, np.asarray(out)[:, :nsteps]
+            )
+            record_rollup_serve("hist_quantile")
+            return QueryResult(grids=[
+                Grid([_strip_metric(l) for l in q_labels], self.start_ms,
+                     self.step_ms, nsteps, q_vals)
+            ])
+        record_rollup_serve("agg")
+        return QueryResult(grids=[
+            Grid(group_labels, self.start_ms, self.step_ms, nsteps, out)
         ])
 
 

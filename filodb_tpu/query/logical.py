@@ -267,3 +267,26 @@ def shift_time(plan: LogicalPlan, delta_ms: int) -> LogicalPlan:
         elif isinstance(v, LogicalPlan):
             kw[f] = shift_time(v, delta_ms)
     return replace(plan, **kw) if kw else plan
+
+
+def narrow_time(plan: LogicalPlan, delta_start_ms: int,
+                delta_end_ms: int) -> LogicalPlan:
+    """Trim the evaluation range: ``start_ms += delta_start_ms`` and
+    ``end_ms += delta_end_ms`` at EVERY node. Derived ranges (raw
+    selectors, subquery inners) are the top-level range plus fixed
+    window/lookback/offset margins, so one uniform trim preserves every
+    per-node relationship. ``at_ms`` pins stay absolute. Used by the
+    planner's over-wide-range time slicing (staged ts offsets are int32
+    ms — ops/staging.MAX_STAGE_SPAN_MS)."""
+    if not isinstance(plan, LogicalPlan):
+        return plan
+    kw = {}
+    for f in plan.__dataclass_fields__:
+        v = getattr(plan, f)
+        if f == "start_ms" and isinstance(v, int):
+            kw[f] = v + delta_start_ms
+        elif f == "end_ms" and isinstance(v, int):
+            kw[f] = v + delta_end_ms
+        elif isinstance(v, LogicalPlan):
+            kw[f] = narrow_time(v, delta_start_ms, delta_end_ms)
+    return replace(plan, **kw) if kw else plan

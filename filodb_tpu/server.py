@@ -275,6 +275,39 @@ class FiloServer:
             from .standing import StandingEngine
 
             self.standing = StandingEngine(self.engine, scfg)
+        # sketch rollup tier (downsample/rollup.py): standing maintainer
+        # folds per-period summary blocks over the ingest path; the
+        # planner substitutes them for eligible long-range window queries
+        # (params.rollups below); the chooser trains the rollup set on
+        # the querylog. /debug/rollups is the admin surface.
+        rcfg = {**DEFAULTS["rollup"], **(cfg.get("rollup") or {})}
+        self.rollups = None
+        self.rollup_chooser = None
+        if rcfg.get("enabled", True):
+            from .downsample.chooser import RollupChooser
+            from .downsample.rollup import RollupManager
+
+            self.rollups = RollupManager(
+                self.memstore,
+                grace_ms=int(rcfg["grace_ms"]),
+                max_entries=int(rcfg["max_entries"]),
+                tick_s=float(rcfg["tick_s"]),
+            )
+            self.engine.planner.params.rollups = self.rollups
+            ccfg = {**DEFAULTS["rollup"]["chooser"],
+                    **(rcfg.get("chooser") or {})}
+            if ccfg.get("enabled", True):
+                self.rollup_chooser = RollupChooser(
+                    self.rollups,
+                    resolutions_ms=tuple(
+                        int(r) for r in ccfg["resolutions_ms"]
+                    ),
+                    min_count=int(ccfg["min_count"]),
+                    min_span_ms=int(ccfg["min_span_ms"]),
+                    idle_s=float(ccfg["idle_s"]),
+                    interval_s=float(ccfg["interval_s"]),
+                )
+                self.rollups.chooser = self.rollup_chooser
         self.profiler = None
         if cfg["profiler"]["enabled"]:
             from .metrics import SamplingProfiler
@@ -378,9 +411,14 @@ class FiloServer:
             ),
             standing=self.standing,
             standing_system=self.system_standing,
+            rollups=self.rollups,
         )
         if self.standing is not None:
             self.standing.start()
+        if self.rollups is not None:
+            self.rollups.start()
+        if self.rollup_chooser is not None:
+            self.rollup_chooser.start()
         if self.system_standing is not None:
             # register + start the SLO maintainer AFTER the HTTP edge is
             # up: rules evaluate from live-traffic metrics the edge emits
@@ -458,6 +496,10 @@ class FiloServer:
 
     def stop(self):
         self._stop.set()
+        if self.rollup_chooser is not None:
+            self.rollup_chooser.stop()
+        if self.rollups is not None:
+            self.rollups.stop()
         if self.standing is not None:
             self.standing.stop()
         if self.system_standing is not None:

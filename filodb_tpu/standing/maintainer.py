@@ -106,6 +106,10 @@ class StandingEngine:
         self.hub = hub or SubscriptionHub(int(cfg["max_subscribers"]))
         self.align_ms = int(cfg["align_ms"])
         self.debounce_s = float(cfg["refresh_debounce_ms"]) / 1e3
+        # qid -> {(cache, sb_key)} pinned against eviction for that
+        # standing query; reconciled after each dispatch so a rolled
+        # aligned range does not leave its predecessor pinned forever
+        self._sb_pins: dict[str, set] = {}
         self._stop = threading.Event()
         self._wake = threading.Event()
         self._thread: threading.Thread | None = None
@@ -162,6 +166,10 @@ class StandingEngine:
         sq = self.registry.remove(qid)
         if sq is None:
             return None
+        self._sb_pins.pop(qid, None)
+        cache = getattr(self.engine.memstore, "_superblock_cache", None)
+        if cache is not None:
+            cache.unpin_owner(qid)  # release superblock eviction pins
         self.hub.close(qid)
         if sq.source == "promoted":
             self.registry.note_demoted(sq.key, reason)
@@ -206,7 +214,7 @@ class StandingEngine:
         return (ex.raw_start_ms - ex.raw_start_ms % a,
                 ex.raw_end_ms - ex.raw_end_ms % a + 2 * a)
 
-    def _execute(self, ex):
+    def _execute(self, ex, owner: str | None = None):
         """Run one (suffix or full) dispatch on the engine's context —
         admission is bypassed (standing work is the server's own standing
         obligation), attribution is not (caller meters the tenant)."""
@@ -214,11 +222,29 @@ class StandingEngine:
 
         ctx = self.engine.context()
         ctx.standing_refresh = True  # keep maintainer dispatches out of the ring
+        pinned: list = []
+        if owner is not None:
+            # pin whatever superblock key(s) the dispatch resolves to
+            # against ad-hoc eviction; stale pins (rolled aligned range)
+            # are released below, the rest on unregister
+            def _pin(cache, key, _o=owner, _l=pinned):
+                cache.pin(key, _o)
+                _l.append((cache, key))
+
+            ctx.superblock_pin_sink = _pin
         # phase capture for the refresh's querylog record: the maintainer
         # calls the exec tree outside the HTTP/engine entry points, so it
         # attaches the recorder itself (stage/dispatch decompose as usual)
         ctx.phases = PhaseRecorder()
-        return ctx, ex.execute(ctx)
+        res = ex.execute(ctx)
+        if owner is not None and pinned:
+            # reconcile: new pins are already held, so dropping the ones
+            # this dispatch did NOT touch never leaves a gap
+            new = set(pinned)
+            for cache, key in self._sb_pins.get(owner, set()) - new:
+                cache.unpin(key, owner)
+            self._sb_pins[owner] = new
+        return ctx, res
 
     def refresh(self, sq: StandingQuery, now_ms: int | None = None,
                 force_full: bool = False) -> bytes | None:
@@ -413,7 +439,7 @@ class StandingEngine:
                     ex_d, k0 = ex, 0
             else:
                 ex_d = ex
-            ctx, res = self._execute(ex_d)
+            ctx, res = self._execute(ex_d, owner=sq.qid)
             fresh, fresh_labels = self._grid_arrays(res, J - k0)
             if k0 > 0 and sq.labels != fresh_labels:
                 # the group set changed (restage with new/removed series
@@ -422,7 +448,7 @@ class StandingEngine:
                 # discarded suffix dispatch's resources still attribute:
                 # its stats merge into the context the caller meters.
                 prev = ctx
-                ctx, res = self._execute(ex)
+                ctx, res = self._execute(ex, owner=sq.qid)
                 ctx.stats.merge(prev.stats)
                 fresh, fresh_labels = self._grid_arrays(res, J)
                 k0 = 0
@@ -480,7 +506,7 @@ class StandingEngine:
             record_fused_fallback("standing_nondecomposable")
         ex, _plan, _tenant = self._materialize(sq.promql, start, end,
                                                sq.step_ms)
-        ctx, res = self._execute(ex)
+        ctx, res = self._execute(ex, owner=sq.qid)
         from ..api import promjson as PJ
 
         data = PJ.render_matrix(res)

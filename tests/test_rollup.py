@@ -1,0 +1,445 @@
+"""Sketch rollup tier end-to-end (doc/perf.md "Sketch rollup tier"):
+planner substitution, parity, fallback, chooser, pinning, debug surfaces.
+
+The contract under test, per ISSUE 16:
+
+- **substitution**: eligible long-range window/aggregate queries serve
+  from per-period summary blocks and record querylog ``path=rollup``;
+- **parity**: moment functions and reset-corrected counter rate/increase
+  are EXACT against a numpy oracle over the rollup's period-mapped
+  windows (``[t-w, t)`` period coverage); ``quantile_over_time`` lands
+  within the sketch's ``2^(1/32)-1`` bin bound of the sample-rank
+  bracket;
+- **fallback**: plan-time ineligible shapes (offset, unaligned start,
+  non-multiple window) AND runtime invalidation (entry retired between
+  plan and execute) produce BIT-IDENTICAL results to the raw path, the
+  latter under the ``rollup_ineligible`` fallback taxonomy entry;
+- **chooser**: a repeated long-range fingerprint in the query log gets a
+  rollup added; an idle chooser-origin rollup gets retired;
+- **pinning**: a standing query's superblock survives an ad-hoc eviction
+  storm (satellite of the same PR: `filodb_superblock_pinned_bytes`).
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from filodb_tpu.coordinator.planner import PlannerParams, QueryEngine
+from filodb_tpu.core.records import SeriesBatch
+from filodb_tpu.core.schemas import (
+    GAUGE, METRIC_TAG, PROM_COUNTER, Dataset, shard_for,
+)
+from filodb_tpu.downsample.chooser import RollupChooser
+from filodb_tpu.downsample.rollup import RollupManager
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.metrics import REGISTRY
+from filodb_tpu.obs.querylog import QUERY_LOG
+from filodb_tpu.query import logical as L
+from filodb_tpu.query.promql import query_range_to_logical_plan
+
+pytestmark = pytest.mark.rollup
+
+BASE = 1_600_000_000_000
+RES = 60_000          # 1m rollup resolution under test
+IVL = 10_000          # scrape interval: 6 samples per period
+SPP = RES // IVL
+P = 182               # ingested periods per series
+T = P * SPP
+ALIGN0 = BASE + (RES - BASE % RES)  # BASE itself is NOT minute-aligned
+N_SHARDS = 4
+S_G, S_C = 6, 4
+BOUND = 2.0 ** (1.0 / 32.0) - 1.0
+
+# grid: window == step == resolution, two lead periods (rate needs the
+# period BEFORE the first window) -> output step j covers period 1+j
+START_MS = ALIGN0 + 2 * RES
+END_MS = ALIGN0 + 180 * RES
+J = (END_MS - START_MS) // RES + 1
+
+
+def _corrected(v):
+    """Host mirror of the manager's reset correction: cumulative base of
+    pre-reset values added back onto the raw counter."""
+    prev = np.concatenate([[v[0]], v[:-1]])
+    return v + np.cumsum(np.where(v < prev, prev, 0.0))
+
+
+@pytest.fixture(scope="module")
+def stack():
+    """One ingested memstore + built 1m rollups + both engines, shared by
+    every parity/fallback test in the module (ingest dominates runtime)."""
+    rng = np.random.default_rng(99)
+    ts = ALIGN0 + np.arange(T, dtype=np.int64) * IVL
+    ms = TimeSeriesMemStore()
+    ms.setup(Dataset("ds"), list(range(N_SHARDS)))
+    gvals = 100.0 * np.exp(0.3 * rng.standard_normal((S_G, T)))
+    for i in range(S_G):
+        tags = {METRIC_TAG: "mem_used", "_ws_": "w", "_ns_": "n",
+                "instance": f"host-{i}"}
+        ms.shard("ds", shard_for(tags, spread=3, num_shards=N_SHARDS)
+                 ).ingest_series(SeriesBatch(GAUGE, tags, ts, {"value": gvals[i]}))
+    cvals = np.cumsum(rng.uniform(0, 10, (S_C, T)), axis=1)
+    cvals[:, 400:] -= cvals[:, [400]] - 1.0  # a mid-stream counter reset
+    for i in range(S_C):
+        tags = {METRIC_TAG: "req_total", "_ws_": "w", "_ns_": "n",
+                "instance": f"host-{i}"}
+        ms.shard("ds", shard_for(tags, spread=3, num_shards=N_SHARDS)
+                 ).ingest_series(
+            SeriesBatch(PROM_COUNTER, tags, ts, {"count": cvals[i]}))
+    rollups = RollupManager(ms)
+    for metric in ("mem_used", "req_total"):
+        plan = query_range_to_logical_plan(
+            f"sum_over_time({metric}[1m])" if metric == "mem_used"
+            else f"rate({metric}[1m])",
+            START_MS / 1e3, END_MS / 1e3, RES / 1e3)
+        rollups.ensure("ds", plan.raw.filters, RES, build=True)
+    eng_ru = QueryEngine(ms, "ds", PlannerParams(rollups=rollups))
+    eng_raw = QueryEngine(ms, "ds")
+    return ms, rollups, eng_ru, eng_raw, gvals, cvals
+
+
+def _run(eng, q, start_ms=START_MS, end_ms=END_MS, step_ms=RES):
+    res = eng.query_range(q, start_ms / 1e3, end_ms / 1e3, step_ms / 1e3)
+    return res, QUERY_LOG.entries(1)[0].get("path")
+
+
+def _by_instance(grid):
+    """values [S, J] reordered by the numeric instance suffix."""
+    vals = np.asarray(grid.values_np(), dtype=np.float64)
+    order = np.argsort([int(l["instance"].split("-")[1]) for l in grid.labels])
+    return vals[order]
+
+
+# -- substitution + parity ---------------------------------------------------
+
+
+def test_moment_functions_exact_vs_period_oracle(stack):
+    """sum/avg/min/max_over_time from moments == numpy over the SAME
+    period-mapped windows (window j covers exactly period 1+j): moments
+    are exact per-period sums, so only f32 staging noise remains."""
+    _ms, _ru, eng_ru, _raw, gvals, _c = stack
+    hours = gvals.reshape(S_G, P, SPP)[:, 1:1 + J]
+    oracles = {
+        "sum_over_time": hours.sum(-1),
+        "avg_over_time": hours.mean(-1),
+        "min_over_time": hours.min(-1),
+        "max_over_time": hours.max(-1),
+    }
+    for func, want in oracles.items():
+        res, path = _run(eng_ru, f"{func}(mem_used[1m])")
+        assert path == "rollup", func
+        got = _by_instance(res.grids[0])
+        assert got.shape == want.shape, func
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-3,
+                                   err_msg=func)
+
+
+def test_counter_rate_and_increase_reset_corrected(stack):
+    """rate/increase from the per-period corrected cumulative-last equals
+    the host reset-correction mirror exactly: increase over window j is
+    clast[period 1+j] - clast[period j] (the lead period's last)."""
+    _ms, _ru, eng_ru, _raw, _g, cvals = stack
+    clast = np.stack([_corrected(v) for v in cvals]).reshape(
+        S_C, P, SPP)[:, :, -1]
+    want_inc = clast[:, 1:1 + J] - clast[:, 0:J]
+    for q, want in (("increase(req_total[1m])", want_inc),
+                    ("rate(req_total[1m])", want_inc / (RES / 1e3))):
+        res, path = _run(eng_ru, q)
+        assert path == "rollup", q
+        got = _by_instance(res.grids[0])
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4,
+                                   err_msg=q)
+
+
+def test_quantile_over_time_within_sketch_bound(stack):
+    """The sketch read-off lands within the documented relative-error
+    bound of the numpy sample-rank bracket over the period windows."""
+    _ms, _ru, eng_ru, _raw, gvals, _c = stack
+    res, path = _run(eng_ru, "quantile_over_time(0.9, mem_used[1m])")
+    assert path == "rollup"
+    got = _by_instance(res.grids[0])
+    hours = gvals.reshape(S_G, P, SPP)[:, 1:1 + J]
+    lo = np.quantile(hours, 0.9, axis=-1, method="lower")
+    hi = np.quantile(hours, 0.9, axis=-1, method="higher")
+    assert got.shape == lo.shape
+    assert np.all(got >= lo * (1 - BOUND) - 1e-9)
+    assert np.all(got <= hi * (1 + BOUND) + 1e-9)
+
+
+def test_aggregate_over_rollup_path_and_parity(stack):
+    """sum(sum_over_time(...)) dispatches the fused rollup aggregate
+    (path=rollup) and equals the numpy oracle's cross-series sum."""
+    _ms, _ru, eng_ru, _raw, gvals, _c = stack
+    res, path = _run(eng_ru, "sum(sum_over_time(mem_used[1m]))")
+    assert path == "rollup"
+    got = np.asarray(res.grids[0].values_np(), dtype=np.float64)[0]
+    want = gvals.reshape(S_G, P, SPP)[:, 1:1 + J].sum(-1).sum(0)
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+def test_raw_engine_never_takes_rollup_path(stack):
+    _ms, _ru, _eng_ru, eng_raw, _g, _c = stack
+    for q in ("avg_over_time(mem_used[1m])", "rate(req_total[1m])"):
+        _res, path = _run(eng_raw, q)
+        assert path != "rollup"
+
+
+# -- fallback ----------------------------------------------------------------
+
+
+def _grid_bytes(res):
+    out = []
+    for g in res.grids:
+        order = np.argsort([json.dumps(l, sort_keys=True) for l in g.labels])
+        vals = np.asarray(g.values_np())[order]
+        out.append((tuple(json.dumps(g.labels[i], sort_keys=True)
+                          for i in order),
+                    g.start_ms, g.step_ms, vals.tobytes()))
+    return out
+
+
+@pytest.mark.parametrize("q, start_ms", [
+    # offset -> plan-time ineligible
+    ("avg_over_time(mem_used[1m] offset 1m)", START_MS),
+    # window not a multiple of the 1m resolution
+    ("avg_over_time(mem_used[90s])", START_MS),
+    # unaligned grid start
+    ("avg_over_time(mem_used[1m])", START_MS + 7_000),
+])
+def test_plan_time_fallback_bit_identical(stack, q, start_ms):
+    """Ineligible shapes must not merely be 'close': the rollup-enabled
+    engine builds the EXACT raw plan, so results are byte-equal."""
+    _ms, _ru, eng_ru, eng_raw, _g, _c = stack
+    res_ru, path = _run(eng_ru, q, start_ms=start_ms)
+    res_raw, _ = _run(eng_raw, q, start_ms=start_ms)
+    assert path != "rollup", q
+    assert _grid_bytes(res_ru) == _grid_bytes(res_raw), q
+
+
+def test_runtime_fallback_bit_identical_and_counted(stack):
+    """Entry retired BETWEEN plan and execute: RollupServeExec delegates
+    to its fallback under ``rollup_ineligible`` and the result is
+    bitwise-equal to the raw plan's."""
+    from filodb_tpu.query.exec.plans import RollupServeExec
+
+    _ms, rollups, eng_ru, eng_raw, _g, _c = stack
+    q = "max_over_time(mem_used[1m])"
+    plan = query_range_to_logical_plan(
+        q, START_MS / 1e3, END_MS / 1e3, RES / 1e3)
+    ex = eng_ru.planner.materialize(plan)
+    assert isinstance(ex, RollupServeExec)
+    filters = plan.raw.filters
+    entry = rollups.ensure("ds", filters, RES)  # idempotent handle
+    assert rollups.retire("ds", filters, RES)
+    ctr = REGISTRY.counter("filodb_fused_fallback", reason="rollup_ineligible")
+    before = ctr.value
+    try:
+        res_fb = ex.execute(eng_ru.context())
+        assert ctr.value == before + 1
+        res_raw = eng_raw.planner.materialize(plan).execute(eng_raw.context())
+        assert _grid_bytes(res_fb) == _grid_bytes(res_raw)
+    finally:
+        # restore the module fixture's entry for later tests
+        rollups.ensure("ds", filters, RES, origin=entry.origin, build=True)
+
+
+# -- chooser -----------------------------------------------------------------
+
+
+def test_chooser_adds_then_retires_idle_rollup(stack):
+    """A fingerprint repeated >= min_count times over >= min_span_ms gets
+    a rollup at the coarsest dividing resolution; once idle past idle_s
+    the chooser-origin entry is retired again."""
+    ms, _ru, _eng_ru, eng_raw, _g, _c = stack
+    mgr = RollupManager(ms)
+    chooser = RollupChooser(
+        mgr, resolutions_ms=(RES,), min_count=3,
+        min_span_ms=3_600_000, idle_s=600.0,
+    )
+    QUERY_LOG.clear()
+    q = "quantile_over_time(0.95, mem_used[1m])"
+    for _ in range(3):
+        _run(eng_raw, q)
+    filters = query_range_to_logical_plan(
+        q, START_MS / 1e3, END_MS / 1e3, RES / 1e3).raw.filters
+    assert not mgr.has("ds", filters, RES)
+    added = chooser.tick()
+    assert any(d.get("action") == "add" for d in added)
+    assert mgr.has("ds", filters, RES)
+    # idle past idle_s with no further hits -> retired (created_s /
+    # last_hit_s are wall-clock, so advance from real time)
+    import time as _time
+
+    QUERY_LOG.clear()
+    retired = chooser.tick(now_s=_time.time() + 601.0)
+    assert any(d.get("action") == "retire" for d in retired)
+    assert not mgr.has("ds", filters, RES)
+
+
+# -- superblock pinning (satellite) ------------------------------------------
+
+
+def test_superblock_cache_pin_survives_eviction(stack):
+    from filodb_tpu.ops.staging import SuperblockCache
+
+    cache = SuperblockCache(max_entries=2)
+    gauge = REGISTRY.gauge("filodb_superblock_pinned_bytes")
+    cache.put("k1", (1,), "v1", 100)
+    cache.pin("k1", "sq-1")
+    assert cache.pinned_bytes() == 100 and gauge.value == 100.0
+    for i in range(2, 6):  # eviction storm: k1 must be skipped
+        cache.put(f"k{i}", (1,), f"v{i}", 100)
+    assert cache.get("k1", (1,)) == "v1"
+    snap = {e["key"]: e["pinned"] for e in cache.snapshot()}
+    assert snap["'k1'"] is True and sum(snap.values()) == 1
+    # pinning an unbuilt key is identity, not storage
+    cache.pin("k-future", "sq-1")
+    assert cache.pinned_bytes() == 100
+    cache.unpin_owner("sq-1")
+    assert cache.pinned_bytes() == 0 and gauge.value == 0.0
+    cache.put("k9", (1,), "v9", 100)   # evicts the LRU survivor first,
+    cache.put("k10", (1,), "v10", 100)  # then k1 once it reaches LRU
+    assert cache.get("k1", (1,)) is None  # unpinned -> evictable again
+
+
+def test_standing_query_pin_survives_adhoc_storm(stack):
+    """Full stack: a registered standing query pins its superblock; an
+    ad-hoc query storm over distinct ranges (distinct sb keys) cannot
+    evict it even from a 2-entry cache; unregister releases the pin."""
+    from filodb_tpu.ops.staging import SuperblockCache
+    from filodb_tpu.standing import StandingEngine
+
+    ms, _ru, _eng_ru, _raw, _g, _c = stack
+    eng = QueryEngine(ms, "ds")
+    old_cache = getattr(ms, "_superblock_cache", None)
+    ms._superblock_cache = cache = SuperblockCache(max_entries=2)
+    try:
+        se = StandingEngine(
+            eng, {"default_span_ms": 30 * RES},
+            clock=lambda: (END_MS + 5_000) / 1e3,
+        )
+        sq = se.register("sum by (instance) (rate(req_total[5m]))", RES)
+        se.refresh(sq)
+        pinned = [e for e in cache.snapshot() if e["pinned"]]
+        assert len(pinned) == 1
+        assert cache.pinned_bytes() > 0
+        pinned_key = pinned[0]["key"]
+        for k in range(1, 7):  # distinct windows -> distinct sb keys
+            eng.query_range(
+                f"sum(avg_over_time(mem_used[{k}m]))",
+                (START_MS + 30 * RES) / 1e3, END_MS / 1e3, RES / 1e3)
+        snap = {e["key"]: e["pinned"] for e in cache.snapshot()}
+        assert snap.get(pinned_key) is True, "standing superblock evicted"
+        se.refresh(sq)  # still serving after the storm
+        se.unregister(sq.qid)
+        assert cache.pinned_bytes() == 0
+        assert not any(e["pinned"] for e in cache.snapshot())
+    finally:
+        if old_cache is not None:
+            ms._superblock_cache = old_cache
+
+
+# -- debug surfaces ----------------------------------------------------------
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=30) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_debug_rollups_and_querylog_fingerprint_endpoints(stack):
+    from filodb_tpu.api.http import serve_background
+
+    _ms, rollups, eng_ru, _raw, _g, _c = stack
+    srv, port = serve_background(eng_ru, rollups=rollups)
+    try:
+        code, body = _get(f"http://127.0.0.1:{port}/debug/rollups")
+        assert code == 200 and body["status"] == "success"
+        assert body["data"]["count"] >= 2
+        assert any(e["resolution_ms"] == RES
+                   for e in body["data"]["entries"])
+        # fingerprint filter: two shapes in the log, filter keeps one
+        QUERY_LOG.clear()
+        _run(eng_ru, "avg_over_time(mem_used[1m])")
+        _run(eng_ru, "rate(req_total[1m])")
+        fp = QUERY_LOG.entries(1)[0]["fingerprint"]
+        code, body = _get(
+            f"http://127.0.0.1:{port}/debug/querylog?fingerprint={fp}")
+        assert code == 200
+        entries = body["data"]
+        assert entries and all(e["fingerprint"] == fp for e in entries)
+        code, _ = _get(f"http://127.0.0.1:{port}/debug/querylog")
+        assert code == 200
+    finally:
+        srv.shutdown()
+    srv2, port2 = serve_background(eng_ru)  # no rollup tier wired
+    try:
+        code, body = _get(f"http://127.0.0.1:{port2}/debug/rollups")
+        assert code == 404
+    finally:
+        srv2.shutdown()
+
+
+def test_wide_range_time_slicing_past_staged_span():
+    """A raw query whose selector span exceeds the staged int32 ms-offset
+    representation (ops/staging.MAX_STAGE_SPAN_MS, ~24.8 days) is
+    time-sliced by the planner into per-slice staged bases and stitched —
+    previously the wrapped offsets silently emptied every window past the
+    wrap point (NaN tail / corrupt values on tree and fused paths alike).
+    The rollup tier is the FAST path for these spans; this covers the
+    raw-serving correctness floor it falls back on."""
+    from filodb_tpu.memstore.shard import StoreConfig
+    from filodb_tpu.ops import staging as ST
+    from filodb_tpu.query.exec.plans import StitchRvsExec
+
+    DAYS = 30
+    W_RES = 3_600_000  # 1h windows on a 6h step grid: 120 output steps
+    W_IVL = 60_000
+    WT = DAYS * 24 * 60
+    align0 = BASE + (W_RES - BASE % W_RES)
+    ts = align0 + np.arange(WT, dtype=np.int64) * W_IVL
+    rng = np.random.default_rng(11)
+    g = 100.0 * np.exp(0.4 * rng.standard_normal(WT))
+    ms = TimeSeriesMemStore(StoreConfig(max_chunk_size=WT))
+    ms.setup(Dataset("wide"), [0])
+    ms.shard("wide", 0).ingest_series(SeriesBatch(
+        GAUGE, {METRIC_TAG: "disk_usage", "instance": "h0"}, ts,
+        {"value": g}))
+    eng = QueryEngine(ms, "wide", PlannerParams())
+    STEP = 6 * W_RES
+    start_s = (align0 + 2 * W_RES) / 1e3
+    end_s = (align0 + DAYS * 24 * W_RES) / 1e3
+
+    # the plan itself is a stitch of >=2 in-representation slices
+    plan = query_range_to_logical_plan(
+        "sum(avg_over_time(disk_usage[1h]))", start_s, end_s, STEP / 1e3)
+    exec_plan = eng.planner.materialize(plan)
+    assert isinstance(exec_plan, StitchRvsExec)
+    assert len(exec_plan.children()) >= 2
+
+    # window (t-1h, t] oracle at every step, INCLUDING past the old int32
+    # wrap point (offset 2^31 ms ~ hour 596)
+    nsteps = int((end_s - start_s) * 1e3 // STEP) + 1
+    want = np.empty(nsteps)
+    for j in range(nsteps):
+        k = (2 * W_RES + j * STEP) // W_IVL
+        want[j] = np.mean(g[k - 59:k + 1])
+    for q in ("avg_over_time(disk_usage[1h])",
+              "sum(avg_over_time(disk_usage[1h]))"):
+        res = eng.query_range(q, start_s, end_s, STEP / 1e3)
+        v = np.asarray(res.grids[0].values_np(), dtype=np.float64)[0]
+        assert v.shape == (nsteps,)
+        assert not np.isnan(v).any()
+        np.testing.assert_allclose(v, want, rtol=1e-5)
+
+    # an in-representation range must NOT stitch (no behavior change)
+    narrow = query_range_to_logical_plan(
+        "sum(avg_over_time(disk_usage[1h]))", start_s,
+        (align0 + 20 * 24 * W_RES) / 1e3, STEP / 1e3)
+    assert not isinstance(eng.planner.materialize(narrow), StitchRvsExec)
+    assert ST.MAX_STAGE_SPAN_MS == 2**31 - 2

@@ -1,0 +1,185 @@
+"""Log-linear sketch property tests (ops/sketch.py; doc/perf.md "Sketch
+rollup tier").
+
+The rollup tier's quantile guarantee rests on two properties, both
+verified here against numpy oracles rather than golden values:
+
+- **bin bound**: every finite value bins to a center within relative
+  error ``2^(1/SUB) - 1`` (SUB=32 -> ~2.2%), with negatives mirrored,
+  NaN excluded, and sub-``2^-24`` magnitudes collapsed to the exact-zero
+  bin;
+- **mergeability**: sketches merge by ADDITION, so the psum merge across
+  a device mesh must read off bit-identically to the single-device
+  host-order sum over the same inputs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from filodb_tpu.config import force_virtual_devices
+
+force_virtual_devices(8)
+
+import filodb_tpu.ops.sketch as SK  # noqa: E402
+
+pytestmark = pytest.mark.rollup
+
+BOUND = 2.0 ** (1.0 / SK.SUB) - 1.0  # the documented relative error bound
+TINY = 2.0 ** SK.E_MIN  # magnitudes below this collapse to the zero bin
+
+
+MAX_MAG = 2.0 ** (SK.E_MIN + SK.HALF / SK.SUB - 1)  # top representable octave
+
+
+def _mixed_values(rng, n):
+    """Adversarial value mix: lognormal positives across many octaves
+    (clamped into the sketch's representable magnitude range — beyond it
+    values saturate to the top bin by design), mirrored negatives, exact
+    zeros, subnormal-scale magnitudes, and a clump of identical values
+    (rank ties)."""
+    mag = np.minimum(np.exp(rng.normal(0, 8, 2 * n)), MAX_MAG)
+    v = np.concatenate([
+        mag[:n],
+        -mag[n:],
+        np.zeros(n // 4),
+        rng.uniform(-1, 1, n // 4) * TINY / 2,  # subnormal collapse
+        np.full(n // 4, 42.0),
+    ])
+    rng.shuffle(v)
+    return v
+
+
+def test_bin_roundtrip_within_bound():
+    rng = np.random.default_rng(0)
+    v = _mixed_values(rng, 4000)
+    bins = SK.bin_of_np(v)
+    centers = SK.bin_centers()
+    assert bins.min() >= 0 and bins.max() < SK.B
+    small = np.abs(v) < TINY
+    assert np.all(bins[small] == SK.ZERO_BIN)
+    assert np.all(centers[bins[small]] == 0.0)
+    big = ~small
+    est = centers[bins[big]]
+    assert np.all(np.sign(est) == np.sign(v[big]))
+    rel = np.abs(est - v[big]) / np.abs(v[big])
+    assert rel.max() <= BOUND + 1e-12, rel.max()
+
+
+def test_bin_of_np_nan_and_device_parity():
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(1)
+    v = _mixed_values(rng, 1000)
+    v[::97] = np.nan
+    host = SK.bin_of_np(v)
+    dev = np.asarray(SK._bin_of(jnp.asarray(v)))
+    assert np.all(host[np.isnan(v)] == -1)
+    assert np.array_equal(host, dev.astype(np.int64))
+
+
+def _host_sketch(values_2d):
+    """[G, W] samples -> [G, B] counts via the host binning path."""
+    G = values_2d.shape[0]
+    counts = np.zeros((G, SK.B), np.float64)
+    bins = SK.bin_of_np(values_2d)
+    for g in range(G):
+        b = bins[g][bins[g] >= 0]
+        np.add.at(counts[g], b, 1.0)
+    return counts
+
+
+@pytest.mark.parametrize("q", [0.0, 0.1, 0.5, 0.9, 0.99, 1.0])
+def test_sketch_quantile_vs_numpy_oracle(q):
+    """Read-off quantile lands within the bin bound of the sample-rank
+    bracket (numpy ``lower``/``higher`` methods) — negatives, zeros and
+    subnormal-collapsed values included. The bracket absorbs the one-rank
+    ambiguity between interpolation conventions; the multiplicative bound
+    is the sketch's, plus a tiny absolute epsilon for the zero bin."""
+    rng = np.random.default_rng(int(q * 100) + 2)
+    G, W = 16, 257
+    vals = _mixed_values(rng, (G * W) // 2 + G)[: G * W].reshape(G, W)
+    counts = _host_sketch(vals)
+    est = SK.sketch_quantile(counts[:, None, :], q)[:, 0]
+    lo = np.quantile(vals, q, axis=1, method="lower")
+    hi = np.quantile(vals, q, axis=1, method="higher")
+    lo_b = np.minimum(lo * (1 - BOUND), lo * (1 + BOUND)) - TINY
+    hi_b = np.maximum(hi * (1 - BOUND), hi * (1 + BOUND)) + TINY
+    assert np.all(est >= lo_b - 1e-12), (est - lo_b).min()
+    assert np.all(est <= hi_b + 1e-12), (hi_b - est).min()
+
+
+def test_rollup_sketch_quantile_windows_match_host():
+    """The device windowed read-off (cumsum-gather over periods, compacted
+    bin axis) equals the host merge+read-off over the same periods."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(3)
+    S, P = 6, 20
+    win_p, step_p, J = 4, 2, 8
+    vals = [
+        [_mixed_values(rng, 16)[:23] for _ in range(P)] for _ in range(S)
+    ]
+    counts = np.zeros((S, P, SK.B), np.float32)
+    for s in range(S):
+        for p in range(P):
+            b = SK.bin_of_np(vals[s][p])
+            np.add.at(counts[s, p], b[b >= 0], 1.0)
+    pop = np.nonzero(counts.sum((0, 1)) > 0)[0]
+    lo_bin, hi_bin = int(pop.min()), int(pop.max()) + 1
+    compact = counts[:, :, lo_bin:hi_bin]
+    centers = SK.bin_centers()[lo_bin:hi_bin]
+    starts = np.arange(J, dtype=np.int32) * step_p
+    dev = np.asarray(SK.rollup_sketch_quantile(
+        jnp.asarray(compact), jnp.asarray(centers, jnp.float32),
+        jnp.asarray(starts), 0.9, win_p,
+    ))
+    merged = np.stack(
+        [compact[:, s0:s0 + win_p].sum(1) for s0 in starts], axis=1
+    )  # [S, J, Bc]
+    host = np.where(
+        merged.sum(-1) > 0,
+        centers[np.minimum(
+            (np.cumsum(merged, -1)
+             < 0.9 * merged.sum(-1, keepdims=True)).sum(-1),
+            len(centers) - 1,
+        )],
+        np.nan,
+    )
+    assert np.array_equal(dev, host.astype(np.float32), equal_nan=True)
+
+
+def test_psum_merge_equals_host_add():
+    """rollup_agg_sketch_quantile under the forced 8-device CPU mesh ==
+    the same program with mesh=None: sketch counts are small integers in
+    f32, so psum order cannot lose precision and the read-off must be
+    BIT-identical."""
+    import jax.numpy as jnp
+
+    from filodb_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh()
+    if mesh is None or mesh.devices.size != 8:
+        pytest.skip("8-device virtual mesh unavailable")
+    rng = np.random.default_rng(4)
+    S, Pw, J = 16, 11, 4  # [S, Pw+1]-shaped inputs, win_p=2, step_p=2
+    win_p, step_p = 2, 2
+    cols = 1 + (J - 1) * step_p + win_p
+    assert cols <= Pw + 1
+    sm = rng.uniform(-100, 100, (S, Pw + 1))
+    cnt = rng.integers(0, 7, (S, Pw + 1)).astype(np.float64)
+    mn = sm / np.maximum(cnt, 1) - rng.uniform(0, 5, (S, Pw + 1))
+    mx = sm / np.maximum(cnt, 1) + rng.uniform(0, 5, (S, Pw + 1))
+    clast = np.cumsum(rng.uniform(0, 10, (S, Pw + 1)), axis=1)
+    gids = rng.integers(0, 3, S).astype(np.int32)
+    args = [jnp.asarray(a, jnp.float32) for a in (mn, mx, sm, cnt, clast)]
+    out_host = np.asarray(SK.rollup_agg_sketch_quantile(
+        "avg_over_time", *args, jnp.asarray(gids), 0.9, 3,
+        win_p, step_p, float(win_p * 60), mesh=None,
+    ))
+    out_mesh = np.asarray(SK.rollup_agg_sketch_quantile(
+        "avg_over_time", *args, jnp.asarray(gids), 0.9, 3,
+        win_p, step_p, float(win_p * 60), mesh=mesh,
+    ))
+    assert np.array_equal(out_host, out_mesh, equal_nan=True)

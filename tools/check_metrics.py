@@ -212,6 +212,75 @@ def standing_violations() -> list[str]:
     return out
 
 
+def rollup_violations() -> list[str]:
+    """Rollup-tier taxonomy lint (downsample/rollup.py): (a) every
+    ``filodb_rollup_*`` family emitted in code carries a HELP text in
+    metrics.HELP_TEXTS, and (b) the canonical maintenance-event set
+    (metrics.ROLLUP_EVENTS — the ``filodb_rollup_maintenance{event}``
+    label taxonomy) matches every literal event the code records via
+    ``record_rollup_event("...")`` — an unrecognized literal would be
+    minted as event="unknown", a canonical-but-unrecorded one is a dead
+    dashboard row. The ``rollup_ineligible`` fused-fallback reason is
+    covered by the shared three-way fused_reason lint above."""
+    out: list[str] = []
+    helped: set[str] = set()
+    canon: set[str] = set()
+    tree = ast.parse((PKG / "metrics.py").read_text())
+    for node in ast.walk(tree):
+        target = None
+        if isinstance(node, ast.Assign) and node.targets:
+            target = node.targets[0]
+        elif isinstance(node, ast.AnnAssign):
+            target = node.target
+        if target is None or not isinstance(target, ast.Name):
+            continue
+        if (target.id == "HELP_TEXTS" and node.value is not None
+                and isinstance(node.value, ast.Dict)):
+            for k in node.value.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    helped.add(k.value)
+        elif target.id == "ROLLUP_EVENTS" and node.value is not None:
+            for c in ast.walk(node.value):
+                if isinstance(c, ast.Constant) and isinstance(c.value, str):
+                    canon.add(c.value)
+    if not canon:
+        return ["rollup lint: ROLLUP_EVENTS not found in "
+                "filodb_tpu/metrics.py"]
+    code, where = code_stems()
+    for s in sorted(code):
+        if s.startswith("filodb_rollup") and s not in helped:
+            locs = ", ".join(where.get(s, [])[:2])
+            out.append(
+                f"rollup family {s}* emitted ({locs}) without a HELP "
+                f"text in metrics.HELP_TEXTS"
+            )
+    recorded: set[str] = set()
+    for path in sorted(PKG.rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        for node in ast.walk(ast.parse(path.read_text())):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = getattr(fn, "attr", None) or getattr(fn, "id", None)
+            if name == "record_rollup_event" and node.args:
+                a = node.args[0]
+                if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                    recorded.add(a.value)
+    for r in sorted(recorded - canon):
+        out.append(
+            f"rollup maintenance event {r!r} recorded in code but missing "
+            f"from metrics.ROLLUP_EVENTS (it would be minted as "
+            f"event=\"unknown\")"
+        )
+    for r in sorted(canon - recorded):
+        out.append(
+            f"rollup maintenance event {r!r} is canonical but no code "
+            f"records it — dead dashboard row"
+        )
+    return out
+
+
 OPS = PKG / "ops"
 
 
@@ -278,6 +347,7 @@ def main() -> int:
     doc = doc_stems()
     violations: list[str] = list(fused_reason_violations())
     violations.extend(standing_violations())
+    violations.extend(rollup_violations())
     violations.extend(jit_registration_violations())
     for s in sorted(code - doc):
         locs = ", ".join(where.get(s, [])[:2])
