@@ -2,7 +2,7 @@
 
 NATIVE_DIR := filodb_tpu/native
 
-.PHONY: all native test test-chaos test-index test-ingest-chaos test-jitter test-multichip test-observability test-rollup test-scheduler test-standing attest bench bench-smoke microbench serve clean tpu-watch tpu-watch-bg
+.PHONY: all native test test-chaos test-index test-ingest-chaos test-jitter test-multichip test-observability test-replica test-rollup test-scheduler test-standing attest bench bench-smoke microbench serve clean tpu-watch tpu-watch-bg
 
 all: native
 
@@ -94,6 +94,16 @@ test-index: native
 # virtual mesh, and superblock pinning under eviction storms
 test-rollup: native
 	python -m pytest tests/test_rollup.py tests/test_sketch_property.py -q -m rollup
+
+# replicated shard plane suite (doc/robustness.md "Replicated shard
+# plane"): replica placement invariants, ingest fan-out with per-replica
+# acks + lag watermarks, bit-equal failover to sibling replicas (control-
+# plane kill, stale-mapping endpoint failure, open breaker as a routing
+# signal), live rebalance with effect-log cutover proof + standing-query
+# handoff, and the chaos storm: kill a node under 16 concurrent clients
+# with partial results OFF and zero 5xx
+test-replica: native
+	python -m pytest tests/test_replica.py -q -m replica
 
 # observability suite (doc/observability.md): trace propagation + stitching,
 # slow-query log, query observatory (per-phase decomposition, query-log

@@ -32,7 +32,10 @@ N_SERIES = int(os.environ.get("FILODB_BENCH_SERIES", 100_000))
 # "standing_refresh" (registered standing query's delta-maintained
 # live-edge refresh vs the pre-standing cold dashboard poll of the same
 # sliding grid, both under live ingest — doc/operations.md "Standing
-# queries & recording rules"; value = cold_p50 / standing_p50)
+# queries & recording rules"; value = cold_p50 / standing_p50), or
+# "failover_storm" (16-client query storm over an RF=2 replica cluster
+# with one node killed mid-window — doc/robustness.md "Replicated shard
+# plane"; value = during-kill qps, match = zero failures + bit-equal)
 WORKLOAD = os.environ.get("FILODB_BENCH_WORKLOAD", "sum_rate")
 # the ONE metric name per workload — emitted by both the success and error
 # JSON paths, and matched against benchmarks/bench_smoke_floor.json entries
@@ -46,6 +49,7 @@ METRIC = {
     "index_regex": "index_regex_lookups_1000k",
     "query_hicard": "query_hicard_2000_of_8000_qps",
     "long_range_quantile": "long_range_quantile_30d_p50",
+    "failover_storm": "failover_storm_qps_2k",
 }.get(WORKLOAD, "sum_rate_100k_series_range_query_p50")
 # concurrent_qps: client thread count, per-mode measurement window, and the
 # batching window handed to the batched engine (the knob under test)
@@ -1452,7 +1456,128 @@ def run_benchmark_long_range_quantile():
     }))
 
 
+def run_benchmark_failover_storm():
+    """Replicated shard plane under a node kill (doc/robustness.md
+    "Replicated shard plane"): an RF=2 in-process replica cluster at
+    N_SERIES series, QPS_CLIENTS client threads looping the canonical
+    dashboard aggregation through the front coordinator's ReplicaRouter
+    (one shard-pinned gRPC leg per shard, siblings attached). Three
+    measured windows: ``before`` (both nodes up), ``during`` (one node
+    killed mid-window — in-flight legs re-pin to their sibling replica),
+    ``after`` (steady state on the survivor).
+
+    value = during-kill throughput (qps, HIGHER is better — the smoke
+    floor gates it via qps_floor_min); vs_baseline = during/before qps
+    ratio; phases_ms carries all three windows' qps + p50/p99. match =
+    ZERO failed queries across all windows with partial results OFF and
+    every result BIT-equal to the pre-kill baseline (per-shard legs keep
+    the merge tree invariant, so failover may not change a single bit)."""
+    import threading
+
+    from filodb_tpu.testkit import machine_metrics, replica_cluster
+
+    n_samples = 360  # 1h @ 10s; RF=2 doubles resident data
+    batch = machine_metrics(n_series=N_SERIES, n_samples=n_samples)
+    c = replica_cluster(batch=batch, n_shards=N_SHARDS)
+    promql = "sum(heap_usage0)"
+    q_start = BASE / 1000.0
+    q_end = (BASE + (n_samples - 1) * INTERVAL_MS) / 1000.0
+
+    def rows(res):
+        return sorted(
+            (tuple(sorted(l.items())), np.asarray(v).tobytes())
+            for g in res.grids for l, v in zip(g.labels, g.values_np())
+        )
+
+    try:
+        assert c.engine.planner.params.allow_partial_results is False
+        baseline = rows(c.engine.query_range(promql, q_start, q_end, STEP_S))
+        failures = [0]
+        mismatches = [0]
+
+        def measure(kill: str | None = None):
+            lat: list[list[float]] = [[] for _ in range(QPS_CLIENTS)]
+            gate = threading.Barrier(QPS_CLIENTS + 1)
+            stop_at = [0.0]
+
+            def client(i):
+                gate.wait()
+                while time.perf_counter() < stop_at[0]:
+                    t0 = time.perf_counter()
+                    try:
+                        res = c.engine.query_range(promql, q_start, q_end,
+                                                   STEP_S)
+                    except Exception:
+                        failures[0] += 1
+                        continue
+                    lat[i].append(time.perf_counter() - t0)
+                    if rows(res) != baseline:
+                        mismatches[0] += 1
+
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(QPS_CLIENTS)]
+            for t in threads:
+                t.start()
+            stop_at[0] = time.perf_counter() + QPS_DURATION_S
+            t_begin = time.perf_counter()
+            gate.wait()
+            if kill is not None:
+                # the kill lands mid-window, under in-flight queries
+                time.sleep(QPS_DURATION_S / 3.0)
+                c.kill(kill)
+            for t in threads:
+                t.join()
+            elapsed = time.perf_counter() - t_begin
+            flat = [x for l in lat for x in l]
+            if not flat:
+                return 0.0, 0.0, 0.0
+            return (
+                len(flat) / elapsed,
+                float(np.percentile(flat, 50) * 1e3),
+                float(np.percentile(flat, 99) * 1e3),
+            )
+
+        b_qps, b_p50, b_p99 = measure()
+        d_qps, d_p50, d_p99 = measure(kill="node-0")
+        a_qps, a_p50, a_p99 = measure()
+    finally:
+        c.stop()
+    import jax
+
+    backend = jax.devices()[0].platform
+    ok = failures[0] == 0 and mismatches[0] == 0 and d_qps > 0
+    sys.stderr.write(
+        f"clients={QPS_CLIENTS} before={b_qps:.1f}qps (p99={b_p99:.1f}ms) "
+        f"during-kill={d_qps:.1f}qps (p99={d_p99:.1f}ms) "
+        f"after={a_qps:.1f}qps (p99={a_p99:.1f}ms) "
+        f"failures={failures[0]} mismatches={mismatches[0]} match={ok}\n"
+    )
+    print(json.dumps({
+        "metric": METRIC,
+        "value": round(d_qps, 1),
+        "unit": "qps",
+        "vs_baseline": round(d_qps / b_qps, 3) if b_qps > 0 else 0.0,
+        "backend": backend,
+        "series": N_SERIES,
+        "clients": QPS_CLIENTS,
+        "match": bool(ok),
+        "phases_ms": {
+            "before_qps": round(b_qps, 1),
+            "during_qps": round(d_qps, 1),
+            "after_qps": round(a_qps, 1),
+            "before_p50": round(b_p50, 2),
+            "before_p99": round(b_p99, 2),
+            "during_p50": round(d_p50, 2),
+            "during_p99": round(d_p99, 2),
+            "after_p50": round(a_p50, 2),
+            "after_p99": round(a_p99, 2),
+        },
+    }))
+
+
 def run_benchmark():
+    if WORKLOAD == "failover_storm":
+        return run_benchmark_failover_storm()
     if WORKLOAD == "long_range_quantile":
         return run_benchmark_long_range_quantile()
     if WORKLOAD == "standing_refresh":

@@ -53,6 +53,11 @@ class _RemoteExecServicer:
         self.engine = engine
         self.local_engine = local_engine
         self.auth_token = auth_token
+        # shard-subset engines (replica routing): a caller may pin the call
+        # to a subset of this node's shards via x-filodb-shards metadata —
+        # engines are built lazily per distinct subset and cached
+        self._subset_engines: dict = {}
+        self._subset_lock = threading.Lock()
 
     # -- helpers ----------------------------------------------------------
 
@@ -71,10 +76,51 @@ class _RemoteExecServicer:
         context.abort(grpc.StatusCode.UNAUTHENTICATED, "bad or missing bearer token")
         return False  # unreached
 
-    def _engine_for(self, params: "pb.QueryParams"):
+    def _engine_for(self, params: "pb.QueryParams", context=None):
+        base = self.engine
+        is_local = False
         if params.local_only and self.local_engine is not None:
-            return self.local_engine
-        return self.engine
+            base = self.local_engine
+            is_local = True
+        subset = self._shard_subset(context) if context is not None else None
+        if subset is None:
+            return base
+        return self._subset_engine(base, is_local, subset)
+
+    def _subset_engine(self, base, is_local: bool, subset: tuple):
+        """Engine pinned to a subset of this node's shards (replica routing:
+        the origin asks for exactly the shards this replica serves for it).
+        Cached per distinct subset; peer fan-out and replica routing are
+        stripped so the subset engine only reads local state."""
+        key = (is_local, subset)
+        with self._subset_lock:
+            eng = self._subset_engines.get(key)
+            if eng is not None:
+                return eng
+            import dataclasses
+
+            from ..coordinator.planner import QueryEngine
+
+            owned = set(base.memstore.shard_nums(base.dataset))
+            shards = [s for s in subset if s in owned]
+            params = dataclasses.replace(
+                base.planner.params, peer_endpoints=(), replica_router=None,
+            )
+            eng = QueryEngine(base.memstore, base.dataset, params=params,
+                              shard_nums=shards)
+            self._subset_engines[key] = eng
+            return eng
+
+    @staticmethod
+    def _shard_subset(context) -> tuple | None:
+        """Sorted shard subset from x-filodb-shards metadata, or None."""
+        for k, v in context.invocation_metadata():
+            if k == SHARDS_MD_KEY:
+                try:
+                    return tuple(sorted(int(x) for x in v.split(",") if x))
+                except ValueError:
+                    return None
+        return None
 
     @staticmethod
     def _allow_partial(context) -> bool | None:
@@ -151,7 +197,7 @@ class _RemoteExecServicer:
 
     def Exec(self, request: "pb.ExecRequest", context):
         self._authorize(context)
-        eng = self._engine_for(request.params)
+        eng = self._engine_for(request.params, context)
         p = request.params
         allow_partial = self._allow_partial(context)
         trace_id, parent_span = self._trace_parent(context)
@@ -174,7 +220,7 @@ class _RemoteExecServicer:
 
     def ExecutePlan(self, request: "pb.ExecutePlanRequest", context):
         self._authorize(context)
-        eng = self._engine_for(request.params)
+        eng = self._engine_for(request.params, context)
         p = request.params
         allow_partial = self._allow_partial(context)
         trace_id, parent_span = self._trace_parent(context)
@@ -267,6 +313,11 @@ PARENT_SPAN_MD_KEY = "x-filodb-parent-span"
 # the frame unsolicited so older origins keep working mid-rolling-deploy
 STATS_EXT_MD_KEY = "x-filodb-stats-ext"
 
+# replica routing: the origin pins the call to a subset of the peer's
+# shards (comma-joined ints) — the peer serves exactly those shards so a
+# scatter leg re-routed to a sibling replica reads the same slice
+SHARDS_MD_KEY = "x-filodb-shards"
+
 # admission-control shed: the peer's Retry-After (seconds) rides trailing
 # call metadata — the gRPC equivalent of the HTTP 429 Retry-After header
 # (the typed rejection itself travels in-band as an AdmissionRejected frame)
@@ -298,11 +349,12 @@ _NOT_PEER_HEALTH_CODES = (
 
 
 def _metadata(auth_token: str | None, allow_partial: bool | None = None,
-              trace: tuple[str, str] | None = None):
+              trace: tuple[str, str] | None = None, shards=None):
     """``allow_partial`` is tri-state: None omits the key (peer uses its own
     default); True/False send "1"/"0" so an origin's explicit choice —
     including strict mode — overrides the peer's configured default.
-    ``trace`` is (trace_id, parent_span_id) of the dispatching span."""
+    ``trace`` is (trace_id, parent_span_id) of the dispatching span.
+    ``shards`` pins the peer to a shard subset (replica routing)."""
     md = []
     if auth_token:
         md.append(("authorization", f"Bearer {auth_token}"))
@@ -311,6 +363,8 @@ def _metadata(auth_token: str | None, allow_partial: bool | None = None,
     if trace is not None:
         md.append((TRACE_ID_MD_KEY, trace[0]))
         md.append((PARENT_SPAN_MD_KEY, trace[1]))
+    if shards:
+        md.append((SHARDS_MD_KEY, ",".join(str(int(s)) for s in shards)))
     # this client understands the StatsExt frame (proto_plan.STATS_EXT);
     # peers only send it when the origin advertises so
     md.append((STATS_EXT_MD_KEY, "1"))
@@ -320,7 +374,7 @@ def _metadata(auth_token: str | None, allow_partial: bool | None = None,
 def _call_stream(endpoint: str, method: str, request, serializer, auth_token,
                  timeout_s: float | None, retries: int = 1,
                  allow_partial: bool | None = None,
-                 trace: tuple[str, str] | None = None):
+                 trace: tuple[str, str] | None = None, shards=None):
     """unary_stream call with bounded UNAVAILABLE retries (mirrors the HTTP
     transport's retry discipline in planners.fetch_json). ``timeout_s`` is a
     TOTAL budget: retries and their per-attempt RPC deadlines all fit inside
@@ -334,7 +388,7 @@ def _call_stream(endpoint: str, method: str, request, serializer, auth_token,
         response_deserializer=pb.StreamFrame.FromString,
     )
     deadline = None if timeout_s is None else _t.monotonic() + timeout_s
-    md = _metadata(auth_token, allow_partial, trace)
+    md = _metadata(auth_token, allow_partial, trace, shards)
     attempt = 0
     while True:
         per_attempt = (
@@ -380,7 +434,7 @@ def exec_plan_remote(endpoint: str, logical_plan, auth_token: str | None = None,
                      local_only: bool = False, deadline_s: float = 0.0,
                      max_series: int = 0, timeout_s: float | None = None,
                      allow_partial: bool | None = None, transport_retries: int = 1,
-                     trace: tuple[str, str] | None = None):
+                     trace: tuple[str, str] | None = None, shard_subset=None):
     req = pb.ExecutePlanRequest(
         plan=plan_to_proto(logical_plan),
         params=pb.QueryParams(local_only=local_only, deadline_s=deadline_s,
@@ -389,7 +443,8 @@ def exec_plan_remote(endpoint: str, logical_plan, auth_token: str | None = None,
     return _call_stream(endpoint, _EXECUTE_PLAN, req,
                         pb.ExecutePlanRequest.SerializeToString, auth_token,
                         timeout_s, retries=transport_retries,
-                        allow_partial=allow_partial, trace=trace)
+                        allow_partial=allow_partial, trace=trace,
+                        shards=shard_subset)
 
 
 from ..query.exec.plans import ExecPlan  # noqa: E402  (no cycle: query/ never imports api/)
@@ -402,7 +457,8 @@ class GrpcPlanRemoteExec(ExecPlan):
     is_remote = True
 
     def __init__(self, endpoint: str, logical_plan, auth_token: str | None = None,
-                 local_only: bool = False, timeout_s: float | None = None):
+                 local_only: bool = False, timeout_s: float | None = None,
+                 shard_subset=None, sibling_endpoints=()):
         super().__init__()
         self.endpoint = endpoint
         self.logical_plan = logical_plan
@@ -411,6 +467,21 @@ class GrpcPlanRemoteExec(ExecPlan):
         self.auth_token = auth_token or os.environ.get("FILODB_REMOTE_TOKEN")
         self.local_only = local_only
         self.timeout_s = timeout_s
+        # replica routing: pin the peer to exactly these shards, with the
+        # sibling replicas the dispatch layer may fail over to
+        self.shard_subset = tuple(shard_subset) if shard_subset else None
+        self.sibling_endpoints = tuple(sibling_endpoints)
+
+    def with_endpoint(self, endpoint: str) -> "GrpcPlanRemoteExec":
+        """Clone for replica failover: same plan/subset/token on a sibling
+        endpoint (the failover layer manages the candidate list)."""
+        clone = GrpcPlanRemoteExec(
+            endpoint, self.logical_plan, auth_token=self.auth_token,
+            local_only=self.local_only, timeout_s=self.timeout_s,
+            shard_subset=self.shard_subset,
+        )
+        clone.transformers = list(self.transformers)
+        return clone
 
     def push_aggregate(self, wrapped_logical) -> None:
         """Aggregate pushdown rewrite: ship ``sum by(...)`` of the leaf
@@ -418,7 +489,10 @@ class GrpcPlanRemoteExec(ExecPlan):
         self.logical_plan = wrapped_logical
 
     def args_str(self) -> str:
-        return f"endpoint={self.endpoint} plan={type(self.logical_plan).__name__}"
+        s = f"endpoint={self.endpoint} plan={type(self.logical_plan).__name__}"
+        if self.shard_subset:
+            s += " shards=" + ",".join(str(x) for x in self.shard_subset)
+        return s
 
     def do_execute(self, ctx):
         from ..metrics import current_span
@@ -441,6 +515,7 @@ class GrpcPlanRemoteExec(ExecPlan):
             # child's retries: transient errors come back marked retryable
             transport_retries=0,
             trace=(sp.trace_id, sp.span_id) if sp is not None else None,
+            shard_subset=self.shard_subset,
         )
 
 

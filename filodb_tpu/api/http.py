@@ -112,6 +112,11 @@ class PromApiHandler(BaseHTTPRequestHandler):
     # zero-arg profiler report hook; wired by the server ONLY when the
     # profiler config block enables it (/debug/profile gate)
     profiler_hook = None
+    # zero-arg cluster snapshot hook (ShardManager.snapshot or
+    # ReplicationPlane.snapshot): shard -> replica table with statuses, lag
+    # watermarks, damper state, recent reassignments (/debug/cluster).
+    # None = endpoint 404s (single-node deployment without a shard plane).
+    cluster_hook = None
     protocol_version = "HTTP/1.1"
     GZIP_MIN_BYTES = 1024
     STREAM_MIN_SAMPLES = 200_000  # above this, query_range streams chunked
@@ -332,6 +337,8 @@ class PromApiHandler(BaseHTTPRequestHandler):
                 return self._resources()
             if path == "/debug/scheduler":
                 return self._scheduler()
+            if path == "/debug/cluster":
+                return self._cluster()
             if path == "/debug/kernels":
                 return self._kernels()
             if path == "/debug/superblocks":
@@ -684,6 +691,15 @@ class PromApiHandler(BaseHTTPRequestHandler):
             "batch": sched.snapshot() if sched is not None else None,
             "admission": adm.snapshot() if adm is not None else None,
         }))
+
+    def _cluster(self):
+        """Replicated-shard-plane introspection (doc/operations.md): the
+        shard -> replica table (per-replica status + lag watermark), node
+        liveness, damper state and the recent-reassignment ring — how an
+        operator confirms a failover routed and a rebalance cut over."""
+        if self.cluster_hook is None:
+            return self._send(404, J.error("no cluster plane configured"))
+        return self._send(200, J.success(self.cluster_hook()))
 
     def _superblocks(self):
         """Superblock-cache introspection: one entry per cached superblock
@@ -1102,7 +1118,7 @@ def make_server(engine: QueryEngine, host: str = "127.0.0.1", port: int = 9090,
                 flush_hook=None,
                 dataset_engines: dict | None = None,
                 standing=None, standing_system=None,
-                rollups=None) -> ThreadingHTTPServer:
+                rollups=None, cluster=None) -> ThreadingHTTPServer:
     # membership hooks (members_hook/join_hook) are wired as class attrs on
     # the returned server's RequestHandlerClass AFTER start — the registry
     # needs the bound port for its self URL (server.py seed bootstrap)
@@ -1113,6 +1129,7 @@ def make_server(engine: QueryEngine, host: str = "127.0.0.1", port: int = 9090,
          "dataset_engines": dict(dataset_engines or {}),
          "standing": standing, "standing_system": standing_system,
          "rollups": rollups,
+         "cluster_hook": staticmethod(cluster) if cluster else None,
          "flush_hook": staticmethod(flush_hook) if flush_hook else None},
     )
     return ThreadingHTTPServer((host, port), handler)
@@ -1122,10 +1139,12 @@ def serve_background(engine: QueryEngine, host: str = "127.0.0.1", port: int = 0
                      auth_token: str | None = None,
                      local_engine: QueryEngine | None = None,
                      flush_hook=None, dataset_engines: dict | None = None,
-                     standing=None, standing_system=None, rollups=None):
+                     standing=None, standing_system=None, rollups=None,
+                     cluster=None):
     """Start the API server on a thread; returns (server, actual_port)."""
     srv = make_server(engine, host, port, auth_token, local_engine, flush_hook,
-                      dataset_engines, standing, standing_system, rollups)
+                      dataset_engines, standing, standing_system, rollups,
+                      cluster)
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
     return srv, srv.server_address[1]

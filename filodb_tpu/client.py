@@ -24,7 +24,7 @@ from typing import Mapping, Sequence
 
 import numpy as np
 
-from .coordinator.planners import fetch_json
+from .coordinator.planners import RemoteFetchError, fetch_json
 
 
 def _public_labels(lbls: Mapping[str, str]) -> dict:
@@ -36,11 +36,16 @@ def _public_labels(lbls: Mapping[str, str]) -> dict:
 
 class FiloClient:
     def __init__(self, endpoint: str, token: str | None = None, timeout: float = 60,
-                 grpc_endpoint: str | None = None):
+                 grpc_endpoint: str | None = None,
+                 failover_endpoints: Sequence[str] = ()):
         self.endpoint = endpoint.rstrip("/")
         self.token = token
         self.timeout = timeout
         self.grpc_endpoint = grpc_endpoint
+        # sibling frontends (replicated shard plane): when the primary
+        # endpoint fails at the transport level, reads retry against each
+        # in turn — the client-side half of replica failover
+        self.failover_endpoints = tuple(e.rstrip("/") for e in failover_endpoints)
 
     # -- queries (reference QueryOps) --------------------------------------
 
@@ -48,8 +53,16 @@ class FiloClient:
         qs = urllib.parse.urlencode(
             [(k, v) for k, vs in params.items() for v in (vs if isinstance(vs, (list, tuple)) else [vs]) if v is not None],
         )
-        url = f"{self.endpoint}{path}" + (f"?{qs}" if qs else "")
-        return fetch_json(url, auth_token=self.token, timeout=self.timeout)
+        suffix = f"{path}" + (f"?{qs}" if qs else "")
+        last = None
+        for base in (self.endpoint, *self.failover_endpoints):
+            try:
+                return fetch_json(f"{base}{suffix}", auth_token=self.token,
+                                  timeout=self.timeout)
+            except (RemoteFetchError, ConnectionError, TimeoutError, OSError) as e:
+                last = e
+                continue
+        raise last
 
     def query_range(self, promql: str, start_s: float, end_s: float, step_s: float):
         """-> (times_s[np.ndarray], [{"metric": labels, "values": np.ndarray}]).
@@ -99,12 +112,27 @@ class FiloClient:
 
     def _grpc_exec(self, promql, start_s, end_s, step_ms, instant=False):
         from .api.grpc_exec import exec_promql
+        from .query.proto_plan import RemoteExecError
 
-        return exec_promql(
-            self.grpc_endpoint, promql,
-            round(start_s * 1000), round(end_s * 1000), step_ms,
-            auth_token=self.token, instant=instant, timeout_s=self.timeout,
-        )
+        # grpc:// failover endpoints are sibling frontends for this
+        # transport; endpoint-health failures move to the next one
+        cands = [self.grpc_endpoint] + [
+            e for e in self.failover_endpoints if e.startswith("grpc://")
+        ]
+        last = None
+        for ep in cands:
+            try:
+                return exec_promql(
+                    ep, promql,
+                    round(start_s * 1000), round(end_s * 1000), step_ms,
+                    auth_token=self.token, instant=instant, timeout_s=self.timeout,
+                )
+            except RemoteExecError as e:
+                last = e
+                if getattr(e, "endpoint_failure", False):
+                    continue
+                raise
+        raise last
 
     def query(self, promql: str, time_s: float | None = None):
         """Instant query -> raw Prometheus ``data`` payload."""

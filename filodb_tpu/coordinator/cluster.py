@@ -6,12 +6,18 @@ doc/sharding.md:157-189 auto-reassignment with 2h damper).
 Single-process-friendly: nodes are logical endpoints; the event-driven state
 machine (status transitions, subscriptions, reassignment policy) matches the
 reference so a networked control plane can drive it later.
+
+Replication (doc/robustness.md "Replicated shard plane"): each shard may have
+R replicas — one primary (the legacy node_of/shards_of_node view, unchanged)
+plus followers, each with its own ShardStatus. Placement keeps replicas on
+distinct nodes; node_left promotes a live follower instead of unassigning.
 """
 
 from __future__ import annotations
 
 import enum
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Sequence
 
@@ -39,12 +45,23 @@ class ShardEvent:
 
 class ShardMapper:
     """shard -> (node, status) map + query routing (reference
-    ShardMapper.scala: status tracking, activeShards, queryShards)."""
+    ShardMapper.scala: status tracking, activeShards, queryShards).
+
+    The primary view (node_of/status_of/shards_of_node) is unchanged from the
+    single-replica days; replicas_of exposes the full ordered replica set
+    (primary first) with a per-replica status.
+    """
 
     def __init__(self, num_shards: int):
         self.num_shards = num_shards
         self._node: list[str | None] = [None] * num_shards
         self._status: list[ShardStatus] = [ShardStatus.UNASSIGNED] * num_shards
+        # per-shard ordered replica map {node: status}; first key is the
+        # primary and mirrors _node/_status exactly (dicts keep insertion
+        # order, so "first key" is well-defined)
+        self._replicas: list[dict[str, ShardStatus]] = [
+            {} for _ in range(num_shards)
+        ]
         self._subscribers: list[Callable[[ShardEvent], None]] = []
 
     def subscribe(self, fn: Callable[[ShardEvent], None]) -> None:
@@ -53,8 +70,23 @@ class ShardMapper:
     def update(self, shard: int, status: ShardStatus, node: str | None = None) -> None:
         self._status[shard] = status
         if node is not None or status in (ShardStatus.UNASSIGNED, ShardStatus.DOWN):
+            old = self._node[shard]
             self._node[shard] = node
-        ev = ShardEvent(shard, status, self._node[shard])
+            if node != old:
+                # primary moved (or cleared): rebuild the replica map with
+                # the new primary in front, keeping surviving followers
+                rest = {
+                    n: st for n, st in self._replicas[shard].items()
+                    if n not in (old, node)
+                }
+                if node is None:
+                    self._replicas[shard] = rest
+                else:
+                    self._replicas[shard] = {node: status, **rest}
+        primary = self._node[shard]
+        if primary is not None:
+            self._replicas[shard][primary] = status
+        ev = ShardEvent(shard, status, primary)
         for fn in self._subscribers:
             fn(ev)
 
@@ -72,6 +104,64 @@ class ShardMapper:
 
     def unassigned(self) -> list[int]:
         return [s for s in range(self.num_shards) if self._status[s] == ShardStatus.UNASSIGNED]
+
+    # -- replicas ---------------------------------------------------------
+
+    def set_replica(self, shard: int, node: str, status: ShardStatus) -> None:
+        """Add or update one replica. When the node is (or becomes) the
+        primary this delegates to update() so the legacy view and the
+        subscriber stream stay the single source of truth."""
+        primary = self._node[shard]
+        if primary is None or primary == node:
+            self.update(shard, status, node)
+            return
+        self._replicas[shard][node] = status
+        ev = ShardEvent(shard, status, node)
+        for fn in self._subscribers:
+            fn(ev)
+
+    def remove_replica(self, shard: int, node: str) -> None:
+        """Drop a replica; a removed primary promotes the first live
+        follower (RECOVERY if it was not already queryable)."""
+        reps = self._replicas[shard]
+        if node not in reps:
+            return
+        if self._node[shard] != node:
+            del reps[node]
+            return
+        # primary removal: promote the first surviving follower
+        del reps[node]
+        for cand, st in reps.items():
+            promoted = st if st in QUERYABLE else ShardStatus.RECOVERY
+            self.update(shard, promoted, cand)
+            return
+        self.update(shard, ShardStatus.UNASSIGNED, None)
+
+    def promote(self, shard: int, node: str) -> None:
+        """Make an existing follower the primary (status carries over)."""
+        reps = self._replicas[shard]
+        if node not in reps or self._node[shard] == node:
+            return
+        self.update(shard, reps[node], node)
+
+    def replicas_of(self, shard: int) -> dict[str, ShardStatus]:
+        """Ordered {node: status}, primary first (copy)."""
+        return dict(self._replicas[shard])
+
+    def nodes_of(self, shard: int) -> list[str]:
+        """Replica nodes, primary first."""
+        return list(self._replicas[shard])
+
+    def live_replicas(self, shard: int) -> list[str]:
+        """Replica nodes currently queryable, primary first."""
+        return [n for n, st in self._replicas[shard].items() if st in QUERYABLE]
+
+    def replica_status_of(self, shard: int, node: str) -> ShardStatus | None:
+        return self._replicas[shard].get(node)
+
+    def replica_shards_of_node(self, node: str) -> list[int]:
+        """Shards holding ANY replica (primary or follower) on the node."""
+        return [s for s in range(self.num_shards) if node in self._replicas[s]]
 
     def query_shards(self, shard_key_hash: int | None = None, spread: int | None = None) -> list[int]:
         """Shards a query must touch; with a shard-key hash + spread the set
@@ -100,6 +190,31 @@ class ShardAssignmentStrategy:
             load[node] += 1
         return out
 
+    def place_replicas(self, mapper: ShardMapper, nodes: Sequence[str],
+                       shards_per_node: int, num_replicas: int):
+        """Follower placement: for each shard with a primary but fewer than
+        num_replicas replicas, pick the least-loaded nodes NOT already
+        holding a replica of it (replicas land on distinct nodes, always).
+        Follower capacity counts against the same shards_per_node budget.
+        Returns {node: [shards]} of new follower placements."""
+        out: dict[str, list[int]] = {n: [] for n in nodes}
+        load = {n: len(mapper.replica_shards_of_node(n)) for n in nodes}
+        for s in range(mapper.num_shards):
+            have = mapper.nodes_of(s)
+            if not have:
+                continue  # no primary yet — assign() owns that
+            need = num_replicas - len(have)
+            for _ in range(max(0, need)):
+                cands = [n for n in nodes
+                         if n not in have and load[n] < shards_per_node]
+                if not cands:
+                    break
+                node = min(cands, key=lambda n: load[n])
+                out[node].append(s)
+                have.append(node)
+                load[node] += 1
+        return out
+
 
 class ShardManager:
     """Cluster-singleton shard coordinator: node join/leave, ingestion-error
@@ -109,19 +224,28 @@ class ShardManager:
 
     def __init__(self, num_shards: int, shards_per_node: int,
                  reassignment_damper_s: float = 7200.0,
-                 clock: Callable[[], float] = time.time):
+                 clock: Callable[[], float] = time.time,
+                 num_replicas: int = 1):
         self.mapper = ShardMapper(num_shards)
         self.strategy = ShardAssignmentStrategy()
         self.shards_per_node = shards_per_node
         self.damper_s = reassignment_damper_s
+        self.num_replicas = max(1, num_replicas)
         self._clock = clock  # injectable for deterministic chaos tests
         self.nodes: list[str] = []
         self._last_reassign: dict[int, float] = {}
+        # ring of recent placement decisions for /debug/cluster
+        self.recent: deque = deque(maxlen=64)
 
     def damper_active(self, shard: int) -> bool:
         """True while a recent reassignment suppresses another bounce."""
         last = self._last_reassign.get(shard)
         return last is not None and self._clock() - last < self.damper_s
+
+    def _note(self, shard: int, node: str | None, event: str) -> None:
+        self.recent.append(
+            {"shard": shard, "node": node, "event": event, "ts": self._clock()}
+        )
 
     # -- membership -------------------------------------------------------
 
@@ -131,20 +255,50 @@ class ShardManager:
         assigned = self.strategy.assign(self.mapper, [node], self.shards_per_node)[node]
         for s in assigned:
             self.mapper.update(s, ShardStatus.ASSIGNED, node)
+            self._note(s, node, "assigned")
+        if self.num_replicas > 1:
+            self._place_followers()
         return assigned
 
+    def _place_followers(self) -> None:
+        placed = self.strategy.place_replicas(
+            self.mapper, self.nodes, self.shards_per_node, self.num_replicas
+        )
+        for node, got in placed.items():
+            for s in got:
+                self.mapper.set_replica(s, node, ShardStatus.ASSIGNED)
+                self._note(s, node, "follower")
+
     def node_left(self, node: str) -> list[int]:
-        shards = self.mapper.shards_of_node(node)
         self.nodes = [n for n in self.nodes if n != node]
-        for s in shards:
-            self.mapper.update(s, ShardStatus.UNASSIGNED, None)
-        return self._reassign(shards)
+        primaried = self.mapper.shards_of_node(node)
+        # strip the dead node's follower entries first so promotion and
+        # attribution never point at it (satellite: stale-node attribution)
+        for s in self.mapper.replica_shards_of_node(node):
+            if s not in primaried:
+                self.mapper.remove_replica(s, node)
+        lost: list[int] = []
+        for s in primaried:
+            survivors = [n for n in self.mapper.nodes_of(s) if n != node]
+            if survivors:
+                # promote a live follower in place — no reassignment churn,
+                # no damper interaction (reference: replica failover)
+                self.mapper.remove_replica(s, node)
+                self._note(s, self.mapper.node_of(s), "promoted")
+            else:
+                self.mapper.update(s, ShardStatus.UNASSIGNED, None)
+                lost.append(s)
+        moved = self._reassign(lost)
+        if self.num_replicas > 1 and self.nodes:
+            self._place_followers()
+        return primaried
 
     def _reassign(self, shards: Sequence[int]) -> list[int]:
         from ..metrics import record_shard_reassignment
 
         moved = []
         now = self._clock()
+        eligible = []
         for s in shards:
             # a shard never reassigned before is infinitely old — the damper
             # only suppresses REPEAT bounces (clocks may start near zero)
@@ -153,15 +307,25 @@ class ShardManager:
                 # bounced too recently -> stop flapping (reference damper)
                 self.mapper.update(s, ShardStatus.DOWN, None)
                 record_shard_reassignment(s, damped=True)
+                self._note(s, None, "damped")
                 continue
-            per_node = self.strategy.assign(self.mapper, self.nodes, self.shards_per_node)
-            for node, got in per_node.items():
-                if s in got:
-                    self.mapper.update(s, ShardStatus.ASSIGNED, node)
-                    self._last_reassign[s] = now
-                    moved.append(s)
-                    record_shard_reassignment(s, damped=False)
-                    break
+            eligible.append(s)
+        if not eligible:
+            return moved
+        # ONE batch assignment for every eligible shard: re-running
+        # strategy.assign per shard is quadratic and lets a later iteration
+        # skip shards an earlier call already placed
+        per_node = self.strategy.assign(self.mapper, self.nodes, self.shards_per_node)
+        placed = {s: node for node, got in per_node.items() for s in got}
+        for s in eligible:
+            node = placed.get(s)
+            if node is None:
+                continue
+            self.mapper.update(s, ShardStatus.ASSIGNED, node)
+            self._last_reassign[s] = now
+            moved.append(s)
+            record_shard_reassignment(s, damped=False)
+            self._note(s, node, "moved")
         return moved
 
     # -- shard lifecycle events (from ingestion) --------------------------
@@ -178,6 +342,50 @@ class ShardManager:
         self.mapper.update(shard, ShardStatus.ERROR, self.mapper.node_of(shard))
         self.mapper.update(shard, ShardStatus.UNASSIGNED, None)
         return bool(self._reassign([shard]))
+
+    # -- live rebalancing -------------------------------------------------
+
+    def rebalance(self, shard: int, to_node: str) -> bool:
+        """Deliberate shard move (operator- or balancer-driven). The damper
+        gates it exactly like failure reassignment — a shard that just
+        bounced will not bounce again. The new owner starts in RECOVERY;
+        the state-handoff layer (coordinator/replication.py) replays data
+        and flips it ACTIVE once the effect log proves cutover. Returns
+        True when the mapping moved."""
+        if to_node not in self.nodes:
+            raise ValueError(f"unknown node {to_node!r}")
+        if self.mapper.node_of(shard) == to_node:
+            return False
+        if self.damper_active(shard):
+            from ..metrics import record_shard_reassignment
+
+            record_shard_reassignment(shard, damped=True)
+            self._note(shard, to_node, "damped")
+            return False
+        self.mapper.update(shard, ShardStatus.RECOVERY, to_node)
+        self._last_reassign[shard] = self._clock()
+        self._note(shard, to_node, "rebalanced")
+        return True
+
+    def snapshot(self) -> dict:
+        """Cluster state for GET /debug/cluster."""
+        shards = []
+        for s in range(self.mapper.num_shards):
+            shards.append({
+                "shard": s,
+                "primary": self.mapper.node_of(s),
+                "status": self.mapper.status_of(s).value,
+                "replicas": {
+                    n: st.value for n, st in self.mapper.replicas_of(s).items()
+                },
+                "damper_active": self.damper_active(s),
+            })
+        return {
+            "nodes": list(self.nodes),
+            "num_replicas": self.num_replicas,
+            "shards": shards,
+            "recent_reassignments": list(self.recent),
+        }
 
 
 class ClusterDiscovery:

@@ -327,6 +327,12 @@ class PlannerParams:
     # (doc/perf.md "Sketch rollup tier"). None = no substitution; every
     # plan is byte-identical to the pre-rollup planner.
     rollups: object | None = None
+    # replicated shard plane (coordinator/replication.ReplicaRouter):
+    # selector scatter consults it for per-shard replica endpoints — each
+    # dispatch leg pins ONE replica (x-filodb-shards) and carries its
+    # sibling endpoints so the dispatch layer can fail over before
+    # allow_partial_results is even considered. None = legacy peer scatter.
+    replica_router: object | None = None
 
 
 class SingleClusterPlanner:
@@ -525,9 +531,14 @@ class SingleClusterPlanner:
         ownership), so concatenation is exact; upper transformers/aggregates
         apply to the union at this node's parent, identically to local
         leaves."""
-        if not self.params.peer_endpoints or logical is None:
+        if logical is None:
             return []
         if not isinstance(logical, (L.PeriodicSeries, L.PeriodicSeriesWithWindowing)):
+            return []
+        router = self.params.replica_router
+        if router is not None:
+            return self._router_leaves(router, logical)
+        if not self.params.peer_endpoints:
             return []
         from ..query.unparse import to_promql
         from .planners import PromQlRemoteExec
@@ -551,6 +562,28 @@ class SingleClusterPlanner:
                     ep, q, logical.start_ms, logical.end_ms, logical.step_ms or 1,
                     auth_token=self.params.remote_auth_token, local_only=True,
                 )
+            r.peer_logical = logical  # for aggregate pushdown rewriting
+            leaves.append(r)
+        return leaves
+
+    def _router_leaves(self, router, logical) -> list:
+        """Replica-routed scatter: the router groups non-local shards into
+        dispatch legs of (shards, candidate endpoints). Each leg becomes ONE
+        shard-pinned remote exec against the selected replica, carrying its
+        sibling endpoints for dispatch-layer failover (query/faults.py)."""
+        from ..api.grpc_exec import GrpcPlanRemoteExec
+
+        local = set(self.shards_for(None))
+        num = getattr(router.plane.mapper, "num_shards", 0)
+        remote = [s for s in range(num) if s not in local]
+        leaves = []
+        for shards, endpoints in router.legs(remote, end_ms=logical.end_ms):
+            r = GrpcPlanRemoteExec(
+                endpoints[0], logical,
+                auth_token=self.params.remote_auth_token,
+                local_only=True, shard_subset=shards,
+                sibling_endpoints=endpoints[1:],
+            )
             r.peer_logical = logical  # for aggregate pushdown rewriting
             leaves.append(r)
         return leaves
@@ -766,7 +799,7 @@ class SingleClusterPlanner:
         )
 
         params = self.params
-        if not params.fused_aggregate or params.peer_endpoints:
+        if not params.fused_aggregate or params.peer_endpoints or params.replica_router is not None:
             return None
         if p.op in FUSED_AGG_OPS:
             if p.params:
@@ -1104,7 +1137,7 @@ class SingleClusterPlanner:
         ratios join shard-locally."""
         if self.params.spread != 0:
             return None
-        if self.params.peer_endpoints:
+        if self.params.peer_endpoints or self.params.replica_router is not None:
             return None  # matching pairs may span hosts
         if p.op not in ("and", "or", "unless") and p.cardinality not in (None, "one-to-one"):
             return None
@@ -1146,7 +1179,7 @@ class SingleClusterPlanner:
         """Long non-aggregated range queries shard the TIME axis over the
         mesh with a ring halo exchange (parallel/timeshard.py)."""
         mesh = self.params.mesh
-        if mesh is None or self.params.peer_endpoints:
+        if mesh is None or self.params.peer_endpoints or self.params.replica_router is not None:
             return None
         from ..ops.kernels import SORTED_FUNCS
         from ..parallel.exec import TIME_SHARD_MIN_STEPS, TimeShardRangeExec
@@ -1183,7 +1216,7 @@ class SingleClusterPlanner:
         """Mesh path: aggregate-of-range-function compiles to one psum
         program when a device mesh is configured."""
         mesh = self.params.mesh
-        if mesh is None or self.params.peer_endpoints:
+        if mesh is None or self.params.peer_endpoints or self.params.replica_router is not None:
             # peer scatter runs through the standard leaf fan-out; the mesh
             # single-psum program would aggregate local shards only
             return None
@@ -1260,12 +1293,14 @@ class QueryEngine:
     """Top-level facade: PromQL string -> executed result (the in-process
     analog of QueryActor -> planner.materialize -> execute)."""
 
-    def __init__(self, memstore, dataset: str, params: PlannerParams | None = None):
+    def __init__(self, memstore, dataset: str, params: PlannerParams | None = None,
+                 shard_nums: Sequence[int] | None = None):
         from .scheduler import SingleFlight
 
         self.memstore = memstore
         self.dataset = dataset
-        self.planner = SingleClusterPlanner(memstore, dataset, params=params)
+        self.planner = SingleClusterPlanner(memstore, dataset,
+                                            shard_nums=shard_nums, params=params)
         self._single_flight = SingleFlight()
         p = self.planner.params
         if p.dispatch_scheduler is None and p.batch_window_ms > 0:

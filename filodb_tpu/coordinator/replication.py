@@ -1,0 +1,386 @@
+"""Replicated shard plane: ingest fan-out, lag watermarks, replica routing,
+and live rebalancing with state handoff (doc/robustness.md "Replicated shard
+plane"; reference FiloDB's peer-to-peer ingestion replication + Tailwind's
+explicit dispatch-boundary dataflow from PAPERS.md).
+
+The ReplicationPlane owns the data motion the ShardManager only *maps*:
+
+- ``append`` splits a batch by shard and fans each sub-batch to every live
+  replica, tracking per-replica acks (sequence numbers against a retained
+  per-shard append log) and a lag watermark (max acked sample timestamp) so
+  a recovering replica serves only behind its watermark.
+- ``set_node_down`` / ``recover`` drive the membership events and replay the
+  retained log tail to catch a returning replica up.
+- ``rebalance`` moves a shard by rebuild-on-arrival: replay the retained log
+  into the new owner, then use the source shard's effect log
+  (``ingest_effects_interval_since``) to PROVE nothing landed on the old
+  owner mid-copy (reason None = clean cutover; "overlap" = replay the tail
+  and re-check; "full_clear"/"log_truncated" = full rebuild). Standing
+  queries homed on the shard re-register on the new owner so delta refreshes
+  resume within one align bucket.
+
+The ReplicaRouter is the query-side view: per shard it offers the live
+replica endpoints primary-first (rotated per shard to spread load), filtered
+by watermark, grouped into dispatch legs the planner turns into one remote
+exec per distinct endpoint set. Failover between a leg's candidates lives in
+query/faults.dispatch_child — a breaker-open or endpoint-failure signal
+re-pins to the next sibling before allow_partial_results is even considered.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Sequence
+
+from ..core.records import RecordBatch
+from ..core.schemas import Dataset
+from ..metrics import (
+    record_rebalance,
+    record_rebalance_standing_move,
+    record_replica_ack,
+    record_replica_watermark,
+)
+from .cluster import QUERYABLE, ShardManager, ShardStatus
+
+# replica statuses that receive live appends: queryable ones plus freshly
+# ASSIGNED followers (they must not fall behind while warming up)
+_APPENDABLE = QUERYABLE | {ShardStatus.ASSIGNED}
+
+
+@dataclass
+class NodeHandle:
+    """One data node the plane can reach: its memstore (in-process) and the
+    gRPC endpoint queries dial, plus an optional StandingEngine."""
+
+    name: str
+    memstore: object
+    endpoint: str | None = None
+    standing: object = None
+    alive: bool = True
+
+
+@dataclass
+class StandingSpec:
+    """A standing query homed on a shard — enough to re-register it on a new
+    owner after a rebalance."""
+
+    promql: str
+    step_ms: int
+    shard: int
+    kwargs: dict = field(default_factory=dict)
+    owner: str | None = None
+    qid: str | None = None
+
+
+class ReplicationPlane:
+    def __init__(self, manager: ShardManager, dataset: str = "prometheus",
+                 spread: int = 2, retain: int = 1024):
+        self.manager = manager
+        self.mapper = manager.mapper
+        self.dataset = dataset
+        self.spread = spread
+        self.nodes: dict[str, NodeHandle] = {}
+        # per-shard retained append log [(seq, sub_batch)] — the replay
+        # source for recovery and rebuild-on-arrival
+        self._log: dict[int, deque] = {
+            s: deque(maxlen=retain) for s in range(self.mapper.num_shards)
+        }
+        self._seq: dict[int, int] = {s: 0 for s in range(self.mapper.num_shards)}
+        self._acks: dict[tuple[int, str], int] = {}
+        self._watermarks: dict[tuple[int, str], int] = {}
+        self._standing: list[StandingSpec] = []
+
+    # -- membership -------------------------------------------------------
+
+    def add_node(self, name: str, memstore, endpoint: str | None = None,
+                 standing=None) -> NodeHandle:
+        h = NodeHandle(name, memstore, endpoint, standing)
+        self.nodes[name] = h
+        return h
+
+    def endpoint_of(self, node: str) -> str | None:
+        h = self.nodes.get(node)
+        return h.endpoint if h else None
+
+    def set_node_down(self, name: str) -> None:
+        """Node failure: mark the handle dead and let the manager promote
+        live followers / reassign shards with no survivor."""
+        h = self.nodes.get(name)
+        if h is not None:
+            h.alive = False
+        if name in self.manager.nodes:
+            self.manager.node_left(name)
+
+    def recover(self, name: str) -> list[int]:
+        """Node return: rejoin, replay the retained log tail past each
+        replica's last ack, and flip replicas ACTIVE once caught up.
+        Returns the shards replayed."""
+        h = self.nodes[name]
+        h.alive = True
+        self.manager.node_joined(name)
+        caught_up = []
+        for s in self.mapper.replica_shards_of_node(name):
+            self.mapper.set_replica(s, name, ShardStatus.RECOVERY)
+            self._replay(s, name, since_seq=self._acks.get((s, name), 0))
+            self.mapper.set_replica(s, name, ShardStatus.ACTIVE)
+            caught_up.append(s)
+        return caught_up
+
+    # -- ingest fan-out ---------------------------------------------------
+
+    def append(self, batch: RecordBatch) -> dict[int, list[str]]:
+        """Fan a batch out to all live replicas of each destination shard.
+        Returns {shard: [nodes acked]}."""
+        options = None
+        for h in self.nodes.values():
+            try:
+                options = h.memstore.dataset(self.dataset).options
+                break
+            except KeyError:
+                continue
+        acked: dict[int, list[str]] = {}
+        split = batch.shard_split(
+            self.spread, self.mapper.num_shards, options
+        )
+        for snum, sub in split.items():
+            seq = self._seq[snum] + 1
+            self._seq[snum] = seq
+            self._log[snum].append((seq, sub))
+            acked[snum] = []
+            for node, status in self.mapper.replicas_of(snum).items():
+                h = self.nodes.get(node)
+                if h is None or not h.alive or status not in _APPENDABLE:
+                    record_replica_ack("skipped")
+                    continue
+                try:
+                    self._ensure_shard(h, snum)
+                    h.memstore.ingest(self.dataset, snum, sub)
+                except Exception:
+                    record_replica_ack("error")
+                    self.mapper.set_replica(snum, node, ShardStatus.ERROR)
+                    continue
+                self._ack(snum, node, seq, sub)
+                acked[snum].append(node)
+        return acked
+
+    def _ack(self, shard: int, node: str, seq: int, sub: RecordBatch) -> None:
+        self._acks[(shard, node)] = max(self._acks.get((shard, node), 0), seq)
+        if len(sub):
+            wm = max(
+                self._watermarks.get((shard, node), 0),
+                int(sub.timestamps.max()),
+            )
+            self._watermarks[(shard, node)] = wm
+            record_replica_watermark(shard, node, wm)
+        record_replica_ack("ok")
+
+    def lag_watermark(self, shard: int, node: str) -> int:
+        """Max sample timestamp (ms) the replica has acked; 0 = nothing."""
+        return self._watermarks.get((shard, node), 0)
+
+    def _ensure_shard(self, h: NodeHandle, snum: int) -> None:
+        try:
+            owned = h.memstore.shard_nums(self.dataset)
+        except KeyError:
+            owned = []
+        if snum not in owned:
+            h.memstore.setup(
+                Dataset(self.dataset), [snum],
+                total_shards=self.mapper.num_shards,
+            )
+
+    def _replay(self, shard: int, node: str, since_seq: int = 0) -> int:
+        """Replay retained log entries with seq > since_seq into a node.
+        Returns the number of entries replayed."""
+        h = self.nodes[node]
+        n = 0
+        for seq, sub in list(self._log[shard]):
+            if seq <= since_seq:
+                continue
+            self._ensure_shard(h, shard)
+            h.memstore.ingest(self.dataset, shard, sub)
+            self._ack(shard, node, seq, sub)
+            n += 1
+        return n
+
+    # -- standing queries -------------------------------------------------
+
+    def register_standing(self, promql: str, step_ms: int, shard: int,
+                          **kwargs) -> StandingSpec:
+        """Register a standing query homed on a shard — it lives on the
+        shard's current primary and follows the shard across rebalances."""
+        spec = StandingSpec(promql, step_ms, shard, dict(kwargs))
+        self._register_on(spec, self.mapper.node_of(shard))
+        self._standing.append(spec)
+        return spec
+
+    def _register_on(self, spec: StandingSpec, node: str | None) -> None:
+        h = self.nodes.get(node) if node else None
+        if h is None or h.standing is None:
+            spec.owner, spec.qid = node, None
+            return
+        sq = h.standing.register(spec.promql, spec.step_ms, **spec.kwargs)
+        spec.owner, spec.qid = node, sq.qid
+
+    def standing_query(self, spec: StandingSpec):
+        """The live StandingQuery object behind a spec, on its current
+        owner's StandingEngine (None when the owner has none)."""
+        h = self.nodes.get(spec.owner) if spec.owner else None
+        if h is None or h.standing is None or not spec.qid:
+            return None
+        return h.standing.registry.get(spec.qid)
+
+    def standing_specs(self, shard: int | None = None) -> list[StandingSpec]:
+        if shard is None:
+            return list(self._standing)
+        return [sp for sp in self._standing if sp.shard == shard]
+
+    # -- live rebalancing -------------------------------------------------
+
+    def rebalance(self, shard: int, to_node: str) -> str:
+        """Move a shard's primary to ``to_node`` by rebuild-on-arrival.
+        Returns the cutover outcome: clean | replayed | rebuilt | damped |
+        failed (also counted in filodb_rebalance{outcome})."""
+        src_name = self.mapper.node_of(shard)
+        src = self.nodes.get(src_name) if src_name else None
+        src_shard = None
+        if src is not None:
+            try:
+                src_shard = src.memstore.shard(self.dataset, shard)
+            except KeyError:
+                src_shard = None
+        v0 = src_shard.version if src_shard is not None else None
+        seq0 = self._seq[shard]
+
+        if not self.manager.rebalance(shard, to_node):
+            record_rebalance("damped")
+            return "damped"
+
+        dst = self.nodes.get(to_node)
+        if dst is None:
+            record_rebalance("failed")
+            return "failed"
+        # rebuild-on-arrival: full retained-log replay into the new owner
+        # (idempotent for a node that already held a follower replica only
+        # in the sense that the memstore dedupes per-series timestamps;
+        # a fresh owner rebuilds from scratch)
+        self._replay(shard, to_node, since_seq=self._acks.get((shard, to_node), 0))
+
+        outcome = "clean"
+        if src_shard is not None and v0 is not None:
+            # effect-log cutover proof: did ANY ingest land on the source
+            # after we snapshotted? (doc/robustness.md effect-log taxonomy)
+            for _ in range(3):
+                reason, _lo, _hi = src_shard.ingest_effects_interval_since(
+                    v0, 0, 1 << 62
+                )
+                if reason is None:
+                    break
+                if reason == "overlap":
+                    # a tail landed on the source mid-copy: replay the tail
+                    # (it is in the retained log) and re-check
+                    v0 = src_shard.version
+                    self._replay(shard, to_node, since_seq=seq0)
+                    seq0 = self._seq[shard]
+                    outcome = "replayed"
+                else:  # full_clear | log_truncated — no interval proof left
+                    v0 = src_shard.version
+                    self._replay(shard, to_node, since_seq=0)
+                    outcome = "rebuilt"
+        self.manager.shard_active(shard)
+        record_rebalance(outcome)
+
+        # standing queries follow the shard: unregister on the old owner,
+        # re-register on the new one so delta refreshes resume there
+        for spec in self.standing_specs(shard):
+            old = self.nodes.get(spec.owner) if spec.owner else None
+            if old is not None and old.standing is not None and spec.qid:
+                try:
+                    old.standing.unregister(spec.qid, reason="rebalanced")
+                except Exception:
+                    pass
+            self._register_on(spec, to_node)
+            record_rebalance_standing_move()
+        return outcome
+
+    # -- observability ----------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Cluster + replication state for GET /debug/cluster."""
+        snap = self.manager.snapshot()
+        for row in snap["shards"]:
+            s = row["shard"]
+            row["watermarks_ms"] = {
+                n: self.lag_watermark(s, n) for n in row["replicas"]
+            }
+            row["log_seq"] = self._seq[s]
+            row["acks"] = {
+                n: self._acks.get((s, n), 0) for n in row["replicas"]
+            }
+        snap["nodes"] = [
+            {
+                "name": h.name,
+                "endpoint": h.endpoint,
+                "alive": h.alive,
+                "standing": h.standing is not None,
+            }
+            for h in self.nodes.values()
+        ] or snap["nodes"]
+        snap["standing"] = [
+            {"shard": sp.shard, "promql": sp.promql, "owner": sp.owner}
+            for sp in self._standing
+        ]
+        return snap
+
+
+class ReplicaRouter:
+    """Query-side replica selection: shards -> dispatch legs.
+
+    A *leg* is (shards, endpoints): one remote exec covering ``shards`` on
+    ``endpoints[0]``, with ``endpoints[1:]`` the sibling replicas the
+    dispatch layer may fail over to. Candidates are live replicas
+    primary-first, rotated per shard to spread read load, and a RECOVERY
+    replica is excluded for queries ending past its lag watermark."""
+
+    def __init__(self, plane: ReplicationPlane, local_node: str | None = None):
+        self.plane = plane
+        self.local_node = local_node
+
+    def candidates(self, shard: int, end_ms: int | None = None) -> list[str]:
+        """Live replica endpoints for one shard, primary first, watermark-
+        filtered, rotated by shard index."""
+        mapper = self.plane.mapper
+        out = []
+        for node, status in mapper.replicas_of(shard).items():
+            if status not in QUERYABLE:
+                continue
+            h = self.plane.nodes.get(node)
+            if h is None or not h.alive or h.endpoint is None:
+                continue
+            if (status is ShardStatus.RECOVERY and end_ms is not None
+                    and self.plane.lag_watermark(shard, node) < end_ms):
+                continue
+            out.append(h.endpoint)
+        if len(out) > 1:
+            k = shard % len(out)
+            out = out[k:] + out[:k]
+        return out
+
+    def legs(self, shards: Sequence[int] | None = None,
+             end_ms: int | None = None) -> list[tuple[tuple, tuple]]:
+        """One dispatch leg PER SHARD, in shard order. Per-shard legs keep
+        the merge tree's structure identical across failovers: re-pinning a
+        leg to a sibling swaps only the endpoint, never the partial-merge
+        grouping, so a failed-over query is bit-equal to the pre-kill one
+        (replicas hold identical fan-out data; float reduction order is a
+        function of tree structure). ``shards`` defaults to every shard."""
+        if shards is None:
+            shards = range(self.plane.mapper.num_shards)
+        legs = []
+        for s in shards:
+            cands = tuple(self.candidates(s, end_ms))
+            if not cands:
+                continue
+            legs.append(((s,), cands))
+        return legs

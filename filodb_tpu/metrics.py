@@ -178,6 +178,12 @@ HELP_TEXTS: dict[str, str] = {
     "filodb_rollup_serves": "Queries served from rollup blocks instead of raw samples, by kind (window|agg|hist_quantile).",
     "filodb_rollup_chooser": "Workload-chooser decisions (add|retire) over querylog fingerprints.",
     "filodb_superblock_pinned_bytes": "Superblock cache bytes pinned by standing queries (skipped by eviction).",
+    "filodb_replica_selection": "Remote dispatches by which replica served (primary|sibling).",
+    "filodb_replica_failovers": "Dispatches re-pinned away from a replica endpoint, by reason (breaker_open|endpoint_failure).",
+    "filodb_replica_acks": "Per-replica ingest fan-out append outcomes (ok|error|skipped).",
+    "filodb_replica_watermark_ms": "Per shard+replica ingest lag watermark (max acked sample timestamp, ms).",
+    "filodb_rebalance": "Live shard rebalance outcomes (clean|replayed|rebuilt|damped|failed).",
+    "filodb_rebalance_standing_moves": "Standing queries re-registered on a shard's new owner after a rebalance.",
 }
 
 
@@ -372,6 +378,46 @@ def record_shard_reassignment(shard: int, damped: bool) -> None:
         "filodb_shard_reassignments", shard=str(shard),
         outcome="down" if damped else "moved",
     ).inc()
+
+
+# -- replicated shard plane (coordinator/replication.py) ---------------------
+
+
+def record_replica_selection(which: str) -> None:
+    """A remote dispatch served by its primary replica or a sibling."""
+    REGISTRY.counter("filodb_replica_selection", which=which).inc()
+
+
+def record_replica_failover(endpoint: str, reason: str) -> None:
+    """A dispatch re-pinned away from a replica endpoint (breaker_open =
+    routed around before calling; endpoint_failure = failed then moved)."""
+    REGISTRY.counter(
+        "filodb_replica_failovers", endpoint=endpoint, reason=reason,
+    ).inc()
+
+
+def record_replica_ack(outcome: str) -> None:
+    """Ingest fan-out append outcome for one (shard, replica) leg."""
+    REGISTRY.counter("filodb_replica_acks", outcome=outcome).inc()
+
+
+def record_replica_watermark(shard: int, node: str, ts_ms: int) -> None:
+    """Lag watermark: the max sample timestamp a replica has acked. A
+    recovering replica serves queries only behind this mark."""
+    REGISTRY.gauge(
+        "filodb_replica_watermark_ms", shard=str(shard), node=node,
+    ).set(float(ts_ms))
+
+
+def record_rebalance(outcome: str) -> None:
+    """Live shard rebalance: clean (effect log proved no concurrent
+    ingest), replayed (tail re-replayed), rebuilt (full log replay),
+    damped, or failed."""
+    REGISTRY.counter("filodb_rebalance", outcome=outcome).inc()
+
+
+def record_rebalance_standing_move() -> None:
+    REGISTRY.counter("filodb_rebalance_standing_moves").inc()
 
 
 # -- query-phase taxonomy ----------------------------------------------------

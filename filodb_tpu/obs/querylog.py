@@ -256,6 +256,10 @@ class QueryLogRing:
             # this key (/debug/kernels indexes by it)
             "executable_key": info.get("executable_key"),
             "compile_miss": info.get("compile_miss"),
+            # replicated shard plane: the remote endpoint(s) that served the
+            # query's scatter legs — a failover shows up as the sibling's
+            # endpoint here (and in /api/v1/query_profile)
+            "endpoint": ",".join(info["endpoints"]) if info.get("endpoints") else None,
             "status": status,
             "error": error,
             "duration_ms": round(float(elapsed_s) * 1e3, 3),

@@ -271,7 +271,55 @@ def dispatch_child(child, ctx):
     if not getattr(child, "is_remote", False):
         return base(child, ctx)
     endpoint = getattr(child, "endpoint", None) or type(child).__name__
-    return call_with_retries(lambda: base(child, ctx), ctx, endpoint)
+    siblings = tuple(getattr(child, "sibling_endpoints", ()) or ())
+    if siblings and hasattr(child, "with_endpoint"):
+        return _dispatch_with_failover(child, ctx, base, endpoint, siblings)
+    res = call_with_retries(lambda: base(child, ctx), ctx, endpoint)
+    _note_endpoint(ctx, endpoint)
+    return res
+
+
+def _note_endpoint(ctx, endpoint: str) -> None:
+    """Record the serving endpoint on the query's observatory annotations so
+    the querylog entry (and /api/v1/query_profile) shows who answered."""
+    obs = getattr(ctx, "obs", None)
+    if obs is None:
+        return
+    eps = obs.setdefault("endpoints", [])
+    if endpoint not in eps:
+        eps.append(endpoint)
+
+
+def _dispatch_with_failover(child, ctx, base, endpoint, siblings):
+    """Replica failover: a breaker-open or endpoint-failure result on one
+    replica is a ROUTING signal — re-pin the leg to the next sibling replica
+    (same plan, same shard subset) before allow_partial_results is even
+    considered. Non-endpoint errors (real query errors) raise immediately:
+    a sibling would answer the same way."""
+    from ..metrics import record_replica_failover, record_replica_selection
+
+    cands = (endpoint,) + tuple(s for s in siblings if s != endpoint)
+    last_exc = None
+    for i, ep in enumerate(cands):
+        c = child if i == 0 else child.with_endpoint(ep)
+        try:
+            res = call_with_retries(lambda: base(c, ctx), ctx, ep)
+        except CircuitOpenError as e:
+            last_exc = e
+            if i + 1 < len(cands):
+                record_replica_failover(ep, "breaker_open")
+                continue
+            raise
+        except Exception as e:  # noqa: BLE001 — classified below
+            last_exc = e
+            if is_endpoint_failure(e) and i + 1 < len(cands):
+                record_replica_failover(ep, "endpoint_failure")
+                continue
+            raise
+        record_replica_selection("primary" if i == 0 else "sibling")
+        _note_endpoint(ctx, ep)
+        return res
+    raise last_exc
 
 
 def call_with_retries(fn, ctx, endpoint: str):

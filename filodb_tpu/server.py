@@ -94,6 +94,19 @@ class FiloServer:
         # total_shards pins the routing modulus to the CLUSTER size even when
         # this process owns a partial slice
         self.memstore.setup(Dataset(self.dataset), owned, total_shards=self.n_shards)
+        # shard plane view for GET /debug/cluster: this process's slice of
+        # the static topology, ACTIVE from boot (v2 static ownership). An
+        # embedding control plane may attach a ReplicationPlane to
+        # self.replication — its richer snapshot (replicas, watermarks,
+        # rebalances) takes over the endpoint.
+        from .coordinator.cluster import ShardManager, ShardStatus
+
+        self.replication = None
+        self.cluster_manager = ShardManager(self.n_shards,
+                                            shards_per_node=self.n_shards)
+        self.cluster_manager.nodes.append("self")
+        for s in owned:
+            self.cluster_manager.mapper.update(s, ShardStatus.ACTIVE, "self")
         for q in cfg.get("quotas", []):
             for sh in self.memstore.shards(self.dataset):
                 sh.cardinality.set_quota(tuple(q["prefix"]), int(q["quota"]))
@@ -373,6 +386,13 @@ class FiloServer:
         self.bootstrapper = None
         self.registry = None
 
+    def _cluster_snapshot(self) -> dict:
+        """GET /debug/cluster payload: the replication plane's snapshot when
+        one is attached, else the static shard-ownership view."""
+        if self.replication is not None:
+            return self.replication.snapshot()
+        return self.cluster_manager.snapshot()
+
     # -- lifecycle --------------------------------------------------------
 
     def recover(self) -> dict[int, int]:
@@ -412,6 +432,7 @@ class FiloServer:
             standing=self.standing,
             standing_system=self.system_standing,
             rollups=self.rollups,
+            cluster=self._cluster_snapshot,
         )
         if self.standing is not None:
             self.standing.start()

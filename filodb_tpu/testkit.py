@@ -270,3 +270,113 @@ def grpc_cluster(batch=None, n_shards: int = 4, owned=(0, 1),
         server.stop(grace=0)
 
     return parent_engine, peer_engine, stop
+
+
+# ---------------------------------------------------------------------------
+# replica topology (replicated shard plane chaos harness)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ReplicaNode:
+    """One data node of a :func:`replica_cluster`: its memstore + engine +
+    gRPC server, and the plane handle they register under."""
+
+    name: str
+    memstore: object
+    engine: object
+    server: object
+    endpoint: str
+    standing: object = None
+
+
+class ReplicaCluster:
+    """Front coordinator + N replicated data nodes, all in-process.
+
+    ``engine`` is the query edge: it owns NO shards and scatters every
+    selector through the ReplicaRouter (one shard-pinned gRPC leg per
+    selected replica, siblings attached for dispatch-layer failover).
+    ``kill(name)`` stops a node's gRPC server AND reports it to the plane —
+    the deterministic chaos primitive."""
+
+    def __init__(self, engine, plane, manager, router, nodes, breakers):
+        self.engine = engine
+        self.plane = plane
+        self.manager = manager
+        self.router = router
+        self.nodes: dict[str, ReplicaNode] = nodes
+        self.breakers = breakers
+
+    def kill(self, name: str) -> None:
+        n = self.nodes[name]
+        n.server.stop(grace=0)
+        self.plane.set_node_down(name)
+
+    def stop(self) -> None:
+        for n in self.nodes.values():
+            n.server.stop(grace=0)
+
+
+def replica_cluster(batch=None, n_shards: int = 4, num_nodes: int = 2,
+                    num_replicas: int = 2, dataset: str = "prometheus",
+                    spread: int = 2, deadline_s: float = 120.0,
+                    standing: bool = False, retry_policy=None,
+                    **params_kw) -> ReplicaCluster:
+    """In-process replicated cluster: ``num_nodes`` data nodes behind a
+    front coordinator, replication factor ``num_replicas``.
+
+    With the default 2 nodes / RF 2 / shards_per_node == n_shards, every
+    node replicates EVERY shard, so killing one node must serve bit-equal
+    results from the survivor. ``batch`` (if given) fans out through the
+    ReplicationPlane — the production ingest path, acks and watermarks
+    included. ``standing=True`` attaches a StandingEngine per data node so
+    rebalance handoff tests can follow standing queries across owners."""
+    from .api.grpc_exec import serve_grpc
+    from .coordinator.cluster import ShardManager, ShardStatus
+    from .coordinator.planner import PlannerParams, QueryEngine
+    from .coordinator.replication import ReplicaRouter, ReplicationPlane
+    from .core.schemas import Dataset
+    from .memstore.memstore import TimeSeriesMemStore
+    from .query.faults import BreakerRegistry, RetryPolicy
+
+    manager = ShardManager(n_shards, shards_per_node=n_shards,
+                           num_replicas=num_replicas)
+    plane = ReplicationPlane(manager, dataset, spread=spread)
+    common = dict(spread=spread, num_shards=n_shards, deadline_s=deadline_s,
+                  **params_kw)
+    nodes: dict[str, ReplicaNode] = {}
+    for i in range(num_nodes):
+        name = f"node-{i}"
+        ms = TimeSeriesMemStore()
+        ms.setup(Dataset(dataset), [], total_shards=n_shards)
+        engine = QueryEngine(ms, dataset, PlannerParams(**common))
+        server, port = serve_grpc(engine, port=0)
+        endpoint = f"grpc://127.0.0.1:{port}"
+        st = None
+        if standing:
+            from .standing.maintainer import StandingEngine
+
+            st = StandingEngine(engine)
+        plane.add_node(name, ms, endpoint=endpoint, standing=st)
+        manager.node_joined(name)
+        nodes[name] = ReplicaNode(name, ms, engine, server, endpoint, st)
+    # fresh topology: every replica is live from the start
+    for s in range(n_shards):
+        for node in list(manager.mapper.nodes_of(s)):
+            manager.mapper.set_replica(s, node, ShardStatus.ACTIVE)
+    if batch is not None:
+        plane.append(batch)
+    router = ReplicaRouter(plane)
+    breakers = BreakerRegistry()
+    if retry_policy is None:
+        # deterministic + fast: seeded jitter, no real sleeping — chaos
+        # outcomes must not depend on wall-clock scheduling
+        retry_policy = RetryPolicy(seed=0, sleep=lambda s: None)
+    ms_front = TimeSeriesMemStore()
+    ms_front.setup(Dataset(dataset), [], total_shards=n_shards)
+    front = QueryEngine(
+        ms_front, dataset,
+        PlannerParams(replica_router=router, breakers=breakers,
+                      retry_policy=retry_policy, **common),
+    )
+    return ReplicaCluster(front, plane, manager, router, nodes, breakers)
