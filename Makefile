@@ -2,7 +2,7 @@
 
 NATIVE_DIR := filodb_tpu/native
 
-.PHONY: all native test test-chaos test-index test-ingest-chaos test-jitter test-multichip test-observability test-replica test-rollup test-scheduler test-standing attest bench bench-smoke microbench serve clean tpu-watch tpu-watch-bg
+.PHONY: all native test test-alerting test-chaos test-index test-ingest-chaos test-jitter test-multichip test-observability test-replica test-rollup test-scheduler test-standing attest bench bench-smoke microbench serve clean tpu-watch tpu-watch-bg
 
 all: native
 
@@ -105,6 +105,15 @@ test-rollup: native
 test-replica: native
 	python -m pytest tests/test_replica.py -q -m replica
 
+# alerting plane suite (doc/observability.md "Alerting plane"): rule-file
+# schema validation, the per-labelset pending→firing state machine with an
+# injected clock (for:/keep_firing_for holds), ALERTS/ALERTS_FOR_STATE
+# write-back + rehydration across restart, notification grouping/dedup +
+# retry/backoff/breaker against a dead receiver, and the e2e proof:
+# injected 5xx -> SLO burn -> firing -> exactly ONE grouped webhook
+test-alerting: native
+	python -m pytest tests/test_alerting.py -q -m alerting
+
 # observability suite (doc/observability.md): trace propagation + stitching,
 # slow-query log, query observatory (per-phase decomposition, query-log
 # ring, _system round trips, SLO burn-rate rules), resource ledger +
@@ -112,7 +121,7 @@ test-replica: native
 # lint (every ExecPlan subclass executes under a span; every phase literal
 # canonical and every fused path decomposed) and the metrics-doc lint
 # (every filodb_* family emitted is documented, and vice versa)
-test-observability: native
+test-observability: native test-alerting
 	python tools/check_spans.py
 	python tools/check_metrics.py
 	python -m pytest tests/ -q -m "observability or chaos" --continue-on-collection-errors

@@ -13,8 +13,11 @@ Endpoints:
   GET      /api/v1/series?match[]=...
   GET      /api/v1/metadata (types from live schemas), /api/v1/status/buildinfo
   GET      /api/v1/query_exemplars (OpenMetrics exemplars ingested via /ingest/prom)
-  GET      /api/v1/rules, /api/v1/alerts — always empty: no rule engine
-           exists in this build, so the empty set is the truthful answer
+  GET      /api/v1/rules  (recording + alerting rule groups, Prometheus
+           shape; ?type=alert|record, ?state=inactive|pending|firing)
+  GET      /api/v1/alerts (active alerts from the alerting plane,
+           obs/alerting.py; ?state= filter)
+  POST     /api/v1/rules/record, /api/v1/rules/alert (runtime rules)
   GET      /admin/health
   POST     /ingest  (JSON lines of {metric, tags, ts_ms, value} — test/dev
            ingest transport; production path is the gateway)
@@ -108,6 +111,10 @@ class PromApiHandler(BaseHTTPRequestHandler):
     # RollupManager (downsample/rollup.py): the sketch-rollup summary
     # tier's admin surface, /debug/rollups. None = endpoint 404s.
     rollups = None
+    # AlertingEngine (obs/alerting.py): alerting rule groups + active
+    # alerts; serves /api/v1/alerts and merges its groups into
+    # /api/v1/rules. None = alerts list empty, no alerting groups.
+    alerting = None
     auth_token: str | None = None  # optional bearer auth (server factory)
     # zero-arg profiler report hook; wired by the server ONLY when the
     # profiler config block enables it (/debug/profile gate)
@@ -373,6 +380,8 @@ class PromApiHandler(BaseHTTPRequestHandler):
                 return self._send(200, J.success(self.standing.registry.snapshot()))
             if path == "/api/v1/rules/record" and self.command == "POST":
                 return self._rules_record()
+            if path == "/api/v1/rules/alert" and self.command == "POST":
+                return self._rules_alert()
             if path == "/debug/standing":
                 if self.standing is None:
                     return self._send(404, J.error("not_found", "standing engine disabled"))
@@ -382,16 +391,9 @@ class PromApiHandler(BaseHTTPRequestHandler):
                     return self._send(404, J.error("not_found", "rollup tier disabled"))
                 return self._send(200, J.success(self.rollups.snapshot()))
             if path == "/api/v1/rules":
-                # the truthful answer: recording rules from the standing
-                # engine AND the _system SLO maintainer when attached,
-                # else the empty set
-                groups: list = []
-                for eng in (self.standing, self.standing_system):
-                    if eng is not None:
-                        groups.extend(eng.rules_payload()["groups"])
-                return self._send(200, J.success({"groups": groups}))
+                return self._rules()
             if path == "/api/v1/alerts":
-                return self._send(200, J.success({"alerts": []}))
+                return self._alerts()
             if path == "/api/v1/status/flags" or path == "/api/v1/status/config":
                 return self._send(200, J.success({}))
             self._send(404, J.error("not_found", f"unknown path {path}"))
@@ -808,17 +810,22 @@ class PromApiHandler(BaseHTTPRequestHandler):
         """Query-observatory ring (doc/observability.md "Query
         observatory"): exemplar-level per-query cost records, newest
         first; ``?limit=`` caps the page, ``?fingerprint=`` keeps only one
-        normalized query shape (the filter applies BEFORE the limit, so a
-        page of a rare fingerprint is still a full page)."""
+        normalized query shape, ``?path=`` one execution path (e.g.
+        ``standing:delta`` — the alerting plane's evaluations filter out
+        this way). Filters apply BEFORE the limit, so a page of a rare
+        fingerprint/path is still a full page."""
         from ..obs.querylog import QUERY_LOG
 
         p = self._params()
         limit = self._q(p, "limit")
         fingerprint = self._q(p, "fingerprint")
+        path_f = self._q(p, "path")
         entries = QUERY_LOG.entries(None)
         if fingerprint:
             entries = [e for e in entries
                        if e.get("fingerprint") == fingerprint]
+        if path_f:
+            entries = [e for e in entries if e.get("path") == path_f]
         if limit:
             entries = entries[: int(limit)]
         return self._send(200, J.success(entries))
@@ -933,6 +940,106 @@ class PromApiHandler(BaseHTTPRequestHandler):
             rule_name=str(name), eval_interval_s=float(interval_s),
         )
         return self._send(200, J.success(sq.snapshot()))
+
+    # -- alerting plane (obs/alerting.py) ----------------------------------
+
+    def _rules_alert(self):
+        """Register an alerting rule at runtime: the rule-file spec as
+        JSON (``{"alert", "expr", "for"?, "keep_firing_for"?, "labels"?,
+        "annotations"?}``) plus optional ``"group"`` (default ``api``) and
+        ``"interval"``."""
+        if self.alerting is None:
+            return self._send(
+                404, J.error("not_found", "alerting plane disabled")
+            )
+        from ..obs.alerting import RuleFileError
+
+        p = self._params()
+        body = self._json_body(p)
+        group = str(body.pop("group", "") or "api")
+        interval = body.pop("interval", None)
+        interval_s = (_parse_step(str(interval))
+                      if interval is not None else None)
+        try:
+            rule = self.alerting.add_rule(body, group=group,
+                                          interval_s=interval_s)
+        except RuleFileError as e:
+            return self._send(400, J.error("bad_data", str(e)))
+        return self._send(200, J.success({
+            "group": group,
+            "name": rule.name,
+            "query": rule.expr,
+            "duration": rule.for_s,
+            "keepFiringFor": rule.keep_firing_for_s,
+            "type": "alerting",
+        }))
+
+    def _rules(self):
+        """Prometheus ``GET /api/v1/rules``: the standing engines'
+        runtime-registered recording rules (synthetic ``standing`` group)
+        plus the alerting plane's loaded groups — top-level ``groups``,
+        rule ``type`` recording|alerting, camelCase eval fields.
+        ``?type=alert|record`` and ``?state=`` filter rules (a state
+        filter keeps only alerting rules — recording rules have no
+        state); groups a filter empties are dropped."""
+        from ..obs.alerting import ALERT_STATES
+
+        p = self._params()
+        rtype = self._q(p, "type")
+        state = self._q(p, "state")
+        if rtype and rtype not in ("alert", "record"):
+            return self._send(400, J.error(
+                "bad_data", "type must be alert|record"
+            ))
+        if state and state not in ALERT_STATES:
+            return self._send(400, J.error(
+                "bad_data",
+                f"state must be one of {'|'.join(ALERT_STATES)}",
+            ))
+        groups: list = []
+        # names the alerting plane owns: its file/API-registered recording
+        # rules also live in the standing registry, so the synthetic
+        # `standing` group must not double-list them
+        owned = (self.alerting.rule_names()
+                 if self.alerting is not None else set())
+        for eng in (self.standing, self.standing_system):
+            if eng is not None:
+                for g in eng.rules_payload()["groups"]:
+                    g["rules"] = [r for r in g["rules"]
+                                  if r["name"] not in owned]
+                    groups.append(g)
+        if self.alerting is not None:
+            groups.extend(self.alerting.rules_payload()["groups"])
+        want = {"alert": "alerting", "record": "recording"}.get(rtype)
+        out = []
+        for g in groups:
+            rules = g["rules"]
+            if want:
+                rules = [r for r in rules if r["type"] == want]
+            if state:
+                rules = [r for r in rules if r.get("state") == state]
+            if not rules:
+                continue
+            out.append({**g, "rules": rules})
+        return self._send(200, J.success({"groups": out}))
+
+    def _alerts(self):
+        """Prometheus ``GET /api/v1/alerts``: active (pending|firing)
+        alerts with expanded annotations; ``?state=`` filters."""
+        from ..obs.alerting import ALERT_STATES
+
+        p = self._params()
+        state = self._q(p, "state")
+        if state and state not in ALERT_STATES:
+            return self._send(400, J.error(
+                "bad_data",
+                f"state must be one of {'|'.join(ALERT_STATES)}",
+            ))
+        if self.alerting is None:
+            return self._send(200, J.success({"alerts": []}))
+        return self._send(200, J.success(
+            self.alerting.alerts_payload(state)
+        ))
 
     def _standing_subscribe(self):
         """SSE push stream for one standing query: the initial frame is
@@ -1118,7 +1225,8 @@ def make_server(engine: QueryEngine, host: str = "127.0.0.1", port: int = 9090,
                 flush_hook=None,
                 dataset_engines: dict | None = None,
                 standing=None, standing_system=None,
-                rollups=None, cluster=None) -> ThreadingHTTPServer:
+                rollups=None, alerting=None,
+                cluster=None) -> ThreadingHTTPServer:
     # membership hooks (members_hook/join_hook) are wired as class attrs on
     # the returned server's RequestHandlerClass AFTER start — the registry
     # needs the bound port for its self URL (server.py seed bootstrap)
@@ -1128,7 +1236,7 @@ def make_server(engine: QueryEngine, host: str = "127.0.0.1", port: int = 9090,
         {"engine": engine, "auth_token": auth_token, "local_engine": local_engine,
          "dataset_engines": dict(dataset_engines or {}),
          "standing": standing, "standing_system": standing_system,
-         "rollups": rollups,
+         "rollups": rollups, "alerting": alerting,
          "cluster_hook": staticmethod(cluster) if cluster else None,
          "flush_hook": staticmethod(flush_hook) if flush_hook else None},
     )
@@ -1140,11 +1248,11 @@ def serve_background(engine: QueryEngine, host: str = "127.0.0.1", port: int = 0
                      local_engine: QueryEngine | None = None,
                      flush_hook=None, dataset_engines: dict | None = None,
                      standing=None, standing_system=None, rollups=None,
-                     cluster=None):
+                     alerting=None, cluster=None):
     """Start the API server on a thread; returns (server, actual_port)."""
     srv = make_server(engine, host, port, auth_token, local_engine, flush_hook,
                       dataset_engines, standing, standing_system, rollups,
-                      cluster)
+                      alerting, cluster)
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
     return srv, srv.server_address[1]

@@ -232,6 +232,26 @@ DEFAULTS: dict = {
         "windows": ["5m", "1h"],
         "interval_s": 15.0,
     },
+    # alerting plane (obs/alerting.py + obs/notify.py, doc/observability.md
+    # "Alerting plane"): Prometheus-compatible alerting rule groups loaded
+    # from rule_files (globs) and POST /api/v1/rules/alert, evaluated on
+    # the _system standing engine (each rule's expr is a standing query;
+    # the newest closed step feeds the inactive→pending→firing state
+    # machine), with state written back as ALERTS / ALERTS_FOR_STATE
+    # series (restart-safe via rehydrate_lookback_ms) and firing alerts
+    # fanned out to Alertmanager-v2 webhook receivers
+    # ([{name, url, group_by, group_wait, group_interval, repeat_interval,
+    # send_resolved}]). enabled null = auto: on exactly when the _system
+    # standing engine runs.
+    "alerting": {
+        "enabled": None,
+        "rule_files": [],
+        "default_interval_s": 15.0,
+        "rehydrate_lookback_ms": 3_600_000,
+        "notify_tick_s": 1.0,
+        "notify_deadline_s": 10.0,
+        "receivers": [],
+    },
 }
 
 

@@ -184,6 +184,10 @@ HELP_TEXTS: dict[str, str] = {
     "filodb_replica_watermark_ms": "Per shard+replica ingest lag watermark (max acked sample timestamp, ms).",
     "filodb_rebalance": "Live shard rebalance outcomes (clean|replayed|rebuilt|damped|failed).",
     "filodb_rebalance_standing_moves": "Standing queries re-registered on a shard's new owner after a rebalance.",
+    "filodb_alerts": "Alerting rules/labelsets by state (inactive|pending|firing).",
+    "filodb_alert_eval_seconds": "Alert-rule evaluation latency (state machine + write-back per tick).",
+    "filodb_alert_eval_failures": "Alert-rule evaluation failures, per rule (refresh errors included).",
+    "filodb_alert_notify": "Alert notification deliveries per receiver and outcome (ok|retry|error|breaker_open).",
 }
 
 

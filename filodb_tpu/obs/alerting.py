@@ -1,0 +1,835 @@
+"""Alerting plane: rule groups + the pending→firing state machine riding
+the standing-query engine (ISSUE 18; doc/observability.md "Alerting
+plane").
+
+An alerting rule is a standing query plus a threshold state machine
+(Tailwind's explicit-obligation framing — PAPERS.md): the rule's ``expr``
+registers on the :class:`~filodb_tpu.standing.maintainer.StandingEngine`
+with an ``alert_sink``, so the maintainer's delta-refreshed newest closed
+step — never a separate dispatch plane — feeds each evaluation. Every
+evaluation therefore already leaves a querylog record
+(``path=standing:delta|standing:full``) and alerting cost is attributable
+like any other tenant.
+
+Per label set the machine walks ``inactive → pending → firing``
+(Prometheus semantics):
+
+- the expr returning a sample CREATES a pending alert (or fires
+  immediately when ``for: 0``);
+- a pending alert held continuously for ``for:`` promotes to firing;
+- absence resolves: a pending alert drops straight back to inactive
+  (never notified), a firing one resolves — unless ``keep_firing_for``
+  still covers the gap (flap suppression).
+
+State is durable the FiloDB way: every evaluation writes
+``ALERTS{alertname,alertstate,...}`` (value 1) and
+``ALERTS_FOR_STATE{alertname,...}`` (value = seconds since the alert went
+active — an age, not Prometheus's absolute epoch, because the store's f32
+value column resolves epochs only to ±64s but holds ages to sub-ms)
+back through the production ingest path into the engine's dataset
+(``_system`` in the server wiring), so firing state is queryable through
+the fused path and :meth:`AlertingEngine.rehydrate` restores it across a
+restart from the same series it wrote.
+
+Rule groups load from YAML files (``conf/rules/*.yml``; schema-checked at
+load — an invalid file raises :class:`RuleFileError` naming the exact
+group/rule) and register at runtime (``POST /api/v1/rules/alert``).
+Firing alerts fan out to Alertmanager-v2-compatible webhook receivers via
+:class:`~filodb_tpu.obs.notify.Notifier`.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import hashlib
+import logging
+import re
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ..metrics import REGISTRY
+
+log = logging.getLogger("filodb_tpu.obs.alerting")
+
+# canonical alertstate values (linted by tools/check_metrics.py against
+# doc/observability.md): `inactive` never appears on ALERTS series (an
+# inactive alert has no series), only in the filodb_alerts gauge + the
+# /api/v1/rules state rollup
+ALERT_STATES = ("inactive", "pending", "firing")
+
+# the synthetic series families alert state writes back as
+ALERTS_SERIES = "ALERTS"
+ALERTS_FOR_STATE_SERIES = "ALERTS_FOR_STATE"
+
+# Prometheus rule/metric name charset
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+# labels the state machine owns; a rule declaring them would collide with
+# its own write-back
+_RESERVED_LABELS = ("alertname", "alertstate")
+
+DEFAULTS: dict = {
+    # null = auto: on exactly when the _system standing engine runs
+    "enabled": None,
+    # globs, resolved relative to the process cwd (conf/rules/*.yml)
+    "rule_files": [],
+    # evaluation cadence for groups that don't set `interval:`
+    "default_interval_s": 15.0,
+    # how far back rehydrate() searches ALERTS_FOR_STATE on startup
+    "rehydrate_lookback_ms": 3_600_000,
+    # notifier cadence + per-delivery deadline budget (obs/notify.py)
+    "notify_tick_s": 1.0,
+    "notify_deadline_s": 10.0,
+    # Alertmanager-v2 webhook receivers (obs/notify.py Receiver fields)
+    "receivers": [],
+}
+
+
+class RuleFileError(ValueError):
+    """A rule file/spec failed schema validation — the message names the
+    file, group and rule so a bad deploy is a one-line diagnosis."""
+
+
+def rfc3339(ms: int) -> str:
+    """Prometheus API timestamp rendering; <= 0 is the API's zero time."""
+    if ms <= 0:
+        return "0001-01-01T00:00:00Z"
+    t = time.gmtime(ms / 1000.0)
+    return (f"{t.tm_year:04d}-{t.tm_mon:02d}-{t.tm_mday:02d}"
+            f"T{t.tm_hour:02d}:{t.tm_min:02d}:{t.tm_sec:02d}"
+            f".{int(ms % 1000):03d}Z")
+
+
+_TMPL = re.compile(
+    r"\{\{\s*\$(?:labels\.([a-zA-Z_][a-zA-Z0-9_]*)|(value))\s*\}\}"
+    r"|\$(?:labels\.([a-zA-Z_][a-zA-Z0-9_]*)|(value))"
+)
+
+
+def expand_template(text: str, labels: dict, value: float) -> str:
+    """Annotation templating: ``{{ $labels.x }}`` / ``{{ $value }}`` (and
+    the brace-less shorthand). Unknown labels expand to the empty string —
+    an annotation typo must not fail an evaluation."""
+
+    def _sub(m: re.Match) -> str:
+        name = m.group(1) or m.group(3)
+        if name is not None:
+            return str(labels.get(name, ""))
+        return f"{float(value):g}"
+
+    return _TMPL.sub(_sub, str(text))
+
+
+def fingerprint(labels: dict) -> str:
+    """Stable per-labelset identity (alertstate excluded — state changes
+    must not change identity)."""
+    h = hashlib.blake2b(digest_size=8)
+    for k, v in sorted(labels.items()):
+        if k == "alertstate":
+            continue
+        h.update(k.encode())
+        h.update(b"\x00")
+        h.update(str(v).encode())
+        h.update(b"\x00")
+    return h.hexdigest()
+
+
+def _duration_s(val, where: str) -> float:
+    """Rule-file duration: a bare number is seconds, a string is a PromQL
+    duration (``30s``, ``5m``)."""
+    if val is None:
+        return 0.0
+    if isinstance(val, bool):
+        raise RuleFileError(f"{where}: expected a duration, got {val!r}")
+    if isinstance(val, (int, float)):
+        if val < 0:
+            raise RuleFileError(f"{where}: duration must be >= 0")
+        return float(val)
+    from ..query.promql import PromQLError, parse_duration_ms
+
+    try:
+        return parse_duration_ms(str(val)) / 1000.0
+    except PromQLError as e:
+        raise RuleFileError(f"{where}: bad duration {val!r}: {e}") from e
+
+
+@dataclass
+class ActiveAlert:
+    """One labelset currently pending or firing for one rule."""
+
+    labels: dict
+    annotations: dict
+    state: str  # pending | firing
+    active_at_ms: int  # when the condition first became true
+    value: float
+    last_true_ms: int  # newest eval where the condition held (flap clock)
+    fired_at_ms: int = 0
+    fingerprint: str = ""
+
+    def payload(self) -> dict:
+        """Prometheus `/api/v1/alerts` entry shape."""
+        return {
+            "labels": dict(self.labels),
+            "annotations": dict(self.annotations),
+            "state": self.state,
+            "activeAt": rfc3339(self.active_at_ms),
+            "value": f"{self.value:g}",
+        }
+
+
+@dataclass
+class AlertRule:
+    name: str
+    expr: str
+    for_s: float = 0.0
+    keep_firing_for_s: float = 0.0
+    labels: dict = field(default_factory=dict)
+    annotations: dict = field(default_factory=dict)
+    group: str = ""
+    file: str = ""
+    # runtime state
+    sq: object = field(default=None, repr=False)
+    active: dict = field(default_factory=dict, repr=False)  # fp -> ActiveAlert
+    eval_duration_s: float = 0.0
+    last_eval_s: float = 0.0
+    last_error: str | None = None
+
+    def state(self) -> str:
+        states = {a.state for a in self.active.values()}
+        if "firing" in states:
+            return "firing"
+        if "pending" in states:
+            return "pending"
+        return "inactive"
+
+
+@dataclass
+class RecordingRule:
+    name: str
+    expr: str
+    group: str = ""
+    file: str = ""
+    sq: object = field(default=None, repr=False)
+
+
+@dataclass
+class RuleGroup:
+    name: str
+    file: str
+    interval_s: float
+    rules: list = field(default_factory=list)
+
+
+def _parse_string_map(val, where: str, reserved: tuple = ()) -> dict:
+    if val is None:
+        return {}
+    if not isinstance(val, dict):
+        raise RuleFileError(f"{where}: expected a mapping, got "
+                            f"{type(val).__name__}")
+    out = {}
+    for k, v in val.items():
+        if not isinstance(k, str) or not _LABEL_RE.match(k):
+            raise RuleFileError(f"{where}: bad label name {k!r}")
+        if k in reserved:
+            raise RuleFileError(
+                f"{where}: label {k!r} is reserved for the state machine"
+            )
+        if isinstance(v, bool) or not isinstance(v, (str, int, float)):
+            raise RuleFileError(f"{where}: label {k!r} value must be a "
+                                f"string/number, got {type(v).__name__}")
+        out[k] = str(v)
+    return out
+
+
+def parse_rule_spec(spec, where: str, group: str = "",
+                    file: str = ""):
+    """One rule mapping → :class:`AlertRule` | :class:`RecordingRule`,
+    schema-checked (shared by file loading and the runtime API)."""
+    if not isinstance(spec, dict):
+        raise RuleFileError(f"{where}: rule must be a mapping")
+    kind = [k for k in ("alert", "record") if k in spec]
+    if len(kind) != 1:
+        raise RuleFileError(
+            f"{where}: rule needs exactly one of `alert:` / `record:`"
+        )
+    name = spec[kind[0]]
+    if not isinstance(name, str) or not _NAME_RE.match(name):
+        raise RuleFileError(f"{where}: bad rule name {name!r}")
+    expr = spec.get("expr")
+    if not isinstance(expr, str) or not expr.strip():
+        raise RuleFileError(f"{where}: rule {name!r} needs a non-empty "
+                            f"`expr:`")
+    if kind[0] == "record":
+        extra = set(spec) - {"record", "expr"}
+        if "labels" in extra:
+            raise RuleFileError(
+                f"{where}: recording rule {name!r}: `labels:` is not "
+                f"supported (write-back keys series by group labels only)"
+            )
+        if extra:
+            raise RuleFileError(
+                f"{where}: recording rule {name!r}: unknown keys "
+                f"{sorted(extra)}"
+            )
+        return RecordingRule(name=name, expr=expr.strip(), group=group,
+                             file=file)
+    allowed = {"alert", "expr", "for", "keep_firing_for", "labels",
+               "annotations"}
+    extra = set(spec) - allowed
+    if extra:
+        raise RuleFileError(
+            f"{where}: alerting rule {name!r}: unknown keys {sorted(extra)}"
+        )
+    return AlertRule(
+        name=name, expr=expr.strip(),
+        for_s=_duration_s(spec.get("for"), f"{where}: {name} for"),
+        keep_firing_for_s=_duration_s(
+            spec.get("keep_firing_for"), f"{where}: {name} keep_firing_for"
+        ),
+        labels=_parse_string_map(spec.get("labels"),
+                                 f"{where}: {name} labels",
+                                 reserved=_RESERVED_LABELS),
+        annotations=_parse_string_map(spec.get("annotations"),
+                                      f"{where}: {name} annotations"),
+        group=group, file=file,
+    )
+
+
+def parse_rule_groups(doc, file: str = "") -> list[RuleGroup]:
+    """One parsed YAML document → schema-checked :class:`RuleGroup` list
+    (Prometheus rule-file layout: top-level ``groups:`` only)."""
+    where = file or "<rules>"
+    if not isinstance(doc, dict):
+        raise RuleFileError(f"{where}: rule file must be a mapping")
+    extra = set(doc) - {"groups"}
+    if extra:
+        raise RuleFileError(f"{where}: unknown top-level keys "
+                            f"{sorted(extra)}")
+    groups_raw = doc.get("groups")
+    if not isinstance(groups_raw, list):
+        raise RuleFileError(f"{where}: `groups:` must be a list")
+    out: list[RuleGroup] = []
+    seen: set[str] = set()
+    for gi, g in enumerate(groups_raw):
+        gwhere = f"{where}: groups[{gi}]"
+        if not isinstance(g, dict):
+            raise RuleFileError(f"{gwhere}: group must be a mapping")
+        extra = set(g) - {"name", "interval", "rules"}
+        if extra:
+            raise RuleFileError(f"{gwhere}: unknown keys {sorted(extra)}")
+        name = g.get("name")
+        if not isinstance(name, str) or not name.strip():
+            raise RuleFileError(f"{gwhere}: group needs a non-empty "
+                                f"`name:`")
+        if name in seen:
+            raise RuleFileError(f"{gwhere}: duplicate group name {name!r}")
+        seen.add(name)
+        interval_s = _duration_s(g.get("interval"), f"{gwhere}: interval")
+        rules_raw = g.get("rules")
+        if not isinstance(rules_raw, list) or not rules_raw:
+            raise RuleFileError(f"{gwhere}: group {name!r} needs a "
+                                f"non-empty `rules:` list")
+        grp = RuleGroup(name=name, file=file, interval_s=interval_s)
+        rnames: set[str] = set()
+        for ri, spec in enumerate(rules_raw):
+            rule = parse_rule_spec(
+                spec, f"{where}: group {name!r} rules[{ri}]",
+                group=name, file=file,
+            )
+            if rule.name in rnames:
+                raise RuleFileError(
+                    f"{gwhere}: duplicate rule name {rule.name!r} in "
+                    f"group {name!r}"
+                )
+            rnames.add(rule.name)
+            grp.rules.append(rule)
+        out.append(grp)
+    return out
+
+
+def load_rule_file(path: str) -> list[RuleGroup]:
+    """Parse + schema-check one YAML rule file."""
+    import yaml
+
+    with open(path, encoding="utf-8") as f:
+        try:
+            doc = yaml.safe_load(f)
+        except yaml.YAMLError as e:
+            raise RuleFileError(f"{path}: invalid YAML: {e}") from e
+    return parse_rule_groups(doc, file=path)
+
+
+class _Sink:
+    """The ``alert_sink`` callable registered on the standing query —
+    carries the rule name so the maintainer can label eval failures it
+    intercepts before the sink ever runs."""
+
+    def __init__(self, engine: "AlertingEngine", rule: AlertRule):
+        self._engine = engine
+        self._rule = rule
+        self.rule = rule.name
+
+    def __call__(self, sq, end_ms: int, vec: list) -> None:
+        self._engine._eval_rule(self._rule, end_ms, vec)
+
+
+class AlertingEngine:
+    """Rule groups + per-labelset state machines, bound to one
+    StandingEngine (the server binds the ``_system`` one)."""
+
+    def __init__(self, standing, config: dict | None = None, notifier=None):
+        self.cfg = {**DEFAULTS, **(config or {})}
+        self.standing = standing
+        self.clock = standing.clock
+        self.notifier = notifier
+        self.groups: dict[str, RuleGroup] = {}
+        self._lock = threading.RLock()
+        if notifier is not None:
+            notifier.alerts_source = self.firing_alerts
+        # scrape-time gauge: filodb_alerts{alertstate} mirrors live state
+        REGISTRY.register_collector(f"alerting:{id(self)}",
+                                    self._publish_gauges)
+
+    # -- rule loading / registration --------------------------------------
+
+    def load_rule_files(self, patterns=None) -> int:
+        """Glob + parse + register every configured rule file. Schema
+        errors RAISE (a bad rule file is a deploy error, not a runtime
+        hiccup); an individual rule failing to PLAN logs and is skipped,
+        like the SLO set. Returns the number of rules registered."""
+        if patterns is None:
+            patterns = self.cfg.get("rule_files") or []
+        if isinstance(patterns, str):
+            patterns = [patterns]
+        n = 0
+        for pat in patterns:
+            paths = sorted(_glob.glob(pat)) or []
+            if not paths:
+                log.warning("alerting: rule file pattern %r matched "
+                            "nothing", pat)
+            for path in paths:
+                for grp in load_rule_file(path):
+                    n += self._add_group(grp)
+        return n
+
+    def _add_group(self, grp: RuleGroup) -> int:
+        with self._lock:
+            if grp.name in self.groups:
+                raise RuleFileError(
+                    f"{grp.file or '<rules>'}: duplicate group name "
+                    f"{grp.name!r} (already loaded from "
+                    f"{self.groups[grp.name].file or '<runtime>'})"
+                )
+            self.groups[grp.name] = grp
+        n = 0
+        for rule in grp.rules:
+            if self._register(grp, rule):
+                n += 1
+        return n
+
+    def add_rule(self, spec: dict, group: str = "api",
+                 interval_s: float | None = None):
+        """Runtime registration (``POST /api/v1/rules/alert``): one rule
+        spec in the same shape a rule file carries. Raises
+        :class:`RuleFileError` on schema problems, ValueError when the
+        expr fails to plan."""
+        rule = parse_rule_spec(spec, "<api>", group=group, file="")
+        with self._lock:
+            grp = self.groups.get(group)
+            if grp is None:
+                grp = RuleGroup(
+                    name=group, file="",
+                    interval_s=(float(interval_s) if interval_s
+                                else float(self.cfg["default_interval_s"])),
+                )
+                self.groups[group] = grp
+            if any(r.name == rule.name and type(r) is type(rule)
+                   for r in grp.rules):
+                raise RuleFileError(
+                    f"group {group!r} already has a rule named "
+                    f"{rule.name!r}"
+                )
+            grp.rules.append(rule)
+        if not self._register(grp, rule, raise_on_error=True):
+            with self._lock:
+                grp.rules.remove(rule)
+                if not grp.rules:
+                    self.groups.pop(group, None)
+            raise ValueError(f"rule {rule.name!r} failed to register")
+        return rule
+
+    def _register(self, grp: RuleGroup, rule,
+                  raise_on_error: bool = False) -> bool:
+        interval_s = grp.interval_s or float(self.cfg["default_interval_s"])
+        step_ms = max(int(interval_s * 1000), 1)
+        try:
+            if isinstance(rule, AlertRule):
+                rule.sq = self.standing.register(
+                    rule.expr, step_ms, span_ms=4 * step_ms,
+                    source="alert", eval_interval_s=interval_s,
+                    alert_sink=_Sink(self, rule),
+                )
+            else:
+                rule.sq = self.standing.register(
+                    rule.expr, step_ms, span_ms=4 * step_ms,
+                    source="rule", rule_name=rule.name,
+                    eval_interval_s=interval_s,
+                )
+            return True
+        except Exception:  # noqa: BLE001 — one sick rule must not kill the set
+            if raise_on_error:
+                raise
+            log.exception("alerting: rule %s (%s) failed to register",
+                          rule.name, grp.name)
+            return False
+
+    # -- evaluation (called by the standing maintainer's alert_sink) ------
+
+    def _eval_rule(self, rule: AlertRule, end_ms: int, vec: list) -> None:
+        """One evaluation tick: walk the state machine over the newest
+        closed step's per-group column, write ALERTS/ALERTS_FOR_STATE
+        back, and hand resolved alerts to the notifier."""
+        t0 = time.perf_counter()
+        try:
+            with self._lock:
+                resolved = self._step_state(rule, end_ms, vec)
+                recs = self._state_recs(rule, end_ms)
+            self._write_back(recs)
+            if resolved and self.notifier is not None:
+                self.notifier.note_resolved(resolved)
+            rule.last_error = None
+        except Exception as e:  # noqa: BLE001 — alerting must not kill refresh
+            rule.last_error = f"{type(e).__name__}: {e}"
+            REGISTRY.counter("filodb_alert_eval_failures",
+                             rule=rule.name).inc()
+            log.exception("alert rule %s evaluation failed", rule.name)
+        finally:
+            rule.eval_duration_s = time.perf_counter() - t0
+            rule.last_eval_s = self.clock()
+            REGISTRY.histogram("filodb_alert_eval_seconds").observe(
+                rule.eval_duration_s
+            )
+
+    def _alert_labels(self, rule: AlertRule, series_labels: dict) -> dict:
+        from ..core.schemas import METRIC_TAG
+
+        labels = {k: str(v) for k, v in series_labels.items()
+                  if k not in (METRIC_TAG, "__name__")}
+        labels.update(rule.labels)
+        labels["alertname"] = rule.name
+        return labels
+
+    def _step_state(self, rule: AlertRule, end_ms: int,
+                    vec: list) -> list[dict]:
+        """The per-labelset state machine (caller holds self._lock).
+        Returns resolved-alert dicts for the notifier."""
+        seen: set[str] = set()
+        for series_labels, value in vec:
+            labels = self._alert_labels(rule, dict(series_labels))
+            fp = fingerprint(labels)
+            seen.add(fp)
+            a = rule.active.get(fp)
+            if a is None:
+                a = ActiveAlert(
+                    labels=labels, annotations={}, state="pending",
+                    active_at_ms=end_ms, value=float(value),
+                    last_true_ms=end_ms, fingerprint=fp,
+                )
+                rule.active[fp] = a
+            a.value = float(value)
+            a.last_true_ms = end_ms
+            if (a.state == "pending"
+                    and end_ms - a.active_at_ms >= rule.for_s * 1000):
+                a.state = "firing"
+                a.fired_at_ms = end_ms
+            # annotations re-expand every eval: $value tracks the series
+            a.annotations = {
+                k: expand_template(v, labels, a.value)
+                for k, v in rule.annotations.items()
+            }
+        resolved: list[dict] = []
+        for fp in [fp for fp in rule.active if fp not in seen]:
+            a = rule.active[fp]
+            if a.state == "pending":
+                # never fired → never notified: straight back to inactive
+                del rule.active[fp]
+                continue
+            if (rule.keep_firing_for_s > 0
+                    and end_ms - a.last_true_ms
+                    < rule.keep_firing_for_s * 1000):
+                continue  # flap suppression: hold firing through the gap
+            del rule.active[fp]
+            resolved.append({
+                "fingerprint": a.fingerprint,
+                "labels": dict(a.labels),
+                "annotations": dict(a.annotations),
+                "starts_at_ms": a.fired_at_ms or a.active_at_ms,
+                "ends_at_ms": end_ms,
+            })
+        return resolved
+
+    def _state_recs(self, rule: AlertRule, end_ms: int) -> list:
+        """(series name, tags, t, v) write-back records for every active
+        alert of one rule (caller holds self._lock)."""
+        recs = []
+        for a in rule.active.values():
+            recs.append((ALERTS_SERIES,
+                         {**a.labels, "alertstate": a.state},
+                         end_ms, 1.0))
+            # the value is the alert's AGE in seconds, not the absolute
+            # epoch Prometheus stores: the store's value column is f32,
+            # where epoch seconds round to ±64s but ages stay sub-ms
+            # accurate for days — rehydrate() subtracts the age from the
+            # exact int64 sample timestamp to recover active_at
+            recs.append((ALERTS_FOR_STATE_SERIES, dict(a.labels),
+                         end_ms, (end_ms - a.active_at_ms) / 1000.0))
+        return recs
+
+    def _write_back(self, recs: list) -> None:
+        """State → series, through the production ingest path: the same
+        routing/quota/cardinality machinery every tenant pays."""
+        if not recs:
+            return
+        from ..core.records import gauge_batch
+
+        engine = self.standing.engine
+        by_name: dict[str, list] = {}
+        for name, tags, t, v in recs:
+            by_name.setdefault(name, []).append((tags, int(t), float(v)))
+        for name, rows in by_name.items():
+            try:
+                engine.memstore.ingest_routed(
+                    self.standing.dataset, gauge_batch(name, rows),
+                    spread=engine.planner.params.spread,
+                )
+            except Exception:  # noqa: BLE001 — quota/cardinality shed
+                log.exception("alert state write-back failed: %s", name)
+
+    # -- restart safety ----------------------------------------------------
+
+    def rehydrate(self, now_ms: int | None = None) -> int:
+        """Restore pending/firing state from the ``ALERTS_FOR_STATE``
+        series this process (or its predecessor) wrote — an alert that was
+        already firing must not restart its ``for:`` clock just because
+        the server restarted. Returns the number of alerts restored."""
+        import numpy as np
+
+        if now_ms is None:
+            now_ms = int(self.clock() * 1000)
+        lookback = int(self.cfg["rehydrate_lookback_ms"])
+        with self._lock:
+            rules = {r.name: r
+                     for g in self.groups.values() for r in g.rules
+                     if isinstance(r, AlertRule)}
+        if not rules:
+            return 0
+        step_s = min(
+            [g.interval_s or float(self.cfg["default_interval_s"])
+             for g in self.groups.values()]
+            or [float(self.cfg["default_interval_s"])]
+        )
+        try:
+            res = self.standing.engine.query_range(
+                ALERTS_FOR_STATE_SERIES,
+                (now_ms - lookback) / 1000.0, now_ms / 1000.0,
+                max(step_s, 1.0),
+            )
+        except Exception:  # noqa: BLE001 — a cold store has no state to restore
+            log.exception("alert rehydration query failed")
+            return 0
+        from ..core.schemas import METRIC_TAG
+
+        restored = 0
+        with self._lock:
+            for g in res.grids:
+                vals = np.asarray(g.values_np(), dtype=float)
+                times = g.step_times_ms()
+                for gi, lbl in enumerate(g.labels):
+                    labels = {k: str(v) for k, v in dict(lbl).items()
+                              if k not in (METRIC_TAG, "__name__")}
+                    rule = rules.get(labels.get("alertname", ""))
+                    if rule is None:
+                        continue
+                    row = vals[gi]
+                    ok = ~np.isnan(row)
+                    if not ok.any():
+                        continue
+                    # each written sample satisfies grid_time - age*1000
+                    # == active_at exactly; lookback carry-forward only
+                    # inflates the difference, so the MINIMUM over the
+                    # row recovers active_at to within one grid step
+                    active_at_ms = int(
+                        np.min(times[ok] - row[ok] * 1000.0)
+                    )
+                    fp = fingerprint(labels)
+                    if fp in rule.active:
+                        continue
+                    state = ("firing"
+                             if now_ms - active_at_ms >= rule.for_s * 1000
+                             else "pending")
+                    rule.active[fp] = ActiveAlert(
+                        labels=labels,
+                        annotations={
+                            k: expand_template(v, labels, float("nan"))
+                            for k, v in rule.annotations.items()
+                        },
+                        state=state, active_at_ms=active_at_ms,
+                        value=float("nan"), last_true_ms=now_ms,
+                        fired_at_ms=(active_at_ms if state == "firing"
+                                     else 0),
+                        fingerprint=fp,
+                    )
+                    restored += 1
+        if restored:
+            log.info("alerting: rehydrated %d active alert(s) from %s",
+                     restored, ALERTS_FOR_STATE_SERIES)
+        return restored
+
+    # -- API payloads ------------------------------------------------------
+
+    def alerts_payload(self, state: str | None = None) -> dict:
+        """Prometheus ``GET /api/v1/alerts`` data shape."""
+        with self._lock:
+            alerts = [a.payload()
+                      for g in self.groups.values() for r in g.rules
+                      if isinstance(r, AlertRule)
+                      for a in r.active.values()]
+        if state:
+            alerts = [a for a in alerts if a["state"] == state]
+        return {"alerts": alerts}
+
+    def rules_payload(self) -> dict:
+        """Prometheus ``GET /api/v1/rules`` data shape for the loaded
+        groups (both rule types; camelCase eval fields)."""
+        groups = []
+        with self._lock:
+            for g in self.groups.values():
+                rules = []
+                last_ms = 0
+                total_s = 0.0
+                for r in g.rules:
+                    if isinstance(r, AlertRule):
+                        last_ms = max(last_ms, int(r.last_eval_s * 1000))
+                        total_s += r.eval_duration_s
+                        sq_err = getattr(r.sq, "last_error", None)
+                        err = r.last_error or sq_err
+                        rules.append({
+                            "name": r.name,
+                            "query": r.expr,
+                            "duration": r.for_s,
+                            "keepFiringFor": r.keep_firing_for_s,
+                            "labels": dict(r.labels),
+                            "annotations": dict(r.annotations),
+                            "alerts": [a.payload()
+                                       for a in r.active.values()],
+                            "state": r.state(),
+                            "health": "err" if err else "ok",
+                            "lastError": err or "",
+                            "evaluationTime": r.eval_duration_s,
+                            "lastEvaluation": rfc3339(
+                                int(r.last_eval_s * 1000)
+                            ),
+                            "type": "alerting",
+                        })
+                    else:
+                        sq = r.sq
+                        last_s = getattr(sq, "last_refresh_s", 0.0) or 0.0
+                        dur = getattr(sq, "last_eval_duration_s", 0.0)
+                        err = getattr(sq, "last_error", None)
+                        last_ms = max(last_ms, int(last_s * 1000))
+                        total_s += dur
+                        rules.append({
+                            "name": r.name,
+                            "query": r.expr,
+                            "labels": {},
+                            "health": "err" if err else "ok",
+                            "lastError": err or "",
+                            "evaluationTime": dur,
+                            "lastEvaluation": rfc3339(int(last_s * 1000)),
+                            "type": "recording",
+                        })
+                groups.append({
+                    "name": g.name,
+                    "file": g.file,
+                    "interval": g.interval_s
+                    or float(self.cfg["default_interval_s"]),
+                    "evaluationTime": total_s,
+                    "lastEvaluation": rfc3339(last_ms),
+                    "rules": rules,
+                })
+        return {"groups": groups}
+
+    def rule_names(self) -> set[str]:
+        """Names this engine owns (the HTTP layer uses this to keep the
+        standing engine's synthetic group from double-listing them)."""
+        with self._lock:
+            return {r.name for g in self.groups.values() for r in g.rules}
+
+    def firing_alerts(self) -> list[dict]:
+        """The notifier's pull surface: every currently-firing alert."""
+        with self._lock:
+            out = []
+            for g in self.groups.values():
+                for r in g.rules:
+                    if not isinstance(r, AlertRule):
+                        continue
+                    for a in r.active.values():
+                        if a.state != "firing":
+                            continue
+                        out.append({
+                            "fingerprint": a.fingerprint,
+                            "labels": dict(a.labels),
+                            "annotations": dict(a.annotations),
+                            "starts_at_ms": a.fired_at_ms
+                            or a.active_at_ms,
+                        })
+            return out
+
+    # -- gauges / lifecycle ------------------------------------------------
+
+    def _publish_gauges(self) -> None:
+        counts = dict.fromkeys(ALERT_STATES, 0)
+        with self._lock:
+            for g in self.groups.values():
+                for r in g.rules:
+                    if not isinstance(r, AlertRule):
+                        continue
+                    if not r.active:
+                        counts["inactive"] += 1
+                        continue
+                    for a in r.active.values():
+                        counts[a.state] += 1
+        for st in ALERT_STATES:
+            REGISTRY.gauge("filodb_alerts", alertstate=st).set(
+                float(counts[st])
+            )
+
+    def start(self) -> None:
+        if self.notifier is not None:
+            self.notifier.start()
+
+    def stop(self) -> None:
+        if self.notifier is not None:
+            self.notifier.stop()
+        REGISTRY.unregister_collector(f"alerting:{id(self)}")
+
+    def snapshot(self) -> dict:
+        """Debug rendering: groups + active alerts + notifier state."""
+        with self._lock:
+            groups = [{
+                "name": g.name, "file": g.file,
+                "interval_s": g.interval_s,
+                "rules": [{
+                    "name": r.name,
+                    "type": ("alerting" if isinstance(r, AlertRule)
+                             else "recording"),
+                    "active": (len(r.active)
+                               if isinstance(r, AlertRule) else 0),
+                } for r in g.rules],
+            } for g in self.groups.values()]
+        out = {"groups": groups}
+        if self.notifier is not None:
+            out["notifier"] = self.notifier.snapshot()
+        return out

@@ -1,0 +1,358 @@
+"""Deduplicated notification fan-out for the alerting plane
+(doc/observability.md "Alerting plane — notification lifecycle").
+
+Firing alerts pull from the :class:`~filodb_tpu.obs.alerting.AlertingEngine`
+and fan out to Alertmanager-v2-compatible webhook receivers. Per receiver,
+alerts group by the receiver's ``group_by`` labels; per group the notifier
+keeps exactly the Alertmanager timing contract:
+
+- a NEW group waits ``group_wait`` before its first notification (so a
+  burst of related alerts lands as ONE payload);
+- a group whose membership changed (new firing fingerprint, or a resolved
+  one to report) re-notifies after ``group_interval``;
+- an UNCHANGED group re-notifies only after ``repeat_interval``.
+
+Dedup is by grouped fingerprint content hash: evaluating the same firing
+alert every interval produces exactly one delivery until the group's
+membership changes or ``repeat_interval`` elapses — the e2e test drives
+repeated evaluations and asserts the single delivery.
+
+Delivery reuses the fault-tolerance plane (query/faults.py): a per-receiver
+circuit breaker gates sends (a dead receiver stops consuming the notify
+thread), and each delivery gets a deadline-budgeted retry loop with
+exponential backoff. Outcomes land in
+``filodb_alert_notify_total{receiver,outcome}`` with the taxonomy
+``ok | retry | error | breaker_open``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import urllib.request
+from dataclasses import dataclass, field
+
+from ..metrics import REGISTRY
+from .alerting import _duration_s, rfc3339
+
+log = logging.getLogger("filodb_tpu.obs.notify")
+
+# delivery outcome taxonomy (linted against doc/observability.md):
+# ok           — payload accepted by the receiver
+# retry        — one failed attempt that will be retried within budget
+# error        — delivery abandoned (attempts or deadline exhausted)
+# breaker_open — skipped: the receiver's circuit breaker is open
+NOTIFY_OUTCOMES = ("ok", "retry", "error", "breaker_open")
+
+_ZERO_TIME = "0001-01-01T00:00:00Z"
+
+
+@dataclass
+class Receiver:
+    """One webhook destination + its grouping/timing knobs (Alertmanager
+    route semantics, flattened: one receiver = one route)."""
+
+    name: str
+    url: str
+    group_by: tuple = ("alertname",)
+    group_wait_s: float = 30.0
+    group_interval_s: float = 300.0
+    repeat_interval_s: float = 14400.0
+    send_resolved: bool = True
+
+    @classmethod
+    def from_config(cls, cfg: dict) -> "Receiver":
+        if not isinstance(cfg, dict):
+            raise ValueError("alerting.receivers entries must be mappings")
+        allowed = {"name", "url", "group_by", "group_wait",
+                   "group_interval", "repeat_interval", "send_resolved"}
+        extra = set(cfg) - allowed
+        if extra:
+            raise ValueError(f"receiver: unknown keys {sorted(extra)}")
+        name = cfg.get("name")
+        url = cfg.get("url")
+        if not name or not isinstance(name, str):
+            raise ValueError("receiver needs a non-empty `name`")
+        if not url or not isinstance(url, str):
+            raise ValueError(f"receiver {name!r} needs a non-empty `url`")
+        gb = cfg.get("group_by", ["alertname"])
+        if isinstance(gb, str):
+            gb = [gb]
+        kw = {}
+        for key, attr in (("group_wait", "group_wait_s"),
+                          ("group_interval", "group_interval_s"),
+                          ("repeat_interval", "repeat_interval_s")):
+            if key in cfg:
+                kw[attr] = _duration_s(cfg[key], f"receiver {name}: {key}")
+        return cls(name=name, url=url, group_by=tuple(str(g) for g in gb),
+                   send_resolved=bool(cfg.get("send_resolved", True)), **kw)
+
+
+@dataclass
+class _Group:
+    """Per-(receiver, group-key) dispatch state."""
+
+    key: tuple
+    group_labels: dict
+    first_seen_s: float
+    last_notify_s: float = 0.0
+    last_hash: str = ""
+    resolved: dict = field(default_factory=dict)  # fp -> resolved dict
+
+
+def _default_transport(url: str, body: bytes, timeout_s: float) -> None:
+    """POST the JSON payload; any HTTP error status raises (urllib)."""
+    req = urllib.request.Request(
+        url, data=body, method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+        resp.read()
+
+
+class Notifier:
+    """Grouping + dedup + breaker/retry delivery over a set of webhook
+    receivers. ``alerts_source`` is a zero-arg callable returning the
+    currently-firing alert dicts (the AlertingEngine binds itself)."""
+
+    def __init__(self, receivers, alerts_source=None, breakers=None,
+                 retry=None, deadline_s: float = 10.0, tick_s: float = 1.0,
+                 clock=time.time, transport=None):
+        from ..query.faults import BreakerRegistry, RetryPolicy
+
+        self.receivers = list(receivers)
+        self.alerts_source = alerts_source
+        self.breakers = breakers if breakers is not None else \
+            BreakerRegistry()
+        self.retry = retry if retry is not None else RetryPolicy()
+        self.deadline_s = float(deadline_s)
+        self.tick_s = float(tick_s)
+        self.clock = clock
+        self.transport = transport or _default_transport
+        self._lock = threading.Lock()
+        # (receiver name, group key) -> _Group
+        self._groups: dict[tuple, _Group] = {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- grouping / dedup --------------------------------------------------
+
+    @staticmethod
+    def _group_key(receiver: Receiver, labels: dict) -> tuple:
+        return tuple((g, str(labels.get(g, ""))) for g in receiver.group_by)
+
+    @staticmethod
+    def _content_hash(firing: list, resolved: list) -> str:
+        return "|".join(
+            sorted(a["fingerprint"] for a in firing)
+        ) + "//" + "|".join(sorted(a["fingerprint"] for a in resolved))
+
+    def note_resolved(self, alerts: list) -> None:
+        """Queue resolved alerts onto the groups that previously notified
+        them — a group nobody was ever told about has nothing to resolve."""
+        with self._lock:
+            for r in self.receivers:
+                if not r.send_resolved:
+                    continue
+                for a in alerts:
+                    key = (r.name, self._group_key(r, a["labels"]))
+                    g = self._groups.get(key)
+                    if g is None or g.last_notify_s <= 0:
+                        continue
+                    g.resolved[a["fingerprint"]] = a
+
+    def tick(self, now_s: float | None = None) -> int:
+        """One dispatch pass; returns the number of deliveries attempted.
+        The background thread calls this every ``tick_s``; tests drive it
+        directly with an injected clock."""
+        if now_s is None:
+            now_s = self.clock()
+        firing = list(self.alerts_source() if self.alerts_source else [])
+        attempted = 0
+        for r in self.receivers:
+            by_key: dict[tuple, list] = {}
+            for a in firing:
+                by_key.setdefault(self._group_key(r, a["labels"]),
+                                  []).append(a)
+            plans = []
+            with self._lock:
+                # register/refresh group state for every live group
+                for gkey, members in by_key.items():
+                    key = (r.name, gkey)
+                    g = self._groups.get(key)
+                    if g is None:
+                        g = _Group(key=gkey, group_labels=dict(gkey),
+                                   first_seen_s=now_s)
+                        self._groups[key] = g
+                # decide which groups flush this tick
+                for (rname, gkey), g in list(self._groups.items()):
+                    if rname != r.name:
+                        continue
+                    members = by_key.get(gkey, [])
+                    resolved = list(g.resolved.values())
+                    if not members and not resolved:
+                        # nothing firing, nothing to resolve: forget it
+                        del self._groups[(rname, gkey)]
+                        continue
+                    h = self._content_hash(members, resolved)
+                    if g.last_notify_s <= 0:
+                        if not members:
+                            continue  # resolved-only, never notified
+                        due = now_s - g.first_seen_s >= r.group_wait_s
+                    elif h != g.last_hash:
+                        due = (now_s - g.last_notify_s
+                               >= r.group_interval_s)
+                    else:
+                        due = (now_s - g.last_notify_s
+                               >= r.repeat_interval_s)
+                    if due:
+                        plans.append((g, members, resolved, h))
+            for g, members, resolved, h in plans:
+                attempted += 1
+                ok = self._deliver(r, g, members, resolved)
+                with self._lock:
+                    g.last_notify_s = now_s
+                    if ok:
+                        g.last_hash = h
+                        for a in resolved:
+                            g.resolved.pop(a["fingerprint"], None)
+                        if not members and not g.resolved:
+                            self._groups.pop((r.name, g.key), None)
+        return attempted
+
+    # -- delivery ----------------------------------------------------------
+
+    def _count(self, receiver: Receiver, outcome: str) -> None:
+        REGISTRY.counter("filodb_alert_notify", receiver=receiver.name,
+                         outcome=outcome).inc()
+
+    def _deliver(self, receiver: Receiver, g: _Group, firing: list,
+                 resolved: list) -> bool:
+        """One deadline-budgeted delivery: breaker gate, then retry with
+        backoff until the payload lands or the budget is gone."""
+        breaker = self.breakers.breaker_for(("notify", receiver.name))
+        if not breaker.allow():
+            self._count(receiver, "breaker_open")
+            return False
+        body = json.dumps(self.build_payload(
+            receiver, g.group_labels, firing, resolved
+        )).encode()
+        deadline = time.monotonic() + self.deadline_s
+        rng = self.retry.rng()
+        attempts = max(int(self.retry.max_attempts), 1)
+        last_err: Exception | None = None
+        for i in range(attempts):
+            budget = deadline - time.monotonic()
+            if budget <= 0:
+                break
+            try:
+                self.transport(receiver.url, body, min(budget,
+                                                       self.deadline_s))
+                breaker.record_success()
+                self._count(receiver, "ok")
+                return True
+            except Exception as e:  # noqa: BLE001 — any failure is retryable here
+                last_err = e
+                if i + 1 >= attempts:
+                    break
+                backoff = self.retry.backoff_s(i, rng)
+                if time.monotonic() + backoff >= deadline:
+                    break
+                self._count(receiver, "retry")
+                self.retry.sleep(backoff)
+        breaker.record_failure()
+        self._count(receiver, "error")
+        log.warning("alert delivery to %s failed: %s", receiver.name,
+                    last_err)
+        return False
+
+    def build_payload(self, receiver: Receiver, group_labels: dict,
+                      firing: list, resolved: list) -> dict:
+        """Alertmanager v2 webhook payload (version "4" wire format)."""
+        alerts = []
+        for a in firing:
+            alerts.append({
+                "status": "firing",
+                "labels": dict(a["labels"]),
+                "annotations": dict(a.get("annotations") or {}),
+                "startsAt": rfc3339(int(a.get("starts_at_ms", 0))),
+                "endsAt": _ZERO_TIME,
+                "generatorURL": "",
+                "fingerprint": a["fingerprint"],
+            })
+        for a in resolved:
+            alerts.append({
+                "status": "resolved",
+                "labels": dict(a["labels"]),
+                "annotations": dict(a.get("annotations") or {}),
+                "startsAt": rfc3339(int(a.get("starts_at_ms", 0))),
+                "endsAt": rfc3339(int(a.get("ends_at_ms", 0))),
+                "generatorURL": "",
+                "fingerprint": a["fingerprint"],
+            })
+
+        def _common(key: str) -> dict:
+            if not alerts:
+                return {}
+            out = dict(alerts[0][key])
+            for a in alerts[1:]:
+                for k in list(out):
+                    if a[key].get(k) != out[k]:
+                        del out[k]
+            return out
+
+        gl = ",".join(f'{k}="{v}"' for k, v in sorted(group_labels.items()))
+        return {
+            "version": "4",
+            "groupKey": f"{{}}:{{{gl}}}",
+            "truncatedAlerts": 0,
+            "status": "firing" if firing else "resolved",
+            "receiver": receiver.name,
+            "groupLabels": dict(group_labels),
+            "commonLabels": _common("labels"),
+            "commonAnnotations": _common("annotations"),
+            "externalURL": "",
+            "alerts": alerts,
+        }
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        if not self.receivers:
+            return
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="filodb-notify"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.tick_s):
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 — the notify loop must not die
+                log.exception("notifier tick failed")
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            groups = [{
+                "receiver": rname,
+                "group": dict(g.key),
+                "last_notify_s": g.last_notify_s,
+                "pending_resolved": len(g.resolved),
+            } for (rname, _k), g in self._groups.items()]
+        return {
+            "receivers": [r.name for r in self.receivers],
+            "groups": groups,
+            "breakers": self.breakers.states(),
+        }

@@ -364,6 +364,34 @@ class FiloServer:
             from .standing import StandingEngine
 
             self.system_standing = StandingEngine(self.system_engine, scfg)
+        # alerting plane (obs/alerting.py + obs/notify.py): rule groups
+        # evaluated on the _system standing engine, state written back as
+        # ALERTS/ALERTS_FOR_STATE, firing alerts fanned out to webhook
+        # receivers through the shared breaker/retry plane. enabled null =
+        # auto (on exactly when the _system standing engine runs).
+        from .obs.alerting import DEFAULTS as ALERT_DEFAULTS
+
+        acfg = {**ALERT_DEFAULTS, **(cfg.get("alerting") or {})}
+        self.alerting_config = acfg
+        self.alerting = None
+        alert_on = acfg.get("enabled")
+        if alert_on is None:
+            alert_on = self.system_standing is not None
+        if alert_on and self.system_standing is not None:
+            from .obs.alerting import AlertingEngine
+            from .obs.notify import Notifier, Receiver
+
+            notifier = None
+            recv = [Receiver.from_config(r)
+                    for r in (acfg.get("receivers") or [])]
+            if recv:
+                notifier = Notifier(
+                    recv, breakers=self.breakers, retry=self.retry_policy,
+                    deadline_s=float(acfg.get("notify_deadline_s", 10.0)),
+                    tick_s=float(acfg.get("notify_tick_s", 1.0)),
+                )
+            self.alerting = AlertingEngine(self.system_standing, acfg,
+                                           notifier=notifier)
         watch_log = tcfg.get("tpu_watch_log", "auto")
         if watch_log:
             import os as _os
@@ -432,6 +460,7 @@ class FiloServer:
             standing=self.standing,
             standing_system=self.system_standing,
             rollups=self.rollups,
+            alerting=self.alerting,
             cluster=self._cluster_snapshot,
         )
         if self.standing is not None:
@@ -447,6 +476,16 @@ class FiloServer:
 
             self.slo_rules = register_slo_rules(self.system_standing,
                                                 self.slo_config)
+            if self.alerting is not None:
+                # rule files load AFTER the SLO set registers (alert exprs
+                # threshold the burn series those rules record) and BEFORE
+                # the maintainer thread starts; rehydration restores
+                # pending/firing state from the ALERTS_FOR_STATE series a
+                # previous process wrote, so a restart never resets a
+                # firing alert's for: clock
+                self.alerting.load_rule_files()
+                self.alerting.rehydrate()
+                self.alerting.start()
             self.system_standing.start()
         if self.self_scraper is not None:
             self.self_scraper.start()
@@ -523,6 +562,8 @@ class FiloServer:
             self.rollups.stop()
         if self.standing is not None:
             self.standing.stop()
+        if self.alerting is not None:
+            self.alerting.stop()
         if self.system_standing is not None:
             self.system_standing.stop()
         if self.self_scraper is not None:

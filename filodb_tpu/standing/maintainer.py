@@ -37,6 +37,12 @@ The engine between the dispatch scheduler and the fused engine (ROADMAP
   (``rule_name{group labels}``), evaluated on ``eval_interval_s`` ticks —
   the recording-rules engine the ROADMAP said falls out for free.
 
+- **alerting rules** — a standing query with an ``alert_sink`` feeds the
+  newest closed step's per-group column to the alerting state machine
+  (obs/alerting.py) after every refresh: the alert condition is evaluated
+  from the partials the maintainer already keeps, never a separate
+  dispatch plane.
+
 Refreshes bypass admission control (they are the system's own standing
 obligation, not ad-hoc tenant load) but their resources ARE attributed: the
 owning tenant (resolved from the query's selector filters at registration)
@@ -119,7 +125,8 @@ class StandingEngine:
 
     def register(self, promql: str, step_ms: int, span_ms: int | None = None,
                  source: str = "manual", key=None, rule_name: str | None = None,
-                 eval_interval_s: float | None = None) -> StandingQuery:
+                 eval_interval_s: float | None = None,
+                 alert_sink=None) -> StandingQuery:
         """Register one standing query. Probes the planned exec to decide
         the maintenance mode: ``delta`` (fused aggregate with a spliceable
         epilogue) or ``full`` (nondecomposable epilogue or a plan shape the
@@ -151,6 +158,7 @@ class StandingEngine:
             step_ms=step_ms, span_ms=span_ms, source=source, key=key,
             mode=mode, mode_reason=mode_reason, ws=tenant[0], ns=tenant[1],
             rule_name=rule_name, eval_interval_s=eval_interval_s,
+            alert_sink=alert_sink,
             window_ms=window_ms, offset_ms=offset_ms,
         )
         self.registry.add(sq)
@@ -261,7 +269,7 @@ class StandingEngine:
                 # touching state here would re-grow what was freed
                 return None
             try:
-                payload, outcome, ctx = self._refresh_locked(
+                payload, outcome, ctx, evalv = self._refresh_locked(
                     sq, now_ms, force_full
                 )
             except Exception as e:  # noqa: BLE001 — maintenance must not die
@@ -269,6 +277,14 @@ class StandingEngine:
                 sq.last_error = f"{type(e).__name__}: {e}"
                 REGISTRY.counter("filodb_standing_refreshes",
                                  outcome="error").inc()
+                if sq.alert_sink is not None:
+                    # the alert rule's condition could not be evaluated
+                    # this interval — surfaced in the alerting health
+                    # family, not just the standing one
+                    REGISTRY.counter(
+                        "filodb_alert_eval_failures",
+                        rule=getattr(sq.alert_sink, "rule", "unknown"),
+                    ).inc()
                 log.exception("standing refresh failed: %s", sq.promql)
                 self._observe_querylog(sq, "error", None,
                                        time.perf_counter() - t0,
@@ -277,6 +293,7 @@ class StandingEngine:
                 return None
             sq.last_error = None
         elapsed = time.perf_counter() - t0
+        sq.last_eval_duration_s = elapsed
         REGISTRY.counter("filodb_standing_refreshes", outcome=outcome).inc()
         REGISTRY.histogram("filodb_standing_refresh_seconds").observe(elapsed)
         if ctx is not None:
@@ -294,6 +311,14 @@ class StandingEngine:
         self._observe_querylog(sq, outcome, ctx, elapsed)
         if payload is not None:
             self.hub.publish(sq.qid, payload)
+        if sq.alert_sink is not None and evalv is not None:
+            # feed the newest closed step to the alerting state machine —
+            # OUTSIDE sq.lock (the sink writes ALERTS back through the
+            # ingest path, which pokes the append listeners)
+            try:
+                sq.alert_sink(sq, evalv[0], evalv[1])
+            except Exception:  # noqa: BLE001 — alerting must not kill refresh
+                log.exception("alert sink failed: %s", sq.promql)
         return payload
 
     def _observe_querylog(self, sq: StandingQuery, outcome: str, ctx,
@@ -425,7 +450,13 @@ class StandingEngine:
             sq.stats["steps_retained"] += J
             REGISTRY.counter("filodb_standing_steps", kind="retained").inc(J)
             sq.last_refresh_s = self.clock()
-            return None, "retained", None
+            evalv = None
+            if sq.alert_sink is not None:
+                # the condition still gets its evaluation tick even when
+                # zero dispatches ran — absence must resolve alerts
+                evalv = self._eval_col(sq.retained, sq.labels,
+                                       sq.grid_end_ms)
+            return None, "retained", None, evalv
         else:
             if k0 > 0:
                 # the delta dispatch: ONLY the touched suffix re-computes,
@@ -484,7 +515,10 @@ class StandingEngine:
         payload = self._render(sq, start, end, J, retained, labels or [])
         if sq.rule_name:
             self._write_rule(sq, start, end, J, retained, labels or [])
-        return payload, outcome, ctx
+        evalv = None
+        if sq.alert_sink is not None:
+            evalv = self._eval_col(retained, labels, end)
+        return payload, outcome, ctx, evalv
 
     def _drop_state(self, sq: StandingQuery) -> None:
         """Release a query's retained delta state (caller holds sq.lock):
@@ -528,7 +562,26 @@ class StandingEngine:
                 sq, start, end, J,
                 np.asarray(g.values_np(), dtype=np.float32), list(g.labels),
             )
-        return payload, "full", ctx
+        evalv = None
+        if sq.alert_sink is not None:
+            vals, labels = self._grid_arrays(res, J)
+            evalv = self._eval_col(vals, labels, end)
+        return payload, "full", ctx, evalv
+
+    @staticmethod
+    def _eval_col(vals, labels, end_ms: int):
+        """``(end_ms, [(labels, value), ...])`` for the newest closed step
+        — the alert sink's input. NaN entries are absent series (a
+        comparison filtered them out, or the window is empty): absence is
+        what RESOLVES an alert, so they are dropped, not forwarded."""
+        vec = []
+        if vals is not None and vals.size and labels:
+            col = vals[:, -1]
+            for gi, lbl in enumerate(labels):
+                v = float(col[gi])
+                if not math.isnan(v):
+                    vec.append((dict(lbl), v))
+        return (int(end_ms), vec)
 
     @staticmethod
     def _grid_arrays(res, num_steps: int):
@@ -738,7 +791,8 @@ class StandingEngine:
             now_s = self.clock()
             for sq in self.registry.list():
                 try:
-                    if sq.rule_name and sq.eval_interval_s:
+                    if (sq.rule_name or sq.alert_sink is not None) \
+                            and sq.eval_interval_s:
                         # rules evaluate on their own clock, not per append
                         if now_s - sq.last_refresh_s >= sq.eval_interval_s:
                             self.refresh(sq)
@@ -768,20 +822,31 @@ class StandingEngine:
 
     def rules_payload(self) -> dict:
         """Prometheus ``/api/v1/rules`` shape for the registered recording
-        rules (one group holding them all — this build has no rule files)."""
+        rules (one synthetic ``standing`` group holds the file-less,
+        runtime-registered ones; file-backed rules are listed by
+        obs/alerting.py's rules_payload instead)."""
+        from ..obs.alerting import rfc3339
+
+        rl = self.registry.rules()
         rules = [{
             "name": sq.rule_name,
             "query": sq.promql,
             "health": "err" if sq.last_error else "ok",
             "lastError": sq.last_error or "",
-            "evaluationTime": 0.0,
-            "lastEvaluation": sq.last_refresh_s,
+            "evaluationTime": float(sq.last_eval_duration_s),
+            "lastEvaluation": rfc3339(int(sq.last_refresh_s * 1000)),
             "type": "recording",
             "labels": {},
-        } for sq in self.registry.rules()]
+        } for sq in rl]
         if not rules:
             return {"groups": []}
         return {"groups": [{
             "name": "standing", "file": "", "interval": 0,
+            "evaluationTime": sum(
+                float(sq.last_eval_duration_s) for sq in rl
+            ),
+            "lastEvaluation": rfc3339(
+                int(max(sq.last_refresh_s for sq in rl) * 1000)
+            ),
             "rules": rules,
         }]}

@@ -14,7 +14,11 @@ three ways:
   admitted as a standing obligation instead of re-arriving as ad-hoc load);
 - ``rule`` — a recording rule (``POST /api/v1/rules/record``): a standing
   query whose newest closed steps write back into the memstore as a real
-  series under the rule's name.
+  series under the rule's name;
+- ``alert`` — an alerting rule (``obs/alerting.py``): a standing query
+  whose newest closed step feeds a per-labelset threshold state machine
+  instead of (or in addition to) a series write-back — the ``alert_sink``
+  callback receives ``(sq, end_ms, vec)`` after every refresh.
 
 Demotion is remembered: a key demoted for a sticky reason (e.g.
 ``standing_nondecomposable`` — topk/quantile/hist_quantile epilogues whose
@@ -63,7 +67,7 @@ class StandingQuery:
     dataset: str
     step_ms: int
     span_ms: int
-    source: str = "manual"  # manual | promoted | rule
+    source: str = "manual"  # manual | promoted | rule | alert
     key: object = None  # the KeyStatsRing key (promoted entries)
     # delta eligibility, decided at registration by probing the planned
     # exec (ops/aggregations.standing_delta_eligible): "delta" refreshes
@@ -76,6 +80,10 @@ class StandingQuery:
     # recording rule: results write back as series `rule_name{group labels}`
     rule_name: str | None = None
     eval_interval_s: float | None = None
+    # alerting rule: called with (sq, end_ms, eval_vec) after every refresh
+    # — eval_vec is the newest closed step's [(labels, value)] column
+    # (obs/alerting.py AlertingEngine._make_sink)
+    alert_sink: object = field(default=None, repr=False)
     created_s: float = field(default_factory=time.time)
     # set (under ``lock``) by StandingRegistry.remove: refreshes racing the
     # unregister bail instead of re-growing state the ledger already
@@ -95,6 +103,7 @@ class StandingQuery:
     offset_ms: int = 0
     seq: int = 0  # refresh sequence number (rides every pushed payload)
     last_refresh_s: float = 0.0
+    last_eval_duration_s: float = 0.0
     last_error: str | None = None
     last_payload: bytes | None = field(default=None, repr=False)
     last_rule_write_ms: int = 0

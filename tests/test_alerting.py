@@ -1,0 +1,932 @@
+"""Alerting plane (doc/observability.md "Alerting plane"): rule groups,
+the per-labelset pending→firing state machine on the standing engine, and
+deduplicated notification fan-out.
+
+Contracts pinned here:
+
+- rule-file schema validation rejects malformed groups with pointed
+  messages, and the SHIPPED conf/rules/*.yml files validate;
+- the state machine holds ``pending`` until ``for:`` elapses, fires
+  exactly at the threshold, resolves silently when a pending labelset
+  disappears (never notified → nothing to resolve), and ``keep_firing_for``
+  suppresses flaps through short gaps;
+- every evaluation writes ``ALERTS{alertname,alertstate,...}`` and
+  ``ALERTS_FOR_STATE`` back through the production ingest path, so alert
+  state is QUERYABLE and a restarted process rehydrates pending/firing
+  without resetting the ``for:`` clock;
+- the notifier keeps the Alertmanager timing contract (group_wait /
+  group_interval / repeat_interval), deduplicates by grouped fingerprint
+  hash (repeated evaluation of the same firing alert → exactly ONE
+  delivery), retries with backoff inside a deadline budget, and a dead
+  receiver trips the per-receiver circuit breaker;
+- the HTTP surfaces are real Prometheus shapes: /api/v1/rules (top-level
+  ``groups``, camelCase eval fields, recording AND alerting types, no
+  double listing), /api/v1/alerts, POST /api/v1/rules/alert, and
+  /debug/querylog?path= filters alert evaluations out;
+- the e2e proof: injected 5xx → SLO burn → pending → firing → exactly ONE
+  grouped webhook → recovery → resolved notification, with the warm
+  canonical query still exactly ONE kernel dispatch while alerting runs.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from filodb_tpu.coordinator.planner import QueryEngine
+from filodb_tpu.core.schemas import Dataset
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.metrics import REGISTRY
+from filodb_tpu.obs.alerting import (
+    ALERT_STATES,
+    ALERTS_FOR_STATE_SERIES,
+    ALERTS_SERIES,
+    AlertingEngine,
+    AlertRule,
+    RuleFileError,
+    expand_template,
+    fingerprint,
+    load_rule_file,
+    parse_rule_groups,
+    rfc3339,
+)
+from filodb_tpu.obs.notify import Notifier, Receiver, _Group
+from filodb_tpu.obs.querylog import QUERY_LOG
+from filodb_tpu.query.faults import RetryPolicy
+from filodb_tpu.standing import StandingEngine
+from filodb_tpu.testkit import counter_batch, kernel_dispatch_total
+
+pytestmark = pytest.mark.alerting
+
+BASE = 1_600_000_000_000
+INTERVAL = 10_000
+N_SAMPLES = 260
+EDGE = BASE + N_SAMPLES * INTERVAL  # newest ingested sample
+STEP_MS = 15_000
+Q = "sum by (job) (rate(http_requests_total[5m]))"
+
+
+def _setup(**acfg):
+    """(memstore, engine, standing, alerting) over one dataset of counter
+    series (all job="api"), clock pinned just past the ingest edge."""
+    ms = TimeSeriesMemStore()
+    ms.setup(Dataset("ds"), list(range(4)))
+    ms.ingest_routed(
+        "ds", counter_batch(n_series=24, n_samples=N_SAMPLES,
+                            start_ms=BASE), spread=3,
+    )
+    eng = QueryEngine(ms, "ds")
+    se = StandingEngine(eng, {"default_span_ms": 1_200_000},
+                        clock=lambda: (EDGE + 5_000) / 1e3)
+    alt = AlertingEngine(se, {"default_interval_s": 15.0, **acfg})
+    return ms, eng, se, alt
+
+
+def _counter(name: str, **labels) -> float:
+    m = REGISTRY._metrics.get((name, tuple(sorted(labels.items()))))
+    return float(m.value) if m is not None else 0.0
+
+
+def _get_json(url: str) -> dict:
+    with urllib.request.urlopen(url) as r:
+        return json.loads(r.read())
+
+
+def _get_status(url: str) -> tuple[int, dict]:
+    try:
+        with urllib.request.urlopen(url) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _post_json(url: str, body: dict) -> tuple[int, dict]:
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(), method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+# -- rule files ---------------------------------------------------------------
+
+
+class TestRuleFiles:
+    def test_shipped_rule_files_validate(self):
+        """The example rule files the dev config loads must stay valid —
+        they double as the documented schema reference."""
+        slo = load_rule_file("conf/rules/slo.yml")
+        assert [g.name for g in slo] == ["slo-burn"]
+        assert sorted(r.name for r in slo[0].rules) == [
+            "AvailabilityBurnFast", "AvailabilityBurnSlow",
+            "LatencyBurnFast",
+        ]
+        fast = next(r for r in slo[0].rules
+                    if r.name == "AvailabilityBurnFast")
+        assert fast.for_s == 30.0 and fast.keep_firing_for_s == 30.0
+        assert fast.labels == {"severity": "page"}
+        assert slo[0].interval_s == 15.0
+
+        plat = load_rule_file("conf/rules/platform.yml")
+        assert [g.name for g in plat] == ["platform"]
+        assert sorted(r.name for r in plat[0].rules) == [
+            "LedgerDrift", "RebalanceFailures", "RecompileStorm",
+            "ReplicaWatermarkLag",
+        ]
+
+    @pytest.mark.parametrize("doc,frag", [
+        ([], "mapping"),
+        ({"groups": [], "extra": 1}, "unknown"),
+        ({"groups": [{"rules": [{"alert": "A", "expr": "x"}]}]}, "name"),
+        ({"groups": [{"name": "g", "rules": []}]}, "non-empty `rules:`"),
+        ({"groups": [{"name": "g", "rules": [
+            {"alert": "A", "record": "r", "expr": "x"}]}]},
+         "exactly one of"),
+        ({"groups": [{"name": "g", "rules": [{"expr": "x"}]}]},
+         "exactly one of"),
+        ({"groups": [{"name": "g", "rules": [
+            {"record": "r", "expr": "x", "labels": {"a": "b"}}]}]},
+         "labels"),
+        ({"groups": [{"name": "g", "rules": [
+            {"alert": "A", "expr": "x", "for": True}]}]}, "duration"),
+        ({"groups": [{"name": "g", "rules": [
+            {"alert": "A", "expr": "x",
+             "labels": {"alertname": "B"}}]}]}, "reserved"),
+        ({"groups": [{"name": "g", "rules": [
+            {"alert": "A", "expr": "x"}, {"alert": "A", "expr": "y"}]}]},
+         "duplicate"),
+    ])
+    def test_schema_errors(self, doc, frag):
+        with pytest.raises(RuleFileError) as ei:
+            parse_rule_groups(doc, file="t.yml")
+        assert frag in str(ei.value)
+
+    def test_durations_and_defaults(self):
+        groups = parse_rule_groups({"groups": [{
+            "name": "g", "interval": "30s", "rules": [
+                {"alert": "A", "expr": "x > 1", "for": "1m",
+                 "keep_firing_for": 90},
+                {"alert": "B", "expr": "y > 1"},
+                {"record": "job:rate", "expr": "rate(z[5m])"},
+            ],
+        }]})
+        g = groups[0]
+        assert g.interval_s == 30.0
+        a, b, rec = g.rules
+        assert a.for_s == 60.0 and a.keep_firing_for_s == 90.0
+        assert b.for_s == 0.0 and b.keep_firing_for_s == 0.0
+        assert not isinstance(rec, AlertRule) and rec.name == "job:rate"
+
+    def test_expand_template(self):
+        lbl = {"job": "api", "shard": "3"}
+        assert expand_template("{{ $labels.job }}/{{$labels.shard}}",
+                               lbl, 1.5) == "api/3"
+        assert expand_template("at {{ $value }}x", lbl, 2.5) == "at 2.5x"
+        # unknown label → empty, not a crash and not a literal
+        assert expand_template("[{{ $labels.nope }}]", lbl, 0) == "[]"
+
+    def test_fingerprint_ignores_alertstate(self):
+        a = {"alertname": "A", "job": "api"}
+        assert fingerprint({**a, "alertstate": "pending"}) == \
+            fingerprint({**a, "alertstate": "firing"}) == fingerprint(a)
+        assert fingerprint(a) != fingerprint({**a, "job": "web"})
+
+    def test_rfc3339(self):
+        assert rfc3339(0) == "0001-01-01T00:00:00Z"
+        assert rfc3339(1_600_000_000_123) == "2020-09-13T12:26:40.123Z"
+
+
+# -- state machine ------------------------------------------------------------
+
+
+def _rule(alt, *, name="HighTraffic", for_="30s", keep="30s",
+          group="sm", annotations=None):
+    """Register an alert rule whose expr never matches real data — the
+    tests drive its state machine with synthetic evaluation vectors."""
+    spec = {
+        "alert": name, "expr": Q + " > 1e12", "for": for_,
+        "keep_firing_for": keep, "labels": {"severity": "page"},
+        "annotations": annotations
+        or {"summary": "job {{ $labels.job }} at {{ $value }}"},
+    }
+    return alt.add_rule(spec, group=group)
+
+
+class TestStateMachine:
+    def test_pending_hold_then_firing(self):
+        _ms, eng, _se, alt = _setup()
+        try:
+            rule = _rule(alt)
+            t0 = EDGE
+            alt._eval_rule(rule, t0, [({"job": "j0"}, 2.0)])
+            (a,) = rule.active.values()
+            assert a.state == "pending" and a.active_at_ms == t0
+            assert a.annotations["summary"] == "job j0 at 2"
+            # 15s elapsed < for:30s — still pending
+            alt._eval_rule(rule, t0 + 15_000, [({"job": "j0"}, 3.5)])
+            assert a.state == "pending" and a.value == 3.5
+            assert a.annotations["summary"] == "job j0 at 3.5"
+            # exactly at the threshold — fires
+            alt._eval_rule(rule, t0 + 30_000, [({"job": "j0"}, 4.0)])
+            assert a.state == "firing" and a.fired_at_ms == t0 + 30_000
+            assert a.active_at_ms == t0  # for: clock never reset
+            # payload shape (Prometheus /api/v1/alerts)
+            (p,) = alt.alerts_payload()["alerts"]
+            assert p["state"] == "firing"
+            assert p["labels"] == {"alertname": "HighTraffic",
+                                   "job": "j0", "severity": "page"}
+            assert p["activeAt"] == rfc3339(t0) and p["value"] == "4"
+            assert alt.alerts_payload("pending")["alerts"] == []
+        finally:
+            alt.stop()
+
+    def test_for_zero_fires_on_first_eval(self):
+        _ms, _eng, _se, alt = _setup()
+        try:
+            rule = _rule(alt, for_=0, keep=0)
+            alt._eval_rule(rule, EDGE, [({"job": "j0"}, 1.0)])
+            (a,) = rule.active.values()
+            assert a.state == "firing"
+        finally:
+            alt.stop()
+
+    def test_pending_resolves_silently(self):
+        """A labelset that vanishes while still pending was never
+        notified — it must go straight back to inactive, not produce a
+        resolved notification."""
+        _ms, _eng, _se, alt = _setup()
+        resolved: list = []
+        alt.notifier = type("N", (), {
+            "note_resolved": staticmethod(resolved.extend),
+            "start": staticmethod(lambda: None),
+            "stop": staticmethod(lambda: None),
+        })()
+        try:
+            rule = _rule(alt)
+            alt._eval_rule(rule, EDGE, [({"job": "j0"}, 2.0)])
+            assert len(rule.active) == 1
+            alt._eval_rule(rule, EDGE + 15_000, [])
+            assert not rule.active and resolved == []
+        finally:
+            alt.stop()
+
+    def test_keep_firing_for_suppresses_flaps(self):
+        _ms, _eng, _se, alt = _setup()
+        resolved: list = []
+        alt.notifier = type("N", (), {
+            "note_resolved": staticmethod(resolved.extend),
+            "start": staticmethod(lambda: None),
+            "stop": staticmethod(lambda: None),
+        })()
+        try:
+            rule = _rule(alt, for_=0, keep="30s")
+            t0 = EDGE
+            alt._eval_rule(rule, t0, [({"job": "j0"}, 2.0)])
+            (a,) = rule.active.values()
+            assert a.state == "firing"
+            # one missed eval inside keep_firing_for: held, not resolved
+            alt._eval_rule(rule, t0 + 15_000, [])
+            assert a.state == "firing" and not resolved
+            # condition returns: last_true advances, still the same alert
+            alt._eval_rule(rule, t0 + 30_000, [({"job": "j0"}, 2.5)])
+            assert len(rule.active) == 1 and not resolved
+            # gone past the hold window: resolved, handed to the notifier
+            alt._eval_rule(rule, t0 + 45_000, [])
+            assert a.state == "firing" and not resolved  # 15s gap: held
+            alt._eval_rule(rule, t0 + 60_000, [])
+            assert not rule.active and len(resolved) == 1
+            assert resolved[0]["labels"]["job"] == "j0"
+            assert resolved[0]["ends_at_ms"] == t0 + 60_000
+        finally:
+            alt.stop()
+
+    def test_per_labelset_independence(self):
+        _ms, _eng, _se, alt = _setup()
+        try:
+            rule = _rule(alt, keep=0)
+            t0 = EDGE
+            alt._eval_rule(rule, t0, [({"job": "j0"}, 1.0),
+                                      ({"job": "j1"}, 2.0)])
+            assert len(rule.active) == 2
+            # j1 keeps burning, j0 recovers while pending
+            alt._eval_rule(rule, t0 + 15_000, [({"job": "j1"}, 2.0)])
+            alt._eval_rule(rule, t0 + 30_000, [({"job": "j1"}, 2.0)])
+            states = {a.labels["job"]: a.state
+                      for a in rule.active.values()}
+            assert states == {"j1": "firing"}
+        finally:
+            alt.stop()
+
+    def test_state_written_back_queryable(self):
+        """ALERTS / ALERTS_FOR_STATE ride the production ingest path into
+        the bound dataset — alert state is a real queryable series."""
+        _ms, eng, _se, alt = _setup()
+        try:
+            rule = _rule(alt, for_=0)
+            t0 = EDGE
+            for k in range(3):
+                alt._eval_rule(rule, t0 + k * 15_000,
+                               [({"job": "j0"}, 2.0)])
+            res = eng.query_range(
+                ALERTS_SERIES + '{alertstate="firing"}',
+                (t0 - 60_000) / 1e3, (t0 + 60_000) / 1e3, 15.0,
+            )
+            vals = [v for g in res.grids
+                    for row in np.asarray(g.values_np(), dtype=float)
+                    for v in row if not np.isnan(v)]
+            assert vals and set(vals) == {1.0}
+            lbls = [dict(lb) for g in res.grids for lb in g.labels]
+            assert any(d.get("alertname") == "HighTraffic"
+                       and d.get("job") == "j0" for d in lbls)
+            res2 = eng.query_range(
+                ALERTS_FOR_STATE_SERIES,
+                (t0 - 60_000) / 1e3, (t0 + 60_000) / 1e3, 15.0,
+            )
+            # value = seconds since active (f32-safe age, not epoch):
+            # evals at t0, t0+15s, t0+30s with active_at=t0 → 0/15/30
+            vals2 = {v for g in res2.grids
+                     for row in np.asarray(g.values_np(), dtype=float)
+                     for v in row if not np.isnan(v)}
+            assert vals2 == {0.0, 15.0, 30.0}
+        finally:
+            alt.stop()
+
+    def test_rehydration_preserves_for_clock(self):
+        """Restart safety: a fresh AlertingEngine (what the server builds
+        on boot) restores pending/firing from ALERTS_FOR_STATE — an alert
+        that was firing before the restart must come back firing with its
+        original active_at, not restart the for: hold."""
+        _ms, _eng, se, alt = _setup()
+        t0 = EDGE
+        try:
+            rule = _rule(alt)
+            for k in range(3):  # pending @t0 → firing @t0+30s
+                alt._eval_rule(rule, t0 + k * 15_000,
+                               [({"job": "j0"}, 2.0)])
+            assert next(iter(rule.active.values())).state == "firing"
+        finally:
+            alt.stop()
+        # "restart": new engine, same rules, same store
+        alt2 = AlertingEngine(se, {"default_interval_s": 15.0})
+        try:
+            rule2 = _rule(alt2)
+            assert not rule2.active
+            assert alt2.rehydrate(now_ms=t0 + 60_000) == 1
+            (a,) = rule2.active.values()
+            # active_at recovers to within one grid step (age encoding)
+            assert a.state == "firing"
+            assert abs(a.active_at_ms - t0) <= STEP_MS
+            assert a.labels["job"] == "j0"
+            # a second rehydrate is a no-op (fingerprint already active)
+            assert alt2.rehydrate(now_ms=t0 + 60_000) == 0
+        finally:
+            alt2.stop()
+        # restored state short of the for: hold comes back PENDING
+        alt3 = AlertingEngine(se, {"default_interval_s": 15.0})
+        try:
+            rule3 = _rule(alt3)
+            assert alt3.rehydrate(now_ms=t0 + 15_000) == 1
+            (a3,) = rule3.active.values()
+            assert a3.state == "pending" and a3.active_at_ms == t0
+        finally:
+            alt3.stop()
+
+    def test_refresh_drives_sink_and_querylog(self):
+        """The real evaluation path: the standing maintainer's refresh
+        feeds the alert sink the newest closed step, and every evaluation
+        leaves a query-observatory record (path=standing:*)."""
+        _ms, _eng, se, alt = _setup()
+        try:
+            rule = alt.add_rule({
+                "alert": "Traffic", "expr": Q + " > 0",
+                "annotations": {"summary": "{{ $labels.job }}"},
+            }, group="live")
+            n0 = len(QUERY_LOG)
+            se.refresh(rule.sq, now_ms=EDGE + 5_000)
+            assert rule.sq.last_error is None
+            assert rule.last_error is None
+            # for: 0 → firing on the creation eval; one job label ("api")
+            (a,) = rule.active.values()
+            assert a.state == "firing" and a.labels["job"] == "api"
+            assert a.annotations["summary"] == "api"
+            assert len(QUERY_LOG) > n0
+            rec = next(e for e in QUERY_LOG.entries(10)
+                       if e["promql"] == rule.expr)
+            assert rec["path"].startswith("standing:")
+            assert rule.eval_duration_s > 0 and rule.last_eval_s > 0
+        finally:
+            alt.stop()
+
+    def test_warm_canonical_query_one_dispatch_with_alerting(self):
+        """Alerting riding the standing engine must not cost the serving
+        path anything: with an alert rule registered and evaluating, the
+        warm canonical query is still exactly ONE kernel dispatch."""
+        _ms, eng, se, alt = _setup()
+        try:
+            rule = alt.add_rule({"alert": "Traffic", "expr": Q + " > 0"},
+                                group="live")
+            se.refresh(rule.sq, now_ms=EDGE + 5_000)
+            start_s = (BASE + 600_000) / 1000
+            end_s = (BASE + 1_800_000) / 1000
+            eng.query_range(Q, start_s, end_s, 15.0)  # warm it
+            se.refresh(rule.sq, now_ms=EDGE + 20_000)  # alerting ticks on
+            d0 = kernel_dispatch_total()
+            eng.query_range(Q, start_s, end_s, 15.0)
+            assert kernel_dispatch_total() - d0 == 1
+        finally:
+            alt.stop()
+
+    def test_eval_failure_counted_not_fatal(self):
+        _ms, _eng, _se, alt = _setup()
+        try:
+            rule = _rule(alt)
+            before = _counter("filodb_alert_eval_failures",
+                              rule="HighTraffic")
+            alt._eval_rule(rule, EDGE, [("not-a-labels-dict",)])
+            assert _counter("filodb_alert_eval_failures",
+                            rule="HighTraffic") == before + 1
+            assert rule.last_error
+            # the next good eval clears the error
+            alt._eval_rule(rule, EDGE + 15_000, [({"job": "j0"}, 1.0)])
+            assert rule.last_error is None
+        finally:
+            alt.stop()
+
+    def test_alerts_gauge_tracks_states(self):
+        _ms, _eng, _se, alt = _setup()
+        try:
+            rule = _rule(alt)
+            alt._publish_gauges()
+            assert _counter("filodb_alerts", alertstate="inactive") >= 1
+            alt._eval_rule(rule, EDGE, [({"job": "j0"}, 2.0)])
+            alt._publish_gauges()
+            assert _counter("filodb_alerts", alertstate="pending") == 1
+        finally:
+            alt.stop()
+
+
+# -- notifier -----------------------------------------------------------------
+
+
+def _alert(fp, name="A", job="j0"):
+    return {"fingerprint": fp,
+            "labels": {"alertname": name, "job": job},
+            "annotations": {"summary": f"{job} burning"},
+            "starts_at_ms": EDGE}
+
+
+def _notifier(name, src, transport, **kw):
+    r = Receiver(name=name, url="http://invalid.test/hook",
+                 group_wait_s=5.0, group_interval_s=30.0,
+                 repeat_interval_s=300.0)
+    kw.setdefault("retry", RetryPolicy(max_attempts=1))
+    return r, Notifier([r], alerts_source=lambda: list(src),
+                       transport=transport, **kw)
+
+
+class TestNotifier:
+    def test_receiver_config_validation(self):
+        r = Receiver.from_config({"name": "am", "url": "http://x/",
+                                  "group_by": "cluster",
+                                  "group_wait": "10s",
+                                  "repeat_interval": "4h"})
+        assert r.group_by == ("cluster",) and r.group_wait_s == 10.0
+        assert r.repeat_interval_s == 14_400.0 and r.send_resolved
+        with pytest.raises(ValueError):
+            Receiver.from_config({"name": "am"})  # no url
+        with pytest.raises(ValueError):
+            Receiver.from_config({"name": "am", "url": "u", "bogus": 1})
+
+    def test_group_wait_then_exactly_one_delivery(self):
+        sent = []
+        src = [_alert("f1")]
+        _r, n = _notifier("wh-wait", src,
+                          lambda url, body, t: sent.append(
+                              json.loads(body)))
+        assert n.tick(now_s=0.0) == 0  # group_wait holds
+        assert n.tick(now_s=4.0) == 0
+        assert n.tick(now_s=5.0) == 1
+        (p,) = sent
+        assert p["version"] == "4" and p["status"] == "firing"
+        assert p["receiver"] == "wh-wait"
+        assert p["groupLabels"] == {"alertname": "A"}
+        assert p["groupKey"] == '{}:{alertname="A"}'
+        (a,) = p["alerts"]
+        assert a["status"] == "firing" and a["fingerprint"] == "f1"
+        assert a["startsAt"] == rfc3339(EDGE)
+        assert a["endsAt"] == "0001-01-01T00:00:00Z"
+        # dedup: unchanged group → silent until repeat_interval
+        for t in (6.0, 30.0, 100.0, 304.0):
+            assert n.tick(now_s=t) == 0
+        assert len(sent) == 1
+        assert _counter("filodb_alert_notify", receiver="wh-wait",
+                        outcome="ok") == 1
+
+    def test_membership_change_renotifies_after_group_interval(self):
+        sent = []
+        src = [_alert("f1")]
+        _r, n = _notifier("wh-member", src,
+                          lambda url, body, t: sent.append(
+                              json.loads(body)))
+        n.tick(now_s=0.0)  # registers the group (group_wait starts)
+        assert n.tick(now_s=5.0) == 1
+        src.append(_alert("f2", job="j1"))
+        assert n.tick(now_s=10.0) == 0  # changed, but inside group_interval
+        assert n.tick(now_s=35.0) == 1
+        assert len(sent) == 2 and len(sent[1]["alerts"]) == 2
+        assert sent[1]["commonLabels"] == {"alertname": "A"}
+
+    def test_resolved_notification_and_cleanup(self):
+        sent = []
+        src = [_alert("f1")]
+        _r, n = _notifier("wh-res", src,
+                          lambda url, body, t: sent.append(
+                              json.loads(body)))
+        n.tick(now_s=0.0)
+        assert n.tick(now_s=5.0) == 1
+        gone = src.pop()
+        n.note_resolved([{**gone, "ends_at_ms": EDGE + 60_000}])
+        assert n.tick(now_s=36.0) == 1  # group_interval after last notify
+        assert sent[1]["status"] == "resolved"
+        (a,) = sent[1]["alerts"]
+        assert a["status"] == "resolved"
+        assert a["endsAt"] == rfc3339(EDGE + 60_000)
+        # delivered + nothing firing → the group is forgotten
+        assert n.snapshot()["groups"] == []
+
+    def test_resolved_without_prior_notification_is_silent(self):
+        sent = []
+        src: list = []
+        _r, n = _notifier("wh-silent", src,
+                          lambda url, body, t: sent.append(body))
+        n.note_resolved([{**_alert("f1"), "ends_at_ms": EDGE}])
+        assert n.tick(now_s=100.0) == 0 and sent == []
+
+    def test_repeat_interval(self):
+        sent = []
+        src = [_alert("f1")]
+        _r, n = _notifier("wh-repeat", src,
+                          lambda url, body, t: sent.append(body))
+        n.tick(now_s=0.0)
+        assert n.tick(now_s=5.0) == 1
+        assert n.tick(now_s=304.0) == 0
+        assert n.tick(now_s=305.0) == 1  # repeat_interval elapsed
+        assert len(sent) == 2
+
+    def test_retry_backoff_then_error(self):
+        def boom(url, body, t):
+            raise OSError("connection refused")
+
+        sleeps: list = []
+        src = [_alert("f1")]
+        _r, n = _notifier(
+            "wh-retry", src, boom,
+            retry=RetryPolicy(max_attempts=3, base_backoff_s=0.5,
+                              multiplier=2.0, jitter=0.0,
+                              sleep=sleeps.append),
+            deadline_s=60.0,
+        )
+        n.tick(now_s=0.0)
+        assert n.tick(now_s=5.0) == 1
+        assert sleeps == [0.5, 1.0]  # exponential backoff between tries
+        assert _counter("filodb_alert_notify", receiver="wh-retry",
+                        outcome="retry") == 2
+        assert _counter("filodb_alert_notify", receiver="wh-retry",
+                        outcome="error") == 1
+        # failed delivery does NOT dedup: the group stays due
+        assert n.tick(now_s=36.0) == 1
+
+    def test_breaker_opens_on_dead_receiver(self):
+        calls = []
+
+        def boom(url, body, t):
+            calls.append(url)
+            raise OSError("connection refused")
+
+        src = [_alert("f1")]
+        r, n = _notifier("wh-breaker", src, boom)
+        g = _Group(key=(("alertname", "A"),),
+                   group_labels={"alertname": "A"}, first_seen_s=0.0)
+        for _ in range(4):  # breaker: min_calls=4, failure_rate=0.5
+            assert not n._deliver(r, g, list(src), [])
+        assert len(calls) == 4
+        assert not n._deliver(r, g, list(src), [])
+        assert len(calls) == 4  # breaker open: transport never invoked
+        assert _counter("filodb_alert_notify", receiver="wh-breaker",
+                        outcome="breaker_open") == 1
+
+
+# -- HTTP surfaces ------------------------------------------------------------
+
+
+_ALERT_RULE_KEYS = {
+    "name", "query", "duration", "keepFiringFor", "labels", "annotations",
+    "alerts", "state", "health", "lastError", "evaluationTime",
+    "lastEvaluation", "type",
+}
+_GROUP_KEYS = {"name", "file", "interval", "evaluationTime",
+               "lastEvaluation", "rules"}
+
+
+class TestHttpSurfaces:
+    def test_rules_and_alerts_endpoints(self, tmp_path):
+        from filodb_tpu.server import FiloServer
+
+        srv = FiloServer({
+            "dataset": "ds", "shards": 2,
+            "store_root": str(tmp_path / "store"),
+            "telemetry": {"self_scrape_interval_s": 3600},
+            "slo": {"interval_s": 15.0, "windows": ["5m"]},
+        })
+        port = srv.start(port=0)
+        base = f"http://127.0.0.1:{port}"
+        try:
+            assert srv.alerting is not None  # auto-on with _system standing
+            code, resp = _post_json(f"{base}/api/v1/rules/alert", {
+                "alert": "HighBurn",
+                "expr": "slo:latency:burnrate:5m > 10",
+                "for": "30s", "keep_firing_for": "1m",
+                "labels": {"severity": "page"},
+                "annotations": {"summary": "burn {{ $value }}"},
+                "group": "custom", "interval": "15s",
+            })
+            assert code == 200, resp
+            assert resp["data"] == {
+                "group": "custom", "name": "HighBurn",
+                "query": "slo:latency:burnrate:5m > 10",
+                "duration": 30.0, "keepFiringFor": 60.0,
+                "type": "alerting",
+            }
+            # duplicate name and malformed spec both 400
+            assert _post_json(f"{base}/api/v1/rules/alert", {
+                "alert": "HighBurn", "expr": "x > 1", "group": "custom",
+            })[0] == 400
+            assert _post_json(f"{base}/api/v1/rules/alert",
+                              {"alert": "NoExpr"})[0] == 400
+
+            # golden: Prometheus rules shape, both rule types
+            data = _get_json(f"{base}/api/v1/rules")["data"]
+            assert set(data) == {"groups"}
+            groups = {g["name"]: g for g in data["groups"]}
+            custom = groups["custom"]
+            assert set(custom) == _GROUP_KEYS
+            assert custom["interval"] == 15.0
+            (r,) = custom["rules"]
+            assert set(r) == _ALERT_RULE_KEYS
+            assert r["type"] == "alerting" and r["state"] == "inactive"
+            assert r["health"] == "ok" and r["alerts"] == []
+            assert r["duration"] == 30.0 and r["keepFiringFor"] == 60.0
+            recs = [r for g in data["groups"] for r in g["rules"]
+                    if r["type"] == "recording"]
+            assert "slo:latency:burnrate:5m" in [r["name"] for r in recs]
+            for r in recs:
+                assert {"name", "query", "health", "evaluationTime",
+                        "lastEvaluation", "type"} <= set(r)
+            # no rule listed twice across groups
+            names = [r["name"] for g in data["groups"]
+                     for r in g["rules"]]
+            assert len(names) == len(set(names))
+
+            # ?type / ?state filters
+            d = _get_json(f"{base}/api/v1/rules?type=alert")["data"]
+            assert d["groups"] and all(
+                r["type"] == "alerting"
+                for g in d["groups"] for r in g["rules"])
+            d = _get_json(f"{base}/api/v1/rules?type=record")["data"]
+            assert d["groups"] and all(
+                r["type"] == "recording"
+                for g in d["groups"] for r in g["rules"])
+            # nothing fires → a state filter empties every group
+            d = _get_json(f"{base}/api/v1/rules?state=firing")["data"]
+            assert d["groups"] == []
+            assert _get_status(f"{base}/api/v1/rules?type=bogus")[0] == 400
+            assert _get_status(f"{base}/api/v1/rules?state=bogus")[0] == 400
+
+            # /api/v1/alerts: live (empty) + validation
+            assert _get_json(f"{base}/api/v1/alerts")["data"] == \
+                {"alerts": []}
+            assert _get_json(
+                f"{base}/api/v1/alerts?state=pending")["data"] == \
+                {"alerts": []}
+            assert _get_status(f"{base}/api/v1/alerts?state=nope")[0] == 400
+
+            # /debug/querylog?path= filter
+            srv.memstore.ingest_routed(
+                "ds", counter_batch(n_series=4, n_samples=60,
+                                    start_ms=BASE), spread=1)
+            _get_json(f"{base}/api/v1/query_range?query="
+                      + urllib.parse.quote(Q)
+                      + f"&start={(BASE + 200_000) / 1000}"
+                      f"&end={(BASE + 500_000) / 1000}&step=60")
+            entries = _get_json(f"{base}/debug/querylog")["data"]
+            assert entries
+            p0 = entries[0]["path"]
+            filt = _get_json(f"{base}/debug/querylog?path="
+                             + urllib.parse.quote(p0))["data"]
+            assert filt and all(e["path"] == p0 for e in filt)
+            assert _get_json(f"{base}/debug/querylog?path=no-such-path"
+                             )["data"] == []
+        finally:
+            srv.stop()
+
+
+# -- end to end ---------------------------------------------------------------
+
+
+class _Webhook(BaseHTTPRequestHandler):
+    bodies: list = []
+
+    def do_POST(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+        n = int(self.headers.get("Content-Length", 0))
+        type(self).bodies.append(json.loads(self.rfile.read(n)))
+        self.send_response(200)
+        self.end_headers()
+
+    def log_message(self, *_a):
+        pass
+
+
+class TestAlertingE2E:
+    def test_slo_burn_to_webhook_and_back(self, tmp_path):
+        """The acceptance path: injected 5xx traffic → the SLO burn
+        recording rule crosses 1 → AvailabilityBurnFast walks
+        pending→firing on the standing engine → exactly ONE grouped
+        webhook lands → recovery resolves the alert → one resolved
+        notification — then the receiver dies and delivery shows real
+        retries/backoff against the dead socket."""
+        from filodb_tpu.server import FiloServer
+
+        hook = ThreadingHTTPServer(("127.0.0.1", 0), _Webhook)
+        _Webhook.bodies = []
+        hook_thread = threading.Thread(target=hook.serve_forever,
+                                       daemon=True)
+        hook_thread.start()
+        wport = hook.server_address[1]
+
+        srv = FiloServer({
+            "dataset": "ds", "shards": 2,
+            "store_root": str(tmp_path / "store"),
+            "telemetry": {"self_scrape_interval_s": 3600},
+            "slo": {"interval_s": 15.0, "windows": ["5m"]},
+            "alerting": {
+                "rule_files": ["conf/rules/slo.yml"],
+                "notify_tick_s": 3600,  # tests drive tick() directly
+                "receivers": [{
+                    "name": "am", "url": f"http://127.0.0.1:{wport}/",
+                    "group_wait": 0, "group_interval": "15s",
+                    "repeat_interval": "1h",
+                }],
+            },
+        })
+        port = srv.start(port=0)
+        base = f"http://127.0.0.1:{port}"
+        try:
+            # deterministic timeline: the maintainer thread must not race
+            # the test's explicit refreshes with wall-clock evaluations
+            ss = srv.system_standing
+            ss._stop.set()
+            ss._wake.set()
+            if ss._thread is not None:
+                ss._thread.join(timeout=2)
+
+            alt = srv.alerting
+            assert alt is not None and alt.notifier is not None
+            rule = next(r for g in alt.groups.values() for r in g.rules
+                        if r.name == "AvailabilityBurnFast")
+            assert rule.sq is not None
+
+            srv.memstore.ingest_routed(
+                "ds", counter_batch(n_series=6, n_samples=60,
+                                    start_ms=BASE), spread=1)
+            qurl = (f"{base}/api/v1/query_range?query="
+                    + urllib.parse.quote(Q)
+                    + f"&start={(BASE + 200_000) / 1000}"
+                    f"&end={(BASE + 500_000) / 1000}&step=60")
+            now = int(time.time() * 1000)
+
+            # OUTAGE: every window, some real 2xx traffic plus a pile of
+            # injected 5xx — the availability burn rate blows past 1
+            for k in range(6):
+                _get_json(qurl)
+                for _ in range(40):
+                    REGISTRY.counter("filodb_http_responses", code="500",
+                                     **{"class": "5xx"}).inc()
+                assert srv.self_scraper.scrape_once(
+                    now_ms=now + k * 15_000) > 0
+
+            def _tick(t_ms):
+                for sq in srv.slo_rules:  # burn series first, then alert
+                    srv.system_standing.refresh(sq, now_ms=t_ms)
+                srv.system_standing.refresh(rule.sq, now_ms=t_ms)
+
+            _tick(now + 75_000)
+            (a,) = rule.active.values()
+            assert a.state == "pending" and a.value > 1.0
+            _tick(now + 90_000)
+            assert a.state == "pending"  # 15s < for:30s
+            _tick(now + 105_000)
+            assert a.state == "firing"
+
+            # the alert surface shows it, annotations expanded with $value
+            alerts = _get_json(f"{base}/api/v1/alerts")["data"]["alerts"]
+            fired = [x for x in alerts
+                     if x["labels"]["alertname"] == "AvailabilityBurnFast"]
+            assert len(fired) == 1 and fired[0]["state"] == "firing"
+            assert "availability error budget burning at" in \
+                fired[0]["annotations"]["summary"]
+            assert "{{" not in fired[0]["annotations"]["summary"]
+            rj = _get_json(f"{base}/api/v1/rules?state=firing")["data"]
+            assert [r["name"] for g in rj["groups"]
+                    for r in g["rules"]] == ["AvailabilityBurnFast"]
+
+            # alert state is real data in _system…
+            out = _get_json(
+                f"{base}/api/v1/query_range?dataset=_system&query="
+                + urllib.parse.quote(
+                    'ALERTS{alertstate="firing",'
+                    'alertname="AvailabilityBurnFast"}')
+                + f"&start={now / 1000}&end={(now + 120_000) / 1000}"
+                "&step=15")["data"]
+            vals = [float(v) for s in out["result"]
+                    for _, v in s["values"] if v != "NaN"]
+            assert vals and set(vals) == {1.0}
+            # …and every evaluation left a query-observatory record
+            ql = _get_json(f"{base}/debug/querylog?path=standing:full"
+                           )["data"]
+            assert any(e["promql"] == rule.expr for e in ql)
+
+            # EXACTLY ONE grouped webhook, then dedup across repeat ticks
+            nt = alt.notifier
+            assert nt.tick(now_s=1000.0) == 1
+            for t in (1001.0, 1016.0, 1100.0):
+                assert nt.tick(now_s=t) == 0
+            assert len(_Webhook.bodies) == 1
+            body = _Webhook.bodies[0]
+            assert body["status"] == "firing" and body["receiver"] == "am"
+            assert body["groupLabels"] == \
+                {"alertname": "AvailabilityBurnFast"}
+            (wa,) = body["alerts"]
+            assert wa["status"] == "firing"
+            assert wa["labels"]["severity"] == "page"
+
+            # RECOVERY: only clean traffic; the 5m rate window slides past
+            # the injected errors and the burn series drops to 0
+            for k in range(6):
+                _get_json(qurl)
+                assert srv.self_scraper.scrape_once(
+                    now_ms=now + 330_000 + k * 15_000) > 0
+            _tick(now + 420_000)  # gap >> keep_firing_for: resolves now
+            assert not rule.active
+            assert _get_json(f"{base}/api/v1/alerts")["data"]["alerts"] \
+                == []
+
+            assert nt.tick(now_s=1200.0) == 1
+            assert len(_Webhook.bodies) == 2
+            res_body = _Webhook.bodies[1]
+            assert res_body["status"] == "resolved"
+            (ra,) = res_body["alerts"]
+            assert ra["status"] == "resolved"
+            assert ra["endsAt"] != "0001-01-01T00:00:00Z"
+
+            # KILLED RECEIVER: the same receiver, socket now dead — the
+            # delivery path really retries with backoff, then gives up
+            hook.shutdown()
+            hook.server_close()
+            hook_thread.join(timeout=2)
+            nt.retry = RetryPolicy(max_attempts=3, base_backoff_s=0.01,
+                                   multiplier=2.0, jitter=0.0, seed=1)
+            r0 = nt.receivers[0]
+            g = _Group(key=(("alertname", "Dead"),),
+                       group_labels={"alertname": "Dead"},
+                       first_seen_s=0.0)
+            retry0 = _counter("filodb_alert_notify", receiver="am",
+                              outcome="retry")
+            err0 = _counter("filodb_alert_notify", receiver="am",
+                            outcome="error")
+            ok0 = _counter("filodb_alert_notify", receiver="am",
+                           outcome="ok")
+            assert not nt._deliver(r0, g, [_alert("fdead", name="Dead")],
+                                   [])
+            assert _counter("filodb_alert_notify", receiver="am",
+                            outcome="retry") == retry0 + 2
+            assert _counter("filodb_alert_notify", receiver="am",
+                            outcome="error") == err0 + 1
+            assert ok0 == 2.0  # the two real deliveries above
+        finally:
+            srv.stop()
+            try:
+                hook.server_close()
+            except OSError:
+                pass

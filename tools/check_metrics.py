@@ -281,6 +281,99 @@ def rollup_violations() -> list[str]:
     return out
 
 
+def alerting_violations() -> list[str]:
+    """Alert-taxonomy lint (obs/alerting.py + obs/notify.py): (a) every
+    ``filodb_alert*`` family emitted in code carries a HELP text in
+    metrics.HELP_TEXTS; (b) the canonical state set (alerting.ALERT_STATES
+    — the ``alertstate`` label taxonomy on ``filodb_alerts`` and the
+    ``ALERTS`` write-back series) matches the doc's "canonical
+    ``alertstate`` values" line in doc/observability.md, and every literal
+    ``alertstate`` value in the package is a member — an off-taxonomy
+    literal would mint a state no dashboard row matches."""
+    out: list[str] = []
+    helped: set[str] = set()
+    tree = ast.parse((PKG / "metrics.py").read_text())
+    for node in ast.walk(tree):
+        target = None
+        if isinstance(node, ast.Assign) and node.targets:
+            target = node.targets[0]
+        elif isinstance(node, ast.AnnAssign):
+            target = node.target
+        if (target is not None and isinstance(target, ast.Name)
+                and target.id == "HELP_TEXTS" and node.value is not None
+                and isinstance(node.value, ast.Dict)):
+            for k in node.value.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    helped.add(k.value)
+    code, where = code_stems()
+    for s in sorted(code):
+        if s.startswith("filodb_alert") and s not in helped:
+            locs = ", ".join(where.get(s, [])[:2])
+            out.append(
+                f"alerting family {s}* emitted ({locs}) without a HELP "
+                f"text in metrics.HELP_TEXTS"
+            )
+    # canonical state set, read from the AST (no imports — runs without jax)
+    canon: set[str] = set()
+    alerting = PKG / "obs" / "alerting.py"
+    for node in ast.walk(ast.parse(alerting.read_text())):
+        if (isinstance(node, ast.Assign) and node.targets
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "ALERT_STATES"):
+            for c in ast.walk(node.value):
+                if isinstance(c, ast.Constant) and isinstance(c.value, str):
+                    canon.add(c.value)
+    if not canon:
+        return out + ["alerting lint: ALERT_STATES not found in "
+                      "filodb_tpu/obs/alerting.py"]
+    # the doc's canonical-states line must agree (the operator contract)
+    m = re.search(r"canonical `alertstate` values:([^\n]*)", DOC.read_text())
+    documented = set(re.findall(r"`([a-z_]+)`", m.group(1))) if m else set()
+    if not m:
+        out.append(
+            "doc/observability.md is missing the 'canonical `alertstate` "
+            "values:' line the alerting lint checks"
+        )
+    else:
+        for s in sorted(canon - documented):
+            out.append(
+                f"alertstate {s!r} is canonical but missing from "
+                f"doc/observability.md's canonical-values line"
+            )
+        for s in sorted(documented - canon):
+            out.append(
+                f"doc/observability.md documents alertstate {s!r} that is "
+                f"not in alerting.ALERT_STATES"
+            )
+    # every literal alertstate value in the package is canonical
+    for path in sorted(PKG.rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        for node in ast.walk(ast.parse(path.read_text())):
+            vals: list[tuple[str, int]] = []
+            if isinstance(node, ast.Call):
+                for kw in node.keywords:
+                    if (kw.arg == "alertstate"
+                            and isinstance(kw.value, ast.Constant)
+                            and isinstance(kw.value.value, str)):
+                        vals.append((kw.value.value, node.lineno))
+            elif isinstance(node, ast.Dict):
+                for k, v in zip(node.keys, node.values):
+                    if (isinstance(k, ast.Constant)
+                            and k.value == "alertstate"
+                            and isinstance(v, ast.Constant)
+                            and isinstance(v.value, str)):
+                        vals.append((v.value, node.lineno))
+            for v, lineno in vals:
+                if v not in canon:
+                    out.append(
+                        f"literal alertstate {v!r} "
+                        f"({path.relative_to(ROOT)}:{lineno}) is not in "
+                        f"alerting.ALERT_STATES"
+                    )
+    return out
+
+
 OPS = PKG / "ops"
 
 
@@ -348,6 +441,7 @@ def main() -> int:
     violations: list[str] = list(fused_reason_violations())
     violations.extend(standing_violations())
     violations.extend(rollup_violations())
+    violations.extend(alerting_violations())
     violations.extend(jit_registration_violations())
     for s in sorted(code - doc):
         locs = ", ".join(where.get(s, [])[:2])
