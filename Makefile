@@ -17,8 +17,10 @@ $(NATIVE_DIR)/libfilodbindex.so: $(NATIVE_DIR)/index.cpp
 $(NATIVE_DIR)/libfilodbprom.so: $(NATIVE_DIR)/promparse.cpp
 	g++ -O3 -march=native -std=c++17 -shared -fPIC $< -o $@
 
-# best-effort: the renderer needs float std::to_chars (gcc >= 11); runtime
-# falls back to the Python renderer (api/promjson.py) when the .so is absent
+# best-effort: the renderer carries its own shortest-repr formatter so it
+# builds on gcc >= 10 (integer std::to_chars only); runtime falls back to
+# the vectorized numpy / pure-Python renderers (api/promjson.py) when the
+# .so is absent
 $(NATIVE_DIR)/libfilodbrender.so: $(NATIVE_DIR)/promrender.cpp
 	-g++ -O3 -march=native -std=c++17 -shared -fPIC $< -o $@
 

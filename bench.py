@@ -50,6 +50,7 @@ METRIC = {
     "query_hicard": "query_hicard_2000_of_8000_qps",
     "long_range_quantile": "long_range_quantile_30d_p50",
     "failover_storm": "failover_storm_qps_2k",
+    "render_2m": "render_2m_stream_msamples",
 }.get(WORKLOAD, "sum_rate_100k_series_range_query_p50")
 # concurrent_qps: client thread count, per-mode measurement window, and the
 # batching window handed to the batched engine (the knob under test)
@@ -1575,7 +1576,143 @@ def run_benchmark_failover_storm():
     }))
 
 
+def run_benchmark_render_2m():
+    """Result-plane streaming render (doc/perf.md "Result plane"): a ~2M
+    sample per-series matrix (rate() without aggregation at native 10s
+    step) served over live HTTP through the chunked-streaming edge —
+    stream_matrix pulls device blocks through the double-buffered D2H
+    prefetcher while earlier blocks encode and hit the socket.
+
+    value = end-to-end body throughput in Msamples/s (HIGHER is better;
+    qps_floor_min gates it). phases_ms carries first-byte latency (must
+    land well before the body completes — the streaming claim), total
+    body wall, and the encoder's prefetch-stall count for the measured
+    runs (dispatch-stall ~0 when D2H keeps ahead of encode). match =
+    the streamed body's data.result is IDENTICAL (exact decimal strings)
+    to an in-process buffered render of the same engine result, AND the
+    warm CANONICAL query (fused sum(rate(...))) over the same data stays
+    exactly ONE kernel dispatch with the streaming edge on — the
+    prefetcher's per-block device slicing must not show up as dispatches.
+    (The 2M per-series matrix itself legitimately dispatches per shard —
+    its per-query count rides phases_ms for the record.)"""
+    import http.client
+    import urllib.parse
+
+    from filodb_tpu.api import promjson as PJ
+    from filodb_tpu.api.http import serve_background
+    from filodb_tpu.coordinator.planner import PlannerParams, QueryEngine
+    from filodb_tpu.metrics import REGISTRY
+    from filodb_tpu.testkit import kernel_dispatch_total
+
+    def stall_total() -> float:
+        total = 0.0
+        with REGISTRY._lock:
+            for (name, _lbls), m in REGISTRY._metrics.items():
+                if name == "filodb_render_stream_stalls":
+                    total += m.value
+        return total
+
+    ms, _ts = build_memstore()
+    _enable_compile_cache()
+    engine = QueryEngine(ms, "prometheus", PlannerParams())
+    srv, port = serve_background(engine)
+    step_s = INTERVAL_MS / 1000.0  # native resolution: per-series matrix
+    q = urllib.parse.quote("rate(http_requests_total[5m])")
+    path = (f"/api/v1/query_range?query={q}"
+            f"&start={START_S}&end={END_S}&step={step_s}")
+
+    def fetch():
+        """One streamed request; returns (body, first_byte_s, total_s)."""
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=600)
+        t0 = time.perf_counter()
+        conn.request("GET", path, headers={"Accept-Encoding": "identity"})
+        r = conn.getresponse()
+        first = r.read(1)
+        t_first = time.perf_counter() - t0
+        body = first + r.read()
+        t_total = time.perf_counter() - t0
+        chunked = r.getheader("Transfer-Encoding") == "chunked"
+        conn.close()
+        return body, t_first, t_total, chunked
+
+    t0 = time.perf_counter()
+    body, _, _, chunked0 = fetch()  # compile + stage + cache warm
+    warmup_s = time.perf_counter() - t0
+    n_samples = sum(len(s["values"])
+                    for s in json.loads(body)["data"]["result"])
+    sys.stderr.write(
+        f"warmup {warmup_s:.1f}s, body {len(body) / 1e6:.1f}MB, "
+        f"{n_samples / 1e6:.2f}M samples, chunked={chunked0}\n")
+    before_dispatch = kernel_dispatch_total()
+    before_stalls = stall_total()
+    firsts, totals = [], []
+    for _ in range(TIMED_RUNS):
+        body, t_first, t_total, _ck = fetch()
+        firsts.append(t_first)
+        totals.append(t_total)
+    warm_dispatches = kernel_dispatch_total() - before_dispatch
+    stalls = stall_total() - before_stalls
+    # canonical-query invariant with the streaming edge enabled: warm
+    # fused sum(rate(...)) stays exactly ONE dispatch
+    canon = urllib.parse.quote("sum(rate(http_requests_total[5m]))")
+    canon_path = (f"/api/v1/query_range?query={canon}"
+                  f"&start={START_S}&end={END_S}&step={STEP_S}")
+    for _ in range(2):  # compile + stage warm
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=600)
+        conn.request("GET", canon_path)
+        conn.getresponse().read()
+        conn.close()
+    before_canon = kernel_dispatch_total()
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=600)
+    conn.request("GET", canon_path)
+    conn.getresponse().read()
+    conn.close()
+    single = kernel_dispatch_total() - before_canon == 1
+    # oracle: buffered in-process render of the same engine result — the
+    # streamed body's payload must be exactly it (same decimal strings)
+    res = engine.query_range("rate(http_requests_total[5m])", START_S, END_S,
+                             step_s)
+    oracle = json.loads(b"".join(PJ.stream_matrix(res)))["data"]["result"]
+    got = json.loads(body)["data"]["result"]
+    key = lambda s: json.dumps(s["metric"], sort_keys=True)  # noqa: E731
+    payload_eq = ({key(s): s["values"] for s in got}
+                  == {key(s): s["values"] for s in oracle})
+    streamed = chunked0 and float(np.median(firsts)) < float(
+        np.median(totals)) / 2.0
+    srv.shutdown()
+    p50_total = float(np.median(totals))
+    msps = n_samples / p50_total / 1e6
+    ok = payload_eq and single and streamed
+    import jax
+
+    backend = jax.devices()[0].platform
+    sys.stderr.write(
+        f"render_2m: {msps:.2f} Msamples/s first_byte_p50="
+        f"{np.median(firsts) * 1e3:.1f}ms total_p50={p50_total * 1e3:.0f}ms "
+        f"stalls={stalls:.0f} matrix_dispatches={warm_dispatches}/"
+        f"{len(totals)} canonical_single_dispatch={single} "
+        f"payload_eq={payload_eq} streamed={streamed}\n")
+    print(json.dumps({
+        "metric": METRIC,
+        "value": round(msps, 3),
+        "unit": "Msamples/s",
+        "backend": backend,
+        "series": N_SERIES,
+        "match": bool(ok),
+        "warmup_s": round(warmup_s, 2),
+        "phases_ms": {
+            "first_byte_p50": round(float(np.median(firsts)) * 1e3, 2),
+            "total_p50": round(p50_total * 1e3, 2),
+            "stream_stalls": round(stalls, 1),
+            "samples_m": round(n_samples / 1e6, 3),
+            "matrix_dispatches_per_query": round(warm_dispatches / max(len(totals), 1), 1),
+        },
+    }))
+
+
 def run_benchmark():
+    if WORKLOAD == "render_2m":
+        return run_benchmark_render_2m()
     if WORKLOAD == "failover_storm":
         return run_benchmark_failover_storm()
     if WORKLOAD == "long_range_quantile":

@@ -486,8 +486,12 @@ ALL.append(bench_mesh_paths)
 
 
 def bench_serialization():
-    """Prom JSON rendering throughput (the serving-edge cost)."""
-    from filodb_tpu.api.promjson import render_matrix
+    """Prom JSON rendering throughput (the serving-edge cost), measured on
+    the PRODUCTION bytes path: stream_matrix fragments — exactly what both
+    the buffered and chunked-streaming edges send (native row renderer when
+    libfilodbrender is built, vectorized numpy tier otherwise)."""
+    from filodb_tpu import native as N
+    from filodb_tpu.api import promjson as J
     from filodb_tpu.query.rangevector import Grid, QueryResult
 
     rng = np.random.default_rng(0)
@@ -495,8 +499,18 @@ def bench_serialization():
     g = Grid([{"_metric_": "m", "i": str(i)} for i in range(1000)],
              BASE, 60_000, 120, vals)
     res = QueryResult(grids=[g])
-    dt = _bench(lambda: render_matrix(res))
-    report("prom_json_render", 1000 * 120 / dt / 1e6, "Msamples/s")
+    dt = _bench(lambda: b"".join(J.stream_matrix(res)))
+    report(f"prom_json_render[{J.active_render_format()}]",
+           1000 * 120 / dt / 1e6, "Msamples/s")
+    if N.render_lib() is not None:
+        # numpy tier on the same workload (what an un-built checkout serves)
+        orig = N.render_matrix_rows
+        N.render_matrix_rows = lambda ts, v: None
+        try:
+            dt = _bench(lambda: b"".join(J.stream_matrix(res)))
+            report("prom_json_render[numpy]", 1000 * 120 / dt / 1e6, "Msamples/s")
+        finally:
+            N.render_matrix_rows = orig
 
     from filodb_tpu.api.arrow_edge import result_to_ipc
 

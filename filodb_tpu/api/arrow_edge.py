@@ -19,21 +19,33 @@ import threading
 import numpy as np
 import pyarrow as pa
 
-from ..query.rangevector import Grid, QueryResult
+from ..query.rangevector import Grid, QueryResult, QueryStats, ScalarResult
+
+# peer-hop media type: a FiloDB peer advertises it via Accept and the serving
+# edge answers Arrow IPC instead of JSON. Older peers never send it and keep
+# getting JSON — that Accept header IS the version negotiation.
+ARROW_CONTENT_TYPE = "application/vnd.filodb.arrow.v1"
 
 
 def grid_to_record_batch(g: Grid) -> pa.RecordBatch:
-    vals = np.ascontiguousarray(g.values_np(), dtype=np.float32)
+    vals = np.ascontiguousarray(g.values_np())
+    if vals.dtype not in (np.float32, np.float64):
+        # keep engine dtypes bit-exact on the wire; everything else (ints,
+        # f16) widens once to f64, which holds them losslessly
+        vals = vals.astype(np.float64)
+    vtype = pa.float64() if vals.dtype == np.float64 else pa.float32()
     n, j = vals.shape
     labels = pa.array([json.dumps(l, sort_keys=True) for l in g.labels], type=pa.utf8())
-    flat = pa.array(vals.ravel(), type=pa.float32())
+    flat = pa.array(vals.ravel(), type=vtype)
     values = pa.FixedSizeListArray.from_arrays(flat, j)
     metadata = {
         b"start_ms": str(g.start_ms).encode(),
         b"step_ms": str(g.step_ms).encode(),
         b"num_steps": str(g.num_steps).encode(),
     }
-    fields = [pa.field("labels", pa.utf8()), pa.field("values", pa.list_(pa.float32(), j))]
+    if g.stale:
+        metadata[b"stale"] = b"1"
+    fields = [pa.field("labels", pa.utf8()), pa.field("values", pa.list_(vtype, j))]
     arrays = [labels, values]
     if g.hist is not None:
         # native histogram buckets ride as a flattened [J*B] list per series
@@ -63,32 +75,149 @@ def record_batch_to_grid(rb: pa.RecordBatch) -> Grid:
         les = np.asarray(json.loads(md[b"les"]), dtype=np.float64)
         hl = rb.column("hist")
         hist = np.asarray(hl.flatten()).reshape(len(labels), width * 0 + hl.type.list_size // nb, nb)
-    return Grid(labels, start_ms, step_ms, num_steps, vals, hist=hist, les=les)
+    return Grid(labels, start_ms, step_ms, num_steps, vals, hist=hist, les=les,
+                stale=md.get(b"stale") == b"1")
 
 
-def result_to_ipc(res: QueryResult) -> bytes:
-    """All grids as one Arrow IPC stream (batch per grid)."""
+# ---------------------------------------------------------------------------
+# Full-result envelope: the node-to-node wire format
+# ---------------------------------------------------------------------------
+#
+# One result = magic + length-prefixed segments. Segment 0 is a JSON envelope
+# (result type, warnings/partial, stats, scalar, trace — the small stuff that
+# rides "warnings"/"stats"/"trace" in the JSON user edge); each grid is its
+# OWN Arrow IPC stream so grids with different step widths / histogram shapes
+# never have to share one stream schema; raw export series (variable-length
+# ts/values per series) close the stream as a final list-typed segment.
+
+_MAGIC = b"FARS1\n"
+
+
+def _frame(parts: list, payload: bytes) -> None:
+    parts.append(len(payload).to_bytes(8, "little"))
+    parts.append(payload)
+
+
+def _batch_bytes(rb: pa.RecordBatch) -> bytes:
     sink = pa.BufferOutputStream()
-    writer = None
-    for g in res.grids:
-        rb = grid_to_record_batch(g)
-        if writer is None:
-            writer = pa.ipc.new_stream(sink, rb.schema)
+    with pa.ipc.new_stream(sink, rb.schema) as writer:
         writer.write_batch(rb)
-    if writer is None:  # empty result: write an empty schema stream
-        schema = pa.schema([pa.field("labels", pa.utf8())])
-        writer = pa.ipc.new_stream(sink, schema)
-    writer.close()
     return sink.getvalue().to_pybytes()
 
 
+def _raw_to_batch(raw) -> pa.RecordBatch:
+    labels = pa.array([json.dumps(l, sort_keys=True) for l, _, _ in raw], type=pa.utf8())
+    ts = pa.array([np.asarray(t, dtype=np.int64) for _, t, _ in raw],
+                  type=pa.list_(pa.int64()))
+    # per-series values may be [T] (plain) or [T, B] (histogram raw): ship
+    # flattened f64 + the column count so the reader can reshape
+    vcols, flat = [], []
+    for _, _, v in raw:
+        a = np.asarray(v, dtype=np.float64)
+        vcols.append(a.shape[1] if a.ndim == 2 else 0)
+        flat.append(a.ravel())
+    vals = pa.array(flat, type=pa.list_(pa.float64()))
+    cols = pa.array(vcols, type=pa.int32())
+    schema = pa.schema(
+        [pa.field("labels", pa.utf8()), pa.field("ts", pa.list_(pa.int64())),
+         pa.field("values", pa.list_(pa.float64())), pa.field("vcols", pa.int32())],
+        metadata={b"kind": b"raw"},
+    )
+    return pa.RecordBatch.from_arrays([labels, ts, vals, cols], schema=schema)
+
+
+def _batch_to_raw(rb: pa.RecordBatch) -> list:
+    out = []
+    labels = rb.column("labels").to_pylist()
+    ts = rb.column("ts")
+    vals = rb.column("values")
+    cols = rb.column("vcols").to_pylist()
+    for i, ls in enumerate(labels):
+        t = np.asarray(ts[i].as_py(), dtype=np.int64)
+        v = np.asarray(vals[i].as_py(), dtype=np.float64)
+        if cols[i]:
+            v = v.reshape(-1, cols[i])
+        out.append((json.loads(ls), t, v))
+    return out
+
+
+def _stats_to_json(st: QueryStats) -> dict:
+    return {k: int(getattr(st, k)) for k in QueryStats._KEYS}
+
+
+def result_to_ipc(res: QueryResult, trace=None) -> bytes:
+    """Encode a QueryResult for a peer hop: JSON envelope segment + one Arrow
+    IPC stream per grid (+ an optional raw-series segment). Float payloads
+    cross bit-exact — no decimal render/parse round-trip."""
+    env: dict = {"resultType": res.result_type, "nGrids": len(res.grids)}
+    if res.warnings:
+        env["warnings"] = list(res.warnings)
+    if res.partial:
+        env["partial"] = True
+    if res.stats is not None:
+        env["stats"] = _stats_to_json(res.stats)
+    if trace is None and isinstance(res.trace, dict):
+        trace = res.trace
+    if trace is not None:
+        env["trace"] = trace
+    if res.scalar is not None:
+        sc = res.scalar
+        env["scalar"] = {
+            "start_ms": int(sc.start_ms), "step_ms": int(sc.step_ms),
+            "num_steps": int(sc.num_steps),
+            # repr() round-trips doubles exactly; json emits exactly that
+            "values": [float(v) for v in np.asarray(sc.values, dtype=np.float64)],
+        }
+    if res.metadata is not None:
+        env["metadata"] = res.metadata
+    parts: list = [_MAGIC]
+    _frame(parts, json.dumps(env).encode())
+    for g in res.grids:
+        _frame(parts, _batch_bytes(grid_to_record_batch(g)))
+    if res.raw is not None:
+        _frame(parts, _batch_bytes(_raw_to_batch(res.raw)))
+    return b"".join(parts)
+
+
 def ipc_to_result(data: bytes) -> QueryResult:
-    reader = pa.ipc.open_stream(pa.BufferReader(data))
-    grids = []
-    for rb in reader:
-        if rb.num_columns >= 2:
-            grids.append(record_batch_to_grid(rb))
-    return QueryResult(grids=grids)
+    if not data.startswith(_MAGIC):
+        # pre-envelope peers shipped a bare IPC stream of grid batches
+        reader = pa.ipc.open_stream(pa.BufferReader(data))
+        grids = [record_batch_to_grid(rb) for rb in reader if rb.num_columns >= 2]
+        return QueryResult(grids=grids)
+    segs = []
+    off = len(_MAGIC)
+    while off < len(data):
+        n = int.from_bytes(data[off:off + 8], "little")
+        off += 8
+        segs.append(data[off:off + n])
+        off += n
+    env = json.loads(segs[0])
+    res = QueryResult(result_type=env.get("resultType", "matrix"))
+    n_grids = int(env.get("nGrids", 0))
+    for seg in segs[1:1 + n_grids]:
+        rb = next(iter(pa.ipc.open_stream(pa.BufferReader(seg))))
+        res.grids.append(record_batch_to_grid(rb))
+    for seg in segs[1 + n_grids:]:
+        rb = next(iter(pa.ipc.open_stream(pa.BufferReader(seg))))
+        if (rb.schema.metadata or {}).get(b"kind") == b"raw":
+            res.raw = _batch_to_raw(rb)
+    if env.get("warnings"):
+        res.warnings = list(env["warnings"])
+    res.partial = bool(env.get("partial"))
+    if env.get("stats"):
+        res.stats = QueryStats(**{k: int(v) for k, v in env["stats"].items()
+                                  if k in QueryStats._KEYS})
+    if env.get("trace") is not None:
+        res.trace = env["trace"]
+    if env.get("scalar") is not None:
+        s = env["scalar"]
+        res.scalar = ScalarResult(int(s["start_ms"]), int(s["step_ms"]),
+                                  int(s["num_steps"]),
+                                  np.asarray(s["values"], dtype=np.float64))
+    if env.get("metadata") is not None:
+        res.metadata = env["metadata"]
+    return res
 
 
 # ---------------------------------------------------------------------------

@@ -191,7 +191,21 @@ class _RemoteExecServicer:
             log.exception("remote exec failed")
             yield error_frame("Internal", f"{type(e).__name__}: {e}")
             return
-        yield from result_to_frames(res, stats_ext=stats_ext)
+        # result-plane accounting parity with the HTTP edge: the gRPC leg
+        # is already columnar (proto frames wrap the raw f32 grid bytes) —
+        # time the frame encode and count wire bytes under format=grpc
+        import time as _time
+
+        from ..metrics import REGISTRY
+
+        t_r = _time.perf_counter()
+        nbytes = 0
+        for frame in result_to_frames(res, stats_ext=stats_ext):
+            nbytes += frame.ByteSize()
+            yield frame
+        REGISTRY.histogram("filodb_render_seconds", format="grpc").observe(
+            _time.perf_counter() - t_r)
+        REGISTRY.counter("filodb_response_bytes", format="grpc").inc(nbytes)
 
     # -- methods ----------------------------------------------------------
 

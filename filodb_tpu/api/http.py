@@ -127,6 +127,14 @@ class PromApiHandler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     GZIP_MIN_BYTES = 1024
     STREAM_MIN_SAMPLES = 200_000  # above this, query_range streams chunked
+    # series rows per device->host block on the streaming path (the
+    # D2H/encode overlap granularity; 0 = pull whole grids upfront).
+    # Config key result_plane.stream_block_rows.
+    STREAM_BLOCK_ROWS = 512
+    # peer columnar edge: honor "Accept: application/vnd.filodb.arrow.v1"
+    # on query_range with Arrow IPC bodies (config result_plane.peer_exchange
+    # = json disables, forcing decimal JSON on every hop)
+    ARROW_EDGE = True
 
     def _engine_for_request(self, params: dict | None = None) -> QueryEngine:
         if self.local_engine is not None and self.headers.get("X-FiloDB-Local"):
@@ -153,6 +161,37 @@ class PromApiHandler(BaseHTTPRequestHandler):
         pass
 
     @staticmethod
+    def _observe_render(fmt: str, render_s: float, nbytes: int,
+                        stalls: int = 0) -> None:
+        """Result-plane encode accounting: filodb_render_seconds{format},
+        filodb_response_bytes_total{format}, and (streaming only)
+        filodb_render_stream_stalls_total — encoder waits on a D2H block
+        the double-buffer failed to hide."""
+        from ..metrics import REGISTRY
+
+        REGISTRY.histogram("filodb_render_seconds", format=fmt).observe(render_s)
+        REGISTRY.counter("filodb_response_bytes", format=fmt).inc(nbytes)
+        if stalls:
+            REGISTRY.counter("filodb_render_stream_stalls").inc(stalls)
+
+    def _peer_accepts_arrow(self) -> bool:
+        """Version negotiation for the node-to-node columnar hop: only a
+        peer that explicitly lists the Arrow media type in Accept gets IPC
+        frames; everyone else (browsers, Grafana, older FiloDB builds) gets
+        JSON. Requires pyarrow locally — an arrow-less install quietly
+        answers JSON, which the requesting peer equally accepts."""
+        if not self.ARROW_EDGE:
+            return False
+        accept = self.headers.get("Accept") or ""
+        if "application/vnd.filodb.arrow" not in accept:
+            return False
+        try:
+            from . import arrow_edge  # noqa: F401 (pyarrow gate)
+        except Exception:
+            return False
+        return True
+
+    @staticmethod
     def _count_response(code: int) -> None:
         """Per-status response accounting — the availability-SLO feed
         (obs/slo.py): ``filodb_http_responses_total{code,class}``. Class
@@ -170,11 +209,17 @@ class PromApiHandler(BaseHTTPRequestHandler):
         """Returns the UNCOMPRESSED body byte count — the query
         observatory records it as the result size, which must measure the
         query, not the client's Accept-Encoding."""
-        body = json.dumps(payload).encode()
+        return self._send_body(code, json.dumps(payload).encode(), headers)
+
+    def _send_body(self, code: int, body: bytes, headers: dict | None = None,
+                   content_type: str = "application/json"):
+        """Pre-encoded-body twin of _send (same gzip/accounting contract) —
+        the buffered matrix path sends stream_matrix's joined chunks through
+        here so buffered and streamed bodies are byte-identical."""
         raw_len = len(body)
         self._count_response(code)
         self.send_response(code)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", content_type)
         for k, v in (headers or {}).items():
             self.send_header(k, v)
         # transparent gzip for big results (remote execs request it)
@@ -194,17 +239,40 @@ class PromApiHandler(BaseHTTPRequestHandler):
     def _send_chunked(self, code: int, chunks):
         """Stream an iterable of byte chunks with chunked transfer encoding
         (HTTP/1.1 keep-alive safe); memory stays bounded by one chunk.
-        Returns total bytes streamed."""
+        Returns total bytes streamed.
+
+        A producer error after the 200 status line cannot become a real
+        error response any more — without care the client would see a
+        truncated 200 that json-parses as nothing. Instead the stream ends
+        with a newline-delimited error envelope (valid JSON on its own
+        line — machine-detectable by any client that notices the body
+        doesn't parse) and a CLEAN chunked terminator, and the abort is
+        counted under filodb_http_responses_total{class="stream_abort"}
+        (the availability SLO's 5xx-equivalent for streamed bodies). A
+        transport error (client gone) just stops the stream."""
         self._count_response(code)
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Transfer-Encoding", "chunked")
         self.end_headers()
         total = 0
-        for chunk in chunks:
-            if chunk:
-                self.wfile.write(f"{len(chunk):X}\r\n".encode() + chunk + b"\r\n")
-                total += len(chunk)
+        try:
+            for chunk in chunks:
+                if chunk:
+                    self.wfile.write(f"{len(chunk):X}\r\n".encode() + chunk + b"\r\n")
+                    total += len(chunk)
+        except (BrokenPipeError, ConnectionResetError):
+            raise  # client is gone; nothing to mark
+        except Exception as e:  # noqa: BLE001 — producer died mid-stream
+            from ..metrics import REGISTRY
+
+            marker = (b'\n{"status":"error","errorType":"stream_aborted",'
+                      + b'"error":' + json.dumps(f"{type(e).__name__}: {e}").encode()
+                      + b"}\n")
+            self.wfile.write(f"{len(marker):X}\r\n".encode() + marker + b"\r\n")
+            total += len(marker)
+            REGISTRY.counter("filodb_http_responses", code=str(code),
+                             **{"class": "stream_abort"}).inc()
         self.wfile.write(b"0\r\n\r\n")
         return total
 
@@ -449,29 +517,33 @@ class PromApiHandler(BaseHTTPRequestHandler):
             return self._send(400, J.error("bad_data", "end timestamp before start"))
         trace_on = self._trace_requested(p)
         trace_id, parent_span = self._trace_parent()
-        res = self._engine_for_request(p).query_range(
-            query, start, end, step, allow_partial_results=self._allow_partial(p),
-            trace_id=trace_id, parent_span_id=parent_span,
-        )
+        engine = self._engine_for_request(p)
+        res = None
+        served_standing = False
+        if (self.standing is not None and engine is self.engine
+                and not trace_on):
+            # a registered standing query already holds this result's
+            # matrix as retained partials — splice + render instead of
+            # re-executing (ROADMAP leftover: only SSE subscribers rode
+            # them before). Trace requests bypass: the retained state has
+            # no span tree to annotate.
+            res = self.standing.serve_range(query, start, end, step)
+            served_standing = res is not None
+        if res is None:
+            res = engine.query_range(
+                query, start, end, step,
+                allow_partial_results=self._allow_partial(p),
+                trace_id=trace_id, parent_span_id=parent_span,
+            )
         from ..metrics import trace_to_dict
         from ..obs.querylog import QUERY_LOG
 
         # the query-observatory record this execution published (None for
         # remote-child legs); the edge folds in its serving phases below
         record = getattr(res, "query_log", None)
-        # D2H transfer phase: pull every result grid to host HERE, timed,
-        # instead of implicitly inside the JSON encoder — the decomposition
-        # the result-plane ROADMAP item needs (is it the transfer or the
-        # encode that dominates?). Not an added sync: rendering forced the
-        # same conversion one call later.
-        t_tr = time.perf_counter()
-        for g in res.grids:
-            g.values = np.asarray(g.values)
-            if g.hist is not None:
-                g.hist = np.asarray(g.hist)
-        transfer_s = time.perf_counter() - t_tr
-        trace = trace_to_dict(res.trace) if trace_on else None
+        trace = trace_to_dict(res.trace) if trace_on and res.trace is not None else None
         warnings = res.warnings or None
+        render_format = "json-" + J.active_render_format()
         if res.result_type == "scalar":
             # range query over a scalar: render as matrix of the scalar
             sc = res.scalar
@@ -497,9 +569,10 @@ class PromApiHandler(BaseHTTPRequestHandler):
             nbytes = self._send(200, J.success(data, warnings=warnings,
                                                partial=res.partial))
             if record is not None:
-                QUERY_LOG.finish_serving(record, transfer_s,
+                QUERY_LOG.finish_serving(record, 0.0,
                                          time.perf_counter() - t_r,
-                                         body_bytes=nbytes, code=200)
+                                         body_bytes=nbytes, code=200,
+                                         render_format=render_format)
             return
         stats = {
             "seriesScanned": res.stats.series_scanned,
@@ -513,33 +586,87 @@ class PromApiHandler(BaseHTTPRequestHandler):
             "cacheMisses": res.stats.cache_misses,
             "cacheExtends": res.stats.cache_extends,
         }
+        if served_standing:
+            stats["servedFrom"] = "standing"
+        # peer edge: a FiloDB peer advertises Arrow via Accept and gets the
+        # grids as columnar IPC frames — floats cross bit-exact, no decimal
+        # render here and no parse there. Browsers/old peers never send the
+        # media type and fall through to JSON: the user edge renders decimal
+        # JSON exactly once, at the outermost hop.
+        if self._peer_accepts_arrow():
+            from . import arrow_edge as AE
+
+            t_tr = time.perf_counter()
+            for g in res.grids:
+                g.values = np.asarray(g.values)
+                if g.hist is not None:
+                    g.hist = np.asarray(g.hist)
+            transfer_s = time.perf_counter() - t_tr
+            t_r = time.perf_counter()
+            body = AE.result_to_ipc(res, trace=trace)
+            nbytes = self._send_body(200, body,
+                                     content_type=AE.ARROW_CONTENT_TYPE)
+            render_s = time.perf_counter() - t_r
+            self._observe_render("arrow", render_s, nbytes)
+            if record is not None:
+                QUERY_LOG.finish_serving(record, transfer_s, render_s,
+                                         body_bytes=nbytes, code=200,
+                                         render_format="arrow")
+            return
         # large results stream chunked: memory stays bounded instead of
         # holding matrix + full JSON string (reference executeStreaming,
-        # ExecPlan.scala:146); small ones keep the gzip-capable dict path
+        # ExecPlan.scala:146); small ones keep the gzip-capable buffered
+        # path — built from the SAME stream_matrix fragments, so streamed
+        # and buffered bodies are byte-identical
         n_samples = sum(g.n_series * g.num_steps for g in res.grids)
         if res.raw is not None:
             n_samples += sum(len(t) for _, t, _ in res.raw)
         if n_samples >= self.STREAM_MIN_SAMPLES:
+            # streaming path: grid values stay on device; stream_matrix
+            # pulls them in STREAM_BLOCK_ROWS-series blocks through a
+            # double-buffered prefetch thread, so the first body bytes
+            # leave before the full D2H completes and transfer overlaps
+            # encode. render phase = send wall minus the encoder's waits
+            # on unfetched blocks (those waits ARE the transfer phase
+            # leaking through the overlap — counted as stream stalls).
+            phases: dict = {}
             t_r = time.perf_counter()
             nbytes = self._send_chunked(
-                200, J.stream_matrix(res, stats, warnings=warnings, trace=trace)
+                200, J.stream_matrix(res, stats, warnings=warnings,
+                                     trace=trace, partial=res.partial,
+                                     block_rows=self.STREAM_BLOCK_ROWS or None,
+                                     phases=phases)
             )
+            total_s = time.perf_counter() - t_r
+            transfer_s = phases.get("transfer", 0.0)
+            render_s = max(total_s - phases.get("stall_s", 0.0), 0.0)
+            self._observe_render(render_format, render_s, nbytes,
+                                 stalls=phases.get("stalls", 0))
             if record is not None:
-                QUERY_LOG.finish_serving(record, transfer_s,
-                                         time.perf_counter() - t_r,
-                                         body_bytes=nbytes, code=200)
+                QUERY_LOG.finish_serving(record, transfer_s, render_s,
+                                         body_bytes=nbytes, code=200,
+                                         render_format=render_format)
             return
+        # buffered path: pull every result grid to host HERE, timed,
+        # instead of implicitly inside the JSON encoder — the transfer vs
+        # render decomposition the result-plane phase plane needs. Not an
+        # added sync: rendering forced the same conversion one call later.
+        t_tr = time.perf_counter()
+        for g in res.grids:
+            g.values = np.asarray(g.values)
+            if g.hist is not None:
+                g.hist = np.asarray(g.hist)
+        transfer_s = time.perf_counter() - t_tr
         t_r = time.perf_counter()
-        data = J.render_matrix(res)
-        data["stats"] = stats
-        if trace is not None:
-            data["trace"] = trace
-        nbytes = self._send(200, J.success(data, warnings=warnings,
-                                           partial=res.partial))
+        body = b"".join(J.stream_matrix(res, stats, warnings=warnings,
+                                        trace=trace, partial=res.partial))
+        nbytes = self._send_body(200, body)
+        render_s = time.perf_counter() - t_r
+        self._observe_render(render_format, render_s, nbytes)
         if record is not None:
-            QUERY_LOG.finish_serving(record, transfer_s,
-                                     time.perf_counter() - t_r,
-                                     body_bytes=nbytes, code=200)
+            QUERY_LOG.finish_serving(record, transfer_s, render_s,
+                                     body_bytes=nbytes, code=200,
+                                     render_format=render_format)
         return
 
     def _query(self):
@@ -1226,20 +1353,24 @@ def make_server(engine: QueryEngine, host: str = "127.0.0.1", port: int = 9090,
                 dataset_engines: dict | None = None,
                 standing=None, standing_system=None,
                 rollups=None, alerting=None,
-                cluster=None) -> ThreadingHTTPServer:
+                cluster=None, result_plane: dict | None = None) -> ThreadingHTTPServer:
     # membership hooks (members_hook/join_hook) are wired as class attrs on
     # the returned server's RequestHandlerClass AFTER start — the registry
     # needs the bound port for its self URL (server.py seed bootstrap)
     register_shard_stats_collector(engine)
-    handler = type(
-        "BoundHandler", (PromApiHandler,),
-        {"engine": engine, "auth_token": auth_token, "local_engine": local_engine,
-         "dataset_engines": dict(dataset_engines or {}),
-         "standing": standing, "standing_system": standing_system,
-         "rollups": rollups, "alerting": alerting,
-         "cluster_hook": staticmethod(cluster) if cluster else None,
-         "flush_hook": staticmethod(flush_hook) if flush_hook else None},
-    )
+    attrs = {"engine": engine, "auth_token": auth_token, "local_engine": local_engine,
+             "dataset_engines": dict(dataset_engines or {}),
+             "standing": standing, "standing_system": standing_system,
+             "rollups": rollups, "alerting": alerting,
+             "cluster_hook": staticmethod(cluster) if cluster else None,
+             "flush_hook": staticmethod(flush_hook) if flush_hook else None}
+    if result_plane:  # config [result_plane] -> serving-edge knobs
+        attrs["STREAM_MIN_SAMPLES"] = int(
+            result_plane.get("stream_min_samples", PromApiHandler.STREAM_MIN_SAMPLES))
+        attrs["STREAM_BLOCK_ROWS"] = int(
+            result_plane.get("stream_block_rows", PromApiHandler.STREAM_BLOCK_ROWS))
+        attrs["ARROW_EDGE"] = result_plane.get("peer_exchange", "arrow") == "arrow"
+    handler = type("BoundHandler", (PromApiHandler,), attrs)
     return ThreadingHTTPServer((host, port), handler)
 
 
@@ -1248,11 +1379,11 @@ def serve_background(engine: QueryEngine, host: str = "127.0.0.1", port: int = 0
                      local_engine: QueryEngine | None = None,
                      flush_hook=None, dataset_engines: dict | None = None,
                      standing=None, standing_system=None, rollups=None,
-                     alerting=None, cluster=None):
+                     alerting=None, cluster=None, result_plane: dict | None = None):
     """Start the API server on a thread; returns (server, actual_port)."""
     srv = make_server(engine, host, port, auth_token, local_engine, flush_hook,
                       dataset_engines, standing, standing_system, rollups,
-                      alerting, cluster)
+                      alerting, cluster, result_plane)
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
     return srv, srv.server_address[1]

@@ -37,7 +37,8 @@ def _public_labels(lbls: Mapping[str, str]) -> dict:
 class FiloClient:
     def __init__(self, endpoint: str, token: str | None = None, timeout: float = 60,
                  grpc_endpoint: str | None = None,
-                 failover_endpoints: Sequence[str] = ()):
+                 failover_endpoints: Sequence[str] = (),
+                 columnar: bool = True):
         self.endpoint = endpoint.rstrip("/")
         self.token = token
         self.timeout = timeout
@@ -46,23 +47,35 @@ class FiloClient:
         # endpoint fails at the transport level, reads retry against each
         # in turn — the client-side half of replica failover
         self.failover_endpoints = tuple(e.rstrip("/") for e in failover_endpoints)
+        # columnar=True negotiates Arrow IPC result frames on query_range
+        # (bit-exact floats, no O(series x steps) JSON parse); servers or
+        # installs without the columnar edge transparently answer JSON
+        self.columnar = columnar
 
     # -- queries (reference QueryOps) --------------------------------------
 
-    def _get(self, path: str, **params):
+    def _url(self, path: str, **params) -> str:
         qs = urllib.parse.urlencode(
             [(k, v) for k, vs in params.items() for v in (vs if isinstance(vs, (list, tuple)) else [vs]) if v is not None],
         )
-        suffix = f"{path}" + (f"?{qs}" if qs else "")
+        return f"{path}" + (f"?{qs}" if qs else "")
+
+    def _failover(self, fetch):
+        """Run ``fetch(base)`` against the primary then each failover
+        sibling, moving on only for transport-level failures."""
         last = None
         for base in (self.endpoint, *self.failover_endpoints):
             try:
-                return fetch_json(f"{base}{suffix}", auth_token=self.token,
-                                  timeout=self.timeout)
+                return fetch(base)
             except (RemoteFetchError, ConnectionError, TimeoutError, OSError) as e:
                 last = e
                 continue
         raise last
+
+    def _get(self, path: str, **params):
+        suffix = self._url(path, **params)
+        return self._failover(lambda base: fetch_json(
+            f"{base}{suffix}", auth_token=self.token, timeout=self.timeout))
 
     def query_range(self, promql: str, start_s: float, end_s: float, step_s: float):
         """-> (times_s[np.ndarray], [{"metric": labels, "values": np.ndarray}]).
@@ -74,31 +87,26 @@ class FiloClient:
         times = start_s + np.arange(n) * (step_ms / 1000.0)
         if self.grpc_endpoint:
             res = self._grpc_exec(promql, start_s, end_s, step_ms)
-            series = []
-            if res.scalar is not None:  # scalar expression, e.g. 1+1
-                row = np.full(n, np.nan)
-                sv = np.asarray(res.scalar.values)[:n]
-                row[: len(sv)] = sv
-                series.append({"metric": {}, "values": row})
-            req_start_ms = round(start_s * 1000)
-            for g in res.grids:
-                vals = g.values_np()
-                # align onto the client grid like the HTTP branch: a grid may
-                # start offset from the request or carry fewer steps
-                # (offset/lookback edges) — place by timestamp, NaN-pad gaps
-                gt = g.step_times_ms()
-                idx = (gt - req_start_ms) // step_ms
-                ok = ((gt - req_start_ms) % step_ms == 0) & (idx >= 0) & (idx < n)
-                src = np.nonzero(ok)[0]
-                dst = idx[ok]
-                for i, lbls in enumerate(g.labels):
-                    row = np.full(n, np.nan)
-                    row[dst] = vals[i, src].astype(np.float64)
-                    series.append({"metric": _public_labels(lbls), "values": row})
-            return times, series
-        data = self._get(
-            "/api/v1/query_range", query=promql, start=start_s, end=end_s, step=step_s
-        )
+            return times, self._result_series(res, n, round(start_s * 1000), step_ms)
+        data = None
+        if self.columnar:
+            # columnar-by-default hop: Arrow IPC result frames when the
+            # server speaks them, the JSON envelope otherwise (older server
+            # or arrow-less install — same negotiation as peer scatter legs)
+            from .coordinator.planners import fetch_result
+
+            suffix = self._url("/api/v1/query_range", query=promql,
+                               start=start_s, end=end_s, step=step_s)
+            fetched = self._failover(lambda base: fetch_result(
+                f"{base}{suffix}", auth_token=self.token, timeout=self.timeout))
+            if not isinstance(fetched, dict):
+                return times, self._result_series(fetched, n,
+                                                  round(start_s * 1000), step_ms)
+            data = fetched["data"]
+        if data is None:
+            data = self._get(
+                "/api/v1/query_range", query=promql, start=start_s, end=end_s, step=step_s
+            )
         t2i = {round(float(t) * 1000): i for i, t in enumerate(times)}
         series = []
         for s in data.get("result", []):
@@ -109,6 +117,30 @@ class FiloClient:
                     row[i] = float(v)
             series.append({"metric": s.get("metric", {}), "values": row})
         return times, series
+
+    @staticmethod
+    def _result_series(res, n: int, req_start_ms: int, step_ms: int) -> list:
+        """Align a columnar QueryResult (gRPC or Arrow-HTTP leg) onto the
+        client grid: a grid may start offset from the request or carry fewer
+        steps (offset/lookback edges) — place by timestamp, NaN-pad gaps."""
+        series = []
+        if res.scalar is not None:  # scalar expression, e.g. 1+1
+            row = np.full(n, np.nan)
+            sv = np.asarray(res.scalar.values)[:n]
+            row[: len(sv)] = sv
+            series.append({"metric": {}, "values": row})
+        for g in res.grids:
+            vals = g.values_np()
+            gt = g.step_times_ms()
+            idx = (gt - req_start_ms) // step_ms
+            ok = ((gt - req_start_ms) % step_ms == 0) & (idx >= 0) & (idx < n)
+            src = np.nonzero(ok)[0]
+            dst = idx[ok]
+            for i, lbls in enumerate(g.labels):
+                row = np.full(n, np.nan)
+                row[dst] = vals[i, src].astype(np.float64)
+                series.append({"metric": _public_labels(lbls), "values": row})
+        return series
 
     def _grpc_exec(self, promql, start_s, end_s, step_ms, instant=False):
         from .api.grpc_exec import exec_promql

@@ -139,8 +139,13 @@ DEFAULTS: dict = {
     # per standing query; key_ring_max bounds the scheduler's retained
     # per-key recurrence ring; align_ms quantizes staging ranges so every
     # refresh rides ONE extendable superblock cache entry.
+    # "serve_range": ordinary /api/v1/query_range requests that match a
+    # registered standing query's promql+step serve straight from its
+    # retained [G, J] partials (querylog path standing:serve) instead of
+    # re-executing.
     "standing": {
         "enabled": True,
+        "serve_range": True,
         "promote_min_count": 8,
         "promote_window_s": 120.0,
         "promote_live_lag_ms": 120_000,
@@ -153,6 +158,18 @@ DEFAULTS: dict = {
         "default_span_ms": 1_800_000,
         "align_ms": 300_000,
         "tick_s": 0.5,
+    },
+    # result plane (doc/perf.md "Result plane"): how query results leave
+    # the node. stream_min_samples: above this, query_range bodies stream
+    # chunked with D2H/encode overlap; stream_block_rows: series rows per
+    # device->host block on that path (0 pulls whole grids upfront);
+    # peer_exchange: "arrow" serves/requests columnar Arrow IPC frames on
+    # node-to-node hops (JSON renders exactly once, at the user edge),
+    # "json" forces decimal JSON on every hop (debug / rolling downgrade).
+    "result_plane": {
+        "stream_min_samples": 200_000,
+        "stream_block_rows": 512,
+        "peer_exchange": "arrow",
     },
     # kernel & compile observatory (obs/kernels.py, doc/observability.md
     # "Kernel & compile observatory"): every jitted kernel dispatch is

@@ -55,18 +55,14 @@ class RemoteFetchError(QueryError):
     endpoint_failure = True
 
 
-def fetch_json(url: str, auth_token: str | None = None, local_only: bool = False,
-               timeout: float = 60, data: dict | None = None,
-               want_envelope: bool = False,
-               extra_headers: dict | None = None) -> dict | list:
-    """THE remote-HTTP fetch used by every cross-host path (query scatter,
-    federation, metadata, membership): gzip transport, bearer auth,
-    X-FiloDB-Local pinning, bounded retries with backoff on transient
-    failures (5xx / connection errors / timeouts; 4xx fails fast). ``data``
-    switches to a JSON POST. Returns the parsed ``data`` payload of a
-    successful Prometheus-shaped response (``want_envelope=True`` returns
-    the whole envelope — the partial-results scatter reads top-level
-    ``warnings``/``partial``).
+def fetch_raw(url: str, auth_token: str | None = None, local_only: bool = False,
+              timeout: float = 60, data: dict | None = None,
+              extra_headers: dict | None = None) -> tuple:
+    """Transport layer under :func:`fetch_json` / :func:`fetch_result`:
+    gzip transport, bearer auth, X-FiloDB-Local pinning, bounded retries
+    with backoff on transient failures (5xx / connection errors / timeouts;
+    4xx fails fast). ``data`` switches to a JSON POST. Returns
+    ``(body_bytes, response_headers)`` with gzip already undone.
 
     ``timeout`` is a TOTAL budget: per-attempt socket timeouts shrink to the
     remaining budget and retries/backoffs never run past it, so a hung peer
@@ -99,10 +95,7 @@ def fetch_json(url: str, auth_token: str | None = None, local_only: bool = False
                 raw = r.read()
                 if r.headers.get("Content-Encoding") == "gzip":
                     raw = gzip.decompress(raw)
-                payload = json.loads(raw)
-            if payload.get("status") != "success":
-                raise QueryError(f"remote request failed: {payload}")
-            return payload if want_envelope else payload["data"]
+                return raw, r.headers
         except urllib.error.HTTPError as e:
             if e.code == 429:
                 # the peer's admission control shed this scatter leg: honor
@@ -131,6 +124,56 @@ def fetch_json(url: str, auth_token: str | None = None, local_only: bool = False
                 break  # budget exhausted: surface the last error now
             _time.sleep(backoff)
     raise RemoteFetchError(f"remote request failed after retries: {last_err}")
+
+
+def fetch_json(url: str, auth_token: str | None = None, local_only: bool = False,
+               timeout: float = 60, data: dict | None = None,
+               want_envelope: bool = False,
+               extra_headers: dict | None = None) -> dict | list:
+    """THE remote-HTTP fetch used by every cross-host path (query scatter,
+    federation, metadata, membership) — :func:`fetch_raw` plus the
+    Prometheus-envelope decode. Returns the parsed ``data`` payload of a
+    successful response (``want_envelope=True`` returns the whole envelope —
+    the partial-results scatter reads top-level ``warnings``/``partial``)."""
+    raw, _hdrs = fetch_raw(url, auth_token=auth_token, local_only=local_only,
+                           timeout=timeout, data=data, extra_headers=extra_headers)
+    payload = json.loads(raw)
+    if payload.get("status") != "success":
+        raise QueryError(f"remote request failed: {payload}")
+    return payload if want_envelope else payload["data"]
+
+
+# node-to-node result hops default to columnar Arrow frames; "json" forces
+# the legacy decimal-JSON legs everywhere (config [result_plane] wires this)
+PEER_EXCHANGE = "arrow"
+
+
+def fetch_result(url: str, auth_token: str | None = None, local_only: bool = False,
+                 timeout: float = 60, extra_headers: dict | None = None):
+    """Columnar-first result fetch for node-to-node hops: advertises the
+    Arrow media type via Accept and decodes the peer's IPC frames (floats
+    cross bit-exact, no decimal render/parse). A peer that answers JSON —
+    older build, arrow-less install, or non-matrix result — falls back to
+    the envelope path: returns a ``QueryResult`` when the peer spoke Arrow,
+    else the parsed JSON envelope dict."""
+    AE = None
+    if PEER_EXCHANGE == "arrow":
+        try:
+            from ..api import arrow_edge as AE  # noqa: N813 (pyarrow gate)
+        except Exception:
+            AE = None
+    headers = dict(extra_headers or {})
+    if AE is not None:
+        headers["Accept"] = AE.ARROW_CONTENT_TYPE + ", application/json"
+    raw, hdrs = fetch_raw(url, auth_token=auth_token, local_only=local_only,
+                          timeout=timeout, extra_headers=headers)
+    ctype = (hdrs.get("Content-Type") or "").split(";")[0].strip()
+    if AE is not None and ctype == AE.ARROW_CONTENT_TYPE:
+        return AE.ipc_to_result(raw)
+    payload = json.loads(raw)
+    if payload.get("status") != "success":
+        raise QueryError(f"remote request failed: {payload}")
+    return payload
 
 
 class PromQlRemoteExec(ExecPlan):
@@ -181,11 +224,17 @@ class PromQlRemoteExec(ExecPlan):
                 TraceContext.TRACE_ID_HEADER: sp.trace_id,
                 TraceContext.PARENT_SPAN_HEADER: sp.span_id,
             }
-        envelope = fetch_json(
+        fetched = fetch_result(
             url, auth_token=self.auth_token, local_only=self.local_only,
-            timeout=max(ctx.remaining_deadline_s(), 0.1), want_envelope=True,
+            timeout=max(ctx.remaining_deadline_s(), 0.1),
             extra_headers=headers,
         )
+        if isinstance(fetched, QueryResult):
+            # columnar leg: the Arrow envelope already carried grids
+            # (bit-exact float payloads), warnings/partial, the peer's span
+            # tree and its QueryStats — no O(series x steps) JSON re-parse
+            return fetched
+        envelope = fetched
         data = envelope["data"]
         result = data["result"]
         num_steps = int((self.end_ms - self.start_ms) // self.step_ms) + 1

@@ -156,10 +156,13 @@ HELP_TEXTS: dict[str, str] = {
     "filodb_tpu_probes_ok": "tpu-watch probes that found a healthy device.",
     "filodb_tpu_bench_attested": "tpu-watch attested benchmark measurements.",
     "filodb_query_phase_seconds": "Per-phase query latency decomposition (parse_plan|admission|stage|dispatch|transfer|render|other).",
-    "filodb_query_path": "Queries by execution path (fused|fallback|tree|standing:delta|standing:full) per dataset.",
+    "filodb_query_path": "Queries by execution path (fused|fallback|tree|standing:delta|standing:full|standing:serve) per dataset.",
     "filodb_tenant_phase_seconds": "Per-phase query wall seconds attributed to the tenant (ws/ns).",
     "filodb_tenant_query_latency_seconds": "End-to-end query latency per tenant (the latency-SLO feed).",
-    "filodb_http_responses": "HTTP API responses by status code and class (2xx|4xx|shed|5xx).",
+    "filodb_http_responses": "HTTP API responses by status code and class (2xx|4xx|shed|5xx|stream_abort).",
+    "filodb_render_seconds": "Result-body encode seconds per format (json-native|json-numpy JSON tiers, arrow peer frames).",
+    "filodb_response_bytes": "Uncompressed result-body bytes sent per format (json|arrow).",
+    "filodb_render_stream_stalls": "Streamed-render encoder waits on a device->host block (D2H the double-buffer failed to hide).",
     "filodb_querylog_entries": "Query-log ring depth (exemplar-level cost records retained).",
     "filodb_index_lookup_seconds": "Part-key index lookup latency by matcher cost class (eq|in|prefix|regex|neg).",
     "filodb_xla_compiles": "XLA compile events per kernel family (a dispatch that grew the jit cache).",

@@ -278,6 +278,18 @@ def render_lib():
             fn.restype = ctypes.c_long
             fn.argtypes = [ctypes.POINTER(ctypes.c_double), vt,
                            ctypes.c_long, ctypes.c_void_p, ctypes.c_long]
+        for name, vt in (("fdb_render_matrix_f64", ctypes.POINTER(ctypes.c_double)),
+                         ("fdb_render_matrix_f32", ctypes.POINTER(ctypes.c_float))):
+            fn = getattr(L, name)
+            fn.restype = ctypes.c_longlong
+            fn.argtypes = [ctypes.POINTER(ctypes.c_double), vt,
+                           ctypes.c_longlong, ctypes.c_longlong,
+                           ctypes.c_void_p, ctypes.c_longlong,
+                           ctypes.POINTER(ctypes.c_longlong)]
+        L.fdb_format_double.restype = ctypes.c_int
+        L.fdb_format_double.argtypes = [ctypes.c_double, ctypes.c_char_p]
+        L.fdb_fmt_slow_count.restype = ctypes.c_long
+        L.fdb_fmt_slow_count.argtypes = []
         _render_lib = L
         return _render_lib
 
@@ -314,3 +326,51 @@ def render_values(ts_s: np.ndarray, vals: np.ndarray):
     if nw < 0:
         return None
     return out[:nw].tobytes()
+
+
+def render_matrix_rows(ts_s: np.ndarray, vals: np.ndarray):
+    """Render a [G,J] matrix as G per-series [[t,"v"],...] fragments in ONE
+    native call (per-row ctypes dispatch costs ~2us, which dominates small
+    rows); returns a list of G bytes objects, or None when the lib is
+    unavailable."""
+    L = render_lib()
+    if L is None or vals.ndim != 2:
+        return None
+    ts = np.ascontiguousarray(ts_s, dtype=np.float64)
+    G, J = vals.shape
+    if len(ts) != J:
+        return None
+    cap = 64 * G * J + 4 * G + 16
+    out = getattr(_render_scratch, "buf", None)
+    if out is None or len(out) < cap:
+        out = np.empty(max(cap, 1 << 20), dtype=np.uint8)
+        _render_scratch.buf = out
+    offs = np.empty(G + 1, dtype=np.int64)
+    offs_p = offs.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong))
+    if vals.dtype == np.float32:
+        v = np.ascontiguousarray(vals, dtype=np.float32)
+        nw = L.fdb_render_matrix_f32(
+            ts.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            v.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            G, J, out.ctypes.data, cap, offs_p)
+    else:
+        v = np.ascontiguousarray(vals, dtype=np.float64)
+        nw = L.fdb_render_matrix_f64(
+            ts.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            v.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            G, J, out.ctypes.data, cap, offs_p)
+    if nw < 0:
+        return None
+    raw = out[:nw].tobytes()
+    return [raw[offs[g]:offs[g + 1]] for g in range(G)]
+
+
+def format_double(v: float) -> str | None:
+    """repr(float(v)) via the native formatter; None when unavailable.
+    Exposed for the byte-parity torture test."""
+    L = render_lib()
+    if L is None:
+        return None
+    buf = ctypes.create_string_buffer(40)
+    n = L.fdb_format_double(float(v), buf)
+    return buf.raw[:n].decode()

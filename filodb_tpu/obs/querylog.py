@@ -285,7 +285,8 @@ class QueryLogRing:
 
     def finish_serving(self, entry: dict, transfer_s: float, render_s: float,
                        body_bytes: int | None = None,
-                       code: int | None = None) -> None:
+                       code: int | None = None,
+                       render_format: str | None = None) -> None:
         """Edge-side completion: fold the serving phases (device→host
         transfer, encode+write) into the record and the aggregate planes.
         Histograms/tenant counters observe for EVERY caller (each
@@ -308,6 +309,11 @@ class QueryLogRing:
                     entry.setdefault("result", {})["bytes"] = int(body_bytes)
                 if code is not None:
                     entry["code"] = int(code)
+                if render_format is not None:
+                    # which encoder tier served the body (native/numpy JSON
+                    # fragments, arrow peer frames) — joins the record to
+                    # filodb_render_seconds{format}
+                    entry["render_format"] = render_format
 
 
 QUERY_LOG = QueryLogRing()

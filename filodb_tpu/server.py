@@ -213,6 +213,15 @@ class FiloServer:
         batch_window_ms = float(qcfg.get("batch_window_ms", 0) or 0)
         scfg = {**DEFAULTS["standing"], **(cfg.get("standing") or {})}
         self.standing_config = scfg
+        # result plane (doc/perf.md): serving-edge streaming knobs + the
+        # node-to-node exchange format. peer_exchange=json pins BOTH sides
+        # of this node to decimal JSON (serving edge stops honoring Arrow
+        # Accept; outgoing scatter legs stop advertising it).
+        rpcfg = {**DEFAULTS["result_plane"], **(cfg.get("result_plane") or {})}
+        self.result_plane_config = rpcfg
+        from .coordinator import planners as _planners
+
+        _planners.PEER_EXCHANGE = str(rpcfg.get("peer_exchange", "arrow"))
         # standing-query promotion rides the scheduler's per-key recurrence
         # ring, so an enabled standing engine needs the scheduler object
         # even when batching is off (window 0 = ring only, no batching)
@@ -462,6 +471,7 @@ class FiloServer:
             rollups=self.rollups,
             alerting=self.alerting,
             cluster=self._cluster_snapshot,
+            result_plane=self.result_plane_config,
         )
         if self.standing is not None:
             self.standing.start()

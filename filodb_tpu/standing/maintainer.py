@@ -82,6 +82,9 @@ DEFAULTS = {
     "default_span_ms": 1_800_000,
     "align_ms": 300_000,
     "tick_s": 0.5,
+    # serve ordinary query_range calls matching a registered standing
+    # query straight from its retained matrix (path=standing:serve)
+    "serve_range": True,
 }
 
 
@@ -657,6 +660,65 @@ class StandingEngine:
     def current_payload(self, qid: str) -> bytes | None:
         sq = self.registry.get(qid)
         return sq.last_payload if sq is not None else None
+
+    # -- edge serving (ordinary query_range from retained state) -----------
+
+    def serve_range(self, promql: str, start_s: float, end_s: float,
+                    step_s: float):
+        """Answer an ordinary ``query_range`` from a registered standing
+        query's retained matrix — the ROADMAP leftover: only SSE
+        subscribers rode standing state before. Returns a QueryResult
+        (querylog record attached under path ``standing:serve``) when a
+        delta-maintained query matches promql + step and its retained grid
+        covers the requested range phase-aligned; None otherwise (the
+        caller falls through to the engine). A grid that has fallen behind
+        the requested end refreshes first — the delta path makes that a
+        suffix-only (often zero-dispatch) catch-up."""
+        if not self.cfg.get("serve_range", True):
+            return None
+        t0 = time.perf_counter()
+        step_ms = max(int(round(step_s * 1000)), 1)
+        start_ms = int(round(start_s * 1000))
+        end_ms = int(round(end_s * 1000))
+        if start_ms % step_ms or (end_ms - start_ms) % step_ms:
+            return None  # phase-misaligned with the standing grid
+        sq = None
+        for cand in self.registry.list():
+            if (cand.promql == promql and cand.step_ms == step_ms
+                    and cand.mode == "delta"):
+                sq = cand
+                break
+        if sq is None:
+            return None
+        if sq.retained is None or end_ms > sq.grid_end_ms:
+            self.refresh(sq)  # catch the grid up to now before slicing
+        from ..query.rangevector import Grid, QueryResult
+
+        with sq.lock:
+            if (sq.removed or sq.retained is None or sq.labels is None
+                    or start_ms < sq.grid_start_ms
+                    or end_ms > sq.grid_end_ms
+                    or (start_ms - sq.grid_start_ms) % step_ms):
+                return None
+            j0 = (start_ms - sq.grid_start_ms) // step_ms
+            j1 = (end_ms - sq.grid_start_ms) // step_ms
+            vals = np.array(sq.retained[:, j0:j1 + 1], copy=True)
+            labels = [dict(lbl) for lbl in sq.labels]
+        J = j1 - j0 + 1
+        res = QueryResult(grids=[Grid(labels, start_ms, step_ms, J, vals)])
+        sq.stats["serves"] = sq.stats.get("serves", 0) + 1
+        from ..obs.querylog import QUERY_LOG, PhaseRecorder
+
+        res.query_log = QUERY_LOG.publish(
+            query_id=_new_qid(), dataset=sq.dataset, promql=promql,
+            ws=sq.ws, ns=sq.ns, step_ms=step_ms,
+            span_ms=end_ms - start_ms, start_s=start_ms / 1000.0,
+            end_s=end_ms / 1000.0, phases=PhaseRecorder(),
+            elapsed_s=time.perf_counter() - t0,
+            path_info={"path": "standing:serve"},
+            result_series=len(labels), result_samples=int(vals.size),
+        )
+        return res
 
     # -- promotion / demotion ----------------------------------------------
 

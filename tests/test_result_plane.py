@@ -1,0 +1,487 @@
+"""Result-plane tests (ISSUE 19): renderer byte-equality goldens across the
+native / numpy / pure-python encode tiers, streamed-vs-buffered body
+identity, the chunked mid-stream abort marker, the Arrow columnar peer
+exchange (bit-equal round-trip + version-negotiation fallback to JSON),
+and standing-query serve_range on the ordinary query_range path."""
+
+import gzip
+import http.client
+import json
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from filodb_tpu import native as N
+from filodb_tpu.api import promjson as J
+from filodb_tpu.query.rangevector import Grid, QueryResult, QueryStats, ScalarResult
+
+BASE = 1_600_000_000_000
+
+# exponent edges, subnormals, ties, specials — every formatting regime the
+# repr grammar has: fixed with ".0", fixed fractional, scientific e±NN,
+# shortest-round-trip torture values, signed zeros, non-finites
+TORTURE = [
+    0.0, -0.0, 1.0, -1.0, 42.0, -273.15, 0.1, 0.2, 0.3, 1 / 3,
+    1e-5, 9.999e-5, 1e-4, 1.5e-5, 1e15, 1e16 - 2, 1e16, 1.1e16, 1e17,
+    5e-324, 2.5e-323, 2.2250738585072014e-308, 1.7976931348623157e308,
+    -1.7976931348623157e308, 9007199254740993.0, 2.0 ** 53, 2.0 ** 53 + 2,
+    0.5, 2.0 ** -10, 123456789.123456789, 1.000000000000001,
+    9.999999999999999e22, 123e-20, 7.038531e-26,
+    float("nan"), float("inf"), float("-inf"),
+]
+
+
+def _fmt_oracle(v: float) -> str:
+    if np.isnan(v):
+        return "NaN"
+    if np.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    return repr(float(v))
+
+
+def _fragment_oracle(ts_s: np.ndarray, row: np.ndarray) -> bytes:
+    """Pure-python fragment oracle: [[t,"v"],...] with NaN samples skipped
+    — the golden byte format every encode tier must reproduce exactly."""
+    parts = [
+        f'[{J._ts3(float(t))},"{_fmt_oracle(float(v))}"]'
+        for t, v in zip(ts_s, row) if not np.isnan(v)
+    ]
+    return ("[" + ",".join(parts) + "]").encode()
+
+
+def _torture_matrix(dtype):
+    rng = np.random.default_rng(3)
+    rows = [np.array(TORTURE, dtype=np.float64)]
+    rows.append(rng.standard_normal(len(TORTURE)) * 10.0 ** rng.integers(
+        -20, 20, len(TORTURE)))
+    rows.append(np.floor(rng.uniform(0, 1e9, len(TORTURE))))
+    rows.append(np.full(len(TORTURE), np.nan))  # all-NaN row -> "[]"
+    vals = np.stack(rows)
+    if dtype == np.float32:
+        with np.errstate(over="ignore"):  # huge doubles -> inf, intended
+            vals = vals.astype(np.float32)
+    return vals
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64])
+def test_render_rows_golden_all_tiers(dtype):
+    """Byte-equality goldens: whatever encode tier serves (native when
+    libfilodbrender is built, the vectorized numpy tier always), row
+    fragments are byte-identical to the pure-python _fmt oracle."""
+    vals = _torture_matrix(dtype)
+    # f32 values widen to double exactly as python float(v) does
+    wide = vals.astype(np.float64)
+    ts = (BASE + np.arange(vals.shape[1]) * 60_123) / 1000.0
+    expected = [_fragment_oracle(ts, wide[i]) for i in range(len(wide))]
+
+    got = J.render_rows(ts, vals)
+    assert [bytes(r) for r in got] == expected
+
+    # numpy tier explicitly (native disabled)
+    orig = N.render_matrix_rows
+    N.render_matrix_rows = lambda t, v: None
+    try:
+        got_np = J.render_rows(ts, vals)
+    finally:
+        N.render_matrix_rows = orig
+    assert [bytes(r) for r in got_np] == expected
+
+    # per-row serving fragment (raw-series path) agrees too
+    for i in range(len(wide)):
+        assert J._values_fragment(ts, vals[i]) == expected[i]
+
+
+def test_native_format_double_matches_repr():
+    lib = N.render_lib()
+    if lib is None:
+        pytest.skip("libfilodbrender not built")
+    rng = np.random.default_rng(11)
+    cases = list(TORTURE)
+    cases += list(rng.standard_normal(5000) * 10.0 ** rng.integers(-300, 300, 5000))
+    cases += list(rng.standard_normal(5000).astype(np.float32).astype(np.float64))
+    for v in cases:
+        v = float(v)
+        got = N.format_double(v)
+        if np.isnan(v):
+            assert got == "nan"
+        elif np.isinf(v):
+            assert got == ("inf" if v > 0 else "-inf")
+        else:
+            assert got == repr(v), f"{v!r}: native {got!r} != repr {repr(v)!r}"
+
+
+def test_histogram_matrix_golden():
+    """Histogram-kind grids: the le-expanded bucket rows render through the
+    same tiers, byte-identical to the oracle."""
+    rng = np.random.default_rng(5)
+    les = np.array([0.1, 1.0, np.inf])
+    hist = np.cumsum(rng.random((2, 4, 3)).astype(np.float32), axis=2)
+    hist[0, 1, :] = np.nan
+    g = Grid([{"_metric_": "lat", "i": "0"}, {"_metric_": "lat", "i": "1"}],
+             BASE, 60_000, 4,
+             np.zeros((2, 4), np.float32), hist=hist, les=les)
+    res = QueryResult(grids=[g])
+    body = b"".join(J.stream_matrix(res))
+    out = json.loads(body)
+    assert out["status"] == "success"
+    ts = (BASE + np.arange(4) * 60_000) / 1000.0
+    wide = hist.astype(np.float64)
+    for s in out["data"]["result"]:
+        le = s["metric"].get("le")
+        if le is None:
+            continue
+        i = int(s["metric"]["i"])
+        b = [0.1, 1.0, float("inf")].index(float(le))
+        frag = json.dumps(s["values"], separators=(",", ":")).encode()
+        assert frag == _fragment_oracle(ts, wide[i, :, b])
+
+
+# -- streamed vs buffered ----------------------------------------------------
+
+
+def _grid(n_series=8, num_steps=40, seed=0, dtype=np.float32):
+    rng = np.random.default_rng(seed)
+    vals = rng.standard_normal((n_series, num_steps)).astype(dtype)
+    vals[rng.random((n_series, num_steps)) < 0.1] = np.nan
+    return Grid([{"_metric_": "m", "i": str(i)} for i in range(n_series)],
+                BASE, 60_000, num_steps, vals)
+
+
+def test_streamed_body_byte_identical_to_buffered():
+    res = QueryResult(grids=[_grid(), _grid(seed=2, num_steps=17)],
+                      warnings=[{"w": "x"}], partial=True)
+    stats = {"seriesScanned": 16}
+    buffered = b"".join(J.stream_matrix(res, stats, warnings=res.warnings,
+                                        partial=True))
+    phases: dict = {}
+    streamed = b"".join(J.stream_matrix(res, stats, warnings=res.warnings,
+                                        partial=True, block_rows=3,
+                                        phases=phases))
+    assert streamed == buffered
+    assert phases["transfer"] >= 0.0
+
+
+def test_http_streamed_equals_buffered_payload(api_server):
+    srv, base, engine = api_server
+    q = urllib.parse.quote("heap_usage0")
+    url = (f"{base}/api/v1/query_range?query={q}"
+           f"&start={(BASE + 600_000) / 1000}&end={(BASE + 3_000_000) / 1000}&step=60")
+    handler = srv.RequestHandlerClass
+    old = handler.STREAM_MIN_SAMPLES
+    try:
+        handler.STREAM_MIN_SAMPLES = 10 ** 9  # force buffered
+        with urllib.request.urlopen(url) as r:
+            buffered = json.loads(r.read())
+            assert r.headers.get("Transfer-Encoding") != "chunked"
+        handler.STREAM_MIN_SAMPLES = 1  # force streaming
+        with urllib.request.urlopen(url) as r:
+            body = r.read()
+            if r.headers.get("Content-Encoding") == "gzip":
+                body = gzip.decompress(body)
+            assert r.headers.get("Transfer-Encoding") == "chunked"
+            streamed = json.loads(body)
+    finally:
+        handler.STREAM_MIN_SAMPLES = old
+    # stats carry per-execution timings; the payload must be identical
+    buffered["data"].pop("stats", None)
+    streamed["data"].pop("stats", None)
+    assert streamed == buffered
+
+
+def test_stream_abort_emits_error_marker(api_server):
+    srv, base, engine = api_server
+    from filodb_tpu.api import http as H
+    from filodb_tpu.metrics import REGISTRY
+
+    def count():
+        total = 0.0
+        with REGISTRY._lock:
+            for (name, lbls), m in REGISTRY._metrics.items():
+                if name == "filodb_http_responses" and dict(lbls).get(
+                        "class") == "stream_abort":
+                    total += m.value
+        return total
+
+    handler = srv.RequestHandlerClass
+    old_min = handler.STREAM_MIN_SAMPLES
+    orig = H.J.stream_matrix
+
+    def exploding(*a, **k):
+        gen = orig(*a, **k)
+        yield next(gen)
+        raise RuntimeError("device fell off mid-body")
+
+    before = count()
+    try:
+        handler.STREAM_MIN_SAMPLES = 1
+        H.J.stream_matrix = exploding
+        q = urllib.parse.quote("heap_usage0")
+        url = (f"{base}/api/v1/query_range?query={q}"
+               f"&start={(BASE + 600_000) / 1000}"
+               f"&end={(BASE + 3_000_000) / 1000}&step=60")
+        with urllib.request.urlopen(url) as r:
+            body = r.read()
+        if body[:2] == b"\x1f\x8b":
+            body = gzip.decompress(body)
+    finally:
+        H.J.stream_matrix = orig
+        handler.STREAM_MIN_SAMPLES = old_min
+    # the stream terminated CLEANLY (chunked terminator reached — read()
+    # returned) with a trailing structured error marker, not a cut socket
+    tail = body.rsplit(b"\n", 2)
+    marker = json.loads(tail[-2])
+    assert marker["status"] == "error"
+    assert marker["errorType"] == "stream_aborted"
+    assert "RuntimeError" in marker["error"]
+    assert count() == before + 1
+
+
+# -- Arrow columnar peer exchange -------------------------------------------
+
+
+def test_arrow_envelope_full_round_trip():
+    AE = pytest.importorskip("filodb_tpu.api.arrow_edge")
+    g64 = _grid(seed=4, dtype=np.float64)
+    les = np.array([0.5, np.inf])
+    hist = np.random.default_rng(9).random((3, 6, 2)).astype(np.float32)
+    gh = Grid([{"h": str(i)} for i in range(3)], BASE, 30_000, 6,
+              np.zeros((3, 6), np.float32), hist=hist, les=les, stale=True)
+    res = QueryResult(grids=[_grid(), g64, gh], warnings=[{"w": "lost"}],
+                      partial=True)
+    res.stats = QueryStats(series_scanned=7, kernel_ns=42, cache_hits=1)
+    res.scalar = ScalarResult(BASE, 1000, 2, np.array([1.25, np.nan]))
+    res.raw = [({"r": "a"}, np.array([1, 5], np.int64), np.array([2.5, np.nan])),
+               ({"r": "b"}, np.array([9], np.int64), np.array([[1.0, 2.0]]))]
+    res.trace = {"span": "root"}
+    back = AE.ipc_to_result(AE.result_to_ipc(res))
+    assert len(back.grids) == 3
+    for a, b in zip(res.grids, back.grids):
+        assert (a.labels, a.start_ms, a.step_ms, a.num_steps, a.stale) == (
+            b.labels, b.start_ms, b.step_ms, b.num_steps, b.stale)
+        va, vb = a.values_np(), b.values_np()
+        assert va.dtype == vb.dtype  # f64 grids stay f64 on the wire
+        assert va.tobytes() == vb.tobytes()  # bit-equal, not just close
+    assert np.asarray(back.grids[2].hist).tobytes() == hist.tobytes()
+    assert np.array_equal(np.asarray(back.grids[2].les), les)
+    assert back.warnings == res.warnings and back.partial
+    assert (back.stats.series_scanned, back.stats.kernel_ns,
+            back.stats.cache_hits) == (7, 42, 1)
+    assert back.trace == {"span": "root"}
+    assert back.scalar.values[0] == 1.25 and np.isnan(back.scalar.values[1])
+    assert len(back.raw) == 2
+    for (la, ta, va), (lb, tb, vb) in zip(res.raw, back.raw):
+        assert la == lb and np.array_equal(ta, tb)
+        assert np.asarray(va, np.float64).tobytes() == vb.tobytes()
+    # empty result round-trips
+    assert AE.ipc_to_result(AE.result_to_ipc(QueryResult())).grids == []
+
+
+def test_arrow_negotiation_and_json_fallback(api_server):
+    AE = pytest.importorskip("filodb_tpu.api.arrow_edge")
+    from filodb_tpu.coordinator import planners as P
+
+    srv, base, engine = api_server
+    q = urllib.parse.quote("heap_usage0")
+    url = (f"{base}/api/v1/query_range?query={q}"
+           f"&start={(BASE + 600_000) / 1000}&end={(BASE + 3_000_000) / 1000}&step=60")
+    # peer hop: columnar by default
+    out = P.fetch_result(url)
+    assert isinstance(out, QueryResult)
+    assert sum(g.n_series for g in out.grids) == 10
+    # bit-equality vs the JSON decimal leg: repr round-trips exactly
+    env = P.fetch_json(url, want_envelope=True)
+    by_lbl = {}
+    for g in out.grids:
+        vals, times = g.values_np(), g.step_times_ms()
+        t2i = {int(t): j for j, t in enumerate(times)}
+        for i, lb in enumerate(g.labels):
+            pub = {("__name__" if k == "_metric_" else k): v
+                   for k, v in lb.items()}
+            by_lbl[json.dumps(pub, sort_keys=True)] = (vals[i], t2i)
+    checked = 0
+    for s in env["data"]["result"]:
+        row, t2i = by_lbl[json.dumps(s["metric"], sort_keys=True)]
+        for t, v in s["values"]:
+            assert np.float32(float(v)) == row[t2i[round(float(t) * 1000)]]
+            checked += 1
+    assert checked > 50
+    # JSON stays the answer without the Accept header (user edge)
+    with urllib.request.urlopen(url) as r:
+        assert r.headers.get("Content-Type") == "application/json"
+    # old-peer negotiation: a server without the columnar edge answers
+    # JSON and fetch_result falls back to the envelope
+    handler = srv.RequestHandlerClass
+    try:
+        handler.ARROW_EDGE = False
+        out2 = P.fetch_result(url)
+    finally:
+        handler.ARROW_EDGE = True
+    assert isinstance(out2, dict) and out2["status"] == "success"
+    # peer_exchange=json config: this node stops advertising Arrow
+    old = P.PEER_EXCHANGE
+    try:
+        P.PEER_EXCHANGE = "json"
+        out3 = P.fetch_result(url)
+    finally:
+        P.PEER_EXCHANGE = old
+    assert isinstance(out3, dict)
+
+
+def test_remote_exec_leg_columnar_bit_equal(api_server):
+    pytest.importorskip("filodb_tpu.api.arrow_edge")
+    from filodb_tpu.coordinator import planners as P
+
+    srv, base, engine = api_server
+
+    class Ctx:
+        allow_partial_results = False
+
+        @staticmethod
+        def remaining_deadline_s():
+            return 30.0
+
+    start_ms, end_ms = BASE + 600_000, BASE + 3_000_000
+    plan = P.PromQlRemoteExec(base, "heap_usage0", start_ms, end_ms, 60_000)
+    arrow_res = plan.do_execute(Ctx())
+    old = P.PEER_EXCHANGE
+    try:
+        P.PEER_EXCHANGE = "json"
+        json_res = P.PromQlRemoteExec(base, "heap_usage0", start_ms, end_ms,
+                                      60_000).do_execute(Ctx())
+    finally:
+        P.PEER_EXCHANGE = old
+
+    def flat(res):
+        out = {}
+        for g in res.grids:
+            vals, times = g.values_np(), g.step_times_ms()
+            for i, lb in enumerate(g.labels):
+                row = {int(t): v for t, v in zip(times, vals[i])
+                       if not np.isnan(v)}
+                out[json.dumps(lb, sort_keys=True)] = row
+        return out
+
+    a, b = flat(arrow_res), flat(json_res)
+    assert a.keys() == b.keys() and len(a) == 10
+    for k in a:
+        assert a[k].keys() == b[k].keys()
+        for t in a[k]:
+            assert np.float32(a[k][t]) == np.float32(b[k][t])
+
+
+def test_client_columnar_matches_json(api_server):
+    pytest.importorskip("filodb_tpu.api.arrow_edge")
+    from filodb_tpu.client import FiloClient
+
+    srv, base, engine = api_server
+    start_s, end_s = (BASE + 600_000) / 1000, (BASE + 3_000_000) / 1000
+    t1, s1 = FiloClient(base).query_range("heap_usage0", start_s, end_s, 60)
+    t2, s2 = FiloClient(base, columnar=False).query_range(
+        "heap_usage0", start_s, end_s, 60)
+    assert np.array_equal(t1, t2) and len(s1) == len(s2) == 10
+    key = lambda s: json.dumps(s["metric"], sort_keys=True)  # noqa: E731
+    m1 = {key(s): s["values"] for s in s1}
+    m2 = {key(s): s["values"] for s in s2}
+    assert m1.keys() == m2.keys()
+    for k in m1:
+        a, b = m1[k], m2[k]
+        mask = ~np.isnan(a)
+        assert np.array_equal(mask, ~np.isnan(b))
+        assert np.array_equal(a[mask], b[mask])
+
+
+# -- standing serve ----------------------------------------------------------
+
+
+def test_standing_serves_ordinary_query_range(api_server_standing):
+    srv, base, engine, se, q, start_s, end_s, step_s = api_server_standing
+    from filodb_tpu.obs.querylog import QUERY_LOG
+
+    url = (f"{base}/api/v1/query_range?query={urllib.parse.quote(q)}"
+           f"&start={start_s}&end={end_s}&step={step_s:g}")
+    with urllib.request.urlopen(url) as r:
+        out = json.loads(r.read())
+    assert out["status"] == "success"
+    assert out["data"]["stats"]["servedFrom"] == "standing"
+    recs = [e for e in QUERY_LOG.entries(50)
+            if e.get("path") == "standing:serve"]
+    assert recs, "standing:serve never logged"
+    # the served matrix is bit-equal to what the standing engine retains
+    # (a fresh evaluation can differ by 1 ulp: incremental vs batch sums)
+    direct = se.serve_range(q, start_s, end_s, step_s)
+    fresh = engine.query_range(q, start_s, end_s, step_s)
+    assert np.allclose(direct.grids[0].values_np(),
+                       fresh.grids[0].values_np(), rtol=1e-5, equal_nan=True)
+    want = {}
+    for g in direct.grids:
+        vals, times = g.values_np(), g.step_times_ms()
+        for i, lb in enumerate(g.labels):
+            pub = {("__name__" if k == "_metric_" else k): v
+                   for k, v in lb.items()}
+            want[json.dumps(pub, sort_keys=True)] = {
+                int(t): np.float32(v) for t, v in zip(times, vals[i])
+                if not np.isnan(v)}
+    got = {}
+    for s in out["data"]["result"]:
+        got[json.dumps(s["metric"], sort_keys=True)] = {
+            round(float(t) * 1000): np.float32(float(v))
+            for t, v in s["values"]}
+    assert got == want
+
+
+# -- fixtures ----------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def api_server():
+    from filodb_tpu.api.http import serve_background
+    from filodb_tpu.coordinator.planner import QueryEngine
+    from filodb_tpu.core.schemas import Dataset
+    from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+    from filodb_tpu.testkit import machine_metrics
+
+    ms = TimeSeriesMemStore()
+    ms.setup(Dataset("prometheus"), range(4))
+    ms.ingest_routed("prometheus",
+                     machine_metrics(n_series=10, n_samples=360, start_ms=BASE),
+                     spread=2)
+    engine = QueryEngine(ms, "prometheus")
+    srv, port = serve_background(engine)
+    yield srv, f"http://127.0.0.1:{port}", engine
+    srv.shutdown()
+
+
+@pytest.fixture(scope="module")
+def api_server_standing():
+    from filodb_tpu.api.http import serve_background
+    from filodb_tpu.coordinator.planner import QueryEngine
+    from filodb_tpu.core.schemas import Dataset
+    from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+    from filodb_tpu.standing import StandingEngine
+    from filodb_tpu.testkit import counter_batch
+
+    ms = TimeSeriesMemStore()
+    ms.setup(Dataset("prometheus"), range(4))
+    n_samples = 360
+    ms.ingest_routed("prometheus",
+                     counter_batch(n_series=8, n_samples=n_samples, start_ms=BASE),
+                     spread=2)
+    engine = QueryEngine(ms, "prometheus")
+    edge_ms = BASE + n_samples * 10_000
+    se = StandingEngine(engine, {"default_span_ms": 3_600_000},
+                        clock=lambda: (edge_ms + 5_000) / 1e3)
+    q = "sum(rate(http_requests_total[5m]))"
+    step_ms = 60_000
+    sq = se.register(q, step_ms)
+    se.refresh(sq)
+    assert sq.retained is not None
+    # a phase-aligned sub-window of the retained grid
+    start_ms = sq.grid_start_ms + 5 * step_ms
+    end_ms = sq.grid_start_ms + 25 * step_ms
+    assert end_ms <= sq.grid_end_ms
+    srv, port = serve_background(engine, standing=se)
+    yield (srv, f"http://127.0.0.1:{port}", engine, se, q,
+           start_ms / 1000, end_ms / 1000, step_ms / 1000)
+    srv.shutdown()
