@@ -51,6 +51,7 @@ METRIC = {
     "long_range_quantile": "long_range_quantile_30d_p50",
     "failover_storm": "failover_storm_qps_2k",
     "render_2m": "render_2m_stream_msamples",
+    "mixed_cost_storm": "mixed_cost_storm_cheap_retained",
 }.get(WORKLOAD, "sum_rate_100k_series_range_query_p50")
 # concurrent_qps: client thread count, per-mode measurement window, and the
 # batching window handed to the batched engine (the knob under test)
@@ -968,6 +969,167 @@ def run_benchmark_concurrent_qps():
     }))
 
 
+def run_benchmark_mixed_cost_storm():
+    """Device-second admission under a mixed-cost tenant storm
+    (doc/operations.md "Admission control"): a cheap tenant (demo/App-1,
+    64 series, 5m sum(rate)) shares the node with a monster tenant
+    (demo/App-2, the full series set, 30m high-cardinality group-by).
+    The monster floods; its tight device-second quota must shed it with a
+    cost-derived Retry-After while the cheap tenant keeps its throughput.
+
+    value = cheap-tenant qps during the flood / cheap-tenant solo qps
+    (retained fraction, HIGHER is better — the smoke floor gates >= 0.8);
+    match = cheap tenant saw zero sheds/errors, the monster was admitted
+    at least once (it has SOME budget) and shed repeatedly, and every
+    shed carried a positive predicted cost and a drain-derived
+    Retry-After."""
+    import threading
+
+    ms, ts = build_memstore()
+    # the cheap tenant's 64 series ride in the same memstore under its own
+    # namespace — metering.tenant_of_plan resolves ws/ns from the selector
+    from filodb_tpu.core.records import SeriesBatch
+    from filodb_tpu.core.schemas import METRIC_TAG, PROM_COUNTER, shard_for
+    rng = np.random.default_rng(7)
+    for i in range(64):
+        tags = {
+            METRIC_TAG: "http_requests_total",
+            "_ws_": "demo",
+            "_ns_": "App-1",
+            "instance": f"cheap-host-{i}",
+            "zone": f"z{i % 8}",
+        }
+        shard = shard_for(tags, spread=3, num_shards=N_SHARDS)
+        vals = np.cumsum(rng.uniform(0, 10, size=N_SAMPLES)) + 1e9
+        ms.shard("prometheus", shard).ingest_series(
+            SeriesBatch(PROM_COUNTER, tags, ts, {"count": vals})
+        )
+    from filodb_tpu.coordinator.planner import PlannerParams, QueryEngine
+    from filodb_tpu.query.scheduler import (
+        AdmissionController, AdmissionRejected,
+    )
+
+    _enable_compile_cache()
+    cheap_q = ('sum(rate(http_requests_total'
+               '{_ws_="demo",_ns_="App-1"}[5m]))')
+    monster_q = ('sum by (instance) (rate(http_requests_total'
+                 '{_ws_="demo",_ns_="App-2"}[30m]))')
+    # cheap tenant: effectively unmetered; monster: ~one full-burst query
+    # per flood window, everything past that sheds on predicted cost
+    ctl = AdmissionController({
+        "demo/App-1": {"rate_device_s": 50.0, "burst_device_s": 50.0},
+        "demo/App-2": {"rate_device_s": 0.005, "burst_device_s": 0.05},
+    })
+    # warm engine (no admission): compiles both shapes and teaches the
+    # cost model each fingerprint's realized device-seconds WITHOUT
+    # draining the gated buckets, so the flood starts from a full burst
+    warm = QueryEngine(ms, "prometheus", PlannerParams())
+    gated = QueryEngine(ms, "prometheus", PlannerParams(admission=ctl))
+    for _ in range(3):
+        warm.query_range(cheap_q, START_S, END_S, STEP_S)
+    for _ in range(2):
+        warm.query_range(monster_q, START_S, END_S, STEP_S)
+
+    cheap_errors = [0]
+
+    def cheap_phase(duration_s):
+        n = [0]
+        stop_at = time.perf_counter() + duration_s
+
+        def client():
+            while time.perf_counter() < stop_at:
+                try:
+                    res = gated.query_range(cheap_q, START_S, END_S, STEP_S)
+                    for g in res.grids:
+                        np.asarray(g.values_np())
+                    n[0] += 1
+                except Exception:
+                    cheap_errors[0] += 1
+
+        t0 = time.perf_counter()
+        th = threading.Thread(target=client)
+        th.start()
+        th.join()
+        return n[0] / (time.perf_counter() - t0)
+
+    sheds: list[tuple[float, float, str]] = []
+    admits = [0]
+
+    def monster_client(stop_evt):
+        while not stop_evt.is_set():
+            try:
+                gated.query_range(monster_q, START_S, END_S, STEP_S)
+                admits[0] += 1
+            except AdmissionRejected as e:
+                sheds.append((
+                    float(getattr(e, "retry_after_s", 0.0)),
+                    float(getattr(e, "predicted_cost_s", 0.0)),
+                    str(getattr(e, "outcome", "")),
+                ))
+                time.sleep(0.02)  # the flood ignores Retry-After
+            except Exception:
+                admits[0] += 0  # engine errors count as neither
+
+    # interleaved solo/flood rounds: container qps drifts between phases,
+    # so a single before/after pair is noise-bound — medians over
+    # alternating rounds compare like with like (the fused_jitter idiom)
+    rounds = 3
+    dur = max(QPS_DURATION_S / rounds, 1.0)
+    solo_rounds, flood_rounds = [], []
+    for _ in range(rounds):
+        solo_rounds.append(cheap_phase(dur))
+        stop_evt = threading.Event()
+        monsters = [
+            threading.Thread(target=monster_client, args=(stop_evt,))
+            for _ in range(2)
+        ]
+        for t in monsters:
+            t.start()
+        flood_rounds.append(cheap_phase(dur))
+        stop_evt.set()
+        for t in monsters:
+            t.join()
+
+    solo_qps = float(np.median(solo_rounds))
+    flood_qps = float(np.median(flood_rounds))
+    retained = flood_qps / solo_qps if solo_qps > 0 else 0.0
+    cost_derived = bool(sheds) and all(
+        r > 0 and c > 0 and o == "shed_rate" for r, c, o in sheds
+    )
+    ok = (
+        cheap_errors[0] == 0 and admits[0] >= 1 and len(sheds) > 0
+        and cost_derived and retained > 0
+    )
+    import jax
+
+    backend = jax.devices()[0].platform
+    sys.stderr.write(
+        f"solo={solo_qps:.0f}qps flood={flood_qps:.0f}qps "
+        f"retained={retained:.2f} monster_admits={admits[0]} "
+        f"sheds={len(sheds)} cost_derived={cost_derived} "
+        f"cheap_errors={cheap_errors[0]}\n"
+    )
+    print(json.dumps({
+        "metric": METRIC,
+        "value": round(retained, 3),
+        "unit": "ratio",
+        "vs_baseline": round(retained, 3),
+        "backend": backend,
+        "series": N_SERIES,
+        "match": ok,
+        "phases_ms": {
+            "solo_qps": round(solo_qps, 1),
+            "flood_qps": round(flood_qps, 1),
+            "monster_admits": admits[0],
+            "monster_sheds": len(sheds),
+            "shed_retry_after_max_s": round(
+                max((r for r, _, _ in sheds), default=0.0), 3),
+            "shed_predicted_cost_max_s": round(
+                max((c for _, c, _ in sheds), default=0.0), 4),
+        },
+    }))
+
+
 def run_benchmark_standing_refresh():
     """Standing-query live-edge refresh cost: the delta path vs a forced
     full re-dispatch of the same grid, under a live ingest stream
@@ -1723,6 +1885,8 @@ def run_benchmark():
         return run_benchmark_ingest_impact()
     if WORKLOAD == "concurrent_qps":
         return run_benchmark_concurrent_qps()
+    if WORKLOAD == "mixed_cost_storm":
+        return run_benchmark_mixed_cost_storm()
     if WORKLOAD == "fused_mesh":
         return run_benchmark_fused_mesh()
     if WORKLOAD == "fused_jitter":

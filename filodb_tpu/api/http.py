@@ -416,6 +416,8 @@ class PromApiHandler(BaseHTTPRequestHandler):
                 return self._cluster()
             if path == "/debug/kernels":
                 return self._kernels()
+            if path == "/debug/costmodel":
+                return self._costmodel()
             if path == "/debug/superblocks":
                 return self._superblocks()
             if path == "/debug/index":
@@ -790,6 +792,21 @@ class PromApiHandler(BaseHTTPRequestHandler):
         limit = self._q(p, "limit")
         return self._send(
             200, J.success(KERNELS.snapshot(int(limit) if limit else None))
+        )
+
+    def _costmodel(self):
+        """Work cost model (doc/perf.md "Cost-model scheduling"): the
+        per-fingerprint predicted vs realized device-second table (EWMA
+        cost, unit cost, last error ratio), per-family priors, and the
+        prediction-source mix (fingerprint / family / prior). ``?limit=``
+        caps the fingerprint table (newest first)."""
+        from ..query.costmodel import COST_MODEL
+
+        p = self._params()
+        limit = self._q(p, "limit")
+        return self._send(
+            200,
+            J.success(COST_MODEL.snapshot(int(limit) if limit else 64)),
         )
 
     def _resources(self):

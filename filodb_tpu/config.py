@@ -88,11 +88,48 @@ DEFAULTS: dict = {
         # single-user setups. batch_max closes a group early.
         "batch_window_ms": 0.0,
         "batch_max": 32,
+        # adaptive batch window (query/scheduler.py, doc/perf.md
+        # "Cost-model scheduling"): with a cap > batch_window_ms the
+        # effective window scales with predicted queued device-seconds —
+        # it collapses toward ZERO when the node idles (no batching tax)
+        # and widens toward the cap as predicted load approaches
+        # batch_load_ref_cost_s (decayed accumulator of admitted
+        # predicted costs). 0 keeps the window fixed at batch_window_ms.
+        "batch_window_cap_ms": 0.0,
+        "batch_load_ref_cost_s": 0.25,
+        # executable pre-warm (doc/perf.md): a background tick scans the
+        # scheduler's recurrence ring for keys seen >= prewarm_min_count
+        # times (any recurrence during a recompile storm) and trace+
+        # compiles their programs off the serving path, so the first real
+        # poll of a soon-hot dashboard pays zero compiles. 0 disables the
+        # tick; interval_s paces it.
+        "prewarm": {
+            "enabled": True,
+            "min_count": 3,
+            "interval_s": 5.0,
+            "per_tick": 2,
+        },
+        # work cost model (query/costmodel.py): predicted device-seconds
+        # per query from the normalized-promql fingerprint joined to the
+        # kernel registry's warm dispatch stats. prior_cost_s doubles as
+        # the legacy query-count -> device-second quota conversion rate;
+        # alpha is the online EWMA step; cold fingerprints price at
+        # family-mean * cold_multiplier (the compile they may trigger).
+        "costmodel": {
+            "prior_cost_s": 0.05,
+            "alpha": 0.3,
+            "cold_multiplier": 2.0,
+        },
         # per-tenant admission control (doc/operations.md): maps "ws/ns"
         # (or "*" = default for every tenant, including "unknown") to
-        # {"rate": queries/s, "burst": bucket, "max_concurrent": n}.
-        # Over-quota queries shed with HTTP 429 + Retry-After (gRPC: typed
-        # in-band error + retry-after metadata). Empty = no tenant quotas.
+        # {"rate_device_s": device-seconds/s, "burst_device_s": bucket,
+        # "max_concurrent": n}. Buckets refill in predicted DEVICE-SECONDS
+        # (the cost model prices each query), so an expensive query drains
+        # proportionally more than a cheap one. Legacy {"rate": queries/s,
+        # "burst": n} configs convert via costmodel.prior_cost_s. Over-
+        # quota queries shed with HTTP 429 + Retry-After derived from the
+        # bucket's actual predicted drain time (gRPC: typed in-band error
+        # + retry-after metadata). Empty = no tenant quotas.
         "tenant_quotas": {},
         # global bound on admitted-and-unfinished queries (0 = unbounded);
         # past it every tenant sheds with 429 until in-flight drains

@@ -1309,6 +1309,13 @@ class QueryEngine:
             p.dispatch_scheduler = DispatchScheduler(
                 p.batch_window_ms, p.batch_max
             )
+        if p.dispatch_scheduler is not None:
+            # executable pre-warm (query/costmodel plane): the scheduler's
+            # background tick traces+compiles about-to-be-hot recurrence
+            # keys through this engine, off the serving path
+            reg = getattr(p.dispatch_scheduler, "register_prewarmer", None)
+            if reg is not None:
+                reg(self._prewarm_key)
 
     def context(self, allow_partial_results: bool | None = None) -> QueryContext:
         params = self.planner.params
@@ -1391,6 +1398,11 @@ class QueryEngine:
             if res.raw is not None:
                 result_series += len(res.raw)
                 result_samples += sum(len(t) for _, t, _ in res.raw)
+        # cost-model plane: what admission priced the query at vs. the
+        # device time it actually consumed; the completed record feeds the
+        # predictor's online update (EWMA per fingerprint + family)
+        predicted = getattr(ctx, "predicted_cost_s", None)
+        realized = ctx.stats.kernel_ns / 1e9 if ctx.stats is not None else 0.0
         record = QUERY_LOG.publish(
             query_id=root.trace_id, dataset=self.dataset, promql=promql,
             ws=ws, ns=ns, step_ms=int(step_ms),
@@ -1399,7 +1411,13 @@ class QueryEngine:
             stats=ctx.stats, path_info=getattr(ctx, "obs", None),
             result_series=result_series, result_samples=result_samples,
             status=status, error=err,
+            predicted_cost_s=predicted,
+            realized_cost_s=realized if realized > 0 else None,
         )
+        if status == "ok":
+            from ..query.costmodel import COST_MODEL
+
+            COST_MODEL.observe(record)
         if res is not None:
             res.query_log = record
         return record
@@ -1535,7 +1553,10 @@ class QueryEngine:
         step_ms = int(step_s * 1000)
         try:
             with rec.phase("admission"):
-                adm = self._admit(plan, ctx)
+                adm = self._admit(
+                    plan, ctx, promql=promql, step_ms=step_ms,
+                    span_ms=max(int((end_s - start_s) * 1000), 0),
+                )
             with adm:
                 res = self._run(exec_plan, ctx)
         except Exception as e:
@@ -1559,18 +1580,37 @@ class QueryEngine:
                            query_id=record["id"] if record else None)
         return res
 
-    def _admit(self, plan, ctx):
+    def _admit(self, plan, ctx, promql: str | None = None,
+               step_ms: int = 0, span_ms: int = 0):
         """Admission-control gate (query/scheduler.AdmissionController):
-        resolve the tenant from the plan's selector filters and claim its
-        concurrency/rate slots for the duration of execution. Raises
-        AdmissionRejected (HTTP 429 + Retry-After) when the tenant is over
-        quota or the global queue-depth bound is hit; a no-op context when
-        no controller is configured. The resolved tenant is stashed on the
-        context so _meter_tenant doesn't walk the plan's leaves a second
-        time per query. Coalesced identical-query followers never reach
-        this point (they share the leader's execution AND its admission
-        slot — sharing an answer costs the tenant nothing)."""
+        resolve the tenant from the plan's selector filters, PRICE the
+        query through the cost model (query/costmodel.py — fingerprint
+        EWMA, family prior for cold fingerprints) and claim its
+        concurrency/rate slots for the duration of execution, draining the
+        tenant's device-second bucket by the prediction. Raises
+        AdmissionRejected (HTTP 429 + Retry-After = the bucket's predicted
+        drain time) when the tenant is over quota or the global
+        queue-depth bound is hit; a no-op context when no controller is
+        configured. The prediction + resolved tenant are stashed on the
+        context: _observe_querylog stamps ``predicted_cost_s`` onto the
+        cost record, and _meter_tenant doesn't walk the plan's leaves a
+        second time per query. Coalesced identical-query followers never
+        reach this point (they share the leader's execution AND its
+        admission slot — sharing an answer costs the tenant nothing)."""
         params = self.planner.params
+        cost_s = None
+        if promql is not None:
+            from ..obs.querylog import promql_fingerprint
+            from ..query.costmodel import COST_MODEL, family_of
+
+            steps = (int(span_ms // step_ms) + 1) if step_ms > 0 else 1
+            fp = promql_fingerprint(self.dataset, promql, step_ms, span_ms)
+            cost_s, source = COST_MODEL.predict(
+                fp, steps=steps, family=family_of(promql)
+            )
+            ctx.predicted_cost_s = cost_s
+            ctx.cost_fingerprint = fp
+            ctx.cost_source = source
         if params.admission is None:
             import contextlib
 
@@ -1579,7 +1619,40 @@ class QueryEngine:
 
         ws, ns = tenant_of_plan(plan)
         ctx._tenant = (ws, ns)
-        return params.admission.admit(ws, ns)
+        return params.admission.admit(ws, ns, cost_s=cost_s)
+
+    def _prewarm_key(self, desc: dict) -> None:
+        """Background trace+compile of a predicted-hot recurrence key
+        (DispatchScheduler.prewarm_tick): run the ring descriptor's query
+        end-to-end OFF the serving path — no admission (the server's own
+        standing obligation, like maintainer refreshes), no querylog or
+        recurrence-ring feedback (``standing_refresh`` flag), no batch
+        window — so its executables and superblock are warm before the
+        first real poll pays the compile in its p99."""
+        import time as _time
+
+        promql = desc.get("promql")
+        step_ms = int(desc.get("step_ms") or 0)
+        span_ms = int(desc.get("span_ms") or 0)
+        if not promql or step_ms <= 0 or span_ms <= 0:
+            return
+        end_s = _time.time() - float(desc.get("end_lag_ms") or 0) / 1e3
+        start_s = end_s - span_ms / 1e3
+        plan = query_range_to_logical_plan(
+            promql, start_s, end_s, step_ms / 1e3,
+            self.planner.params.lookback_ms,
+        )
+        if self.planner.params.agg_rules is not None:
+            from .lpopt import optimize_with_preagg
+
+            plan = optimize_with_preagg(plan, self.planner.params.agg_rules)
+        exec_plan = self.planner.materialize(plan)
+        ctx = self.context()
+        ctx.standing_refresh = True  # keep prewarm out of the ring
+        # solo-path compile is the one a dashboard's first poll would pay:
+        # don't route the warmup through the batch window it exists to dodge
+        ctx.dispatch_scheduler = None
+        exec_plan.execute(ctx)
 
     def _run(self, exec_plan, ctx):
         """Execute on the shared bounded scheduler when configured, else
@@ -1630,7 +1703,10 @@ class QueryEngine:
         )
         try:
             with rec.phase("admission"):
-                adm = self._admit(plan, ctx)
+                adm = self._admit(
+                    plan, ctx, promql=qname, step_ms=g_step,
+                    span_ms=max(int((g_end - g_start) * 1000), 0),
+                )
             with adm:
                 res = self._run(exec_plan, ctx)
         except Exception as e:
@@ -1689,7 +1765,7 @@ class QueryEngine:
         self._start_trace(ctx, promql, trace_id, parent_span_id)
         try:
             with rec.phase("admission"):
-                adm = self._admit(plan, ctx)
+                adm = self._admit(plan, ctx, promql=promql)
             with adm:
                 res = self._run(exec_plan, ctx)
         except Exception as e:

@@ -191,6 +191,8 @@ HELP_TEXTS: dict[str, str] = {
     "filodb_alert_eval_seconds": "Alert-rule evaluation latency (state machine + write-back per tick).",
     "filodb_alert_eval_failures": "Alert-rule evaluation failures, per rule (refresh errors included).",
     "filodb_alert_notify": "Alert notification deliveries per receiver and outcome (ok|retry|error|breaker_open).",
+    "filodb_costmodel_error_ratio": "Cost-model prediction quality per completed query: max(predicted/realized, realized/predicted) device-seconds.",
+    "filodb_prewarm": "Executable pre-warm attempts by outcome (ok|error): recurrence-ring keys trace+compiled off the serving path.",
 }
 
 

@@ -361,6 +361,25 @@ class ExecutableRegistry:
 
     # -- introspection -----------------------------------------------------
 
+    def device_p50_ms(self, key: str) -> float:
+        """Warm-dispatch device-time p50 (ms) for one executable key —
+        the cost model's registry join: a querylog record with no kernel
+        time of its own (fully cache-served) still prices at what its
+        executable measurably costs when it does run."""
+        with self._lock:
+            rec = self._records.get(key)
+            if rec is None:
+                return 0.0
+            return hist_quantile_est(rec.device_hist, 0.5) * 1e3
+
+    def storm_annotations(self) -> dict[str, dict]:
+        """Copy of the live recompile-storm annotations (family ->
+        {time, compiles_in_window, window_s, unstable_dims}) — the
+        scheduler's pre-warm trigger reads these without paying for a
+        full snapshot."""
+        with self._lock:
+            return {k: dict(v) for k, v in self._storms.items()}
+
     def snapshot(self, limit: int | None = None) -> dict:
         """The /debug/kernels (and attestation-artifact) rendering:
         per-executable table sorted by dispatches, storm annotations,

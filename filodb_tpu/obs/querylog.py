@@ -214,7 +214,9 @@ class QueryLogRing:
                 phases: PhaseRecorder, elapsed_s: float,
                 stats=None, path_info: dict | None = None,
                 result_series: int = 0, result_samples: int = 0,
-                status: str = "ok", error: str | None = None) -> dict:
+                status: str = "ok", error: str | None = None,
+                predicted_cost_s: float | None = None,
+                realized_cost_s: float | None = None) -> dict:
         """Build + ring one query's cost record and feed the aggregate
         planes (phase histograms with trace-id exemplars, per-tenant phase
         counters, per-path counter). The engine calls this once per
@@ -262,6 +264,13 @@ class QueryLogRing:
             "endpoint": ",".join(info["endpoints"]) if info.get("endpoints") else None,
             "status": status,
             "error": error,
+            # cost-model plane (query/costmodel.py): what admission PRICED
+            # this execution at vs. what the device actually charged —
+            # the pair every prediction-quality surface joins on
+            "predicted_cost_s": (round(float(predicted_cost_s), 6)
+                                 if predicted_cost_s is not None else None),
+            "realized_cost_s": (round(float(realized_cost_s), 6)
+                                if realized_cost_s is not None else None),
             "duration_ms": round(float(elapsed_s) * 1e3, 3),
             "phases_ms": {k: round(v * 1e3, 3) for k, v in ph.items()},
             "stats": {

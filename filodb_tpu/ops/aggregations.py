@@ -122,10 +122,11 @@ FUSED_MXU_FUNCS = {
     "rate", "increase", "delta", "idelta", "irate",
 }
 
-# range functions the fused JITTER/MASKED variants handle (the mxu_jitter
-# set minus min/max_over_time, which need the lazily-built tile/edge
-# structures — those fall to the general kernel, counted grid_jitter/holes)
-FUSED_JITTER_FUNCS = FUSED_MXU_FUNCS
+# range functions the fused JITTER/MASKED variants handle: the mxu_jitter
+# set plus min/max_over_time, which ride dedicated fused minmax programs
+# (tile hierarchy + edge one-hots, built lazily via wm.ensure_minmax) —
+# jittered/holey grids stay ONE fast fused dispatch for them too
+FUSED_JITTER_FUNCS = FUSED_MXU_FUNCS | {"min_over_time", "max_over_time"}
 
 
 def _grid_variant(block, func: str, is_delta: bool):
@@ -192,7 +193,12 @@ def batch_variant_supported(block, func: str, kind: str, is_delta: bool,
         # jittered hist grids take the unbatched jitter variant
         return block.regular_ts is not None or block.nominal_ts is None
     variant, reason = _grid_variant(block, func, is_delta)
-    if variant in ("jitter", "masked") and mesh is not None:
+    if variant in ("jitter", "masked") and func in (
+        "min_over_time", "max_over_time"
+    ):
+        # the fused minmax programs (tile hierarchy + edge one-hots) have
+        # no batched twin — the query still runs ONE fused dispatch, it
+        # just doesn't coalesce with other lanes
         return False
     if variant == "general" and reason is None and _pallas_variant(
         block, func, mesh
@@ -216,6 +222,23 @@ def _mwm_args(wm) -> tuple:
     return (wm.d_W0, wm.d_SEL, wm.d_idx, wm.d_c0pos, wm.d_has_klo,
             wm.d_has_khi, wm.d_F0_rel, wm.d_L0_rel, wm.d_Klo_rel,
             wm.d_Khi_rel, wm.d_blo_rel, wm.d_ehi_rel)
+
+
+def _jmm_args(wm) -> tuple:
+    """The minmax window structure as ONE flat tuple in jitter_minmax's
+    positional order (requires wm.ensure_minmax() first — the tile/edge
+    structures build lazily)."""
+    return (wm.d_SEL, wm.d_idx, wm.d_tile_mask, wm.d_edge_onehot,
+            wm.d_edge_valid, wm.d_edge_idx, wm.d_count0, wm.d_has_klo,
+            wm.d_has_khi, wm.d_blo_rel, wm.d_ehi_rel)
+
+
+def _mmm_args(wm) -> tuple:
+    """Masked-grid minmax structure tuple (jitter_masked_minmax order:
+    the grid-level c0pos replaces the per-window certain count)."""
+    return (wm.d_SEL, wm.d_idx, wm.d_tile_mask, wm.d_edge_onehot,
+            wm.d_edge_valid, wm.d_edge_idx, wm.d_c0pos, wm.d_has_klo,
+            wm.d_has_khi, wm.d_blo_rel, wm.d_ehi_rel)
 
 
 def _mgrid_args(g) -> tuple:
@@ -342,6 +365,42 @@ def _fused_masked_jit(func, epilogue, mba, mwm, window_ms, maxdev, gids,
         func, *mba, *mwm, window_ms,
         is_counter=is_counter, is_delta=is_delta, fetch=fetch,
         maxdev=maxdev,
+    )
+    return _apply_epilogue(sj, epilogue, gids, n_real, qv, num_groups)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "func", "epilogue", "num_groups", "n_valid", "fetch"
+))
+def _fused_jitter_minmax_jit(func, epilogue, vals, dev, jmm, gids, n_real,
+                             qv, num_groups: int, n_valid: int, fetch: str):
+    """min/max_over_time on a near-regular grid: the tile-hierarchy minmax
+    kernel (ops/mxu_jitter.jitter_minmax) + epilogue in ONE compiled
+    program — min/max no longer degrade jittered grids to the multi-pass
+    general path. ``jmm`` is the flat minmax structure tuple (_jmm_args,
+    built lazily via wm.ensure_minmax BEFORE the timed span)."""
+    from .mxu_jitter import jitter_minmax
+
+    sj = jitter_minmax(
+        vals, dev, *jmm, n_valid=n_valid,
+        is_min=(func == "min_over_time"), fetch=fetch,
+    )
+    return _apply_epilogue(sj, epilogue, gids, n_real, qv, num_groups)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "func", "epilogue", "num_groups", "fetch"
+))
+def _fused_masked_minmax_jit(func, epilogue, vals, dev, valid, cc, mmm,
+                             gids, n_real, qv, num_groups: int, fetch: str):
+    """Missing-scrape min/max fused variant: the validity-masked tile
+    hierarchy (jitter_masked_minmax) + epilogue in one program. ``mmm`` =
+    _mmm_args (after wm.ensure_minmax)."""
+    from .mxu_jitter import jitter_masked_minmax
+
+    sj = jitter_masked_minmax(
+        vals, dev, valid, cc, *mmm,
+        is_min=(func == "min_over_time"), fetch=fetch,
     )
     return _apply_epilogue(sj, epilogue, gids, n_real, qv, num_groups)
 
@@ -578,6 +637,72 @@ def _fused_sharded_masked_jit(mesh, func, epilogue, mba, mwm, window_ms,
     )(mba, gids)
 
 
+@functools.partial(jax.jit, static_argnames=(
+    "mesh", "func", "epilogue", "num_groups", "n_valid", "fetch"
+))
+def _fused_sharded_jitter_minmax_jit(mesh, func, epilogue, vals, dev, jmm,
+                                     gids, n_real, qv, num_groups: int,
+                                     n_valid: int, fetch: str):
+    """Series-sharded twin of _fused_jitter_minmax_jit: the replicated
+    minmax structures ride the closure, the tile-hierarchy kernel runs per
+    row band (``n_valid`` masks the TIME axis, unchanged by series
+    sharding), and the epilogue combines over the mesh in one program."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..jax_compat import shard_map
+    from .mxu_jitter import jitter_minmax
+
+    axis = mesh.axis_names[0]
+
+    def local(vals_l, dev_l, gids_l):
+        sj = jitter_minmax(
+            vals_l, dev_l, *jmm, n_valid=n_valid,
+            is_min=(func == "min_over_time"), fetch=fetch,
+        )
+        return _sharded_epilogue(sj, epilogue, gids_l, n_real, qv,
+                                 num_groups, axis)
+
+    row, vec = P(axis, None), P(axis)
+    return shard_map(
+        local, mesh=mesh,
+        in_specs=(row, row, vec),
+        out_specs=_sharded_out_specs(epilogue),
+        check=False,
+    )(vals, dev, gids)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "mesh", "func", "epilogue", "num_groups", "fetch"
+))
+def _fused_sharded_masked_minmax_jit(mesh, func, epilogue, vals, dev, valid,
+                                     cc, mmm, gids, n_real, qv,
+                                     num_groups: int, fetch: str):
+    """Series-sharded twin of _fused_masked_minmax_jit (row-band sidecar
+    arrays, replicated minmax structures in the closure)."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..jax_compat import shard_map
+    from .mxu_jitter import jitter_masked_minmax
+
+    axis = mesh.axis_names[0]
+
+    def local(vals_l, dev_l, valid_l, cc_l, gids_l):
+        sj = jitter_masked_minmax(
+            vals_l, dev_l, valid_l, cc_l, *mmm,
+            is_min=(func == "min_over_time"), fetch=fetch,
+        )
+        return _sharded_epilogue(sj, epilogue, gids_l, n_real, qv,
+                                 num_groups, axis)
+
+    row, vec = P(axis, None), P(axis)
+    return shard_map(
+        local, mesh=mesh,
+        in_specs=(row, row, row, row, vec),
+        out_specs=_sharded_out_specs(epilogue),
+        check=False,
+    )(vals, dev, valid, cc, gids)
+
+
 def _exec_key_parts(variant: str, epilogue, block, j_pad: int,
                     num_groups: int, mesh=None, batch: str | None = None):
     """Executable-key parts for the kernel observatory (obs/kernels.py
@@ -649,6 +774,12 @@ def _fused_dispatch(func: str, epilogue: tuple, block, gids_padded,
         )
         if not wm.ok:
             variant, reason = "general", "grid_holes"
+    if (variant in ("jitter", "masked")
+            and func in ("min_over_time", "max_over_time")):
+        # min/max tile/edge structures build lazily on the memoized window
+        # structure (only these two functions read them) — still host-side
+        # build work, so it stays outside the timed span
+        wm.ensure_minmax()
     if variant == "general" and reason is None and _pallas_variant(
         block, func, mesh
     ):
@@ -685,28 +816,50 @@ def _fused_dispatch(func: str, epilogue: tuple, block, gids_padded,
     elif variant == "jitter":
         from .mxu_kernels import fetch_strategy
 
-        common = (
-            func, epilogue, block.vals, block.ts_dev, raw, _jwm_args(wm),
-            np.float32(params.window_ms), gids_padded, n_real, qv,
-            num_groups, is_counter, is_delta, fetch_strategy(),
-        )
-        if mesh is not None:
-            fn, args = _fused_sharded_jitter_jit, (mesh,) + common
+        if func in ("min_over_time", "max_over_time"):
+            common = (
+                func, epilogue, block.vals, block.ts_dev, _jmm_args(wm),
+                gids_padded, n_real, qv, num_groups,
+                int(np.asarray(block.lens)[0]), fetch_strategy(),
+            )
+            if mesh is not None:
+                fn, args = _fused_sharded_jitter_minmax_jit, (mesh,) + common
+            else:
+                fn, args = _fused_jitter_minmax_jit, common
         else:
-            fn, args = _fused_jitter_jit, common
+            common = (
+                func, epilogue, block.vals, block.ts_dev, raw, _jwm_args(wm),
+                np.float32(params.window_ms), gids_padded, n_real, qv,
+                num_groups, is_counter, is_delta, fetch_strategy(),
+            )
+            if mesh is not None:
+                fn, args = _fused_sharded_jitter_jit, (mesh,) + common
+            else:
+                fn, args = _fused_jitter_jit, common
     elif variant == "masked":
         from .mxu_kernels import fetch_strategy
 
-        common = (
-            func, epilogue, _mgrid_args(block.mgrid), _mwm_args(wm),
-            np.float32(params.window_ms),
-            np.float32(block.mgrid.maxdev_ms), gids_padded, n_real, qv,
-            num_groups, is_counter, is_delta, fetch_strategy(),
-        )
-        if mesh is not None:
-            fn, args = _fused_sharded_masked_jit, (mesh,) + common
+        if func in ("min_over_time", "max_over_time"):
+            g = block.mgrid
+            common = (
+                func, epilogue, g.vals, g.dev, g.valid, g.cc, _mmm_args(wm),
+                gids_padded, n_real, qv, num_groups, fetch_strategy(),
+            )
+            if mesh is not None:
+                fn, args = _fused_sharded_masked_minmax_jit, (mesh,) + common
+            else:
+                fn, args = _fused_masked_minmax_jit, common
         else:
-            fn, args = _fused_masked_jit, common
+            common = (
+                func, epilogue, _mgrid_args(block.mgrid), _mwm_args(wm),
+                np.float32(params.window_ms),
+                np.float32(block.mgrid.maxdev_ms), gids_padded, n_real, qv,
+                num_groups, is_counter, is_delta, fetch_strategy(),
+            )
+            if mesh is not None:
+                fn, args = _fused_sharded_masked_jit, (mesh,) + common
+            else:
+                fn, args = _fused_masked_jit, common
     elif variant == "pallas":
         fn = _fused_pallas_jit
         args = (
@@ -1244,6 +1397,94 @@ def _batched_sharded_mxu_jit(mesh, func, epilogue, vals, raw, baseline, W_u,
     )(vals, raw, baseline, gids_q)
 
 
+@functools.partial(jax.jit, static_argnames=(
+    "mesh", "func", "epilogue", "u_map", "num_groups", "is_counter",
+    "is_delta", "fetch"
+))
+def _batched_sharded_jitter_jit(mesh, func, epilogue, vals, dev, raw, wm_u,
+                                window_ms_u, gids_q, n_real, qv_q,
+                                u_map: tuple, num_groups: int,
+                                is_counter: bool, is_delta: bool,
+                                fetch: str):
+    """Series-sharded twin of _batched_jitter_jit: the replicated stacked
+    window structures ride the closure and the unrolled per-lane epilogues
+    combine over the mesh inside ONE multi-device program — mesh + jitter
+    lanes coalesce instead of dropping to per-lane dispatch."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..jax_compat import shard_map
+    from .mxu_jitter import jitter_range_kernel
+
+    axis = mesh.axis_names[0]
+
+    def local(vals_l, dev_l, raw_l, gids_ql):
+        sj_u = [
+            jitter_range_kernel(
+                func, vals_l, dev_l, raw_l, *(a[u] for a in wm_u),
+                window_ms_u[u], is_counter=is_counter, is_delta=is_delta,
+                fetch=fetch,
+            )
+            for u in range(max(u_map) + 1)
+        ]
+        outs = [
+            _sharded_epilogue(sj_u[u_map[i]], epilogue, gids_ql[i], n_real,
+                              qv_q[i], num_groups, axis)
+            for i in range(len(u_map))
+        ]
+        return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *outs)
+
+    row = P(axis, None)
+    return shard_map(
+        local, mesh=mesh,
+        in_specs=(row, row, row, P(None, axis)),
+        out_specs=_sharded_out_specs(epilogue),
+        check=False,
+    )(vals, dev, raw, gids_q)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "mesh", "func", "epilogue", "u_map", "num_groups", "is_counter",
+    "is_delta", "fetch"
+))
+def _batched_sharded_masked_jit(mesh, func, epilogue, mba, wm_u,
+                                window_ms_u, maxdev, gids_q, n_real, qv_q,
+                                u_map: tuple, num_groups: int,
+                                is_counter: bool, is_delta: bool,
+                                fetch: str):
+    """Series-sharded twin of _batched_masked_jit (row-band sidecar
+    arrays, replicated stacked masked window structures in the closure)."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..jax_compat import shard_map
+    from .mxu_jitter import jitter_masked_kernel
+
+    axis = mesh.axis_names[0]
+
+    def local(mba_l, gids_ql):
+        sj_u = [
+            jitter_masked_kernel(
+                func, *mba_l, *(a[u] for a in wm_u), window_ms_u[u],
+                is_counter=is_counter, is_delta=is_delta, fetch=fetch,
+                maxdev=maxdev,
+            )
+            for u in range(max(u_map) + 1)
+        ]
+        outs = [
+            _sharded_epilogue(sj_u[u_map[i]], epilogue, gids_ql[i], n_real,
+                              qv_q[i], num_groups, axis)
+            for i in range(len(u_map))
+        ]
+        return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *outs)
+
+    row = P(axis, None)
+    return shard_map(
+        local, mesh=mesh,
+        in_specs=(tuple(row for _ in mba), P(None, axis)),
+        out_specs=_sharded_out_specs(epilogue),
+        check=False,
+    )(mba, gids_q)
+
+
 _BATCH_STACK_MEMO_MAX = 64
 
 
@@ -1376,10 +1617,11 @@ def fused_batched_scalar(func: str, epilogue: tuple, block, lanes,
     variant selection matches _fused_dispatch exactly (_grid_variant) so a
     batched lane computes through the same kernel variant as its unbatched
     execution would. Combinations the batched program set does not model —
-    mesh + jitter/masked, pallas-promoted irregular grids, a merged window
-    failing the jitter safety bound — RAISE, which the scheduler turns
-    into per-lane unbatched execution (batching is an optimization, never
-    a correctness risk)."""
+    min/max_over_time on jitter/masked grids (dedicated fused minmax
+    programs), pallas-promoted irregular grids, a merged window failing
+    the jitter safety bound — RAISE, which the scheduler turns into
+    per-lane unbatched execution (batching is an optimization, never a
+    correctness risk)."""
     import time as _time
 
     from ..metrics import record_kernel_dispatch
@@ -1428,7 +1670,8 @@ def fused_batched_scalar(func: str, epilogue: tuple, block, lanes,
             st["window_ms_u"], st["gids_q"], n_real, qv_q, u_map,
             num_groups, is_counter, is_delta, fetch_strategy(),
         )
-        fn = _batched_jitter_jit
+        fn = (_batched_sharded_jitter_jit if mesh is not None
+              else _batched_jitter_jit)
     elif variant == "masked":
         from .mxu_kernels import fetch_strategy
 
@@ -1438,7 +1681,8 @@ def fused_batched_scalar(func: str, epilogue: tuple, block, lanes,
             st["gids_q"], n_real, qv_q, u_map,
             num_groups, is_counter, is_delta, fetch_strategy(),
         )
-        fn = _batched_masked_jit
+        fn = (_batched_sharded_masked_jit if mesh is not None
+              else _batched_masked_jit)
     else:
         args = (
             func, epilogue, block.ts, block.vals, block.lens, block.baseline,
@@ -1767,17 +2011,23 @@ def _register_kernel_observatory() -> None:
         _fused_mxu_jit=_fused_mxu_jit,
         _fused_jitter_jit=_fused_jitter_jit,
         _fused_masked_jit=_fused_masked_jit,
+        _fused_jitter_minmax_jit=_fused_jitter_minmax_jit,
+        _fused_masked_minmax_jit=_fused_masked_minmax_jit,
         _fused_pallas_jit=_fused_pallas_jit,
         _fused_sharded_general_jit=_fused_sharded_general_jit,
         _fused_sharded_mxu_jit=_fused_sharded_mxu_jit,
         _fused_sharded_jitter_jit=_fused_sharded_jitter_jit,
         _fused_sharded_masked_jit=_fused_sharded_masked_jit,
+        _fused_sharded_jitter_minmax_jit=_fused_sharded_jitter_minmax_jit,
+        _fused_sharded_masked_minmax_jit=_fused_sharded_masked_minmax_jit,
         _batched_general_jit=_batched_general_jit,
         _batched_mxu_jit=_batched_mxu_jit,
         _batched_jitter_jit=_batched_jitter_jit,
         _batched_masked_jit=_batched_masked_jit,
         _batched_sharded_general_jit=_batched_sharded_general_jit,
         _batched_sharded_mxu_jit=_batched_sharded_mxu_jit,
+        _batched_sharded_jitter_jit=_batched_sharded_jitter_jit,
+        _batched_sharded_masked_jit=_batched_sharded_masked_jit,
         topk_mask=topk_mask,
         segment_quantile=segment_quantile,
     )

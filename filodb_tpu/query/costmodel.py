@@ -1,0 +1,251 @@
+"""Learned per-query cost model — predicted device-seconds per fingerprint.
+
+The scheduling plane prices work in *device-seconds*, not query counts: a
+30-day ``quantile_over_time`` and a 5-minute ``rate`` are not the same
+token. The predictor joins the two observability planes PRs 12/14 built:
+
+- the query observatory's normalized promql **fingerprint**
+  (obs/querylog.promql_fingerprint — dataset + query text + grid shape,
+  live edge normalized away), which is stable across a dashboard panel's
+  re-issues, and
+- the kernel registry's per-executable warm-dispatch stats
+  (obs/kernels.ExecutableRegistry device-time histograms), used to back
+  fill a realized cost when a record carries no kernel time of its own
+  (e.g. a fully cache-served execution).
+
+Per fingerprint it keeps an EWMA of realized device-seconds plus a
+normalized *unit cost* (device-seconds per series×step of work), updated
+online from every completed querylog record. Cold fingerprints are priced
+by a conservative **family prior**: the per-family unit-cost EWMA scaled
+by the query's own grid work and a safety multiplier (over-estimating an
+unknown query sheds it a little early; under-estimating burns another
+tenant's quota). With no family evidence either, the configured flat
+prior applies — the same constant used to convert legacy query-count
+quotas into device-second buckets, so an unconfigured deployment behaves
+exactly as before.
+
+Consumers:
+
+- ``AdmissionController`` (query/scheduler.py) drains the tenant bucket
+  by the prediction, so ``Retry-After`` is the bucket's actual drain time;
+- ``DispatchScheduler`` widens/narrows its batch window from the decayed
+  sum of predicted queue cost;
+- querylog records gain ``predicted_cost_s`` / ``realized_cost_s`` and
+  the ``filodb_costmodel_error_ratio`` histogram tracks |log error| of
+  every prediction on the self-scrape (``GET /debug/costmodel`` shows the
+  per-fingerprint detail).
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from collections import OrderedDict
+
+from ..metrics import REGISTRY
+
+# Flat prior: what one "typical" query is worth in device-seconds before
+# any evidence. Doubles as the legacy-quota conversion rate (N queries/s
+# -> N * prior device-seconds/s), so converting units alone changes no
+# admission decision.
+DEFAULT_PRIOR_COST_S = 0.05
+# Cold-fingerprint predictions are scaled up: the cost of over-pricing an
+# unknown query is one early shed; the cost of under-pricing it is a
+# drained bucket every other tenant pays for.
+DEFAULT_COLD_MULTIPLIER = 2.0
+DEFAULT_ALPHA = 0.3
+
+_RANGE_FN = re.compile(r"\b([a-z_0-9]+_over_time|rate|irate|increase|delta"
+                       r"|idelta|changes|resets|deriv)\s*\(")
+
+
+def family_of(promql: str) -> str:
+    """Coarse workload family of a query — the outermost range function
+    (``rate``, ``min_over_time``, ...) or ``instant``. Derived from the
+    query text on both the predict and the observe side so the join never
+    depends on which executable variant actually served the dispatch."""
+    m = _RANGE_FN.search(promql or "")
+    return m.group(1) if m else "instant"
+
+
+class CostModel:
+    """Online device-second predictor, keyed by promql fingerprint with a
+    per-family fallback ladder (fingerprint EWMA -> family unit cost ×
+    grid work -> flat prior). Thread-safe; all state is O(max_entries)."""
+
+    def __init__(self, prior_cost_s: float = DEFAULT_PRIOR_COST_S,
+                 alpha: float = DEFAULT_ALPHA,
+                 cold_multiplier: float = DEFAULT_COLD_MULTIPLIER,
+                 max_entries: int = 4096):
+        self.prior_cost_s = max(float(prior_cost_s), 1e-6)
+        self.alpha = min(max(float(alpha), 0.01), 1.0)
+        self.cold_multiplier = max(float(cold_multiplier), 1.0)
+        self._max = max(int(max_entries), 16)
+        self._lock = threading.Lock()
+        # fingerprint -> {cost_s, unit_cost_s, n, family,
+        #                 last_predicted_s, last_realized_s, last_error_ratio}
+        self._fp: OrderedDict[str, dict] = OrderedDict()
+        # family -> {unit_cost_s, cost_s, n}
+        self._families: dict[str, dict] = {}
+        self._sources = {"fingerprint": 0, "family": 0, "prior": 0}
+        self._observed = 0
+
+    def configure(self, prior_cost_s: float | None = None,
+                  alpha: float | None = None,
+                  cold_multiplier: float | None = None,
+                  max_entries: int | None = None) -> None:
+        with self._lock:
+            if prior_cost_s is not None:
+                self.prior_cost_s = max(float(prior_cost_s), 1e-6)
+            if alpha is not None:
+                self.alpha = min(max(float(alpha), 0.01), 1.0)
+            if cold_multiplier is not None:
+                self.cold_multiplier = max(float(cold_multiplier), 1.0)
+            if max_entries is not None:
+                self._max = max(int(max_entries), 16)
+                while len(self._fp) > self._max:
+                    self._fp.popitem(last=False)
+
+    # -- prediction --------------------------------------------------------
+
+    def predict(self, fingerprint: str, steps: int = 0, series: int = 0,
+                family: str | None = None) -> tuple[float, str]:
+        """Predicted device-seconds for one execution of ``fingerprint``
+        and the evidence tier that priced it (``fingerprint`` | ``family``
+        | ``prior``). ``steps``/``series`` scale the family unit cost for
+        cold fingerprints (grid shape × series count scaling); a warm
+        fingerprint's own EWMA already embodies its grid."""
+        work = max(int(steps), 1) * max(int(series), 1)
+        with self._lock:
+            e = self._fp.get(fingerprint)
+            if e is not None and e["n"] > 0:
+                self._fp.move_to_end(fingerprint)
+                self._sources["fingerprint"] += 1
+                return max(e["cost_s"], 1e-9), "fingerprint"
+            fam = self._families.get(family or "")
+            if fam is not None and fam["n"] > 0:
+                self._sources["family"] += 1
+                if work > 1 and fam["unit_cost_s"] > 0.0:
+                    cost = fam["unit_cost_s"] * work
+                else:
+                    cost = fam["cost_s"]
+                return max(cost * self.cold_multiplier, 1e-9), "family"
+            self._sources["prior"] += 1
+            return self.prior_cost_s, "prior"
+
+    # -- online update -----------------------------------------------------
+
+    def observe(self, record: dict) -> None:
+        """Fold one completed querylog record back into the model. The
+        realized cost is the record's own kernel device time; a record
+        without any (fully cache-served) falls back to the kernel
+        registry's warm p50 for the executable that served it, keeping
+        the EWMA anchored to device reality instead of decaying to zero."""
+        if not isinstance(record, dict) or record.get("status") == "shed":
+            return
+        fp = record.get("fingerprint")
+        if not fp:
+            return
+        realized = float(record.get("realized_cost_s") or 0.0)
+        if realized <= 0.0:
+            realized = self._registry_device_p50(record.get("executable_key"))
+        if realized <= 0.0:
+            return
+        stats = record.get("stats") or {}
+        grid = record.get("grid") or {}
+        steps = int(grid.get("steps") or 1)
+        series = int(stats.get("series_scanned") or 0)
+        work = max(steps, 1) * max(series, 1)
+        fam_key = family_of(record.get("promql", ""))
+        predicted = record.get("predicted_cost_s")
+        a = self.alpha
+        with self._lock:
+            self._observed += 1
+            e = self._fp.get(fp)
+            if e is None:
+                e = {"cost_s": realized, "unit_cost_s": realized / work,
+                     "n": 0, "family": fam_key, "last_predicted_s": None,
+                     "last_realized_s": None, "last_error_ratio": None}
+                self._fp[fp] = e
+                while len(self._fp) > self._max:
+                    self._fp.popitem(last=False)
+            else:
+                e["cost_s"] += a * (realized - e["cost_s"])
+                e["unit_cost_s"] += a * (realized / work - e["unit_cost_s"])
+            e["n"] += 1
+            e["family"] = fam_key
+            e["last_realized_s"] = realized
+            self._fp.move_to_end(fp)
+            fam = self._families.setdefault(
+                fam_key, {"unit_cost_s": 0.0, "cost_s": 0.0, "n": 0})
+            if fam["n"] == 0:
+                fam["cost_s"] = realized
+                fam["unit_cost_s"] = realized / work
+            else:
+                fam["cost_s"] += a * (realized - fam["cost_s"])
+                fam["unit_cost_s"] += a * (realized / work
+                                           - fam["unit_cost_s"])
+            fam["n"] += 1
+            if predicted is not None and predicted > 0.0:
+                ratio = max(predicted / realized, realized / predicted)
+                e["last_predicted_s"] = float(predicted)
+                e["last_error_ratio"] = round(ratio, 4)
+        if predicted is not None and predicted > 0.0:
+            # symmetric error ratio (>= 1.0; 1.0 = perfect) — prediction
+            # quality rides the self-scrape via this histogram
+            REGISTRY.histogram("filodb_costmodel_error_ratio").observe(
+                max(predicted / realized, realized / predicted))
+
+    @staticmethod
+    def _registry_device_p50(executable_key: str | None) -> float:
+        if not executable_key:
+            return 0.0
+        from ..obs.kernels import KERNELS
+
+        ms = KERNELS.device_p50_ms(executable_key)
+        return (ms or 0.0) / 1e3
+
+    # -- introspection -----------------------------------------------------
+
+    def error_ratio(self, fingerprint: str) -> float | None:
+        """Last prediction's symmetric error ratio for ``fingerprint``
+        (None until a predicted record completes) — the convergence probe
+        tests/test_costmodel.py asserts on."""
+        with self._lock:
+            e = self._fp.get(fingerprint)
+            return e["last_error_ratio"] if e else None
+
+    def snapshot(self, limit: int = 64) -> dict:
+        """``GET /debug/costmodel`` payload: predictions + realized errors
+        per warm fingerprint, family priors, and which evidence tier has
+        been pricing admissions."""
+        with self._lock:
+            fps = [
+                {"fingerprint": fp, **{k: (round(v, 6)
+                                           if isinstance(v, float) else v)
+                                       for k, v in e.items()}}
+                for fp, e in list(self._fp.items())[-max(int(limit), 0):]
+            ][::-1]
+            return {
+                "prior_cost_s": self.prior_cost_s,
+                "alpha": self.alpha,
+                "cold_multiplier": self.cold_multiplier,
+                "observed": self._observed,
+                "prediction_sources": dict(self._sources),
+                "families": {
+                    k: {"unit_cost_s": round(v["unit_cost_s"], 9),
+                        "cost_s": round(v["cost_s"], 6), "n": v["n"]}
+                    for k, v in sorted(self._families.items())
+                },
+                "fingerprints": fps,
+            }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._fp.clear()
+            self._families.clear()
+            self._sources = {"fingerprint": 0, "family": 0, "prior": 0}
+            self._observed = 0
+
+
+COST_MODEL = CostModel()

@@ -1787,6 +1787,11 @@ class FusedAggregateExec(ExecPlan):
         # bookkeeping: no device sync is added around the (async) dispatch.
         rec = current_phases()
         obs = getattr(ctx, "obs", None)
+        # the cost model's prediction rides the request: the scheduler's
+        # adaptive batch window widens/narrows on the decayed sum of these
+        request.predicted_cost_s = float(
+            getattr(ctx, "predicted_cost_s", 0.0) or 0.0
+        )
         t0 = _time.perf_counter()
         if (sched is not None and getattr(sched, "enabled", False)
                 and AGG.batch_variant_supported(

@@ -51,6 +51,7 @@ overflow-bucket cap the metering counters use
 
 from __future__ import annotations
 
+import math
 import threading
 import time
 from collections import deque
@@ -85,12 +86,14 @@ class AdmissionRejected(QueryError):
 
     def __init__(self, message: str, retry_after_s: float = 1.0,
                  ws: str = "unknown", ns: str = "unknown",
-                 outcome: str = "shed_rate"):
+                 outcome: str = "shed_rate",
+                 predicted_cost_s: float = 0.0):
         super().__init__(message)
         self.retry_after_s = float(retry_after_s)
         self.ws = ws
         self.ns = ns
         self.outcome = outcome
+        self.predicted_cost_s = float(predicted_cost_s)
 
     def warning(self) -> dict:
         """The structured warning shape riding error envelopes and partial
@@ -101,6 +104,7 @@ class AdmissionRejected(QueryError):
             "ws": self.ws,
             "ns": self.ns,
             "retry_after_s": round(self.retry_after_s, 3),
+            "predicted_cost_s": round(self.predicted_cost_s, 6),
             "error": str(self),
         }
 
@@ -108,12 +112,22 @@ class AdmissionRejected(QueryError):
 class TokenBucket:
     """Classic token bucket with an injectable clock (deterministic
     tests). ``rate`` tokens/second refill up to ``burst``; ``try_take``
-    returns 0.0 on success or the seconds until the next token accrues."""
+    returns 0.0 on success or the seconds until enough tokens accrue.
+
+    Tokens are unit-agnostic: admission runs its buckets in
+    device-seconds (``try_take(predicted_cost_s)``), so an expensive
+    query drains proportionally more than a cheap one. A cost above the
+    bucket capacity is clamped TO the capacity — the request admits after
+    a full drain-and-refill rather than starving forever, and the
+    returned wait is therefore always an achievable drain time (the
+    Retry-After contract: shed, wait the advertised seconds, admit).
+    ``min_burst`` floors the capacity (1.0 = one legacy query token)."""
 
     def __init__(self, rate: float, burst: float,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 min_burst: float = 1.0):
         self.rate = float(rate)
-        self.burst = max(float(burst), 1.0)
+        self.burst = max(float(burst), float(min_burst))
         self._clock = clock
         self._tokens = self.burst
         self._last = clock()
@@ -126,16 +140,21 @@ class TokenBucket:
             )
             self._last = now
 
-    def try_take(self) -> float:
+    def try_take(self, cost: float = 1.0) -> float:
         with self._lock:
+            c = min(max(float(cost), 0.0), self.burst)
             now = self._clock()
             self._refill(now)
-            if self._tokens >= 1.0:
-                self._tokens -= 1.0
+            # nanosecond-of-device-time tolerance: refill accumulates
+            # float error at large clock values, and the Retry-After
+            # contract (shed, wait the advertised seconds, admit) must
+            # not fail by one ulp of (now - last) * rate
+            if self._tokens >= c - 1e-9:
+                self._tokens = max(self._tokens - c, 0.0)
                 return 0.0
             if self.rate <= 0:
                 return float("inf")
-            return (1.0 - self._tokens) / self.rate
+            return (c - self._tokens) / self.rate
 
     def balance(self) -> float:
         with self._lock:
@@ -145,12 +164,21 @@ class TokenBucket:
 
 @dataclass(frozen=True)
 class TenantQuota:
-    """Per-tenant admission quota. ``rate`` <= 0 disables the token
-    bucket; ``max_concurrent`` <= 0 disables the concurrency cap."""
+    """Per-tenant admission quota. Buckets run in DEVICE-SECONDS (the
+    cost model's currency): ``rate_device_s`` device-seconds/second
+    refill up to ``burst_device_s``. Legacy query-count quotas
+    (``rate``/``burst``) are still accepted and converted at bucket-build
+    time via the cost model's flat prior — since the default per-query
+    cost IS that prior, an unconfigured deployment's admission decisions
+    are unchanged by the unit conversion. ``rate``/``rate_device_s`` <= 0
+    disables the token bucket; ``max_concurrent`` <= 0 disables the
+    concurrency cap."""
 
-    rate: float = 0.0  # queries/second refill
-    burst: float = 0.0  # bucket capacity; <= 0 defaults to max(rate, 1)
+    rate: float = 0.0  # legacy: queries/second refill
+    burst: float = 0.0  # legacy: capacity in queries; <= 0 -> max(rate, 1)
     max_concurrent: int = 0
+    rate_device_s: float = 0.0  # device-seconds/second refill (preferred)
+    burst_device_s: float = 0.0  # capacity in device-seconds
 
     @classmethod
     def from_config(cls, cfg: dict) -> "TenantQuota":
@@ -158,19 +186,40 @@ class TenantQuota:
             rate=float(cfg.get("rate", 0.0) or 0.0),
             burst=float(cfg.get("burst", 0.0) or 0.0),
             max_concurrent=int(cfg.get("max_concurrent", 0) or 0),
+            rate_device_s=float(cfg.get("rate_device_s", 0.0) or 0.0),
+            burst_device_s=float(cfg.get("burst_device_s", 0.0) or 0.0),
         )
+
+    def device_rate(self, prior_cost_s: float) -> float:
+        """Refill rate in device-seconds/second (legacy queries/s × the
+        family prior when no native device-second rate is configured)."""
+        if self.rate_device_s > 0:
+            return self.rate_device_s
+        return self.rate * prior_cost_s
+
+    def device_burst(self, prior_cost_s: float) -> float:
+        if self.burst_device_s > 0:
+            return self.burst_device_s
+        if self.rate_device_s > 0:
+            return max(self.rate_device_s, prior_cost_s)
+        q_burst = self.burst if self.burst > 0 else max(self.rate, 1.0)
+        return q_burst * prior_cost_s
 
 
 class _TenantState:
     __slots__ = ("bucket", "quota", "in_flight", "shed")
 
-    def __init__(self, quota: TenantQuota | None, clock):
+    def __init__(self, quota: TenantQuota | None, clock,
+                 prior_cost_s: float = 1.0):
         self.quota = quota
         self.bucket = None
-        if quota is not None and quota.rate > 0:
+        if quota is not None and (quota.rate > 0 or quota.rate_device_s > 0):
             self.bucket = TokenBucket(
-                quota.rate, quota.burst if quota.burst > 0
-                else max(quota.rate, 1.0), clock,
+                quota.device_rate(prior_cost_s),
+                quota.device_burst(prior_cost_s), clock,
+                # capacity floor = ONE prior-priced query, not one legacy
+                # token: device-second bursts are fractions of 1.0
+                min_burst=prior_cost_s,
             )
         self.in_flight = 0
         self.shed = 0
@@ -191,7 +240,10 @@ class AdmissionController:
 
     def __init__(self, quotas: dict | None = None, max_queued: int = 0,
                  clock: Callable[[], float] = time.monotonic,
-                 retry_after_default_s: float = 1.0):
+                 retry_after_default_s: float = 1.0,
+                 prior_cost_s: float | None = None):
+        from .costmodel import DEFAULT_PRIOR_COST_S
+
         self._quotas = {
             k: (q if isinstance(q, TenantQuota) else TenantQuota.from_config(q))
             for k, q in (quotas or {}).items()
@@ -199,6 +251,12 @@ class AdmissionController:
         self.max_queued = int(max_queued)
         self._clock = clock
         self.retry_after_default_s = float(retry_after_default_s)
+        # the legacy-quota conversion rate AND the default price of a
+        # query admitted without a prediction — one constant, so counting
+        # queries and counting prior-priced device-seconds are identical
+        self.prior_cost_s = max(
+            float(prior_cost_s if prior_cost_s is not None
+                  else DEFAULT_PRIOR_COST_S), 1e-6)
         self._states: dict[str, _TenantState] = {}
         self._in_flight = 0
         self._shed_total = 0
@@ -211,7 +269,7 @@ class AdmissionController:
         st = self._states.get(key)
         if st is None:
             st = self._states[key] = _TenantState(
-                self._quota_for(key), self._clock
+                self._quota_for(key), self._clock, self.prior_cost_s
             )
         return st
 
@@ -220,13 +278,18 @@ class AdmissionController:
             "filodb_admission", outcome=outcome, ws=ws, ns=ns
         ).inc()
 
-    def admit(self, ws: str, ns: str):
-        """Admit or shed one query for tenant (ws, ns). Returns a context
-        manager holding the tenant + global concurrency slots; raises
-        :class:`AdmissionRejected` with a computed ``Retry-After`` when the
-        query must shed."""
+    def admit(self, ws: str, ns: str, cost_s: float | None = None):
+        """Admit or shed one query for tenant (ws, ns), draining the
+        tenant's device-second bucket by ``cost_s`` (the cost model's
+        prediction; the flat prior when the caller has none). Returns a
+        context manager holding the tenant + global concurrency slots;
+        raises :class:`AdmissionRejected` with the bucket's ACTUAL
+        predicted drain time as ``Retry-After`` when the query must
+        shed."""
         from ..metering import bounded_tenant_pair
 
+        cost = (float(cost_s) if cost_s is not None and cost_s > 0
+                else self.prior_cost_s)
         ws, ns = bounded_tenant_pair(ws, ns)
         key = f"{ws}/{ns}"
         with self._lock:
@@ -254,19 +317,32 @@ class AdmissionController:
                     ws=ws, ns=ns, outcome="shed_queue",
                 )
             if st.bucket is not None:
-                wait_s = st.bucket.try_take()
+                charge = cost
+                if quota is not None and quota.rate_device_s <= 0:
+                    # legacy query-count quota: never charge LESS than one
+                    # prior-priced query — the operator said "N queries/s"
+                    # and a swarm of model-priced cheap queries must not
+                    # turn that into thousands/s; an expensive query still
+                    # drains proportionally MORE than one
+                    charge = max(cost, self.prior_cost_s)
+                wait_s = st.bucket.try_take(charge)
                 if wait_s > 0:
                     st.shed += 1
                     self._shed_total += 1
                     self._count("shed_rate", ws, ns)
                     raise AdmissionRejected(
-                        f"tenant {key} over rate quota "
-                        f"({st.quota.rate:g}/s)",
+                        f"tenant {key} over device-second quota "
+                        f"({st.bucket.rate:g} dev-s/s; query predicted "
+                        f"{cost:g} dev-s)",
+                        # the bucket's computed drain time IS the hint —
+                        # waiting it out admits by construction (regression
+                        # tested in tests/test_costmodel.py)
                         retry_after_s=min(
                             wait_s, 60.0
                         ) if wait_s != float("inf")
                         else self.retry_after_default_s,
                         ws=ws, ns=ns, outcome="shed_rate",
+                        predicted_cost_s=cost,
                     )
             st.in_flight += 1
             self._in_flight += 1
@@ -291,6 +367,10 @@ class AdmissionController:
                     "tokens": (round(st.bucket.balance(), 3)
                                if st.bucket is not None else None),
                     "rate": st.quota.rate if st.quota else None,
+                    "rate_device_s": (round(st.bucket.rate, 6)
+                                      if st.bucket is not None else None),
+                    "burst_device_s": (round(st.bucket.burst, 6)
+                                       if st.bucket is not None else None),
                     "max_concurrent": (st.quota.max_concurrent
                                        if st.quota else None),
                 }
@@ -300,6 +380,8 @@ class AdmissionController:
                 "in_flight": self._in_flight,
                 "max_queued": self.max_queued,
                 "shed_total": self._shed_total,
+                "unit": "device_seconds",
+                "prior_cost_s": self.prior_cost_s,
                 "tenants": tenants,
             }
 
@@ -457,6 +539,10 @@ class FusedRequest:
     hist_q: bool = False  # hist lane wants the quantile epilogue
     run_single: Callable[[], Any] = None
     timeout_s: float = 60.0
+    # the cost model's device-second prediction for the owning query
+    # (0.0 = unpriced): feeds the scheduler's decayed queue-cost
+    # accumulator, which drives the adaptive batch window
+    predicted_cost_s: float = 0.0
     # stamped by the executing leader (DispatchScheduler._execute) BEFORE
     # the future resolves: the group's actual kernel-launch wall seconds.
     # The waiting caller subtracts it from its total wait to split queue
@@ -611,17 +697,58 @@ class DispatchScheduler:
     to the pre-scheduler behavior). ``max_batch`` closes a group early.
     ``waiter`` is injectable for deterministic tests: it receives the
     group's close event and the window seconds and returns when the window
-    ends (default: ``event.wait(window_s)``)."""
+    ends (default: ``event.wait(window_s)``).
+
+    **Adaptive window** (``window_cap_ms`` > ``window_ms`` > 0): the
+    effective window tracks the decayed sum of predicted device-seconds
+    recently submitted (``FusedRequest.predicted_cost_s``) — it widens
+    toward the cap when predicted queue cost is high (batching pays) and
+    collapses toward zero when the pipe is idle (latency wins; a lone
+    query dispatches immediately). ``load_ref_cost_s`` is the queue cost
+    that saturates the window at its cap. Without a cap the configured
+    window is a constant, exactly the pre-adaptive behavior.
+
+    **Pre-warm**: a QueryEngine registers a prewarmer closure; each
+    ``prewarm_tick`` scans the recurrence ring for keys hot enough to be
+    worth compiling ahead of demand (``prewarm_min_count`` observations;
+    ANY live recompile-storm annotation from the kernel registry lowers
+    the bar to 1 — shape churn means cold executables are about to be
+    hot) and runs each once in the background, off the serving path, so
+    the first real dispatch finds a warm jit cache."""
 
     def __init__(self, window_ms: float = 0.0, max_batch: int = 32,
                  waiter: Callable[[threading.Event, float], Any] | None = None,
-                 key_ring_max: int = 512):
-        self.window_s = max(float(window_ms), 0.0) / 1e3
+                 key_ring_max: int = 512, window_cap_ms: float = 0.0,
+                 load_ref_cost_s: float = 0.25,
+                 prior_cost_s: float | None = None,
+                 prewarm_min_count: int = 3,
+                 clock: Callable[[], float] = time.monotonic):
+        from .costmodel import DEFAULT_PRIOR_COST_S
+
+        self.base_window_s = max(float(window_ms), 0.0) / 1e3
+        self.window_cap_s = max(float(window_cap_ms), 0.0) / 1e3
+        self.adaptive = self.window_cap_s > self.base_window_s > 0
+        self.load_ref_cost_s = max(float(load_ref_cost_s), 1e-6)
+        self.prior_cost_s = max(
+            float(prior_cost_s if prior_cost_s is not None
+                  else DEFAULT_PRIOR_COST_S), 1e-6)
         self.max_batch = max(int(max_batch), 1)
         self._waiter = waiter
         self._open: dict[tuple, _Group] = {}
         self._lock = threading.Lock()
         self._queued = 0
+        # decayed predicted-queue-cost accumulator (device-seconds within
+        # the last ~tau): its own lock so the window property never nests
+        # under the group lock
+        self._clock = clock
+        self._load_lock = threading.Lock()
+        self._load_tau_s = 2.0
+        self._load_cost_s = 0.0
+        self._load_stamp = clock()
+        # pre-warm state: engine-registered executor + once-per-key memo
+        self._prewarm_exec: Callable[[dict], Any] | None = None
+        self._prewarmed: dict = {}
+        self.prewarm_min_count = max(int(prewarm_min_count), 1)
         # per-key recurrence/age ring (standing-query promotion feed):
         # retained across batch close, observed on every fused dispatch
         # whether batching is enabled or not (window_ms 0 keeps the ring
@@ -632,6 +759,7 @@ class DispatchScheduler:
         self.stats = {
             "queries": 0, "batched": 0, "solo": 0, "fallback": 0,
             "coalesced": 0, "dispatches": 0, "merged_windows": 0,
+            "prewarmed": 0,
         }
 
     def observe_key(self, key, desc: dict | None = None) -> None:
@@ -642,13 +770,94 @@ class DispatchScheduler:
 
     @property
     def enabled(self) -> bool:
-        return self.window_s > 0
+        return self.base_window_s > 0
+
+    @property
+    def window_s(self) -> float:
+        """The EFFECTIVE collection window: the configured constant, or —
+        adaptive mode — the cap scaled by how loaded the queue looks
+        (decayed predicted cost / ``load_ref_cost_s``, clamped to 1)."""
+        if not self.adaptive:
+            return self.base_window_s
+        frac = min(self._load() / self.load_ref_cost_s, 1.0)
+        return self.window_cap_s * frac
+
+    def _load(self) -> float:
+        """Decayed predicted queue cost (device-seconds), read-side."""
+        with self._load_lock:
+            dt = self._clock() - self._load_stamp
+            decay = math.exp(-dt / self._load_tau_s) if dt > 0 else 1.0
+            return self._load_cost_s * decay
+
+    def _note_load(self, cost_s: float) -> None:
+        with self._load_lock:
+            now = self._clock()
+            dt = now - self._load_stamp
+            if dt > 0:
+                self._load_cost_s *= math.exp(-dt / self._load_tau_s)
+                self._load_stamp = now
+            self._load_cost_s += max(float(cost_s), 0.0)
+
+    # -- executable pre-warm ------------------------------------------------
+
+    def register_prewarmer(self, fn: Callable[[dict], Any]) -> None:
+        """Install the closure that traces+compiles one recurrence-ring
+        descriptor off the serving path. First registration wins: several
+        engines can share one scheduler, and the primary serving engine
+        (constructed first) is the one whose executables matter."""
+        if self._prewarm_exec is None:
+            self._prewarm_exec = fn
+
+    def prewarm_tick(self, limit: int = 2, storms: dict | None = None) -> list:
+        """One background pre-warm pass: pick up to ``limit`` ring keys
+        that look about-to-be-hot and run each through the registered
+        executor once. ``storms`` (kernel-registry recompile-storm
+        annotations; fetched live when None) lower the recurrence bar to
+        a single observation — when shapes are churning, every key's
+        executable is suspect. Returns the keys warmed this tick."""
+        if self._prewarm_exec is None:
+            return []
+        if storms is None:
+            from ..obs.kernels import KERNELS
+
+            storms = KERNELS.storm_annotations()
+        min_count = 1 if storms else self.prewarm_min_count
+        picks = []
+        for key, e in self.key_ring.entries():
+            if key in self._prewarmed or e["count"] < min_count:
+                continue
+            desc = e.get("desc")
+            if not desc or not desc.get("promql"):
+                continue
+            picks.append((key, desc))
+            if len(picks) >= max(int(limit), 1):
+                break
+        warmed = []
+        for key, desc in picks:
+            self._prewarmed[key] = True
+            while len(self._prewarmed) > 4 * self.key_ring.max_entries:
+                self._prewarmed.pop(next(iter(self._prewarmed)))
+            try:
+                self._prewarm_exec(desc)
+            except Exception:  # noqa: BLE001 — pre-warm is advisory
+                REGISTRY.counter("filodb_prewarm", outcome="error").inc()
+                continue
+            self.stats["prewarmed"] += 1
+            REGISTRY.counter("filodb_prewarm", outcome="ok").inc()
+            warmed.append(key)
+        return warmed
 
     def dispatch(self, request: FusedRequest):
         """Submit one fused dispatch; returns its kernel output (leader
         executes for the whole group, followers share)."""
         if not self.enabled:
             return request.run_single()
+        # feed the adaptive window's queue-cost signal (unpriced requests
+        # count at the flat prior, so load tracks arrival rate even before
+        # the cost model has evidence)
+        self._note_load(request.predicted_cost_s
+                        if request.predicted_cost_s > 0
+                        else self.prior_cost_s)
         fam = request.family()
         key = request.group_key()
         lane = request.lane_key()
@@ -741,8 +950,11 @@ class DispatchScheduler:
         so the next round's group fills almost at once and dispatches
         immediately instead of idling out the rest of the window; a
         sporadic lone query likewise waits only the gap, not the window."""
-        deadline = time.monotonic() + self.window_s
-        gap = self.window_s / 4
+        # capture the effective window ONCE: in adaptive mode the property
+        # moves with load, and a leader must hold a consistent deadline
+        w = self.window_s
+        deadline = time.monotonic() + w
+        gap = w / 4
         while True:
             now = time.monotonic()
             if group.closed.is_set() or now >= deadline:
@@ -830,9 +1042,17 @@ class DispatchScheduler:
     def snapshot(self) -> dict:
         """The /debug/scheduler rendering: window config, live queue state
         and cumulative batching outcomes."""
+        # the window property takes the load lock — read it OUTSIDE the
+        # group lock (neither is reentrant)
+        eff_ms = self.window_s * 1e3
+        load = self._load()
         with self._lock:
             out = {
-                "window_ms": self.window_s * 1e3,
+                "window_ms": eff_ms,
+                "base_window_ms": self.base_window_s * 1e3,
+                "window_cap_ms": self.window_cap_s * 1e3,
+                "adaptive": self.adaptive,
+                "load_cost_s": round(load, 6),
                 "max_batch": self.max_batch,
                 "open_groups": len(self._open),
                 "queued_lanes": self._queued,

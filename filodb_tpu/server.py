@@ -205,6 +205,19 @@ class FiloServer:
             device_timing=bool(kcfg["device_timing"]),
         )
         register_kernel_obs_collector()
+        # work cost model (query/costmodel.py): per-fingerprint predicted
+        # device-seconds, fed back from completed querylog records — it
+        # prices admission and drives the adaptive batch window below
+        from .query.costmodel import COST_MODEL
+
+        cmcfg = {**DEFAULTS["query"]["costmodel"],
+                 **(qcfg.get("costmodel") or {})}
+        COST_MODEL.configure(
+            prior_cost_s=float(cmcfg["prior_cost_s"]),
+            alpha=float(cmcfg["alpha"]),
+            cold_multiplier=float(cmcfg["cold_multiplier"]),
+        )
+        prior_cost_s = float(cmcfg["prior_cost_s"])
         # query dispatch scheduler (query/scheduler.py): ONE process-wide
         # micro-batcher + admission controller shared by every engine
         # (scattering, local and _system) so concurrent queries coalesce
@@ -225,12 +238,21 @@ class FiloServer:
         # standing-query promotion rides the scheduler's per-key recurrence
         # ring, so an enabled standing engine needs the scheduler object
         # even when batching is off (window 0 = ring only, no batching)
+        pwcfg = {**DEFAULTS["query"]["prewarm"],
+                 **(qcfg.get("prewarm") or {})}
+        self.prewarm_config = pwcfg
         if batch_window_ms > 0 or scfg.get("enabled", True):
             from .query.scheduler import DispatchScheduler
 
             self.dispatch_scheduler = DispatchScheduler(
                 batch_window_ms, int(qcfg.get("batch_max", 32) or 32),
                 key_ring_max=int(scfg.get("key_ring_max", 512) or 512),
+                window_cap_ms=float(
+                    qcfg.get("batch_window_cap_ms", 0) or 0),
+                load_ref_cost_s=float(
+                    qcfg.get("batch_load_ref_cost_s", 0.25) or 0.25),
+                prior_cost_s=prior_cost_s,
+                prewarm_min_count=int(pwcfg.get("min_count", 3) or 3),
             )
         self.admission = None
         quotas = qcfg.get("tenant_quotas") or {}
@@ -239,7 +261,8 @@ class FiloServer:
             from .query.scheduler import AdmissionController
 
             self.admission = AdmissionController(
-                quotas, max_queued=admission_max_queued
+                quotas, max_queued=admission_max_queued,
+                prior_cost_s=prior_cost_s,
             )
         common = dict(
             spread=self.spread,
@@ -561,6 +584,13 @@ class FiloServer:
         t = threading.Thread(target=self._maintenance_loop, daemon=True)
         t.start()
         self._threads.append(t)
+        if (self.dispatch_scheduler is not None
+                and self.prewarm_config.get("enabled", True)
+                and int(self.prewarm_config.get("per_tick", 2) or 0) > 0):
+            tp = threading.Thread(target=self._prewarm_loop, daemon=True,
+                                  name="filodb-prewarm")
+            tp.start()
+            self._threads.append(tp)
         log.info("filodb-tpu serving on :%d (%d shards)", actual_port, self.n_shards)
         return actual_port
 
@@ -586,6 +616,19 @@ class FiloServer:
             self._grpc.stop(grace=0.5)
         if self.scheduler is not None:
             self.scheduler.shutdown()
+
+    def _prewarm_loop(self):
+        """Background executable pre-warm (query/scheduler.py
+        prewarm_tick): trace+compile the programs of recurrence-ring keys
+        about to go hot, OFF the serving path, so the first real poll of a
+        recurring dashboard pays zero compiles."""
+        interval = float(self.prewarm_config.get("interval_s", 5.0) or 5.0)
+        limit = int(self.prewarm_config.get("per_tick", 2) or 2)
+        while not self._stop.wait(interval):
+            try:
+                self.dispatch_scheduler.prewarm_tick(limit=limit)
+            except Exception:  # noqa: BLE001
+                log.exception("prewarm tick failed")
 
     def _maintenance_loop(self):
         """Periodic flush + retention eviction + tenant metering (reference

@@ -101,7 +101,10 @@ def fused_reason_violations() -> list[str]:
     the canonical set (metrics.FUSED_FALLBACK_REASONS) must match BOTH the
     doc/perf.md fallback table's rows and every literal reason the code
     records — a reason recorded but undocumented is an undashboarded
-    series, a documented-but-unrecorded one is a dead runbook row."""
+    series, a documented-but-unrecorded one is a dead runbook row, and a
+    canonical entry with NO recording call site is a dead taxonomy entry
+    (a burned-down fallback whose reason must leave the frozenset and the
+    doc table together)."""
     out: list[str] = []
     # canonical set, read from the AST (no imports — runs without jax)
     canon: set[str] = set()
@@ -116,13 +119,36 @@ def fused_reason_violations() -> list[str]:
     if not canon:
         return ["fused-fallback lint: FUSED_FALLBACK_REASONS not found in "
                 "filodb_tpu/metrics.py"]
-    # literal reasons the code records: record_fused_fallback("x") and the
-    # FusedAggregateExec fallback helper self._fall(ctx, "x")
+    # literal reasons the code records. Direct call sites —
+    # record_fused_fallback("x") and the FusedAggregateExec fallback helper
+    # self._fall(ctx, "x") — feed the recorded-but-not-canonical check;
+    # most reasons flow through a variable (returned from a classifier,
+    # threaded through _grid_variant), so the dead-entry direction counts
+    # any EXACT-match string constant in package code outside the
+    # frozenset itself (docstrings never equal a bare reason name).
     recorded: set[str] = set()
+    mentioned: set[str] = set()
     for path in sorted(PKG.rglob("*.py")):
         if "__pycache__" in path.parts:
             continue
-        for node in ast.walk(ast.parse(path.read_text())):
+        tree = ast.parse(path.read_text())
+        if path.name == "metrics.py":
+            # skip the canonical frozenset's own literals
+            for node in ast.walk(tree):
+                if (isinstance(node, ast.Assign) and node.targets
+                        and isinstance(node.targets[0], ast.Name)
+                        and node.targets[0].id == "FUSED_FALLBACK_REASONS"):
+                    skip = {id(c) for c in ast.walk(node.value)}
+                    break
+            else:
+                skip = set()
+        else:
+            skip = set()
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)
+                    and node.value in canon and id(node) not in skip):
+                mentioned.add(node.value)
             if not isinstance(node, ast.Call):
                 continue
             fn = node.func
@@ -147,6 +173,13 @@ def fused_reason_violations() -> list[str]:
             f"fused-fallback reason {r!r} recorded in code but missing from "
             f"metrics.FUSED_FALLBACK_REASONS (it would be minted as "
             f"reason=\"unknown\")"
+        )
+    for r in sorted(canon - (recorded | mentioned)):
+        out.append(
+            f"fused-fallback reason {r!r} is canonical but no code records "
+            f"it — dead taxonomy entry; remove it from "
+            f"metrics.FUSED_FALLBACK_REASONS and doc/perf.md's fallback "
+            f"table together"
         )
     for r in sorted(canon - documented):
         out.append(
